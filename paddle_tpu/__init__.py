@@ -1,0 +1,69 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: /root/reference), built on JAX/XLA/Pallas.
+
+Top-level namespace mirrors ``paddle.*``: tensor ops, nn, optimizer, amp, io,
+distributed, jit, static-analogue compiled path. The compute path is pure
+JAX (XLA on TPU); eager autograd is a tape over jax.vjp closures
+(see autograd/tape.py); distributed is mesh/GSPMD-first (see distributed/).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,  # noqa
+                         float32, float64, get_default_dtype, int8, int16,
+                         int32, int64, set_default_dtype, uint8)
+from .core.flags import get_flags, set_flags  # noqa
+from .core.state import enable_grad, no_grad, set_grad_enabled  # noqa
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa
+
+# Flat op namespace (paddle.* functional surface).
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+
+from . import autograd  # noqa
+from . import amp  # noqa
+from . import distributed  # noqa
+from . import io  # noqa
+from . import jit  # noqa
+from . import nn  # noqa
+from . import optimizer  # noqa
+from .framework.io import load, save  # noqa
+
+import jax as _jax
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return len(_jax.devices())
+
+
+def get_device() -> str:
+    d = _jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str):
+    # Placement is managed by XLA/shardings; accepted for API parity.
+    return device
+
+
+def grad(*args, **kwargs):
+    return autograd.grad(*args, **kwargs)
+
+
+def _monkeypatch_tensor_repr():
+    pass

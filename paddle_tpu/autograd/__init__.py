@@ -1,0 +1,52 @@
+"""Autograd package: tape engine, grad API, PyLayer.
+
+Reference: paddle/fluid/eager/ + python/paddle/autograd/."""
+from __future__ import annotations
+
+from ..core.state import enable_grad, no_grad, set_grad_enabled  # noqa
+from .tape import GradNode, record_node, run_backward  # noqa
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity (subset): grads of outputs w.r.t. inputs without
+    touching .grad. Implemented by running the tape and collecting into a
+    side buffer via temporary hooks.
+
+    Note: create_graph=True (higher-order eager grad) is not yet supported on
+    the eager tape; use the functional API (paddle_tpu.jit / jax.grad) for
+    higher-order derivatives.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use the "
+            "functional/jit path for higher-order gradients")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    # Sink mode: no .grad is touched anywhere in the graph (reference:
+    # general_grad.h computes grads w.r.t. selected inputs only).
+    sink = {}
+    wanted = {id(t): t for t in inputs}
+    run_backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph),
+                 wanted=wanted, sink=sink)
+    out = []
+    from ..core.tensor import Tensor
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have "
+                "been used in the graph (set allow_unused=True to allow).")
+        out.append(Tensor(g) if g is not None else None)
+    return out

@@ -1,0 +1,228 @@
+"""Eager reverse-mode tape engine.
+
+Queue-driven traversal of the recorded graph with in-degree bookkeeping —
+the same algorithm as the reference tape engine (paddle/fluid/eager/backward.cc:105
+RunBackward + getInDegreeMap backward.cc:24-66, GradTensorHolder accumulation),
+re-designed for JAX: each GradNode's backward is a ``jax.vjp`` closure produced
+at forward time by the op dispatcher (ops/_op.py), so there is no per-op
+hand-written grad code and every backward is itself jit-compatible.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import deque
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class GradNode:
+    """One recorded op application (reference: GradNodeBase,
+    paddle/fluid/eager/grad_node_info.h:197)."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs",
+                 "out_refs", "__weakref__")
+
+    def __init__(self, name, vjp_fn, inputs, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn          # cotangents(tuple) -> input grads(tuple)
+        # inputs: list of Tensor (differentiable inputs, strong refs keep the
+        # graph alive through the chain of producing nodes)
+        self.inputs = inputs
+        self.out_avals = out_avals    # [(shape, dtype)] per output slot
+        self.n_outputs = len(out_avals)
+        # weakrefs to output tensors, for hook application / retain_grads
+        self.out_refs = []
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_out={self.n_outputs})"
+
+
+def record_node(name, vjp_fn, input_tensors, output_tensors):
+    """Attach a GradNode to output tensors. Called by the op dispatcher."""
+    avals = [(tuple(o._data.shape), o._data.dtype) for o in output_tensors]
+    node = GradNode(name, vjp_fn, list(input_tensors), avals)
+    for slot, o in enumerate(output_tensors):
+        o._grad_node = node
+        o._output_slot = slot
+        o.stop_gradient = False
+        node.out_refs.append(weakref.ref(o))
+    return node
+
+
+def _collect_graph(roots):
+    """DFS from root nodes; returns (nodes, consumer_count) where
+    consumer_count[node] = number of reachable consumer edges into node
+    (reference: getInDegreeMap)."""
+    visited = set()
+    consumer_count = {}
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        consumer_count.setdefault(id(node), 0)
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None:
+                consumer_count[id(prod)] = consumer_count.get(id(prod), 0) + 1
+                stack.append(prod)
+    return consumer_count
+
+
+def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
+                 retain_graph: bool = False, wanted: Optional[dict] = None,
+                 sink: Optional[dict] = None):
+    """Reference semantics of egr::RunBackward: seed cotangents at ``tensors``,
+    flow to leaves, accumulate into ``leaf.grad``.
+
+    ``sink`` mode (reference: general_grad.h — grad w.r.t. selected inputs):
+    when ``sink`` is a dict, NOTHING is written to any ``.grad``; instead the
+    finalized grads of the tensors in ``wanted`` (id -> Tensor, leaf or
+    intermediate) are recorded into ``sink[id]``. Used by ``paddle.grad``.
+    """
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # node-id -> {slot: accumulated cotangent array}; the GradTensorHolder.
+    buffers = {}
+    id_to_node = {}
+    roots = []
+    # Leaf accumulation buffer: hooks must fire ONCE on the summed grad
+    # (GradNodeAccumulation semantics), not per incoming edge.
+    leaf_buffer = {}  # id(t) -> [tensor, accumulated_array]
+
+    def _seed(t: Tensor, g):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones_like(t._data)
+        elif isinstance(g, Tensor):
+            g = g._data
+        else:
+            g = jnp.asarray(g, dtype=t._data.dtype)
+        return g
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("backward() on a tensor with stop_gradient=True")
+        g = _seed(t, g)
+        node = t._grad_node
+        if node is None:
+            # Leaf root: the grad goes straight to the leaf buffer.
+            _buffer_leaf(leaf_buffer, t, g)
+            continue
+        id_to_node[id(node)] = node
+        buf = buffers.setdefault(id(node), {})
+        slot = t._output_slot
+        buf[slot] = buf[slot] + g if slot in buf else g
+        roots.append(node)
+
+    if not roots:
+        return
+
+    consumer_count = _collect_graph(roots)
+    for n in list({id(r): r for r in roots}.values()):
+        id_to_node[id(n)] = n
+
+    ready = deque(n for n in {id(r): r for r in roots}.values()
+                  if consumer_count.get(id(n), 0) == 0)
+    # Roots with pending consumers (e.g. backward on an intermediate that also
+    # feeds the graph) wait until their consumers drain.
+    pending_roots = [n for n in {id(r): r for r in roots}.values()
+                     if consumer_count.get(id(n), 0) > 0]
+
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        buf = buffers.pop(id(node), {})
+        cotangents = []
+        for slot in range(node.n_outputs):
+            if slot in buf:
+                g = buf[slot]
+            else:
+                shape, dt = node.out_avals[slot]
+                g = jnp.zeros(shape, dt)
+            out_t = node.out_refs[slot]() if slot < len(node.out_refs) else None
+            if out_t is not None and out_t._hooks:
+                for hook in out_t._hooks:
+                    r = hook(Tensor(g))
+                    if r is not None:
+                        g = r._data if isinstance(r, Tensor) else r
+            if (sink is not None and out_t is not None
+                    and wanted and id(out_t) in wanted):
+                prev = sink.get(id(out_t))
+                sink[id(out_t)] = g if prev is None else prev + g
+            cotangents.append(g)
+
+        in_grads = node.vjp_fn(tuple(cotangents) if node.n_outputs > 1 else cotangents[0])
+
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            prod = t._grad_node
+            if prod is None:
+                _buffer_leaf(leaf_buffer, t, g)
+            else:
+                id_to_node[id(prod)] = prod
+                pbuf = buffers.setdefault(id(prod), {})
+                slot = t._output_slot
+                pbuf[slot] = pbuf[slot] + g if slot in pbuf else g
+                consumer_count[id(prod)] -= 1
+                if consumer_count[id(prod)] == 0:
+                    ready.append(prod)
+        if not ready and pending_roots:
+            still = [n for n in pending_roots if id(n) not in processed]
+            ready.extend(n for n in still if consumer_count.get(id(n), 0) <= 0)
+            pending_roots = [n for n in still if consumer_count.get(id(n), 0) > 0]
+
+        if not retain_graph:
+            node.vjp_fn = _freed_vjp(node.name)
+
+    # Finalize leaves: fire hooks once on the summed grad, then write .grad
+    # (or the sink in paddle.grad mode).
+    for t, acc in leaf_buffer.values():
+        gt = Tensor(acc)
+        if t._hooks:
+            for hook in t._hooks:
+                r = hook(gt)
+                if r is not None:
+                    gt = r if isinstance(r, Tensor) else Tensor(r)
+        if sink is not None:
+            if wanted and id(t) in wanted:
+                prev = sink.get(id(t))
+                sink[id(t)] = gt._data if prev is None else prev + gt._data
+        elif t.grad is None:
+            t.grad = Tensor(gt._data)
+        else:
+            t.grad._data = t.grad._data + gt._data
+
+
+def _freed_vjp(name):
+    def _err(*_):
+        raise RuntimeError(
+            f"Trying to run backward through {name} a second time, but the "
+            "graph was freed. Pass retain_graph=True the first time.")
+    return _err
+
+
+def _buffer_leaf(leaf_buffer: dict, t: Tensor, g):
+    """GradNodeAccumulation equivalent: sum per-edge contributions; hooks and
+    the .grad write happen once at the end of run_backward (this is where
+    DP/sharding comm overlap attaches — reference: parallel.py:417 reducer
+    hooks)."""
+    entry = leaf_buffer.get(id(t))
+    if entry is None:
+        leaf_buffer[id(t)] = [t, g]
+    else:
+        entry[1] = entry[1] + g

@@ -1,0 +1,2 @@
+from . import dtype, flags, state  # noqa
+from .tensor import Parameter, Tensor, to_tensor  # noqa

@@ -1,0 +1,104 @@
+"""Dtype system for paddle_tpu.
+
+TPU-first dtype registry: canonical names mirror the reference framework's
+``paddle.dtype`` vocabulary (reference: paddle/phi/common/data_type.h) but map
+directly onto JAX/XLA dtypes. bfloat16 is a first-class citizen (MXU-native);
+float64 is supported but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are the jnp dtypes themselves: keeping them native
+# means zero conversion cost at dispatch time and full XLA compatibility.
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize a user-provided dtype (string / numpy / jnp) to a numpy dtype.
+
+    Mirrors the reference's ``convert_dtype`` helper
+    (python/paddle/base/data_feeder.py) but without the VarDesc legacy enum.
+
+    TPU-first canonicalization: unless ``jax_enable_x64`` is on, 64-bit dtypes
+    canonicalize to their 32-bit counterparts — TPUs have no native f64 and
+    int32 indexing is the fast path. This matches JAX's own behavior, made
+    explicit here.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+        d = np.dtype(_NAME_TO_DTYPE[key])
+    else:
+        d = np.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64:
+        d = {np.dtype(np.int64): np.dtype(np.int32),
+             np.dtype(np.uint64): np.dtype(np.uint32),
+             np.dtype(np.float64): np.dtype(np.float32),
+             np.dtype(np.complex128): np.dtype(np.complex64)}.get(d, d)
+    return d
+
+
+def is_floating_point(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == np.dtype(bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)
+
+
+_DEFAULT_DTYPE = [np.dtype(float32)]
+
+
+def get_default_dtype():
+    """Default floating dtype for parameter/tensor creation (paddle parity:
+    python/paddle/base/framework.py get_default_dtype)."""
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if not (is_floating_point(d)):
+        raise TypeError(f"set_default_dtype only accepts floating dtypes, got {dtype}")
+    _DEFAULT_DTYPE[0] = d

@@ -1,0 +1,81 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's exported-flag system
+(paddle/common/flags.h:336 ExportedFlagInfoMap, PHI_DEFINE_EXPORTED_* macros):
+typed flags with defaults, overridable from the environment (``FLAGS_*``) and
+from Python via ``set_flags`` / ``get_flags`` — the same user surface as
+``paddle.set_flags``.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+
+@dataclass
+class _FlagInfo:
+    name: str
+    default: Any
+    doc: str
+    parser: Callable[[str], Any]
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _FlagInfo] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default, doc: str = ""):
+    """Register a flag. Type inferred from the default. Env var ``FLAGS_<name>``
+    overrides the default at registration time."""
+    if isinstance(default, bool):
+        parser = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    value = default
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        value = parser(env)
+    _REGISTRY[name] = _FlagInfo(name, default, doc, parser, value)
+
+
+def get_flags(flags):
+    """paddle.get_flags parity: accepts a str or list of str, returns a dict."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[len("FLAGS_"):] if f.startswith("FLAGS_") else f
+        if key not in _REGISTRY:
+            raise ValueError(f"Flag {f} is not registered")
+        out[f] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity."""
+    for k, v in flags.items():
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise ValueError(f"Flag {k} is not registered")
+        info = _REGISTRY[key]
+        info.value = info.parser(v) if isinstance(v, str) else v
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].value
+
+
+# Core flags (subset of the reference's ~150, the ones with TPU meaning).
+define_flag("check_nan_inf", False, "Check outputs for NaN/Inf after each op (debug).")
+define_flag("use_pallas_kernels", True, "Use hand-written Pallas kernels where available.")
+define_flag("eager_jit_ops", True, "jit-compile each eager op (cached) instead of op-by-op dispatch.")
+define_flag("default_matmul_precision", "default", "jax matmul precision: default|high|highest.")

@@ -1,0 +1,63 @@
+"""Global eager-mode state: grad recording and functional (tracing) mode."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        # Functional mode: set while a program is being traced by jax.jit /
+        # jax.grad (the compiled "static graph" path). In this mode the eager
+        # tape is bypassed entirely — differentiation is done by jax on the
+        # whole step function, which is the TPU-native equivalent of the
+        # reference's static autograd (SURVEY.md §3.3).
+        self.functional_depth = 0
+
+
+_state = _State()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled and _state.functional_depth == 0
+
+
+def in_functional_mode() -> bool:
+    return _state.functional_depth > 0
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad parity."""
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def functional_mode():
+    _state.functional_depth += 1
+    try:
+        yield
+    finally:
+        _state.functional_depth -= 1
+
+
+def set_grad_enabled(mode: bool):
+    prev = _state.grad_enabled
+    _state.grad_enabled = bool(mode)
+    return prev

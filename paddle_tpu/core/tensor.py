@@ -1,0 +1,407 @@
+"""The Tensor facade.
+
+TPU-native design: a thin mutable handle around an immutable ``jax.Array``
+(or a jax tracer when inside a jitted program). This gives the imperative,
+Paddle-shaped user experience (``.grad``, ``backward()``, in-place-looking
+updates) on top of JAX's functional core:
+
+- eager mode: every op goes through the op dispatcher (ops/_op.py) which
+  records a GradNode on the global tape (autograd/tape.py). This mirrors the
+  reference's eager ad-func + GradNodeBase design
+  (paddle/fluid/eager/grad_node_info.h:197) without codegen: jax.vjp supplies
+  the per-op backward closure.
+- functional/jit mode: the same Tensor methods run on tracers with the tape
+  disabled; jax.grad over the whole step provides autograd (the static path).
+
+"Mutation" (``set_value``, optimizer updates) rebinds ``_data`` — the handle
+is mutable, the array is not. This is exactly the discipline XLA wants
+(donated buffers in compiled steps) while preserving Paddle's API.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from . import state
+
+
+class Tensor:
+    """paddle.Tensor parity surface, backed by jax.Array.
+
+    Reference: the eager tensor (paddle/fluid/eager + phi::DenseTensor,
+    paddle/phi/core/dense_tensor.h:37). Here there is one tensor type for all
+    placements: a sharded ``jax.Array`` with a NamedSharding *is* the
+    DistTensor (SURVEY.md §7 table).
+    """
+
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "name",
+        "persistable",
+        "_grad_node",
+        "_output_slot",
+        "_hooks",
+        "_placements",
+        "_process_mesh",
+        "__weakref__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name
+        self.persistable = False
+        self._grad_node = None   # producing GradNode (autograd/tape.py)
+        self._output_slot = 0    # index among producer's outputs
+        self._hooks = None       # list of grad hooks
+        self._placements = None  # distributed placement annotation
+        self._process_mesh = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        d = getattr(self._data, "devices", None)
+        if d is None:
+            return "traced"
+        try:
+            return str(next(iter(self._data.devices())))
+        except Exception:
+            return "traced"
+
+    @property
+    def placements(self):
+        return self._placements
+
+    @property
+    def process_mesh(self):
+        return self._process_mesh
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self.dtype).itemsize
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        from .. import ops
+        return ops.clone(self)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    # -- mutation (handle rebinding) ---------------------------------------
+    def set_value(self, value):
+        """In-place value assignment (paddle Tensor.set_value parity)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: tensor {tuple(self._data.shape)} vs value {tuple(value.shape)}")
+        self._data = value
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        return self
+
+    def add_(self, other):
+        other = other._data if isinstance(other, Tensor) else other
+        self._data = self._data + other
+        return self
+
+    def subtract_(self, other):
+        other = other._data if isinstance(other, Tensor) else other
+        self._data = self._data - other
+        return self
+
+    def multiply_(self, other):
+        other = other._data if isinstance(other, Tensor) else other
+        self._data = self._data * other
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._data = jnp.clip(self._data, min, max)
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """Imperative reverse-mode (paddle Tensor.backward parity).
+
+        Queue-driven traversal mirroring the reference tape engine
+        (paddle/fluid/eager/backward.cc:105 RunBackward).
+        """
+        from ..autograd import tape
+        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad._data = jnp.zeros_like(self.grad._data)
+        else:
+            self.grad = None
+
+    def register_hook(self, hook):
+        """Register a grad hook: hook(grad: Tensor) -> Tensor | None."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+        return _Handle(self._hooks, hook)
+
+    def retain_grads(self):
+        # Non-leaf grad retention: record a hook that stashes the grad.
+        def _stash(g):
+            self.grad = g
+            return g
+        if self._grad_node is not None:
+            self.register_hook(_stash)
+
+    # -- operator overloads (route through ops for tape recording) ----------
+    def _binop(self, other, opname, reverse=False):
+        from .. import ops
+        fn = getattr(ops, opname)
+        return fn(other, self) if reverse else fn(self, other)
+
+    def __add__(self, o):
+        return self._binop(o, "add")
+
+    def __radd__(self, o):
+        return self._binop(o, "add", True)
+
+    def __sub__(self, o):
+        return self._binop(o, "subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, "subtract", True)
+
+    def __mul__(self, o):
+        return self._binop(o, "multiply")
+
+    def __rmul__(self, o):
+        return self._binop(o, "multiply", True)
+
+    def __truediv__(self, o):
+        return self._binop(o, "divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "divide", True)
+
+    def __floordiv__(self, o):
+        return self._binop(o, "floor_divide")
+
+    def __mod__(self, o):
+        return self._binop(o, "mod")
+
+    def __pow__(self, o):
+        return self._binop(o, "pow")
+
+    def __rpow__(self, o):
+        return self._binop(o, "pow", True)
+
+    def __matmul__(self, o):
+        return self._binop(o, "matmul")
+
+    def __neg__(self):
+        from .. import ops
+        return ops.scale(self, scale=-1.0)
+
+    def __abs__(self):
+        from .. import ops
+        return ops.abs(self)
+
+    def __eq__(self, o):
+        return self._binop(o, "equal")
+
+    def __ne__(self, o):
+        return self._binop(o, "not_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "less_than")
+
+    def __le__(self, o):
+        return self._binop(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binop(o, "greater_equal")
+
+    def __invert__(self):
+        from .. import ops
+        return ops.logical_not(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __getitem__(self, idx):
+        from .. import ops
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        # Functional scatter under the hood (jax .at[].set); rebinds the handle.
+        from .. import ops
+        value = value._data if isinstance(value, Tensor) else value
+        idx = ops._unwrap_index(idx)
+        self._data = self._data.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            body = np.array2string(np.asarray(self._data), precision=4, separator=", ")
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    # -- common method aliases (filled further by ops.register_methods) -----
+    def dim(self):
+        return self.ndim
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor(children[0], stop_gradient=aux[0], name=aux[1])
+    return t
+
+
+# Registering Tensor as a pytree makes the whole eager API usable directly
+# under jax.jit / shard_map: handles flatten to their arrays.
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (paddle.base.framework.Parameter parity):
+    stop_gradient defaults False, persistable True."""
+
+    def __init__(self, data, name: Optional[str] = None, trainable: bool = True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._data,), (p.stop_gradient, p.name)),
+    lambda aux, ch: Parameter(ch[0], name=aux[1], trainable=not aux[0]),
+)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    del place  # device placement is handled by jax; sharding via dist API
+    if isinstance(data, Tensor):
+        d = data._data
+        if dtype is not None:
+            d = d.astype(dtypes.convert_dtype(dtype))
+        return Tensor(d, stop_gradient=stop_gradient)
+    if dtype is not None:
+        dtype = dtypes.convert_dtype(dtype)
+    arr = jnp.asarray(data, dtype=dtype)
+    # Paddle promotes python floats to the default dtype (float32), not f64.
+    if dtype is None and arr.dtype == jnp.float64 and not jax.config.jax_enable_x64:
+        arr = arr.astype(dtypes.get_default_dtype())
+    return Tensor(arr, stop_gradient=stop_gradient)

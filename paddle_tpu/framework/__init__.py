@@ -1,0 +1,2 @@
+from .random import (Generator, default_generator, get_rng_state, next_key,  # noqa
+                     rng_scope, seed, set_rng_state)

@@ -1,0 +1,56 @@
+"""Serialization: paddle.save / paddle.load parity
+(reference: python/paddle/framework/io.py).
+
+State dicts of Tensors are stored as pickled numpy arrays; nested containers
+are preserved. Distributed (sharded) checkpointing lives in
+distributed/checkpoint/."""
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return {"__pt_tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_storable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__pt_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(__import__("jax.numpy", fromlist=["asarray"]).asarray(obj["data"]),
+                       stop_gradient=obj["stop_gradient"], name=obj.get("name"))
+            return t
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_storable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save parity."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with open(p, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    """paddle.load parity."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy=return_numpy)

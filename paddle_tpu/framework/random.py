@@ -1,0 +1,92 @@
+"""RNG state management.
+
+Design: a global eager generator (paddle.seed parity) plus an explicit
+``rng_scope`` for pure/jitted code — inside a scope, keys derive
+deterministically from the scope key by ``fold_in`` on a trace-time counter,
+so a jitted train step that takes a per-step key is fully functional (the
+TPU-native replacement for the reference's per-device RNG state + the
+RNGStatesTracker used for TP determinism,
+python/paddle/distributed/fleet/layers/mpu/random.py:34)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class Generator:
+    """Stateful key generator (eager mode)."""
+
+    def __init__(self, seed_: int = 0):
+        self._key = jax.random.PRNGKey(seed_)
+        self._seed = seed_
+
+    def manual_seed(self, s: int):
+        self._key = jax.random.PRNGKey(s)
+        self._seed = s
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+
+default_generator = Generator(0)
+
+
+class _ScopeState(threading.local):
+    def __init__(self):
+        self.stack = []  # list of [key, counter]
+
+
+_scopes = _ScopeState()
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Pure RNG scope: all random ops inside draw keys derived from ``key``.
+    Safe under jit tracing (counter advances at trace time, deterministically)."""
+    _scopes.stack.append([key, 0])
+    try:
+        yield
+    finally:
+        _scopes.stack.pop()
+
+
+def next_key():
+    """Key for one random op: from the innermost rng_scope if present,
+    else from the global eager generator."""
+    if _scopes.stack:
+        entry = _scopes.stack[-1]
+        k = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return k
+    return default_generator.next_key()
+
+
+def in_rng_scope() -> bool:
+    return bool(_scopes.stack)
+
+
+def seed(s: int):
+    """paddle.seed parity: reseed the global generator."""
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    default_generator.set_state(state[0])

@@ -1,0 +1,146 @@
+"""Aggregate op namespace (the `_C_ops`-equivalent flat op surface,
+reference: python/paddle/_C_ops.py re-exporting core.eager.ops). Also attaches
+the op set onto Tensor as methods (paddle Tensor method parity)."""
+from __future__ import annotations
+
+from ._op import (_unwrap_index, get_op, op_fn, registered_ops, unwrap,  # noqa
+                  wrap)
+from .creation import *  # noqa
+from .math import *  # noqa
+from .reduction import *  # noqa
+from .manipulation import *  # noqa
+from .linalg import *  # noqa
+from .logic import *  # noqa
+from .random import *  # noqa
+
+from ..core.tensor import Tensor
+
+
+def _m(name, f, positional_kw=None):
+    """Attach op as a Tensor method. ``positional_kw``: names of paddle's
+    positional args that the pure op takes as keywords (e.g. reshape(shape))."""
+    import functools
+    if positional_kw:
+        @functools.wraps(f)
+        def meth(self, *args, **kwargs):
+            for kw, a in zip(positional_kw, args):
+                kwargs[kw] = a
+            return f(self, **kwargs)
+    else:
+        @functools.wraps(f)
+        def meth(self, *args, **kwargs):
+            return f(self, *args, **kwargs)
+    if not hasattr(Tensor, name):
+        setattr(Tensor, name, meth)
+
+
+def _register_tensor_methods():
+    # Ops whose pure fn takes only positional tensor args (safe to forward
+    # the method call verbatim). Ops with keyword-only config args go in the
+    # `kw` table or get explicit adapters below.
+    simple = [
+        "add", "subtract", "multiply", "divide", "mod", "pow", "abs", "exp",
+        "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "sin",
+        "cos", "tan", "tanh", "sigmoid", "floor", "ceil", "round", "trunc",
+        "sign", "reciprocal", "maximum", "minimum", "erf", "erfinv", "matmul",
+        "dot", "inner", "outer", "cross", "cholesky", "inv", "det",
+        "equal", "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal", "logical_and", "logical_or", "logical_xor",
+        "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor",
+        "bitwise_not", "isclose", "allclose", "equal_all", "isnan", "isinf",
+        "isfinite", "where", "topk", "unique", "t",
+        "zero_", "numel", "conj", "real", "imag", "angle", "lerp",
+        "clone", "masked_select", "gather_nd",
+        "kron", "frac", "digamma", "lgamma", "atan", "asin", "acos",
+        "sinh", "cosh", "asinh", "acosh", "atanh", "expm1",
+        "heaviside", "hypot", "deg2rad", "rad2deg", "unbind",
+    ]
+    import sys
+    ns = sys.modules[__name__].__dict__
+    for name in simple:
+        if name in ns:
+            _m(name, ns[name])
+
+    kw = {
+        "sum": ["axis", "keepdim"],
+        "mean": ["axis", "keepdim"],
+        "max": ["axis", "keepdim"],
+        "min": ["axis", "keepdim"],
+        "prod": ["axis", "keepdim"],
+        "amax": ["axis", "keepdim"],
+        "amin": ["axis", "keepdim"],
+        "all": ["axis", "keepdim"],
+        "any": ["axis", "keepdim"],
+        "argmax": ["axis", "keepdim"],
+        "argmin": ["axis", "keepdim"],
+        "std": ["axis", "unbiased", "keepdim"],
+        "var": ["axis", "unbiased", "keepdim"],
+        "median": ["axis", "keepdim"],
+        "reshape": ["shape"],
+        "transpose": ["perm"],
+        "flatten": ["start_axis", "stop_axis"],
+        "squeeze": ["axis"],
+        "unsqueeze": ["axis"],
+        "tile": ["repeat_times"],
+        "expand": ["shape"],
+        "clip": ["min", "max"],
+        "scale": ["scale", "bias"],
+        "flip": ["axis"],
+        "moveaxis": ["source", "destination"],
+        "norm": ["p", "axis", "keepdim"],
+        "sort": ["axis", "descending"],
+        "argsort": ["axis", "descending"],
+        "cumsum": ["axis"],
+        "cumprod": ["dim"],
+        "logsumexp": ["axis", "keepdim"],
+        "logit": ["eps"],
+        "nan_to_num": ["nan", "posinf", "neginf"],
+        "roll": ["shifts", "axis"],
+        "tril": ["diagonal"],
+        "triu": ["diagonal"],
+        "diagonal": ["offset", "axis1", "axis2"],
+        "trace": ["offset", "axis1", "axis2"],
+        "repeat_interleave": ["repeats", "axis"],
+        "broadcast_to": ["shape"],
+        "nonzero": ["as_tuple"],
+        "bincount": ["weights", "minlength"],
+    }
+    for name, kws in kw.items():
+        if name in ns:
+            _m(name, ns[name], positional_kw=kws)
+
+    # methods needing custom signatures
+    def split_m(self, num_or_sections, axis=0):
+        return split(self, num_or_sections, axis=axis)
+    def chunk_m(self, chunks, axis=0):
+        return chunk(self, chunks, axis=axis)
+    def cast_m(self, dtype):
+        return cast(self, dtype)
+    def item_m(self):
+        return self._data.item()
+    if not hasattr(Tensor, "split"):
+        Tensor.split = split_m
+        Tensor.chunk = chunk_m
+        Tensor.cast = cast_m
+    Tensor.mm = lambda self, y: matmul(self, y)
+    Tensor.bmm = lambda self, y: matmul(self, y)
+    Tensor.unstack = lambda self, axis=0: unbind(self, axis=axis)
+    # Mixed positional/keyword adapters (first args are tensors, trailing
+    # paddle-positional args map onto kw-only config of the pure fn).
+    Tensor.masked_fill = lambda self, mask, value: masked_fill(self, mask, value=value)
+    Tensor.gather = lambda self, index, axis=0: gather(self, index, axis=axis)
+    Tensor.index_select = lambda self, index, axis=0: index_select(self, index, axis=axis)
+    Tensor.take_along_axis = (
+        lambda self, indices, axis, broadcast=True: take_along_axis(self, indices, axis=axis))
+    Tensor.put_along_axis = (
+        lambda self, indices, values, axis, reduce="assign":
+        put_along_axis(self, indices, values, axis=axis, reduce=reduce))
+    Tensor.scatter = (
+        lambda self, index, updates, overwrite=True:
+        scatter(self, index, updates, overwrite=overwrite))
+    Tensor.tensordot = lambda self, y, axes=2: tensordot(self, y, axes=axes)
+    Tensor.index_add = (
+        lambda self, index, axis, value: index_add(self, index, axis=axis, value=value))
+
+
+_register_tensor_methods()

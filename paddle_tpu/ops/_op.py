@@ -1,0 +1,137 @@
+"""Op dispatcher: the eager "ad-function" layer.
+
+TPU-native replacement for the reference's generated per-op forward wrappers
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:301
+FORWARD_FUNCTION_TEMPLATE) and kernel dispatch
+(paddle/phi/core/kernel_factory.cc:230 SelectKernelOrThrowError):
+
+- every op is a *pure JAX function* over arrays (the single source of truth,
+  like the reference's ops.yaml specs);
+- the ``@op_fn`` decorator produces the user-facing eager function: unwrap
+  Tensor handles, run the pure function (XLA dispatches to TPU), and — when
+  grads are needed — record a GradNode whose backward is the ``jax.vjp``
+  closure of the same pure function. No per-op grad code, no codegen step.
+- under jit tracing ("functional mode") the tape is bypassed; the same pure
+  functions trace into the compiled program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core import state
+from ..core.flags import flag_value
+from ..core.tensor import Tensor
+
+_OP_REGISTRY = {}
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_diff_dtype(dt) -> bool:
+    # Only inexact dtypes participate in AD (int leaves would otherwise
+    # produce jax float0 tangents).
+    return jnp.issubdtype(dt, jnp.inexact)
+
+
+def wrap(x, stop_gradient=True):
+    return Tensor(x, stop_gradient=stop_gradient)
+
+
+def _unwrap_index(idx):
+    """Unwrap Tensors inside an indexing expression."""
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    return idx
+
+
+def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
+          nondiff_args: tuple = ()):
+    """Decorator turning a pure JAX function into an eager op.
+
+    Convention: tensor inputs are positional; config is keyword-only.
+    ``nondiff_args``: positional indices never differentiated (e.g. integer
+    label inputs). Comparison/int-output ops pass ``differentiable=False``.
+    """
+    if fn is None:
+        return functools.partial(op_fn, name=name, differentiable=differentiable,
+                                 nondiff_args=nondiff_args)
+    opname = name or fn.__name__
+
+    @functools.wraps(fn)
+    def dispatch(*args, **kwargs):
+        raw = [unwrap(a) for a in args]
+        kwraw = {k: unwrap(v) for k, v in kwargs.items()}
+
+        need_grad = (
+            differentiable
+            and state.grad_enabled()
+            and any(isinstance(a, Tensor) and not a.stop_gradient
+                    and i not in nondiff_args
+                    and _is_diff_dtype(a._data.dtype)
+                    for i, a in enumerate(args))
+        )
+
+        if not need_grad:
+            out = fn(*raw, **kwraw)
+            if flag_value("check_nan_inf"):
+                _check_nan_inf(opname, out)
+            if isinstance(out, tuple):
+                return tuple(wrap(o) for o in out)
+            return wrap(out)
+
+        diff_idx = [i for i, a in enumerate(args)
+                    if isinstance(a, Tensor) and not a.stop_gradient
+                    and i not in nondiff_args
+                    and _is_diff_dtype(a._data.dtype)]
+        diff_tensors = [args[i] for i in diff_idx]
+
+        def closed(*diff_arrays):
+            full = list(raw)
+            for i, a in zip(diff_idx, diff_arrays):
+                full[i] = a
+            return fn(*full, **kwraw)
+
+        out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+        if flag_value("check_nan_inf"):
+            _check_nan_inf(opname, out)
+
+        from ..autograd import tape
+        if isinstance(out, tuple):
+            outs = [wrap(o) for o in out]
+            tape.record_node(opname, vjp_fn, diff_tensors, outs)
+            return tuple(outs)
+        out_t = wrap(out)
+        tape.record_node(opname, vjp_fn, diff_tensors, [out_t])
+        return out_t
+
+    dispatch.pure_fn = fn
+    dispatch.op_name = opname
+    _OP_REGISTRY[opname] = dispatch
+    return dispatch
+
+
+def get_op(name: str):
+    return _OP_REGISTRY.get(name)
+
+
+def registered_ops():
+    return dict(_OP_REGISTRY)
+
+
+def _check_nan_inf(opname, out):
+    outs = out if isinstance(out, tuple) else (out,)
+    for o in outs:
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
+            bad = bool(jnp.any(~jnp.isfinite(o)))
+            if bad:
+                raise FloatingPointError(f"NaN/Inf detected in output of op '{opname}'")

@@ -1,0 +1,131 @@
+"""Creation ops (paddle.tensor.creation parity, python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-exported)
+from ._op import op_fn, unwrap, wrap
+
+
+def _dt(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def zeros(shape, dtype=None):
+    return wrap(jnp.zeros(shape, _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return wrap(jnp.ones(shape, _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    fill_value = unwrap(fill_value)
+    return wrap(jnp.full(shape, fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None):
+    return wrap(jnp.zeros(shape, _dt(dtype)))
+
+
+@op_fn
+def zeros_like(x, *, dtype=None):
+    return jnp.zeros_like(x, dtype=dtypes.convert_dtype(dtype) if dtype else None)
+
+
+@op_fn
+def ones_like(x, *, dtype=None):
+    return jnp.ones_like(x, dtype=dtypes.convert_dtype(dtype) if dtype else None)
+
+
+@op_fn
+def full_like(x, fill_value, *, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtypes.convert_dtype(dtype) if dtype else None)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = dtypes.convert_dtype("int64")  # canonicalizes per x64 mode
+        else:
+            dtype = dtypes.get_default_dtype()
+    else:
+        dtype = dtypes.convert_dtype(dtype)
+    return wrap(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@op_fn
+def clone(x):
+    # Arrays are immutable; a differentiable identity is a true clone.
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    """paddle.assign parity: copy into `output` if given."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    if output is None:
+        return clone(x)
+    output.set_value(x)
+    return output
+
+
+@op_fn
+def diag(x, *, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+@op_fn
+def diagflat(x, *, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@op_fn
+def tril(x, *, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@op_fn
+def triu(x, *, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, indexing="ij"):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return tuple(wrap(g) for g in jnp.meshgrid(*arrays, indexing=indexing))
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, offset, col)
+    return wrap(jnp.stack([r, c]))
+
+
+def triu_indices(row, col, offset=0):
+    r, c = jnp.triu_indices(row, offset, col)
+    return wrap(jnp.stack([r, c]))
+
+
+def complex(real, imag):
+    return wrap(jnp.asarray(unwrap(real)) + 1j * jnp.asarray(unwrap(imag)))

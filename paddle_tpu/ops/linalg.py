@@ -1,0 +1,194 @@
+"""Linear algebra ops (paddle.tensor.linalg + paddle.linalg parity).
+
+Matmuls are the MXU workload: everything here lowers to XLA dot_general with
+a configurable precision (bf16-first on TPU). Replaces the reference's cuBLAS
+bindings (paddle/phi/kernels/funcs/blas/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import flag_value
+from ._op import op_fn, unwrap, wrap
+
+
+def _precision():
+    p = flag_value("default_matmul_precision")
+    return None if p == "default" else p
+
+
+@op_fn
+def matmul(x, y, *, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return matmul(x, y)
+
+
+@op_fn
+def dot(x, y):
+    # paddle.dot: 1-D or batched 1-D inner product.
+    return jnp.sum(x * y, axis=-1)
+
+
+@op_fn
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@op_fn
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op_fn
+def cross(x, y, *, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@op_fn(name="einsum")
+def _einsum(*operands, equation):
+    return jnp.einsum(equation, *operands, precision=_precision())
+
+
+def einsum(equation, *operands):
+    return _einsum(*operands, equation=equation)
+
+
+@op_fn
+def norm(x, *, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = 2 if axis is not None or x.ndim == 1 else "fro"
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@op_fn
+def matrix_norm(x, *, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@op_fn
+def cholesky(x, *, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@op_fn
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+@op_fn
+def pinv(x, *, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+@op_fn
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@op_fn
+def triangular_solve(x, y, *, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+@op_fn
+def cholesky_solve(x, y, *, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@op_fn(differentiable=False)
+def matrix_rank(x, *, tol=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@op_fn
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@op_fn
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@op_fn
+def matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
+    return wrap(q), wrap(r)
+
+
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
+    return wrap(u), wrap(s), wrap(jnp.swapaxes(vh, -1, -2))
+
+
+def eig(x):
+    w, v = jnp.linalg.eig(unwrap(x))
+    return wrap(w), wrap(v)
+
+
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
+    return wrap(w), wrap(v)
+
+
+def eigvals(x):
+    return wrap(jnp.linalg.eigvals(unwrap(x)))
+
+
+def eigvalsh(x, UPLO="L"):
+    return wrap(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO))
+
+
+def lu(x):
+    lu_, piv = jax.scipy.linalg.lu_factor(unwrap(x))
+    return wrap(lu_), wrap(piv)
+
+
+@op_fn
+def lstsq_sol(x, y):
+    sol, _, _, _ = jnp.linalg.lstsq(x, y)
+    return sol
+
+
+@op_fn
+def multi_dot_op(*xs):
+    return jnp.linalg.multi_dot(xs, precision=_precision())
+
+
+def multi_dot(xs):
+    return multi_dot_op(*xs)
+
+
+@op_fn
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)

@@ -1,0 +1,102 @@
+"""Comparison / logical / bitwise ops (paddle.tensor.logic parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._op import op_fn, unwrap
+
+
+@op_fn(differentiable=False)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@op_fn(differentiable=False)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@op_fn(differentiable=False)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@op_fn(differentiable=False)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@op_fn(differentiable=False)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@op_fn(differentiable=False)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@op_fn(differentiable=False)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@op_fn(differentiable=False)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@op_fn(differentiable=False)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@op_fn(differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@op_fn(differentiable=False)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@op_fn(differentiable=False)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@op_fn(differentiable=False)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@op_fn(differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@op_fn(differentiable=False)
+def isclose(x, y, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    from ._op import wrap
+    return wrap(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                             equal_nan=equal_nan))
+
+
+def equal_all(x, y):
+    from ._op import wrap
+    return wrap(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+@op_fn(differentiable=False)
+def isin(x, test_x):
+    return jnp.isin(x, test_x)

@@ -1,0 +1,407 @@
+"""Shape/layout manipulation ops (paddle.tensor.manipulation parity).
+
+On TPU these are metadata or cheap relayout ops for XLA — the equivalent of
+the reference's zero-copy stride kernels (paddle/phi/kernels/stride/) without
+the aliasing hazards: arrays are immutable, so "views" are safe by
+construction and XLA elides copies where layouts permit."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from ._op import op_fn, unwrap, wrap, _unwrap_index
+
+
+@op_fn
+def reshape(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+@op_fn
+def transpose(x, *, perm):
+    return jnp.transpose(x, axes=perm)
+
+
+def t(x):
+    if x.ndim <= 1:
+        return x
+    return transpose(x, perm=list(range(x.ndim))[::-1])
+
+
+@op_fn
+def moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op_fn
+def swapaxes(x, *, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@op_fn
+def flatten(x, *, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    sa = start_axis % nd
+    so = stop_axis % nd
+    shape = x.shape[:sa] + (-1,) + x.shape[so + 1:]
+    return jnp.reshape(x, shape)
+
+
+@op_fn
+def squeeze(x, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@op_fn
+def unsqueeze(x, *, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def concat(xs, axis=0):
+    return _concat(*xs, axis=axis)
+
+
+@op_fn(name="concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def stack(xs, axis=0):
+    return _stack(*xs, axis=axis)
+
+
+@op_fn(name="stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@op_fn
+def split_op(x, *, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list (may contain -1)
+    secs = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in secs:
+        known = sum(s for s in secs if s != -1)
+        secs[secs.index(-1)] = total - known
+    points = np.cumsum(secs)[:-1].tolist()
+    return tuple(jnp.split(x, points, axis=axis))
+
+
+def split(x, num_or_sections, axis=0):
+    return list(split_op(x, num_or_sections=num_or_sections, axis=axis))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    parts = split(x, n, axis=axis)
+    return [squeeze(p, axis=axis) for p in parts]
+
+
+@op_fn
+def tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+@op_fn
+def expand(x, *, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@op_fn
+def broadcast_to(x, *, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_tensors(inputs):
+    arrs = jnp.broadcast_arrays(*[unwrap(i) for i in inputs])
+    return [wrap(a) for a in arrs]
+
+
+def broadcast_shape(s1, s2):
+    return list(np.broadcast_shapes(tuple(s1), tuple(s2)))
+
+
+@op_fn
+def flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@op_fn
+def roll(x, *, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@op_fn
+def rot90(x, *, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@op_fn
+def pad(x, *, pad, mode="constant", value=0.0, data_format="NCHW"):
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics (python/paddle/nn/functional/common.py pad): the
+        # FIRST pair applies to the LAST dim (pad_left/right on W, then
+        # pad_top/bottom on H, ...), so the pair list reverses onto the dims.
+        k = len(pad) // 2
+        width = [(0, 0)] * (nd - k)
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)]
+        width += pairs[::-1]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode=jmode, constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+@op_fn
+def cast_f(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    dt = dtypes.convert_dtype(dtype)
+    if dtypes.is_floating_point(dt) or dtypes.is_complex(dt):
+        return cast_f(x, dtype=dt)
+    # Integer/bool target: non-differentiable path.
+    return wrap(unwrap(x).astype(dt))
+
+
+@op_fn(name="getitem")
+def _getitem_pure(x, *, idx):
+    return x[idx]
+
+
+def getitem(x, idx):
+    return _getitem_pure(x, idx=_unwrap_index(idx))
+
+
+@op_fn
+def gather(x, index, *, axis=0):
+    return jnp.take(x, index.astype(jnp.int32) if hasattr(index, "astype") else index, axis=axis)
+
+
+@op_fn
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@op_fn
+def index_select(x, index, *, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@op_fn
+def take_along_axis(x, indices, *, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@op_fn
+def put_along_axis(x, indices, values, *, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    dims = list(range(x.ndim))
+    # scatter-add/mul via .at
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims])
+           for d, s in enumerate(x.shape)]
+    idx[axis] = indices
+    if reduce == "add":
+        return x.at[tuple(idx)].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[tuple(idx)].multiply(values)
+    raise ValueError(f"unsupported reduce: {reduce}")
+
+
+@op_fn
+def scatter(x, index, updates, *, overwrite=True):
+    """paddle.scatter parity: scatter rows of `updates` into x at `index`."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@op_fn
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(shape, dtype=unwrap(updates).dtype)
+    return scatter_nd_add(wrap(zeros), index, updates)
+
+
+@op_fn
+def index_add(x, index, *, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].add(jnp.moveaxis(value, axis, 0))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@op_fn
+def index_put(x, indices, value, *, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@op_fn
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@op_fn(differentiable=False)
+def nonzero(x, *, as_tuple=False):
+    idx = jnp.nonzero(x)
+    if as_tuple:
+        return idx
+    return jnp.stack(idx, axis=1)
+
+
+@op_fn(differentiable=False)
+def masked_select_nondiff(x, mask):
+    return x[mask]
+
+
+def masked_select(x, mask):
+    return masked_select_nondiff(x, mask)
+
+
+@op_fn
+def masked_fill(x, mask, *, value):
+    return jnp.where(mask, value, x)
+
+
+@op_fn
+def sort(x, *, axis=-1, descending=False):
+    s = jnp.sort(x, axis=axis)
+    if descending:
+        s = jnp.flip(s, axis=axis)
+    return s
+
+
+@op_fn(differentiable=False)
+def argsort(x, *, axis=-1, descending=False, stable=True):
+    s = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return s
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    """paddle.topk parity: returns (values, indices). Values are
+    differentiable (gather of x); indices come from lax.top_k."""
+    xr = unwrap(x)
+    if not largest:
+        xr_n = -xr
+    else:
+        xr_n = xr
+    if axis != -1 and axis != xr.ndim - 1:
+        xr_m = jnp.moveaxis(xr_n, axis, -1)
+    else:
+        xr_m = xr_n
+    _, idx = jax.lax.top_k(xr_m, k)
+    if axis != -1 and axis != xr.ndim - 1:
+        idx = jnp.moveaxis(idx, -1, axis)
+    indices = wrap(idx.astype(jnp.int64))
+    values = take_along_axis(x, wrap(idx), axis=axis)
+    return values, indices
+
+
+@op_fn(differentiable=False)
+def unique_op(x):
+    return jnp.unique(x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    r = jnp.unique(unwrap(x), return_index=return_index,
+                   return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if isinstance(r, tuple):
+        return tuple(wrap(v) for v in r)
+    return wrap(r)
+
+
+@op_fn(differentiable=False)
+def searchsorted(sorted_sequence, values, *, right=False):
+    return jnp.searchsorted(sorted_sequence, values, side="right" if right else "left")
+
+
+@op_fn(differentiable=False)
+def bincount(x, *, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@op_fn
+def repeat_interleave(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op_fn
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@op_fn
+def as_complex(x):
+    return x[..., 0] + 1j * x[..., 1]
+
+
+@op_fn
+def diagonal(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op_fn
+def trace(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op_fn
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def numel(x):
+    return wrap(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1))
+
+
+def shape(x):
+    return wrap(jnp.asarray(unwrap(x).shape, dtype=jnp.int32))
+
+
+@op_fn(differentiable=False)
+def one_hot_nd(x, *, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def one_hot(x, num_classes):
+    return one_hot_nd(x, num_classes=num_classes)
+
+
+@op_fn
+def tensordot(x, y, *, axes=2):
+    return jnp.tensordot(x, y, axes=axes)

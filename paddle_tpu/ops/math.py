@@ -1,0 +1,413 @@
+"""Elementwise & scalar math ops (paddle.tensor.math parity,
+python/paddle/tensor/math.py). Each op is a pure jnp/lax function — XLA fuses
+chains of these into single TPU kernels, replacing the reference's
+hand-written elementwise CUDA machinery (paddle/phi/kernels/funcs/elementwise_base.h)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ._op import op_fn, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+@op_fn
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@op_fn
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@op_fn
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@op_fn
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@op_fn(differentiable=False)
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@op_fn
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+@op_fn
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@op_fn
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@op_fn
+def abs(x):
+    return jnp.abs(x)
+
+
+@op_fn
+def exp(x):
+    return jnp.exp(x)
+
+
+@op_fn
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@op_fn
+def log(x):
+    return jnp.log(x)
+
+
+@op_fn
+def log2(x):
+    return jnp.log2(x)
+
+
+@op_fn
+def log10(x):
+    return jnp.log10(x)
+
+
+@op_fn
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@op_fn
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@op_fn
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@op_fn
+def square(x):
+    return jnp.square(x)
+
+
+@op_fn
+def sin(x):
+    return jnp.sin(x)
+
+
+@op_fn
+def cos(x):
+    return jnp.cos(x)
+
+
+@op_fn
+def tan(x):
+    return jnp.tan(x)
+
+
+@op_fn
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@op_fn
+def acos(x):
+    return jnp.arccos(x)
+
+
+@op_fn
+def atan(x):
+    return jnp.arctan(x)
+
+
+@op_fn
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@op_fn
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@op_fn
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@op_fn
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@op_fn
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@op_fn
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@op_fn
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@op_fn(differentiable=False)
+def floor(x):
+    return jnp.floor(x)
+
+
+@op_fn(differentiable=False)
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@op_fn(differentiable=False)
+def round(x):
+    return jnp.round(x)
+
+
+@op_fn(differentiable=False)
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@op_fn
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@op_fn(differentiable=False)
+def sign(x):
+    return jnp.sign(x)
+
+
+@op_fn
+def reciprocal(x):
+    return 1.0 / x
+
+
+@op_fn
+def clip(x, *, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@op_fn
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@op_fn
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@op_fn
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@op_fn
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@op_fn
+def erf(x):
+    return jax.lax.erf(x)
+
+
+@op_fn
+def erfinv(x):
+    return jax.lax.erf_inv(x)
+
+
+@op_fn
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op_fn
+def logit(x, *, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@op_fn
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@op_fn
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@op_fn
+def softplus(x, *, beta=1.0, threshold=20.0):
+    # Clamp the untaken branch: where's VJP multiplies its cotangent by 0,
+    # and 0 * inf (from exp overflow) would poison the grad with NaN.
+    safe = jnp.minimum(x * beta, threshold)
+    return jnp.where(x * beta > threshold, x, jnp.log1p(jnp.exp(safe)) / beta)
+
+
+@op_fn
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+@op_fn
+def cumsum(x, *, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@op_fn
+def cumprod(x, *, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+@op_fn
+def cummax_values(x, *, axis=None):
+    return jax.lax.cummax(x, axis=axis if axis is not None else 0)
+
+
+@op_fn
+def logsumexp(x, *, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@op_fn
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def add_n(inputs):
+    """paddle.add_n parity: sum of a list of tensors."""
+    from functools import reduce
+    if isinstance(inputs, Tensor):
+        return inputs
+    return reduce(lambda a, b: add(a, b), inputs)
+
+
+@op_fn(differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@op_fn(differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@op_fn(differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@op_fn
+def angle(x):
+    return jnp.angle(x)
+
+
+@op_fn
+def conj(x):
+    return jnp.conj(x)
+
+
+@op_fn
+def real(x):
+    return jnp.real(x)
+
+
+@op_fn
+def imag(x):
+    return jnp.imag(x)
+
+
+@op_fn
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@op_fn
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@op_fn
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@op_fn
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@op_fn
+def polygamma(x, *, n=0):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@op_fn
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@op_fn(differentiable=False)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@op_fn
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@op_fn
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@op_fn
+def ldexp(x, y):
+    return x * jnp.power(2.0, y)
+
+
+@op_fn
+def nan_to_num(x, *, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op_fn
+def trapezoid(y, *, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, dx=dx, axis=axis)
+
+
+def increment(x, value=1.0):
+    """In-place counter increment (paddle.increment parity). Grad-breaking by
+    design: mutates the handle outside the tape — intended for step counters
+    and other stop_gradient bookkeeping tensors, like the reference op."""
+    x._data = x._data + value
+    return x

@@ -1,0 +1,91 @@
+"""Random sampling ops (paddle.tensor.random parity), keyed by the RNG
+subsystem in framework/random.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..framework.random import next_key
+from ._op import unwrap, wrap
+
+
+def _dt(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return wrap(jax.random.uniform(next_key(), tuple(shape), dtype=_dt(dtype),
+                                   minval=min, maxval=max))
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    return wrap(jax.random.normal(next_key(), tuple(shape), dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    mean_, std_ = unwrap(mean), unwrap(std)
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean_), jnp.shape(std_))
+    return wrap(mean_ + std_ * jax.random.normal(next_key(), tuple(shape),
+                                                 dtype=dtypes.get_default_dtype()))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(next_key(), tuple(shape), low, high,
+                                   dtype=dtypes.convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64"):
+    return wrap(jax.random.permutation(next_key(), n).astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x):
+    p = unwrap(x)
+    return wrap(jax.random.bernoulli(next_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    p = unwrap(x)
+    logits = jnp.log(jnp.clip(p, 1e-30, None))
+    if replacement:
+        if logits.ndim == 1:
+            out = jax.random.categorical(next_key(), logits, shape=(num_samples,))
+        else:
+            out = jax.random.categorical(next_key(), logits[..., None, :],
+                                         shape=logits.shape[:-1] + (num_samples,))
+        return wrap(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(next_key(), logits.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return wrap(idx.astype(jnp.int64))
+
+
+def poisson(x):
+    lam = unwrap(x)
+    return wrap(jax.random.poisson(next_key(), lam).astype(lam.dtype))
+
+
+def exponential_(x, lam=1.0):
+    sample = jax.random.exponential(next_key(), tuple(x.shape)) / lam
+    x._data = sample.astype(x.dtype)
+    return x
+
+
+def shuffle(x, axis=0):
+    return wrap(jax.random.permutation(next_key(), unwrap(x), axis=axis,
+                                       independent=False))
+
+
+def gumbel(shape, dtype=None):
+    return wrap(jax.random.gumbel(next_key(), tuple(shape), dtype=_dt(dtype)))

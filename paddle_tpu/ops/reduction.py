@@ -1,0 +1,117 @@
+"""Reduction ops (paddle.tensor math/search reductions).
+
+Reductions map onto XLA reduce ops that tile efficiently on the TPU VPU
+(replacing paddle/phi/kernels/funcs/reduce_function.h machinery)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op import op_fn
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+@op_fn(name="sum")
+def sum(x, *, axis=None, keepdim=False, dtype=None):
+    return jnp.sum(x, axis=_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@op_fn
+def mean(x, *, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn(name="max")
+def max(x, *, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn(name="min")
+def min(x, *, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn
+def prod(x, *, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@op_fn
+def amax(x, *, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn
+def amin(x, *, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn
+def nansum(x, *, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn
+def nanmean(x, *, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn(name="all", differentiable=False)
+def all(x, *, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn(name="any", differentiable=False)
+def any(x, *, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn(differentiable=False)
+def argmax(x, *, axis=None, keepdim=False, dtype="int64"):
+    r = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return r
+
+
+@op_fn(differentiable=False)
+def argmin(x, *, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim)
+
+
+@op_fn
+def std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op_fn
+def var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op_fn
+def median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn
+def quantile(x, q, *, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn(differentiable=False)
+def count_nonzero(x, *, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op_fn
+def kthvalue_values(x, *, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    idx = k - 1
+    taken = jnp.take(v, idx, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+    return taken

@@ -1,0 +1,203 @@
+"""Tape engine tests — numeric parity with finite differences, the same
+strategy as the reference's OpTest.check_grad (test/legacy_test/op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at numpy x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = f(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        fm = f(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+class TestBackwardBasics:
+    def test_simple_chain(self):
+        a = pt.to_tensor(2.0, stop_gradient=False)
+        b = a * a * a
+        b.backward()
+        assert abs(a.grad.item() - 12.0) < 1e-5
+
+    def test_grad_accumulation(self):
+        a = pt.to_tensor(3.0, stop_gradient=False)
+        (a * 2.0).backward()
+        (a * 5.0).backward()
+        assert abs(a.grad.item() - 7.0) < 1e-5
+
+    def test_clear_grad(self):
+        a = pt.to_tensor(3.0, stop_gradient=False)
+        (a * 2.0).backward()
+        a.clear_grad()
+        assert a.grad is None
+
+    def test_diamond(self):
+        # y = x*x used twice: dz/dx = 2*(x*x)' contributions
+        x = pt.to_tensor(3.0, stop_gradient=False)
+        y = x * x
+        z = y + y
+        z.backward()
+        assert abs(x.grad.item() - 12.0) < 1e-5
+
+    def test_stop_gradient_blocks(self):
+        x = pt.to_tensor(1.0, stop_gradient=False)
+        y = pt.to_tensor(1.0)  # stop_gradient=True
+        z = x * y
+        z.backward()
+        assert y.grad is None
+        assert x.grad is not None
+
+    def test_detach_cuts_graph(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        assert abs(x.grad.item() - 4.0) < 1e-5  # only via z=y*x
+
+    def test_backward_nonscalar_requires_grad_tensor(self):
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2.0
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y = x * 2.0
+        y.backward(pt.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+    def test_double_backward_without_retain_raises(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=False)
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert abs(x.grad.item() - 8.0) < 1e-5
+
+    def test_backward_on_error_path(self):
+        t = pt.to_tensor(1.0)  # stop_gradient True
+        with pytest.raises(RuntimeError):
+            t.backward()
+
+    def test_multi_output_op(self):
+        x = pt.to_tensor(np.array([3.0, 1.0, 2.0], np.float32), stop_gradient=False)
+        vals, idx = pt.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+    def test_no_grad_context(self):
+        x = pt.to_tensor(1.0, stop_gradient=False)
+        with pt.no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_hooks(self):
+        x = pt.to_tensor(1.0, stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.item())
+            return g * 2.0
+        x.register_hook(hook)
+        (x * 3.0).backward()
+        assert seen == [3.0]
+        assert abs(x.grad.item() - 6.0) < 1e-5
+
+    def test_intermediate_hook(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.register_hook(lambda g: g * 10.0)
+        z = y * 3.0
+        z.backward()
+        # dz/dy=3 -> hook -> 30 -> dy/dx=2x=4 -> 120
+        assert abs(x.grad.item() - 120.0) < 1e-4
+
+    def test_retain_grads_intermediate(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.retain_grads()
+        z = y * 3.0
+        z.backward()
+        assert abs(y.grad.item() - 3.0) < 1e-5
+
+
+class TestGradAPI:
+    def test_grad_basic(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        (gx,) = pt.grad(y, x)
+        assert abs(gx.item() - 4.0) < 1e-5
+        assert x.grad is None  # .grad untouched
+
+    def test_grad_multiple_inputs(self):
+        x = pt.to_tensor(2.0, stop_gradient=False)
+        w = pt.to_tensor(3.0, stop_gradient=False)
+        y = x * w + x
+        gx, gw = pt.grad(y, [x, w])
+        assert abs(gx.item() - 4.0) < 1e-5
+        assert abs(gw.item() - 2.0) < 1e-5
+
+
+class TestNumericParity:
+    @pytest.mark.parametrize("opname,np_f", [
+        ("exp", np.exp), ("tanh", np.tanh), ("sqrt", np.sqrt),
+        ("log", np.log), ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+    ])
+    def test_unary_grads(self, opname, np_f):
+        xv = np.random.rand(3, 4).astype(np.float64) + 0.5
+        x = pt.to_tensor(xv.astype(np.float32), stop_gradient=False)
+        getattr(pt, opname)(x).sum().backward()
+
+        def f(v):
+            return float(np_f(v).sum())
+        ng = numeric_grad(f, xv.copy())
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+    def test_matmul_grad(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        x = pt.to_tensor(a, stop_gradient=False)
+        y = pt.to_tensor(b, stop_gradient=False)
+        pt.matmul(x, y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 5)) @ b.T, rtol=1e-4)
+        np.testing.assert_allclose(y.grad.numpy(), a.T @ np.ones((3, 5)), rtol=1e-4)
+
+    def test_reduction_grads(self):
+        xv = np.random.randn(4, 5).astype(np.float32)
+        x = pt.to_tensor(xv, stop_gradient=False)
+        pt.mean(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((4, 5), 1 / 20), rtol=1e-5)
+
+    def test_getitem_grad(self):
+        x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+        x[0].sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1, 1, 1], [0, 0, 0]])
+
+    def test_concat_grad(self):
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = pt.to_tensor([3.0], stop_gradient=False)
+        pt.concat([x, y]).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1, 1])
+        np.testing.assert_allclose(y.grad.numpy(), [1])
+
+    def test_where_grad(self):
+        x = pt.to_tensor([1.0, -1.0], stop_gradient=False)
+        cond = pt.to_tensor([True, False])
+        y = pt.where(cond, x * 2.0, x * 3.0)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 3])

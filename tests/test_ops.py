@@ -1,0 +1,250 @@
+"""Op numeric tests vs NumPy (reference strategy: OpTest,
+test/legacy_test/op_test.py:418 — outputs compared against NumPy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def T(a, sg=True):
+    return pt.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_factories(self):
+        np.testing.assert_allclose(pt.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+        np.testing.assert_allclose(pt.ones([2]).numpy(), [1, 1])
+        np.testing.assert_allclose(pt.full([2], 7.0).numpy(), [7, 7])
+        np.testing.assert_allclose(pt.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(pt.arange(1, 7, 2).numpy(), [1, 3, 5])
+        np.testing.assert_allclose(pt.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        np.testing.assert_allclose(pt.eye(3).numpy(), np.eye(3))
+
+    def test_like_factories(self):
+        x = T(np.ones((2, 2), np.float32))
+        assert pt.zeros_like(x).shape == [2, 2]
+        np.testing.assert_allclose(pt.full_like(x, 3.0).numpy(), np.full((2, 2), 3))
+
+    def test_tri(self):
+        x = T(np.ones((3, 3), np.float32))
+        np.testing.assert_allclose(pt.tril(x).numpy(), np.tril(np.ones((3, 3))))
+        np.testing.assert_allclose(pt.triu(x, diagonal=1).numpy(),
+                                   np.triu(np.ones((3, 3)), 1))
+
+    def test_assign(self):
+        out = pt.zeros([2])
+        pt.assign(T([5.0, 6.0]), out)
+        np.testing.assert_allclose(out.numpy(), [5, 6])
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        x = T(a)
+        np.testing.assert_allclose(pt.exp(x).numpy(), np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(pt.abs(x).numpy(), np.abs(a), rtol=1e-6)
+        np.testing.assert_allclose(pt.tanh(x).numpy(), np.tanh(a), rtol=1e-4)
+        np.testing.assert_allclose(pt.square(x).numpy(), a * a, rtol=1e-6)
+        np.testing.assert_allclose(pt.sign(x).numpy(), np.sign(a))
+
+    def test_clip(self):
+        x = T([-2.0, 0.5, 3.0])
+        np.testing.assert_allclose(pt.clip(x, min=-1, max=1).numpy(), [-1, 0.5, 1])
+
+    def test_cumsum(self):
+        x = T(np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(pt.cumsum(x, axis=1).numpy(),
+                                   np.cumsum(x.numpy(), axis=1))
+
+    def test_add_n(self):
+        xs = [T([1.0]), T([2.0]), T([3.0])]
+        np.testing.assert_allclose(pt.add_n(xs).numpy(), [6])
+
+    def test_maximum_minimum(self):
+        a, b = T([1.0, 5.0]), T([3.0, 2.0])
+        np.testing.assert_allclose(pt.maximum(a, b).numpy(), [3, 5])
+        np.testing.assert_allclose(pt.minimum(a, b).numpy(), [1, 2])
+
+    def test_logsumexp(self):
+        a = np.random.randn(4).astype(np.float32)
+        np.testing.assert_allclose(pt.logsumexp(T(a)).numpy(),
+                                   np.log(np.exp(a).sum()), rtol=1e-4)
+
+
+class TestReduction:
+    def test_basic(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        x = T(a)
+        np.testing.assert_allclose(pt.sum(x).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(pt.mean(x, axis=0).numpy(), a.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(pt.max(x, axis=1).numpy(), a.max(1), rtol=1e-6)
+        np.testing.assert_allclose(pt.min(x).numpy(), a.min(), rtol=1e-6)
+        np.testing.assert_allclose(pt.prod(x, axis=0).numpy(), a.prod(0), rtol=1e-4)
+
+    def test_keepdim(self):
+        x = T(np.ones((2, 3), np.float32))
+        assert pt.sum(x, axis=1, keepdim=True).shape == [2, 1]
+
+    def test_argmax(self):
+        a = np.array([[1, 5, 2], [7, 0, 3]], np.float32)
+        np.testing.assert_array_equal(pt.argmax(T(a), axis=1).numpy(), [1, 0])
+
+    def test_std_var(self):
+        a = np.random.randn(10).astype(np.float32)
+        np.testing.assert_allclose(pt.std(T(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(pt.var(T(a), unbiased=False).numpy(),
+                                   a.var(), rtol=1e-4)
+
+    def test_any_all(self):
+        x = T(np.array([True, False]))
+        assert bool(pt.any(x).numpy())
+        assert not bool(pt.all(x).numpy())
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(6, dtype=np.float32)
+        x = T(a)
+        assert pt.reshape(x, shape=[2, 3]).shape == [2, 3]
+        y = pt.reshape(x, shape=[2, -1])
+        assert y.shape == [2, 3]
+        z = pt.transpose(y, perm=[1, 0])
+        assert z.shape == [3, 2]
+
+    def test_squeeze_unsqueeze(self):
+        x = T(np.zeros((1, 3, 1), np.float32))
+        assert pt.squeeze(x).shape == [3]
+        assert pt.squeeze(x, axis=0).shape == [3, 1]
+        assert pt.unsqueeze(T([1.0, 2.0]), axis=0).shape == [1, 2]
+        assert pt.unsqueeze(T([1.0, 2.0]), axis=[0, 2]).shape == [1, 2, 1]
+
+    def test_concat_stack_split(self):
+        x, y = T([[1.0, 2]]), T([[3.0, 4]])
+        assert pt.concat([x, y], axis=0).shape == [2, 2]
+        assert pt.stack([x, y], axis=0).shape == [2, 1, 2]
+        parts = pt.split(T(np.arange(10, dtype=np.float32)), 2)
+        assert len(parts) == 2 and parts[0].shape == [5]
+        parts = pt.split(T(np.arange(10, dtype=np.float32)), [3, -1])
+        assert parts[1].shape == [7]
+
+    def test_gather_scatter(self):
+        x = T(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = T(np.array([0, 2]))
+        np.testing.assert_allclose(pt.gather(x, idx).numpy(), x.numpy()[[0, 2]])
+        upd = T(np.ones((2, 3), np.float32))
+        out = pt.scatter(x, idx, upd)
+        np.testing.assert_allclose(out.numpy()[0], [1, 1, 1])
+
+    def test_where_masked(self):
+        x = T(np.array([1.0, -2.0, 3.0]))
+        np.testing.assert_allclose(
+            pt.masked_fill(x, T(np.array([True, False, True])), value=0.0).numpy(),
+            [0, -2, 0])
+
+    def test_tile_expand(self):
+        x = T([[1.0, 2.0]])
+        assert pt.tile(x, repeat_times=[2, 2]).shape == [2, 4]
+        assert pt.expand(x, shape=[3, 2]).shape == [3, 2]
+        assert pt.broadcast_to(x, shape=[3, 2]).shape == [3, 2]
+
+    def test_flip_roll(self):
+        x = T(np.arange(4, dtype=np.float32))
+        np.testing.assert_allclose(pt.flip(x, axis=0).numpy(), [3, 2, 1, 0])
+        np.testing.assert_allclose(pt.roll(x, shifts=1).numpy(), [3, 0, 1, 2])
+
+    def test_pad(self):
+        x = T(np.ones((2, 2), np.float32))
+        out = pt.pad(x, pad=[1, 1], value=0.0)
+        assert out.shape == [2, 4]
+
+    def test_topk_sort(self):
+        x = T(np.array([3.0, 1.0, 4.0, 1.0, 5.0]))
+        v, i = pt.topk(x, 2)
+        np.testing.assert_allclose(v.numpy(), [5, 4])
+        np.testing.assert_array_equal(i.numpy(), [4, 2])
+        np.testing.assert_allclose(pt.sort(x, descending=True).numpy(),
+                                   [5, 4, 3, 1, 1])
+
+    def test_one_hot(self):
+        out = pt.one_hot(T(np.array([0, 2])), 3)
+        np.testing.assert_allclose(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_unique(self):
+        out = pt.unique(T(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+    def test_take_put_along_axis(self):
+        x = T(np.arange(6, dtype=np.float32).reshape(2, 3))
+        idx = T(np.array([[0], [2]]))
+        np.testing.assert_allclose(
+            pt.take_along_axis(x, idx, axis=1).numpy(), [[0], [5]])
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.matmul(T(a), T(b)).numpy(), a @ b, rtol=1e-4)
+        np.testing.assert_allclose(
+            pt.matmul(T(a), T(b.T), transpose_y=True).numpy(), a @ b, rtol=1e-4)
+
+    def test_batched_matmul(self):
+        a = np.random.randn(5, 2, 3).astype(np.float32)
+        b = np.random.randn(5, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.bmm(T(a), T(b)).numpy(), a @ b, rtol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(pt.einsum("ij,jk->ik", T(a), T(b)).numpy(),
+                                   a @ b, rtol=1e-4)
+
+    def test_norm(self):
+        a = np.array([3.0, 4.0], np.float32)
+        np.testing.assert_allclose(pt.norm(T(a)).numpy(), 5.0, rtol=1e-5)
+        m = np.random.randn(3, 3).astype(np.float32)
+        np.testing.assert_allclose(pt.norm(T(m), p="fro").numpy(),
+                                   np.linalg.norm(m), rtol=1e-5)
+
+    def test_solve_inv(self):
+        a = np.array([[2.0, 0], [0, 4.0]], np.float32)
+        np.testing.assert_allclose(pt.inv(T(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-5)
+        b = np.array([[2.0], [8.0]], np.float32)
+        np.testing.assert_allclose(pt.solve(T(a), T(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-5)
+
+    def test_svd_qr(self):
+        m = np.random.randn(4, 3).astype(np.float32)
+        u, s, v = pt.svd(T(m))
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, m, rtol=1e-3, atol=1e-4)
+        q, r = pt.qr(T(m))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), m, rtol=1e-3, atol=1e-4)
+
+
+class TestRandom:
+    def test_determinism_with_seed(self):
+        pt.seed(7)
+        a = pt.randn([4]).numpy()
+        pt.seed(7)
+        b = pt.randn([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_shapes_ranges(self):
+        u = pt.uniform([100], min=0.0, max=1.0)
+        assert u.shape == [100]
+        assert float(u.numpy().min()) >= 0 and float(u.numpy().max()) <= 1
+        r = pt.randint(0, 5, [50])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 5
+        p = pt.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+    def test_rng_scope_purity(self):
+        import jax
+        from paddle_tpu.framework.random import rng_scope
+        with rng_scope(jax.random.PRNGKey(0)):
+            a = pt.randn([3]).numpy()
+        with rng_scope(jax.random.PRNGKey(0)):
+            b = pt.randn([3]).numpy()
+        np.testing.assert_allclose(a, b)

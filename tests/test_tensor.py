@@ -1,0 +1,144 @@
+"""Tensor facade tests (reference analogue: test/legacy_test tensor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestTensorBasics:
+    def test_to_tensor(self):
+        t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == np.float32
+        assert t.stop_gradient is True
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_to_tensor_dtype(self):
+        t = pt.to_tensor([1, 2, 3], dtype="float32")
+        assert t.dtype == np.float32
+        # int64 canonicalizes to int32 on TPU (x64 disabled), by design.
+        t = pt.to_tensor([1.0], dtype="int64")
+        assert np.issubdtype(t.dtype, np.integer)
+
+    def test_default_float32(self):
+        t = pt.to_tensor(3.14)
+        assert t.dtype == np.float32
+
+    def test_item_scalar(self):
+        assert pt.to_tensor(42).item() == 42
+        assert abs(float(pt.to_tensor(1.5)) - 1.5) < 1e-6
+
+    def test_operators(self):
+        x = pt.to_tensor([1.0, 2.0])
+        y = pt.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((x + y).numpy(), [4, 6])
+        np.testing.assert_allclose((x - y).numpy(), [-2, -2])
+        np.testing.assert_allclose((x * y).numpy(), [3, 8])
+        np.testing.assert_allclose((y / x).numpy(), [3, 2])
+        np.testing.assert_allclose((x ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-x).numpy(), [-1, -2])
+        np.testing.assert_allclose((2.0 + x).numpy(), [3, 4])
+        np.testing.assert_allclose((2.0 - x).numpy(), [1, 0])
+
+    def test_comparison(self):
+        x = pt.to_tensor([1.0, 5.0])
+        y = pt.to_tensor([3.0, 3.0])
+        np.testing.assert_array_equal((x < y).numpy(), [True, False])
+        np.testing.assert_array_equal((x >= y).numpy(), [False, True])
+        np.testing.assert_array_equal((x == x).numpy(), [True, True])
+
+    def test_getitem(self):
+        x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(x[0].numpy(), [0, 1, 2, 3])
+        np.testing.assert_allclose(x[1, 2].numpy(), 6)
+        np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+        np.testing.assert_allclose(x[::2].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+    def test_setitem(self):
+        x = pt.to_tensor(np.zeros((3, 3), np.float32))
+        x[1] = 5.0
+        np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+        x[0, 0] = 7.0
+        assert x.numpy()[0, 0] == 7
+
+    def test_inplace_helpers(self):
+        x = pt.to_tensor([1.0, 2.0])
+        x.add_(1.0)
+        np.testing.assert_allclose(x.numpy(), [2, 3])
+        x.scale_(2.0)
+        np.testing.assert_allclose(x.numpy(), [4, 6])
+        x.zero_()
+        np.testing.assert_allclose(x.numpy(), [0, 0])
+
+    def test_set_value(self):
+        x = pt.to_tensor([1.0, 2.0])
+        x.set_value(np.array([9.0, 9.0], np.float32))
+        np.testing.assert_allclose(x.numpy(), [9, 9])
+        with pytest.raises(ValueError):
+            x.set_value(np.zeros((3,), np.float32))
+
+    def test_astype(self):
+        x = pt.to_tensor([1.5, 2.5])
+        y = x.astype("int32")
+        assert y.dtype == np.int32
+
+    def test_detach_clone(self):
+        x = pt.to_tensor([1.0], stop_gradient=False)
+        d = x.detach()
+        assert d.stop_gradient
+        c = x.clone()
+        assert not c.stop_gradient  # clone is differentiable
+
+    def test_shape_props(self):
+        x = pt.to_tensor(np.zeros((2, 3, 4), np.float32))
+        assert x.shape == [2, 3, 4]
+        assert x.ndim == 3
+        assert x.size == 24
+        assert x.numel() == 24
+        assert len(x) == 2
+
+    def test_iteration(self):
+        x = pt.to_tensor([[1.0], [2.0]])
+        rows = list(x)
+        assert len(rows) == 2
+
+    def test_parameter(self):
+        p = pt.Parameter(np.ones((2, 2), np.float32) * 1)
+        assert not p.stop_gradient
+        assert p.persistable
+
+
+class TestDtype:
+    def test_set_default(self):
+        pt.set_default_dtype("bfloat16")
+        try:
+            t = pt.zeros([2])
+            assert t.dtype == pt.bfloat16
+        finally:
+            pt.set_default_dtype("float32")
+
+    def test_flags(self):
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        assert pt.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_check(self):
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = pt.to_tensor([1.0, 0.0])
+            with pytest.raises(FloatingPointError):
+                pt.log(pt.to_tensor([-1.0]))
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        obj = {"w": pt.to_tensor([[1.0, 2.0]]), "step": 3,
+               "nested": [pt.to_tensor([5])]}
+        p = tmp_path / "ckpt.pdparams"
+        pt.save(obj, p)
+        loaded = pt.load(p)
+        np.testing.assert_allclose(loaded["w"].numpy(), [[1, 2]])
+        assert loaded["step"] == 3
+        np.testing.assert_allclose(loaded["nested"][0].numpy(), [5])
