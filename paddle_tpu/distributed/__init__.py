@@ -1,1 +1,6 @@
-"""Placeholder — populated in this round."""
+"""paddle.distributed parity surface — phase-5 build-out in progress.
+
+Reference export list: python/paddle/distributed/__init__.py (SURVEY.md §2.6).
+"""
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,  # noqa
+                  is_initialized)

@@ -1,1 +1,7 @@
-"""Placeholder — populated in this round."""
+"""paddle.io parity surface (reference: python/paddle/io/__init__.py)."""
+from .dataloader import DataLoader, default_collate_fn  # noqa
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)

@@ -1,0 +1,165 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py +
+python/paddle/io/reader.py DataLoader).
+
+TPU-native design: the loader produces host numpy batches on background
+threads (double-buffered prefetch) and converts to device arrays at yield
+time. Threads replace the reference's shared-memory worker *processes*: on
+TPU hosts the input pipeline is IO/CPU-light relative to the device step, and
+the GIL is released during numpy/jax conversion. num_workers>0 selects the
+threaded prefetcher; 0 is fully synchronous (debug mode, like the reference's
+single-process mode).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference:
+    dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return to_tensor(jnp.stack([b._data for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return to_tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return to_tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(items)) for items in transposed]
+    return batch
+
+
+class _ThreadedPrefetcher:
+    def __init__(self, make_iter: Callable, num_workers: int,
+                 prefetch_factor: int):
+        self._make_iter = make_iter
+        self._depth = max(2, num_workers * prefetch_factor)
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        sentinel = object()
+        stop = threading.Event()
+        err = []
+
+        def worker():
+            try:
+                for item in self._make_iter():
+                    # bounded put that aborts when the consumer went away,
+                    # so an early `break` in the train loop can't leave the
+                    # thread blocked forever holding batches in memory
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            stop.set()
+            while not q.empty():  # unblock a final put
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+class DataLoader:
+    """paddle.io.DataLoader parity surface."""
+
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler=None,
+                 batch_size: Optional[int] = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn=None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+                self.batch_size = batch_size
+
+    def _raw_iter(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            if self.batch_size is None:
+                for sample in it:
+                    yield sample
+                return
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        elif self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.dataset[i]
+        else:
+            for batch_idx in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in batch_idx])
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return iter(_ThreadedPrefetcher(self._raw_iter,
+                                            self.num_workers,
+                                            self.prefetch_factor))
+        return self._raw_iter()
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)

@@ -1,1 +1,22 @@
-"""Placeholder — populated in this round."""
+"""paddle.nn parity surface (reference: python/paddle/nn/__init__.py):
+Layer system, layers, functional, initializers.
+"""
+from . import functional  # noqa
+from . import initializer  # noqa
+from .layer import *  # noqa: F401,F403
+from .layer.base import Layer  # noqa
+
+
+class ParamAttr:
+    """paddle.ParamAttr parity: bundles initializer/trainable/name
+    (+ regularizer, learning_rate consumed by the optimizer)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

@@ -1,0 +1,200 @@
+"""Activation functionals (paddle.nn.functional.* parity).
+
+Reference surface: python/paddle/nn/functional/activation.py. Each op is a
+pure JAX function registered through the op dispatcher (ops/_op.py), so in
+eager mode it records a tape node (backward = jax.vjp closure) and under jit
+it traces straight into the compiled program. XLA fuses these into the
+surrounding matmuls on TPU — no hand-written kernels needed at this level.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._op import op_fn
+
+__all__ = [
+    "celu", "elu", "gelu", "glu", "gumbel_softmax", "hardshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "leaky_relu", "log_sigmoid",
+    "log_softmax", "maxout", "mish", "prelu", "relu", "relu6", "rrelu",
+    "selu", "sigmoid", "silu", "softmax", "softplus", "softshrink",
+    "softsign", "swish", "tanh", "tanhshrink", "thresholded_relu",
+]
+
+
+@op_fn
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@op_fn
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+@op_fn
+def elu(x, *, alpha: float = 1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op_fn
+def celu(x, *, alpha: float = 1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+@op_fn
+def selu(x, *, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op_fn
+def gelu(x, *, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@op_fn
+def leaky_relu(x, *, negative_slope: float = 0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@op_fn
+def prelu(x, weight, *, data_format: str = "NCHW"):
+    # weight: scalar [1] or per-channel [C]; broadcast on the channel axis.
+    w = weight
+    if w.ndim == 1 and w.shape[0] != 1 and x.ndim > 1:
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@op_fn
+def rrelu(x, *, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = False, key=None):
+    if training and key is not None:
+        a = jax.random.uniform(key, x.shape, dtype=x.dtype,
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+@op_fn(name="f_sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op_fn
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@op_fn(name="f_tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@op_fn
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@op_fn
+def hardshrink(x, *, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+@op_fn
+def softshrink(x, *, threshold: float = 0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0))
+
+
+@op_fn
+def hardsigmoid(x, *, slope: float = 1.0 / 6.0, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0, 1)
+
+
+@op_fn
+def hardswish(x):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+@op_fn
+def hardtanh(x, *, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+@op_fn
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op_fn(name="f_softplus")
+def softplus(x, *, beta: float = 1.0, threshold: float = 20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
+
+
+@op_fn(name="f_softsign")
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+@op_fn
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@op_fn
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@op_fn
+def thresholded_relu(x, *, threshold: float = 1.0, value: float = 0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@op_fn
+def softmax(x, *, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op_fn
+def log_softmax(x, *, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op_fn
+def glu(x, *, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@op_fn
+def maxout(x, *, groups: int, axis: int = 1):
+    ax = axis if axis >= 0 else x.ndim + axis
+    c = x.shape[ax]
+    shape = x.shape[:ax] + (c // groups, groups) + x.shape[ax + 1:]
+    return jnp.max(x.reshape(shape), axis=ax + 1)
+
+
+@op_fn
+def gumbel_softmax(x, *, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1, key=None):
+    if key is not None:
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, x.shape, dtype=x.dtype, minval=1e-20,
+                               maxval=1.0) + 1e-20))
+        x = x + g
+    y = jax.nn.softmax(x / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                    inplace=False)
+        y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through estimator
+    return y

@@ -1,0 +1,102 @@
+"""Attention functionals.
+
+Reference surface: python/paddle/nn/functional/flash_attention.py
+(flash_attention:147, scaled_dot_product_attention:722). TPU-native design:
+one pure attention function with a kernel-dispatch seam — the default is the
+XLA softmax-attention (fused well by XLA for moderate seq lens); the Pallas
+flash kernel (paddle_tpu/kernels/flash_attention.py) overrides when
+available/profitable, mirroring the reference's KernelFactory choice of
+flash-attn vs math path.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._op import op_fn
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "sdpa_reference"]
+
+# Filled by paddle_tpu.kernels at import time with a pallas implementation;
+# signature (q, k, v, bias, causal, scale) -> out. None = use XLA path.
+_FLASH_IMPL = None
+
+
+def register_flash_impl(fn):
+    global _FLASH_IMPL
+    _FLASH_IMPL = fn
+
+
+def sdpa_reference(q, k, v, attn_mask=None, *, causal=False, scale=None,
+                   dropout_p=0.0, key=None):
+    """Math attention on [B, S, H, D] (paddle layout). float32 softmax."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    # [B,S,H,D] -> [B,H,S,D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # GQA: broadcast kv heads if fewer than q heads
+    if kt.shape[1] != qt.shape[1]:
+        rep = qt.shape[1] // kt.shape[1]
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+@op_fn
+def _sdpa_op(query, key, value, attn_mask=None, *, dropout_p: float = 0.0,
+             is_causal: bool = False, rng_key=None, scale=None):
+    use_flash = (_FLASH_IMPL is not None and attn_mask is None
+                 and dropout_p == 0.0)
+    if use_flash:
+        return _FLASH_IMPL(query, key, value, causal=is_causal, scale=scale)
+    return sdpa_reference(query, key, value, attn_mask, causal=is_causal,
+                          scale=scale, dropout_p=dropout_p, key=rng_key)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0,
+                                 is_causal: bool = False,
+                                 training: bool = True, name=None,
+                                 scale=None):
+    """paddle scaled_dot_product_attention parity: inputs [B, S, H, D].
+    Attention dropout draws its key from the framework RNG (same discipline
+    as F.dropout)."""
+    del name
+    from ...framework import random as frandom
+    p = dropout_p if training else 0.0
+    rng_key = frandom.next_key() if p > 0.0 else None
+    return _sdpa_op(query, key, value, attn_mask, dropout_p=p,
+                    is_causal=is_causal, rng_key=rng_key, scale=scale)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle flash_attention parity (flash_attention.py:147):
+    returns (out, softmax_lse-or-None)."""
+    del fixed_seed_offset, rng_name, name
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout_p=dropout if training else 0.0,
+        is_causal=causal, training=training)
+    return out, None

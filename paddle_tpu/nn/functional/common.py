@@ -1,0 +1,340 @@
+"""Common functionals: linear, embedding, dropout, pad, interpolate, …
+
+Reference surface: python/paddle/nn/functional/{common,input,vision}.py.
+All pure-JAX; dropout draws its key from the framework RNG (eager) or the
+enclosing rng_scope (jit), mirroring the reference's seeded dropout kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...framework import random as frandom
+from ...ops._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "linear", "embedding", "one_hot", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "pad", "zeropad2d", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "cosine_similarity", "bilinear", "label_smooth",
+]
+
+
+@op_fn
+def linear(x, weight, bias=None):
+    """y = x @ W + b. Weight layout [in, out] (paddle convention —
+    python/paddle/nn/functional/common.py linear); maps straight onto the MXU.
+    """
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op_fn(nondiff_args=(0,))
+def embedding(ids, weight, *, padding_idx: Optional[int] = None,
+              sparse: bool = False):
+    del sparse  # gather is dense on TPU; SelectedRows grads have no analogue
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+@op_fn(differentiable=False)
+def one_hot(x, *, num_classes: int):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def _dropout_impl(x, p, training, mode, key, bcast_dims=None):
+    if not training or p == 0.0:
+        return x
+    keep = 1.0 - p
+    shape = list(x.shape)
+    if bcast_dims:
+        for d in bcast_dims:
+            shape[d] = 1
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)  # downscale_in_infer
+
+
+@op_fn(name="dropout_p")
+def _dropout_op(x, *, p, training, mode, key, bcast_dims=None):
+    return _dropout_impl(x, p, training, mode, key, bcast_dims)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """paddle.nn.functional.dropout parity (upscale_in_train default)."""
+    del name
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else wrap(unwrap(x))
+    bcast = None
+    if axis is not None:
+        nd = unwrap(x).ndim
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        bcast = [d for d in range(nd) if d not in axes]
+    return _dropout_op(x, p=float(p), training=True, mode=mode,
+                       key=frandom.next_key(), bcast_dims=bcast)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    """Drops whole channels of NCHW/NHWC feature maps."""
+    del name
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else wrap(unwrap(x))
+    bcast = [2, 3] if data_format == "NCHW" else [1, 2]
+    return _dropout_op(x, p=float(p), training=True, mode="upscale_in_train",
+                       key=frandom.next_key(), bcast_dims=bcast)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    del name
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else wrap(unwrap(x))
+    bcast = [2, 3, 4] if data_format == "NCDHW" else [1, 2, 3]
+    return _dropout_op(x, p=float(p), training=True, mode="upscale_in_train",
+                       key=frandom.next_key(), bcast_dims=bcast)
+
+
+@op_fn(name="alpha_dropout_p")
+def _alpha_dropout_op(x, *, p, key):
+    # SELU-preserving dropout (reference: common.py alpha_dropout).
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    del name
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else wrap(unwrap(x))
+    return _alpha_dropout_op(x, p=float(p), key=frandom.next_key())
+
+
+def _norm_pad(pad_spec, ndim, data_format):
+    """Convert a paddle pad spec to a jnp.pad config.
+
+    Paddle semantics (python/paddle/nn/functional/common.py pad): an int pads
+    every dim; a list of 2*ndim ints is per-dim pairs ordered from the LAST
+    dim backwards (torch-style); a shorter list pads the spatial dims of the
+    NC*/N*C layout, again last-spatial-dim first.
+    """
+    if isinstance(pad_spec, int):
+        return [(pad_spec, pad_spec)] * ndim
+    pad_spec = [int(p) for p in pad_spec]
+    out = [(0, 0)] * ndim
+    n_pairs = len(pad_spec) // 2
+    if n_pairs == ndim:
+        dims = list(range(ndim - 1, -1, -1))
+    elif data_format.startswith("NC"):
+        dims = list(range(ndim - 1, ndim - 1 - n_pairs, -1))
+    else:  # channel-last: spatial dims end one before the channel dim
+        dims = list(range(ndim - 2, ndim - 2 - n_pairs, -1))
+    for i, d in enumerate(dims):
+        out[d] = (pad_spec[2 * i], pad_spec[2 * i + 1])
+    return out
+
+
+@op_fn(name="f_pad")
+def _pad_op(x, *, pad_cfg, mode, value):
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pad_cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, pad_cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad parity."""
+    del name
+    nd = unwrap(x).ndim
+    cfg = _norm_pad(unwrap(pad) if isinstance(pad, Tensor) else pad, nd,
+                    data_format)
+    cfg = [(int(a), int(b)) for a, b in cfg]
+    return _pad_op(x, pad_cfg=tuple(cfg), mode=mode, value=value)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format, name=name)
+
+
+@op_fn
+def cosine_similarity(x1, x2, *, axis: int = 1, eps: float = 1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@op_fn
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2] (reference: common.py bilinear)
+    y = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op_fn
+def label_smooth(label, *, epsilon: float = 0.1):
+    k = label.shape[-1]
+    return (1 - epsilon) * label + epsilon / k
+
+
+@op_fn
+def pixel_shuffle(x, *, upscale_factor: int, data_format: str = "NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@op_fn
+def pixel_unshuffle(x, *, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+@op_fn
+def channel_shuffle(x, *, groups: int, data_format: str = "NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = x.transpose(0, 2, 1, 3, 4)
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = x.transpose(0, 1, 2, 4, 3)
+    return x.reshape(n, h, w, c)
+
+
+def _lerp_axis_aligned(x, axis, out_size):
+    """Linear resize of one axis with align_corners=True coordinates:
+    src = i * (in-1)/(out-1)."""
+    in_size = x.shape[axis]
+    if out_size == 1 or in_size == 1:
+        idx = jnp.zeros((out_size,), jnp.int32)
+        return jnp.take(x, idx, axis=axis)
+    src = jnp.arange(out_size, dtype=jnp.float32) * \
+        ((in_size - 1) / (out_size - 1))
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    frac = (src - lo.astype(jnp.float32))
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    frac = frac.reshape(shape).astype(x.dtype)
+    return jnp.take(x, lo, axis=axis) * (1 - frac) + \
+        jnp.take(x, hi, axis=axis) * frac
+
+
+@op_fn
+def interpolate(x, *, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, data_format: str = "NCHW"):
+    """Resize via jax.image (XLA gather/conv lowering on TPU).
+
+    align_corners=True for the linear family uses the corner-aligned source
+    grid (src = i*(in-1)/(out-1)), matching the reference's interpolate;
+    'area' mode is bin-averaging (adaptive average pooling semantics).
+    """
+    channel_last = not data_format.startswith("NC")
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    sp_axes = (tuple(range(1, x.ndim - 1)) if channel_last
+               else tuple(range(2, x.ndim)))
+    if mode == "area":
+        from .pooling import _adaptive
+        return _adaptive(x, len(size), tuple(size), data_format, "avg")
+    linear_family = mode in ("linear", "bilinear", "trilinear")
+    if align_corners and linear_family:
+        for ax, s in zip(sp_axes, size):
+            x = _lerp_axis_aligned(x, ax, s)
+        return x
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic"}[mode]
+    if channel_last:
+        full = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    else:
+        full = (x.shape[0], x.shape[1]) + tuple(size)
+    return jax.image.resize(x, full, method=jmode)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    del name
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+
+@op_fn
+def unfold(x, *, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference: common.py unfold). x: [N,C,H,W] ->
+    [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings[:2]
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+@op_fn
+def fold(x, *, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im: inverse of unfold (sum overlapping patches)."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) \
+        else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings[:2]
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    oh_img, ow_img = output_sizes
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    oh = (oh_img + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (ow_img + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    x = x.reshape(n, c, kh, kw, oh, ow)
+    out = jnp.zeros((n, c, oh_img + 2 * ph, ow_img + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + sh * oh:sh, wj:wj + sw * ow:sw].add(
+                x[:, :, i, j])
+    return out[:, :, ph:ph + oh_img, pw:pw + ow_img]

@@ -1,0 +1,165 @@
+"""Convolution functionals.
+
+Reference surface: python/paddle/nn/functional/conv.py (conv1d/2d/3d and
+transpose variants). TPU-native design: one pure function over
+``jax.lax.conv_general_dilated`` — XLA lowers it onto the MXU directly, with
+layout chosen by dimension_numbers (both NCHW and NHWC supported; NHWC is the
+TPU-preferred layout). Weight layout follows paddle: [out_c, in_c/groups, *k].
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._op import op_fn
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _dim_numbers(ndim_spatial: int, data_format: str):
+    sp = "DHW"[-ndim_spatial:] if ndim_spatial <= 3 else None
+    if data_format.startswith("NC"):
+        lhs = "NC" + sp
+    else:
+        lhs = "N" + sp + "C"
+    rhs = "OI" + sp
+    return (lhs, rhs, lhs)
+
+
+def _norm_padding(padding, n, data_format):
+    """paddle padding: int | list[int] | list[pair] | 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # paddle also allows per-dim pairs including batch/channel; strip those
+    pairs = [tuple(p) for p in padding]
+    if len(pairs) == n + 2:
+        if data_format.startswith("NC"):
+            pairs = pairs[2:]
+        else:
+            pairs = pairs[1:-1]
+    return pairs
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
+          nsp):
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    _dim_numbers(nsp, data_format))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tuplize(stride, nsp),
+        padding=_norm_padding(padding, nsp, data_format),
+        rhs_dilation=_tuplize(dilation, nsp),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        if data_format.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * nsp)
+        else:
+            out = out + bias
+    return out
+
+
+@op_fn
+def conv1d(x, weight, bias=None, *, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 1)
+
+
+@op_fn
+def conv2d(x, weight, bias=None, *, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2)
+
+
+@op_fn
+def conv3d(x, weight, bias=None, *, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, nsp, output_size):
+    # weight layout [in_c, out_c/groups, *k] (paddle conv_transpose
+    # convention). Implemented as the gradient of conv: lhs-dilated conv.
+    stride = _tuplize(stride, nsp)
+    dilation = _tuplize(dilation, nsp)
+    opad = _tuplize(output_padding or 0, nsp)
+    pad_cfg = _norm_padding(padding, nsp, data_format)
+
+    # flip spatial dims and swap I/O: transpose conv = conv with flipped
+    # kernel, lhs dilation = stride.
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nsp)))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape((groups, ic // groups, ocg) + w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((groups * ocg, ic // groups) + w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+
+    k = [dilation[i] * (weight.shape[2 + i] - 1) + 1 for i in range(nsp)]
+    if isinstance(pad_cfg, str):
+        if pad_cfg == "VALID":
+            pad_cfg = [(0, 0)] * nsp
+        else:  # SAME
+            pad_cfg = [((k[i] - 1) // 2, k[i] // 2) for i in range(nsp)]
+    tpad = [(k[i] - 1 - pad_cfg[i][0],
+             k[i] - 1 - pad_cfg[i][1] + opad[i]) for i in range(nsp)]
+
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    _dim_numbers(nsp, data_format))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nsp, padding=tpad,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        if data_format.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * nsp)
+        else:
+            out = out + bias
+    return out
+
+
+@op_fn
+def conv1d_transpose(x, weight, bias=None, *, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     output_size=None, data_format: str = "NCL"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 1, output_size)
+
+
+@op_fn
+def conv2d_transpose(x, weight, bias=None, *, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     output_size=None, data_format: str = "NCHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+@op_fn
+def conv3d_transpose(x, weight, bias=None, *, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     output_size=None, data_format: str = "NCDHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)

@@ -1,0 +1,316 @@
+"""Loss functionals.
+
+Reference surface: python/paddle/nn/functional/loss.py. All pure JAX;
+cross_entropy follows paddle semantics (softmax+NLL fused by default,
+ignore_index, weight, soft labels, label smoothing).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._op import op_fn
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "dice_loss", "npair_loss", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@op_fn(nondiff_args=(1,))
+def cross_entropy(input, label, weight=None, *, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False,
+                  axis: int = -1, use_softmax: bool = True,
+                  label_smoothing: float = 0.0):
+    """paddle.nn.functional.cross_entropy parity
+    (reference loss.py cross_entropy)."""
+    if use_softmax:
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(input.astype(jnp.float32), 1e-30))
+    nclass = input.shape[axis]
+
+    if soft_label or (hasattr(label, "ndim") and label.ndim == input.ndim
+                      and label.shape == input.shape
+                      and jnp.issubdtype(label.dtype, jnp.floating)):
+        soft = label.astype(jnp.float32)
+        if label_smoothing > 0.0:
+            soft = (1 - label_smoothing) * soft + label_smoothing / nclass
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(soft * weight.reshape(
+                (1,) * (input.ndim - 1) + (-1,)), axis=axis)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(w)
+        return _reduce(loss, reduction)
+
+    lbl = label
+    if lbl.ndim == input.ndim:  # trailing singleton label dim
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        smooth_term = jnp.mean(logp, axis=axis)
+        picked = (1 - label_smoothing) * picked + label_smoothing * smooth_term
+    loss = jnp.where(valid, -picked, 0.0)
+    if weight is not None:
+        w = jnp.where(valid, jnp.take(weight, safe), 0.0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def softmax_with_cross_entropy(logits, label, *, soft_label: bool = False,
+                               ignore_index: int = -100, axis: int = -1,
+                               return_softmax: bool = False):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis,
+                        keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.squeeze(jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis), axis=axis)
+        loss = jnp.expand_dims(jnp.where(valid, -picked, 0.0), axis)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss
+
+
+@op_fn(nondiff_args=(1,))
+def binary_cross_entropy(input, label, weight=None, *,
+                         reduction: str = "mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+    lbl = label.astype(jnp.float32)
+    loss = -(lbl * jnp.log(x) + (1 - lbl) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def binary_cross_entropy_with_logits(logit, label, weight=None, *,
+                                     reduction: str = "mean",
+                                     pos_weight=None):
+    z = logit.astype(jnp.float32)
+    lbl = label.astype(jnp.float32)
+    # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+    loss = jnp.maximum(z, 0) - z * lbl + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * lbl + 1
+        loss = loss * log_w
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def mse_loss(input, label, *, reduction: str = "mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def l1_loss(input, label, *, reduction: str = "mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op_fn(nondiff_args=(1,))
+def log_loss(input, label, *, epsilon: float = 1e-4):
+    x = input.astype(jnp.float32)
+    lbl = label.astype(jnp.float32)
+    return -lbl * jnp.log(x + epsilon) - (1 - lbl) * jnp.log1p(epsilon - x + 1e-30)
+
+
+@op_fn(nondiff_args=(1,))
+def nll_loss(input, label, weight=None, *, ignore_index: int = -100,
+             reduction: str = "mean"):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1)
+    picked = jnp.squeeze(picked, axis=1)
+    loss = jnp.where(valid, -picked, 0.0)
+    if weight is not None:
+        w = jnp.where(valid, jnp.take(weight, safe), 0.0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def kl_div(input, label, *, reduction: str = "mean", log_target: bool = False):
+    lbl = label.astype(jnp.float32)
+    if log_target:
+        loss = jnp.exp(lbl) * (lbl - input)
+    else:
+        loss = jnp.where(lbl > 0, lbl * (jnp.log(jnp.maximum(lbl, 1e-30))
+                                         - input), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def smooth_l1_loss(input, label, *, reduction: str = "mean",
+                   delta: float = 1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(2,))
+def margin_ranking_loss(input, other, label, *, margin: float = 0.0,
+                        reduction: str = "mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def hinge_embedding_loss(input, label, *, margin: float = 1.0,
+                         reduction: str = "mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(2,))
+def cosine_embedding_loss(input1, input2, label, *, margin: float = 0.0,
+                          reduction: str = "mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+@op_fn
+def triplet_margin_loss(input, positive, negative, *, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6,
+                        swap: bool = False, reduction: str = "mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(d_pos - d_neg + margin, 0.0), reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def sigmoid_focal_loss(logit, label, normalizer=None, *, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum"):
+    z = logit.astype(jnp.float32)
+    lbl = label.astype(jnp.float32)
+    p = jax.nn.sigmoid(z)
+    ce = jnp.maximum(z, 0) - z * lbl + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    p_t = p * lbl + (1 - p) * (1 - lbl)
+    a_t = alpha * lbl + (1 - alpha) * (1 - lbl)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def dice_loss(input, label, *, epsilon: float = 1e-5):
+    lbl = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1],
+                         dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lbl, axis=reduce_axes)
+    union = jnp.sum(input, axis=reduce_axes) + jnp.sum(lbl, axis=reduce_axes)
+    dice = (2 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1 - dice)
+
+
+@op_fn(nondiff_args=(2,))
+def npair_loss(anchor, positive, labels, *, l2_reg: float = 0.002):
+    batch = anchor.shape[0]
+    lbl = labels.reshape(-1, 1).astype(jnp.float32)
+    same = (lbl == lbl.T).astype(jnp.float32)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    sim = anchor @ positive.T
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.sum(same * logp, axis=1)
+    l2 = l2_reg * (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) \
+        / (2.0 * batch)
+    return jnp.mean(ce) + l2
+
+
+@op_fn(nondiff_args=(1,))
+def poisson_nll_loss(input, label, *, log_input: bool = True,
+                     full: bool = False, epsilon: float = 1e-8,
+                     reduction: str = "mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label + \
+            0.5 * jnp.log(2 * jnp.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def multi_label_soft_margin_loss(input, label, weight=None, *,
+                                 reduction: str = "mean"):
+    loss = -(label * jax.nn.log_sigmoid(input) +
+             (1 - label) * jax.nn.log_sigmoid(-input))
+    loss = jnp.mean(loss, axis=-1)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(1,))
+def soft_margin_loss(input, label, *, reduction: str = "mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+@op_fn(nondiff_args=(2,))
+def gaussian_nll_loss(input, variance, label, *, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+    return _reduce(loss, reduction)

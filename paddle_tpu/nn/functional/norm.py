@@ -1,0 +1,201 @@
+"""Normalization functionals.
+
+Reference surface: python/paddle/nn/functional/norm.py (+ rms_norm from
+python/paddle/incubate/nn/functional/fused_rms_norm.py — on TPU the "fused"
+variant IS the default: XLA fuses the reduction+scale into one kernel, and a
+Pallas kernel (kernels/) can override for long rows).
+
+Design note: batch_norm's running-stat update is a host-side handle rebind
+(the Layer owns the stats); the functional is pure and returns the new stats.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._op import op_fn, unwrap, wrap
+
+__all__ = ["normalize", "layer_norm", "rms_norm", "batch_norm",
+           "instance_norm", "group_norm", "local_response_norm"]
+
+
+@op_fn
+def normalize(x, *, p: float = 2, axis: int = 1, epsilon: float = 1e-12):
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    else:
+        n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+@op_fn
+def layer_norm(x, weight=None, bias=None, *, normalized_ndim: int = 1,
+               epsilon: float = 1e-5):
+    """LayerNorm over the trailing ``normalized_ndim`` dims.
+
+    Stats in float32 regardless of input dtype (bf16-safe on TPU).
+    """
+    axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op_fn
+def rms_norm(x, weight=None, *, epsilon: float = 1e-6, axis: int = -1):
+    """RMSNorm (reference: incubate fused_rms_norm). float32 accumulation."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    y = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        y = y * weight
+    return y
+
+
+@op_fn
+def _batch_norm_train(x, weight, bias, *, epsilon, data_format, ch_axis):
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    y = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape) + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y, mean, var
+
+
+@op_fn
+def _batch_norm_eval(x, running_mean, running_var, weight, bias, *,
+                     epsilon, ch_axis):
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    y = (x.astype(jnp.float32) - running_mean.reshape(shape)) * \
+        jax.lax.rsqrt(running_var.reshape(shape) + epsilon)
+    y = y.astype(x.dtype)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_format: str = "NCHW",
+               use_global_stats: Optional[bool] = None, name=None):
+    """paddle.nn.functional.batch_norm parity.
+
+    In training mode updates ``running_mean/var`` in place (handle rebind)
+    with paddle's momentum convention: r = m*r + (1-m)*batch_stat.
+    """
+    del name
+    ch_axis = 1 if data_format.startswith("NC") and unwrap(x).ndim > 1 else \
+        unwrap(x).ndim - 1
+    if data_format in ("NLC", "NHWC", "NDHWC"):
+        ch_axis = unwrap(x).ndim - 1
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        y, mean, var = _batch_norm_train(
+            x, weight, bias, epsilon=epsilon, data_format=data_format,
+            ch_axis=ch_axis)
+        if isinstance(running_mean, Tensor):
+            n = 1
+            for i, s in enumerate(unwrap(x).shape):
+                if i != ch_axis:
+                    n *= s
+            unbiased = unwrap(var) * (n / max(n - 1, 1))
+            running_mean._data = (momentum * running_mean._data +
+                                  (1 - momentum) * unwrap(mean))
+            running_var._data = (momentum * running_var._data +
+                                 (1 - momentum) * unbiased)
+        return y
+    return _batch_norm_eval(x, running_mean, running_var, weight, bias,
+                            epsilon=epsilon, ch_axis=ch_axis)
+
+
+@op_fn
+def instance_norm(x, weight=None, bias=None, *, epsilon: float = 1e-5,
+                  data_format: str = "NCHW"):
+    if data_format.startswith("NC"):
+        ch_axis = 1
+        axes = tuple(range(2, x.ndim))
+    else:
+        ch_axis = x.ndim - 1
+        axes = tuple(range(1, x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+@op_fn
+def group_norm(x, weight=None, bias=None, *, num_groups: int,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    if data_format.startswith("NC"):
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        xg = x.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, xg.ndim))
+        xf = xg.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+        y = y.reshape(x.shape)
+        shape = [1, c] + [1] * len(spatial)
+    else:
+        n, c = x.shape[0], x.shape[-1]
+        spatial = x.shape[1:-1]
+        xg = x.reshape((n,) + spatial + (num_groups, c // num_groups))
+        axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+        xf = xg.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+        y = y.reshape(x.shape)
+        shape = [1] * (x.ndim - 1) + [c]
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+@op_fn
+def local_response_norm(x, *, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0,
+                        data_format: str = "NCHW"):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    sq = jnp.moveaxis(sq, ch_axis, -1)
+    pad_l = (size - 1) // 2
+    pad_r = size - 1 - pad_l
+    padded = jnp.pad(sq, [(0, 0)] * (sq.ndim - 1) + [(pad_l, pad_r)])
+    win = jax.lax.reduce_window(
+        padded, 0.0, jax.lax.add,
+        (1,) * (sq.ndim - 1) + (size,), (1,) * sq.ndim,
+        [(0, 0)] * sq.ndim)
+    win = jnp.moveaxis(win, -1, ch_axis)
+    return x / jnp.power(k + alpha * win, beta)

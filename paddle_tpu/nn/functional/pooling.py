@@ -1,0 +1,186 @@
+"""Pooling functionals over ``lax.reduce_window`` (XLA's native windowed
+reduction — maps to the TPU vector unit without custom kernels).
+
+Reference surface: python/paddle/nn/functional/pooling.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._op import op_fn
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _tuplize(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _window(nsp, k, s, data_format):
+    if data_format.startswith("NC"):
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    else:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    return dims, strides
+
+
+def _pad_cfg(padding, nsp, data_format, ndim):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _tuplize(padding, nsp)
+    if len(p) == 2 * nsp:
+        pairs = [(p[2 * i], p[2 * i + 1]) for i in range(nsp)]
+    else:
+        pairs = [(x, x) for x in p]
+    full = [(0, 0)] * ndim
+    if data_format.startswith("NC"):
+        for i in range(nsp):
+            full[2 + i] = pairs[i]
+    else:
+        for i in range(nsp):
+            full[1 + i] = pairs[i]
+    return full
+
+
+def _pool(x, nsp, kernel, stride, padding, data_format, kind,
+          exclusive=True, ceil_mode=False):
+    k = _tuplize(kernel, nsp)
+    s = _tuplize(stride if stride is not None else kernel, nsp)
+    dims, strides = _window(nsp, k, s, data_format)
+    pad = _pad_cfg(padding, nsp, data_format, x.ndim)
+    if isinstance(pad, str):
+        pad_seq = lax.padtype_to_pads(x.shape, dims, strides, pad)
+    else:
+        pad_seq = pad
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, dims, strides, pad_seq)
+    # avg
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad_seq)
+    if exclusive and any(p != (0, 0) for p in pad_seq):
+        ones = jnp.ones(x.shape, x.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad_seq)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+@op_fn
+def avg_pool1d(x, *, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    return _pool(x, 1, kernel_size, stride, padding, data_format, "avg",
+                 exclusive, ceil_mode)
+
+
+@op_fn
+def avg_pool2d(x, *, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCHW"):
+    return _pool(x, 2, kernel_size, stride, padding, data_format, "avg",
+                 exclusive, ceil_mode)
+
+
+@op_fn
+def avg_pool3d(x, *, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool(x, 3, kernel_size, stride, padding, data_format, "avg",
+                 exclusive, ceil_mode)
+
+
+@op_fn
+def max_pool1d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCL"):
+    return _pool(x, 1, kernel_size, stride, padding, data_format, "max")
+
+
+@op_fn
+def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    return _pool(x, 2, kernel_size, stride, padding, data_format, "max")
+
+
+@op_fn
+def max_pool3d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    return _pool(x, 3, kernel_size, stride, padding, data_format, "max")
+
+
+def _adaptive(x, nsp, output_size, data_format, kind):
+    out = _tuplize(output_size, nsp)
+    if data_format.startswith("NC"):
+        spatial = x.shape[2:2 + nsp]
+        sp_axes = list(range(2, 2 + nsp))
+    else:
+        spatial = x.shape[1:1 + nsp]
+        sp_axes = list(range(1, 1 + nsp))
+    # evenly divisible fast path: reshape + reduce (single XLA reduce).
+    if all(spatial[i] % out[i] == 0 for i in range(nsp)):
+        shape = list(x.shape)
+        new_shape = []
+        red_axes = []
+        j = 0
+        for ax in range(x.ndim):
+            if ax in sp_axes:
+                i = sp_axes.index(ax)
+                new_shape += [out[i], spatial[i] // out[i]]
+                red_axes.append(len(new_shape) - 1)
+            else:
+                new_shape.append(shape[ax])
+        xr = x.reshape(new_shape)
+        if kind == "avg":
+            return jnp.mean(xr, axis=tuple(red_axes))
+        return jnp.max(xr, axis=tuple(red_axes))
+    # general path: per-output-bin start/end (torch/paddle semantics)
+    def pool_axis(arr, axis, in_s, out_s):
+        starts = [(i * in_s) // out_s for i in range(out_s)]
+        ends = [-(-((i + 1) * in_s) // out_s) for i in range(out_s)]
+        pieces = []
+        for st, en in zip(starts, ends):
+            sl = [slice(None)] * arr.ndim
+            sl[axis] = slice(st, en)
+            seg = arr[tuple(sl)]
+            red = jnp.mean if kind == "avg" else jnp.max
+            pieces.append(red(seg, axis=axis, keepdims=True))
+        return jnp.concatenate(pieces, axis=axis)
+    for i, ax in enumerate(sp_axes):
+        x = pool_axis(x, ax, spatial[i], out[i])
+    return x
+
+
+@op_fn
+def adaptive_avg_pool1d(x, *, output_size, data_format="NCL"):
+    return _adaptive(x, 1, output_size, data_format, "avg")
+
+
+@op_fn
+def adaptive_avg_pool2d(x, *, output_size, data_format="NCHW"):
+    return _adaptive(x, 2, output_size, data_format, "avg")
+
+
+@op_fn
+def adaptive_avg_pool3d(x, *, output_size, data_format="NCDHW"):
+    return _adaptive(x, 3, output_size, data_format, "avg")
+
+
+@op_fn
+def adaptive_max_pool1d(x, *, output_size, data_format="NCL"):
+    return _adaptive(x, 1, output_size, data_format, "max")
+
+
+@op_fn
+def adaptive_max_pool2d(x, *, output_size, data_format="NCHW"):
+    return _adaptive(x, 2, output_size, data_format, "max")
+
+
+@op_fn
+def adaptive_max_pool3d(x, *, output_size, data_format="NCDHW"):
+    return _adaptive(x, 3, output_size, data_format, "max")

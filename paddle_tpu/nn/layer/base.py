@@ -1,0 +1,351 @@
+"""The Layer base class (paddle.nn.Layer parity).
+
+Reference: python/paddle/nn/layer/layers.py (class Layer). TPU-native notes:
+parameters are Tensor handles over jax.Arrays; ``state_dict`` yields the
+handles so a jitted step can flatten them as a pytree (Layer itself also
+registers as a pytree via ``parameters()``/``raw_state``); buffers
+(e.g. BN running stats) are non-trainable handles updated by rebind.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Iterator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core.tensor import Parameter, Tensor
+from .. import initializer as init_mod
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, key):
+        self._hooks, self._key = hooks, key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    """Base class for all network layers."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._dtype = dtypes.convert_dtype(dtype) if dtype else jnp.float32
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or type(self).__name__.lower()
+
+    # -- construction helpers ------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """paddle.nn.Layer.create_parameter parity. ``attr`` may be a
+        ParamAttr-like object (initializer/trainable/name), False (no param),
+        or an Initializer."""
+        if attr is False:
+            return None
+        dtype = dtypes.convert_dtype(dtype) if dtype else self._dtype
+        initializer = None
+        trainable = True
+        name = None
+        if attr is not None:
+            if isinstance(attr, init_mod.Initializer):
+                initializer = attr
+            else:
+                initializer = getattr(attr, "initializer", None)
+                trainable = getattr(attr, "trainable", True)
+                name = getattr(attr, "name", None)
+        # Precedence (reference: layers.py create_parameter): explicit
+        # attr initializer > global initializer > caller's default >
+        # built-in default (zeros for bias, XavierUniform for weights).
+        if initializer is None:
+            initializer = init_mod.global_initializer(is_bias)
+        if initializer is None:
+            initializer = default_initializer
+        if initializer is None:
+            initializer = init_mod.Constant(0.0) if is_bias \
+                else init_mod.XavierUniform()
+        data = initializer(tuple(int(s) for s in shape), dtype)
+        return Parameter(data, name=name, trainable=trainable)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(jnp.asarray(tensor))
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute protocol --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            bufs.pop(name, None) if bufs else None
+            params[name] = value
+        elif isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            subs[name] = value
+        elif params is not None and name in params:
+            params[name] = value
+        elif subs is not None and name in subs:
+            subs[name] = value
+        elif bufs is not None and name in bufs:
+            if value is not None and not isinstance(value, Tensor):
+                value = Tensor(jnp.asarray(value))
+            bufs[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d:
+                extra += list(d.keys())
+        return list(super().__dir__()) + extra
+
+    # -- traversal -----------------------------------------------------------
+    def named_parameters(self, prefix: str = "",
+                         include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for layer_prefix, layer in self.named_sublayers(
+                prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{layer_prefix}.{pname}" if layer_prefix else pname
+                yield full, p
+
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix,
+                                           include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self: bool = False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter([l for l in self._sub_layers.values() if l is not None])
+
+    def named_children(self):
+        return iter([(n, l) for n, l in self._sub_layers.items()
+                     if l is not None])
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for layer_prefix, layer in self.named_sublayers(
+                prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = f"{layer_prefix}.{bname}" if layer_prefix else bname
+                yield full, b
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- train / eval --------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   use_hook: bool = True, structured_name_prefix: str = ""):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            # find owning layer to check persistability
+            owner = self
+            parts = name.split(".")[:-1]
+            try:
+                for part in parts:
+                    owner = owner._sub_layers[part]
+            except Exception:
+                owner = None
+            if owner is not None and short in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def to_static_state_dict(self, *a, **k):
+        return self.state_dict(*a, **k)
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Load values into existing parameter/buffer handles (rebind)."""
+        missing, unexpected = [], []
+        own = self.state_dict()
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            target = own[name]
+            arr = value._data if isinstance(value, Tensor) else jnp.asarray(
+                np.asarray(value))
+            if tuple(arr.shape) != tuple(target._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {tuple(arr.shape)} vs "
+                    f"{tuple(target._data.shape)}")
+            target._data = arr.astype(target._data.dtype)
+        for name in own:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / conversion --------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dtype = dtypes.convert_dtype(dtype)
+            for _, p in self.named_parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(dtype)
+            for _, b in self.named_buffers():
+                if jnp.issubdtype(b._data.dtype, jnp.floating):
+                    b._data = b._data.astype(dtype)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dtype
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype=jnp.float32)
+
+    def half(self):
+        return self.to(dtype=jnp.float16)
+
+    def bfloat16(self):
+        return self.to(dtype=jnp.bfloat16)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + ln for ln in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"

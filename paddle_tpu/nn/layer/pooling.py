@@ -1,0 +1,143 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .base import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D"]
+
+
+class _Pool(Layer):
+    _fn = None
+    _default_df = "NCHW"
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+        self.data_format = data_format or self._default_df
+
+    def extra_repr(self):
+        return (f"kernel_size={self.kernel_size}, stride={self.stride}, "
+                f"padding={self.padding}")
+
+
+class AvgPool1D(_Pool):
+    _default_df = "NCL"
+
+    def forward(self, x):
+        return F.avg_pool1d(x, kernel_size=self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class AvgPool2D(_Pool):
+    def forward(self, x):
+        return F.avg_pool2d(x, kernel_size=self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class AvgPool3D(_Pool):
+    _default_df = "NCDHW"
+
+    def forward(self, x):
+        return F.avg_pool3d(x, kernel_size=self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            exclusive=self.exclusive,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class MaxPool1D(_Pool):
+    _default_df = "NCL"
+
+    def forward(self, x):
+        return F.max_pool1d(x, kernel_size=self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class MaxPool2D(_Pool):
+    def forward(self, x):
+        return F.max_pool2d(x, kernel_size=self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class MaxPool3D(_Pool):
+    _default_df = "NCDHW"
+
+    def forward(self, x):
+        return F.max_pool3d(x, kernel_size=self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class _AdaptivePool(Layer):
+    _default_df = "NCHW"
+
+    def __init__(self, output_size, data_format=None, return_mask=False,
+                 name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format or self._default_df
+        self.return_mask = return_mask
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    _default_df = "NCL"
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, output_size=self.output_size,
+                                     data_format=self.data_format)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, output_size=self.output_size,
+                                     data_format=self.data_format)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    _default_df = "NCDHW"
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, output_size=self.output_size,
+                                     data_format=self.data_format)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    _default_df = "NCL"
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, output_size=self.output_size,
+                                     data_format=self.data_format)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, output_size=self.output_size,
+                                     data_format=self.data_format)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    _default_df = "NCDHW"
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, output_size=self.output_size,
+                                     data_format=self.data_format)

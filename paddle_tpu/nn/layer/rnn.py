@@ -1,0 +1,354 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells).
+
+Reference: python/paddle/nn/layer/rnn.py. TPU-native design: the whole
+sequence loop is ONE ``lax.scan`` inside a single registered op, so XLA
+compiles a fused loop (no per-timestep Python dispatch) and the tape's
+jax.vjp closure differentiates through the scan. Weight layout follows
+paddle: weight_ih [G*H, I], weight_hh [G*H, H]; LSTM gate order i,f,g,o;
+GRU gate order r,z,c.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._op import op_fn
+from .. import initializer as I
+from .base import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "SimpleRNN", "LSTM",
+           "GRU", "RNN", "BiRNN"]
+
+
+def _rnn_step(act, x_t, h, w_ih, w_hh, b_ih, b_hh):
+    g = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    return act(g)
+
+
+def _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    g = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    gg = jnp.tanh(gg)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * gg
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x_t @ w_ih.T
+    gh = h @ w_hh.T
+    if b_ih is not None:
+        gi = gi + b_ih
+    if b_hh is not None:
+        gh = gh + b_hh
+    i_r, i_z, i_c = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    c = jnp.tanh(i_c + r * h_c)
+    return (1 - z) * c + z * h  # paddle/cudnn convention
+
+
+@op_fn
+def _rnn_scan(x, h0, w_ih, w_hh, b_ih=None, b_hh=None, *, mode: str,
+              activation: str = "tanh", reverse: bool = False,
+              c0=None):
+    """One direction, one layer. x: [B,T,I]; h0: [B,H]. Returns (out, h[,c])."""
+    act = jnp.tanh if activation == "tanh" else (lambda v: jnp.maximum(v, 0))
+    xs = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+
+    if mode == "LSTM":
+        def step(carry, x_t):
+            h, c = carry
+            h2, c2 = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+            return (h2, c2), h2
+        (hT, cT), ys = lax.scan(step, (h0, c0), xs)
+        if reverse:
+            ys = jnp.flip(ys, axis=0)
+        return jnp.swapaxes(ys, 0, 1), hT, cT
+    if mode == "GRU":
+        def step(h, x_t):
+            h2 = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+            return h2, h2
+    else:
+        def step(h, x_t):
+            h2 = _rnn_step(act, x_t, h, w_ih, w_hh, b_ih, b_hh)
+            return h2, h2
+    hT, ys = lax.scan(step, h0, xs)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / (hidden_size ** 0.5)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (n_gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (n_gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter((n_gates * hidden_size,),
+                                  attr=bias_ih_attr, is_bias=True,
+                                  default_initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter((n_gates * hidden_size,),
+                                  attr=bias_hh_attr, is_bias=True,
+                                  default_initializer=u)
+
+    def _zero_state(self, x, size):
+        from ... import ops
+        return ops.zeros([x.shape[0], size], dtype="float32")
+
+
+@op_fn
+def _cell_rnn(x, h, w_ih, w_hh, b_ih=None, b_hh=None, *, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else (lambda v: jnp.maximum(v, 0))
+    return _rnn_step(act, x, h, w_ih, w_hh, b_ih, b_hh)
+
+
+@op_fn
+def _cell_lstm(x, h, c, w_ih, w_hh, b_ih=None, b_hh=None):
+    return _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh)
+
+
+@op_fn
+def _cell_gru(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
+    return _gru_step(x, h, w_ih, w_hh, b_ih, b_hh)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self._zero_state(
+            inputs, self.hidden_size)
+        out = _cell_rnn(inputs, h, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh,
+                        activation=self.activation)
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self._zero_state(inputs, self.hidden_size)
+            c = self._zero_state(inputs, self.hidden_size)
+        else:
+            h, c = states
+        h2, c2 = _cell_lstm(inputs, h, c, self.weight_ih, self.weight_hh,
+                            self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self._zero_state(
+            inputs, self.hidden_size)
+        h2 = _cell_gru(inputs, h, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        n_dir = 2 if self.bidirect else 1
+        self.num_directions = n_dir
+        n_gates = {"LSTM": 4, "GRU": 3}.get(self.MODE.split("_")[0], 1)
+        std = 1.0 / (hidden_size ** 0.5)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(n_dir):
+                in_size = input_size if layer == 0 else hidden_size * n_dir
+                sfx = f"_reverse" if d == 1 else ""
+                self.add_parameter(
+                    f"weight_ih_l{layer}{sfx}",
+                    self.create_parameter((n_gates * hidden_size, in_size),
+                                          attr=weight_ih_attr,
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"weight_hh_l{layer}{sfx}",
+                    self.create_parameter(
+                        (n_gates * hidden_size, hidden_size),
+                        attr=weight_hh_attr, default_initializer=u))
+                self.add_parameter(
+                    f"bias_ih_l{layer}{sfx}",
+                    self.create_parameter((n_gates * hidden_size,),
+                                          attr=bias_ih_attr, is_bias=True,
+                                          default_initializer=u))
+                self.add_parameter(
+                    f"bias_hh_l{layer}{sfx}",
+                    self.create_parameter((n_gates * hidden_size,),
+                                          attr=bias_hh_attr, is_bias=True,
+                                          default_initializer=u))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        mode = self.MODE.split("_")[0]
+        is_lstm = mode == "LSTM"
+        x = inputs
+        if self.time_major:
+            x = ops.transpose(x, perm=[1, 0, 2])
+        batch = x.shape[0]
+        n_dir = self.num_directions
+        total = self.num_layers * n_dir
+
+        if initial_states is None:
+            z = ops.zeros([total, batch, self.hidden_size], dtype="float32")
+            h0s = [z[i] for i in range(total)]
+            c0s = [z[i] for i in range(total)] if is_lstm else None
+        else:
+            if is_lstm:
+                h0, c0 = initial_states
+                h0s = [h0[i] for i in range(total)]
+                c0s = [c0[i] for i in range(total)]
+            else:
+                h0 = initial_states
+                h0s = [h0[i] for i in range(total)]
+                c0s = None
+
+        h_finals, c_finals = [], []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(n_dir):
+                idx = layer * n_dir + d
+                sfx = "_reverse" if d == 1 else ""
+                w_ih = getattr(self, f"weight_ih_l{layer}{sfx}")
+                w_hh = getattr(self, f"weight_hh_l{layer}{sfx}")
+                b_ih = getattr(self, f"bias_ih_l{layer}{sfx}")
+                b_hh = getattr(self, f"bias_hh_l{layer}{sfx}")
+                if is_lstm:
+                    y, hT, cT = _rnn_scan(
+                        x, h0s[idx], w_ih, w_hh, b_ih, b_hh, mode="LSTM",
+                        reverse=(d == 1), c0=c0s[idx])
+                    c_finals.append(cT)
+                else:
+                    y, hT = _rnn_scan(
+                        x, h0s[idx], w_ih, w_hh, b_ih, b_hh, mode=mode,
+                        activation=self.activation, reverse=(d == 1))
+                h_finals.append(hT)
+                outs.append(y)
+            x = outs[0] if n_dir == 1 else ops.concat(outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1 \
+                    and self.training:
+                from .. import functional as Fn
+                x = Fn.dropout(x, p=self.dropout, training=True)
+
+        out = x
+        if self.time_major:
+            out = ops.transpose(out, perm=[1, 0, 2])
+        h_final = ops.stack(h_finals, axis=0)
+        if is_lstm:
+            c_final = ops.stack(c_finals, axis=0)
+            return out, (h_final, c_final)
+        return out, h_final
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence runner (reference: rnn.py RNN).
+    Python loop over time — for odd custom cells; the fused classes above
+    are the fast path."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ... import ops
+        x = inputs
+        if self.time_major:
+            x = ops.transpose(x, perm=[1, 0, 2])
+        T = x.shape[1]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        ys = []
+        for t in steps:
+            y, states = self.cell(x[:, t], states)
+            ys.append(y)
+        if self.is_reverse:
+            ys = ys[::-1]
+        out = ops.stack(ys, axis=1)
+        if self.time_major:
+            out = ops.transpose(out, perm=[1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        return ops.concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)

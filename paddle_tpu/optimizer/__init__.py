@@ -1,1 +1,6 @@
-"""Placeholder — populated in this round."""
+"""paddle.optimizer parity surface
+(reference: python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa
+from .optimizer import (Adagrad, Adam, Adamax, AdamW, ClipGradByGlobalNorm,  # noqa
+                        ClipGradByNorm, ClipGradByValue, L1Decay, L2Decay,
+                        Lamb, Momentum, Optimizer, RMSProp, SGD)

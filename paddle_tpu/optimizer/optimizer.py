@@ -1,0 +1,437 @@
+"""Optimizers (reference: python/paddle/optimizer/*.py).
+
+TPU-native design: each optimizer's math is a pure per-parameter update rule;
+``step()`` gathers (param, grad, state) pytrees and applies ONE jitted update
+across all parameters (the multi-tensor/fused path of the reference,
+optimizer.py _append_optimize_multi_tensor, is the *default* here — XLA fuses
+the whole update into a few kernels). Handles are rebound in place, so eager
+semantics (param.grad produced by the tape) are preserved.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam",
+           "AdamW", "Adamax", "Lamb", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _clip(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append(g * scale)
+        return out
+
+
+class ClipGradByGlobalNorm:
+    """Reference: nn/clip.py ClipGradByGlobalNorm. In hybrid-parallel
+    training the norm is reduced across model-parallel groups by
+    HybridParallelOptimizer; here sharded grads are jax.Arrays whose global
+    norm XLA computes with a psum when inside pjit."""
+
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = clip_norm
+
+    def _clip(self, grads):
+        sq = [jnp.sum(jnp.square(g)) for g in grads if g is not None]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None else g * scale for g in grads]
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer/optimizer.py Optimizer)."""
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._param_groups = None
+        if parameters and isinstance(parameters[0], dict):
+            self._param_groups = parameters
+            flat = []
+            for g in parameters:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        if isinstance(weight_decay, float):
+            self._weight_decay = L2Decay(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[int, dict] = {}
+        self._global_step = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "set_lr is not allowed when the lr is an LRScheduler")
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._lr = scheduler
+
+    # -- state ---------------------------------------------------------------
+    def _ensure_state(self, p: Parameter) -> dict:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, p: Parameter) -> dict:
+        return {}
+
+    # -- the pure update rule (override) ------------------------------------
+    def _update(self, param, grad, state: dict, lr, step):
+        raise NotImplementedError
+
+    def _decay_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (L1Decay, L2Decay)):
+            return wd.coeff
+        return float(wd)
+
+    def _use_decay_for(self, p: Parameter) -> bool:
+        return True
+
+    # -- step ----------------------------------------------------------------
+    def step(self):
+        params = [p for p in (self._parameter_list or [])
+                  if not p.stop_gradient and p.grad is not None]
+        if not params:
+            self._global_step += 1
+            return
+        grads = [p.grad._data for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip(grads)
+        lr = self.get_lr()
+        self._global_step += 1
+        step = self._global_step
+        wd = self._decay_coeff()
+        is_l1 = isinstance(self._weight_decay, L1Decay)
+
+        for p, g in zip(params, grads):
+            if g is None:
+                continue
+            st = self._ensure_state(p)
+            self._current_param = p
+            use_wd = wd if self._use_decay_for(p) else 0.0
+            if use_wd and not self._decoupled_wd():
+                # Coupled regularizer-gradient (reference: regularizer.py):
+                # L2 adds coeff*w, L1 adds coeff*sign(w) to the gradient.
+                reg = jnp.sign(p._data) if is_l1 else p._data
+                g = g + use_wd * reg.astype(g.dtype)
+            new_p, new_st = self._update(
+                p._data, g, st, jnp.float32(lr), step)
+            if use_wd and self._decoupled_wd():
+                # Decoupled decay (AdamW) shrinks the *stored* weight: the
+                # float32 master when one exists, else the param itself.
+                master = new_st.get("master_weight")
+                if master is not None:
+                    decay_src = st.get("master_weight")
+                    decay_src = p._data.astype(jnp.float32) \
+                        if decay_src is None else decay_src
+                    new_st["master_weight"] = master - \
+                        lr * use_wd * decay_src
+                    new_p = new_st["master_weight"]
+                else:
+                    new_p = new_p - lr * use_wd * p._data
+            p._data = new_p.astype(p._data.dtype)
+            self._accumulators[id(p)] = new_st
+
+    def _decoupled_wd(self) -> bool:
+        return False
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- serialization -------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._parameter_list or []):
+            st = self._accumulators.get(id(p))
+            if st:
+                name = p.name or f"param_{i}"
+                for k, v in st.items():
+                    out[f"{name}.{k}"] = Tensor(v) if isinstance(
+                        v, jax.Array) else v
+        return out
+
+    def set_state_dict(self, state):
+        self._global_step = state.get("global_step", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list or []):
+            name = p.name or f"param_{i}"
+            st = self._ensure_state(p)
+            for k in list(st.keys()):
+                key = f"{name}.{k}"
+                if key in state:
+                    v = state[key]
+                    st[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update(self, param, grad, state, lr, step):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr, step):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_val)}
+
+    def _update(self, param, grad, state, lr, step):
+        m = state["moment"] + jnp.square(grad)
+        new_p = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._data),
+              "momentum": jnp.zeros_like(p._data)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._data)
+        return st
+
+    def _update(self, param, grad, state, lr, step):
+        ms = self._rho * state["mean_square"] + \
+            (1 - self._rho) * jnp.square(grad)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        new_state["momentum"] = mom
+        return param - mom, new_state
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+        self._multi_precision = multi_precision
+
+    def _init_state(self, p):
+        # multi_precision: keep a float32 master copy for bf16/fp16 params
+        # (reference: optimizer.py _create_master_weight).
+        st = {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+              "moment2": jnp.zeros(p._data.shape, jnp.float32)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p._data.shape, jnp.float32)
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            st["master_weight"] = p._data.astype(jnp.float32)
+        return st
+
+    def _update(self, param, grad, state, lr, step):
+        master = state.get("master_weight")
+        w = master if master is not None else param
+        g = grad.astype(jnp.float32)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * \
+            jnp.square(g)
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        new_state = {"moment1": m1, "moment2": m2}
+        v = m2
+        if self._amsgrad:
+            v = jnp.maximum(state["moment2_max"], m2)
+            new_state["moment2_max"] = v
+        update = (m1 / bc1) / (jnp.sqrt(v / bc2) + self._epsilon)
+        new_w = w - lr * update
+        if master is not None:
+            new_state["master_weight"] = new_w
+            return new_w.astype(param.dtype), new_state
+        return new_w, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision, amsgrad=amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_wd(self):
+        return True
+
+    def _use_decay_for(self, p):
+        if self._apply_decay_param_fun is not None:
+            return self._apply_decay_param_fun(p.name or "")
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._data.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, param, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        bc = 1 - self._beta1 ** step
+        new_p = param - (lr / bc) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+                "moment2": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, param, grad, state, lr, step):
+        g = grad.astype(jnp.float32)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * \
+            jnp.square(g)
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        r = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + self._epsilon)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(
+                getattr(self, "_current_param", None)):
+            wd = 0.0
+        update = r + wd * param.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(param.astype(jnp.float32))))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        trust = jnp.where(
+            (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        new_p = param - lr * trust * update
+        return new_p, {"moment1": m1, "moment2": m2}

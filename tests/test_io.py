@@ -1,0 +1,143 @@
+"""io tests (reference strategy: test/legacy_test/test_dataloader_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, ChainDataset, ComposeDataset,
+                           ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, WeightedRandomSampler,
+                           random_split)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class StreamDataset(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        x = paddle.to_tensor(np.arange(12).reshape(6, 2).astype(np.float32))
+        y = paddle.to_tensor(np.arange(6))
+        ds = TensorDataset([x, y])
+        assert len(ds) == 6
+        xi, yi = ds[2]
+        np.testing.assert_allclose(xi.numpy(), [4, 5])
+
+    def test_concat_and_subset(self):
+        d = ConcatDataset([RangeDataset(3), RangeDataset(2)])
+        assert len(d) == 5
+        assert d[3][0] == 0.0
+        s = Subset(RangeDataset(10), [5, 7])
+        assert len(s) == 2 and s[1][0] == 7.0
+
+    def test_compose(self):
+        d = ComposeDataset([RangeDataset(3), RangeDataset(3)])
+        assert len(d[0]) == 4
+
+    def test_random_split(self):
+        a, b = random_split(RangeDataset(10), [7, 3])
+        assert len(a) == 7 and len(b) == 3
+        all_idx = sorted([x[0] for x in a] + [x[0] for x in b])
+        assert all_idx == [float(i) for i in range(10)]
+
+    def test_chain(self):
+        c = ChainDataset([StreamDataset(2), StreamDataset(3)])
+        assert len(list(c)) == 5
+
+
+class TestSamplers:
+    def test_sequence(self):
+        assert list(SequenceSampler(RangeDataset(4))) == [0, 1, 2, 3]
+
+    def test_random_is_permutation(self):
+        got = sorted(RandomSampler(RangeDataset(10)))
+        assert got == list(range(10))
+
+    def test_weighted(self):
+        s = WeightedRandomSampler([0.0, 1.0, 0.0], num_samples=20)
+        assert all(i == 1 for i in s)
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(RangeDataset(10), batch_size=3)
+        batches = list(bs)
+        assert len(batches) == 4 and len(batches[-1]) == 1
+        bs = BatchSampler(RangeDataset(10), batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3
+
+    def test_distributed_batch_sampler_partitions(self):
+        seen = []
+        for rank in range(2):
+            s = DistributedBatchSampler(RangeDataset(10), batch_size=2,
+                                        num_replicas=2, rank=rank)
+            for b in s:
+                seen.extend(b)
+        assert sorted(seen) == list(range(10))
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4]
+        np.testing.assert_allclose(x.numpy(), [0, 1, 2, 3])
+
+    def test_shuffle_covers_all(self):
+        dl = DataLoader(RangeDataset(20), batch_size=5, shuffle=True)
+        seen = np.concatenate([b[0].numpy() for b in dl])
+        assert sorted(seen.tolist()) == [float(i) for i in range(20)]
+
+    def test_drop_last(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4, drop_last=True)
+        assert len(list(dl)) == 2
+
+    def test_num_workers_threaded(self):
+        dl = DataLoader(RangeDataset(32), batch_size=4, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 8
+        seen = np.concatenate([b[0].numpy() for b in batches])
+        assert sorted(seen.tolist()) == [float(i) for i in range(32)]
+
+    def test_iterable_dataset(self):
+        dl = DataLoader(StreamDataset(7), batch_size=3)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[-1].shape == [1]
+
+    def test_dict_collate(self):
+        class DictDS(Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.ones(2, np.float32)}
+
+            def __len__(self):
+                return 4
+        dl = DataLoader(DictDS(), batch_size=2)
+        b = next(iter(dl))
+        assert b["a"].shape == [2] and b["b"].shape == [2, 2]
+
+    def test_custom_collate(self):
+        dl = DataLoader(RangeDataset(4), batch_size=2,
+                        collate_fn=lambda b: len(b))
+        assert list(dl) == [2, 2]
+
+    def test_len(self):
+        dl = DataLoader(RangeDataset(10), batch_size=3)
+        assert len(dl) == 4
