@@ -1,1 +1,6 @@
-"""Placeholder — populated in this round."""
+"""paddle.amp parity surface (reference: python/paddle/amp/__init__.py)."""
+from . import amp_lists  # noqa
+from .auto_cast import (amp_decorate, amp_guard, auto_cast, black_list,  # noqa
+                        current_cast_dtype_for, decorate,
+                        is_auto_cast_enabled, white_list)
+from .grad_scaler import AmpScaler, GradScaler, OptimizerState  # noqa

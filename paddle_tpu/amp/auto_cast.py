@@ -1,0 +1,129 @@
+"""Automatic mixed precision (reference: python/paddle/amp/auto_cast.py).
+
+TPU-native notes: bfloat16 is the native half type (no loss scaling needed);
+float16 is supported for parity and pairs with GradScaler. O1 casts per-op by
+white/black list at the dispatcher seam (ops/_op.py consults
+``current_cast_dtype_for``); O2 casts whole layers via ``decorate`` keeping
+norm params in float32 + float32 master weights in the optimizer.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from . import amp_lists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate",
+           "is_auto_cast_enabled", "current_cast_dtype_for", "white_list",
+           "black_list"]
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = frozenset()
+        self.black = frozenset()
+
+
+_amp = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return _amp.enabled
+
+
+def white_list():
+    return _amp.white
+
+
+def black_list():
+    return _amp.black
+
+
+def current_cast_dtype_for(opname: str):
+    """Called by the op dispatcher per call. Returns the dtype float inputs
+    should be cast to, or None to leave them untouched."""
+    if not _amp.enabled:
+        return None
+    if opname in _amp.white:
+        return _amp.dtype
+    if opname in _amp.black:
+        return jnp.float32
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """paddle.amp.auto_cast parity (auto_cast.py amp_guard)."""
+    prev = (_amp.enabled, _amp.dtype, _amp.level, _amp.white, _amp.black)
+    white = set(amp_lists.WHITE_LIST)
+    black = set(amp_lists.BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    if level == "O2":
+        # O2: everything not blacklisted runs in the low dtype; the layer
+        # params were already cast by decorate(); treat white as "all".
+        black -= white
+    _amp.enabled = bool(enable)
+    _amp.dtype = convert_dtype(dtype)
+    _amp.level = level
+    _amp.white = frozenset(white)
+    _amp.black = frozenset(black)
+    try:
+        yield
+    finally:
+        (_amp.enabled, _amp.dtype, _amp.level, _amp.white,
+         _amp.black) = prev
+
+
+amp_guard = auto_cast
+
+_KEEP_FP32_LAYERS = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                     "RMSNorm", "SyncBatchNorm")
+
+
+def decorate(models, optimizers=None, level: str = "O2",
+             dtype: str = "bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate parity: O2 casts model params to the low dtype,
+    keeping norm layers in float32 (reference: auto_cast.py amp_decorate)."""
+    dt = convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                name = type(layer).__name__
+                if any(name.startswith(k) for k in _KEEP_FP32_LAYERS):
+                    continue
+                if excluded_layers and isinstance(
+                        layer, tuple(excluded_layers)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(
+                            p._data.dtype, jnp.floating):
+                        p._data = p._data.astype(dt)
+        if optimizers is not None:
+            opt_list = optimizers if isinstance(
+                optimizers, (list, tuple)) else [optimizers]
+            for o in opt_list:
+                if hasattr(o, "_multi_precision"):
+                    o._multi_precision = True
+    if optimizers is None:
+        return models if isinstance(models, (list, tuple)) else model_list[0]
+    return (models if isinstance(models, (list, tuple)) else model_list[0],
+            optimizers)
+
+
+amp_decorate = decorate

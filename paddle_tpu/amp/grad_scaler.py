@@ -1,0 +1,135 @@
+"""Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py
+AmpScaler:41 / GradScaler:619).
+
+Needed for float16 only — bfloat16 has fp32's exponent range, so the scaler
+becomes a transparent no-op when grads stay finite (use_dynamic_loss_scaling
+still honored for parity).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler", "OptimizerState"]
+
+
+class OptimizerState:
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True,
+                 init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._opt_states = {}
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v: float):
+        self._scale = float(v)
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        from .. import ops
+        return ops.scale(loss, scale=self._scale)
+
+    def _grads_of(self, optimizer):
+        return [p for p in (optimizer._parameter_list or [])
+                if p.grad is not None and not p.stop_gradient]
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        st = self._opt_states.get(id(optimizer), OptimizerState.INIT)
+        if st == OptimizerState.UNSCALED:
+            return
+        inv = 1.0 / self._scale
+        # One fused finiteness check: accumulate per-grad flags on device,
+        # materialize a single scalar at the end (no per-param host sync).
+        found_acc = jnp.zeros((), jnp.bool_)
+        for p in self._grads_of(optimizer):
+            g = p.grad._data * inv
+            found_acc = found_acc | jnp.any(~jnp.isfinite(g))
+            p.grad._data = g
+        self._found_inf = bool(found_acc)
+        self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._opt_states.get(id(optimizer),
+                                OptimizerState.INIT) != \
+                OptimizerState.UNSCALED:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._opt_states[id(optimizer)] = OptimizerState.STEPPED
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._opt_states.clear()
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._opt_states.clear()
+
+    def minimize(self, optimizer, loss):
+        # loss is assumed already scaled (reference AmpScaler.minimize)
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+        self._dynamic = state.get("use_dynamic_loss_scaling", self._dynamic)
+
+
+AmpScaler = GradScaler

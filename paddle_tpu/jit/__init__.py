@@ -1,1 +1,3 @@
-"""Placeholder — populated in this round."""
+"""paddle.jit parity surface (reference: python/paddle/jit/__init__.py)."""
+from .api import (InputSpec, StaticFunction, TranslatedLayer,  # noqa
+                  enable_to_static, load, not_to_static, save, to_static)

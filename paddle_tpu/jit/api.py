@@ -1,0 +1,385 @@
+"""paddle.jit — the compiled path (to_static / save / load).
+
+Reference: python/paddle/jit/api.py (to_static:136, save, load) and the
+dy2st machinery (SURVEY.md §2.3). TPU-native redesign:
+
+- **Capture** is trace-based: the eager Layer/function runs once under
+  ``jax.jit`` tracing with parameter/buffer handles temporarily rebound to
+  tracers (the Tensor facade is a pytree, so the SAME model code serves both
+  modes — no AST transpile or bytecode hook needed; those exist in the
+  reference because torch-style mutation can't trace, our ops are pure).
+- **Program cache** keyed by input shapes/dtypes/training-flag mirrors the
+  reference's _ExecutorCache (base/executor.py:857): new input signature →
+  new traced program (the reference's dynamic-shape buckets).
+- **Autograd**: a to_static call in training mode is ONE tape node whose
+  backward is the compiled vjp of the whole program — the static-graph
+  backward of the reference (append_backward) collapses into jax.vjp of the
+  jitted function; XLA compiles both passes.
+- **Buffers** (BN stats etc.) are threaded as extra outputs and written back
+  after each call, keeping in-place semantics without mutation inside jit.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.base import Layer
+
+__all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
+           "save", "load", "TranslatedLayer", "enable_to_static"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (shape may contain None: resolved at
+    first trace; each distinct concrete signature compiles once)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _sig_of(x) -> tuple:
+    if isinstance(x, Tensor):
+        return ("T", tuple(x._data.shape), str(x._data.dtype),
+                bool(x.stop_gradient))
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return ("A", tuple(x.shape), str(x.dtype))
+    if isinstance(x, (list, tuple)):
+        return ("L", tuple(_sig_of(v) for v in x))
+    if isinstance(x, dict):
+        return ("D", tuple(sorted((k, _sig_of(v)) for k, v in x.items())))
+    return ("P", repr(x))
+
+
+class _Program:
+    """One traced+compiled specialization (reference: a PIR Program +
+    PirInterpreter instance in the _ExecutorCache)."""
+
+    def __init__(self, jitted, out_tree_store):
+        self.jitted = jitted
+        self.out_tree_store = out_tree_store
+
+
+class StaticFunction:
+    """Callable wrapper produced by ``to_static``
+    (reference: dy2static/program_translator.py StaticFunction)."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 input_spec=None, build_strategy=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._programs: Dict[tuple, _Program] = {}
+        functools.update_wrapper(self, fn)
+
+    # -- helpers -------------------------------------------------------------
+    def _named_params(self):
+        if self._layer is None:
+            return []
+        return [(n, p) for n, p in self._layer.named_parameters()
+                if p is not None]
+
+    def _named_buffers(self):
+        if self._layer is None:
+            return []
+        return [(n, b) for n, b in self._layer.named_buffers()
+                if b is not None]
+
+    def _cache_key(self, args, kwargs):
+        training = self._layer.training if self._layer is not None else False
+        return (_sig_of(args), _sig_of(kwargs), training,
+                tuple(str(p._data.dtype) for _, p in self._named_params()))
+
+    def _build_program(self, args, kwargs) -> _Program:
+        named_params = self._named_params()
+        named_buffers = self._named_buffers()
+        fn = self._fn
+        out_store: dict = {}
+
+        def pure(param_arrays, buffer_arrays, arg_arrays, kwarg_arrays):
+            # Rebind handles to tracers for the duration of the trace,
+            # restore after (the handles belong to live eager objects).
+            saved_p = [(p, p._data) for _, p in named_params]
+            saved_b = [(b, b._data) for _, b in named_buffers]
+            try:
+                for (n, p) in named_params:
+                    p._data = param_arrays[n]
+                for (n, b) in named_buffers:
+                    b._data = buffer_arrays[n]
+                with state.functional_mode():
+                    out = fn(*arg_arrays, **kwarg_arrays)
+                new_buffers = {n: b._data for n, b in named_buffers}
+                flat, tree = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                flat = [o._data if isinstance(o, Tensor) else o for o in flat]
+                out_store["tree"] = tree
+                out_store["n_out"] = len(flat)
+                return tuple(flat), new_buffers
+            finally:
+                for p, d in saved_p:
+                    p._data = d
+                for b, d in saved_b:
+                    b._data = d
+
+        return _Program(jax.jit(pure), out_store)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)
+        key = self._cache_key(args, kwargs)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._build_program(args, kwargs)
+            self._programs[key] = prog
+
+        named_params = self._named_params()
+        named_buffers = self._named_buffers()
+        param_arrays = {n: p._data for n, p in named_params}
+        buffer_arrays = {n: b._data for n, b in named_buffers}
+        arg_arrays = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, args,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        kwarg_arrays = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+        trainable = [(n, p) for n, p in named_params if not p.stop_gradient]
+        diff_args: List[Tuple[int, Tensor]] = [
+            (i, a) for i, a in enumerate(args)
+            if isinstance(a, Tensor) and not a.stop_gradient
+            and jnp.issubdtype(a._data.dtype, jnp.inexact)]
+        need_grad = state.grad_enabled() and (trainable or diff_args)
+
+        if not need_grad:
+            flat_out, new_buffers = prog.jitted(
+                param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
+        else:
+            train_names = [n for n, _ in trainable]
+            diff_idx = [i for i, _ in diff_args]
+
+            def closed(train_arrays, diff_arg_arrays):
+                pa = dict(param_arrays)
+                pa.update(train_arrays)
+                aa = list(arg_arrays)
+                for i, arr in zip(diff_idx, diff_arg_arrays):
+                    aa[i] = arr
+                return prog.jitted(pa, buffer_arrays, tuple(aa),
+                                   kwarg_arrays)
+
+            train_arrays = {n: p._data for n, p in trainable}
+            diff_arg_arrays = tuple(a._data for _, a in diff_args)
+            (flat_out, new_buffers), vjp_fn = jax.vjp(
+                closed, train_arrays, diff_arg_arrays)
+
+            input_tensors = [p for _, p in trainable] + \
+                [a for _, a in diff_args]
+            zero_bufs = {n: jnp.zeros_like(v)
+                         for n, v in new_buffers.items()}
+
+            def tape_vjp(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else \
+                    (cotangents,)
+                g_train, g_args = vjp_fn((tuple(cts), zero_bufs))
+                return [g_train[n] for n in train_names] + list(g_args)
+
+            from ..autograd import tape
+            out_tensors = [Tensor(o) for o in flat_out]
+            tape.record_node(f"to_static[{self._fn.__name__}]", tape_vjp,
+                             input_tensors, out_tensors)
+            for n, b in named_buffers:
+                b._data = new_buffers[n]
+            tree = prog.out_tree_store["tree"]
+            wrapped = jax.tree_util.tree_unflatten(tree, out_tensors)
+            return wrapped
+
+        for n, b in named_buffers:
+            b._data = new_buffers[n]
+        tree = prog.out_tree_store["tree"]
+        return jax.tree_util.tree_unflatten(
+            tree, [Tensor(o) for o in flat_out])
+
+    @property
+    def concrete_programs(self):
+        return self._programs
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """paddle.jit.to_static parity (reference: jit/api.py:136)."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(obj.forward, layer=obj,
+                                input_spec=input_spec)
+            obj.forward = sf
+            return obj
+        layer = getattr(obj, "__self__", None)
+        if isinstance(layer, Layer):
+            return StaticFunction(obj, layer=layer, input_spec=input_spec)
+        return StaticFunction(obj, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# save / load: StableHLO export (reference: jit.save -> .pdmodel/.pdiparams)
+# ---------------------------------------------------------------------------
+
+def _resolve_specs(layer, input_spec):
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shape = [1 if d is None else int(d) for d in s.shape]
+            specs.append(jax.ShapeDtypeStruct(tuple(shape), s.dtype))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s._data.shape),
+                                              s._data.dtype))
+        else:
+            arr = jnp.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: writes ``path.pdmodel`` (serialized StableHLO
+    program via jax.export), ``path.pdiparams`` (weights), ``path.pdmeta``
+    (treedefs). The artifact is hermetic: load() does not need the model
+    class."""
+    if isinstance(layer, StaticFunction):
+        fn, owner = layer._fn, layer._layer
+    elif isinstance(layer, Layer):
+        fwd = layer.forward
+        if isinstance(fwd, StaticFunction):
+            fn, owner = fwd._fn, layer
+        else:
+            fn, owner = fwd, layer
+    else:
+        fn, owner = layer, None
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes to export)")
+    specs = _resolve_specs(owner, input_spec)
+
+    named_params = [] if owner is None else \
+        [(n, p) for n, p in owner.named_parameters()]
+    named_buffers = [] if owner is None else \
+        [(n, b) for n, b in owner.named_buffers()]
+    if owner is not None:
+        was_training = owner.training
+        owner.eval()
+
+    out_store = {}
+
+    def pure(param_arrays, buffer_arrays, *arg_arrays):
+        saved_p = [(p, p._data) for _, p in named_params]
+        saved_b = [(b, b._data) for _, b in named_buffers]
+        try:
+            for (n, p) in named_params:
+                p._data = param_arrays[n]
+            for (n, b) in named_buffers:
+                b._data = buffer_arrays[n]
+            with state.functional_mode():
+                out = fn(*arg_arrays)
+            flat, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_store["tree_pickle"] = pickle.dumps(tree)
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in flat)
+        finally:
+            for p, d in saved_p:
+                p._data = d
+            for b, d in saved_b:
+                b._data = d
+
+    param_specs = {n: jax.ShapeDtypeStruct(tuple(p._data.shape),
+                                           p._data.dtype)
+                   for n, p in named_params}
+    buffer_specs = {n: jax.ShapeDtypeStruct(tuple(b._data.shape),
+                                            b._data.dtype)
+                    for n, b in named_buffers}
+    exported = jax.export.export(jax.jit(pure))(
+        param_specs, buffer_specs, *specs)
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    from ..framework.io import save as fsave
+    fsave({"params": {n: p for n, p in named_params},
+           "buffers": {n: b for n, b in named_buffers}},
+          path + ".pdiparams")
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"out_tree": out_store["tree_pickle"],
+                     "n_inputs": len(specs)}, f)
+    if owner is not None and was_training:
+        owner.train()
+
+
+class TranslatedLayer(Layer):
+    """Deserialized inference program (reference:
+    jit/translated_layer.py TranslatedLayer)."""
+
+    def __init__(self, exported, params, buffers, out_tree):
+        super().__init__()
+        self._exported = exported
+        self._param_arrays = {n: (p._data if isinstance(p, Tensor)
+                                  else jnp.asarray(np.asarray(p)))
+                              for n, p in params.items()}
+        self._buffer_arrays = {n: (b._data if isinstance(b, Tensor)
+                                   else jnp.asarray(np.asarray(b)))
+                               for n, b in buffers.items()}
+        for n, arr in self._param_arrays.items():
+            self.add_parameter(n.replace(".", "__"), Parameter(arr))
+        self._out_tree = out_tree
+
+    def forward(self, *args):
+        arg_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                      for a in args]
+        flat = self._exported.call(self._param_arrays, self._buffer_arrays,
+                                   *arg_arrays)
+        return jax.tree_util.tree_unflatten(
+            self._out_tree, [Tensor(o) for o in flat])
+
+
+def load(path, **configs) -> TranslatedLayer:
+    """paddle.jit.load parity."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    from ..framework.io import load as fload
+    blob = fload(path + ".pdiparams")
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    out_tree = pickle.loads(meta["out_tree"])
+    return TranslatedLayer(exported, blob["params"], blob["buffers"],
+                           out_tree)

@@ -182,9 +182,9 @@ def maxout(x, *, groups: int, axis: int = 1):
     return jnp.max(x.reshape(shape), axis=ax + 1)
 
 
-@op_fn
-def gumbel_softmax(x, *, temperature: float = 1.0, hard: bool = False,
-                   axis: int = -1, key=None):
+@op_fn(name="gumbel_softmax_p")
+def _gumbel_softmax_op(x, *, temperature: float = 1.0, hard: bool = False,
+                       axis: int = -1, key=None):
     if key is not None:
         g = -jnp.log(-jnp.log(
             jax.random.uniform(key, x.shape, dtype=x.dtype, minval=1e-20,
@@ -198,3 +198,13 @@ def gumbel_softmax(x, *, temperature: float = 1.0, hard: bool = False,
                                     inplace=False)
         y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through estimator
     return y
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1, name=None):
+    """paddle gumbel_softmax parity — always samples Gumbel noise, drawing
+    its key from the framework RNG (same discipline as dropout)."""
+    del name
+    from ...framework import random as frandom
+    return _gumbel_softmax_op(x, temperature=temperature, hard=hard,
+                              axis=axis, key=frandom.next_key())

@@ -71,9 +71,14 @@ def _dropout_op(x, *, p, training, mode, key, bcast_dims=None):
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
-    """paddle.nn.functional.dropout parity (upscale_in_train default)."""
+    """paddle.nn.functional.dropout parity (upscale_in_train default).
+    downscale_in_infer scales by (1-p) at inference instead of upscaling
+    at train time (reference: common.py dropout)."""
     del name
     if not training or p == 0.0:
+        if p > 0.0 and mode == "downscale_in_infer":
+            from ...ops import scale as _scale
+            return _scale(x, scale=1.0 - p)
         return x if isinstance(x, Tensor) else wrap(unwrap(x))
     bcast = None
     if axis is not None:

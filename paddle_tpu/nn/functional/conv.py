@@ -124,6 +124,22 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
             pad_cfg = [(0, 0)] * nsp
         else:  # SAME
             pad_cfg = [((k[i] - 1) // 2, k[i] // 2) for i in range(nsp)]
+    if output_size is not None:
+        # Resolve the stride ambiguity: derive output_padding so the result
+        # hits the requested spatial size (reference: conv.py
+        # conv2d_transpose output_size handling).
+        if data_format.startswith("NC"):
+            in_sp = x.shape[2:2 + nsp]
+        else:
+            in_sp = x.shape[1:1 + nsp]
+        out_req = _tuplize(output_size, nsp)
+        opad = tuple(
+            out_req[i] - ((in_sp[i] - 1) * stride[i] - pad_cfg[i][0]
+                          - pad_cfg[i][1] + k[i])
+            for i in range(nsp))
+        if any(o < 0 or o >= stride[i] for i, o in enumerate(opad)):
+            raise ValueError(
+                f"output_size {out_req} unreachable with stride {stride}")
     tpad = [(k[i] - 1 - pad_cfg[i][0],
              k[i] - 1 - pad_cfg[i][1] + opad[i]) for i in range(nsp)]
 

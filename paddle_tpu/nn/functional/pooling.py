@@ -61,14 +61,28 @@ def _pool(x, nsp, kernel, stride, padding, data_format, kind,
     if isinstance(pad, str):
         pad_seq = lax.padtype_to_pads(x.shape, dims, strides, pad)
     else:
-        pad_seq = pad
+        pad_seq = list(pad)
+    if ceil_mode:
+        # Extend the high-side padding so partially-covered windows are
+        # emitted: out = ceil((in + pl + pr - k)/s) + 1 (paddle semantics).
+        pad_seq = list(pad_seq)
+        for ax in range(x.ndim):
+            kk, ss = dims[ax], strides[ax]
+            if kk == 1 and ss == 1:
+                continue
+            pl, pr = pad_seq[ax]
+            span = x.shape[ax] + pl + pr - kk
+            out_ceil = -(-span // ss) + 1
+            needed = (out_ceil - 1) * ss + kk - (x.shape[ax] + pl + pr)
+            if needed > 0:
+                pad_seq[ax] = (pl, pr + needed)
     if kind == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, dims, strides, pad_seq)
     # avg
     summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad_seq)
-    if exclusive and any(p != (0, 0) for p in pad_seq):
+    if (exclusive or ceil_mode) and any(p != (0, 0) for p in pad_seq):
         ones = jnp.ones(x.shape, x.dtype)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad_seq)
         return summed / counts
@@ -99,19 +113,22 @@ def avg_pool3d(x, *, kernel_size, stride=None, padding=0, exclusive=True,
 @op_fn
 def max_pool1d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NCL"):
-    return _pool(x, 1, kernel_size, stride, padding, data_format, "max")
+    return _pool(x, 1, kernel_size, stride, padding, data_format, "max",
+                 ceil_mode=ceil_mode)
 
 
 @op_fn
 def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NCHW"):
-    return _pool(x, 2, kernel_size, stride, padding, data_format, "max")
+    return _pool(x, 2, kernel_size, stride, padding, data_format, "max",
+                 ceil_mode=ceil_mode)
 
 
 @op_fn
 def max_pool3d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NCDHW"):
-    return _pool(x, 3, kernel_size, stride, padding, data_format, "max")
+    return _pool(x, 3, kernel_size, stride, padding, data_format, "max",
+                 ceil_mode=ceil_mode)
 
 
 def _adaptive(x, nsp, output_size, data_format, kind):

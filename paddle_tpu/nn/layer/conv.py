@@ -50,6 +50,28 @@ class _ConvNd(Layer):
                 (out_channels,), attr=bias_attr, is_bias=True,
                 default_initializer=I.Uniform(-bound, bound))
 
+    def _prepad(self, x):
+        """Non-zero padding modes pre-pad the input (reflect/replicate/
+        circular) and run the conv unpadded (reference: conv.py _ConvNd)."""
+        if self._padding_mode == "zeros":
+            return x, self._padding
+        p = self._padding
+        if isinstance(p, int):
+            spec = [p, p] * self._nsp
+        else:
+            # conv padding lists are first-spatial-dim-first; F.pad wants
+            # last-dim-first pairs, so reverse the per-dim order.
+            spec = []
+            for v in reversed(list(p)):
+                if isinstance(v, (tuple, list)):
+                    spec += [v[0], v[1]]
+                else:
+                    spec += [v, v]
+        mode = {"reflect": "reflect", "replicate": "replicate",
+                "circular": "circular"}[self._padding_mode]
+        x = F.pad(x, spec, mode=mode, data_format=self._data_format)
+        return x, 0
+
     def extra_repr(self):
         return (f"{self._in_channels}, {self._out_channels}, "
                 f"kernel_size={self._kernel_size}, stride={self._stride}")
@@ -64,8 +86,9 @@ class Conv1D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
+        x, pad = self._prepad(x)
         return F.conv1d(x, self.weight, self.bias, stride=self._stride,
-                        padding=self._padding, dilation=self._dilation,
+                        padding=pad, dilation=self._dilation,
                         groups=self._groups, data_format=self._data_format)
 
 
@@ -78,8 +101,9 @@ class Conv2D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
+        x, pad = self._prepad(x)
         return F.conv2d(x, self.weight, self.bias, stride=self._stride,
-                        padding=self._padding, dilation=self._dilation,
+                        padding=pad, dilation=self._dilation,
                         groups=self._groups, data_format=self._data_format)
 
 
@@ -92,8 +116,9 @@ class Conv3D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
+        x, pad = self._prepad(x)
         return F.conv3d(x, self.weight, self.bias, stride=self._stride,
-                        padding=self._padding, dilation=self._dilation,
+                        padding=pad, dilation=self._dilation,
                         groups=self._groups, data_format=self._data_format)
 
 

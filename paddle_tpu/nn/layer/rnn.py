@@ -64,34 +64,78 @@ def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
 @op_fn
 def _rnn_scan(x, h0, w_ih, w_hh, b_ih=None, b_hh=None, *, mode: str,
               activation: str = "tanh", reverse: bool = False,
-              c0=None):
-    """One direction, one layer. x: [B,T,I]; h0: [B,H]. Returns (out, h[,c])."""
+              c0=None, seq_len=None):
+    """One direction, one layer. x: [B,T,I]; h0: [B,H]. Returns (out, h[,c]).
+
+    ``seq_len`` [B] masks padded timesteps: the carried state freezes at the
+    last valid step (so final h/c match the unpadded run) and padded outputs
+    are zero; the reverse direction reverses only the valid region —
+    reference semantics of rnn.py with sequence_length.
+    """
     act = jnp.tanh if activation == "tanh" else (lambda v: jnp.maximum(v, 0))
-    xs = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+    T = x.shape[1]
     if reverse:
-        xs = jnp.flip(xs, axis=0)
+        if seq_len is None:
+            x = jnp.flip(x, axis=1)
+        else:
+            # per-batch reversal of the valid prefix: t -> len-1-t for t<len
+            tgrid = jnp.arange(T)[None, :]
+            idx = jnp.where(tgrid < seq_len[:, None],
+                            seq_len[:, None] - 1 - tgrid, tgrid)
+            x = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    xs = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+    ts = jnp.arange(T)
+
+    def mask_of(t):
+        if seq_len is None:
+            return None
+        return (t < seq_len)[:, None]  # [B,1]
 
     if mode == "LSTM":
-        def step(carry, x_t):
+        def step(carry, inp):
+            x_t, t = inp
             h, c = carry
             h2, c2 = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
-            return (h2, c2), h2
-        (hT, cT), ys = lax.scan(step, (h0, c0), xs)
-        if reverse:
-            ys = jnp.flip(ys, axis=0)
-        return jnp.swapaxes(ys, 0, 1), hT, cT
-    if mode == "GRU":
-        def step(h, x_t):
-            h2 = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
-            return h2, h2
+            m = mask_of(t)
+            if m is not None:
+                h2 = jnp.where(m, h2, h)
+                c2 = jnp.where(m, c2, c)
+                y = jnp.where(m, h2, 0.0)
+            else:
+                y = h2
+            return (h2, c2), y
+        (hT, cT), ys = lax.scan(step, (h0, c0), (xs, ts))
     else:
-        def step(h, x_t):
-            h2 = _rnn_step(act, x_t, h, w_ih, w_hh, b_ih, b_hh)
-            return h2, h2
-    hT, ys = lax.scan(step, h0, xs)
+        if mode == "GRU":
+            def cell(x_t, h):
+                return _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+        else:
+            def cell(x_t, h):
+                return _rnn_step(act, x_t, h, w_ih, w_hh, b_ih, b_hh)
+
+        def step(h, inp):
+            x_t, t = inp
+            h2 = cell(x_t, h)
+            m = mask_of(t)
+            if m is not None:
+                h2 = jnp.where(m, h2, h)
+                y = jnp.where(m, h2, 0.0)
+            else:
+                y = h2
+            return h2, y
+        hT, ys = lax.scan(step, h0, (xs, ts))
+    ys = jnp.swapaxes(ys, 0, 1)  # [B,T,H]
     if reverse:
-        ys = jnp.flip(ys, axis=0)
-    return jnp.swapaxes(ys, 0, 1), hT
+        if seq_len is None:
+            ys = jnp.flip(ys, axis=1)
+        else:
+            tgrid = jnp.arange(T)[None, :]
+            idx = jnp.where(tgrid < seq_len[:, None],
+                            seq_len[:, None] - 1 - tgrid, tgrid)
+            ys = jnp.take_along_axis(ys, idx[:, :, None], axis=1)
+    if mode == "LSTM":
+        return ys, hT, cT
+    return ys, hT
 
 
 class _RNNCellBase(Layer):
@@ -268,15 +312,19 @@ class _RNNBase(Layer):
                 w_hh = getattr(self, f"weight_hh_l{layer}{sfx}")
                 b_ih = getattr(self, f"bias_ih_l{layer}{sfx}")
                 b_hh = getattr(self, f"bias_hh_l{layer}{sfx}")
+                slen = sequence_length
+                if slen is not None and hasattr(slen, "_data"):
+                    slen = slen._data
                 if is_lstm:
                     y, hT, cT = _rnn_scan(
                         x, h0s[idx], w_ih, w_hh, b_ih, b_hh, mode="LSTM",
-                        reverse=(d == 1), c0=c0s[idx])
+                        reverse=(d == 1), c0=c0s[idx], seq_len=slen)
                     c_finals.append(cT)
                 else:
                     y, hT = _rnn_scan(
                         x, h0s[idx], w_ih, w_hh, b_ih, b_hh, mode=mode,
-                        activation=self.activation, reverse=(d == 1))
+                        activation=self.activation, reverse=(d == 1),
+                        seq_len=slen)
                 h_finals.append(hT)
                 outs.append(y)
             x = outs[0] if n_dir == 1 else ops.concat(outs, axis=-1)

@@ -72,6 +72,18 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
         raw = [unwrap(a) for a in args]
         kwraw = {k: unwrap(v) for k, v in kwargs.items()}
 
+        # AMP auto-cast seam (reference: the AMP_LOGIC_TEMPLATE block in every
+        # generated ad-func, eager_gen.py:565): white-list ops cast float
+        # inputs to the amp dtype, black-list ops to float32.
+        from ..amp.auto_cast import current_cast_dtype_for
+        amp_dt = current_cast_dtype_for(opname)
+        if amp_dt is not None:
+            raw = [a.astype(amp_dt)
+                   if (hasattr(a, "dtype") and hasattr(a, "astype")
+                       and jnp.issubdtype(a.dtype, jnp.floating)
+                       and a.dtype != amp_dt)
+                   else a for a in raw]
+
         need_grad = (
             differentiable
             and state.grad_enabled()

@@ -320,6 +320,15 @@ class OneCycleLR(LRScheduler):
         up_steps = int(self.phase_pct * self.total_steps)
         if step <= up_steps and up_steps > 0:
             return self._interp(self.initial_lr, self.max_lr, step / up_steps)
+        if self.three_phase:
+            # up -> symmetric down to initial_lr -> anneal to end_lr
+            down_steps = up_steps
+            if step <= up_steps + down_steps and down_steps > 0:
+                pct = (step - up_steps) / down_steps
+                return self._interp(self.max_lr, self.initial_lr, pct)
+            tail = self.total_steps - up_steps - down_steps
+            pct = (step - up_steps - down_steps) / max(tail, 1)
+            return self._interp(self.initial_lr, self.end_lr, pct)
         down = self.total_steps - up_steps
         pct = (step - up_steps) / max(down, 1)
         return self._interp(self.max_lr, self.end_lr, pct)

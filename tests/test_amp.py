@@ -1,0 +1,155 @@
+"""AMP tests (reference strategy: test/amp/ — dtype routing by op list,
+GradScaler dynamics, O2 decorate)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32), stop_gradient=sg)
+
+
+class TestAutoCast:
+    def test_white_op_casts_to_bf16(self):
+        a, b = t(np.random.randn(4, 4)), t(np.random.randn(4, 4))
+        with amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+
+    def test_black_op_stays_fp32(self):
+        x = paddle.to_tensor(np.random.randn(4).astype(np.float32))
+        with amp.auto_cast(dtype="bfloat16"):
+            out = paddle.exp(x)
+        assert out.dtype == jnp.float32
+
+    def test_other_ops_keep_input_dtype(self):
+        x = t(np.random.randn(4))
+        with amp.auto_cast():
+            out = x + x
+        assert out.dtype == jnp.float32
+
+    def test_disabled_outside_context(self):
+        a, b = t(np.random.randn(2, 2)), t(np.random.randn(2, 2))
+        out = paddle.matmul(a, b)
+        assert out.dtype == jnp.float32
+
+    def test_custom_lists(self):
+        x = t(np.random.randn(4))
+        with amp.auto_cast(custom_white_list={"exp"}, dtype="bfloat16"):
+            out = paddle.exp(x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_nested_restores(self):
+        with amp.auto_cast():
+            assert amp.is_auto_cast_enabled()
+            with amp.auto_cast(enable=False):
+                assert not amp.is_auto_cast_enabled()
+            assert amp.is_auto_cast_enabled()
+        assert not amp.is_auto_cast_enabled()
+
+    def test_linear_under_autocast_trains(self):
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        o = opt.Adam(learning_rate=1e-2, parameters=net.parameters())
+        x = t(np.random.randn(16, 8))
+        y = t(np.random.randn(16, 4))
+        first = last = None
+        for _ in range(40):
+            with amp.auto_cast(dtype="bfloat16"):
+                out = net(x)
+            loss = paddle.mean((out.astype("float32") - y) ** 2)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first
+
+
+class TestDecorate:
+    def test_o2_casts_params_but_not_norms(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8),
+                              nn.Linear(8, 2))
+        amp.decorate(model, level="O2", dtype="bfloat16")
+        assert model[0].weight.dtype == jnp.bfloat16
+        assert model[1].weight.dtype == jnp.float32
+        assert model[2].weight.dtype == jnp.bfloat16
+
+    def test_o2_sets_multi_precision(self):
+        model = nn.Linear(4, 4)
+        o = opt.AdamW(parameters=model.parameters())
+        amp.decorate(model, o, level="O2")
+        assert o._multi_precision
+
+
+class TestGradScaler:
+    def test_scale_multiplies(self):
+        s = amp.GradScaler(init_loss_scaling=8.0)
+        loss = t(2.0)
+        assert float(s.scale(loss)) == 16.0
+
+    def test_unscale_restores_grads(self):
+        p = paddle.Parameter(t([1.0, 2.0])._data)
+        s = amp.GradScaler(init_loss_scaling=4.0)
+        loss = s.scale(paddle.sum(p * 3.0))
+        loss.backward()
+        np.testing.assert_allclose(p.grad.numpy(), [12.0, 12.0])
+        o = opt.SGD(learning_rate=0.0, parameters=[p])
+        s.unscale_(o)
+        np.testing.assert_allclose(p.grad.numpy(), [3.0, 3.0])
+
+    def test_inf_skips_step_and_decreases_scale(self):
+        p = paddle.Parameter(t([1.0])._data)
+        o = opt.SGD(learning_rate=1.0, parameters=[p])
+        s = amp.GradScaler(init_loss_scaling=64.0, decr_ratio=0.5)
+        p.grad = t([float("inf")])
+        s.step(o)
+        s.update()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+        assert s.get_loss_scaling() == 32.0
+
+    def test_good_steps_increase_scale(self):
+        p = paddle.Parameter(t([1.0])._data)
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        s = amp.GradScaler(init_loss_scaling=2.0, incr_every_n_steps=2,
+                           incr_ratio=2.0)
+        for _ in range(2):
+            loss = s.scale(paddle.sum(p * 1.0))
+            loss.backward()
+            s.step(o)
+            s.update()
+            o.clear_grad()
+        assert s.get_loss_scaling() == 4.0
+
+    def test_full_fp16_loop(self):
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        o = opt.Adam(learning_rate=1e-2, parameters=net.parameters())
+        s = amp.GradScaler(init_loss_scaling=1024.0)
+        x = t(np.random.randn(8, 8))
+        y = t(np.random.randn(8, 4))
+        first = last = None
+        for _ in range(30):
+            with amp.auto_cast(dtype="float16"):
+                out = net(x)
+            loss = paddle.mean((out.astype("float32") - y) ** 2)
+            scaled = s.scale(loss)
+            scaled.backward()
+            s.step(o)
+            s.update()
+            o.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first
+
+    def test_state_dict(self):
+        s = amp.GradScaler(init_loss_scaling=7.0)
+        st = s.state_dict()
+        s2 = amp.GradScaler()
+        s2.load_state_dict(st)
+        assert s2.get_loss_scaling() == 7.0

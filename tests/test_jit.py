@@ -1,0 +1,147 @@
+"""jit/to_static tests (reference strategy: test/dygraph_to_static/ —
+eager vs compiled output parity, program caching, save/load roundtrip)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import jit
+
+
+def t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32), stop_gradient=sg)
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        net = SmallNet()
+        x = t(np.random.randn(3, 4))
+        eager = net(x).numpy()
+        snet = jit.to_static(SmallNet())
+        snet.set_state_dict(net.state_dict())
+        np.testing.assert_allclose(snet(x).numpy(), eager, rtol=1e-5)
+
+    def test_program_cache_per_shape(self):
+        net = jit.to_static(SmallNet())
+        net.eval()
+        net(t(np.random.randn(2, 4)))
+        net(t(np.random.randn(5, 4)))
+        net(t(np.random.randn(2, 4)))
+        assert len(net.forward.concrete_programs) == 2
+
+    def test_function_to_static(self):
+        @jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        a, b = t(np.random.randn(2, 3)), t(np.random.randn(3, 2))
+        want = a.numpy() @ b.numpy() + 1.0
+        np.testing.assert_allclose(f(a, b).numpy(), want, rtol=1e-5)
+
+    def test_training_backward_through_compiled(self):
+        paddle.seed(0)
+        net_e = SmallNet()
+        net_s = jit.to_static(SmallNet())
+        net_s.set_state_dict(net_e.state_dict())
+        x = t(np.random.randn(4, 4))
+        y = t(np.random.randn(4, 2))
+
+        le = paddle.mean((net_e(x) - y) ** 2)
+        le.backward()
+        ls = paddle.mean((net_s(x) - y) ** 2)
+        ls.backward()
+        assert abs(float(le) - float(ls)) < 1e-5
+        np.testing.assert_allclose(net_e.fc1.weight.grad.numpy(),
+                                   net_s.fc1.weight.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_compiled_training_converges(self):
+        paddle.seed(0)
+        net = jit.to_static(SmallNet())
+        o = opt.Adam(learning_rate=1e-2, parameters=net.parameters())
+        x = t(np.random.randn(16, 4))
+        y = t(np.random.randn(16, 2))
+        first = last = None
+        for _ in range(50):
+            loss = paddle.mean((net(x) - y) ** 2)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first * 0.5
+
+    def test_input_grad_flows(self):
+        net = jit.to_static(SmallNet())
+        x = t(np.random.randn(2, 4), sg=False)
+        loss = paddle.sum(net(x))
+        loss.backward()
+        assert x.grad is not None and x.grad.shape == [2, 4]
+
+    def test_buffer_update_under_jit(self):
+        class BNNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.bn = nn.BatchNorm1D(4, data_format="NCL")
+
+            def forward(self, x):
+                return self.bn(x)
+
+        net = jit.to_static(BNNet())
+        net.train()
+        before = net.bn._mean.numpy().copy()
+        with paddle.no_grad():
+            net(t(np.random.randn(8, 4, 5) * 3 + 2))
+        after = net.bn._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_enable_to_static_toggle(self):
+        net = jit.to_static(SmallNet())
+        jit.enable_to_static(False)
+        try:
+            x = t(np.random.randn(2, 4))
+            out = net(x)  # falls back to eager
+            assert out.shape == [2, 2]
+            assert len(net.forward.concrete_programs) == 0
+        finally:
+            jit.enable_to_static(True)
+
+
+class TestSaveLoad:
+    def test_roundtrip(self):
+        net = SmallNet()
+        net.eval()
+        x = t(np.random.randn(3, 4))
+        want = net(x).numpy()
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "model")
+        jit.save(net, path, input_spec=[jit.InputSpec([3, 4], "float32")])
+        assert os.path.exists(path + ".pdmodel")
+        loaded = jit.load(path)
+        got = loaded(x).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_loaded_artifact_is_hermetic(self):
+        """Load must not need the original class (serving parity)."""
+        net = SmallNet()
+        net.eval()
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "m2")
+        jit.save(net, path, input_spec=[jit.InputSpec([2, 4], "float32")])
+        loaded = jit.load(path)
+        out = loaded(t(np.random.randn(2, 4)))
+        assert out.shape == [2, 2]

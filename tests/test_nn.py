@@ -376,3 +376,67 @@ class TestLayerMechanics:
         bn = nn.BatchNorm1D(3, data_format="NCL")
         sd = bn.state_dict()
         assert "_mean" in sd and "_variance" in sd
+
+
+class TestParityFixes:
+    """Regression tests for Paddle-parity parameters that are easy to drop
+    silently (found via review): ceil_mode, padding_mode, output_size,
+    sequence_length, dropout downscale mode, gumbel sampling."""
+
+    def test_ceil_mode_shapes(self):
+        x = t(np.random.randn(1, 1, 6, 6))
+        assert F.max_pool2d(x, kernel_size=3, stride=2,
+                            ceil_mode=True).shape == [1, 1, 3, 3]
+        assert F.max_pool2d(x, kernel_size=3, stride=2).shape == [1, 1, 2, 2]
+        ya = F.avg_pool2d(t(np.ones((1, 1, 6, 6))), kernel_size=3, stride=2,
+                          ceil_mode=True)
+        np.testing.assert_allclose(ya.numpy(), np.ones((1, 1, 3, 3)))
+
+    def test_conv_transpose_output_size(self):
+        x = t(np.random.randn(1, 2, 7, 7))
+        convt = nn.Conv2DTranspose(2, 3, 3, stride=2, padding=1)
+        assert convt(x, output_size=[14, 14]).shape == [1, 3, 14, 14]
+        assert convt(x).shape == [1, 3, 13, 13]
+
+    def test_conv_padding_mode_reflect(self):
+        c = nn.Conv2D(1, 1, 3, padding=1, padding_mode="reflect",
+                      bias_attr=False)
+        xi = t(np.random.randn(1, 1, 5, 5))
+        want = F.conv2d(F.pad(xi, [1, 1, 1, 1], mode="reflect"), c.weight,
+                        stride=1, padding=0).numpy()
+        np.testing.assert_allclose(c(xi).numpy(), want, rtol=1e-5)
+
+    def test_dropout_downscale_in_infer(self):
+        x = t(np.ones(10))
+        y = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+        np.testing.assert_allclose(y.numpy(), 0.5 * np.ones(10))
+
+    def test_gumbel_softmax_samples(self):
+        paddle.seed(3)
+        logits = t(np.zeros((4, 8)))
+        g1 = F.gumbel_softmax(logits, hard=True).numpy()
+        g2 = F.gumbel_softmax(logits, hard=True).numpy()
+        assert not np.allclose(g1, g2)
+        np.testing.assert_allclose(g1.sum(-1), np.ones(4))
+
+    def test_lstm_sequence_length(self):
+        paddle.seed(4)
+        lstm = nn.LSTM(3, 5)
+        xfull = np.random.randn(2, 6, 3).astype(np.float32)
+        lens = paddle.to_tensor(np.array([4, 6], np.int32))
+        out, (h, c) = lstm(t(xfull), sequence_length=lens)
+        out_p, (h_p, c_p) = lstm(t(xfull[:, :4]))
+        np.testing.assert_allclose(h.numpy()[0, 0], h_p.numpy()[0, 0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(out.numpy()[0, 4:], np.zeros((2, 5)),
+                                   atol=1e-6)
+
+    def test_gru_bidirect_sequence_length(self):
+        paddle.seed(4)
+        gru = nn.GRU(3, 4, direction="bidirect")
+        xfull = np.random.randn(2, 6, 3).astype(np.float32)
+        lens = paddle.to_tensor(np.array([4, 6], np.int32))
+        ob, hb = gru(t(xfull), sequence_length=lens)
+        ob_p, hb_p = gru(t(xfull[:, :4]))
+        np.testing.assert_allclose(ob.numpy()[0, :4], ob_p.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)

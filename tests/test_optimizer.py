@@ -242,3 +242,26 @@ class TestOptimizerState:
         o.minimize(loss)
         assert p.grad is None  # cleared
         assert not np.allclose(p.numpy(), np.zeros(4))
+
+
+class TestLRParityFixes:
+    def test_onecycle_three_phase(self):
+        s = lr_mod.OneCycleLR(max_learning_rate=0.1, total_steps=100,
+                              three_phase=True, phase_pct=0.3)
+        vals = []
+        for _ in range(101):
+            vals.append(s())
+            s.step()
+        assert abs(vals[30] - 0.1) < 1e-6          # peak after up phase
+        assert abs(vals[60] - 0.1 / 25) < 1e-3     # back to initial_lr
+        assert vals[100] <= 2e-4                   # annealed to end_lr
+
+    def test_l1_decay_applies_sign(self):
+        p = paddle.Parameter(paddle.to_tensor(
+            np.array([2.0, -2.0], np.float32))._data)
+        o = opt.SGD(learning_rate=1.0, parameters=[p],
+                    weight_decay=opt.L1Decay(0.5))
+        loss = paddle.sum(p * 0.0)
+        loss.backward()
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [1.5, -1.5], rtol=1e-6)
