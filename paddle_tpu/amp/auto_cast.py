@@ -63,7 +63,7 @@ def auto_cast(enable: bool = True, custom_white_list=None,
               custom_black_list=None, level: str = "O1",
               dtype: str = "bfloat16", use_promote: bool = True):
     """paddle.amp.auto_cast parity (auto_cast.py amp_guard)."""
-    prev = (_amp.enabled, _amp.dtype, _amp.level, _amp.white, _amp.black)
+    dt = convert_dtype(dtype)  # validate before touching global state
     white = set(amp_lists.WHITE_LIST)
     black = set(amp_lists.BLACK_LIST)
     if custom_white_list:
@@ -76,12 +76,13 @@ def auto_cast(enable: bool = True, custom_white_list=None,
         # O2: everything not blacklisted runs in the low dtype; the layer
         # params were already cast by decorate(); treat white as "all".
         black -= white
-    _amp.enabled = bool(enable)
-    _amp.dtype = convert_dtype(dtype)
-    _amp.level = level
-    _amp.white = frozenset(white)
-    _amp.black = frozenset(black)
+    prev = (_amp.enabled, _amp.dtype, _amp.level, _amp.white, _amp.black)
     try:
+        _amp.enabled = bool(enable)
+        _amp.dtype = dt
+        _amp.level = level
+        _amp.white = frozenset(white)
+        _amp.black = frozenset(black)
         yield
     finally:
         (_amp.enabled, _amp.dtype, _amp.level, _amp.white,

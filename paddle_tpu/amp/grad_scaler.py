@@ -38,8 +38,8 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
-        self._opt_states = {}
+        self._found_inf = False          # any-optimizer aggregate (for update)
+        self._opt_states = {}            # id(opt) -> (state, found_inf)
 
     def is_enable(self) -> bool:
         return self._enable
@@ -66,9 +66,14 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        st = self._opt_states.get(id(optimizer), OptimizerState.INIT)
+        st, _ = self._opt_states.get(id(optimizer),
+                                     (OptimizerState.INIT, False))
         if st == OptimizerState.UNSCALED:
             return
+        if st == OptimizerState.STEPPED:
+            raise RuntimeError(
+                "unscale_() is being called after step() for this optimizer; "
+                "call update() first (reference: grad_scaler.py)")
         inv = 1.0 / self._scale
         # One fused finiteness check: accumulate per-grad flags on device,
         # materialize a single scalar at the end (no per-param host sync).
@@ -77,20 +82,28 @@ class GradScaler:
             g = p.grad._data * inv
             found_acc = found_acc | jnp.any(~jnp.isfinite(g))
             p.grad._data = g
-        self._found_inf = bool(found_acc)
-        self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
+        found = bool(found_acc)
+        # Per-optimizer flag: another optimizer's clean grads must not clear
+        # this one's inf result (and vice versa).
+        self._found_inf = self._found_inf or found
+        self._opt_states[id(optimizer)] = (OptimizerState.UNSCALED, found)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if self._opt_states.get(id(optimizer),
-                                OptimizerState.INIT) != \
-                OptimizerState.UNSCALED:
+        st, _ = self._opt_states.get(id(optimizer),
+                                     (OptimizerState.INIT, False))
+        if st == OptimizerState.STEPPED:
+            raise RuntimeError(
+                "step() has already been called for this optimizer since the "
+                "last update()")
+        if st != OptimizerState.UNSCALED:
             self.unscale_(optimizer)
-        if not self._found_inf:
+        _, found = self._opt_states[id(optimizer)]
+        if not found:
             optimizer.step()
-        self._opt_states[id(optimizer)] = OptimizerState.STEPPED
+        self._opt_states[id(optimizer)] = (OptimizerState.STEPPED, found)
 
     def update(self):
         if not self._enable or not self._dynamic:

@@ -1,6 +1,37 @@
-"""paddle.distributed parity surface — phase-5 build-out in progress.
+"""paddle.distributed parity surface.
 
-Reference export list: python/paddle/distributed/__init__.py (SURVEY.md §2.6).
+Reference export list: python/paddle/distributed/__init__.py (SURVEY.md §2.6
+"Public paddle.distributed API (parity checklist)").
+
+Layering (TPU-native):
+  env.py          — rank/world/init over the jax.distributed coordination svc
+  process_mesh.py — ProcessMesh -> jax.sharding.Mesh
+  placement.py    — Shard/Replicate/Partial vocabulary
+  api.py          — shard_tensor/reshard/shard_layer/shard_optimizer (DistTensor
+                    = jax.Array + NamedSharding)
+  collective.py   — process groups + eager/host collectives
+  comm_ops.py     — compiled collectives (lax.psum/all_gather/ppermute) — the
+                    actual ICI/DCN backend
+  fleet/          — hybrid-parallel programming model (topology, mp layers)
 """
-from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,  # noqa
-                  is_initialized)
+from . import comm_ops  # noqa
+from .api import (ShardingStage1, ShardingStage2, ShardingStage3,  # noqa
+                  dtensor_from_fn, reshard, shard_dataloader, shard_layer,
+                  shard_optimizer, shard_scaler, shard_tensor,
+                  unshard_dtensor)
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
+                         all_reduce, alltoall, alltoall_single, barrier,
+                         broadcast, broadcast_object_list,
+                         destroy_process_group, gather, get_backend,
+                         get_group, irecv, is_available, isend, new_group,
+                         recv, reduce, reduce_scatter, scatter,
+                         scatter_object_list, send, wait)
+from .env import (ParallelEnv, get_rank, get_world_size,  # noqa
+                  init_parallel_env, is_initialized)
+from .placement import Partial, Placement, ReduceType, Replicate, Shard  # noqa
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa
+
+from . import fleet  # noqa  (hybrid-parallel programming model)
+from .parallel import DataParallel  # noqa
+from . import checkpoint  # noqa
+from .checkpoint import load_state_dict, save_state_dict  # noqa

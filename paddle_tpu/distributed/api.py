@@ -1,0 +1,316 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer /
+shard_optimizer / ShardingStage1-3 / shard_dataloader.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor:131,
+reshard:579, shard_layer:678, shard_optimizer:1353, ShardingStage1/2/3
+shard_fns :1122-1352, shard_dataloader:2846).
+
+TPU-native redesign (SURVEY.md §7): ``jax.Array + NamedSharding`` *is* the
+DistTensor. ``shard_tensor`` = ``jax.device_put`` with a NamedSharding;
+``reshard`` = another device_put — XLA emits the collective (all-gather,
+all-to-all for s→s, etc.) over ICI. SPMD propagation (the reference's 85
+spmd_rules files) comes free from GSPMD: ops on sharded arrays produce
+correctly-sharded outputs with compiler-inserted collectives.
+
+On Partial: jax.Array presents *global-value semantics* — a pending partial
+sum is compiler-internal (GSPMD partial tiles), never user-visible state.
+We accept Partial placements for API parity, record them as annotations, and
+store the materialized (already-reduced) value; resharding Partial→Replicate
+is therefore a data no-op. This is a deliberate semantic upgrade, not a gap:
+the reference needs explicit p_to_r reshard functions because each rank holds
+local partial state; a single-controller sharded array never does.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "shard_tensor", "reshard", "dtensor_from_fn", "unshard_dtensor",
+    "shard_layer", "shard_optimizer", "shard_scaler", "shard_dataloader",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+]
+
+
+def _storage_placements(placements: Sequence[Placement]) -> List[Placement]:
+    """Partial stores replicated (see module docstring)."""
+    return [Replicate() if isinstance(p, Partial) else p for p in placements]
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Distribute ``data`` over ``mesh`` per ``placements``.
+
+    Reference: auto_parallel/api.py:131 shard_tensor (creates DistTensor with
+    TensorDistAttr). Here: device_put with NamedSharding; annotation kept on
+    the handle for introspection parity (Tensor.placements/.process_mesh).
+    """
+    from ..core.tensor import to_tensor
+    t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
+    sharding = mesh.named_sharding(_storage_placements(placements))
+    arr = jax.device_put(t._data, sharding)
+    if isinstance(t, Parameter):
+        out = Parameter(arr, name=t.name, trainable=not t.stop_gradient)
+    else:
+        out = Tensor(arr, stop_gradient=t.stop_gradient
+                     if stop_gradient is None else stop_gradient, name=t.name)
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    out._placements = list(placements)
+    out._process_mesh = mesh
+    return out
+
+
+def reshard(tensor: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Convert a tensor's distribution (reference: api.py:579 reshard; the
+    C++ reshard function matrix r↔s/p↔r/s↔s is replaced by one device_put —
+    XLA lowers s→s to all-to-all, s→r to all-gather, etc.)."""
+    return shard_tensor(tensor, mesh, placements)
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs) -> Tensor:
+    """Reference: api.py dtensor_from_fn — build then distribute.
+
+    TPU note: for large params, prefer constructing under jit with output
+    shardings so each shard materializes directly on its device; here we
+    build globally then device_put (fine at test scale, and jit paths in
+    models use sharded init)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(tensor: Tensor) -> Tensor:
+    """Gather to a fully-replicated plain tensor (api.py unshard_dtensor)."""
+    if tensor._process_mesh is None:
+        return tensor
+    mesh = tensor._process_mesh
+    rep = [Replicate() for _ in range(mesh.ndim)]
+    arr = jax.device_put(tensor._data, mesh.named_sharding(rep))
+    out = Tensor(arr, stop_gradient=tensor.stop_gradient, name=tensor.name)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard every parameter of ``layer`` in place.
+
+    Reference: api.py:678 shard_layer. ``shard_fn(name, layer, mesh)``
+    mutates one sublayer's params; default replicates everything (matching
+    the reference's default)."""
+    def _default(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None:
+                continue
+            rep = [Replicate() for _ in range(mesh.ndim)]
+            sublayer._parameters[pname] = _as_param(
+                shard_tensor(p, mesh, rep))
+
+    fn = shard_fn or _default
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def _as_param(t: Tensor) -> Parameter:
+    if isinstance(t, Parameter):
+        return t
+    p = Parameter(t._data, name=t.name, trainable=not t.stop_gradient)
+    p._placements = t._placements
+    p._process_mesh = t._process_mesh
+    return p
+
+
+# -- sharding stages (ZeRO) -------------------------------------------------
+
+class _ShardingStage:
+    """Callable shard_fn passed to shard_optimizer.
+
+    Reference: auto_parallel/api.py:1122-1352 (ShardingStage1/2/3 classes).
+    TPU-native meaning on one Mesh:
+      stage 1: optimizer states sharded over the sharding axis;
+      stage 2: + gradients stored reduce-scattered over that axis;
+      stage 3: + parameters sharded over that axis (gathered on use — in
+               compiled steps XLA's GSPMD does gather-on-use from the
+               sharding constraint; no hook machinery needed).
+    """
+    stage = 0
+
+    def __init__(self, mesh_dim: str = "dp", mesh: Optional[ProcessMesh] = None):
+        self.mesh_dim = mesh_dim
+        self.mesh = mesh
+
+    def _mesh(self) -> ProcessMesh:
+        from .process_mesh import get_mesh
+        mesh = self.mesh or get_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "ShardingStage needs a mesh: pass one or dist.set_mesh(...)")
+        return mesh
+
+    def _shard_1d(self, t: Tensor) -> Tensor:
+        """Shard dim 0 over the sharding axis when divisible, else replicate
+        (reference behavior: non-divisible params stay unsharded)."""
+        mesh = self._mesh()
+        axis = mesh.dim_names.index(self.mesh_dim)
+        n = mesh.shape[axis]
+        placements: List[Placement] = [Replicate()] * mesh.ndim
+        if t.ndim >= 1 and t.shape[0] % n == 0:
+            placements[axis] = Shard(0)
+        return shard_tensor(t, mesh, placements)
+
+    def shard_accumulator(self, t: Tensor) -> Tensor:
+        return self._shard_1d(t)
+
+    def shard_gradient(self, t: Tensor) -> Tensor:
+        if self.stage >= 2:
+            return self._shard_1d(t)
+        return t
+
+    def shard_param(self, t: Tensor) -> Tensor:
+        if self.stage >= 3:
+            return self._shard_1d(t)
+        return t
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+class _ShardOptimizer:
+    """Optimizer wrapper applying a sharding stage.
+
+    Reference: api.py shard_optimizer/_ShardOptimizer. Accumulators are
+    sharded at creation (stage1+); gradients reshard before step (stage2+);
+    params live sharded (stage3). The wrapped optimizer's math is unchanged —
+    XLA executes each update on the shards that own them.
+    """
+
+    def __init__(self, optimizer, shard_fn: Optional[_ShardingStage] = None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def _shard_array(self, arr):
+        """Shard a raw jax array's dim 0 over the sharding axis (device_put
+        is a no-op when already placed)."""
+        fn = self._shard_fn
+        mesh = fn._mesh()
+        axis = mesh.dim_names.index(fn.mesh_dim)
+        n = mesh.shape[axis]
+        if getattr(arr, "ndim", 0) < 1 or arr.shape[0] % n != 0:
+            return arr
+        placements: List[Placement] = [Replicate()] * mesh.ndim
+        placements[axis] = Shard(0)
+        return jax.device_put(
+            arr, mesh.named_sharding(placements))
+
+    def _apply_stage(self):
+        fn = self._shard_fn
+        if fn is None:
+            return
+        params = self._inner._parameter_list or []
+        if fn.stage >= 2:
+            for p in params:
+                if getattr(p, "grad", None) is not None:
+                    p.grad = fn.shard_gradient(p.grad)
+        if fn.stage >= 3:
+            for p in params:
+                sharded = fn.shard_param(p)
+                p._data = sharded._data
+                p._placements = sharded._placements
+                p._process_mesh = sharded._process_mesh
+        # Shard accumulator arrays (created lazily on first step). The inner
+        # dicts map state name -> raw jax array (optimizer.py _init_state).
+        for acc_map in getattr(self._inner, "_accumulators", {}).values():
+            for key, acc in list(acc_map.items()):
+                if isinstance(acc, jax.Array):
+                    acc_map[key] = self._shard_array(acc)
+
+    def step(self):
+        if self._shard_fn is not None and self._shard_fn.stage >= 2:
+            self._apply_stage()
+        self._inner.step()
+        self._apply_stage()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner.clear_grad(set_to_zero)
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[_ShardingStage] = None):
+    """Reference: api.py:1353 shard_optimizer."""
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+def shard_scaler(scaler):
+    """Reference: api.py shard_scaler — grad-scaler found/inf state is a
+    global-semantics scalar here, nothing to do."""
+    return scaler
+
+
+class _ShardDataloader:
+    """Wraps a DataLoader so each batch lands sharded over the dp axis.
+
+    Reference: api.py:2846 shard_dataloader (DistributedDataLoader). Here:
+    device_put the host batch with Shard(0) on ``shard_dims`` — in
+    multi-process mode each host feeds its slice (jax makes the global array
+    from per-host shards)."""
+
+    def __init__(self, dataloader, meshes, shard_dims=None, input_keys=None):
+        self._loader = dataloader
+        self.mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+        self.shard_dims = shard_dims if shard_dims is not None \
+            else self.mesh.dim_names[0]
+        self.input_keys = input_keys
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _shard_batch(self, item):
+        mesh = self.mesh
+        axis = mesh.dim_names.index(self.shard_dims) \
+            if isinstance(self.shard_dims, str) else self.shard_dims
+        placements: List[Placement] = [Replicate()] * mesh.ndim
+        placements[axis] = Shard(0)
+
+        def one(x):
+            if isinstance(x, Tensor):
+                return shard_tensor(x, mesh, placements)
+            return x
+        if isinstance(item, (list, tuple)):
+            return type(item)(one(x) for x in item)
+        if isinstance(item, dict):
+            return {k: one(v) for k, v in item.items()}
+        return one(item)
+
+    def __iter__(self):
+        for item in self._loader:
+            yield self._shard_batch(item)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    return _ShardDataloader(dataloader, meshes, shard_dims, input_keys)

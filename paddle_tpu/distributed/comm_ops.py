@@ -1,0 +1,127 @@
+"""Compiled collective ops — the TPU-native communication backend.
+
+Reference equivalents: the PHI collective kernels + NCCL comm contexts
+(paddle/phi/kernels/gpu/all_reduce_kernel.cu area,
+phi/core/distributed/nccl_comm_context.cc) and the legacy c_* operators
+(paddle/fluid/operators/collective/).
+
+TPU-native design: these are thin, named wrappers over jax.lax collectives,
+used *inside* jit/shard_map programs. XLA lowers them onto ICI (intra-slice)
+or DCN (inter-slice) — stream management, ring construction, and overlap all
+come from the compiler, replacing NCCL's runtime machinery. Use them:
+
+    @partial(shard_map, mesh=mesh, in_specs=..., out_specs=...)
+    def step(...):
+        g = comm_ops.all_reduce(g, axis="dp")
+
+They also carry Tensor handles transparently (unwrap/wrap) so eager model
+code under shard_map keeps the paddle-shaped surface.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "p2p_permute", "broadcast", "axis_index", "axis_size", "psum", "pmean",
+    "pmax", "pmin",
+]
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _rewrap(x, raw):
+    return Tensor(raw, stop_gradient=x.stop_gradient) \
+        if isinstance(x, Tensor) else raw
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    return lax.axis_size(axis)
+
+
+def all_reduce(x, axis: AxisName, op: str = "sum"):
+    """c_allreduce_{sum,max,min,prod,avg} equivalent → lax.psum/pmax/pmin."""
+    raw = _unwrap(x)
+    if op == "sum":
+        out = lax.psum(raw, axis)
+    elif op == "max":
+        out = lax.pmax(raw, axis)
+    elif op == "min":
+        out = lax.pmin(raw, axis)
+    elif op in ("avg", "mean"):
+        out = lax.pmean(raw, axis)
+    elif op == "prod":
+        out = jnp.exp(lax.psum(jnp.log(raw), axis))
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    return _rewrap(x, out)
+
+
+def psum(x, axis: AxisName):
+    return _rewrap(x, lax.psum(_unwrap(x), axis))
+
+
+def pmean(x, axis: AxisName):
+    return _rewrap(x, lax.pmean(_unwrap(x), axis))
+
+
+def pmax(x, axis: AxisName):
+    return _rewrap(x, lax.pmax(_unwrap(x), axis))
+
+
+def pmin(x, axis: AxisName):
+    return _rewrap(x, lax.pmin(_unwrap(x), axis))
+
+
+def all_gather(x, axis: AxisName, *, gather_dim: int = 0, tiled: bool = True):
+    """c_allgather equivalent. ``tiled=True`` concatenates along
+    ``gather_dim`` (the common Megatron-SP use); False stacks a new dim."""
+    out = lax.all_gather(_unwrap(x), axis, axis=gather_dim, tiled=tiled)
+    return _rewrap(x, out)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_dim: int = 0):
+    """c_reducescatter equivalent → lax.psum_scatter (ICI-ring lowered)."""
+    out = lax.psum_scatter(_unwrap(x), axis, scatter_dimension=scatter_dim,
+                           tiled=True)
+    return _rewrap(x, out)
+
+
+def all_to_all(x, axis: AxisName, *, split_dim: int, concat_dim: int):
+    """alltoall equivalent (MoE dispatch / s→s reshard) → lax.all_to_all."""
+    out = lax.all_to_all(_unwrap(x), axis, split_axis=split_dim,
+                         concat_axis=concat_dim, tiled=True)
+    return _rewrap(x, out)
+
+
+def p2p_permute(x, axis: AxisName, perm: Sequence[tuple]):
+    """Point-to-point over a ring — the PP send/recv primitive.
+
+    Reference: ProcessGroupNCCL::Send/Recv (process_group_nccl.cc:598,637) +
+    pp_utils/p2p_communication.py. TPU-native: lax.ppermute compiles to ICI
+    collective-permute; ``perm`` is [(src, dst), ...] in axis coordinates.
+    """
+    out = lax.ppermute(_unwrap(x), axis, perm=perm)
+    return _rewrap(x, out)
+
+
+def broadcast(x, axis: AxisName, src: int = 0):
+    """c_broadcast equivalent: keep src's value on all ranks of the axis."""
+    raw = _unwrap(x)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src, raw, jnp.zeros_like(raw))
+    return _rewrap(x, lax.psum(masked, axis))

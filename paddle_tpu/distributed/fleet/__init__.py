@@ -1,0 +1,15 @@
+"""paddle.distributed.fleet parity surface (hybrid-parallel programming).
+
+Reference: python/paddle/distributed/fleet/__init__.py.
+"""
+from . import fleet as _fleet_mod
+from .fleet import (DistributedStrategy, Fleet, distributed_model,  # noqa
+                    distributed_optimizer, get_hybrid_communicate_group,
+                    init, is_first_worker, worker_index, worker_num)
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa
+                        RowParallelLinear, VocabParallelEmbedding)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa
+
+# meta_parallel namespace parity (reference: fleet/meta_parallel/__init__.py
+# exports the mpu layers too).
+from . import mp_layers as meta_parallel  # noqa

@@ -1,0 +1,192 @@
+"""Hybrid-parallel topology: the nd process grid.
+
+Reference: python/paddle/distributed/fleet/base/topology.py
+(CommunicateTopology:65, HybridCommunicateGroup:178) — axis order
+``[data, pipe, sharding, sep, model]``, one NCCL group per axis plus fused
+groups.
+
+TPU-native redesign: the five axes become named dims of ONE global
+ProcessMesh (SURVEY.md §7: "fleet 5-axis topology → one Mesh with named
+axes"). "Creating a comm group" costs nothing — an axis name is the group;
+XLA compiles collectives over any axis subset. HybridCommunicateGroup keeps
+the reference's query API (ranks/world-sizes per axis) so fleet code ports
+over, and hands out the mesh for compiled paths.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import collective, env
+from ..process_mesh import ProcessMesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+# Reference order (topology.py:65): data, pipe, sharding, sep, model.
+_DEFAULT_ORDER = ["data", "pipe", "sharding", "sep", "model"]
+# Mesh axis names used across the TPU build (models annotate against these).
+AXIS_NAME = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+             "sep": "sep", "model": "mp"}
+
+
+class CommunicateTopology:
+    """An nd grid over ranks with named axes + coordinate queries."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = _DEFAULT_ORDER,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(self._world.size)
+
+    def get_rank(self, **axis_coords) -> int:
+        coord = tuple(axis_coords[name] for name in self._parallel_names)
+        return int(self._world[coord])
+
+    def get_coord(self, rank: int):
+        idx = np.argwhere(self._world == rank)[0]
+        return tuple(int(i) for i in idx)
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        axis = self._parallel_names.index(axis_name)
+        return [int(r) for r in np.take(self._world, index, axis=axis).flatten()]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Groups of ranks that vary only along ``axis_name`` (the reference's
+        per-axis comm groups)."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1)
+        return [list(map(int, row)) for row in moved.reshape(-1, self._dims[axis])]
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = dict(zip(self._parallel_names, self.get_coord(global_rank)))
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:178. Query surface for each parallel axis plus
+    the global ProcessMesh for compiled (GSPMD) paths."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = env.get_rank()
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") \
+            if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+
+        # One global mesh with the non-trivial axes, reference order.
+        names, dims = [], []
+        for ref_name in self._topo.get_hybrid_group_names():
+            d = self._topo.get_dim(ref_name)
+            names.append(AXIS_NAME[ref_name])
+            dims.append(d)
+        self.mesh = ProcessMesh(
+            np.arange(int(np.prod(dims))).reshape(dims), names)
+
+        # Per-axis groups (host-side handles; compiled comm uses axis names).
+        self._groups: Dict[str, collective.Group] = {}
+        for ref_name in self._topo.get_hybrid_group_names():
+            ranks = self._ranks_of_my_group(ref_name)
+            self._groups[ref_name] = collective.new_group(
+                ranks, mesh_axis=AXIS_NAME[ref_name])
+
+    def _ranks_of_my_group(self, axis_name: str) -> List[int]:
+        for grp in self._topo.get_comm_list(axis_name):
+            if self.global_rank in grp:
+                return grp
+        return [self.global_rank]
+
+    def get_parallel_mode(self) -> str:
+        """Reference: topology.py get_parallel_mode (ParallelMode)."""
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._sep_degree > 1:
+            return "segment_parallel"
+        if self._mp_degree > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    # -- per-axis queries (reference API names) -----------------------------
+    def _axis_rank(self, name: str) -> int:
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[self._topo.get_hybrid_group_names().index(name)]
+
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_rank("data")
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_rank("model")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_stage_id(self) -> int:
+        return self._axis_rank("pipe")
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self._axis_rank("pipe")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._axis_rank("sep") if self._sep_degree >= 1 else 0
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._groups.get("sep")
+
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self._pp_degree - 1

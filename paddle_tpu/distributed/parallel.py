@@ -1,0 +1,61 @@
+"""DataParallel wrapper.
+
+Reference: python/paddle/distributed/parallel.py:202 (DataParallel) + the
+C++ EagerReducer (collective/reducer.cc) doing bucketed grad allreduce with
+backward overlap.
+
+TPU-native redesign: with a dp-sharded batch (shard_dataloader) the
+partitioned backward ALREADY produces globally-reduced gradients — GSPMD
+inserts the reduce where the batch dim contracts away, overlapping it with
+the backward compute the way the reducer's fused buckets do. DataParallel is
+therefore an annotation wrapper: it replicates parameters over the mesh and
+keeps the reference surface (``no_sync``, ``scale_loss``) meaningful.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.base import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1,
+                 find_unused_parameters: bool = False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        from .process_mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is not None:
+            from .api import shard_layer
+            shard_layer(layers, mesh)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Reference: parallel.py no_sync — skip grad allreduce inside.
+        Gradient reduction here is part of the compiled backward over the
+        sharded batch, and grad-accumulation steps simply don't resync
+        because accumulation happens on the already-reduced global value;
+        the context is kept for API parity."""
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__.get("_sub_layers")["_layers"], name)

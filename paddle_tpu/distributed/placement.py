@@ -1,0 +1,98 @@
+"""Placement vocabulary for distributed tensors.
+
+Reference: paddle/phi/core/distributed/auto_parallel/placement_types.h:36-132
+(Placement / Shard / Replicate / Partial) and python/paddle/distributed
+(Shard, Replicate, Partial, ReduceType exports).
+
+TPU-native design: a placement list over mesh dims compiles down to a
+``jax.sharding.NamedSharding`` (PartitionSpec). ``Partial`` has no direct
+GSPMD storage type — we keep it as an annotation on the Tensor handle and
+materialize the pending reduction (psum over the mesh axis) when resharding
+to Replicate/Shard, exactly mirroring the reference's p_to_r / p_to_s
+reshard functions (phi/core/distributed/auto_parallel/reshard/).
+"""
+from __future__ import annotations
+
+import enum
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "ReduceType"]
+
+
+class ReduceType(enum.Enum):
+    """Reference: placement_types.h ReduceType enum (kRedSum..kRedAll)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class Placement:
+    """Base placement (placement_types.h:36)."""
+
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self).__name__)
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    """Shard(dim): tensor dim ``dim`` is split across the mesh dim this
+    placement is attached to."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    """Pending-reduction placement (each shard holds a partial value)."""
+
+    def __init__(self, reduce_type: ReduceType = ReduceType.kRedSum):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type.name})"

@@ -1,0 +1,182 @@
+"""ProcessMesh: the device-mesh abstraction.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py
+(ProcessMesh) + paddle/phi/core/distributed/auto_parallel (mesh in
+TensorDistAttr). TPU-native design: a ProcessMesh *is* a
+``jax.sharding.Mesh`` over real (or virtual host-platform) devices; axis
+names carry the parallelism semantics (dp/mp/pp/sep/...). Placement lists
+compile to ``NamedSharding(mesh, PartitionSpec(...))`` — GSPMD then inserts
+the ICI collectives (SURVEY.md §7: "DistTensor+SPMD rules+reshard → jax.Array
++ NamedSharding").
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .placement import Partial, Placement, Replicate, Shard
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "auto_mesh"]
+
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """An n-dimensional grid of processes/devices with named dims.
+
+    ``mesh`` is an array of global device ids (ranks); ``dim_names`` names
+    each grid axis. Unlike the reference (where ranks map to NCCL group
+    members), here ranks index ``jax.devices()`` and the mesh lowers to an
+    XLA device assignment.
+    """
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if mesh is None and shape is not None:
+            mesh = np.array(process_ids if process_ids is not None
+                            else range(int(np.prod(shape)))).reshape(shape)
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._mesh = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- paddle-parity accessors --------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._mesh.flatten()]
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Reference: process_mesh.py get_mesh_with_dim — reorder so
+        ``dim_name`` is the leading axis; with ``index``, slice it out."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        new_mesh = self._mesh.transpose(order)
+        names = [self._dim_names[i] for i in order]
+        if index is None:
+            return ProcessMesh(new_mesh, names)
+        return ProcessMesh(new_mesh[index], names[1:])
+
+    # -- jax lowering --------------------------------------------------------
+    def jax_mesh(self) -> Mesh:
+        """Materialize as jax.sharding.Mesh (cached)."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if self.size > len(devices):
+                raise RuntimeError(
+                    f"ProcessMesh needs {self.size} devices, only "
+                    f"{len(devices)} visible. For tests use "
+                    f"--xla_force_host_platform_device_count.")
+            dev_grid = np.asarray(
+                [devices[i] for i in self._mesh.flatten()]
+            ).reshape(self._mesh.shape)
+            self._jax_mesh = Mesh(dev_grid, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+    def named_sharding(self, placements: Sequence[Placement],
+                       ndim: Optional[int] = None) -> NamedSharding:
+        """Compile a placement list (one entry per mesh dim) to NamedSharding.
+
+        Partial placements shard nothing (the pending-reduce annotation lives
+        on the Tensor handle; see placement.py docstring).
+        """
+        spec = placements_to_spec(placements, self._dim_names)
+        return NamedSharding(self.jax_mesh(), spec)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def placements_to_spec(placements: Sequence[Placement],
+                       dim_names: Sequence[str]) -> PartitionSpec:
+    """[Shard(0), Replicate()] over mesh dims (a, b) -> PartitionSpec(('a',)).
+
+    Multiple mesh dims sharding the same tensor dim stack into a tuple in
+    mesh-dim order (matches GSPMD's multi-axis sharding and the reference's
+    nd-mesh shardings).
+    """
+    by_tensor_dim = {}
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            by_tensor_dim.setdefault(p.dim, []).append(dim_names[mesh_dim])
+    if not by_tensor_dim:
+        return PartitionSpec()
+    max_dim = max(by_tensor_dim)
+    entries = []
+    for d in range(max_dim + 1):
+        axes = by_tensor_dim.get(d)
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec: PartitionSpec, mesh: ProcessMesh):
+    """Inverse of placements_to_spec (best-effort; Partial not represented)."""
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[mesh.dim_names.index(ax)] = Shard(tdim)
+    return placements
+
+
+def set_mesh(mesh: ProcessMesh):
+    """Reference: paddle.distributed.auto_parallel.set_mesh."""
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def auto_mesh(*dim_sizes, dim_names: Optional[Sequence[str]] = None) -> ProcessMesh:
+    """Convenience: build a mesh over the first prod(dim_sizes) devices."""
+    shape = tuple(int(s) for s in dim_sizes)
+    return ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), dim_names)

@@ -257,11 +257,24 @@ def not_to_static(fn):
 # ---------------------------------------------------------------------------
 
 def _resolve_specs(layer, input_spec):
+    """InputSpec dims of None export as *symbolic* dims (jax.export shape
+    polymorphism) so the artifact serves any size on those axes — the
+    dynamic-dim behavior of the reference's exported programs."""
     specs = []
+    scope = jax.export.SymbolicScope()
+    n_sym = [0]
+
+    def _dim(d):
+        if d is None or (isinstance(d, int) and d < 0):
+            n_sym[0] += 1
+            return jax.export.symbolic_shape(
+                f"dyn{n_sym[0]}", scope=scope)[0]
+        return int(d)
+
     for s in input_spec:
         if isinstance(s, InputSpec):
-            shape = [1 if d is None else int(d) for d in s.shape]
-            specs.append(jax.ShapeDtypeStruct(tuple(shape), s.dtype))
+            shape = tuple(_dim(d) for d in s.shape)
+            specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
         elif isinstance(s, Tensor):
             specs.append(jax.ShapeDtypeStruct(tuple(s._data.shape),
                                               s._data.dtype))
@@ -278,17 +291,20 @@ def save(layer, path, input_spec=None, **configs):
     class."""
     if isinstance(layer, StaticFunction):
         fn, owner = layer._fn, layer._layer
+        input_spec = input_spec or layer._input_spec
     elif isinstance(layer, Layer):
         fwd = layer.forward
         if isinstance(fwd, StaticFunction):
             fn, owner = fwd._fn, layer
+            input_spec = input_spec or fwd._input_spec
         else:
             fn, owner = fwd, layer
     else:
         fn, owner = layer, None
 
     if input_spec is None:
-        raise ValueError("jit.save requires input_spec (shapes to export)")
+        raise ValueError(
+            "jit.save requires input_spec (pass it here or to to_static)")
     specs = _resolve_specs(owner, input_spec)
 
     named_params = [] if owner is None else \

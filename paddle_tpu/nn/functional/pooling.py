@@ -62,6 +62,7 @@ def _pool(x, nsp, kernel, stride, padding, data_format, kind,
         pad_seq = lax.padtype_to_pads(x.shape, dims, strides, pad)
     else:
         pad_seq = list(pad)
+    pad_orig = [tuple(p) for p in pad_seq]
     if ceil_mode:
         # Extend the high-side padding so partially-covered windows are
         # emitted: out = ceil((in + pl + pr - k)/s) + 1 (paddle semantics).
@@ -80,11 +81,22 @@ def _pool(x, nsp, kernel, stride, padding, data_format, kind,
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, dims, strides, pad_seq)
-    # avg
+    # avg. Divisor semantics (reference pooling kernels): exclusive=True
+    # counts only real cells; exclusive=False also counts the user's padding
+    # cells but never the ceil_mode extension (pool_size is clipped to
+    # input+padding before the window is clamped).
     summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad_seq)
-    if (exclusive or ceil_mode) and any(p != (0, 0) for p in pad_seq):
+    if any(p != (0, 0) for p in pad_seq):
         ones = jnp.ones(x.shape, x.dtype)
-        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad_seq)
+        if exclusive:
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                       pad_seq)
+        else:
+            ones = jnp.pad(ones, pad_orig, constant_values=1)
+            pad_ext = [(0, full[1] - orig[1])
+                       for full, orig in zip(pad_seq, pad_orig)]
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                       pad_ext)
         return summed / counts
     return summed / float(np.prod(k))
 

@@ -22,6 +22,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from ..amp.auto_cast import _amp as _amp_state
+from ..amp.auto_cast import current_cast_dtype_for as _current_cast_dtype_for
 from ..core import state
 from ..core.flags import flag_value
 from ..core.tensor import Tensor
@@ -75,9 +77,8 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
         # AMP auto-cast seam (reference: the AMP_LOGIC_TEMPLATE block in every
         # generated ad-func, eager_gen.py:565): white-list ops cast float
         # inputs to the amp dtype, black-list ops to float32.
-        from ..amp.auto_cast import current_cast_dtype_for
-        amp_dt = current_cast_dtype_for(opname)
-        if amp_dt is not None:
+        amp_dt = _amp_state.enabled and _current_cast_dtype_for(opname)
+        if amp_dt:
             raw = [a.astype(amp_dt)
                    if (hasattr(a, "dtype") and hasattr(a, "astype")
                        and jnp.issubdtype(a.dtype, jnp.floating)

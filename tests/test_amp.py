@@ -126,6 +126,32 @@ class TestGradScaler:
             o.clear_grad()
         assert s.get_loss_scaling() == 4.0
 
+    def test_per_optimizer_found_inf(self):
+        # One optimizer's inf must not be cleared by another's clean grads.
+        p1 = paddle.Parameter(t([1.0])._data)
+        p2 = paddle.Parameter(t([1.0])._data)
+        o1 = opt.SGD(learning_rate=1.0, parameters=[p1])
+        o2 = opt.SGD(learning_rate=1.0, parameters=[p2])
+        s = amp.GradScaler(init_loss_scaling=2.0)
+        p1.grad = t([float("inf")])
+        p2.grad = t([2.0])
+        s.unscale_(o1)
+        s.unscale_(o2)
+        s.step(o1)
+        s.step(o2)
+        np.testing.assert_allclose(p1.numpy(), [1.0])  # skipped
+        np.testing.assert_allclose(p2.numpy(), [0.0])  # 1 - 1*1.0
+
+    def test_double_step_raises(self):
+        import pytest
+        p = paddle.Parameter(t([1.0])._data)
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        s = amp.GradScaler(init_loss_scaling=2.0)
+        p.grad = t([2.0])
+        s.step(o)
+        with pytest.raises(RuntimeError):
+            s.step(o)
+
     def test_full_fp16_loop(self):
         paddle.seed(0)
         net = nn.Linear(8, 4)

@@ -135,6 +135,33 @@ class TestSaveLoad:
         got = loaded(x).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-5)
 
+    def test_dynamic_batch_export(self):
+        """InputSpec dims of None export symbolically: one artifact serves
+        any batch size (shape polymorphism via jax.export)."""
+        net = SmallNet()
+        net.eval()
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "dyn")
+        jit.save(net, path, input_spec=[jit.InputSpec([None, 4], "float32")])
+        loaded = jit.load(path)
+        for bs in (1, 3, 7):
+            x = t(np.random.randn(bs, 4))
+            got = loaded(x).numpy()
+            np.testing.assert_allclose(got, net(x).numpy(), rtol=1e-5)
+
+    def test_save_uses_to_static_spec(self):
+        """jit.save without input_spec falls back to the spec passed at
+        to_static decoration time."""
+        net = SmallNet()
+        net.eval()
+        net_s = jit.to_static(net,
+                              input_spec=[jit.InputSpec([2, 4], "float32")])
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "spec_fallback")
+        jit.save(net_s, path)
+        loaded = jit.load(path)
+        assert loaded(t(np.random.randn(2, 4))).shape == [2, 2]
+
     def test_loaded_artifact_is_hermetic(self):
         """Load must not need the original class (serving parity)."""
         net = SmallNet()

@@ -147,6 +147,23 @@ class TestConvPool:
         y = F.avg_pool2d(x, kernel_size=2)
         np.testing.assert_allclose(y.numpy(), np.ones((1, 1, 2, 2)))
 
+    def test_avg_pool_inclusive_ceil(self):
+        # exclusive=False counts padding cells in the divisor, but never the
+        # ceil_mode extension (reference pooling kernel semantics).
+        x = t(np.ones((1, 1, 4, 4)))
+        y = F.avg_pool2d(x, kernel_size=2, stride=2, padding=1,
+                         exclusive=False, ceil_mode=True)
+        # corner window: 1 real + 3 pad cells -> 1/4
+        assert y.shape == [1, 1, 3, 3]
+        np.testing.assert_allclose(y.numpy()[0, 0, 0, 0], 0.25)
+        np.testing.assert_allclose(y.numpy()[0, 0, 1, 1], 1.0)
+
+    def test_avg_pool_exclusive_pad(self):
+        x = t(np.ones((1, 1, 4, 4)))
+        y = F.avg_pool2d(x, kernel_size=2, stride=2, padding=1,
+                         exclusive=True, ceil_mode=True)
+        np.testing.assert_allclose(y.numpy()[0, 0], np.ones((3, 3)))
+
     def test_adaptive_avg_pool(self):
         x = t(np.random.randn(2, 3, 8, 8))
         y = F.adaptive_avg_pool2d(x, output_size=1)
