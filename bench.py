@@ -1,0 +1,72 @@
+"""Benchmark: Llama decoder training throughput on the available device.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Metric: training tokens/sec on a Llama block stack sized to fit the chip,
+plus model FLOPs utilisation (MFU) computed from the 6*N*tokens estimate.
+vs_baseline is MFU / 0.40 (BASELINE.json north star: >=40% MFU).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    if "--smoke" in sys.argv:
+        # CPU smoke: don't claim the shared TPU chip.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama as L
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
+    # Single-chip benchmark config: a 4-layer 8B-shaped slice on TPU
+    # (fits one chip's HBM with remat), tiny on CPU fallback.
+    if on_tpu:
+        cfg = L.llama_3_8b(num_hidden_layers=4)
+        batch, seq, iters = 4, 2048, 10
+    else:
+        cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+        batch, seq, iters = 4, 128, 5
+
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    opt_state = L.adamw_init(params)
+    step = L.make_train_step(cfg, lr=1e-4)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+
+    # warmup/compile
+    params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tps = tokens / dt
+    # 6ND (fwd+bwd) + remat fwd (~2ND more) -> use 6ND for standard MFU
+    n_params = L.count_params(cfg)
+    flops_per_token = 6 * n_params
+    peak = 459e12 if on_tpu else 1e12   # v5p bf16 peak; CPU nominal
+    mfu = tps * flops_per_token / peak
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "params": n_params,
+                  "platform": dev.platform, "batch": batch, "seq": seq,
+                  "layers": cfg.num_hidden_layers,
+                  "loss": float(loss)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

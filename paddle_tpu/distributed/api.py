@@ -229,10 +229,9 @@ class _ShardOptimizer:
         return jax.device_put(
             arr, mesh.named_sharding(placements))
 
-    def _apply_stage(self):
+    def _place_grads_and_params(self):
+        """Pre-step placement: stage>=2 shards grads, stage 3 params."""
         fn = self._shard_fn
-        if fn is None:
-            return
         params = self._inner._parameter_list or []
         if fn.stage >= 2:
             for p in params:
@@ -244,18 +243,28 @@ class _ShardOptimizer:
                 p._data = sharded._data
                 p._placements = sharded._placements
                 p._process_mesh = sharded._process_mesh
-        # Shard accumulator arrays (created lazily on first step). The inner
-        # dicts map state name -> raw jax array (optimizer.py _init_state).
+
+    def _place_accumulators(self):
+        """Post-step placement: accumulators are created lazily during
+        step(), so their sharding can only be applied after it. The inner
+        dicts map state name -> raw jax array (optimizer.py _init_state)."""
         for acc_map in getattr(self._inner, "_accumulators", {}).values():
             for key, acc in list(acc_map.items()):
                 if isinstance(acc, jax.Array):
                     acc_map[key] = self._shard_array(acc)
 
+    def _apply_stage(self):
+        if self._shard_fn is None:
+            return
+        self._place_grads_and_params()
+        self._place_accumulators()
+
     def step(self):
         if self._shard_fn is not None and self._shard_fn.stage >= 2:
-            self._apply_stage()
+            self._place_grads_and_params()
         self._inner.step()
-        self._apply_stage()
+        if self._shard_fn is not None:
+            self._place_accumulators()
 
     def clear_grad(self, set_to_zero: bool = False):
         self._inner.clear_grad(set_to_zero)

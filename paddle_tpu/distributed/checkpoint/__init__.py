@@ -78,10 +78,30 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     # np.savez appends .npz — normalize name.
     if os.path.exists(shard_file + ".npz"):
         os.replace(shard_file + ".npz", shard_file)
+    if jax.process_count() > 1:
+        # Multi-host: every host contributes its shard windows; the
+        # coordinator merges before writing (the reference's global Metadata
+        # of tensor->shard mapping, metadata.py). Exchange rides the
+        # coordination-service KV store (collective.all_gather_object).
+        from .. import collective as _coll
+        all_metas: list = []
+        _coll.all_gather_object(all_metas, meta)
+        if jax.process_index() == coordinator_rank:
+            merged = {"tensors": {}, "format": meta["format"]}
+            for m in all_metas:
+                for key, entry in m["tensors"].items():
+                    if entry.get("kind") == "object":
+                        merged["tensors"].setdefault(key, entry)
+                        continue
+                    tgt = merged["tensors"].setdefault(
+                        key, {**entry, "shards": []})
+                    windows = {tuple(map(tuple, s["window"]))
+                               for s in tgt["shards"]}
+                    for s in entry["shards"]:
+                        if tuple(map(tuple, s["window"])) not in windows:
+                            tgt["shards"].append(s)
+            meta = merged
     if jax.process_index() == coordinator_rank:
-        # Multi-host note: each host's metadata covers its own shards; the
-        # coordinator merges via the coordination service in multi-host runs
-        # (single-host covers all shards already).
         with open(os.path.join(path, _META_NAME), "w") as f:
             json.dump(meta, f)
 

@@ -196,19 +196,27 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor, group=None,
     return _Task() if not sync_op else None
 
 
+# Per-process call counter for coordination-service keys. Collective calls
+# execute in the same order on every process (SPMD single-controller-per-host
+# discipline), so the counter value is identical across peers at each call —
+# unlike id(object_list), which is process-local.
+_AG_SEQ = [0]
+
+
 def all_gather_object(object_list: List, obj, group=None):
     """Host object exchange. Multi-host: via the coordination-service KV
     store (jax.distributed client), mirroring TCPStore exchange."""
     n = _group_size(group)
     client = _coord_client()
     if client is not None and n > 1:
+        seq = _AG_SEQ[0]
+        _AG_SEQ[0] += 1
         me = env.get_rank()
         blob = pickle.dumps(obj).hex()
-        client.key_value_set(f"ag_{id(object_list)}_{me}", blob)
+        client.key_value_set(f"ag_{seq}_{me}", blob)
         object_list.clear()
         for r in range(n):
-            data = client.blocking_key_value_get(
-                f"ag_{id(object_list)}_{r}", 60_000)
+            data = client.blocking_key_value_get(f"ag_{seq}_{r}", 60_000)
             object_list.append(pickle.loads(bytes.fromhex(data)))
     else:
         object_list.clear()

@@ -65,7 +65,15 @@ def all_reduce(x, axis: AxisName, op: str = "sum"):
     elif op in ("avg", "mean"):
         out = lax.pmean(raw, axis)
     elif op == "prod":
-        out = jnp.exp(lax.psum(jnp.log(raw), axis))
+        # Sign-safe product: |x| via exp(psum(log)), sign via parity of
+        # negative counts, zeros via a mask (log(0) would poison psum).
+        zero = raw == 0
+        absx = jnp.where(zero, 1.0, jnp.abs(raw))
+        mag = jnp.exp(lax.psum(jnp.log(absx), axis))
+        neg = lax.psum((raw < 0).astype(raw.dtype), axis)
+        sign = 1.0 - 2.0 * (neg % 2)
+        any_zero = lax.pmax(zero.astype(raw.dtype), axis)
+        out = jnp.where(any_zero > 0, 0.0, sign * mag).astype(raw.dtype)
     else:
         raise ValueError(f"unknown reduce op {op}")
     return _rewrap(x, out)

@@ -262,18 +262,23 @@ def _resolve_specs(layer, input_spec):
     dynamic-dim behavior of the reference's exported programs."""
     specs = []
     scope = jax.export.SymbolicScope()
-    n_sym = [0]
+    syms = {}
 
-    def _dim(d):
+    def _dim(d, axis):
         if d is None or (isinstance(d, int) and d < 0):
-            n_sym[0] += 1
-            return jax.export.symbolic_shape(
-                f"dyn{n_sym[0]}", scope=scope)[0]
+            # One shared symbol per axis position: None batch dims of
+            # different inputs must unify (ids/mask pairs broadcast
+            # together), matching the reference where a dynamic dim is a
+            # program-level symbol, not per-input.
+            if axis not in syms:
+                syms[axis] = jax.export.symbolic_shape(
+                    f"dyn_d{axis}", scope=scope)[0]
+            return syms[axis]
         return int(d)
 
     for s in input_spec:
         if isinstance(s, InputSpec):
-            shape = tuple(_dim(d) for d in s.shape)
+            shape = tuple(_dim(d, i) for i, d in enumerate(s.shape))
             specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
         elif isinstance(s, Tensor):
             specs.append(jax.ShapeDtypeStruct(tuple(s._data.shape),

@@ -1,1 +1,5 @@
+"""Model zoo (reference capability: PaddleNLP/PaddleMIX model recipes
+trained through the framework — SURVEY.md §7 phase 8)."""
+from . import llama  # noqa: F401
 
+__all__ = ["llama"]

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from ...ops._op import op_fn
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "sdpa_reference"]
+           "sdpa_reference", "sdpa_raw", "apply_rotary_emb",
+           "fused_rotary_position_embedding"]
 
 # Filled by paddle_tpu.kernels at import time with a pallas implementation;
 # signature (q, k, v, bias, causal, scale) -> out. None = use XLA path.
@@ -63,15 +64,24 @@ def sdpa_reference(q, k, v, attn_mask=None, *, causal=False, scale=None,
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
 
-@op_fn
-def _sdpa_op(query, key, value, attn_mask=None, *, dropout_p: float = 0.0,
+def sdpa_raw(query, key, value, attn_mask=None, *, dropout_p: float = 0.0,
              is_causal: bool = False, rng_key=None, scale=None):
+    """Raw-array attention dispatcher (kernel seam): flash kernel when
+    registered and applicable, else the XLA math path. Used by both the
+    eager op below and the functional model cores (models/llama.py)."""
     use_flash = (_FLASH_IMPL is not None and attn_mask is None
                  and dropout_p == 0.0)
     if use_flash:
         return _FLASH_IMPL(query, key, value, causal=is_causal, scale=scale)
     return sdpa_reference(query, key, value, attn_mask, causal=is_causal,
                           scale=scale, dropout_p=dropout_p, key=rng_key)
+
+
+@op_fn
+def _sdpa_op(query, key, value, attn_mask=None, *, dropout_p: float = 0.0,
+             is_causal: bool = False, rng_key=None, scale=None):
+    return sdpa_raw(query, key, value, attn_mask, dropout_p=dropout_p,
+                    is_causal=is_causal, rng_key=rng_key, scale=scale)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -100,3 +110,77 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         query, key, value, None, dropout_p=dropout if training else 0.0,
         is_causal=causal, training=training)
     return out, None
+
+
+# -- rotary position embedding (shared raw-array helpers) -------------------
+# Single source of the rope math for the eager op, the incubate wrapper, and
+# the functional model cores (models/llama.py). Reference surface:
+# incubate/nn/functional/fused_rotary_position_embedding.py.
+
+def rope_tables(seq_len: int, head_dim: int, *, theta: float = 10000.0,
+                dtype=jnp.float32):
+    """cos/sin tables [S, head_dim//2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    freqs = jnp.outer(jnp.arange(seq_len, dtype=jnp.float32), inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def rope_raw(x, cos, sin, *, neox: bool = True):
+    """Apply rope on raw arrays. x: [B, S, H, D]; cos/sin: [S, D/2] or
+    (gathered at positions) [B, S, D/2]. ``neox=True`` is the rotate-half
+    convention (GPT-NeoX / Llama); False the interleaved-pair convention."""
+    c = cos[None, :, None, :] if cos.ndim == 2 else cos[:, :, None, :]
+    s = sin[None, :, None, :] if sin.ndim == 2 else sin[:, :, None, :]
+    if neox:
+        d2 = x.shape[-1] // 2
+        x1, x2 = x[..., :d2], x[..., d2:]
+        return jnp.concatenate(
+            [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+@op_fn
+def apply_rotary_emb(x, cos, sin):
+    """Rotary position embedding (rotate-half). x: [B, S, H, D];
+    cos/sin: [S, D/2]."""
+    return rope_raw(x, cos, sin)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """paddle.incubate parity wrapper: applies rope to q/k (v passed
+    through). sin/cos: [1, S, 1, D] or [S, D/2] tables; ``position_ids``
+    [B, S] gathers per-token table rows (incremental decoding)."""
+    def table(t):
+        a = t._data if hasattr(t, "_data") else jnp.asarray(t)
+        if a.ndim == 4:
+            a = a[0, :, 0, :]
+        if a.shape[-1] == q.shape[-1]:   # full-D table -> half table
+            a = a[..., : a.shape[-1] // 2]
+        return a
+
+    if cos is None or sin is None:
+        cos_t, sin_t = rope_tables(q.shape[1], q.shape[-1])
+    else:
+        cos_t, sin_t = table(cos), table(sin)
+    if position_ids is not None:
+        pos = position_ids._data if hasattr(position_ids, "_data") \
+            else jnp.asarray(position_ids)
+        cos_t = jnp.take(cos_t, pos, axis=0)   # [B, S, D/2]
+        sin_t = jnp.take(sin_t, pos, axis=0)
+
+    outs = [_rope_op(q, cos_t, sin_t, neox=use_neox_rotary_style)]
+    outs.append(_rope_op(k, cos_t, sin_t, neox=use_neox_rotary_style)
+                if k is not None else None)
+    outs.append(v)
+    return tuple(outs)
+
+
+@op_fn(name="fused_rope")
+def _rope_op(x, c, s, *, neox: bool = True):
+    return rope_raw(x, c, s, neox=neox)

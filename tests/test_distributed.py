@@ -119,14 +119,14 @@ class TestShardLayer:
         mesh = make_mesh(2, 4, names=["dp", "mp"])
 
         def shard_fn(name, sublayer, m):
-            import paddle_tpu.distributed.fleet.mp_layers as mpl
             for pname, p in list(sublayer._parameters.items()):
                 if p is None or p.ndim != 2:
                     continue
                 t = dist.shard_tensor(p, m, [dist.Replicate(), dist.Shard(1)])
-                sublayer._parameters[pname] = mpl._shard_param.__wrapped__(
-                    p, m, "mp", 1) if False else \
-                    type(p)(t._data, name=p.name)
+                new_p = type(p)(t._data, name=p.name)
+                new_p._placements = t._placements
+                new_p._process_mesh = t._process_mesh
+                sublayer._parameters[pname] = new_p
 
         layer = pt.nn.Linear(8, 8)
         dist.shard_layer(layer, mesh, shard_fn)
