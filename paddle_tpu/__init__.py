@@ -30,6 +30,8 @@ from . import io  # noqa
 from . import jit  # noqa
 from . import nn  # noqa
 from . import optimizer  # noqa
+from . import kernels  # noqa
+from . import models  # noqa
 from .framework.io import load, save  # noqa
 
 import jax as _jax
@@ -67,3 +69,7 @@ def grad(*args, **kwargs):
 
 def _monkeypatch_tensor_repr():
     pass
+
+
+# Pallas kernels self-select on TPU backends (KernelFactory-style dispatch).
+kernels.auto_register()

@@ -1,1 +1,82 @@
+"""Pallas TPU kernel library — the phi/kernels/fusion equivalent.
 
+Reference capability: paddle/phi/kernels/fusion/ (52 fused CUDA kernels) and
+the flash-attn wrapper (gpu/flash_attn_kernel.cu). TPU-native: hand-written
+pallas kernels for the ops where XLA's automatic fusion is not enough —
+flash attention (tiled online softmax on the MXU) and fused RMSNorm; the
+rest of the reference's fused set (bias+act, rope, swiglu) is left to XLA
+fusion, which already emits single kernels for those elementwise chains.
+
+Dispatch mirrors the reference's KernelFactory choice (SURVEY.md §7
+"KernelFactory dispatch" row): `register()` installs the pallas impls into
+the functional seams (attention._FLASH_IMPL, norm._FUSED_RMS_IMPL) with
+shape-support guards and XLA fallback. On TPU the kernels compile natively;
+off-TPU they run in pallas interpret mode (tests) or fall back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import flash_attention as _fa
+from . import rms_norm as _rn
+
+flash_attention = _fa.flash_attention
+fused_rms_norm = _rn.rms_norm
+
+__all__ = ["flash_attention", "fused_rms_norm", "register", "unregister"]
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _make_flash_dispatch(tpu_only: bool):
+    def dispatch(q, k, v, *, causal=False, scale=None):
+        from ..nn.functional import attention as _att
+        if (tpu_only and not _on_tpu()) or not _fa.supported(q, k, v):
+            return _att.sdpa_reference(q, k, v, causal=causal, scale=scale)
+        return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
+    return dispatch
+
+
+def _make_rms_dispatch(tpu_only: bool):
+    def dispatch(x, w, eps):
+        out_dtype = jnp.result_type(x.dtype, w.dtype)
+        if ((tpu_only and not _on_tpu())
+                or w.ndim != 1 or w.shape[0] != x.shape[-1]):
+            # XLA path (same math as nn.functional.norm.rms_norm body)
+            xf = x.astype(jnp.float32)
+            r = jax.lax.rsqrt(
+                jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+            return ((xf * r).astype(x.dtype) * w).astype(out_dtype)
+        return _rn.rms_norm(x, w, eps).astype(out_dtype)
+    return dispatch
+
+
+def register(flash: bool = True, rms: bool = True, tpu_only: bool = False):
+    """Install pallas kernels into the op-dispatch seams.
+
+    ``tpu_only=True`` installs lazy dispatchers that check the backend at
+    call time (never at import — multi-host jax.distributed.initialize and
+    platform selection must be able to run first) and fall back to the XLA
+    math off-TPU."""
+    from ..nn.functional import attention as _att
+    from ..nn.functional import norm as _norm
+    if flash:
+        _att.register_flash_impl(_make_flash_dispatch(tpu_only))
+    if rms:
+        _norm.register_rms_impl(_make_rms_dispatch(tpu_only))
+
+
+def unregister():
+    from ..nn.functional import attention as _att
+    from ..nn.functional import norm as _norm
+    _att.register_flash_impl(None)
+    _norm.register_rms_impl(None)
+
+
+def auto_register():
+    """Called from package init. Installs the lazy TPU-gated dispatchers —
+    no backend probe happens until the first attention/norm call."""
+    register(tpu_only=True)

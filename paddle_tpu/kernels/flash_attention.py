@@ -1,0 +1,379 @@
+"""Pallas TPU flash attention (forward + backward).
+
+Reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu (wrapping
+third_party/flashattn) and nn/functional/flash_attention.py. TPU-native
+design: tiled online-softmax kernels on the MXU following the canonical
+pallas TPU pattern — a (batch*heads, q_blocks, k_blocks) grid whose minor
+axis iterates sequentially per core, carrying running max/denominator in
+VMEM scratch; causal blocks above the diagonal are skipped (predicated),
+GQA queries map to their kv head via the BlockSpec index map, and the
+backward pass recomputes probabilities blockwise from the saved
+log-sum-exp (no S×S materialisation anywhere).
+
+Layouts: public API is paddle's [B, S, H, D]; kernels run on [B*H, S, D].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                scale, causal, offset, block_q, block_k, num_k_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    # bottom-right-aligned causal (sdpa convention): row r sees cols
+    # <= r + offset, offset = sk - sq
+    last_ki = jnp.minimum(
+        (qi + 1) * block_q - 1 + offset,
+        (num_k_blocks * block_k) - 1) // block_k \
+        if causal else num_k_blocks - 1
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # causal: whole block above the diagonal contributes nothing
+    run = (ki * block_k <= (qi + 1) * block_q - 1 + offset) \
+        if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                                    # [bq, d]
+        k = k_ref[0]                                    # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]                             # [bq, 1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)       # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                          # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = l_s[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)                 # fully-masked rows
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:, :1] + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    """q: [BH, Sq, D]; k/v: [BKV, Sk, D] with BH = BKV * group."""
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    group = bh // bkv
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+
+    grid = (bh, nq, nk)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          offset=sk - sq, block_q=block_q, block_k=block_k,
+                          num_k_blocks=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g=group: (b // g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, offset, block_q, block_k,
+                   num_k_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_ki = jnp.minimum(
+        (qi + 1) * block_q - 1 + offset,
+        (num_k_blocks * block_k) - 1) // block_k \
+        if causal else num_k_blocks - 1
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k <= (qi + 1) * block_q - 1 + offset) \
+        if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        kk = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    offset, block_q, block_k, num_q_blocks):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks strictly above the diagonal see none of this k block
+    run = ((qi + 1) * block_q - 1 + offset >= ki * block_k) \
+        if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        kk = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    group = bh // bkv
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    do = g.astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                             # [BH, Sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          offset=sk - sq, block_q=block_q, block_k=block_k,
+                          num_k_blocks=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv computed per *query* head then group-summed to the kv head
+    # (avoids cross-program races for GQA).
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          offset=sk - sq, block_q=block_q, block_k=block_k,
+                          num_q_blocks=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, j, i, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, j, i, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        dk = dk_full.reshape(bkv, group, sk, d).sum(axis=1)
+        dv = dv_full.reshape(bkv, group, sk, d).sum(axis=1)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom_vjp over [B, S, H, D])
+# ---------------------------------------------------------------------------
+
+def _reshape_in(x):
+    """[B, S, H, D] -> [B*H, S, D]."""
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _reshape_out(x, b, h):
+    bh, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    qr = _reshape_in(q)
+    kr = _reshape_in(k)
+    vr = _reshape_in(v)
+    out, lse = _fwd(qr, kr, vr, scale=scale, causal=causal,
+                    block_q=block_q, block_k=block_k, interpret=interpret)
+    return _reshape_out(out, b, h), (qr, kr, vr, out, lse, b, h)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    qr, kr, vr, out, lse, b, h = res
+    kvh = kr.shape[0] // b
+    gr = _reshape_in(g)
+    dq, dk, dv = _bwd((qr, kr, vr, out, lse), gr, scale=scale,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      interpret=interpret)
+    return (_reshape_out(dq, b, h), _reshape_out(dk, b, kvh),
+            _reshape_out(dv, b, kvh))
+
+
+_flash.defvjp(lambda q, k, v, *a: _flash_fwd(q, k, v, *a),
+              _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Flash attention on [B, S, H, D] (paddle layout); supports GQA
+    (fewer kv heads) and causal masking. Differentiable (custom VJP,
+    flash backward). Sequence lengths must divide the block sizes —
+    the dispatcher (kernels/__init__.py) falls back to the XLA path
+    otherwise."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    return _flash(q, k, v, float(scale), bool(causal), bq, bk, interpret)
+
+
+def supported(q, k, v, *, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Whether the kernel handles these shapes (else XLA fallback)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    # blocks must tile the sequence AND be sublane-aligned (8) so the
+    # kernel's VMEM tiles map cleanly onto the (8, 128) register shape
+    return (sq % bq == 0 and sk % bk == 0 and
+            bq % 8 == 0 and bk % 8 == 0 and
+            h % k.shape[2] == 0 and d <= 256)

@@ -51,9 +51,22 @@ def layer_norm(x, weight=None, bias=None, *, normalized_ndim: int = 1,
     return y
 
 
+# Kernel seam (same pattern as attention._FLASH_IMPL): paddle_tpu.kernels
+# registers the pallas fused rms_norm here; None = plain XLA path.
+_FUSED_RMS_IMPL = None
+
+
+def register_rms_impl(fn):
+    global _FUSED_RMS_IMPL
+    _FUSED_RMS_IMPL = fn
+
+
 @op_fn
 def rms_norm(x, weight=None, *, epsilon: float = 1e-6, axis: int = -1):
     """RMSNorm (reference: incubate fused_rms_norm). float32 accumulation."""
+    if (_FUSED_RMS_IMPL is not None and weight is not None
+            and axis in (-1, x.ndim - 1)):
+        return _FUSED_RMS_IMPL(x, weight, epsilon)
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
     y = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
