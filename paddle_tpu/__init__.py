@@ -32,6 +32,7 @@ from . import nn  # noqa
 from . import optimizer  # noqa
 from . import kernels  # noqa
 from . import models  # noqa
+from . import incubate  # noqa
 from .framework.io import load, save  # noqa
 
 import jax as _jax

@@ -32,6 +32,7 @@ from .placement import Partial, Placement, ReduceType, Replicate, Shard  # noqa
 from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa
 
 from . import fleet  # noqa  (hybrid-parallel programming model)
+from . import pipeline  # noqa  (collective-permute PP schedules)
 from .parallel import DataParallel  # noqa
 from . import checkpoint  # noqa
 from .checkpoint import load_state_dict, save_state_dict  # noqa

@@ -8,6 +8,9 @@ from .fleet import (DistributedStrategy, Fleet, distributed_model,  # noqa
                     init, is_first_worker, worker_index, worker_num)
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa
                         RowParallelLinear, VocabParallelEmbedding)
+from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,  # noqa
+                        SharedLayerDesc)
+from . import sequence_parallel_utils  # noqa
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa
 
 # meta_parallel namespace parity (reference: fleet/meta_parallel/__init__.py

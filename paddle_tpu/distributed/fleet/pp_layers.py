@@ -1,0 +1,213 @@
+"""PipelineLayer — the user-facing pipeline model description.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py
+(`PipelineLayer:257`, `SegmentLayers:92`, LayerDesc/SharedLayerDesc).
+TPU-native notes: segmentation (uniform or parameter-weighted) is identical
+in spirit; execution differs — instead of per-rank processes exchanging
+activations over NCCL p2p, `PipelineLayer` (a) runs all stages in-process
+for eager/debug use and (b) exports per-stage callables that
+distributed.pipeline.pipeline_spmd schedules as one collective-permute
+program over the 'pp' mesh axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+from ...nn.layer.base import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(
+                f"LayerDesc expects a Layer subclass, got {layer_cls}")
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages (e.g. tied embeddings). On TPU the
+    weight lives replicated (or tp-sharded) and both stages reference the
+    same Parameter; the reference instead allreduces grads between the
+    owning ranks."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layer descs into num_parts stages (reference
+    SegmentLayers:92): uniform by count, or weighted by parameter count
+    when method='parameter'."""
+
+    def __init__(self, layers: Sequence, num_parts: int,
+                 method: str = "uniform"):
+        self.layers = layers
+        self.num_parts = num_parts
+        self.method = method
+        if len(layers) < num_parts:
+            raise ValueError(
+                f"cannot split {len(layers)} layers into {num_parts} parts")
+
+    def do_segment(self) -> List[int]:
+        n = len(self.layers)
+        if self.method == "uniform":
+            base, extra = divmod(n, self.num_parts)
+            bounds = [0]
+            for i in range(self.num_parts):
+                bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+            return bounds
+        if self.method.startswith("layer:"):
+            # each stage *starts at* a layer of the named class (reference
+            # "layer:Block" semantics — trailing non-named layers stay with
+            # their preceding block)
+            name = self.method.split(":", 1)[1]
+            idx = [i for i, l in enumerate(self.layers)
+                   if getattr(getattr(l, "layer_cls", type(l)),
+                              "__name__", "") == name]
+            if len(idx) < self.num_parts:
+                raise ValueError(
+                    f"only {len(idx)} '{name}' layers for "
+                    f"{self.num_parts} parts")
+            per, extra = divmod(len(idx), self.num_parts)
+            bounds = [0]
+            taken = 0
+            for i in range(self.num_parts - 1):
+                taken += per + (1 if i < extra else 0)
+                bounds.append(idx[taken])   # next part starts AT this block
+            bounds.append(n)
+            return bounds
+        if self.method == "parameter":
+            weights = []
+            for l in self.layers:
+                if isinstance(l, LayerDesc):
+                    built = l.build_layer()
+                    w = sum(p.numel() for p in built.parameters())
+                elif isinstance(l, Layer):
+                    w = sum(p.numel() for p in l.parameters())
+                else:
+                    w = 0
+                weights.append(max(int(w), 1))
+            total = sum(weights)
+            bounds = [0]
+            acc = 0
+            remaining_parts = self.num_parts
+            target = total / remaining_parts
+            for i, w in enumerate(weights):
+                layers_left = n - (i + 1)
+                acc += w
+                # close the part when it reaches the (re-balanced) target,
+                # or when the remaining layers are only just enough to give
+                # every remaining part at least one layer
+                must_cut = layers_left == remaining_parts - 1
+                if remaining_parts > 1 and (acc >= target or must_cut):
+                    bounds.append(i + 1)
+                    remaining_parts -= 1
+                    total -= acc
+                    acc = 0
+                    target = total / max(remaining_parts, 1)
+            bounds.append(n)
+            return bounds
+        raise ValueError(f"unknown segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    """Reference pp_layers.py:257. Describes the whole model as a flat
+    layer list, segments it into stages, builds only what this rank needs
+    (here: builds all stages — single-controller SPMD — and exposes
+    per-stage sublayers + run helpers)."""
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, **kwargs):
+        super().__init__()
+        self._descs = list(layers)
+        if topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        seg = SegmentLayers(self._descs, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        self._shared = {}
+        built: List[Layer] = []
+        self.run_functions: List[Any] = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                if d.forward_func is not None:
+                    fwd = d.forward_func
+                    layer_ref = layer
+                    self.run_functions.append(
+                        lambda x, l=layer_ref, f=fwd: f(l, x))
+                else:
+                    self.run_functions.append(layer)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                built.append(layer)
+                self.run_functions.append(layer)
+            elif isinstance(d, Layer):
+                built.append(d)
+                self.run_functions.append(d)
+            elif callable(d):
+                built.append(None)
+                self.run_functions.append(d)
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        # register built layers so parameters() walks them
+        for i, l in enumerate(built):
+            if l is not None:
+                self.add_sublayer(str(i), l)
+
+    # -- introspection ----------------------------------------------------
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_slices(self):
+        return [(self.segment_parts[i], self.segment_parts[i + 1])
+                for i in range(self._num_stages)]
+
+    def get_stage_layers(self, stage_id: int):
+        lo, hi = self.stage_slices()[stage_id]
+        return self.run_functions[lo:hi]
+
+    def stage_callable(self, stage_id: int) -> Callable:
+        """The stage as a plain callable activation -> activation."""
+        fns = self.get_stage_layers(stage_id)
+
+        def run(x):
+            for f in fns:
+                x = f(x)
+            return x
+        return run
+
+    def forward(self, x):
+        """Eager full-model forward (all stages in-process)."""
+        for f in self.run_functions:
+            x = f(x)
+        return x
+
+    @property
+    def loss_fn(self):
+        return self._loss_fn

@@ -1,0 +1,215 @@
+"""Sequence parallelism utilities (Megatron-SP parity).
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp PyLayers (:85-147), ColumnSequenceParallelLinear
+(:395), RowSequenceParallelLinear (:528).
+
+TPU-native: under GSPMD the scatter/gather pair is a *sharding constraint*
+on the sequence dim — XLA materialises the all-gather before a TP matmul
+and the reduce-scatter after it, overlapping with compute (the hand overlap
+of SPInnerOverlapLinear:240 comes free from the XLA scheduler). The op
+classes below keep the reference's API: in eager single-process they are
+identity-like views over the full sequence; inside a jitted/sharded program
+they emit with_sharding_constraint on the seq dim of the 'mp' axis. The
+explicit-collective forms (used inside shard_map) live in
+distributed.comm_ops (all_gather/reduce_scatter).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ... import nn
+from ...core.tensor import Tensor
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]
+
+
+def _current_mesh():
+    """The global ProcessMesh set by fleet.init / dist.auto_parallel."""
+    from ..process_mesh import get_mesh
+    return get_mesh()
+
+
+from ...ops._op import op_fn
+
+
+@op_fn(name="sp_sharding_constraint")
+def _constraint_op(x, *, sharding):
+    # differentiable: vjp of with_sharding_constraint is the constraint
+    # itself, recorded on the tape like every other op
+    return lax.with_sharding_constraint(x, sharding)
+
+
+def _seq_constraint(x, shard: bool, seq_axis: int = 1):
+    """Annotate the sequence dim as mp-sharded (scatter) or replicated
+    (gather). Outside a mesh context this is the identity."""
+    mesh = _current_mesh()
+    if mesh is None:
+        return x
+    jm = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    if "mp" not in jm.axis_names:
+        return x
+    raw = x._data if isinstance(x, Tensor) else x
+    spec = [None] * raw.ndim
+    if shard:
+        spec[seq_axis] = "mp"
+    sharding = NamedSharding(jm, P(*spec))
+    try:
+        if isinstance(x, Tensor):
+            return _constraint_op(x, sharding=sharding)
+        return lax.with_sharding_constraint(raw, sharding)
+    except Exception:   # not under jit / device mismatch: plain identity
+        return x
+
+
+def _feature_constraint(x, shard: bool):
+    """Annotate the last (feature/head) dim as mp-sharded or replicated."""
+    mesh = _current_mesh()
+    if mesh is None:
+        return x
+    jm = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    if "mp" not in jm.axis_names:
+        return x
+    raw = x._data if isinstance(x, Tensor) else x
+    spec = [None] * raw.ndim
+    if shard:
+        spec[-1] = "mp"
+    sharding = NamedSharding(jm, P(*spec))
+    try:
+        if isinstance(x, Tensor):
+            return _constraint_op(x, sharding=sharding)
+        return lax.with_sharding_constraint(raw, sharding)
+    except Exception:
+        return x
+
+
+class ScatterOp:
+    """reference :85 — split activations along seq dim across mp ranks.
+    GSPMD: a seq-dim sharding constraint."""
+
+    @staticmethod
+    def apply(x, axis: int = 1):
+        return _seq_constraint(x, shard=True, seq_axis=axis)
+
+
+class GatherOp:
+    """reference :103 — gather seq-sharded activations back."""
+
+    @staticmethod
+    def apply(x, axis: int = 1):
+        return _seq_constraint(x, shard=False, seq_axis=axis)
+
+
+class AllGatherOp:
+    """reference :121 — allgather along seq (fwd) / reduce-scatter (bwd)."""
+
+    @staticmethod
+    def apply(x, axis: int = 1):
+        return _seq_constraint(x, shard=False, seq_axis=axis)
+
+
+class ReduceScatterOp:
+    """reference :138 — reduce-scatter along seq (fwd) / allgather (bwd)."""
+
+    @staticmethod
+    def apply(x, axis: int = 1):
+        return _seq_constraint(x, shard=True, seq_axis=axis)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """reference :166 — tag params whose grads need the SP allreduce; under
+    GSPMD replicated params already psum their grads, so this is metadata
+    only (kept for API parity / checkpoint tooling)."""
+    param.sequence_parallel = True
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """reference :192 — no-op on TPU: the grad allreduce the hooks issue is
+    emitted by GSPMD from the sharding annotations."""
+    return model
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """reference :395 — column-parallel linear whose input arrives
+    seq-sharded: allgather(seq) → matmul with column-sharded weight.
+    GSPMD expression: weight Shard(1) on mp; input constrained seq-sharded;
+    output constrained head/feature-sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, name=None, **kw):
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        # reference :395: gather_output=True returns the full (replicated)
+        # feature dim instead of leaving it mp-sharded
+        self.gather_output = gather_output
+        self._shard_weight()
+
+    def _shard_weight(self):
+        mesh = _current_mesh()
+        if mesh is None:
+            return
+        from .. import api as dist_api
+        from ..placement import Replicate, Shard
+        jm = mesh
+        try:
+            nd = jm.ndim
+            pl = [Replicate()] * nd
+            pl[jm.dim_names.index("mp")] = Shard(1)
+            t = dist_api.shard_tensor(self.linear.weight, jm, pl)
+            self.linear.weight._data = t._data
+        except Exception:
+            pass
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)           # seq gathered before the matmul
+        y = self.linear(x)
+        if self.gather_output:
+            y = _feature_constraint(y, shard=False)
+        else:
+            y = _feature_constraint(y, shard=True)
+        return y
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """reference :528 — row-parallel linear whose output returns to the
+    seq-sharded domain: matmul with row-sharded weight → reduce-scatter
+    over seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, name=None, **kw):
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self._shard_weight()
+
+    def _shard_weight(self):
+        mesh = _current_mesh()
+        if mesh is None:
+            return
+        from .. import api as dist_api
+        from ..placement import Replicate, Shard
+        try:
+            nd = mesh.ndim
+            pl = [Replicate()] * nd
+            pl[mesh.dim_names.index("mp")] = Shard(0)
+            t = dist_api.shard_tensor(self.linear.weight, mesh, pl)
+            self.linear.weight._data = t._data
+        except Exception:
+            pass
+
+    def forward(self, x):
+        y = self.linear(x)
+        return ReduceScatterOp.apply(y)    # back to the seq-sharded domain

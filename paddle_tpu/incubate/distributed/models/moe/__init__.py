@@ -1,0 +1,2 @@
+from .layer import (GShardGate, MoELayer, NaiveGate, SwitchGate,  # noqa
+                    moe_dispatch_combine, top_k_gating)

@@ -1,0 +1,68 @@
+"""paddle.incubate.nn.functional parity: fused-op API surface.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_bias_act, ...). On TPU these
+route to the pallas kernel library or XLA fusion (SURVEY.md §2.7 incubate
+row) — the public names and signatures follow the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.functional.attention import fused_rotary_position_embedding  # noqa: F401
+from ...ops._op import op_fn
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "swiglu",
+           "fused_rotary_position_embedding", "fused_bias_act"]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    """reference incubate fused_rms_norm: normalizes over the trailing
+    dims starting at ``begin_norm_axis`` (flattened), returns
+    (out, invvar-like). The pallas fused kernel applies when registered
+    (kernels.register)."""
+    ndim = len(x.shape)
+    axis = begin_norm_axis % ndim
+    if axis == ndim - 1:
+        out = F.rms_norm(x, norm_weight, epsilon=epsilon)
+    else:
+        # flatten trailing dims into one, normalize, restore — reference
+        # semantics for begin_norm_axis < ndim-1
+        from ... import ops
+        shape = list(x.shape)
+        flat = ops.reshape(x, shape=shape[:axis] + [-1])
+        wflat = ops.reshape(norm_weight, shape=[-1])             if norm_weight is not None else None
+        out = ops.reshape(F.rms_norm(flat, wflat, epsilon=epsilon),
+                          shape=shape)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1):
+    return F.layer_norm(x, normalized_shape=x.shape[begin_norm_axis:],
+                        weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon), None
+
+
+@op_fn
+def swiglu(x, y=None):
+    """reference incubate swiglu: silu(x) * y (y=None: split x in half).
+    XLA fuses this chain into one kernel on TPU."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@op_fn
+def fused_bias_act(x, bias=None, *, act_method: str = "gelu"):
+    """reference incubate fused_bias_act: bias-add + activation."""
+    if bias is not None:
+        x = x + bias
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swiglu": lambda v: swiglu.pure_fn(v)}
+    return acts[act_method](x)

@@ -20,11 +20,13 @@ import jax.numpy as jnp
 
 from . import flash_attention as _fa
 from . import rms_norm as _rn
+from .ring_attention import ring_attention  # noqa
 
 flash_attention = _fa.flash_attention
 fused_rms_norm = _rn.rms_norm
 
-__all__ = ["flash_attention", "fused_rms_norm", "register", "unregister"]
+__all__ = ["flash_attention", "fused_rms_norm", "ring_attention",
+           "register", "unregister"]
 
 
 def _on_tpu() -> bool:
