@@ -33,6 +33,12 @@ from . import optimizer  # noqa
 from . import kernels  # noqa
 from . import models  # noqa
 from . import incubate  # noqa
+from . import metric  # noqa
+from . import vision  # noqa
+from . import distribution  # noqa
+from . import hapi  # noqa
+from .hapi import Model, summary  # noqa
+from .hapi import callbacks  # noqa
 from .framework.io import load, save  # noqa
 
 import jax as _jax

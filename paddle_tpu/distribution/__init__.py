@@ -1,0 +1,547 @@
+"""paddle.distribution parity.
+
+Reference: python/paddle/distribution/ (Distribution base, Normal,
+Uniform, Categorical, Bernoulli, Beta, Dirichlet, Gamma, Exponential,
+Laplace, LogNormal, Multinomial, Gumbel, Geometric, Poisson, kl_divergence).
+TPU-native: densities/KLs are jnp expressions (jit-able); sampling draws
+keys from the framework RNG (framework.random) so paddle.seed governs it.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as frandom
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace",
+           "LogNormal", "Gumbel", "Geometric", "Poisson", "Multinomial",
+           "kl_divergence", "register_kl"]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    return Tensor(x)
+
+
+class Distribution:
+    """reference distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _shape(self, shape):
+        return tuple(shape) + self._batch_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        eps = jax.random.normal(key, self._shape(shape))
+        return _wrap(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(e, self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.base.loc + self.base.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.base.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.base.loc + s2))
+
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(_raw(self.base.sample(shape))))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(_raw(self.base.log_prob(jnp.log(v))) - jnp.log(v))
+
+    def entropy(self):
+        return _wrap(_raw(self.base.entropy()) + self.base.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _raw(low).astype(jnp.float32)
+        self.high = _raw(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2,
+                                      self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                      self._batch_shape))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        u = jax.random.uniform(key, self._shape(shape))
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self._batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _raw(logits).astype(jnp.float32)
+        elif probs is not None:
+            self.logits = jnp.log(_raw(probs).astype(jnp.float32))
+        else:
+            raise ValueError("provide logits or probs")
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        if logp.ndim == 1:
+            # scalar-batch distribution queried at many values
+            return _wrap(jnp.take(logp, v))
+        return _wrap(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        p = jnp.exp(logp)
+        return _wrap(-jnp.sum(p * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _raw(probs).astype(jnp.float32)
+        elif logits is not None:
+            self.probs_ = jax.nn.sigmoid(_raw(logits).astype(jnp.float32))
+        else:
+            raise ValueError("provide probs or logits")
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs_)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(jax.random.bernoulli(
+            key, self.probs_, self._shape(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _raw(alpha).astype(jnp.float32)
+        self.beta = _raw(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(jax.random.beta(key, self.alpha, self.beta,
+                                     self._shape(shape)))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return _wrap(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                     + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _raw(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration
+                     / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(jax.random.dirichlet(key, self.concentration,
+                                          tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        c = self.concentration
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                 - jax.scipy.special.gammaln(jnp.sum(c, -1)))
+        return _wrap(jnp.sum((c - 1) * jnp.log(v), -1) - lnorm)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _raw(concentration).astype(jnp.float32)
+        self.rate = _raw(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        g = jax.random.gamma(key, self.concentration, self._shape(shape))
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        c, r = self.concentration, self.rate
+        return _wrap(c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                     - jax.scipy.special.gammaln(c))
+
+    def entropy(self):
+        c, r = self.concentration, self.rate
+        dg = jax.scipy.special.digamma
+        return _wrap(c - jnp.log(r) + jax.scipy.special.gammaln(c)
+                     + (1 - c) * dg(c))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _raw(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(jax.random.exponential(
+            key, self._shape(shape)) / self.rate)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale ** 2,
+                                      self._batch_shape))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(self.loc + self.scale * jax.random.laplace(
+            key, self._shape(shape)))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return _wrap(math.pi ** 2 / 6 * self.scale ** 2
+                     * jnp.ones(self._batch_shape))
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(self.loc + self.scale * jax.random.gumbel(
+            key, self._shape(shape)))
+
+    def log_prob(self, value):
+        z = (_raw(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_ = _raw(probs).astype(jnp.float32)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.probs_)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        u = jax.random.uniform(key, self._shape(shape))
+        return _wrap(jnp.ceil(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap((v - 1) * jnp.log1p(-self.probs_)
+                     + jnp.log(self.probs_))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _raw(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        return _wrap(jax.random.poisson(
+            key, self.rate, self._shape(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate
+                     - jax.scipy.special.gammaln(v + 1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_ = _raw(probs).astype(jnp.float32)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs_)
+
+    def sample(self, shape=()):
+        key = frandom.next_key()
+        n = self.probs_.shape[-1]
+        # draws: [total_count, *shape, *batch] — leading count axis keeps
+        # the requested shape broadcast-compatible with the logits batch
+        draws = jax.random.categorical(
+            key, jnp.log(self.probs_),
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        onehot = jax.nn.one_hot(draws, n)
+        return _wrap(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        logp = jnp.log(self.probs_)
+        coef = (jax.scipy.special.gammaln(
+            jnp.asarray(self.total_count + 1.0))
+            - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1))
+        return _wrap(coef + jnp.sum(v * logp, -1))
+
+
+# -- KL registry (reference distribution/kl.py) ------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p = p.scale ** 2
+    var_q = q.scale ** 2
+    return _wrap(jnp.log(q.scale / p.scale)
+                 + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return _wrap(a * (jnp.log(a) - jnp.log(b))
+                 + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)

@@ -1,0 +1,3 @@
+"""hapi — high-level train/eval/predict API (reference python/paddle/hapi)."""
+from . import callbacks  # noqa: F401
+from .model import Model, summary  # noqa: F401

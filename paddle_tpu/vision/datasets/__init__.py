@@ -1,0 +1,125 @@
+"""paddle.vision.datasets parity (reference: vision/datasets/).
+
+The reference downloads MNIST/Cifar/Flowers at first use; this environment
+has no egress, so these classes load from a local `data_file`/`image_path`
+and raise a clear error when absent. `FakeData` provides synthetic images
+for smoke tests (analogue of the reference test fixtures)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset."""
+
+    def __init__(self, size=256, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        rng = np.random.default_rng(seed)
+        self.images = rng.normal(size=(size, *image_shape)).astype("float32")
+        self.labels = rng.integers(0, num_classes, size=size).astype("int64")
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(Dataset):
+    """reference vision/datasets/mnist.py — loads idx-format files from
+    ``image_path``/``label_path`` (no auto-download here)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if image_path is None or label_path is None:
+            raise FileNotFoundError(
+                "MNIST auto-download is unavailable (no network); pass "
+                "image_path= and label_path= to local idx(.gz) files")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")[..., None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    _n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "Cifar auto-download is unavailable (no network); pass "
+                "data_file= pointing at the local python-version archive")
+        import pickle
+        import tarfile
+        self.transform = transform
+        images, labels = [], []
+        key = b"labels" if self._n_classes == 10 else b"fine_labels"
+        with tarfile.open(data_file) as tf:
+            names = [m for m in tf.getmembers()
+                     if ("test" in m.name if mode == "test"
+                         else "data_batch" in m.name or "train" in m.name)]
+            for m in names:
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                images.append(d[b"data"])
+                labels.extend(d[key])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype="int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    _n_classes = 10
+
+
+class Cifar100(_CifarBase):
+    _n_classes = 100

@@ -1,0 +1,8 @@
+"""paddle.vision.models parity (reference: python/paddle/vision/models/).
+Weights-from-url loading is unavailable (no egress); pretrained=True raises
+with that explanation."""
+from .lenet import LeNet  # noqa
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa
+                     resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa

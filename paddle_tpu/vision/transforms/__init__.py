@@ -1,0 +1,12 @@
+"""paddle.vision.transforms parity.
+
+Reference: python/paddle/vision/transforms/ (transforms.py + functional).
+TPU-native notes: transforms run host-side on numpy HWC images in the
+DataLoader workers (same stage as the reference's CPU transforms); the
+device never sees per-sample python work."""
+from .transforms import (BaseTransform, BrightnessTransform, CenterCrop,  # noqa
+                         ColorJitter, Compose, ContrastTransform, Normalize,
+                         Pad, RandomCrop, RandomHorizontalFlip,
+                         RandomResizedCrop, RandomRotation, RandomVerticalFlip,
+                         Resize, ToTensor, Transpose)
+from . import functional  # noqa

@@ -1,0 +1,234 @@
+"""Functional image ops on numpy HWC arrays (reference:
+vision/transforms/functional*.py — the cv2/PIL backends collapse to one
+numpy backend here; PIL images are accepted and converted)."""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
+           "hflip", "vflip", "rotate", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue",
+           "to_grayscale"]
+
+
+def _as_np(img):
+    if hasattr(img, "convert"):   # PIL
+        img = np.asarray(img)
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC -> float32 tensor (CHW default). Integer dtypes scale to [0,1]
+    by 255 (dtype-based, like the reference); float inputs pass through."""
+    arr = _as_np(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    scale = np.issubdtype(arr.dtype, np.integer)
+    arr = arr.astype(np.float32)
+    if scale:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    import paddle_tpu as P
+    return P.to_tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    """size: int (short side) or (h, w). Bilinear on numpy."""
+    arr = _as_np(img).astype(np.float32)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            nh, nw = size, int(w * size / h)
+        else:
+            nh, nw = int(h * size / w), size
+    else:
+        nh, nw = size
+    ys = np.clip((np.arange(nh) + 0.5) * h / nh - 0.5, 0, h - 1)
+    xs = np.clip((np.arange(nw) + 0.5) * w / nw - 0.5, 0, w - 1)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    out = (arr[y0][:, x0] * (1 - wy) * (1 - wx)
+           + arr[y0][:, x1] * (1 - wy) * wx
+           + arr[y1][:, x0] * wy * (1 - wx)
+           + arr[y1][:, x1] * wy * wx)
+    if squeeze:
+        out = out[:, :, 0]
+    return out
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _as_np(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    width = [(top, bottom), (left, right)] + \
+        [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, width, mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    return _as_np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_np(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_np(img)[::-1]
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotation about the center; ``expand=True`` grows the canvas to hold
+    the whole rotated image; nearest or bilinear sampling."""
+    arr = _as_np(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else center
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        # epsilon guards against float error inflating exact multiples
+        nh = int(np.ceil(abs(h * cos) + abs(w * sin) - 1e-9))
+        nw = int(np.ceil(abs(w * cos) + abs(h * sin) - 1e-9))
+        ocy, ocx = (nh - 1) / 2, (nw - 1) / 2
+    else:
+        nh, nw = h, w
+        ocy, ocx = cy, cx
+    yy, xx = np.mgrid[0:nh, 0:nw]
+    # inverse-map each output pixel to source coordinates
+    ys = cos * (yy - ocy) + sin * (xx - ocx) + cy
+    xs = -sin * (yy - ocy) + cos * (xx - ocx) + cx
+    out = np.full((nh, nw, arr.shape[2]), fill, dtype=arr.dtype)
+    if interpolation == "bilinear":
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        valid = (y0 >= 0) & (y0 < h - 1) & (x0 >= 0) & (x0 < w - 1)
+        y0c = np.clip(y0, 0, h - 2)
+        x0c = np.clip(x0, 0, w - 2)
+        wy = (ys - y0c)[..., None]
+        wx = (xs - x0c)[..., None]
+        interp = (arr[y0c, x0c] * (1 - wy) * (1 - wx)
+                  + arr[y0c, x0c + 1] * (1 - wy) * wx
+                  + arr[y0c + 1, x0c] * wy * (1 - wx)
+                  + arr[y0c + 1, x0c + 1] * wy * wx)
+        out[valid] = interp[valid].astype(arr.dtype)
+    else:
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out[valid] = arr[yi[valid], xi[valid]]
+    if squeeze:
+        out = out[:, :, 0]
+    return out
+
+
+def adjust_brightness(img, factor):
+    arr = _as_np(img).astype(np.float32) * factor
+    return np.clip(arr, 0, 255 if arr.max() > 1 else 1.0)
+
+
+def adjust_contrast(img, factor):
+    arr = _as_np(img).astype(np.float32)
+    mean = arr.mean()
+    out = mean + factor * (arr - mean)
+    return np.clip(out, 0, 255 if arr.max() > 1 else 1.0)
+
+
+def adjust_saturation(img, factor):
+    """Blend with the grayscale image: factor 0 = grayscale, 1 = original."""
+    arr = _as_np(img).astype(np.float32)
+    gray = to_grayscale(arr, num_output_channels=3) if arr.ndim == 3 \
+        else arr
+    out = gray + factor * (arr - gray)
+    return np.clip(out, 0, 255 if arr.max() > 1 else 1.0)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by ``hue_factor`` (in [-0.5, 0.5]) via HSV conversion."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _as_np(img).astype(np.float32)
+    high = arr.max() > 1
+    x = arr / 255.0 if high else arr
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = x.max(-1)
+    mn = x.min(-1)
+    d = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = (((g - b) / d) % 6)[m]
+    m = mx == g
+    h[m] = ((b - r) / d + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / d + 4)[m]
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, d / (mx + 1e-12), 0.0)
+    v = mx
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    out = np.zeros_like(x)
+    for idx, (rr, gg, bb) in enumerate(
+            [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+             (v, p, q)]):
+        m = i == idx
+        out[..., 0][m] = rr[m]
+        out[..., 1][m] = gg[m]
+        out[..., 2][m] = bb[m]
+    return out * 255.0 if high else out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_np(img).astype(np.float32)
+    gray = arr @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if arr.ndim == 3 else arr
+    if num_output_channels == 3:
+        gray = np.stack([gray] * 3, axis=-1)
+    elif arr.ndim == 3:
+        gray = gray[..., None]
+    return gray

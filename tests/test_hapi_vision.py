@@ -1,0 +1,191 @@
+"""hapi Model / metric / vision / distribution tests (SURVEY.md §2.7
+parity rows; assertion style follows test/legacy_test/test_model.py and
+test_metrics.py in the reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import distribution as D
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import FakeData
+
+RNG = np.random.default_rng(5)
+
+
+class Blobs(Dataset):
+    def __init__(self, n=192, labeled=True):
+        rng = np.random.default_rng(0)
+        centers = rng.normal(size=(4, 8)) * 3
+        self.y = rng.integers(0, 4, size=n)
+        self.x = (centers[self.y]
+                  + rng.normal(size=(n, 8))).astype("float32")
+        self.y = self.y.astype("int64")
+        self.labeled = labeled
+
+    def __getitem__(self, i):
+        return (self.x[i], self.y[i]) if self.labeled else self.x[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TestHapiModel:
+    def _fit(self, **kw):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=opt.Adam(learning_rate=1e-2,
+                               parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        model.fit(Blobs(), epochs=3, batch_size=64, verbose=0, **kw)
+        return model
+
+    def test_fit_evaluate_predict(self):
+        model = self._fit()
+        res = model.evaluate(Blobs(), batch_size=64, verbose=0)
+        assert res["acc"] > 0.9, res
+        preds = model.predict(Blobs(64, labeled=False), batch_size=32,
+                              stack_outputs=True, verbose=0)
+        assert preds[0].shape == (64, 4)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = self._fit()
+        path = str(tmp_path / "ck")
+        model.save(path)
+        net2 = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        m2 = paddle.Model(net2)
+        m2.prepare(loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        m2.load(path)
+        r1 = model.evaluate(Blobs(), batch_size=64, verbose=0)
+        r2 = m2.evaluate(Blobs(), batch_size=64, verbose=0)
+        np.testing.assert_allclose(r1["loss"], r2["loss"], rtol=1e-6)
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 4))
+        model = paddle.Model(net)
+        # lr=0: loss can never improve, so patience=0 stops at epoch 2
+        model.prepare(optimizer=opt.Adam(learning_rate=0.0,
+                                         parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=0)
+        model.fit(Blobs(), eval_data=Blobs(), epochs=50, batch_size=64,
+                  verbose=0, callbacks=[es])
+        assert model.stop_training
+
+    def test_summary_counts(self):
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        info = paddle.summary(net)
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9, 0], [0.8, 0.1, 0.1]], "float32")
+        label = np.array([1, 2], "int64")
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.5) < 1e-6
+        assert abs(top2 - 0.5) < 1e-6   # sample2: label 2 not in top2
+
+    def test_precision_recall(self):
+        p = Precision()
+        r = Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7], "float32")
+        labels = np.array([1, 0, 1, 1], "int64")
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        auc = Auc()
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8],
+                          [0.1, 0.9]], "float32")
+        labels = np.array([0, 0, 1, 1])
+        auc.update(preds, labels)
+        assert abs(auc.accumulate() - 1.0) < 1e-6
+
+
+class TestVision:
+    def test_transform_pipeline(self):
+        t = transforms.Compose([
+            transforms.Resize(40), transforms.RandomCrop(32),
+            transforms.RandomHorizontalFlip(),
+            transforms.Normalize(mean=[0.5] * 3, std=[0.5] * 3,
+                                 data_format="HWC"),
+            transforms.Transpose()])
+        img = RNG.integers(0, 255, (48, 64, 3)).astype("uint8")
+        assert t(img).shape == (3, 32, 32)
+
+    def test_resize_bilinear_matches_scale(self):
+        from paddle_tpu.vision.transforms import functional as VF
+        img = np.arange(16, dtype="float32").reshape(4, 4)
+        out = VF.resize(img, (2, 2))
+        assert out.shape == (2, 2)
+        assert out[0, 0] < out[1, 1]
+
+    @pytest.mark.parametrize("builder,inshape,classes", [
+        (lambda: models.LeNet(), (2, 1, 28, 28), 10),
+        (lambda: models.resnet18(num_classes=10), (2, 3, 32, 32), 10),
+        (lambda: models.mobilenet_v2(num_classes=5), (2, 3, 32, 32), 5),
+    ])
+    def test_model_forward_shapes(self, builder, inshape, classes):
+        net = builder()
+        x = paddle.to_tensor(RNG.normal(size=inshape).astype("float32"))
+        assert net(x).shape == [inshape[0], classes]
+
+    def test_lenet_trains_on_fakedata(self):
+        paddle.seed(0)
+        net = models.LeNet()
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=opt.Adam(learning_rate=1e-3,
+                               parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        data = FakeData(size=64, image_shape=(1, 28, 28), num_classes=10)
+        model.fit(data, epochs=1, batch_size=32, verbose=0)
+
+    def test_pretrained_raises(self):
+        with pytest.raises(NotImplementedError):
+            models.resnet18(pretrained=True)
+
+
+class TestDistribution:
+    def test_normal_moments_and_logprob(self):
+        paddle.seed(0)
+        n = D.Normal(0.0, 1.0)
+        s = n.sample([20000]).numpy()
+        assert abs(s.mean()) < 0.05 and abs(s.std() - 1) < 0.05
+        assert abs(float(n.log_prob(0.0)) + 0.9189385) < 1e-5
+
+    def test_kl_closed_forms(self):
+        kl = float(D.kl_divergence(D.Normal(0., 1.), D.Normal(0., 1.)))
+        assert abs(kl) < 1e-6
+        kl2 = float(D.kl_divergence(D.Normal(0., 1.), D.Normal(1., 2.)))
+        assert abs(kl2 - (np.log(2) + (1 + 1) / 8 - 0.5)) < 1e-5
+
+    def test_categorical(self):
+        c = D.Categorical(logits=np.zeros(4, "float32"))
+        assert abs(float(c.entropy()) - np.log(4)) < 1e-5
+        lp = c.log_prob(np.array([0, 3]))
+        np.testing.assert_allclose(lp.numpy(), np.log(0.25), rtol=1e-5)
+
+    def test_sampling_statistics(self):
+        paddle.seed(3)
+        g = D.Gamma(2.0, 4.0)
+        assert abs(float(g.sample([20000]).numpy().mean()) - 0.5) < 0.02
+        b = D.Bernoulli(probs=0.3)
+        assert abs(float(b.sample([20000]).numpy().mean()) - 0.3) < 0.02
+
+    def test_multinomial_counts(self):
+        m = D.Multinomial(10, np.array([0.2, 0.3, 0.5], "float32"))
+        s = m.sample([50]).numpy()
+        assert (s.sum(-1) == 10).all()
