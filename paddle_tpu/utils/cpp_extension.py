@@ -1,0 +1,84 @@
+"""paddle.utils.cpp_extension parity — JIT-compile C++ into the process.
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py (setup:79,
+load:795) + extension_utils.py. TPU-native notes: no CUDA/nvcc branch —
+extensions are host-side C++ (runtime helpers, custom host ops, IO); the
+device compute path is XLA/Pallas. Bindings are C-ABI + ctypes (no
+pybind11 in this environment, per the build constraints), so extension
+sources export ``extern "C"`` symbols.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+__all__ = ["load", "get_build_directory", "CppExtension", "setup"]
+
+_DEFAULT_CFLAGS = ["-O2", "-fPIC", "-std=c++17", "-shared", "-pthread"]
+
+
+def get_build_directory() -> str:
+    root = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _source_digest(sources: Sequence[str], cflags: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(cflags).encode())
+    return h.hexdigest()[:16]
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_flags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> ctypes.CDLL:
+    """Compile ``sources`` into a shared library and dlopen it (reference
+    cpp_extension.load:795 — same contract: returns the loaded module,
+    recompiles only when sources change)."""
+    sources = [os.path.abspath(s) for s in sources]
+    cflags = _DEFAULT_CFLAGS + list(extra_cxx_flags or [])
+    ldflags = list(extra_ldflags or [])
+    build_dir = build_directory or get_build_directory()
+    digest = _source_digest(sources, cflags + ldflags)
+    so_path = os.path.join(build_dir, f"{name}-{digest}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", *cflags, *sources, "-o", so_path, *ldflags]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd), file=sys.stderr)
+        try:
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            raise RuntimeError(
+                f"compiling extension '{name}' failed: {e}") from e
+    return ctypes.CDLL(so_path)
+
+
+class CppExtension:
+    """setup()-style extension description (reference CppExtension)."""
+
+    def __init__(self, sources, extra_compile_args=None, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.kwargs = kwargs
+
+
+def setup(name: str, ext_modules=None, **kwargs):
+    """Minimal setup() parity: eagerly builds each CppExtension into the
+    extension cache (the reference drives setuptools; here artifacts are
+    plain .so files loaded with ctypes)."""
+    exts = ext_modules or []
+    if isinstance(exts, CppExtension):
+        exts = [exts]
+    return [load(f"{name}_{i}", e.sources,
+                 extra_cxx_flags=e.extra_compile_args)
+            for i, e in enumerate(exts)]
