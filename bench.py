@@ -5,6 +5,14 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 Metric: training tokens/sec on a Llama block stack sized to fit the chip,
 plus model FLOPs utilisation (MFU) computed from the 6*N*tokens estimate.
 vs_baseline is MFU / 0.40 (BASELINE.json north star: >=40% MFU).
+
+Hardened against shared-TPU backend flakes: backend init is probed with
+retries, and any failure still emits a parseable JSON line (value 0 +
+error detail) instead of a stack dump. Param/optimizer init runs inside a
+single jitted program (no eager op-by-op device traffic). The run records
+whether the Pallas flash-attention kernel actually engaged at the bench
+shapes (kernels.dispatch_stats) and flags a fallback in the JSON output so
+a silent fallback can't quietly cost MFU unnoticed.
 """
 import json
 import sys
@@ -13,16 +21,47 @@ import time
 import numpy as np
 
 
+def _emit(payload):
+    print(json.dumps(payload))
+
+
+def _fail(metric, msg):
+    _emit({"metric": metric, "value": 0.0, "unit": "tokens/s",
+           "vs_baseline": 0.0, "error": msg[-2000:]})
+
+
+def _probe_backend(retries=3, delay=10.0):
+    """Initialize the jax backend with retries (shared-TPU tunnel can be
+    transiently unavailable). Returns the first device."""
+    import jax
+    last = None
+    for i in range(retries):
+        try:
+            return jax.devices()[0]
+        except Exception as e:  # backend init failure
+            last = e
+            time.sleep(delay * (i + 1))
+    raise RuntimeError(f"backend init failed after {retries} tries: {last}")
+
+
 def main():
+    metric = "llama_train_tokens_per_sec_per_chip"
     if "--smoke" in sys.argv:
         # CPU smoke: don't claim the shared TPU chip.
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
+
+    try:
+        dev = _probe_backend()
+    except Exception as e:
+        _fail(metric, f"{type(e).__name__}: {e}")
+        return
+
+    from paddle_tpu import kernels
     from paddle_tpu.models import llama as L
 
-    dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
     # Single-chip benchmark config: a 4-layer 8B-shaped slice on TPU
     # (fits one chip's HBM with remat), tiny on CPU fallback.
@@ -33,39 +72,62 @@ def main():
         cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
         batch, seq, iters = 4, 128, 5
 
-    params = L.init_params(cfg, jax.random.PRNGKey(0))
-    opt_state = L.adamw_init(params)
-    step = L.make_train_step(cfg, lr=1e-4)
-    ids = jnp.asarray(np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+    try:
+        # One jitted program builds params + opt state directly on device.
+        @jax.jit
+        def init():
+            p = L.init_params(cfg, jax.random.PRNGKey(0))
+            return p, L.adamw_init(p)
 
-    # warmup/compile
-    params, opt_state, loss = step(params, opt_state, ids)
-    jax.block_until_ready(loss)
+        params, opt_state = init()
+        jax.block_until_ready(params["embed"])
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
+        step = L.make_train_step(cfg, lr=1e-4)
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+
+        # warmup/compile — and record which attention kernel got traced in
+        kernels.reset_dispatch_stats()
         params, opt_state, loss = step(params, opt_state, ids)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        jax.block_until_ready(loss)
+        stats = kernels.dispatch_stats()
+        flash_missed = on_tpu and stats["flash"] == 0
+        if flash_missed:
+            # Fast path missed: still bench, but flag it in the JSON line
+            # (not just stderr) so the record shows the degraded path.
+            sys.stderr.write(
+                f"WARNING: pallas flash kernel did not engage: {stats}\n")
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, ids)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    except Exception as e:
+        _fail(metric, f"{type(e).__name__}: {e}")
+        return
 
     tokens = batch * seq * iters
     tps = tokens / dt
-    # 6ND (fwd+bwd) + remat fwd (~2ND more) -> use 6ND for standard MFU
+    # 6ND (fwd+bwd) -> standard MFU (remat recompute not credited)
     n_params = L.count_params(cfg)
     flops_per_token = 6 * n_params
     peak = 459e12 if on_tpu else 1e12   # v5p bf16 peak; CPU nominal
     mfu = tps * flops_per_token / peak
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
+    payload = {
+        "metric": metric,
         "value": round(tps, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"mfu": round(mfu, 4), "params": n_params,
                   "platform": dev.platform, "batch": batch, "seq": seq,
                   "layers": cfg.num_hidden_layers,
+                  "flash_dispatch": stats,
                   "loss": float(loss)},
-    }))
+    }
+    if flash_missed:
+        payload["warning"] = "pallas flash kernel did not engage (XLA fallback)"
+    _emit(payload)
 
 
 if __name__ == "__main__":

@@ -26,7 +26,25 @@ flash_attention = _fa.flash_attention
 fused_rms_norm = _rn.rms_norm
 
 __all__ = ["flash_attention", "fused_rms_norm", "ring_attention",
-           "register", "unregister"]
+           "register", "unregister", "dispatch_stats", "reset_dispatch_stats"]
+
+# Trace-time dispatch counters (reference capability: the KernelFactory's
+# selected-kernel visibility / FLAGS_enable_api_kernel_fallback logging,
+# kernel_factory.cc:230). Incremented when the dispatcher traces the pallas
+# kernel vs the XLA fallback into a program — lets benchmarks *assert* the
+# fast path actually engaged at their shapes instead of silently falling
+# back (a silent `supported()` miss would quietly cost MFU).
+_DISPATCH_STATS = {"flash": 0, "flash_fallback": 0,
+                   "rms": 0, "rms_fallback": 0}
+
+
+def dispatch_stats() -> dict:
+    return dict(_DISPATCH_STATS)
+
+
+def reset_dispatch_stats() -> None:
+    for k in _DISPATCH_STATS:
+        _DISPATCH_STATS[k] = 0
 
 
 def _on_tpu() -> bool:
@@ -37,7 +55,9 @@ def _make_flash_dispatch(tpu_only: bool):
     def dispatch(q, k, v, *, causal=False, scale=None):
         from ..nn.functional import attention as _att
         if (tpu_only and not _on_tpu()) or not _fa.supported(q, k, v):
+            _DISPATCH_STATS["flash_fallback"] += 1
             return _att.sdpa_reference(q, k, v, causal=causal, scale=scale)
+        _DISPATCH_STATS["flash"] += 1
         return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
     return dispatch
 
@@ -48,10 +68,12 @@ def _make_rms_dispatch(tpu_only: bool):
         if ((tpu_only and not _on_tpu())
                 or w.ndim != 1 or w.shape[0] != x.shape[-1]):
             # XLA path (same math as nn.functional.norm.rms_norm body)
+            _DISPATCH_STATS["rms_fallback"] += 1
             xf = x.astype(jnp.float32)
             r = jax.lax.rsqrt(
                 jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
             return ((xf * r).astype(x.dtype) * w).astype(out_dtype)
+        _DISPATCH_STATS["rms"] += 1
         return _rn.rms_norm(x, w, eps).astype(out_dtype)
     return dispatch
 

@@ -62,6 +62,10 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16       # params/activations dtype (MXU-friendly)
     remat: bool = True              # per-layer rematerialisation
+    # remat policy: "full" recomputes everything (min HBM); "dots" saves
+    # non-batch matmul outputs (reference recompute's selective checkpointing
+    # — fewer recomputed FLOPs, higher MFU, modest extra HBM).
+    remat_policy: str = "dots"
 
     @property
     def head_dim(self) -> int:
@@ -189,7 +193,12 @@ def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
         return _block(carry, lp, cos, sin, c, sp, mesh), None
 
     if c.remat:
-        step = jax.checkpoint(step, prevent_cse=False)
+        if c.remat_policy not in ("dots", "full"):
+            raise ValueError(
+                f"remat_policy must be 'dots' or 'full', got {c.remat_policy!r}")
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if c.remat_policy == "dots" else None)
+        step = jax.checkpoint(step, prevent_cse=False, policy=policy)
     x, _ = lax.scan(step, x, params["layers"])
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
     head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
