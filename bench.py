@@ -15,10 +15,24 @@ shapes (kernels.dispatch_stats) and flags a fallback in the JSON output so
 a silent fallback can't quietly cost MFU unnoticed.
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _peak_flops(dev) -> float:
+    """bf16 peak FLOP/s per chip by TPU generation (device_kind, or the
+    axon tunnel's PALLAS_AXON_TPU_GEN env)."""
+    table = {"v6e": 918e12, "v5p": 459e12, "v5e": 197e12,
+             "v4": 275e12, "v3": 123e12}
+    kind = (dev.device_kind or "").lower().replace(" ", "")
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for k, v in table.items():
+        if k in kind or k in gen:
+            return v
+    return 459e12   # assume v5p (BASELINE.json north-star hardware)
 
 
 def _emit(payload):
@@ -30,37 +44,50 @@ def _fail(metric, msg):
            "vs_baseline": 0.0, "error": msg[-2000:]})
 
 
-def _probe_backend(retries=3, delay=10.0):
+def _probe_backend(retries=3, delay=10.0, hang_timeout=180):
     """Initialize the jax backend with retries (shared-TPU tunnel can be
-    transiently unavailable). Returns the first device."""
+    transiently unavailable). A SIGALRM watchdog converts an init *hang*
+    (observed failure mode of the tunnel) into an exception so the caller
+    can still emit the JSON error line. Returns the first device."""
+    import signal
+
     import jax
+
     last = None
     for i in range(retries):
+        def _alarm(signum, frame):
+            raise TimeoutError(
+                f"backend init hang (> {hang_timeout}s)")
+
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(hang_timeout)
         try:
             return jax.devices()[0]
-        except Exception as e:  # backend init failure
+        except Exception as e:  # init failure OR watchdog timeout
             last = e
             time.sleep(delay * (i + 1))
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
     raise RuntimeError(f"backend init failed after {retries} tries: {last}")
 
 
 def main():
     metric = "llama_train_tokens_per_sec_per_chip"
-    if "--smoke" in sys.argv:
-        # CPU smoke: don't claim the shared TPU chip.
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    import jax
-    import jax.numpy as jnp
-
     try:
+        if "--smoke" in sys.argv:
+            # CPU smoke: don't claim the shared TPU chip.
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        import jax
+        import jax.numpy as jnp
+
         dev = _probe_backend()
+        from paddle_tpu import kernels
+        from paddle_tpu.models import llama as L
     except Exception as e:
         _fail(metric, f"{type(e).__name__}: {e}")
         return
-
-    from paddle_tpu import kernels
-    from paddle_tpu.models import llama as L
 
     on_tpu = dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
     # Single-chip benchmark config: a 4-layer 8B-shaped slice on TPU
@@ -112,7 +139,7 @@ def main():
     # 6ND (fwd+bwd) -> standard MFU (remat recompute not credited)
     n_params = L.count_params(cfg)
     flops_per_token = 6 * n_params
-    peak = 459e12 if on_tpu else 1e12   # v5p bf16 peak; CPU nominal
+    peak = _peak_flops(dev) if on_tpu else 1e12   # CPU nominal
     mfu = tps * flops_per_token / peak
     payload = {
         "metric": metric,

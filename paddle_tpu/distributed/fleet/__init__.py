@@ -10,6 +10,8 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa
                         RowParallelLinear, VocabParallelEmbedding)
 from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,  # noqa
                         SharedLayerDesc)
+from . import pipeline_schedules  # noqa
+from .pipeline_runtime import PipelineParallel  # noqa
 from . import sequence_parallel_utils  # noqa
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa
 

@@ -1,0 +1,232 @@
+"""Pipeline schedules: FThenB (GPipe), 1F1B, interleaved VPP, zero-bubble.
+
+Reference capability:
+- 1F1B: fleet/meta_parallel/pipeline_parallel.py:459 forward_backward_pipeline
+- interleaved VPP: pipeline_parallel.py:1008 PipelineParallelWithInterleave
+- zero-bubble: distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:37-106
+  (splits backward into an input-grad job ``backward_b`` and a weight-grad
+  job ``backward_w`` so weight grads fill the cooldown bubble)
+
+TPU-native design: a schedule here is DATA — an ordered list of typed
+actions per pipeline stage — consumed by the host-driven stage runtime
+(pipeline_runtime.PipelineParallel), which executes each action as a cached
+jitted stage program. This mirrors the reference's *static* scheduling
+design (typed Job lists in a core.Plan run by StandaloneExecutor,
+new_executor/interpreter/plan.h) rather than its dygraph hand-coded loops:
+on TPU every unit of work should be a compiled program, and the schedule
+should be an inspectable artifact.
+
+Action kinds:
+  F  — forward of one micro-batch through one stage-chunk
+  B  — full backward (input grad + weight grad together)
+  BI — backward input-grad only   (zero-bubble)
+  BW — backward weight-grad only  (zero-bubble)
+
+Positions: with virtual-pipeline chunks, stage ``s`` of ``S`` holds chunks
+``c`` in 0..v-1; the model is cut into ``S*v`` parts and part index
+``p = c*S + s`` (Megatron/reference assignment: consecutive model parts
+round-robin over stages).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+__all__ = ["Action", "build_schedule", "fthenb", "one_f_one_b",
+           "interleaved_1f1b", "zero_bubble_h1", "validate_schedule",
+           "peak_live_activations"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    kind: str       # F | B | BI | BW
+    chunk: int      # virtual-pipeline chunk on this stage (0 if v == 1)
+    micro: int      # micro-batch id
+
+    def __repr__(self):
+        return f"{self.kind}{self.chunk}.{self.micro}"
+
+
+Schedule = List[List[Action]]   # [stage][ordered actions]
+
+
+def fthenb(num_stages: int, num_micro: int) -> Schedule:
+    """GPipe: all forwards, then all backwards (reverse order)."""
+    sched = []
+    for _s in range(num_stages):
+        acts = [Action("F", 0, m) for m in range(num_micro)]
+        acts += [Action("B", 0, m) for m in reversed(range(num_micro))]
+        sched.append(acts)
+    return sched
+
+
+def one_f_one_b(num_stages: int, num_micro: int) -> Schedule:
+    """1F1B (reference pipeline_parallel.py:459): per stage, a warmup of
+    ``S - s - 1`` forwards, then steady-state alternating F/B, then a
+    cooldown of the remaining backwards. Bounds live activations per stage
+    to ``S - s`` instead of GPipe's ``num_micro``."""
+    sched = []
+    for s in range(num_stages):
+        warmup = min(num_stages - s - 1, num_micro)
+        acts: List[Action] = []
+        f = b = 0
+        for _ in range(warmup):
+            acts.append(Action("F", 0, f)); f += 1
+        while f < num_micro:
+            acts.append(Action("F", 0, f)); f += 1
+            acts.append(Action("B", 0, b)); b += 1
+        while b < num_micro:
+            acts.append(Action("B", 0, b)); b += 1
+        sched.append(acts)
+    return sched
+
+
+def _vpp_chunk_micro(k: int, S: int, v: int) -> Tuple[int, int]:
+    """Map iteration index -> (chunk, micro) for the interleaved schedule.
+
+    Micro-batches advance in groups of S; within a group the same S micros
+    pass through every chunk before the next group starts (the reference's
+    get_model_chunk_id logic in PipelineParallelWithInterleave)."""
+    kg = k % (S * v)
+    chunk = kg // S
+    group = k // (S * v)
+    micro = group * S + (kg % S)
+    return chunk, micro
+
+
+def interleaved_1f1b(num_stages: int, num_micro: int,
+                     num_chunks: int) -> Schedule:
+    """Interleaved virtual-pipeline 1F1B (reference
+    pipeline_parallel.py:1008). Each stage runs ``num_chunks`` model chunks;
+    requires num_micro % num_stages == 0 (reference asserts the same)."""
+    S, v = num_stages, num_chunks
+    if v < 2:
+        return one_f_one_b(num_stages, num_micro)
+    if num_micro % S != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_micro ({num_micro}) to be "
+            f"a multiple of num_stages ({S})")
+    total = num_micro * v
+    sched = []
+    for s in range(S):
+        warmup = min((S - s - 1) * 2 + (v - 1) * S, total)
+        acts: List[Action] = []
+        for k in range(warmup):
+            c, m = _vpp_chunk_micro(k, S, v)
+            acts.append(Action("F", c, m))
+        for k in range(warmup, total):
+            c, m = _vpp_chunk_micro(k, S, v)
+            acts.append(Action("F", c, m))
+            cb, mb = _vpp_chunk_micro(k - warmup, S, v)
+            acts.append(Action("B", v - 1 - cb, mb))
+        for k in range(total - warmup, total):
+            cb, mb = _vpp_chunk_micro(k, S, v)
+            acts.append(Action("B", v - 1 - cb, mb))
+        sched.append(acts)
+    return sched
+
+
+def zero_bubble_h1(num_stages: int, num_micro: int) -> Schedule:
+    """Zero-bubble ZB-H1 (reference pipeline_zero_bubble.py:37): 1F1B with
+    the backward split into BI (input grad — on the critical path to the
+    previous stage) and BW (weight grad — free to slide later). Each stage
+    defers ``S - s - 1`` weight-grad jobs into its cooldown bubble, so the
+    cooldown does useful work instead of idling. Peak stashed-input count
+    rises to ~2*(S-s)-1 vs 1F1B's S-s (the BW job pins its stage input
+    until it runs) — the H1 memory/bubble trade, asserted by
+    tests/test_pipeline_schedules.py::test_memory_bounds.
+    """
+    S = num_stages
+    sched = []
+    for s in range(S):
+        defer = min(S - s - 1, num_micro)
+        warmup = min(S - s - 1, num_micro)
+        acts: List[Action] = []
+        f = bi = bw = 0
+        for _ in range(warmup):
+            acts.append(Action("F", 0, f)); f += 1
+        while f < num_micro:
+            acts.append(Action("F", 0, f)); f += 1
+            acts.append(Action("BI", 0, bi)); bi += 1
+            if bi - bw > defer:
+                acts.append(Action("BW", 0, bw)); bw += 1
+        while bi < num_micro:
+            # cooldown: incoming BIs arrive one pipeline-cycle apart, leaving
+            # slack for deferred W jobs in the gap BEFORE each next BI — this
+            # is what makes the bubble "zero": W fills the idle wait instead
+            # of trailing after the last BI
+            for _ in range(2):
+                if bw < bi:
+                    acts.append(Action("BW", 0, bw)); bw += 1
+            acts.append(Action("BI", 0, bi)); bi += 1
+        while bw < num_micro:
+            acts.append(Action("BW", 0, bw)); bw += 1
+        sched.append(acts)
+    return sched
+
+
+_BUILDERS = {
+    "FThenB": lambda S, M, v: fthenb(S, M),
+    "1F1B": lambda S, M, v: one_f_one_b(S, M),
+    "1F1B-Interleave": lambda S, M, v: interleaved_1f1b(S, M, v),
+    "ZBH1": lambda S, M, v: zero_bubble_h1(S, M),
+}
+
+
+def build_schedule(name: str, num_stages: int, num_micro: int,
+                   num_chunks: int = 1) -> Schedule:
+    if name not in _BUILDERS:
+        raise ValueError(
+            f"unknown schedule {name!r}; one of {sorted(_BUILDERS)}")
+    return _BUILDERS[name](num_stages, num_micro, num_chunks)
+
+
+# ---------------------------------------------------------------------------
+# Static analysis (used by tests and by the runtime's deadlock check)
+# ---------------------------------------------------------------------------
+
+def validate_schedule(sched: Schedule, num_micro: int,
+                      num_chunks: int = 1) -> None:
+    """Check completeness + per-stage ordering constraints:
+    every (chunk, micro) has exactly one F and one B (or BI+BW); BI before
+    BW for the same unit; B/BI of a unit after its F on the same stage."""
+    S = len(sched)
+    for s, acts in enumerate(sched):
+        seen: Dict[Tuple[str, int, int], int] = {}
+        for i, a in enumerate(acts):
+            key = (a.kind, a.chunk, a.micro)
+            if key in seen:
+                raise AssertionError(f"stage {s}: duplicate {a}")
+            seen[key] = i
+        for c in range(num_chunks):
+            for m in range(num_micro):
+                fi = seen.get(("F", c, m))
+                if fi is None:
+                    raise AssertionError(f"stage {s}: missing F{c}.{m}")
+                if ("B", c, m) in seen:
+                    if seen[("B", c, m)] < fi:
+                        raise AssertionError(f"stage {s}: B{c}.{m} before F")
+                else:
+                    bi = seen.get(("BI", c, m))
+                    bw = seen.get(("BW", c, m))
+                    if bi is None or bw is None:
+                        raise AssertionError(
+                            f"stage {s}: missing backward for {c}.{m}")
+                    if not (fi < bi < bw):
+                        raise AssertionError(
+                            f"stage {s}: bad BI/BW order for {c}.{m}")
+
+
+def peak_live_activations(acts: List[Action]) -> int:
+    """Max number of forward activations held before their backward frees
+    them (the schedule's per-stage memory high-water mark; BW frees nothing
+    — the weight-grad job keeps the stashed input until it runs)."""
+    live = 0
+    peak = 0
+    for a in acts:
+        if a.kind == "F":
+            live += 1
+            peak = max(peak, live)
+        elif a.kind in ("B", "BW"):
+            live -= 1
+    return peak

@@ -13,9 +13,12 @@ mesh axis (SURVEY.md §7 "PP" row): every device holds one stage's weights
 injects a fresh micro-batch each tick; the last stage emits into the output
 buffer. Differentiating the scanned program yields the reversed pipeline
 (backward micro-batch schedule) automatically — GPipe semantics with
-per-stage rematerialisation bounding activation memory. Interleaved (VPP)
-runs `v` chunks per device by scanning the schedule `v` times with a
-circular shift between rounds.
+per-stage rematerialisation bounding activation memory.
+
+This module is the fully-compiled homogeneous-stage pipeline. The general
+schedule family — 1F1B, interleaved VPP, zero-bubble, heterogeneous
+embedding/head stages — lives in fleet/pipeline_schedules.py (schedules as
+data) + fleet/pipeline_runtime.py (the stage-program interpreter).
 """
 from __future__ import annotations
 

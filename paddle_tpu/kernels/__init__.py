@@ -48,7 +48,14 @@ def reset_dispatch_stats() -> None:
 
 
 def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
+    # "axon" is the shared-TPU tunnel backend (a real TPU chip behind a
+    # remote-compile proxy) — pallas lowers there too.
+    if jax.default_backend() in ("tpu", "axon"):
+        return True
+    try:
+        return "TPU" in (jax.devices()[0].device_kind or "")
+    except Exception:
+        return False
 
 
 def _make_flash_dispatch(tpu_only: bool):

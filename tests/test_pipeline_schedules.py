@@ -1,0 +1,228 @@
+"""Pipeline schedule family tests: validity, memory bounds, makespan, and
+loss/grad parity vs single-stage on heterogeneous stages.
+
+Mirrors the reference's hybrid_parallel_pp_* loss-parity discipline
+(test/collective/fleet/, SURVEY.md §4) realized single-process.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.distributed.fleet.pipeline_schedules import (
+    Action, build_schedule, fthenb, interleaved_1f1b, one_f_one_b,
+    peak_live_activations, validate_schedule, zero_bubble_h1)
+from paddle_tpu.distributed.fleet.pp_layers import PipelineLayer, LayerDesc
+from paddle_tpu.distributed.fleet.pipeline_runtime import PipelineParallel
+
+
+# ---------------------------------------------------------------------------
+# schedule statics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("S,M", [(2, 2), (2, 6), (4, 4), (4, 8), (3, 5)])
+@pytest.mark.parametrize("name", ["FThenB", "1F1B", "ZBH1"])
+def test_schedule_valid(name, S, M):
+    validate_schedule(build_schedule(name, S, M), M)
+
+
+@pytest.mark.parametrize("S,M,v", [(2, 2, 2), (2, 4, 2), (4, 4, 2),
+                                   (2, 4, 3), (4, 8, 2)])
+def test_interleaved_valid(S, M, v):
+    validate_schedule(build_schedule("1F1B-Interleave", S, M, v), M, v)
+
+
+def test_interleaved_requires_multiple():
+    with pytest.raises(ValueError):
+        interleaved_1f1b(4, 6, 2)
+
+
+def test_memory_bounds():
+    S, M = 4, 8
+    gp = fthenb(S, M)
+    fb = one_f_one_b(S, M)
+    zb = zero_bubble_h1(S, M)
+    for s in range(S):
+        assert peak_live_activations(gp[s]) == M
+        assert peak_live_activations(fb[s]) <= min(S - s, M)
+        assert peak_live_activations(zb[s]) <= min(2 * (S - s), M)
+
+
+def _makespan(sched, costs):
+    """Tick simulation: each stage executes its next action when its data
+    dependency is satisfied (produced at an earlier finish time)."""
+    S = len(sched)
+    # dependency products: F(p,m) -> y; B/BI(p,m) -> dx
+    finish = {}
+    ptr = [0] * S
+    t_free = [0] * S
+    P_total = S  # v=1 only
+
+    def dep_time(s, a):
+        p = a.chunk * S + s
+        if a.kind == "F":
+            return 0 if p == 0 else finish.get(("y", p - 1, a.micro))
+        if a.kind in ("B", "BI"):
+            if p == P_total - 1:
+                return finish.get(("y", p, a.micro))
+            return finish.get(("dx", p + 1, a.micro))
+        return finish.get(("bi", p, a.micro))   # BW after BI
+
+    done = 0
+    total = sum(len(x) for x in sched)
+    while done < total:
+        progressed = False
+        for s in range(S):
+            if ptr[s] >= len(sched[s]):
+                continue
+            a = sched[s][ptr[s]]
+            d = dep_time(s, a)
+            if d is None:
+                continue
+            start = max(t_free[s], d)
+            end = start + costs[a.kind]
+            t_free[s] = end
+            p = a.chunk * S + s
+            if a.kind == "F":
+                finish[("y", p, a.micro)] = end
+            elif a.kind == "B":
+                finish[("dx", p, a.micro)] = end
+            elif a.kind == "BI":
+                finish[("dx", p, a.micro)] = end
+                finish[("bi", p, a.micro)] = end
+            ptr[s] += 1
+            done += 1
+            progressed = True
+        assert progressed, "schedule deadlocked in simulation"
+    return max(t_free)
+
+
+@pytest.mark.parametrize("S,M", [(3, 6), (4, 8), (4, 16)])
+def test_zero_bubble_beats_1f1b(S, M):
+    # F=1 tick; full B = BI+BW = 2 ticks; split jobs 1 tick each.
+    costs = {"F": 1, "B": 2, "BI": 1, "BW": 1}
+    t_1f1b = _makespan(one_f_one_b(S, M), costs)
+    t_zb = _makespan(zero_bubble_h1(S, M), costs)
+    assert t_zb < t_1f1b
+
+
+# ---------------------------------------------------------------------------
+# loss/grad parity on heterogeneous stages (embedding -> blocks -> CE head)
+# ---------------------------------------------------------------------------
+
+VOCAB, DIM, CLS = 17, 16, 5
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(DIM, DIM)
+
+    def forward(self, x):
+        return F.tanh(self.fc(x))
+
+
+class Head(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(DIM, CLS)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def _ce(logits, labels):
+    # mean CE over all positions
+    return F.cross_entropy(logits.reshape([-1, CLS]),
+                           labels.reshape([-1])).mean()
+
+
+def _build_pipe(parts):
+    descs = [LayerDesc(nn.Embedding, VOCAB, DIM),
+             LayerDesc(Block), LayerDesc(Block), LayerDesc(Block),
+             LayerDesc(Block), LayerDesc(Block),
+             LayerDesc(Head)]
+    return PipelineLayer(descs, num_stages=parts, loss_fn=_ce)
+
+
+def _eager_reference(pipe, ids, labels):
+    """Single-stage: full forward + tape backward."""
+    for p in pipe.parameters():
+        p.clear_grad()
+    out = pipe(ids)
+    loss = _ce(out, labels)
+    loss.backward()
+    grads = {n: np.array(p.grad.numpy())
+             for n, p in pipe.named_parameters() if p.grad is not None}
+    for p in pipe.parameters():
+        p.clear_grad()
+    return float(loss.numpy()), grads
+
+
+def _run_schedule(pipe, ids, labels, schedule, num_stages, num_micro,
+                  devices=None):
+    pp = PipelineParallel(pipe, num_micro=num_micro, schedule=schedule,
+                          num_stages=num_stages, devices=devices)
+    loss = pp.forward_backward_pipeline(ids, labels)
+    grads = {n: np.array(p.grad.numpy())
+             for n, p in pipe.named_parameters() if p.grad is not None}
+    for p in pipe.parameters():
+        p.clear_grad()
+    return float(loss.numpy()), grads
+
+
+@pytest.mark.parametrize("schedule,num_stages", [
+    ("FThenB", 4), ("1F1B", 4), ("ZBH1", 4),
+    ("1F1B", 7),                      # one layer per stage, non-uniform
+    ("1F1B-Interleave", 2),           # 7 parts not divisible -> skip below
+])
+def test_pipeline_parity(schedule, num_stages):
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, VOCAB, (8, 6)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, CLS, (8, 6)).astype("int32"))
+
+    if schedule == "1F1B-Interleave":
+        parts = 4                     # 2 stages x 2 chunks
+    else:
+        parts = num_stages
+    pipe = _build_pipe(parts if parts != 7 else 7)
+    ref_loss, ref_grads = _eager_reference(pipe, ids, labels)
+
+    loss, grads = _run_schedule(pipe, ids, labels, schedule, num_stages,
+                                num_micro=4)
+    assert np.allclose(loss, ref_loss, rtol=1e-5, atol=1e-5)
+    assert set(grads) == set(ref_grads)
+    for n in ref_grads:
+        np.testing.assert_allclose(grads[n], ref_grads[n],
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_pipeline_parity_multi_device():
+    """Stages placed on distinct CPU devices — exercises the activation
+    transfer ('p2p') path."""
+    rng = np.random.default_rng(1)
+    ids = paddle.to_tensor(rng.integers(0, VOCAB, (8, 6)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, CLS, (8, 6)).astype("int32"))
+    pipe = _build_pipe(4)
+    ref_loss, ref_grads = _eager_reference(pipe, ids, labels)
+    loss, grads = _run_schedule(pipe, ids, labels, "1F1B", 4, num_micro=4,
+                                devices="auto")
+    assert np.allclose(loss, ref_loss, rtol=1e-5, atol=1e-5)
+    for n in ref_grads:
+        np.testing.assert_allclose(grads[n], ref_grads[n],
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_pipeline_train_batch_step():
+    """train_batch applies the optimizer and the loss goes down."""
+    rng = np.random.default_rng(2)
+    ids = paddle.to_tensor(rng.integers(0, VOCAB, (8, 6)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, CLS, (8, 6)).astype("int32"))
+    pipe = _build_pipe(4)
+    pp = PipelineParallel(pipe, num_micro=4, schedule="1F1B")
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=pipe.parameters())
+    losses = [float(pp.train_batch(ids, labels, opt).numpy())
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
