@@ -72,6 +72,55 @@ def _probe_backend(retries=3, delay=10.0, hang_timeout=180):
     raise RuntimeError(f"backend init failed after {retries} tries: {last}")
 
 
+def _preflight_kernels(on_tpu):
+    """Lower + run each Pallas kernel standalone (fwd AND bwd) at tiny
+    shapes before the timed loop. A kernel that fails de-registers itself
+    so the model traces the XLA fallback — a kernel bug costs MFU, never
+    the whole bench number (BENCH_r02 recorded 0.0 because a lowering
+    error inside the first train step killed everything)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import kernels
+
+    if not on_tpu:
+        return {}
+    failures = {}
+
+    def try_kernel(name, fn):
+        try:
+            jax.block_until_ready(fn())
+        except Exception as e:
+            failures[name] = f"{type(e).__name__}: {e}"[:500]
+
+    def flash_case():
+        q = jnp.ones((1, 256, 2, 128), jnp.bfloat16)
+
+        def loss(q):
+            return jnp.sum(kernels.flash_attention(
+                q, q, q, causal=True, interpret=False).astype(jnp.float32))
+        return jax.jit(jax.grad(loss))(q)
+
+    def rms_case():
+        x = jnp.ones((64, 1024), jnp.bfloat16)
+        w = jnp.ones((1024,), jnp.bfloat16)
+
+        def loss(x, w):
+            return jnp.sum(kernels.fused_rms_norm(
+                x, w, 1e-6, 64, False).astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1)))(x, w)
+
+    try_kernel("flash", flash_case)
+    try_kernel("rms", rms_case)
+    if failures:
+        sys.stderr.write(f"kernel preflight failures: {failures}\n")
+        # re-register only the kernels that survived preflight
+        kernels.unregister()
+        kernels.register(flash="flash" not in failures,
+                         rms="rms" not in failures, tpu_only=True)
+    return failures
+
+
 def main():
     metric = "llama_train_tokens_per_sec_per_chip"
     try:
@@ -98,6 +147,8 @@ def main():
     else:
         cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
         batch, seq, iters = 4, 128, 5
+
+    preflight = _preflight_kernels(on_tpu)
 
     try:
         # One jitted program builds params + opt state directly on device.
@@ -152,6 +203,8 @@ def main():
                   "flash_dispatch": stats,
                   "loss": float(loss)},
     }
+    if preflight:
+        payload["extra"]["kernel_preflight_failures"] = preflight
     if flash_missed:
         payload["warning"] = "pallas flash kernel did not engage (XLA fallback)"
     _emit(payload)

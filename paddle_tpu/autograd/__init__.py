@@ -4,6 +4,7 @@ Reference: paddle/fluid/eager/ + python/paddle/autograd/."""
 from __future__ import annotations
 
 from ..core.state import enable_grad, no_grad, set_grad_enabled  # noqa
+from .py_layer import PyLayer, PyLayerContext  # noqa
 from .tape import GradNode, record_node, run_backward  # noqa
 
 
@@ -18,28 +19,27 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
-    """paddle.grad parity (subset): grads of outputs w.r.t. inputs without
-    touching .grad. Implemented by running the tape and collecting into a
-    side buffer via temporary hooks.
+    """paddle.grad parity: grads of outputs w.r.t. inputs without touching
+    .grad. Implemented by running the tape and collecting into a side
+    buffer (sink mode).
 
-    Note: create_graph=True (higher-order eager grad) is not yet supported on
-    the eager tape; use the functional API (paddle_tpu.jit / jax.grad) for
-    higher-order derivatives.
+    ``create_graph=True`` runs every node's backward through the taped
+    dispatcher (tape._apply_node_taped) so the returned grads carry their
+    own grad graph and this function can be applied to them again —
+    verified against jax.grad(jax.grad(f)) in tests/test_autograd.py.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; use the "
-            "functional/jit path for higher-order gradients")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
     # Sink mode: no .grad is touched anywhere in the graph (reference:
     # general_grad.h computes grads w.r.t. selected inputs only).
     sink = {}
     wanted = {id(t): t for t in inputs}
     run_backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph),
-                 wanted=wanted, sink=sink)
+                 wanted=wanted, sink=sink, create_graph=create_graph)
     out = []
     from ..core.tensor import Tensor
     for t in inputs:
@@ -48,5 +48,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             raise RuntimeError(
                 "One of the differentiated tensors appears to not have "
                 "been used in the graph (set allow_unused=True to allow).")
-        out.append(Tensor(g) if g is not None else None)
+        if g is None:
+            out.append(None)
+        else:
+            out.append(g if isinstance(g, Tensor) else Tensor(g))
     return out

@@ -1,0 +1,111 @@
+"""PyLayer: user-defined differentiable operations on the eager tape.
+
+Reference capability: python/paddle/autograd/py_layer.py (PyLayer,
+PyLayerContext) and the eager binding paddle/fluid/pybind/eager_py_layer.cc.
+TPU-native redesign: forward runs under no_grad (its internal ops are not
+taped — the PyLayer node IS the grad graph for this region, the reference's
+semantics), and one GradNode is recorded whose backward calls the user's
+``backward`` staticmethod. Under ``create_graph`` the user backward runs
+with grad recording ON, so its ops tape and the produced grads are
+themselves differentiable (the reference's double-grad-through-PyLayer).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import state
+from ..core.tensor import Tensor
+from . import tape
+
+
+class PyLayerContext:
+    """Context passed to forward/backward (reference: PyLayerContext,
+    python/paddle/autograd/py_layer.py:30)."""
+
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+        self._not_inplace = False
+
+    def save_for_backward(self, *tensors):
+        """Stash tensors for the backward pass. Only for Tensors; anything
+        else can simply be stored as a ctx attribute."""
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+    def mark_not_inplace(self, *args):
+        self._not_inplace = True
+
+
+class PyLayer:
+    """Define a custom differentiable op by subclassing with static
+    ``forward(ctx, *args)`` and ``backward(ctx, *grads)`` methods, then
+    call ``.apply(*args)`` (reference: python/paddle/autograd/py_layer.py,
+    class PyLayer docs). ``backward`` must return one grad per Tensor
+    positional input of ``forward`` (None for unneeded ones)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement a forward staticmethod")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError(
+            "PyLayer subclasses must implement a backward staticmethod")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with state.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        # grads flow only to positional Tensor inputs (reference: tensors
+        # in kwargs do not receive grad — py_layer.py apply() docs).
+        # Routing is positional, not by identity — the same Tensor passed
+        # twice gets each slot's own grad (the tape then accumulates them).
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_pos = [i for i, t in enumerate(tensor_inputs)
+                    if not t.stop_gradient
+                    and jnp.issubdtype(t._data.dtype, jnp.inexact)]
+        diff_inputs = [tensor_inputs[i] for i in diff_pos]
+        if not state.grad_enabled() or not diff_inputs:
+            return outs
+
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        if not out_tensors:
+            return outs
+
+        def run_user_backward(cot_tensors, taped):
+            cm = state.enable_grad() if taped else state.no_grad()
+            with cm:
+                grads = cls.backward(ctx, *cot_tensors)
+            grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
+            if len(grads) != len(tensor_inputs):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"but forward received {len(tensor_inputs)} Tensor "
+                    "inputs — they must match one-to-one")
+            return [grads[i] for i in diff_pos]
+
+        def vjp_fn(cotangents):
+            cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            gs = run_user_backward([Tensor(c) for c in cots], taped=False)
+            return tuple(None if g is None
+                         else (g._data if isinstance(g, Tensor) else g)
+                         for g in gs)
+
+        node = tape.record_node(cls.__name__ + ".apply", vjp_fn,
+                                diff_inputs, out_tensors)
+        node.multi_out = len(out_tensors) > 1
+        node.tensor_grad = lambda cots: [
+            g if g is None or isinstance(g, Tensor) else Tensor(g)
+            for g in run_user_backward(list(cots), taped=True)]
+        return outs

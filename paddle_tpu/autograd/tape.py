@@ -24,7 +24,8 @@ class GradNode:
     paddle/fluid/eager/grad_node_info.h:197)."""
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs",
-                 "out_refs", "__weakref__")
+                 "out_refs", "pure_call", "pure_spec", "multi_out",
+                 "tensor_grad", "__weakref__")
 
     def __init__(self, name, vjp_fn, inputs, out_avals):
         self.name = name
@@ -36,6 +37,19 @@ class GradNode:
         self.n_outputs = len(out_avals)
         # weakrefs to output tensors, for hook application / retain_grads
         self.out_refs = []
+        # create_graph support (higher-order grad): either a pure fn over
+        # the diff inputs (pure_call) or a (fn, kwargs, diff_idx,
+        # nondiff_raw, n_args) spec to rebuild one (pure_spec, set by
+        # op_fn — avoids pinning raw inputs in a closure), re-differentiated
+        # through the dispatcher when the backward itself must be taped
+        # (reference: the generated double_grad op family; here one
+        # generic re-vjp serves all ops).
+        self.pure_call = None
+        self.pure_spec = None
+        self.multi_out = False
+        # PyLayer: a Tensor-level backward (user code) used for the taped
+        # (create_graph) path instead of re-vjp'ing a pure fn.
+        self.tensor_grad = None
 
     def __repr__(self):
         return f"GradNode({self.name}, n_out={self.n_outputs})"
@@ -45,6 +59,7 @@ def record_node(name, vjp_fn, input_tensors, output_tensors):
     """Attach a GradNode to output tensors. Called by the op dispatcher."""
     avals = [(tuple(o._data.shape), o._data.dtype) for o in output_tensors]
     node = GradNode(name, vjp_fn, list(input_tensors), avals)
+    node.multi_out = len(output_tensors) > 1
     for slot, o in enumerate(output_tensors):
         o._grad_node = node
         o._output_slot = slot
@@ -74,9 +89,67 @@ def _collect_graph(roots):
     return consumer_count
 
 
+def _taped_call(name, pure, tensors):
+    """Dispatch ``pure`` (tuple-returning fn over arrays) on Tensor inputs
+    with tape recording — the op_fn dispatch core, reused so a backward
+    computation can itself be differentiated (create_graph)."""
+    from ..core import state as _state
+    raw = [t._data for t in tensors]
+    diff_idx = [i for i, t in enumerate(tensors)
+                if not t.stop_gradient
+                and jnp.issubdtype(t._data.dtype, jnp.inexact)]
+    if not _state.grad_enabled() or not diff_idx:
+        return [Tensor(o) for o in pure(*raw)]
+
+    def closed(*arrs):
+        full = list(raw)
+        for i, a in zip(diff_idx, arrs):
+            full[i] = a
+        return pure(*full)
+
+    out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    outs = [Tensor(o, stop_gradient=False) for o in out]
+    node = record_node(name, vjp_fn, [tensors[i] for i in diff_idx], outs)
+    node.pure_call = closed
+    node.multi_out = True
+    return outs
+
+
+def _apply_node_taped(node, cot_tensors):
+    """create_graph node application: compute this node's input grads as
+    *taped* Tensors so the whole backward is differentiable again."""
+    if node.tensor_grad is not None:          # PyLayer: user backward, taped
+        return node.tensor_grad(cot_tensors)
+    if node.pure_call is not None:
+        pure_call = node.pure_call
+    elif node.pure_spec is not None:
+        fn, kwraw, diff_idx, nondiff_raw, n_args = node.pure_spec
+
+        def pure_call(*diff_arrays):
+            full = [None] * n_args
+            for i, a in nondiff_raw.items():
+                full[i] = a
+            for i, a in zip(diff_idx, diff_arrays):
+                full[i] = a
+            return fn(*full, **kwraw)
+    else:
+        raise RuntimeError(
+            f"create_graph=True: op '{node.name}' recorded no pure call; "
+            "its backward cannot be re-differentiated")
+    n_out = node.n_outputs
+
+    def grad_pure(*args):
+        cots, prims = args[:n_out], args[n_out:]
+        _, vjp = jax.vjp(pure_call, *prims)
+        return tuple(vjp(tuple(cots) if node.multi_out else cots[0]))
+
+    return _taped_call(node.name + "_grad", grad_pure,
+                       list(cot_tensors) + list(node.inputs))
+
+
 def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
                  retain_graph: bool = False, wanted: Optional[dict] = None,
-                 sink: Optional[dict] = None):
+                 sink: Optional[dict] = None, create_graph: bool = False):
     """Reference semantics of egr::RunBackward: seed cotangents at ``tensors``,
     flow to leaves, accumulate into ``leaf.grad``.
 
@@ -84,9 +157,17 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
     when ``sink`` is a dict, NOTHING is written to any ``.grad``; instead the
     finalized grads of the tensors in ``wanted`` (id -> Tensor, leaf or
     intermediate) are recorded into ``sink[id]``. Used by ``paddle.grad``.
+
+    ``create_graph`` mode (reference: egr::RunBackward's create_graph +
+    the generated double_grad ops): cotangents flow as *Tensors* and every
+    node's backward runs through the taped dispatcher (_apply_node_taped),
+    so the produced grads carry their own grad graph and can be
+    differentiated again. Implies the graph is retained.
     """
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
+    if create_graph:
+        retain_graph = True
 
     # node-id -> {slot: accumulated cotangent array}; the GradTensorHolder.
     buffers = {}
@@ -104,10 +185,10 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
                     f"got shape {t.shape}")
             g = jnp.ones_like(t._data)
         elif isinstance(g, Tensor):
-            g = g._data
+            return g if create_graph else g._data
         else:
             g = jnp.asarray(g, dtype=t._data.dtype)
-        return g
+        return Tensor(g) if create_graph else g
 
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient:
@@ -153,19 +234,28 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
             else:
                 shape, dt = node.out_avals[slot]
                 g = jnp.zeros(shape, dt)
+                if create_graph:
+                    g = Tensor(g)
             out_t = node.out_refs[slot]() if slot < len(node.out_refs) else None
             if out_t is not None and out_t._hooks:
                 for hook in out_t._hooks:
-                    r = hook(Tensor(g))
+                    r = hook(g if create_graph else Tensor(g))
                     if r is not None:
-                        g = r._data if isinstance(r, Tensor) else r
+                        if create_graph:
+                            g = r if isinstance(r, Tensor) else Tensor(r)
+                        else:
+                            g = r._data if isinstance(r, Tensor) else r
             if (sink is not None and out_t is not None
                     and wanted and id(out_t) in wanted):
                 prev = sink.get(id(out_t))
                 sink[id(out_t)] = g if prev is None else prev + g
             cotangents.append(g)
 
-        in_grads = node.vjp_fn(tuple(cotangents) if node.n_outputs > 1 else cotangents[0])
+        if create_graph:
+            in_grads = _apply_node_taped(node, cotangents)
+        else:
+            in_grads = node.vjp_fn(
+                tuple(cotangents) if node.multi_out else cotangents[0])
 
         for t, g in zip(node.inputs, in_grads):
             if g is None:
@@ -188,11 +278,14 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
 
         if not retain_graph:
             node.vjp_fn = _freed_vjp(node.name)
+            node.pure_call = None
+            node.pure_spec = None
+            node.tensor_grad = None
 
     # Finalize leaves: fire hooks once on the summed grad, then write .grad
     # (or the sink in paddle.grad mode).
     for t, acc in leaf_buffer.values():
-        gt = Tensor(acc)
+        gt = acc if create_graph else Tensor(acc)
         if t._hooks:
             for hook in t._hooks:
                 r = hook(gt)
@@ -201,7 +294,12 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
         if sink is not None:
             if wanted and id(t) in wanted:
                 prev = sink.get(id(t))
-                sink[id(t)] = gt._data if prev is None else prev + gt._data
+                if create_graph:
+                    sink[id(t)] = gt if prev is None else prev + gt
+                else:
+                    sink[id(t)] = gt._data if prev is None else prev + gt._data
+        elif create_graph:
+            t.grad = gt if t.grad is None else t.grad + gt
         elif t.grad is None:
             t.grad = Tensor(gt._data)
         else:

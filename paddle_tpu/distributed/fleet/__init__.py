@@ -12,7 +12,9 @@ from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,  # noqa
                         SharedLayerDesc)
 from . import pipeline_schedules  # noqa
 from .pipeline_runtime import PipelineParallel  # noqa
+from .recompute import recompute, recompute_sequential  # noqa
 from . import sequence_parallel_utils  # noqa
+from . import utils  # noqa
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa
 
 # meta_parallel namespace parity (reference: fleet/meta_parallel/__init__.py

@@ -1,0 +1,136 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference capability: python/paddle/distributed/fleet/recompute/recompute.py:109
+(``recompute(function, *args)`` — drop activations in forward, replay the
+region in backward, with RNG-state restore). TPU-native redesign: the region
+is captured as one *pure* function and wrapped in ``jax.checkpoint`` — XLA
+then rematerializes it inside the compiled backward, which is strictly
+better than the reference's eager replay (the recompute fuses into the
+backward program, no Python re-execution, no RNG save/restore needed
+because the pure function replays with identical PRNG usage by
+construction).
+
+Works in both execution modes:
+- eager: the checkpointed pure fn is dispatched through the tape
+  (tape._taped_call), so ``.backward()`` rematerializes the region;
+  a Layer's parameters are lifted to explicit inputs so their grads flow.
+- functional (inside jit / paddle_tpu.jit.to_static tracing): the wrapped
+  call simply traces ``jax.checkpoint(fn)`` into the outer program.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core import state
+from ...core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+@contextlib.contextmanager
+def _swap_params(params, arrays):
+    olds = [p._data for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+    try:
+        yield
+    finally:
+        for p, o in zip(params, olds):
+            p._data = o
+
+
+def _collect_params(function):
+    if hasattr(function, "parameters"):
+        seen, out = set(), []
+        for p in function.parameters():
+            if isinstance(p, Tensor) and id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
+        return out
+    return []
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` now; rematerialize it during backward.
+
+    ``function``: a Layer or callable over Tensors. Extra config kwargs
+    accepted for API parity: ``preserve_rng_state`` (always effectively
+    True — pure-function replay is deterministic) and ``use_reentrant``
+    (ignored; there is only one implementation).
+    """
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+    params = _collect_params(function)
+    all_inputs = tensor_args + params
+    n_args = len(tensor_args)
+
+    out_struct = {}
+
+    def pure(*arrays):
+        xs, ps = arrays[:n_args], arrays[n_args:]
+        full = list(args)
+        for i, x in zip(tensor_idx, xs):
+            full[i] = Tensor(x, stop_gradient=args[i].stop_gradient)
+        with _swap_params(params, ps), state.no_grad():
+            out = function(*full, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        out_struct["multi"] = multi
+        out_struct["is_tensor"] = [isinstance(o, Tensor) for o in outs]
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+    ckpt = jax.checkpoint(pure)
+
+    from ...autograd import tape
+    outs = tape._taped_call("recompute", ckpt, all_inputs)
+    # restore non-Tensor outputs to their original (raw array) type
+    outs = [o if was_t else o._data
+            for o, was_t in zip(outs, out_struct["is_tensor"])]
+    if not out_struct["multi"]:
+        return outs[0]
+    return tuple(outs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: fleet.utils.recompute_sequential — chunk a Sequential and
+    recompute each segment. ``ctx`` carries {"segments": N}."""
+    segments = int((ctx or {}).get("segments", 1))
+    layers = list(functions)
+    if segments <= 1:
+        chunks = [layers]
+    else:
+        size = max(1, len(layers) // segments)
+        chunks = [layers[i:i + size] for i in range(0, len(layers), size)]
+
+    out = args
+
+    def run_chunk(chunk):
+        def fn(*xs):
+            y = xs
+            for lyr in chunk:
+                y = lyr(*y) if isinstance(y, tuple) else lyr(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y if len(y) > 1 else y[0]
+        # lift every chunk layer's params
+        class _Holder:
+            def parameters(self):
+                ps = []
+                for lyr in chunk:
+                    if hasattr(lyr, "parameters"):
+                        ps.extend(lyr.parameters())
+                return ps
+            def __call__(self, *xs):
+                return fn(*xs)
+        return _Holder()
+
+    for chunk in chunks:
+        holder = run_chunk(chunk)
+        out = recompute(holder, *(out if isinstance(out, tuple) else (out,)),
+                        **kwargs)
+    return out

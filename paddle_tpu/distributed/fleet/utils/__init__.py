@@ -1,0 +1,3 @@
+"""fleet.utils parity surface (reference:
+python/paddle/distributed/fleet/utils/__init__.py — recompute re-export)."""
+from ..recompute import recompute, recompute_sequential  # noqa

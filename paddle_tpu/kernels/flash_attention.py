@@ -88,7 +88,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l = l_s[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)                 # fully-masked rows
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_s[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_s[:, :1] + jnp.log(l)            # [bq, 1]
 
 
 def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
@@ -114,11 +114,16 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            # LSE rides a trailing singleton lane dim: Mosaic requires the
+            # last two block dims be (8, 128)-divisible OR equal to the
+            # array dims — (block_q, 1) over [bh, sq, 1] satisfies the
+            # "equal" arm with zero padding waste (a bare (1, block_q)
+            # block over [bh, sq] is illegal and killed BENCH_r02).
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -166,11 +171,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows + offset >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])                     # lse_ref[0]: [bq, 1]
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -208,7 +213,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows + offset >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])            # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])                     # lse_ref[0]: [bq, 1]
         do = do_ref[0]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -216,7 +221,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, d]
@@ -236,7 +241,7 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
     nk = pl.cdiv(sk, block_k)
     do = g.astype(q.dtype)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                             # [BH, Sq]
+                    axis=-1, keepdims=True)              # [BH, Sq, 1]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -250,8 +255,8 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d),
                          lambda b, i, j, g_=group: (b // g_, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -275,8 +280,8 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d),
                          lambda b, j, i, g_=group: (b // g_, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -367,13 +372,19 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
 
 
 def supported(q, k, v, *, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Whether the kernel handles these shapes (else XLA fallback)."""
+    """Whether the kernel handles these shapes (else XLA fallback).
+
+    Beyond divisibility, this checks Mosaic's block-shape legality for
+    every BlockSpec the kernels will emit (tiling.block_legal) — interpret
+    mode can't catch an illegal block, so the dispatcher must reject it
+    here before a doomed pallas_call is traced (BENCH_r02's failure mode).
+    """
+    from .tiling import flash_specs_legal
     b, sq, h, d = q.shape
     sk = k.shape[1]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    # blocks must tile the sequence AND be sublane-aligned (8) so the
-    # kernel's VMEM tiles map cleanly onto the (8, 128) register shape
     return (sq % bq == 0 and sk % bk == 0 and
             bq % 8 == 0 and bk % 8 == 0 and
-            h % k.shape[2] == 0 and d <= 256)
+            h % k.shape[2] == 0 and d <= 256 and
+            flash_specs_legal(b * h, sq, sk, d, bq, bk, q.dtype))

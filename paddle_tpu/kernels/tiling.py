@@ -1,0 +1,62 @@
+"""Mosaic (Pallas TPU) block-shape legality rules.
+
+The TPU lowering requires that the last two dimensions of every BlockSpec
+block be divisible by the dtype's native tile — (8, 128) for 4-byte types,
+(16, 128) for 2-byte, (32, 128) for 1-byte — OR equal the corresponding
+dimension of the overall array. Rank-1 blocks need the last dim divisible
+by 128 or equal to the array's. Interpret mode does not enforce this, so
+a kernel can pass every CPU test and still fail to lower on the chip
+(exactly what BENCH_r02 recorded); `block_legal` lets `supported()` and
+the test suite check legality without a TPU.
+
+Reference capability: the reference validates kernel launch configs at
+dispatch time (phi KernelFactory); here legality is a pure shape predicate
+so the XLA fallback can engage *before* a doomed pallas_call is traced.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_LANE = 128
+
+
+def _sublane(dtype) -> int:
+    itemsize = np.dtype(dtype).itemsize
+    return {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+
+
+def block_legal(block_shape, array_shape, dtype=np.float32) -> bool:
+    """Whether Mosaic can lower a block of ``block_shape`` (ints, or None
+    for squeezed dims) over an array of ``array_shape``.
+
+    Note: squeezed (None) dims still count toward the trailing-two rule —
+    a ``(None, bq)`` block over ``[bh, sq]`` is checked as ``(1, bq)`` and
+    is illegal unless ``bh == 1`` (verified empirically on TPU v5e).
+    """
+    block = [1 if b is None else int(b) for b in block_shape]
+    array = list(array_shape)
+    if len(block) != len(array):
+        return False
+    if any(b < 1 or b > a for b, a in zip(block, array)):
+        return False
+    if len(block) == 0:
+        return True
+    sub = _sublane(dtype)
+    if len(block) == 1:
+        return block[-1] % _LANE == 0 or block[-1] == array[-1]
+    ok_lane = block[-1] % _LANE == 0 or block[-1] == array[-1]
+    ok_sub = block[-2] % sub == 0 or block[-2] == array[-2]
+    return ok_lane and ok_sub
+
+
+def flash_specs_legal(bh, sq, sk, d, block_q, block_k, dtype) -> bool:
+    """Legality of every BlockSpec the flash kernels emit (fwd + bwd)."""
+    lse = np.float32
+    return (
+        # q/o/do/dq blocks: (1, block_q, d) over [bh, s, d]
+        block_legal((1, block_q, d), (bh, sq, d), dtype)
+        # k/v/dk/dv blocks: (1, block_k, d)
+        and block_legal((1, block_k, d), (bh, sk, d), dtype)
+        # lse/delta blocks: (1, block_q, 1) over [bh, sq, 1] (always f32)
+        and block_legal((1, block_q, 1), (bh, sq, 1), lse)
+    )

@@ -119,12 +119,21 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
             _check_nan_inf(opname, out)
 
         from ..autograd import tape
+        # create_graph support: store what's needed to REBUILD the pure
+        # call (fn + kwargs + non-diff raw args) rather than the `closed`
+        # closure itself — the closure would pin every raw input for the
+        # graph's lifetime, while the diff arrays are already retained via
+        # node.inputs and are re-read from there at double-grad time.
+        nondiff_raw = {i: a for i, a in enumerate(raw) if i not in diff_idx}
+        pure_spec = (fn, kwraw, tuple(diff_idx), nondiff_raw, len(raw))
         if isinstance(out, tuple):
             outs = [wrap(o) for o in out]
-            tape.record_node(opname, vjp_fn, diff_tensors, outs)
+            node = tape.record_node(opname, vjp_fn, diff_tensors, outs)
+            node.pure_spec, node.multi_out = pure_spec, True
             return tuple(outs)
         out_t = wrap(out)
-        tape.record_node(opname, vjp_fn, diff_tensors, [out_t])
+        node = tape.record_node(opname, vjp_fn, diff_tensors, [out_t])
+        node.pure_spec, node.multi_out = pure_spec, False
         return out_t
 
     dispatch.pure_fn = fn

@@ -201,3 +201,256 @@ class TestNumericParity:
         y = pt.where(cond, x * 2.0, x * 3.0)
         y.sum().backward()
         np.testing.assert_allclose(x.grad.numpy(), [2, 3])
+
+
+class TestPyLayer:
+    def test_forward_backward(self):
+        import paddle_tpu.autograd as ag
+
+        class CubeLayer(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3.0 * x * x
+
+        a = pt.to_tensor(2.0, stop_gradient=False)
+        y = CubeLayer.apply(a)
+        assert abs(y.item() - 8.0) < 1e-6
+        y.backward()
+        assert abs(a.grad.item() - 12.0) < 1e-5
+
+    def test_multi_input_output(self):
+        import paddle_tpu.autograd as ag
+
+        class MulAdd(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x, y):
+                ctx.save_for_backward(x, y)
+                return x * y, x + y
+
+            @staticmethod
+            def backward(ctx, dprod, dsum):
+                x, y = ctx.saved_tensor()
+                return dprod * y + dsum, dprod * x + dsum
+
+        a = pt.to_tensor(3.0, stop_gradient=False)
+        b = pt.to_tensor(4.0, stop_gradient=False)
+        p, s = MulAdd.apply(a, b)
+        (p + 2.0 * s).backward()
+        # d/da (ab + 2(a+b)) = b + 2 = 6 ; d/db = a + 2 = 5
+        assert abs(a.grad.item() - 6.0) < 1e-5
+        assert abs(b.grad.item() - 5.0) < 1e-5
+
+    def test_none_grad_and_nontensor_input(self):
+        import paddle_tpu.autograd as ag
+
+        class ScaleFirst(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x, y, k):
+                return x * k + y * 0.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 5.0, None
+
+        a = pt.to_tensor(1.0, stop_gradient=False)
+        b = pt.to_tensor(1.0, stop_gradient=False)
+        out = ScaleFirst.apply(a, b, 5.0)
+        out.backward()
+        assert abs(a.grad.item() - 5.0) < 1e-5
+        assert b.grad is None
+
+    def test_backward_arity_mismatch_raises(self):
+        import paddle_tpu.autograd as ag
+
+        class Bad(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x, y):
+                return x + y
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy  # only one grad for two tensor inputs
+
+        a = pt.to_tensor(1.0, stop_gradient=False)
+        b = pt.to_tensor(1.0, stop_gradient=False)
+        out = Bad.apply(a, b)
+        with pytest.raises(ValueError):
+            out.backward()
+
+    def test_trains_in_layer(self):
+        """A PyLayer op inside an nn.Layer trains end-to-end."""
+        import paddle_tpu.autograd as ag
+        import paddle_tpu.nn as nn
+
+        class SquareFn(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2.0 * x
+
+        lin = nn.Linear(4, 4)
+        x = pt.to_tensor(np.random.randn(2, 4).astype("float32"))
+        y = SquareFn.apply(lin(x)).sum()
+        y.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+class TestCreateGraph:
+    def test_grad_of_grad_matches_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sin(x) * x * x
+
+        x0 = 0.7
+        a = pt.to_tensor(x0, stop_gradient=False)
+        y = (a * a) * pt.sin(a)
+        (g,) = pt.grad(y, a, create_graph=True)
+        (gg,) = pt.grad(g, a)
+        expect_g = jax.grad(f)(jnp.float32(x0))
+        expect_gg = jax.grad(jax.grad(f))(jnp.float32(x0))
+        assert abs(g.item() - float(expect_g)) < 1e-5
+        assert abs(gg.item() - float(expect_gg)) < 1e-4
+
+    def test_third_order(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = pt.to_tensor(0.5, stop_gradient=False)
+        y = a * a * a * a          # x^4
+        (g1,) = pt.grad(y, a, create_graph=True)     # 4x^3
+        (g2,) = pt.grad(g1, a, create_graph=True)    # 12x^2
+        (g3,) = pt.grad(g2, a)                       # 24x
+        assert abs(g1.item() - 4 * 0.5 ** 3) < 1e-5
+        assert abs(g2.item() - 12 * 0.5 ** 2) < 1e-5
+        assert abs(g3.item() - 24 * 0.5) < 1e-4
+
+    def test_create_graph_multivar(self):
+        # grad-of-grad on a 2-var function: f = x^2 * y; d2f/dxdy = 2x
+        x = pt.to_tensor(3.0, stop_gradient=False)
+        y = pt.to_tensor(5.0, stop_gradient=False)
+        f = x * x * y
+        (gx,) = pt.grad(f, x, create_graph=True)     # 2xy
+        (gxy,) = pt.grad(gx, y)                      # 2x
+        assert abs(gxy.item() - 6.0) < 1e-5
+
+    def test_pylayer_create_graph(self):
+        import paddle_tpu.autograd as ag
+
+        class Cube(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3.0 * x * x
+
+        a = pt.to_tensor(2.0, stop_gradient=False)
+        y = Cube.apply(a)
+        (g,) = pt.grad(y, a, create_graph=True)      # 3x^2 = 12
+        (gg,) = pt.grad(g, a)                        # 6x = 12
+        assert abs(g.item() - 12.0) < 1e-5
+        assert abs(gg.item() - 12.0) < 1e-4
+
+
+class TestRecompute:
+    def test_recompute_matches_plain(self):
+        from paddle_tpu.distributed.fleet import recompute
+        import paddle_tpu.nn as nn
+
+        lin1 = nn.Linear(8, 8)
+        lin2 = nn.Linear(8, 8)
+
+        def block(x):
+            return lin2(pt.nn.functional.relu(lin1(x)))
+
+        class Block:
+            def parameters(self):
+                return list(lin1.parameters()) + list(lin2.parameters())
+
+            def __call__(self, x):
+                return block(x)
+
+        xnp = np.random.randn(4, 8).astype("float32")
+        x1 = pt.to_tensor(xnp, stop_gradient=False)
+        y1 = recompute(Block(), x1).sum()
+        y1.backward()
+        g_rc = [p.grad.numpy().copy() for p in Block().parameters()]
+        gx_rc = x1.grad.numpy().copy()
+
+        for p in Block().parameters():
+            p.clear_grad()
+        x2 = pt.to_tensor(xnp, stop_gradient=False)
+        y2 = block(x2).sum()
+        y2.backward()
+        g_pl = [p.grad.numpy() for p in Block().parameters()]
+        np.testing.assert_allclose(float(y1.numpy()), float(y2.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(gx_rc, x2.grad.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        for a, b in zip(g_rc, g_pl):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_recompute_sequential(self):
+        from paddle_tpu.distributed.fleet import recompute_sequential
+        import paddle_tpu.nn as nn
+
+        layers = [nn.Linear(6, 6) for _ in range(4)]
+        x = pt.to_tensor(np.random.randn(2, 6).astype("float32"),
+                         stop_gradient=False)
+        y = recompute_sequential({"segments": 2}, layers, x)
+        y.sum().backward()
+        assert x.grad is not None
+        for lyr in layers:
+            assert lyr.weight.grad is not None
+
+    def test_recompute_inside_jit(self):
+        """Functional mode: recompute traces jax.checkpoint into the
+        program (no tape), grads flow via jax.grad."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.fleet import recompute
+        from paddle_tpu.core import state
+
+        def f(x):
+            with state.functional_mode():
+                def fn(t):
+                    return t * t * t
+                return recompute(fn, pt.Tensor(x))._data.sum()
+
+        g = jax.grad(f)(jnp.arange(4.0))
+        np.testing.assert_allclose(np.asarray(g), 3 * np.arange(4.0) ** 2,
+                                   rtol=1e-6)
+
+    def test_pylayer_duplicate_input_positional_grads(self):
+        """Same Tensor passed twice: each slot's grad accumulates."""
+        import paddle_tpu.autograd as ag
+
+        class TwoSlot(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x, y):
+                return x * 1.0 + y * 2.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 1.0, dy * 2.0
+
+        a = pt.to_tensor(1.0, stop_gradient=False)
+        TwoSlot.apply(a, a).backward()
+        assert abs(a.grad.item() - 3.0) < 1e-6

@@ -1,0 +1,84 @@
+"""Mosaic block-shape legality tests.
+
+Interpret mode skips Mosaic's tiling checks, so a kernel can pass every
+CPU numeric test and still fail to lower on TPU (BENCH_r02 recorded
+exactly that: block (1, 128) over a (128, 2048) LSE array). These tests
+pin the legality predicate to the empirically-verified TPU rules so the
+dispatcher's `supported()` guard keeps illegal specs off the chip.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import importlib
+
+fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+rn = importlib.import_module("paddle_tpu.kernels.rms_norm")
+from paddle_tpu.kernels.tiling import block_legal, flash_specs_legal
+
+
+class TestBlockLegal:
+    def test_bench_r02_lse_shape_rejected(self):
+        # the exact spec that killed BENCH_r02: (1, block_q) over [BH, Sq]
+        assert not block_legal((1, 128), (128, 2048), np.float32)
+
+    def test_squeezed_dim_still_counts(self):
+        # (None, bq) over [bh, sq] is checked as (1, bq): illegal
+        # (verified on TPU v5e — the squeeze does NOT satisfy Mosaic)
+        assert not block_legal((None, 128), (128, 2048), np.float32)
+
+    def test_rms_partial_dw_rejected(self):
+        # (1, d) over [grid, d] with grid > 1: sublane dim 1 fails
+        assert not block_legal((1, 4096), (4, 4096), np.float32)
+        # but legal when the block spans the whole array
+        assert block_legal((1, 4096), (1, 4096), np.float32)
+
+    def test_trailing_singleton_equal_arm(self):
+        # (1, bq, 1) over [bh, sq, 1]: last dim equals array dim -> legal
+        assert block_legal((1, 128, 1), (128, 2048, 1), np.float32)
+        assert block_legal((128, 1), (1024, 1), np.float32)
+
+    def test_divisible_arm(self):
+        assert block_legal((1, 128, 128), (8, 512, 128), np.float32)
+        assert block_legal((256, 1024), (2048, 1024), np.float32)
+
+    def test_dtype_sublane(self):
+        # bf16 tile is (16, 128): 8 rows not divisible, not equal
+        assert not block_legal((8, 128), (64, 256), jnp.bfloat16)
+        assert block_legal((16, 128), (64, 256), jnp.bfloat16)
+
+    def test_rank_and_bounds(self):
+        assert not block_legal((1, 128), (8, 128, 128))    # rank mismatch
+        assert not block_legal((256, 128), (128, 128))     # block > array
+
+
+class TestSupportedGuards:
+    def test_flash_bench_shapes_supported(self):
+        # the BENCH llama config must take the fast path
+        q = jnp.zeros((4, 2048, 32, 128), jnp.bfloat16)
+        kv = jnp.zeros((4, 2048, 8, 128), jnp.bfloat16)
+        assert fa.supported(q, kv, kv)
+        assert flash_specs_legal(4 * 32, 2048, 2048, 128, 128, 128,
+                                 jnp.bfloat16)
+
+    def test_flash_every_emitted_spec_is_legal(self):
+        # mirror of the specs _fwd/_bwd construct, checked via block_legal
+        bh, sq, sk, d, bq, bk = 128, 2048, 2048, 128, 128, 128
+        dt = jnp.bfloat16
+        assert block_legal((1, bq, d), (bh, sq, d), dt)       # q/o/do/dq
+        assert block_legal((1, bk, d), (bh, sk, d), dt)       # k/v/dk/dv
+        assert block_legal((1, bq, 1), (bh, sq, 1), np.float32)  # lse/delta
+
+    def test_rms_block_rows_bounded(self):
+        # v5e scoped-vmem OOMs at (256, 4096) blocks; picker must shrink
+        br = rn._pick_block_rows(rn.DEFAULT_BLOCK_ROWS, 4096, 4096)
+        assert br * 4096 <= rn._MAX_BLOCK_ELEMS
+        assert 4096 % br == 0 and br % 8 == 0
+        # small d keeps the full default
+        assert rn._pick_block_rows(256, 1024, 512) == 256
+
+    def test_rms_emitted_specs_legal(self):
+        n, d = 4096, 4096
+        br = rn._pick_block_rows(rn.DEFAULT_BLOCK_ROWS, n, d)
+        assert block_legal((br, d), (n, d), jnp.bfloat16)
+        assert block_legal((br, 1), (n, 1), np.float32)       # rstd
+        assert block_legal((1, d), (1, d), np.float32)        # dw out
