@@ -25,6 +25,17 @@ from .ops import creation as _creation
 
 from . import autograd  # noqa
 from . import amp  # noqa
+
+# NOTE: `from . import linalg` would be satisfied by the `linalg` attribute
+# the ops star-import leaked onto this package (ops.linalg); import the real
+# namespace modules explicitly so paddle_tpu.linalg is linalg.py.
+import importlib as _importlib
+
+linalg = _importlib.import_module(".linalg", __name__)
+fft = _importlib.import_module(".fft", __name__)
+signal = _importlib.import_module(".signal", __name__)
+from .signal import istft, stft  # noqa
+from .ops.manipulation_ext import tensor_unfold as unfold  # noqa
 from . import distributed  # noqa
 from . import io  # noqa
 from . import jit  # noqa
@@ -34,6 +45,9 @@ from . import kernels  # noqa
 from . import models  # noqa
 from . import incubate  # noqa
 from . import metric  # noqa
+from . import profiler  # noqa
+from . import static  # noqa
+from . import inference  # noqa
 from . import vision  # noqa
 from . import distribution  # noqa
 from . import hapi  # noqa
@@ -72,6 +86,28 @@ def set_device(device: str):
 
 def grad(*args, **kwargs):
     return autograd.grad(*args, **kwargs)
+
+
+# -- static-mode toggles (reference: base/framework.py enable_static) -------
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def in_static_mode() -> bool:
+    return _static_mode
 
 
 def _monkeypatch_tensor_repr():

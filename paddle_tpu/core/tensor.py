@@ -49,6 +49,7 @@ class Tensor:
         "_hooks",
         "_placements",
         "_process_mesh",
+        "_symbolic",
         "__weakref__",
     )
 
@@ -65,6 +66,7 @@ class Tensor:
         self._hooks = None       # list of grad hooks
         self._placements = None  # distributed placement annotation
         self._process_mesh = None
+        self._symbolic = None    # static-graph Var (static/ir.py) or None
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -162,28 +164,10 @@ class Tensor:
         self._data = jnp.zeros_like(self._data)
         return self
 
-    def scale_(self, scale):
-        self._data = self._data * scale
-        return self
-
-    def add_(self, other):
-        other = other._data if isinstance(other, Tensor) else other
-        self._data = self._data + other
-        return self
-
-    def subtract_(self, other):
-        other = other._data if isinstance(other, Tensor) else other
-        self._data = self._data - other
-        return self
-
-    def multiply_(self, other):
-        other = other._data if isinstance(other, Tensor) else other
-        self._data = self._data * other
-        return self
-
-    def clip_(self, min=None, max=None):
-        self._data = jnp.clip(self._data, min, max)
-        return self
+    # scale_/add_/subtract_/multiply_/clip_ and the other op inplace
+    # variants are installed by ops/__init__._register_inplace with
+    # grad-node adoption semantics (fill_/zero_/copy_ above stay raw data
+    # writes, matching the reference's non-autograd setters).
 
     # -- autograd -----------------------------------------------------------
     def backward(self, grad_tensor=None, retain_graph: bool = False):

@@ -7,11 +7,15 @@ from ._op import (_unwrap_index, get_op, op_fn, registered_ops, unwrap,  # noqa
                   wrap)
 from .creation import *  # noqa
 from .math import *  # noqa
+from .math_ext import *  # noqa
 from .reduction import *  # noqa
 from .manipulation import *  # noqa
+from .manipulation_ext import *  # noqa
 from .linalg import *  # noqa
+from .linalg_ext import *  # noqa
 from .logic import *  # noqa
 from .random import *  # noqa
+from . import fft_ops  # noqa  (namespaced under paddle_tpu.fft)
 
 from ..core.tensor import Tensor
 
@@ -142,5 +146,273 @@ def _register_tensor_methods():
     Tensor.index_add = (
         lambda self, index, axis, value: index_add(self, index, axis=axis, value=value))
 
+    # extended surface (linalg_ext / math_ext / manipulation_ext)
+    simple2 = [
+        "copysign", "nextafter", "i0", "i0e", "i1", "i1e", "sinc",
+        "gammaln", "gammainc", "gammaincc", "neg", "sgn", "signbit",
+        "isneginf", "isposinf", "isreal", "is_complex", "is_floating_point",
+        "is_integer", "floor_mod", "remainder", "take", "mv", "inverse",
+        "matrix_transpose", "cdist", "dist", "cov", "corrcoef", "cond",
+        "vander", "histogram", "svd", "qr", "eig", "eigvals",
+        "lu", "lstsq", "expand_as", "view_as", "atleast_1d", "atleast_2d",
+        "atleast_3d", "index_sample", "masked_scatter", "unique_consecutive",
+        "mode", "diag_embed", "frexp", "diff", "addmm",
+    ]
+    for name in simple2:
+        if name in ns:
+            _m(name, ns[name])
+
+    kw2 = {
+        "logcumsumexp": ["axis"],
+        "cummin": ["axis"],
+        "cummax": ["axis"],
+        "nanmedian": ["axis", "keepdim"],
+        "nanquantile": ["q", "axis", "keepdim"],
+        "bitwise_left_shift": ["y"],
+        "bitwise_right_shift": ["y"],
+        "renorm": ["p", "axis", "max_norm"],
+        "multigammaln": ["p"],
+        "kthvalue": ["k", "axis", "keepdim"],
+        "unflatten": ["axis", "shape"],
+        "tensor_split": ["num_or_indices", "axis"],
+        "vector_norm": ["p", "axis", "keepdim"],
+    }
+    for name, kws in kw2.items():
+        if name in ns:
+            _m(name, ns[name], positional_kw=kws)
+
+    Tensor.bucketize = (
+        lambda self, sorted_sequence, out_int32=False, right=False:
+        bucketize(self, sorted_sequence, out_int32=out_int32, right=right))
+    Tensor.index_fill = (
+        lambda self, index, axis, value: index_fill(self, index, axis, value))
+    Tensor.select_scatter = (
+        lambda self, values, axis, index:
+        select_scatter(self, values, axis, index))
+    Tensor.slice_scatter = (
+        lambda self, value, axes=None, starts=None, ends=None, strides=None:
+        slice_scatter(self, value, axes, starts, ends, strides))
+    Tensor.diagonal_scatter = (
+        lambda self, y, offset=0, axis1=0, axis2=1:
+        diagonal_scatter(self, y, offset, axis1, axis2))
+    Tensor.as_strided = (
+        lambda self, shape, stride, offset=0:
+        as_strided(self, shape, stride, offset))
+    Tensor.view = lambda self, shape_or_dtype: view(self, shape_or_dtype)
+
+    # remaining paddle Tensor-method parity: ops whose first arg is the
+    # tensor and whose paddle method forwards positionally
+    simple3 = [
+        "as_complex", "as_real", "atan2", "cholesky_solve", "count_nonzero",
+        "diag", "diagflat", "dsplit", "eigvalsh", "floor_divide", "fmax",
+        "fmin", "gcd", "histogramdd", "householder_product", "hsplit",
+        "increment", "index_put", "is_empty", "lcm", "ldexp", "logaddexp",
+        "lu_unpack", "matrix_power", "multinomial", "multiplex", "nanmean",
+        "nansum", "ormqr", "pca_lowrank", "pinv", "polar", "polygamma",
+        "quantile", "rank", "reduce_as", "reverse", "rot90", "scatter_nd",
+        "scatter_nd_add", "shard_index", "slice", "solve", "stanh",
+        "strided_slice", "svd_lowrank", "top_p_sampling", "trapezoid",
+        "triangular_solve", "vsplit", "istft", "stft",
+    ]
+    from . import fft_ops as _fft_ops
+    ns2 = dict(ns)
+    ns2.setdefault("istft", _fft_ops.istft)
+    ns2.setdefault("stft", _fft_ops.stft)
+    for name in simple3:
+        if name in ns2:
+            _m(name, ns2[name])
+    Tensor.concat = lambda self, *xs, axis=0: concat([self, *xs], axis=axis)
+    Tensor.stack = lambda self, *xs, axis=0: stack([self, *xs], axis=axis)
+    Tensor.add_n = lambda self, *xs: add_n([self, *xs])
+    Tensor.broadcast_tensors = (
+        lambda self, *xs: broadcast_tensors([self, *xs]))
+    Tensor.cumulative_trapezoid = (
+        lambda self, x=None, dx=None, axis=-1:
+        cumulative_trapezoid(self, x, dx, axis))
+    from .manipulation_ext import tensor_unfold as _tensor_unfold_fn
+    Tensor.unfold = (
+        lambda self, axis, size, step: _tensor_unfold_fn(self, axis, size, step))
+    from .random import exponential_ as _exponential_
+    Tensor.exponential_ = lambda self, lam=1.0: _exponential_(self, lam)
+    Tensor.multi_dot = lambda self, *xs: multi_dot([self, *xs])
+
 
 _register_tensor_methods()
+
+
+# ---------------------------------------------------------------------------
+# In-place variants (paddle's `op_`): mutation = rebinding on the Tensor
+# facade (core/tensor.py:32). The result ADOPTS the out tensor's grad node
+# so autograd still flows — the TPU-native stand-in for the reference's
+# inplace version-counter machinery (paddle/fluid/eager/utils.cc
+# CheckInplace): XLA arrays are immutable, so "inplace" is an API-surface
+# notion only.
+# ---------------------------------------------------------------------------
+import weakref as _weakref
+
+
+def _adopt(x: Tensor, out: Tensor) -> Tensor:
+    x._data = out._data
+    if out._grad_node is not None:
+        node, slot = out._grad_node, out._output_slot
+        x._grad_node, x._output_slot = node, slot
+        if slot < len(node.out_refs):
+            node.out_refs[slot] = _weakref.ref(x)
+        x.stop_gradient = False
+    elif x._grad_node is not None:
+        # Tracked tensor modified in-place while grads are off: its old
+        # graph no longer describes its value. Poison the node so a later
+        # backward errors loudly (the reference's inplace version-counter
+        # check, eager/utils.cc CheckInplace) instead of silently using
+        # the stale graph.
+        from ..autograd.tape import GradNode
+
+        def _poison(*_):
+            raise RuntimeError(
+                "Tensor was modified by an in-place operation while grad "
+                "recording was off; its autograd graph is invalid. "
+                "Recompute it or call .detach() before the in-place op.")
+        node = GradNode("inplace(no_grad)", _poison, [],
+                        [(tuple(x._data.shape), x._data.dtype)])
+        x._grad_node, x._output_slot = node, 0
+        node.out_refs.append(_weakref.ref(x))
+    return x
+
+
+def _snapshot(x: Tensor) -> Tensor:
+    """Freeze x's current (data, graph position) into a fresh Tensor so an
+    inplace op can be recorded against the snapshot — x itself is about to
+    be re-pointed at the op's output, and recording against x directly
+    would make the new node its own input (a graph cycle)."""
+    s = Tensor(x._data, stop_gradient=x.stop_gradient)
+    if x._grad_node is not None:
+        node, slot = x._grad_node, x._output_slot
+        s._grad_node, s._output_slot = node, slot
+        if slot < len(node.out_refs) and node.out_refs[slot]() is x:
+            node.out_refs[slot] = _weakref.ref(s)
+        s.stop_gradient = False
+    return s
+
+
+_INPLACE_NAMES = [
+    "abs", "acos", "acosh", "add", "addmm", "asin", "asinh", "atan",
+    "atanh", "bitwise_and", "bitwise_left_shift", "bitwise_not",
+    "bitwise_or", "bitwise_right_shift", "bitwise_xor", "ceil", "clip",
+    "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma", "divide",
+    "equal", "erfinv", "exp", "expm1", "flatten", "floor", "floor_divide",
+    "floor_mod", "frac", "gammainc", "gammaincc", "gammaln", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "index_fill",
+    "index_put", "lcm",
+    "ldexp", "lerp", "less_equal", "less_than", "lgamma", "log", "log10",
+    "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+    "multigammaln", "multiply", "nan_to_num", "neg", "not_equal",
+    "polygamma", "pow", "put_along_axis", "reciprocal", "remainder",
+    "renorm", "reshape", "round", "rsqrt", "scale", "scatter", "sigmoid",
+    "sin", "sinc", "sinh", "sqrt", "squeeze", "subtract", "tan", "tanh",
+    "tril", "triu", "trunc", "unsqueeze", "where",
+]
+
+
+def _make_inplace(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def inplace(x, *args, **kwargs):
+        return _adopt(x, fn(_snapshot(x), *args, **kwargs))
+    inplace.__name__ = fn.__name__ + "_"
+    return inplace
+
+
+def _register_inplace():
+    import sys
+    ns = sys.modules[__name__].__dict__
+    for name in _INPLACE_NAMES:
+        base = ns.get(name)
+        if base is None:
+            continue
+        iname = name + "_"
+        method = getattr(Tensor, name, None)
+        ns.setdefault(iname, _make_inplace(base))
+        if method is not None and not hasattr(Tensor, iname):
+            def meth(self, *a, _m=method, **k):
+                return _adopt(self, _m(_snapshot(self), *a, **k))
+            setattr(Tensor, iname, meth)
+
+    # transpose_/t_/cast_ have method-specific signatures
+    def _t_(self):
+        return _adopt(self, _snapshot(self).t())
+    def _transpose_(self, perm):
+        return _adopt(self, transpose(_snapshot(self), perm=perm))
+    def _cast_(self, dtype):
+        return _adopt(self, cast(_snapshot(self), dtype))
+    if not hasattr(Tensor, "t_"):
+        Tensor.t_ = _t_
+        Tensor.transpose_ = _transpose_
+        Tensor.cast_ = _cast_
+    ns.setdefault("t_", lambda x: _t_(x))
+    ns.setdefault("transpose_", lambda x, perm: _transpose_(x, perm))
+    ns.setdefault("cast_", lambda x, dtype: _cast_(x, dtype))
+
+    # random fills (reference: tensor/random.py uniform_/normal_/...)
+    from ..framework.random import next_key as _next_key
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+        x._data = _jax.random.uniform(_next_key(), x._data.shape,
+                                      x._data.dtype, min, max)
+        return x
+
+    def normal_(x, mean=0.0, std=1.0, seed=0, name=None):
+        x._data = mean + std * _jax.random.normal(_next_key(),
+                                                  x._data.shape, x._data.dtype)
+        return x
+
+    def cauchy_(x, loc=0, scale=1, name=None):
+        u = _jax.random.uniform(_next_key(), x._data.shape, x._data.dtype)
+        x._data = loc + scale * _jnp.tan(_jnp.pi * (u - 0.5))
+        return x
+
+    def geometric_(x, probs, name=None):
+        u = _jax.random.uniform(_next_key(), x._data.shape, x._data.dtype)
+        x._data = _jnp.ceil(_jnp.log1p(-u) / _jnp.log1p(-probs))
+        return x
+
+    for f in (uniform_, normal_, cauchy_, geometric_):
+        ns.setdefault(f.__name__, f)
+        if not hasattr(Tensor, f.__name__):
+            setattr(Tensor, f.__name__, f)
+
+
+_register_inplace()
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Reference: tensor/creation.py create_parameter."""
+    import jax.numpy as _jnp
+    from ..core.dtype import convert_dtype
+    from ..core.tensor import Parameter
+    import math as _math
+    dt = convert_dtype(dtype)
+    if default_initializer is not None:
+        data = default_initializer(shape, dt)
+        if isinstance(data, Tensor):
+            data = data._data
+    elif is_bias:
+        data = _jnp.zeros(shape, dt)
+    else:   # Xavier-uniform default, matching nn initializer defaults
+        fan_in = shape[0] if shape else 1
+        fan_out = shape[-1] if shape else 1
+        bound = _math.sqrt(6.0 / (fan_in + fan_out))
+        import jax as _jax
+        from ..framework.random import next_key as _nk
+        data = _jax.random.uniform(_nk(), tuple(shape), dt, -bound, bound)
+    return Parameter(data)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    import jax.numpy as _jnp
+    from ..core.dtype import convert_dtype
+    return Tensor(_jnp.zeros((), convert_dtype(dtype)))

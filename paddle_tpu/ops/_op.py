@@ -30,6 +30,16 @@ from ..core.tensor import Tensor
 
 _OP_REGISTRY = {}
 
+# Profiler seam (reference: the RecordEvent wrapper in every generated
+# ad-func, eager_gen.py). None when no profiler is recording — a single
+# tuple-load guard on the hot path.
+_PROFILE_HOOK = None
+
+
+def set_profile_hook(begin, end):
+    global _PROFILE_HOOK
+    _PROFILE_HOOK = (begin, end) if begin is not None else None
+
 
 def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
@@ -71,6 +81,24 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
 
     @functools.wraps(fn)
     def dispatch(*args, **kwargs):
+        ph = _PROFILE_HOOK
+        if ph is not None:
+            ph[0](opname)
+            try:
+                return _dispatch_inner(*args, **kwargs)
+            finally:
+                ph[1]()
+        return _dispatch_inner(*args, **kwargs)
+
+    def _dispatch_inner(*args, **kwargs):
+        # static-build interception (reference: under program_guard ops
+        # append to the Program instead of executing — framework.py
+        # in_dygraph_mode branch of every API). A symbolic input (positional
+        # OR keyword) means we are inside a static.Program build.
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, Tensor) and a._symbolic is not None:
+                return _record_static(a._symbolic.program, opname, fn,
+                                      args, kwargs)
         raw = [unwrap(a) for a in args]
         kwraw = {k: unwrap(v) for k, v in kwargs.items()}
 
@@ -148,6 +176,24 @@ def get_op(name: str):
 
 def registered_ops():
     return dict(_OP_REGISTRY)
+
+
+def _record_static(prog, opname, fn, args, kwargs):
+    """Record one op into a static Program (static/ir.py) with output
+    shapes from jax.eval_shape — the InferMeta step of the reference's
+    static op append (SURVEY §2.1). Tensor kwargs (symbolic or concrete)
+    are traced; non-tensor config kwargs are baked."""
+    spec_args = [a._data if isinstance(a, Tensor) else a for a in args]
+    tensor_kw = {k: v._data for k, v in kwargs.items()
+                 if isinstance(v, Tensor)}
+    static_kw = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Tensor)}
+    out = jax.eval_shape(lambda *xs, **tkw: fn(*xs, **static_kw, **tkw),
+                         *spec_args, **tensor_kw)
+    multi = isinstance(out, tuple)
+    outs = out if multi else (out,)
+    out_tensors = prog.record_op(opname, fn, list(args), dict(kwargs), outs)
+    return tuple(out_tensors) if multi else out_tensors[0]
 
 
 def _check_nan_inf(opname, out):

@@ -1,0 +1,220 @@
+"""FFT op family (paddle.fft parity).
+
+Reference capability: python/paddle/fft.py (fft_c2c/fft_r2c/fft_c2r phi
+kernels backed by cuFFT/pocketfft). TPU-native: jnp.fft lowers to XLA's
+FFT HLO, which runs natively on TPU; normalization modes match paddle's
+("backward" | "ortho" | "forward"). stft/istft are composed from frame +
+fft the way the reference composes them in python (signal.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq", "stft", "istft",
+]
+
+
+def _norm(normalization):
+    if normalization not in ("backward", "ortho", "forward"):
+        raise ValueError(
+            f"Unexpected norm: {normalization!r} (use backward/ortho/forward)")
+    return normalization
+
+
+def _mk1(jfn, opname):
+    @op_fn(name=opname)
+    def op(x, *, n=None, axis=-1, norm="backward"):
+        return jfn(x, n=n, axis=axis, norm=_norm(norm))
+
+    def api(x, n=None, axis=-1, norm="backward", name=None):
+        return op(x, n=n, axis=axis, norm=norm)
+    return api
+
+
+def _mkn(jfn, opname):
+    @op_fn(name=opname)
+    def op(x, *, s=None, axes=None, norm="backward"):
+        return jfn(x, s=s, axes=axes, norm=_norm(norm))
+
+    def api(x, s=None, axes=None, norm="backward", name=None):
+        if isinstance(axes, list):
+            axes = tuple(axes)
+        if isinstance(s, list):
+            s = tuple(s)
+        return op(x, s=s, axes=axes, norm=norm)
+    return api
+
+
+def _mk2(jfn, opname):
+    nd = _mkn(jfn, opname)
+
+    def api(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return nd(x, s=s, axes=axes, norm=norm)
+    return api
+
+
+fft = _mk1(jnp.fft.fft, "fft")
+ifft = _mk1(jnp.fft.ifft, "ifft")
+rfft = _mk1(jnp.fft.rfft, "rfft")
+irfft = _mk1(jnp.fft.irfft, "irfft")
+hfft = _mk1(jnp.fft.hfft, "hfft")
+ihfft = _mk1(jnp.fft.ihfft, "ihfft")
+
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+fft2 = _mk2(jnp.fft.fftn, "fft2")
+ifft2 = _mk2(jnp.fft.ifftn, "ifft2")
+rfft2 = _mk2(jnp.fft.rfftn, "rfft2")
+irfft2 = _mk2(jnp.fft.irfftn, "irfft2")
+
+
+def _hfftn(x, s=None, axes=None, norm="backward"):
+    # hermitian-input nd fft: conj-reverse trick over the last axis
+    return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes,
+                          norm={"backward": "forward", "forward": "backward",
+                                "ortho": "ortho"}[norm])
+
+
+def _ihfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes,
+                                  norm={"backward": "forward",
+                                        "forward": "backward",
+                                        "ortho": "ortho"}[norm]))
+
+
+hfftn = _mkn(_hfftn, "hfftn")
+ihfftn = _mkn(_ihfftn, "ihfftn")
+hfft2 = _mk2(_hfftn, "hfft2")
+ihfft2 = _mk2(_ihfftn, "ihfft2")
+
+
+@op_fn(name="fftshift")
+def _fftshift(x, *, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    if isinstance(axes, list):
+        axes = tuple(axes)
+    return _fftshift(x, axes=axes)
+
+
+@op_fn(name="ifftshift")
+def _ifftshift(x, *, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    if isinstance(axes, list):
+        axes = tuple(axes)
+    return _ifftshift(x, axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    arr = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype))
+    return wrap(arr)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    arr = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype))
+    return wrap(arr)
+
+
+@op_fn(name="stft_op")
+def _stft(x, window, *, n_fft, hop_length, center, pad_mode, normalized,
+          onesided):
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    n = x.shape[-1]
+    n_frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx] * window                       # [..., frames, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+        else jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)                   # [..., freq, frames]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Reference: python/paddle/signal.py stft."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = unwrap(window)
+    if win_length < n_fft:                              # center-pad window
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    return _stft(x, win, n_fft=n_fft, hop_length=hop_length, center=center,
+                 pad_mode=pad_mode, normalized=normalized, onesided=onesided)
+
+
+@op_fn(name="istft_op")
+def _istft(spec, window, *, n_fft, hop_length, center, normalized,
+           onesided, length, return_complex):
+    spec = jnp.swapaxes(spec, -1, -2)                   # [..., frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1))
+    if not return_complex:
+        frames = frames.real if jnp.iscomplexobj(frames) else frames
+    frames = frames * window
+    n_frames = frames.shape[-2]
+    out_len = n_fft + hop_length * (n_frames - 1)
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :]).reshape(-1)
+    batch = frames.shape[:-2]
+    flat = frames.reshape(batch + (-1,))
+    out = jnp.zeros(batch + (out_len,), flat.dtype)
+    out = out.at[..., idx].add(flat)
+    wsq = jnp.zeros((out_len,), window.dtype)
+    wsq = wsq.at[idx].add(jnp.broadcast_to(window * window,
+                                           (n_frames, n_fft)).reshape(-1))
+    out = out / jnp.where(wsq > 1e-11, wsq, 1.0)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:out_len - pad]
+    if length is not None:
+        out = out[..., :length]
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Reference: python/paddle/signal.py istft (overlap-add)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = unwrap(window)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    return _istft(x, win, n_fft=n_fft, hop_length=hop_length, center=center,
+                  normalized=normalized, onesided=onesided, length=length,
+                  return_complex=return_complex)

@@ -141,44 +141,8 @@ def matrix_power(x, *, n):
     return jnp.linalg.matrix_power(x, n)
 
 
-def qr(x, mode="reduced"):
-    q, r = jnp.linalg.qr(unwrap(x), mode=mode)
-    return wrap(q), wrap(r)
-
-
-def svd(x, full_matrices=False):
-    u, s, vh = jnp.linalg.svd(unwrap(x), full_matrices=full_matrices)
-    return wrap(u), wrap(s), wrap(jnp.swapaxes(vh, -1, -2))
-
-
-def eig(x):
-    w, v = jnp.linalg.eig(unwrap(x))
-    return wrap(w), wrap(v)
-
-
-def eigh(x, UPLO="L"):
-    w, v = jnp.linalg.eigh(unwrap(x), UPLO=UPLO)
-    return wrap(w), wrap(v)
-
-
-def eigvals(x):
-    return wrap(jnp.linalg.eigvals(unwrap(x)))
-
-
-def eigvalsh(x, UPLO="L"):
-    return wrap(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO))
-
-
-def lu(x):
-    lu_, piv = jax.scipy.linalg.lu_factor(unwrap(x))
-    return wrap(lu_), wrap(piv)
-
-
-@op_fn
-def lstsq_sol(x, y):
-    sol, _, _, _ = jnp.linalg.lstsq(x, y)
-    return sol
-
+# qr/svd/eig/eigh/lu/lstsq live in linalg_ext.py (taped, reference-
+# convention outputs — svd returns VH per tensor/linalg.py:2503).
 
 @op_fn
 def multi_dot_op(*xs):

@@ -1,0 +1,377 @@
+"""Linear-algebra decompositions and statistics ops.
+
+Reference capability: python/paddle/tensor/linalg.py (svd/qr/eig/lu/... —
+backed by phi LAPACK kernels, paddle/phi/kernels/cpu/svd_kernel.cc etc.).
+TPU-native: everything lowers through jnp.linalg / lax.linalg, which XLA
+compiles natively on TPU where supported (svd, qr, eigh, cholesky, lu)
+and via CPU callback semantics for the general complex eig family —
+matching the reference, whose eig is CPU-only too (eig_kernel.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "svd", "svd_lowrank", "pca_lowrank", "qr", "eig", "eigvals", "eigh",
+    "eigvalsh", "lu", "lu_unpack", "householder_product", "ormqr", "cond",
+    "cov", "corrcoef", "cdist", "dist", "mv", "inverse", "lstsq", "vander",
+    "histogram", "histogramdd", "vector_norm", "matrix_transpose", "addmm",
+]
+
+
+@op_fn(name="svd")
+def _svd(x, *, full_matrices=False):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+def svd(x, full_matrices=False, name=None):
+    return _svd(x, full_matrices=full_matrices)
+
+
+@op_fn(name="qr_op")
+def _qr(x, *, mode="reduced"):
+    if mode == "r":
+        return (jnp.linalg.qr(x, mode="r"),)
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+def qr(x, mode="reduced", name=None):
+    out = _qr(x, mode=mode)
+    return out[0] if mode == "r" else out
+
+
+@op_fn(differentiable=False)
+def eig(x):
+    w, v = jnp.linalg.eig(x)
+    return w, v
+
+
+@op_fn(differentiable=False, name="eigvals")
+def _eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvals(x, name=None):
+    return _eigvals(x)
+
+
+@op_fn(name="eigh_op")
+def _eigh(x, *, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+def eigh(x, UPLO="L", name=None):
+    return _eigh(x, UPLO=UPLO)
+
+
+@op_fn(name="eigvalsh_op")
+def _eigvalsh(x, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _eigvalsh(x, UPLO=UPLO)
+
+
+@op_fn(name="lu_op")
+def _lu(x):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32) + 1   # paddle pivots are 1-based
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = _lu(x)
+    if get_infos:
+        info = wrap(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization into P, L, U (reference:
+    python/paddle/tensor/linalg.py lu_unpack)."""
+    xa, piv = unwrap(x), unwrap(y)
+    m, n = xa.shape[-2], xa.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(xa[..., :, :k], -1) + jnp.eye(m, k, dtype=xa.dtype)
+        U = jnp.triu(xa[..., :k, :])
+    if unpack_pivots:
+        def perm_from_piv(p):
+            perm = jnp.arange(m)
+            def body(i, perm):
+                j = p[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj)
+                return perm.at[j].set(pi)
+            return jax.lax.fori_loop(0, p.shape[0], body, perm)
+        flat_piv = piv.reshape((-1, piv.shape[-1]))
+        perms = jax.vmap(perm_from_piv)(flat_piv)
+        perms = perms.reshape(piv.shape[:-1] + (m,))
+        P = jax.nn.one_hot(perms, m, dtype=xa.dtype)
+        P = jnp.swapaxes(P, -1, -2)
+    return wrap(P), wrap(L), wrap(U)
+
+
+@op_fn(name="householder_product_op")
+def _householder_product(x, tau):
+    # out = H_0 H_1 ... H_{k-1} [:, :n], H_i = I - tau_i v_i v_i^T
+    m, n = x.shape[-2], x.shape[-1]
+
+    def one(mat, t):
+        q = jnp.eye(m, dtype=mat.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, mat[:, i]))
+            h = jnp.eye(m, dtype=mat.dtype) - t[i] * jnp.outer(v, v)
+            return q @ h
+        q = jax.lax.fori_loop(0, t.shape[0], body, q)
+        return q[:, :n]
+
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.shape[:-2]
+    xf = x.reshape((-1,) + x.shape[-2:])
+    tf = tau.reshape((-1, tau.shape[-1]))
+    return jax.vmap(one)(xf, tf).reshape(batch + (m, n))
+
+
+def householder_product(x, tau, name=None):
+    return _householder_product(x, tau)
+
+
+@op_fn(name="ormqr_op")
+def _ormqr(x, tau, other, *, left=True, transpose=False):
+    # apply the k Householder reflectors H_i = I - tau_i v_i v_i^T to
+    # `other` directly (the LAPACK ormqr strategy — no explicit Q)
+    m = x.shape[-2]
+    k = tau.shape[-1]
+
+    def apply_one(c, i, right_side):
+        v = jnp.where(jnp.arange(m) < i, 0.0,
+                      jnp.where(jnp.arange(m) == i, 1.0, x[..., :, i]))
+        if right_side:
+            # c @ H = c - tau (c v) v^T
+            cv = c @ v
+            return c - tau[..., i] * jnp.outer(cv, v)
+        # H @ c = c - tau v (v^T c)
+        vc = v @ c
+        return c - tau[..., i] * jnp.outer(v, vc)
+
+    c = other
+    # left, no transpose: Q C = H_0 ... H_{k-1} C  (apply right-to-left)
+    # left, transpose:    Q^T C = H_{k-1} ... H_0 C
+    # right, no transpose: C Q = C H_0 ... H_{k-1} (apply left-to-right)
+    order = jnp.arange(k)
+    reverse = (left and not transpose) or (not left and transpose)
+    if reverse:
+        order = order[::-1]
+
+    def body(j, c):
+        return apply_one(c, order[j], right_side=not left)
+
+    return jax.lax.fori_loop(0, k, body, c)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q (implicit in Householder form) from a QR
+    (reference: tensor/linalg.py ormqr)."""
+    return _ormqr(x, tau, other, left=left, transpose=transpose)
+
+
+@op_fn(name="cond_op", differentiable=False)
+def _cond(x, *, p=None):
+    p = 2 if p is None else p
+    if p in (2, -2):
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return (s[..., 0] / s[..., -1]) if p == 2 else (s[..., -1] / s[..., 0])
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond(x, p=p)
+
+
+@op_fn(name="cov_op")
+def _cov(x, *, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _cov(x, rowvar=rowvar, ddof=ddof,
+                fweights=unwrap(fweights) if fweights is not None else None,
+                aweights=unwrap(aweights) if aweights is not None else None)
+
+
+@op_fn(name="corrcoef_op")
+def _corrcoef(x, *, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _corrcoef(x, rowvar=rowvar)
+
+
+@op_fn(name="cdist_op")
+def _cdist(x, y, *, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    if p == 0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    return _cdist(x, y, p=float(p))
+
+
+@op_fn(name="dist_op")
+def _dist(x, y, *, p=2.0):
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+def dist(x, y, p=2.0, name=None):
+    return _dist(x, y, p=float(p))
+
+
+@op_fn
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def inverse(x, name=None):
+    from .linalg import inv
+    return inv(x)
+
+
+@op_fn(name="lstsq_op")
+def _lstsq_full(x, y, *, rcond=None):
+    sol, res, rank_, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank_.astype(jnp.int32), sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return _lstsq_full(x, y, rcond=rcond)
+
+
+@op_fn(name="vander_op")
+def _vander(x, *, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _vander(x, n=n, increasing=increasing)
+
+
+@op_fn(name="histogram_op", differentiable=False)
+def _histogram(x, *, bins=100, min=0, max=0, weight=None, density=False):
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = jnp.min(x), jnp.max(x)
+        hi = jnp.where(hi == lo, lo + 1.0, hi)
+    hist, _ = jnp.histogram(x.reshape(-1),
+                            bins=bins, range=(lo, hi),
+                            weights=None if weight is None
+                            else weight.reshape(-1),
+                            density=density)
+    if density or weight is not None:
+        return hist
+    return hist.astype(jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    return _histogram(input, bins=bins, min=min, max=max,
+                      weight=unwrap(weight) if weight is not None else None,
+                      density=density)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xa = unwrap(x)
+    h, edges = jnp.histogramdd(xa, bins=bins, range=ranges, density=density,
+                               weights=unwrap(weights)
+                               if weights is not None else None)
+    return wrap(h), [wrap(e) for e in edges]
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: tensor/linalg.py svd_lowrank,
+    Halko et al. subspace iteration — deterministic start vectors here so
+    the op is jit-stable)."""
+    xa = unwrap(x)
+    if M is not None:
+        xa = xa - unwrap(M)
+    m, n = xa.shape[-2], xa.shape[-1]
+    q = min(q, m, n)
+    key = jax.random.key(0)
+    omega = jax.random.normal(key, xa.shape[:-2] + (n, q), xa.dtype)
+    y = xa @ omega
+    Q, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        # re-orthonormalize each power iteration (numerical stability —
+        # plain power iteration collapses the basis in float32)
+        Z, _ = jnp.linalg.qr(jnp.swapaxes(xa, -1, -2) @ Q)
+        Q, _ = jnp.linalg.qr(xa @ Z)
+    b = jnp.swapaxes(Q, -1, -2) @ xa
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return wrap(Q @ u), wrap(s), wrap(jnp.swapaxes(vh, -1, -2))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    xa = unwrap(x)
+    m, n = xa.shape[-2], xa.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        xa = xa - jnp.mean(xa, axis=-2, keepdims=True)
+    u, s, v = svd_lowrank(wrap(xa), q=q, niter=niter)
+    return u, s, v
+
+
+@op_fn(name="vector_norm_op")
+def _vector_norm(x, *, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    if axis is not None and isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _vector_norm(x, p=float(p), axis=axis, keepdim=keepdim)
+
+
+@op_fn
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@op_fn(name="addmm_op")
+def _addmm(input, x, y, *, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _addmm(input, x, y, beta=beta, alpha=alpha)

@@ -1,0 +1,438 @@
+"""Manipulation op long tail (paddle.tensor.manipulation parity).
+
+Reference capability: python/paddle/tensor/manipulation.py (split/scatter
+families, strided views). TPU-native: all views are functional gathers /
+slices — XLA turns contiguous slices into zero-copy bitcasts where
+possible, so there is no stride machinery to port.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._op import op_fn, unwrap, wrap
+
+# this module defines a public `slice` op (paddle API name) — keep a
+# handle on the builtin for internal indexing
+_py_slice = slice
+
+__all__ = [
+    "atleast_1d", "atleast_2d", "atleast_3d", "as_strided", "view",
+    "view_as", "unflatten", "expand_as", "tensor_split", "hsplit",
+    "vsplit", "dsplit", "select_scatter", "slice_scatter",
+    "diagonal_scatter", "index_fill", "index_sample", "masked_scatter",
+    "reverse", "slice", "strided_slice", "unique_consecutive", "unstack",
+    "shard_index", "kthvalue", "mode", "diag_embed", "broadcast_tensors",
+    "crop", "top_p_sampling", "is_empty", "tensor_unfold",
+]
+
+
+def is_empty(x, name=None):
+    return wrap(jnp.asarray(unwrap(x).size == 0))
+
+
+@op_fn(name="tensor_unfold_op")
+def _tensor_unfold(x, *, axis, size, step):
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    n_windows = (n - size) // step + 1
+    idx = jnp.arange(n_windows)[:, None] * step + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(x, axis, -1)
+    win = moved[..., idx]                     # [..., n_windows, size]
+    # paddle places the window axis where `axis` was, size last
+    return jnp.moveaxis(win, -2, axis)
+
+
+def tensor_unfold(x, axis, size, step, name=None):
+    """paddle.unfold on a Tensor (sliding windows along one axis;
+    reference: tensor/manipulation.py unfold). The nn.functional.unfold
+    (im2col) keeps the plain `unfold` name, as in the reference."""
+    return _tensor_unfold(x, axis=int(axis), size=int(size), step=int(step))
+
+
+def _atleast(nd):
+    def impl(*inputs, name=None):
+        outs = []
+        for x in inputs:
+            a = unwrap(x)
+            a = jnp.asarray(a)
+            while a.ndim < nd:
+                # paddle appends trailing dims for atleast_3d, leading for 1d/2d
+                if nd == 3 and a.ndim == 2:
+                    a = a[:, :, None]
+                else:
+                    a = a[None, ...]
+            outs.append(wrap(a))
+        return outs[0] if len(outs) == 1 else outs
+    return impl
+
+
+atleast_1d = _atleast(1)
+atleast_2d = _atleast(2)
+atleast_3d = _atleast(3)
+
+
+@op_fn(name="as_strided_op")
+def _as_strided(x, *, shape, stride, offset=0):
+    # functional gather equivalent of the strided view
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset)
+    for dim, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(dim) * st
+    return flat[idx.reshape(shape)]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    return _as_strided(x, shape=tuple(shape), stride=tuple(stride),
+                       offset=offset)
+
+
+def view(x, shape_or_dtype, name=None):
+    from ..core.dtype import convert_dtype
+    a = unwrap(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        from .manipulation import reshape
+        return reshape(x, shape=shape_or_dtype)
+    return wrap(a.view(convert_dtype(shape_or_dtype)))
+
+
+def view_as(x, other, name=None):
+    from .manipulation import reshape
+    return reshape(x, shape=list(unwrap(other).shape))
+
+
+@op_fn(name="unflatten_op")
+def _unflatten(x, *, axis, shape):
+    axis = axis % x.ndim
+    new = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return x.reshape(new)
+
+
+def unflatten(x, axis, shape, name=None):
+    shape = [int(s) for s in (unwrap(shape).tolist()
+                              if hasattr(unwrap(shape), "tolist") else shape)]
+    return _unflatten(x, axis=int(axis), shape=tuple(shape))
+
+
+def expand_as(x, y, name=None):
+    from .manipulation import broadcast_to
+    return broadcast_to(x, shape=list(unwrap(y).shape))
+
+
+def _split_indices(n, indices_or_sections, axis_len):
+    if isinstance(indices_or_sections, int):
+        return indices_or_sections
+    return [int(i) for i in indices_or_sections]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    a = unwrap(x)
+    pieces = jnp.array_split(a, _split_indices(a.shape[axis], num_or_indices,
+                                               a.shape[axis]), axis=axis)
+    return [wrap(p) for p in pieces]
+
+
+def hsplit(x, num_or_indices, name=None):
+    a = unwrap(x)
+    axis = 0 if a.ndim == 1 else 1
+    return tensor_split(x, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@op_fn(name="select_scatter_op")
+def _select_scatter(x, values, *, axis, index):
+    return jax.lax.dynamic_update_index_in_dim(
+        x, values.astype(x.dtype), index, axis)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    return _select_scatter(x, values, axis=int(axis), index=int(index))
+
+
+@op_fn(name="slice_scatter_op")
+def _slice_scatter(x, value, *, axes, starts, ends, strides):
+    idx = [_py_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = _py_slice(st, en, sd)
+    return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+def slice_scatter(x, value, axes=None, starts=None, ends=None, strides=None,
+                  name=None):
+    a = unwrap(x)
+    axes = list(range(a.ndim)) if axes is None else [int(v) for v in axes]
+    starts = [0] * len(axes) if starts is None else [int(v) for v in starts]
+    ends = ([a.shape[ax] for ax in axes] if ends is None
+            else [int(v) for v in ends])
+    strides = [1] * len(axes) if strides is None else [int(v) for v in strides]
+    return _slice_scatter(x, value, axes=tuple(axes), starts=tuple(starts),
+                          ends=tuple(ends), strides=tuple(strides))
+
+
+@op_fn(name="diagonal_scatter_op")
+def _diagonal_scatter(x, y, *, offset=0, axis1=0, axis2=1):
+    moved = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    m, n = moved.shape[-2], moved.shape[-1]
+    rows = jnp.arange(m)[:, None]
+    cols = jnp.arange(n)[None, :]
+    mask = (cols - rows) == offset
+    k = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+    diag = jnp.zeros(moved.shape, moved.dtype)
+    r0 = max(0, -offset)
+    c0 = max(0, offset)
+    upd = jnp.zeros(moved.shape[:-2] + (m, n), moved.dtype)
+    ii = jnp.arange(k)
+    upd = upd.at[..., r0 + ii, c0 + ii].set(y.astype(x.dtype))
+    out = jnp.where(mask, upd, moved)
+    return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal_scatter(x, y, offset=int(offset), axis1=int(axis1),
+                             axis2=int(axis2))
+
+
+@op_fn(name="index_fill_op")
+def _index_fill(x, index, *, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+def index_fill(x, index, axis, value, name=None):
+    from ..core.tensor import Tensor
+    if isinstance(value, Tensor):
+        value = unwrap(value)
+    return _index_fill(x, index, axis=int(axis), value=value)
+
+
+@op_fn(name="index_sample_op")
+def _index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+def index_sample(x, index, name=None):
+    return _index_sample(x, index)
+
+
+@op_fn(name="masked_scatter_op")
+def _masked_scatter(x, mask, value):
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    flat_m = mask_b.reshape(-1)
+    # position among True entries for each element
+    order = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+    src = value.reshape(-1)
+    take = jnp.clip(order, 0, src.shape[0] - 1)
+    return jnp.where(flat_m, src[take], x.reshape(-1)).reshape(x.shape)
+
+
+def masked_scatter(x, mask, value, name=None):
+    return _masked_scatter(x, mask, value)
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+    return flip(x, axis=axis)
+
+
+@op_fn(name="slice_op")
+def _slice(input, *, axes, starts, ends):
+    idx = [_py_slice(None)] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = _py_slice(st, en)
+    return input[tuple(idx)]
+
+
+def slice(input, axes, starts, ends):
+    starts = [int(unwrap(s)) if hasattr(s, "item") or hasattr(s, "_data")
+              else int(s) for s in starts]
+    ends = [int(unwrap(e)) if hasattr(e, "item") or hasattr(e, "_data")
+            else int(e) for e in ends]
+    return _slice(input, axes=tuple(int(a) for a in axes),
+                  starts=tuple(starts), ends=tuple(ends))
+
+
+@op_fn(name="strided_slice_op")
+def _strided_slice(x, *, axes, starts, ends, strides):
+    idx = [_py_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = _py_slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _strided_slice(x, axes=tuple(int(a) for a in axes),
+                          starts=tuple(int(s) for s in starts),
+                          ends=tuple(int(e) for e in ends),
+                          strides=tuple(int(s) for s in strides))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Deduplicate consecutive runs (reference: manipulation.py
+    unique_consecutive). Result size is data-dependent — eager-only, like
+    the reference's dynamic-shape ops."""
+    import numpy as np
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.ones(a.shape[0], bool)
+        keep[1:] = a[1:] != a[:-1]
+        out = a[keep]
+        results = [wrap(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            results.append(wrap(jnp.asarray(inv.astype(dtype))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, a.shape[0]))
+            results.append(wrap(jnp.asarray(counts.astype(dtype))))
+        return results[0] if len(results) == 1 else tuple(results)
+    moved = np.moveaxis(a, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    keep = np.ones(flat.shape[0], bool)
+    keep[1:] = (flat[1:] != flat[:-1]).any(axis=1)
+    out = np.moveaxis(moved[keep], 0, axis)
+    results = [wrap(jnp.asarray(out))]
+    if return_inverse:
+        results.append(wrap(jnp.asarray((np.cumsum(keep) - 1).astype(dtype))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, flat.shape[0]))
+        results.append(wrap(jnp.asarray(counts.astype(dtype))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    from .manipulation import unbind
+    return unbind(x, axis=axis)
+
+
+@op_fn(differentiable=False, name="shard_index_op")
+def _shard_index(input, *, index_num, nshards, shard_id, ignore_value):
+    size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    inside = (input >= lo) & (input < hi)
+    return jnp.where(inside, input - lo, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id ({shard_id}) must be in [0, {nshards})")
+    return _shard_index(input, index_num=index_num, nshards=nshards,
+                        shard_id=shard_id, ignore_value=ignore_value)
+
+
+@op_fn(name="kthvalue_op")
+def _kthvalue(x, *, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _kthvalue(x, k=int(k), axis=int(axis), keepdim=keepdim)
+
+
+@op_fn(name="mode_op")
+def _mode(x, *, axis=-1, keepdim=False):
+    moved = jnp.moveaxis(x, axis, -1)
+    srt = jnp.sort(moved, axis=-1)
+    arg = jnp.argsort(moved, axis=-1)
+    n = srt.shape[-1]
+    # run-length: count how many of the following entries equal this one
+    eq = srt[..., :, None] == srt[..., None, :]
+    counts = jnp.sum(eq, axis=-1)
+    best = jnp.argmax(counts, axis=-1)
+    v = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    i = jnp.take_along_axis(arg, best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _mode(x, axis=int(axis), keepdim=keepdim)
+
+
+@op_fn(name="diag_embed_op")
+def _diag_embed(input, *, offset=0, dim1=-2, dim2=-1):
+    last = input.shape[-1]
+    size = last + abs(offset)
+    out = jnp.zeros(input.shape[:-1] + (size, size), input.dtype)
+    ii = jnp.arange(last)
+    r0 = max(0, -offset)
+    c0 = max(0, offset)
+    out = out.at[..., r0 + ii, c0 + ii].set(input)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    # place the two new axes at dim1/dim2
+    order = {}
+    order[d1] = nd - 2
+    order[d2] = nd - 1
+    rest = iter(perm)
+    full = [order[i] if i in order else next(rest) for i in range(nd)]
+    return jnp.transpose(out, full)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    return _diag_embed(input, offset=int(offset), dim1=int(dim1),
+                       dim2=int(dim2))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [unwrap(i) for i in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [wrap(jnp.broadcast_to(a, shape)) for a in arrs]
+
+
+@op_fn(name="crop_op")
+def _crop(x, *, shape, offsets):
+    idx = tuple(_py_slice(o, o + s)
+                for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    a = unwrap(x)
+    shape = list(a.shape) if shape is None else [
+        a.shape[i] if int(s) == -1 else int(s) for i, s in enumerate(shape)]
+    offsets = [0] * a.ndim if offsets is None else [int(o) for o in offsets]
+    return _crop(x, shape=tuple(shape), offsets=tuple(offsets))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (reference: tensor/manipulation.py
+    top_p_sampling — phi top_p_sampling kernel). Returns (values, ids)."""
+    import numpy as np
+    a = unwrap(x)
+    p = unwrap(ps)
+    sorted_idx = jnp.argsort(-a, axis=-1)
+    sorted_logits = jnp.take_along_axis(a, sorted_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs <= p[..., None]          # keep first token always
+    masked = jnp.where(keep, probs, 0.0)
+    masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+    key = jax.random.key(np.random.randint(0, 2**31) if seed in (None, -1)
+                         else int(seed))
+    choice = jax.random.categorical(key, jnp.log(masked + 1e-30), axis=-1)
+    ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+    vals = jnp.take_along_axis(a, ids, axis=-1)
+    return wrap(vals), wrap(ids.astype(jnp.int64))

@@ -1,0 +1,375 @@
+"""Math op long tail (paddle.tensor math/special-function parity).
+
+Reference capability: python/paddle/tensor/math.py + the phi special-math
+kernels (i0/i1/polygamma/gammainc — paddle/phi/kernels/cpu/*_kernel.cc).
+TPU-native: everything is a jnp/lax one-liner compiled by XLA; special
+functions come from jax.scipy.special (native TPU lowerings), not bound
+C libraries.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "copysign", "nextafter", "i0", "i0e", "i1", "i1e", "sinc", "gammaln",
+    "gammainc", "gammaincc", "multigammaln", "logcumsumexp", "cummin",
+    "cummax", "nanmedian", "nanquantile", "neg", "sgn", "signbit",
+    "bitwise_left_shift", "bitwise_right_shift", "bucketize", "diff",
+    "cumulative_trapezoid", "frexp", "floor_mod", "remainder", "renorm",
+    "multiplex", "polar", "reduce_as", "take", "isneginf", "isposinf",
+    "isreal", "is_complex", "is_floating_point", "is_integer", "rank",
+    "increment", "add_n", "broadcast_shape",
+]
+
+
+@op_fn
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@op_fn(differentiable=False)
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@op_fn
+def i0(x):
+    return jsp.i0(x)
+
+
+@op_fn
+def i0e(x):
+    return jsp.i0e(x)
+
+
+@op_fn
+def i1(x):
+    return jsp.i1(x)
+
+
+@op_fn
+def i1e(x):
+    return jsp.i1e(x)
+
+
+@op_fn
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@op_fn
+def gammaln(x):
+    return jsp.gammaln(x)
+
+
+@op_fn
+def gammainc(x, y):
+    return jsp.gammainc(x, y)
+
+
+@op_fn
+def gammaincc(x, y):
+    return jsp.gammaincc(x, y)
+
+
+@op_fn(name="multigammaln_op")
+def _multigammaln(x, *, p=1):
+    return jsp.multigammaln(x, p)
+
+
+def multigammaln(x, p=1, name=None):
+    return _multigammaln(x, p=int(p))
+
+
+@op_fn(name="logcumsumexp")
+def _logcumsumexp(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    out = _logcumsumexp(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+@op_fn(name="cummin_op")
+def _cummin(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    n = x.shape[axis]
+    iota = jax.lax.broadcasted_iota(jnp.int64, x.shape, axis)
+    hit = x == jax.lax.cummin(x, axis=axis)
+    idx = jnp.where(hit, iota, -1)
+    idx = jax.lax.cummax(idx, axis=axis)
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    vals, idx = _cummin(x, axis=axis)
+    return vals, idx.astype(dtype) if dtype else idx
+
+
+@op_fn(name="cummax_op")
+def _cummax_full(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    iota = jax.lax.broadcasted_iota(jnp.int64, x.shape, axis)
+    hit = x == vals
+    idx = jnp.where(hit, iota, -1)
+    idx = jax.lax.cummax(idx, axis=axis)
+    return vals, idx
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    vals, idx = _cummax_full(x, axis=axis)
+    return vals, idx.astype(dtype) if dtype else idx
+
+
+@op_fn(name="nanmedian_op")
+def _nanmedian(x, *, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _nanmedian(x, axis=axis, keepdim=keepdim)
+
+
+@op_fn(name="nanquantile_op")
+def _nanquantile(x, *, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _nanquantile(x, q=q, axis=axis, keepdim=keepdim,
+                        interpolation=interpolation)
+
+
+@op_fn
+def neg(x):
+    return -x
+
+
+@op_fn
+def sgn(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+@op_fn(differentiable=False)
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@op_fn(differentiable=False)
+def bitwise_left_shift(x, y, *, is_arithmetic=True):
+    return jnp.left_shift(x, y)
+
+
+@op_fn(differentiable=False)
+def bitwise_right_shift(x, y, *, is_arithmetic=True):
+    return (jnp.right_shift(x, y) if is_arithmetic
+            else jax.lax.shift_right_logical(x, y))
+
+
+@op_fn(differentiable=False, name="bucketize_op")
+def _bucketize(x, sorted_sequence, *, out_int32=False, right=False):
+    side = "right" if right else "left"
+    idx = jnp.searchsorted(sorted_sequence, x, side=side)
+    return idx.astype(jnp.int32) if out_int32 else idx.astype(jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return _bucketize(x, sorted_sequence, out_int32=out_int32, right=right)
+
+
+@op_fn(name="diff_op")
+def _diff(x, *, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _diff(x, n=n, axis=axis,
+                 prepend=unwrap(prepend) if prepend is not None else None,
+                 append=unwrap(append) if append is not None else None)
+
+
+@op_fn(name="cumulative_trapezoid_op")
+def _cumulative_trapezoid(y, *, x=None, dx=None, axis=-1):
+    n = y.shape[axis]
+    y0 = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        x0 = jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)
+        x1 = jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+        d = x1 - x0
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum((y0 + y1) * d / 2.0, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return _cumulative_trapezoid(
+        y, x=unwrap(x) if x is not None else None, dx=dx, axis=axis)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(unwrap(x))
+    return wrap(m), wrap(e.astype(jnp.int32))
+
+
+@op_fn
+def floor_mod(x, y):
+    return jnp.mod(x, y)
+
+
+def remainder(x, y, name=None):
+    from .math import mod
+    return mod(x, y)
+
+
+@op_fn(name="renorm_op")
+def _renorm(x, *, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm,
+                       max_norm / (norms + 1e-7), 1.0)
+    flat = flat * factor[:, None]
+    return jnp.moveaxis(flat.reshape(moved.shape), 0, axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _renorm(x, p=float(p), axis=int(axis), max_norm=float(max_norm))
+
+
+@op_fn(name="multiplex_op")
+def _multiplex(*inputs, index):
+    stacked = jnp.stack(inputs, axis=0)     # [n, batch, ...]
+    sel = index.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[sel, rows]
+
+
+def multiplex(inputs, index, name=None):
+    return _multiplex(*[unwrap(i) for i in inputs], index=unwrap(index))
+
+
+@op_fn
+def polar(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+@op_fn(name="reduce_as_op")
+def _reduce_as(x, *, target_shape):
+    # sum x down to target_shape (reference: tensor/math.py reduce_as)
+    ndiff = x.ndim - len(target_shape)
+    axes = list(range(ndiff))
+    for i, (xs, ts) in enumerate(zip(x.shape[ndiff:], target_shape)):
+        if ts == 1 and xs != 1:
+            axes.append(ndiff + i)
+    out = jnp.sum(x, axis=tuple(axes), keepdims=False) if axes else x
+    return out.reshape(target_shape)
+
+
+def reduce_as(x, target, name=None):
+    return _reduce_as(x, target_shape=tuple(unwrap(target).shape))
+
+
+@op_fn(name="take_op")
+def _take(x, index, *, mode="raise"):
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int64)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:   # 'raise': negative wraps once (paddle semantics under jit)
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"'mode' must be raise/wrap/clip, got {mode}")
+    return _take(x, index, mode=mode)
+
+
+@op_fn(differentiable=False)
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@op_fn(differentiable=False)
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@op_fn(differentiable=False)
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def rank(input):
+    return wrap(jnp.asarray(unwrap(input).ndim, jnp.int32))
+
+
+def increment(x, value=1.0, name=None):
+    """In-place increment (reference: tensor/math.py increment — mutation
+    is rebinding on the Tensor facade)."""
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x._data = x._data + value
+        return x
+    return wrap(x + value)
+
+
+@op_fn(name="add_n_op")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    return _add_n(*inputs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,4 @@
+"""paddle.signal namespace parity (reference: python/paddle/signal.py)."""
+from .ops.fft_ops import istft, stft  # noqa
+
+__all__ = ['stft', 'istft']

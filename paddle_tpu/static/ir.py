@@ -1,0 +1,259 @@
+"""Static-graph IR: Program / Block / Operator records.
+
+Reference capability: the PIR program layer (paddle/pir/ — Program, Block,
+Operation) and python/paddle/base/framework.py Program. TPU-native
+redesign: an op here is a *pure JAX function* plus symbolic in/out vars;
+"lowering" is replaying the recorded ops under jax.jit, so the executable
+form is exactly the XLA program and every PIR pass the reference needs for
+correctness (DCE, fusion, layout) is delegated to XLA. The IR's jobs are
+the ones XLA can't do: deferred construction (build now, feed later),
+inspectability (op listing / var naming), and program-as-artifact
+(serialize via jax.export in static.save_inference_model).
+
+Symbolic variables ride the SAME Tensor facade as eager values —
+``Tensor._data`` holds a jax.ShapeDtypeStruct and ``Tensor._symbolic`` is
+the Var record; the op dispatcher (ops/_op.py) sees a symbolic input and
+records an Operator instead of executing.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_name_counter = itertools.count()
+
+
+class _ParamRef:
+    """A live reference to an eager Parameter inside an op's argument
+    template. Replay reads ``param._data`` at call time (and the compiled
+    runner takes the array as an input), so weight updates between
+    Executor.run calls are visible — the reference's scope-backed weight
+    semantics without a scope."""
+
+    __slots__ = ("param",)
+
+    def __init__(self, param):
+        self.param = param
+
+    def __repr__(self):
+        return f"_ParamRef({getattr(self.param, 'name', None)})"
+
+
+class Var:
+    """A symbolic value in a Program (reference: pir::Value / the old
+    framework.Variable)."""
+
+    __slots__ = ("name", "shape", "dtype", "program", "producer", "slot",
+                 "none_axes")
+
+    def __init__(self, name, shape, dtype, program, producer=None, slot=0,
+                 none_axes=()):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.program = program
+        self.producer = producer    # Operator or None (feed/constant)
+        self.slot = slot
+        # axes declared None/-1 by static.data — concretized to 1 for
+        # shape inference, exported as symbolic dims by
+        # save_inference_model so the artifact stays batch-polymorphic
+        self.none_axes = tuple(none_axes)
+
+    def __repr__(self):
+        return f"Var({self.name}: {list(self.shape)}x{self.dtype})"
+
+
+class Operator:
+    """One recorded op application (reference: pir::Operation)."""
+
+    __slots__ = ("type", "fn", "arg_template", "var_positions", "kwargs",
+                 "inputs", "outputs")
+
+    def __init__(self, type_, fn, arg_template, var_positions, kwargs,
+                 inputs, outputs):
+        self.type = type_
+        self.fn = fn                      # the pure jax fn
+        # arg_template: list of concrete values with None at var positions
+        self.arg_template = arg_template
+        self.var_positions = var_positions  # positions filled from inputs
+        self.kwargs = kwargs
+        self.inputs: List[Var] = inputs
+        self.outputs: List[Var] = outputs
+
+    def __repr__(self):
+        ins = ", ".join(v.name for v in self.inputs)
+        outs = ", ".join(v.name for v in self.outputs)
+        return f"{outs} = {self.type}({ins})"
+
+
+class Block:
+    """Reference: pir::Block — a straight-line op list here (control flow
+    is in-op via lax.cond/scan, the XLA-native form)."""
+
+    def __init__(self, program):
+        self.program = program
+        self.ops: List[Operator] = []
+        self.vars: Dict[str, Var] = {}
+
+
+class Program:
+    """Reference: base/framework.py Program / pir Program."""
+
+    def __init__(self):
+        self.blocks = [Block(self)]
+        self.feed_vars: Dict[str, Var] = {}
+        self._jit_cache: Dict[tuple, Any] = {}
+
+    # -- build-side --------------------------------------------------------
+    @property
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def new_var_name(self, hint="tmp"):
+        return f"{hint}_{next(_name_counter)}"
+
+    def add_feed(self, name, shape, dtype) -> Tensor:
+        none_axes = tuple(i for i, d in enumerate(shape)
+                          if d is None or (isinstance(d, int) and d < 0))
+        shape = tuple(1 if (d is None or d < 0) else int(d) for d in shape)
+        var = Var(name, shape, dtype, self, none_axes=none_axes)
+        self.feed_vars[name] = var
+        self.global_block.vars[name] = var
+        t = Tensor(jax.ShapeDtypeStruct(shape, dtype))
+        t._symbolic = var
+        t.stop_gradient = True
+        return t
+
+    def record_op(self, type_, fn, args, kwargs, out_structs):
+        """Called by the op dispatcher in static-build mode. Tensor values
+        in ``kwargs`` are recorded too (as Var / _ParamRef entries resolved
+        at replay)."""
+        from ..core.tensor import Parameter
+        blk = self.global_block
+        inputs, var_positions, template = [], [], []
+
+        def encode(a):
+            sym = getattr(a, "_symbolic", None) if isinstance(a, Tensor) \
+                else None
+            if sym is not None:
+                return sym
+            if isinstance(a, Parameter):
+                return _ParamRef(a)
+            if isinstance(a, Tensor):
+                return a._data
+            return a
+
+        for i, a in enumerate(args):
+            enc = encode(a)
+            if isinstance(enc, Var):
+                inputs.append(enc)
+                var_positions.append(i)
+                template.append(None)
+            else:
+                template.append(enc)
+        kwargs = {k: encode(v) for k, v in kwargs.items()}
+        outputs = []
+        out_tensors = []
+        for slot, ss in enumerate(out_structs):
+            name = self.new_var_name(type_)
+            var = Var(name, ss.shape, ss.dtype, self, slot=slot)
+            blk.vars[name] = var
+            outputs.append(var)
+            t = Tensor(jax.ShapeDtypeStruct(tuple(ss.shape), ss.dtype))
+            t._symbolic = var
+            t.stop_gradient = True
+            out_tensors.append(t)
+        op = Operator(type_, fn, template, var_positions, kwargs, inputs,
+                      outputs)
+        for v in outputs:
+            v.producer = op
+        blk.ops.append(op)
+        return out_tensors
+
+    # -- inspect -----------------------------------------------------------
+    def ops(self) -> List[Operator]:
+        return list(self.global_block.ops)
+
+    def all_vars(self) -> List[Var]:
+        return list(self.global_block.vars.values())
+
+    def __str__(self):
+        lines = [f"Program (feeds: {list(self.feed_vars)})"]
+        for op in self.global_block.ops:
+            lines.append(f"  {op!r}")
+        return "\n".join(lines)
+
+    # -- execute -----------------------------------------------------------
+    def param_refs(self, ops: Optional[Sequence[Operator]] = None
+                   ) -> List[_ParamRef]:
+        """All distinct live-parameter references, in first-use order."""
+        refs, seen = [], set()
+        for op in (self.global_block.ops if ops is None else ops):
+            for entry in list(op.arg_template) + list(op.kwargs.values()):
+                if isinstance(entry, _ParamRef) and id(entry.param) not in seen:
+                    seen.add(id(entry.param))
+                    refs.append(entry)
+        return refs
+
+    def _replay_env(self, env: Dict[str, Any], fetch_vars: Sequence[Var],
+                    param_overrides: Optional[Dict[int, Any]] = None,
+                    ops: Optional[Sequence[Operator]] = None):
+        """Topological replay (ops are recorded in order). ``env`` maps var
+        names to arrays; parameters resolve to ``param_overrides`` (keyed by
+        id(param)) or the live ``param._data``. ``ops`` restricts replay to
+        a snapshot (append_backward replays the forward slice only)."""
+        def resolve(entry):
+            if isinstance(entry, _ParamRef):
+                if param_overrides is not None \
+                        and id(entry.param) in param_overrides:
+                    return param_overrides[id(entry.param)]
+                return entry.param._data
+            if isinstance(entry, Var):
+                return env[entry.name]
+            return entry
+
+        for op in (self.global_block.ops if ops is None else ops):
+            args = [resolve(e) for e in op.arg_template]
+            for pos, var in zip(op.var_positions, op.inputs):
+                args[pos] = env[var.name]
+            kw = {k: resolve(v) for k, v in op.kwargs.items()}
+            out = op.fn(*args, **kw)
+            outs = out if isinstance(out, tuple) else (out,)
+            for var, o in zip(op.outputs, outs):
+                env[var.name] = o
+        return tuple(env[v.name] for v in fetch_vars)
+
+    def compile(self, fetch_vars: Sequence[Var]):
+        """One jitted executable per (feed-signature, fetch-list) — the
+        _ExecutorCache equivalent (reference: base/executor.py:857). Live
+        parameters are jit INPUTS (not baked constants) so weight updates
+        between runs don't force recompiles."""
+        refs = self.param_refs()
+
+        def run(feed_arrays, param_arrays):
+            overrides = {id(r.param): a for r, a in zip(refs, param_arrays)}
+            return self._replay_env(dict(feed_arrays), fetch_vars, overrides)
+
+        return jax.jit(run), refs
+
+    def run(self, feed: Dict[str, Any], fetch_vars: Sequence[Var]):
+        feed_arrays = {}
+        for name, v in feed.items():
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(
+                np.asarray(v))
+            feed_arrays[name] = arr
+        key = (tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                            for n, a in feed_arrays.items())),
+               tuple(v.name for v in fetch_vars))
+        entry = self._jit_cache.get(key)
+        if entry is None:
+            entry = self.compile(fetch_vars)
+            self._jit_cache[key] = entry
+        jitted, refs = entry
+        return jitted(feed_arrays, [r.param._data for r in refs])

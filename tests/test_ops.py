@@ -216,8 +216,8 @@ class TestLinalg:
 
     def test_svd_qr(self):
         m = np.random.randn(4, 3).astype(np.float32)
-        u, s, v = pt.svd(T(m))
-        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        u, s, vh = pt.svd(T(m))   # reference convention: x = U diag(S) VH
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
         np.testing.assert_allclose(rec, m, rtol=1e-3, atol=1e-4)
         q, r = pt.qr(T(m))
         np.testing.assert_allclose(q.numpy() @ r.numpy(), m, rtol=1e-3, atol=1e-4)

@@ -1,0 +1,280 @@
+"""paddle.static + paddle.inference tests.
+
+Reference strategy: test/legacy_test/test_executor_* (feed/fetch parity),
+test_inference_api.py (predictor IO binding), save in one process and
+serve in a *fresh* process (the deploy contract).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+
+
+class TestStaticProgram:
+    def test_build_inspect_run(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = pt.exp(x) * 2.0
+            z = pt.sum(y, axis=1)
+        assert len(main.ops()) >= 2
+        assert "exp" in str(main)
+        exe = static.Executor()
+        xin = np.random.randn(3, 4).astype("float32")
+        (zout,) = exe.run(main, feed={"x": xin}, fetch_list=[z])
+        np.testing.assert_allclose(zout, (np.exp(xin) * 2).sum(1), rtol=1e-5)
+
+    def test_multiple_feeds_and_fetches(self):
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data("a", [2, 3], "float32")
+            b = static.data("b", [2, 3], "float32")
+            s = a + b
+            p = a * b
+        exe = static.Executor()
+        an = np.random.randn(2, 3).astype("float32")
+        bn = np.random.randn(2, 3).astype("float32")
+        souts = exe.run(main, feed={"a": an, "b": bn}, fetch_list=[s, p])
+        np.testing.assert_allclose(souts[0], an + bn, rtol=1e-6)
+        np.testing.assert_allclose(souts[1], an * bn, rtol=1e-6)
+
+    def test_layer_params_live(self):
+        """Parameters used by a Layer under program_guard are read live at
+        each run — an update between runs changes the output without a
+        recompile-and-bake."""
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 4], "float32")
+            y = lin(x)
+        exe = static.Executor()
+        xin = np.ones((1, 4), "float32")
+        (y1,) = exe.run(main, feed={"x": xin}, fetch_list=[y])
+        lin.weight.set_value(pt.to_tensor(lin.weight.numpy() * 2))
+        (y2,) = exe.run(main, feed={"x": xin}, fetch_list=[y])
+        b = lin.bias.numpy()
+        np.testing.assert_allclose(y2 - b, (y1 - b) * 2, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_append_backward_matches_eager(self):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 1)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 4], "float32")
+            loss = pt.mean(lin(x) ** 2)
+        grads = static.append_backward(loss)
+        assert len(grads) == 2   # weight + bias
+        exe = static.Executor()
+        xin = np.random.randn(8, 4).astype("float32")
+        outs = exe.run(main, feed={"x": xin},
+                       fetch_list=[loss] + [g for _, g in grads])
+
+        # eager reference
+        xe = pt.to_tensor(xin)
+        le = pt.mean(lin(xe) ** 2)
+        le.backward()
+        np.testing.assert_allclose(outs[0], le.numpy(), rtol=1e-5)
+        eager = {id(lin.weight): lin.weight.grad.numpy(),
+                 id(lin.bias): lin.bias.grad.numpy()}
+        for (p, _), got in zip(grads, outs[1:]):
+            np.testing.assert_allclose(got, eager[id(p)], rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_static_training_loop_converges(self):
+        """The build-once/run-many static training workflow (reference:
+        Executor-driven fit loops) — manual SGD on fetched grads."""
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 1)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [16, 4], "float32")
+            t = static.data("t", [16, 1], "float32")
+            loss = pt.mean((lin(x) - t) ** 2)
+        grads = static.append_backward(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+
+        rng = np.random.default_rng(0)
+        xin = rng.normal(size=(16, 4)).astype("float32")
+        tgt = (xin @ rng.normal(size=(4, 1)).astype("float32") + 0.3)
+        first = None
+        for i in range(60):
+            outs = exe.run(main, feed={"x": xin, "t": tgt.astype("float32")},
+                           fetch_list=[loss] + [g for _, g in grads])
+            if first is None:
+                first = outs[0]
+            for (p, _), g in zip(grads, outs[1:]):
+                p.set_value(pt.to_tensor(p.numpy() - 0.1 * g))
+        assert outs[0] < 0.05 * first
+
+    def test_enable_disable_static(self):
+        assert pt.in_dynamic_mode()
+        pt.enable_static()
+        assert pt.in_static_mode()
+        pt.disable_static()
+        assert pt.in_dynamic_mode()
+
+    def test_save_load_inference_model(self, tmp_path):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            y = pt.tanh(x) * 3.0
+        exe = static.Executor()
+        prefix = str(tmp_path / "m")
+        static.save_inference_model(prefix, [x], [y], exe)
+        prog, feed_names, fetch_names = static.load_inference_model(
+            prefix, exe)
+        xin = np.random.randn(2, 4).astype("float32")
+        (out,) = prog.run({feed_names[0]: xin})
+        np.testing.assert_allclose(out, np.tanh(xin) * 3.0, rtol=1e-5)
+
+
+class TestPredictor:
+    def _save_artifact(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import InputSpec
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                return pt.nn.functional.softmax(self.fc(x), axis=-1)
+
+        net = Net()
+        prefix = str(tmp_path / "net")
+        pt.jit.save(net, prefix, input_spec=[InputSpec([None, 4],
+                                                       "float32")])
+        xin = np.random.randn(5, 4).astype("float32")
+        expect = net(pt.to_tensor(xin)).numpy()
+        return prefix, xin, expect
+
+    def test_predictor_handles(self, tmp_path):
+        from paddle_tpu import inference
+        prefix, xin, expect = self._save_artifact(tmp_path)
+        cfg = inference.Config(prefix)
+        pred = inference.create_predictor(cfg)
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(xin)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_predictor_positional_run(self, tmp_path):
+        from paddle_tpu import inference
+        prefix, xin, expect = self._save_artifact(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        (out,) = pred.run([xin])
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_fresh_process_serving(self, tmp_path):
+        """Save here; serve through the Predictor API in a NEW python
+        process (the reference deploy contract: no model class, no saver
+        state — just the artifact)."""
+        prefix, xin, expect = self._save_artifact(tmp_path)
+        np.save(str(tmp_path / "x.npy"), xin)
+        script = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu import inference
+pred = inference.create_predictor(inference.Config({prefix!r}))
+x = np.load({str(tmp_path / 'x.npy')!r})
+(out,) = pred.run([x])
+np.save({str(tmp_path / 'out.npy')!r}, out)
+print("SERVED_OK")
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=240)
+        assert "SERVED_OK" in r.stdout, r.stderr[-2000:]
+        out = np.load(str(tmp_path / "out.npy"))
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_config_surface(self, tmp_path):
+        from paddle_tpu import inference
+        prefix, _, _ = self._save_artifact(tmp_path)
+        cfg = inference.Config(prefix)
+        cfg.disable_gpu()
+        cfg.switch_ir_optim(True)
+        assert cfg.ir_optim()
+        assert prefix in cfg.summary()
+        with pytest.raises(FileNotFoundError):
+            inference.create_predictor(inference.Config(str(tmp_path / "no")))
+
+
+class TestReviewRegressions:
+    def test_append_backward_sees_frozen_param_updates(self):
+        """Frozen params are live grad-op inputs, not baked constants."""
+        import paddle_tpu.nn as nn
+        l1 = nn.Linear(4, 4)
+        l2 = nn.Linear(4, 1)
+        for p in l2.parameters():
+            p.stop_gradient = True      # freeze l2
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 4], "float32")
+            loss = pt.mean(l2(l1(x)) ** 2)
+        grads = static.append_backward(loss)
+        assert all(id(p) in {id(q) for q in l1.parameters()}
+                   for p, _ in grads)
+        exe = static.Executor()
+        xin = np.random.randn(4, 4).astype("float32")
+        g1 = exe.run(main, feed={"x": xin},
+                     fetch_list=[g for _, g in grads])
+        # change the FROZEN weight; cached grad executable must see it
+        l2.weight.set_value(pt.to_tensor(l2.weight.numpy() * 3.0))
+        g2 = exe.run(main, feed={"x": xin},
+                     fetch_list=[g for _, g in grads])
+        assert not np.allclose(g1[0], g2[0])
+        # eager check of the post-update grads
+        xe = pt.to_tensor(xin)
+        le = pt.mean(l2(l1(xe)) ** 2)
+        le.backward()
+        np.testing.assert_allclose(g2[0], l1.weight.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_saved_artifact_is_batch_polymorphic(self, tmp_path):
+        """None dims in static.data stay symbolic in the saved artifact."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = pt.tanh(x)
+        prefix = str(tmp_path / "poly")
+        static.save_inference_model(prefix, [x], [y], static.Executor())
+        prog, feed_names, _ = static.load_inference_model(
+            prefix, static.Executor())
+        for bs in (1, 8):
+            xin = np.random.randn(bs, 4).astype("float32")
+            (out,) = prog.run({feed_names[0]: xin})
+            np.testing.assert_allclose(out, np.tanh(xin), rtol=1e-5)
+
+    def test_symbolic_kwarg_recorded(self):
+        """A symbolic tensor passed via keyword records as a program var."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            m = static.data("m", [3], "bool")
+            out = pt.masked_fill(x, m, value=0.0)
+            # symbolic kwarg: where(cond, x, y=kw)
+            out2 = pt.where(m, x, y=out)
+        exe = static.Executor()
+        xin = np.array([1.0, -2.0, 3.0], "float32")
+        mn = np.array([True, False, True])
+        o1, o2 = exe.run(main, feed={"x": xin, "m": mn},
+                         fetch_list=[out, out2])
+        np.testing.assert_allclose(o1, np.where(mn, 0.0, xin))
+        np.testing.assert_allclose(o2, np.where(mn, xin, o1))
