@@ -16,9 +16,9 @@ Layering (TPU-native):
 """
 from . import comm_ops  # noqa
 from .api import (ShardingStage1, ShardingStage2, ShardingStage3,  # noqa
-                  dtensor_from_fn, reshard, shard_dataloader, shard_layer,
-                  shard_optimizer, shard_scaler, shard_tensor,
-                  unshard_dtensor)
+                  dtensor_from_fn, per_device_bytes, reshard,
+                  shard_dataloader, shard_layer, shard_optimizer,
+                  shard_scaler, shard_tensor, unshard_dtensor)
 from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
                          all_reduce, alltoall, alltoall_single, barrier,
                          broadcast, broadcast_object_list,
@@ -32,7 +32,9 @@ from .placement import Partial, Placement, ReduceType, Replicate, Shard  # noqa
 from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa
 
 from . import fleet  # noqa  (hybrid-parallel programming model)
+from . import launch  # noqa  (the launch CLI: python -m ...distributed.launch)
 from . import pipeline  # noqa  (collective-permute PP schedules)
+from .spawn import spawn  # noqa
 from .parallel import DataParallel  # noqa
 from . import checkpoint  # noqa
 from .checkpoint import load_state_dict, save_state_dict  # noqa

@@ -32,7 +32,11 @@ def is_initialized() -> bool:
 def init_parallel_env():
     """Reference: parallel.py init_parallel_env — rendezvous + process group
     bring-up. Here: jax.distributed.initialize when multi-host env vars are
-    present (coordination service over DCN); single-host is a no-op."""
+    present (coordination service over DCN); single-host is a no-op.
+
+    NOTE: must run before anything touches the XLA backend — so the env-var
+    check comes first and no jax query (process_count/devices) happens
+    before initialize."""
     global _initialized
     if _initialized:
         return
@@ -40,11 +44,17 @@ def init_parallel_env():
         os.environ.get("MASTER_ADDR")
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    if coord and nprocs > 1 and jax.process_count() == 1:
-        port = os.environ.get("MASTER_PORT", "8476")
-        jax.distributed.initialize(
-            coordinator_address=f"{coord.split(':')[0]}:{port}",
-            num_processes=nprocs, process_id=pid)
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", coord.split(":")[-1]
+                              if ":" in coord else "8476")
+        try:
+            already = jax.distributed.is_initialized()
+        except AttributeError:   # older jax
+            already = False
+        if not already:
+            jax.distributed.initialize(
+                coordinator_address=f"{coord.split(':')[0]}:{port}",
+                num_processes=nprocs, process_id=pid)
     _initialized = True
 
 

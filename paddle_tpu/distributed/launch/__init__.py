@@ -1,0 +1,3 @@
+"""paddle.distributed.launch parity package (reference:
+python/paddle/distributed/launch/__init__.py)."""
+from .main import launch, main  # noqa

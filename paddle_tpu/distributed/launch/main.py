@@ -1,0 +1,140 @@
+"""Distributed launch controller.
+
+Reference capability: python/paddle/distributed/launch/main.py:21 (the
+``python -m paddle.distributed.launch`` CLI) + controllers/collective.py:22
+(CollectiveController: build per-rank envs, spawn, watch) + the failure
+detection in controllers/watcher.py. TPU-native redesign: one process per
+HOST (not per chip — XLA drives all local chips from one controller), with
+rendezvous via jax.distributed's coordination service instead of TCPStore;
+env-var names keep the PADDLE_* spelling so reference launch scripts port
+unchanged.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle.distributed.launch parity CLI")
+    p.add_argument("--nproc_per_node", "--nprocs", type=int, default=None,
+                   help="processes on this node (default: 1; on TPU one "
+                        "process drives all local chips)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (defaults to 127.0.0.1 with "
+                        "a free port for single-node runs)")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference-CLI parity (XLA owns "
+                        "device selection)")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("script", help="training script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
+           master=None, log_dir=None, job_id="default",
+           extra_env=None) -> int:
+    """Spawn ``nproc_per_node`` worker processes with rendezvous env and
+    watch them (reference: CollectiveController.run). Returns the exit
+    code: 0 iff every worker exited 0; on any failure the remaining
+    workers are terminated (the watcher's fail-fast)."""
+    if master is None:
+        master = f"127.0.0.1:{_free_port()}"
+    host, port = master.rsplit(":", 1)
+    world = nnodes * nproc_per_node
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    procs = []
+    logs = []
+    for local in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_RANK_IN_NODE": str(local),
+            "PADDLE_LOCAL_SIZE": str(nproc_per_node),
+            "PADDLE_MASTER": master,
+            "MASTER_ADDR": host,
+            "MASTER_PORT": port,
+            "PADDLE_JOB_ID": str(job_id),
+        })
+        env.update(extra_env or {})
+        if log_dir:
+            log = open(os.path.join(log_dir, f"workerlog.{rank}"), "wb")
+            out = err = log
+        else:
+            log = None
+            out = err = None
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", script, *script_args], env=env,
+            stdout=out, stderr=err))
+
+    rc = 0
+    try:
+        alive = set(range(len(procs)))
+        while alive:
+            time.sleep(0.2)
+            for i in list(alive):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                alive.discard(i)
+                if r != 0:
+                    # fail fast: one dead worker kills the job
+                    # (reference: watcher peer-failure propagation)
+                    rc = r
+                    for j in alive:
+                        procs[j].terminate()
+                    deadline = time.time() + 10
+                    for j in alive:
+                        try:
+                            procs[j].wait(max(0.1,
+                                              deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            procs[j].kill()
+                    alive.clear()
+    except KeyboardInterrupt:
+        for pr in procs:
+            pr.send_signal(signal.SIGTERM)
+        rc = 130
+    finally:
+        for log in logs:
+            if log:
+                log.close()
+    return rc
+
+
+def main(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    nproc = args.nproc_per_node or 1
+    rc = launch(args.script, args.script_args, nproc_per_node=nproc,
+                nnodes=args.nnodes, node_rank=args.node_rank,
+                master=args.master, log_dir=args.log_dir,
+                job_id=args.job_id)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,10 +39,20 @@ class DataParallel(Layer):
     @contextlib.contextmanager
     def no_sync(self):
         """Reference: parallel.py no_sync — skip grad allreduce inside.
-        Gradient reduction here is part of the compiled backward over the
-        sharded batch, and grad-accumulation steps simply don't resync
-        because accumulation happens on the already-reduced global value;
-        the context is kept for API parity."""
+
+        Semantics here: gradient reduction is part of the compiled backward
+        over the dp-sharded batch, so accumulated microstep grads are
+        already exact — accumulate-then-step under no_sync produces the
+        same update as one big batch (tested in
+        tests/test_distributed.py::test_no_sync_accumulation_parity).
+
+        Cost note (documented delta): each eager microstep's backward still
+        executes its grad reduction — the reduction is fused into the
+        compiled backward, not deferrable from Python. To also SAVE the
+        per-microstep reduction bandwidth the way the reference's bucketed
+        reducer does, jit the whole accumulation loop (paddle_tpu.jit /
+        make_train_step with lax.scan over microbatches): XLA then reduces
+        once per accumulation window."""
         yield
 
     def scale_loss(self, loss):

@@ -1,0 +1,56 @@
+"""paddle.distributed.spawn parity.
+
+Reference: python/paddle/distributed/spawn.py — run ``func(*args)`` in
+``nprocs`` fresh processes with the rendezvous env prepared. Uses the
+'spawn' start method so each worker gets a clean interpreter (jax must
+initialize per process).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Sequence
+
+__all__ = ["spawn"]
+
+
+def _worker(func, args, rank, nprocs, master, backend):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_RANK_IN_NODE": str(rank),
+        "PADDLE_LOCAL_SIZE": str(nprocs),
+        "PADDLE_MASTER": master,
+        "MASTER_ADDR": master.rsplit(":", 1)[0],
+        "MASTER_PORT": master.rsplit(":", 1)[1],
+    })
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """Reference: spawn(func, args, nprocs, join). Returns the context
+    (list of processes) when join=False."""
+    from .launch.main import _free_port
+    master = options.get("master") or f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, master,
+                              options.get("backend", "xla")),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    failed = []
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            failed.append(p.exitcode)
+    if failed:
+        raise RuntimeError(
+            f"spawn: {len(failed)} worker(s) failed with exit codes "
+            f"{failed}")
+    return procs

@@ -16,10 +16,13 @@ import jax
 
 
 class Generator:
-    """Stateful key generator (eager mode)."""
+    """Stateful key generator (eager mode). The key materializes lazily —
+    building it at import time would initialize the XLA backend before
+    jax.distributed.initialize can run (multi-process bring-up,
+    distributed/env.py)."""
 
     def __init__(self, seed_: int = 0):
-        self._key = jax.random.PRNGKey(seed_)
+        self._key = None
         self._seed = seed_
 
     def manual_seed(self, s: int):
@@ -31,10 +34,14 @@ class Generator:
         return self._seed
 
     def next_key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         return self._key
 
     def set_state(self, state):

@@ -128,7 +128,19 @@ class StaticFunction:
                 for (n, b) in named_buffers:
                     b._data = buffer_arrays[n]
                 with state.functional_mode():
-                    out = fn(*arg_arrays, **kwarg_arrays)
+                    try:
+                        out = fn(*arg_arrays, **kwarg_arrays)
+                    except (jax.errors.TracerBoolConversionError,
+                            jax.errors.ConcretizationTypeError) as e:
+                        raise RuntimeError(
+                            "to_static: the function branches on a tensor "
+                            "VALUE, which trace-based capture cannot "
+                            "record (the reference's SOT guards exist for "
+                            "this — jit/sot/translate.py). Rewrite the "
+                            "branch with paddle_tpu.where / lax.cond, or "
+                            "keep it out of the to_static region. Python "
+                            "branches on non-tensor values are baked at "
+                            "trace time per input signature.") from e
                 new_buffers = {n: b._data for n, b in named_buffers}
                 flat, tree = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))

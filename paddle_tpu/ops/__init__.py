@@ -310,8 +310,15 @@ _INPLACE_NAMES = [
     "polygamma", "pow", "put_along_axis", "reciprocal", "remainder",
     "renorm", "reshape", "round", "rsqrt", "scale", "scatter", "sigmoid",
     "sin", "sinc", "sinh", "sqrt", "squeeze", "subtract", "tan", "tanh",
-    "tril", "triu", "trunc", "unsqueeze", "where",
+    "tril", "triu", "trunc", "unsqueeze",
+    # NOT "where": where_(cond, x, y) mutates x (arg 1), not the condition,
+    # so the generic first-arg adoption would corrupt the bool cond tensor
 ]
+
+
+def _where_(condition, x, y, name=None):
+    """paddle.where_ parity: writes the selection into x."""
+    return _adopt(x, where(condition, _snapshot(x), y))
 
 
 def _make_inplace(fn):
@@ -386,6 +393,9 @@ def _register_inplace():
 
 
 _register_inplace()
+where_ = _where_
+if not hasattr(Tensor, "where_"):
+    Tensor.where_ = lambda self, x, y: _where_(self, x, y)
 
 
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,

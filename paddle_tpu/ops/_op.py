@@ -41,6 +41,16 @@ def set_profile_hook(begin, end):
     _PROFILE_HOOK = (begin, end) if begin is not None else None
 
 
+# Flipped (permanently) by the first static.data() call — gates the
+# symbolic-input scan off the eager hot path.
+_HAS_SYMBOLIC = False
+
+
+def enable_symbolic_scan():
+    global _HAS_SYMBOLIC
+    _HAS_SYMBOLIC = True
+
+
 def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
@@ -94,11 +104,18 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
         # static-build interception (reference: under program_guard ops
         # append to the Program instead of executing — framework.py
         # in_dygraph_mode branch of every API). A symbolic input (positional
-        # OR keyword) means we are inside a static.Program build.
-        for a in list(args) + list(kwargs.values()):
-            if isinstance(a, Tensor) and a._symbolic is not None:
-                return _record_static(a._symbolic.program, opname, fn,
-                                      args, kwargs)
+        # OR keyword) means we are inside a static.Program build. The scan
+        # is gated on a flag flipped by the first static.data() call, so
+        # purely-eager programs pay one global load per dispatch.
+        if _HAS_SYMBOLIC:
+            for a in args:
+                if isinstance(a, Tensor) and a._symbolic is not None:
+                    return _record_static(a._symbolic.program, opname, fn,
+                                          args, kwargs)
+            for a in kwargs.values():
+                if isinstance(a, Tensor) and a._symbolic is not None:
+                    return _record_static(a._symbolic.program, opname, fn,
+                                          args, kwargs)
         raw = [unwrap(a) for a in args]
         kwraw = {k: unwrap(v) for k, v in kwargs.items()}
 

@@ -148,34 +148,39 @@ def householder_product(x, tau, name=None):
 @op_fn(name="ormqr_op")
 def _ormqr(x, tau, other, *, left=True, transpose=False):
     # apply the k Householder reflectors H_i = I - tau_i v_i v_i^T to
-    # `other` directly (the LAPACK ormqr strategy — no explicit Q)
+    # `other` directly (the LAPACK ormqr strategy — no explicit Q);
+    # batched inputs vmap a 2-D kernel, like _householder_product
     m = x.shape[-2]
     k = tau.shape[-1]
-
-    def apply_one(c, i, right_side):
-        v = jnp.where(jnp.arange(m) < i, 0.0,
-                      jnp.where(jnp.arange(m) == i, 1.0, x[..., :, i]))
-        if right_side:
-            # c @ H = c - tau (c v) v^T
-            cv = c @ v
-            return c - tau[..., i] * jnp.outer(cv, v)
-        # H @ c = c - tau v (v^T c)
-        vc = v @ c
-        return c - tau[..., i] * jnp.outer(v, vc)
-
-    c = other
     # left, no transpose: Q C = H_0 ... H_{k-1} C  (apply right-to-left)
     # left, transpose:    Q^T C = H_{k-1} ... H_0 C
     # right, no transpose: C Q = C H_0 ... H_{k-1} (apply left-to-right)
-    order = jnp.arange(k)
     reverse = (left and not transpose) or (not left and transpose)
-    if reverse:
-        order = order[::-1]
 
-    def body(j, c):
-        return apply_one(c, order[j], right_side=not left)
+    def one(mat, t, c0):
+        order = jnp.arange(k)[::-1] if reverse else jnp.arange(k)
 
-    return jax.lax.fori_loop(0, k, body, c)
+        def body(j, c):
+            i = order[j]
+            col = jax.lax.dynamic_index_in_dim(mat, i, 1, keepdims=False)
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, col))
+            ti = t[i]
+            if left:
+                vc = v @ c                   # [n]
+                return c - ti * v[:, None] * vc[None, :]
+            cv = c @ v                       # [rows]
+            return c - ti * cv[:, None] * v[None, :]
+
+        return jax.lax.fori_loop(0, k, body, c0)
+
+    if x.ndim == 2:
+        return one(x, tau, other)
+    batch = x.shape[:-2]
+    xf = x.reshape((-1,) + x.shape[-2:])
+    tf = tau.reshape((-1, k))
+    cf = other.reshape((-1,) + other.shape[-2:])
+    return jax.vmap(one)(xf, tf, cf).reshape(batch + other.shape[-2:])
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):

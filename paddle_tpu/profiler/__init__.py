@@ -62,10 +62,15 @@ class _PyRecorder:
         self._mu = threading.Lock()
         self.enabled = False
         self._t0 = 0
+        self._epoch = 0
 
     def start(self):
         with self._mu:
             self._all.clear()
+        # stale open frames from a span that straddled the previous stop()
+        # must not leak into this session (wrong name/duration pairing):
+        # frames are epoch-stamped and end() discards old-epoch frames
+        self._epoch += 1
         self._t0 = time.perf_counter_ns()
         self.enabled = True
 
@@ -80,14 +85,16 @@ class _PyRecorder:
 
     def begin(self, name):
         if self.enabled:
-            self._stack().append((name, time.perf_counter_ns()))
+            self._stack().append((name, time.perf_counter_ns(), self._epoch))
 
     def end(self):
         if not self.enabled:
             return
         st = self._stack()
+        while st and st[-1][2] != self._epoch:
+            st.pop()   # frame opened in a previous session: discard
         if st:
-            name, t0 = st.pop()
+            name, t0, _ = st.pop()
             with self._mu:
                 self._all.append((name, t0, time.perf_counter_ns(),
                                   threading.get_ident() & 0xFFFFFF))

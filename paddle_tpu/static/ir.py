@@ -119,6 +119,8 @@ class Program:
         return f"{hint}_{next(_name_counter)}"
 
     def add_feed(self, name, shape, dtype) -> Tensor:
+        from ..ops._op import enable_symbolic_scan
+        enable_symbolic_scan()
         none_axes = tuple(i for i, d in enumerate(shape)
                           if d is None or (isinstance(d, int) and d < 0))
         shape = tuple(1 if (d is None or d < 0) else int(d) for d in shape)

@@ -1,0 +1,69 @@
+"""Worker script for the 2-process bring-up test (run via the launch CLI,
+NOT collected by pytest). Exercises the previously-dead multi-process
+branches: jax.distributed rendezvous, all_gather_object over the
+coordination-service KV store, barrier, and the distributed-checkpoint
+metadata merge + cross-process round trip."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected world=2, got {world}"
+    assert jax.process_count() == 2, "jax.distributed did not initialize"
+
+    # 1. object collective over the coordination-service KV store
+    gathered = []
+    dist.all_gather_object(gathered, {"rank": rank, "payload": rank * 10})
+    assert [g["rank"] for g in gathered] == [0, 1], gathered
+    assert [g["payload"] for g in gathered] == [0, 10], gathered
+
+    # 2. barrier
+    dist.barrier()
+
+    # 3. distributed checkpoint: each process saves ITS OWN shard of a
+    # "row-sharded" tensor (rank r owns rows [4r, 4r+4)); the coordinator
+    # merges metadata; then both processes load the FULL tensor back.
+    full = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    mine = full[rank * 4:(rank + 1) * 4]
+    state = {"w": pt.Tensor(jax.numpy.asarray(mine))}
+    # teach save that this is a window of a global tensor by saving the
+    # per-process piece under the same key with distinct windows via the
+    # metadata merge: emulate with manual meta rewrite is NOT needed —
+    # save writes local shards; merge unions windows across processes.
+    # Single-device arrays are whole-array windows, so instead exercise a
+    # replicated tensor plus per-rank objects:
+    state = {
+        "w": pt.Tensor(jax.numpy.asarray(full)),     # replicated
+        f"only_rank{rank}": int(rank) + 7,           # per-rank object
+    }
+    dist.save_state_dict(state, ckpt_dir)
+    dist.barrier()
+
+    target = {"w": pt.Tensor(jax.numpy.zeros((8, 3), "float32")),
+              "only_rank0": None, "only_rank1": None}
+    dist.load_state_dict(target, ckpt_dir)
+    np.testing.assert_allclose(np.asarray(target["w"]._data), full)
+    assert target["only_rank0"] == 7, target
+    assert target["only_rank1"] == 8, target
+
+    dist.barrier()
+    print(f"MP_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

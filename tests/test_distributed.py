@@ -390,3 +390,31 @@ class TestEnv:
         assert penv.nranks == 1
         dist.init_parallel_env()
         assert dist.is_initialized()
+
+
+class TestNoSyncAccumulation:
+    def test_no_sync_accumulation_parity(self):
+        """Grad accumulation under no_sync == one big batch (the contract
+        documented in DataParallel.no_sync)."""
+        mesh = make_mesh(8, names=["dp"])
+        dist.set_mesh(mesh)
+        try:
+            pt.seed(21)
+            layer = pt.nn.Linear(16, 4)
+            model = dist.DataParallel(layer)
+            xin = np.random.default_rng(1).normal(
+                size=(8, 16)).astype("float32")
+
+            # accumulate two half-batches under no_sync, sync on the last
+            with model.no_sync():
+                ((model(pt.to_tensor(xin[:4])) ** 2).mean() / 2).backward()
+            ((model(pt.to_tensor(xin[4:])) ** 2).mean() / 2).backward()
+            acc = layer.weight.grad.numpy().copy()
+            layer.weight.clear_grad()
+            layer.bias.clear_grad()
+
+            ((model(pt.to_tensor(xin)) ** 2).mean()).backward()
+            full = layer.weight.grad.numpy()
+            np.testing.assert_allclose(acc, full, rtol=1e-4, atol=1e-6)
+        finally:
+            dist.set_mesh(None)

@@ -1,0 +1,156 @@
+"""Full-stack hybrid integration: fleet.init + AMP + GradScaler +
+shard_optimizer + tensor parallelism + a pipeline schedule, on the
+8-virtual-device CPU mesh, with loss parity against a plain single-device
+fp32 run (VERDICT r2 ask 9; reference pattern:
+test/collective/fleet/hybrid_parallel_mp_amp.py and
+hybrid_parallel_pp_fp16.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.amp as amp
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+import paddle_tpu.nn as nn
+
+
+def _data(seed=0, n=16, din=16, dout=16):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, din)).astype("float32")
+    t = rng.normal(size=(n, dout)).astype("float32")
+    return x, t
+
+
+class _RefNet(nn.Layer):
+    """Plain single-device twin of the MP net (same init seeds)."""
+
+    def __init__(self, w1, b1, w2, b2):
+        super().__init__()
+        self.l1 = nn.Linear(16, 32)
+        self.l2 = nn.Linear(32, 16)
+        self.l1.weight.set_value(pt.to_tensor(w1))
+        self.l1.bias.set_value(pt.to_tensor(b1))
+        self.l2.weight.set_value(pt.to_tensor(w2))
+        self.l2.bias.set_value(pt.to_tensor(b2))
+
+    def forward(self, x):
+        return self.l2(pt.nn.functional.gelu(self.l1(x)))
+
+
+class TestFullStackHybrid:
+    def test_mp_sharding_amp_scaler_parity(self):
+        """fleet.init(dp=2, sharding=2, mp=2) + Column/RowParallel + AMP
+        auto_cast + GradScaler + fleet.distributed_optimizer (ZeRO-1 over
+        the sharding axis): loss trajectory matches the single-device fp32
+        run to bf16 tolerance."""
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 2}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        try:
+            assert hcg.get_model_parallel_world_size() == 2
+            assert hcg.get_sharding_parallel_world_size() == 2
+
+            pt.seed(5)
+            col = fleet.ColumnParallelLinear(16, 32, gather_output=False,
+                                             has_bias=True)
+            row = fleet.RowParallelLinear(32, 16, input_is_parallel=True,
+                                          has_bias=True)
+
+            class MPNet(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.col, self.row = col, row
+
+                def forward(self, x):
+                    return self.row(pt.nn.functional.gelu(self.col(x)))
+
+            model = fleet.distributed_model(MPNet())
+            # capture identical initial weights for the reference twin
+            w1 = np.asarray(col.weight.numpy())
+            b1 = np.asarray(col.bias.numpy())
+            w2 = np.asarray(row.weight.numpy())
+            b2 = np.asarray(row.bias.numpy())
+
+            opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+            opt = fleet.distributed_optimizer(opt, strategy)
+            scaler = amp.GradScaler(init_loss_scaling=1024.0)
+
+            xin, tgt = _data()
+            losses = []
+            for _ in range(5):
+                with amp.auto_cast(dtype="bfloat16"):
+                    out = model(pt.to_tensor(xin))
+                    loss = ((out.astype("float32")
+                             - pt.to_tensor(tgt)) ** 2).mean()
+                scaler.scale(loss).backward()
+                scaler.step(opt)
+                scaler.update()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+
+            # ZeRO-1 evidence inside the full stack: moments sharded
+            inner = opt._inner if hasattr(opt, "_inner") else opt
+            accs = [a for m in inner._accumulators.values()
+                    for a in m.values() if hasattr(a, "addressable_shards")]
+            assert accs
+            sharded = [a for a in accs
+                       if len({s.data.shape for s in a.addressable_shards
+                               }) and list(a.addressable_shards)[0].data.shape
+                       != a.shape]
+            assert sharded, "no optimizer state actually sharded"
+
+            # single-device fp32 reference
+            ref = _RefNet(w1, b1, w2, b2)
+            ropt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                      parameters=ref.parameters())
+            ref_losses = []
+            for _ in range(5):
+                loss = ((ref(pt.to_tensor(xin))
+                         - pt.to_tensor(tgt)) ** 2).mean()
+                loss.backward()
+                ropt.step()
+                ropt.clear_grad()
+                ref_losses.append(float(loss.numpy()))
+
+            np.testing.assert_allclose(losses, ref_losses, rtol=0.05,
+                                       atol=5e-3)
+            assert losses[-1] < losses[0]
+        finally:
+            dist.set_mesh(None)
+            fleet.fleet._hcg = None
+
+    def test_pp_schedule_with_amp_scaler_parity(self):
+        """PipelineParallel (1F1B) + GradScaler vs single-stage fp32."""
+        from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                                  PipelineParallel)
+
+        def build(num_stages):
+            pt.seed(9)
+            descs = [LayerDesc(nn.Linear, 16, 16) for _ in range(4)]
+            return PipelineLayer(
+                descs, num_stages=num_stages,
+                loss_fn=lambda out, lab: ((out - lab) ** 2).mean())
+
+        xin, tgt = _data(seed=3, n=8, din=16, dout=16)
+
+        def run(num_stages, use_scaler):
+            pipe = build(num_stages)
+            pp = PipelineParallel(pipe, num_micro=4, schedule="1F1B")
+            opt = pt.optimizer.SGD(learning_rate=0.05,
+                                   parameters=pipe.parameters())
+            scaler = amp.GradScaler(init_loss_scaling=256.0) \
+                if use_scaler else None
+            losses = []
+            for _ in range(4):
+                loss = pp.train_batch(pt.to_tensor(xin), pt.to_tensor(tgt),
+                                      optimizer=opt, scaler=scaler)
+                losses.append(float(loss.numpy()))
+            return losses
+
+        base = run(1, False)
+        hybrid = run(4, True)
+        np.testing.assert_allclose(hybrid, base, rtol=2e-3, atol=1e-4)
+        assert hybrid[-1] < hybrid[0]

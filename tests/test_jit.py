@@ -172,3 +172,18 @@ class TestSaveLoad:
         loaded = jit.load(path)
         out = loaded(t(np.random.randn(2, 4)))
         assert out.shape == [2, 2]
+
+
+class TestTraceGuards:
+    def test_value_dependent_branch_raises_helpfully(self):
+        import pytest
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def f(x):
+            if (x.sum() > 0).item():     # value-dependent Python branch
+                return x * 2
+            return x - 1
+
+        with pytest.raises(RuntimeError, match="to_static.*branches on"):
+            f(paddle.to_tensor(np.ones((3,), "float32")))

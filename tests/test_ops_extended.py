@@ -617,3 +617,31 @@ class TestInplaceRegressions:
             p.add_(t(np.ones(3, "float32")))
         (p * 2.0).sum().backward()
         np.testing.assert_allclose(p.grad.numpy(), [2.0] * 3)
+
+    def test_ormqr_batched(self):
+        import scipy.linalg as sla
+        outs, expects = [], []
+        raws, taus, others = [], [], []
+        for b in range(3):
+            a = RNG.standard_normal((4, 4))
+            (qr_raw, tau), _r = sla.qr(a, mode="raw")
+            q = sla.qr(a)[0]
+            o = RNG.standard_normal((4, 2)).astype("float32")
+            raws.append(np.asarray(qr_raw).astype("float32"))
+            taus.append(tau.astype("float32"))
+            others.append(o)
+            expects.append(q @ o)
+        out = pt.linalg.ormqr(t(np.stack(raws)), t(np.stack(taus)),
+                              t(np.stack(others)))
+        np.testing.assert_allclose(out.numpy(), np.stack(expects),
+                                   atol=1e-4)
+
+    def test_where_inplace_targets_x(self):
+        cond = t(np.array([True, False, True]))
+        x = t(np.array([1.0, 2.0, 3.0], "float32"))
+        y = t(np.array([9.0, 9.0, 9.0], "float32"))
+        r = pt.where_(cond, x, y)
+        assert r is x
+        np.testing.assert_allclose(x.numpy(), [1.0, 9.0, 3.0])
+        np.testing.assert_array_equal(np.asarray(cond.numpy()),
+                                      [True, False, True])

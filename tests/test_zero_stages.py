@@ -1,0 +1,181 @@
+"""ZeRO stage 1/2/3 semantics with memory evidence (VERDICT r2 ask 8).
+
+Reference capability: python/paddle/distributed/fleet/meta_parallel/sharding/
+group_sharded_stage2.py:46 (per-rank grad segments) and
+group_sharded_stage3.py:85 (parameter sharding with memory reduction).
+Evidence here is live-array accounting (distributed.per_device_bytes) on
+the 8-virtual-device CPU mesh: stage-3 must actually store ~1/8 of the
+parameter bytes per device, stage-2 ~1/8 of the gradient bytes, and the
+sharded run must match the unsharded run numerically.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+
+
+def make_mesh(*shape, names=None):
+    return dist.ProcessMesh(
+        np.arange(int(np.prod(shape))).reshape(shape), names)
+
+
+def total_bytes(params):
+    return sum(int(np.prod(p.shape)) * p._data.dtype.itemsize
+               for p in params)
+
+
+def build_mlp(seed=3):
+    pt.seed(seed)
+    import paddle_tpu.nn as nn
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(64, 128)
+            self.l2 = nn.Linear(128, 64)
+            self.l3 = nn.Linear(64, 8)
+
+        def forward(self, x):
+            h = pt.nn.functional.gelu(self.l1(x))
+            h = pt.nn.functional.gelu(self.l2(h))
+            return self.l3(h)
+
+    return MLP()
+
+
+class TestZeroMemoryEvidence:
+    def test_stage3_param_bytes_one_over_n(self):
+        mesh = make_mesh(8, names=["dp"])
+        model = build_mlp()
+        params = list(model.parameters())
+        full = total_bytes(params)
+
+        opt = dist.shard_optimizer(
+            pt.optimizer.AdamW(learning_rate=1e-3, parameters=params),
+            dist.ShardingStage3("dp", mesh))
+        x = pt.to_tensor(np.random.randn(8, 64).astype("float32"))
+        model(x).sum().backward()
+        opt.step()
+
+        per_dev = dist.per_device_bytes(model.parameters())
+        assert len(per_dev) == 8
+        # dim-0-divisible params shard 8-ways; biases of size 8 shard too;
+        # only the (8,)-shaped l3 bias may replicate. Require < 1.30/8.
+        for d, nbytes in per_dev.items():
+            assert nbytes <= full * 1.30 / 8, (
+                f"stage-3 device {d} stores {nbytes}B of {full}B "
+                f"(> 1.30/8)")
+
+    def test_stage1_params_replicated_moments_sharded(self):
+        mesh = make_mesh(8, names=["dp"])
+        model = build_mlp()
+        params = list(model.parameters())
+        full = total_bytes(params)
+
+        opt = dist.shard_optimizer(
+            pt.optimizer.AdamW(learning_rate=1e-3, parameters=params),
+            dist.ShardingStage1("dp", mesh))
+        x = pt.to_tensor(np.random.randn(8, 64).astype("float32"))
+        model(x).sum().backward()
+        opt.step()
+
+        # params stay full on every device at stage 1
+        per_dev = dist.per_device_bytes(model.parameters())
+        for d, nbytes in per_dev.items():
+            assert nbytes >= full * 0.99
+
+        # but moment accumulators are ~1/8 per device
+        accs = []
+        for acc_map in opt._inner._accumulators.values():
+            accs.extend(a for a in acc_map.values()
+                        if hasattr(a, "addressable_shards"))
+        assert accs
+        acc_total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                        for a in accs)
+        acc_per_dev = dist.per_device_bytes(accs)
+        for d, nbytes in acc_per_dev.items():
+            assert nbytes <= acc_total * 1.30 / 8
+
+    def test_stage2_gradient_scatter_view(self):
+        """Stage-2: after placement, each device owns ~1/8 of grad bytes
+        (the reduce-scatter view) while params remain replicated."""
+        mesh = make_mesh(8, names=["dp"])
+        model = build_mlp()
+        params = list(model.parameters())
+        opt = dist.shard_optimizer(
+            pt.optimizer.SGD(learning_rate=0.1, parameters=params),
+            dist.ShardingStage2("dp", mesh))
+        x = pt.to_tensor(np.random.randn(8, 64).astype("float32"))
+        model(x).sum().backward()
+        opt._apply_stage()
+
+        grads = [p.grad for p in params if p.grad is not None]
+        assert grads
+        gfull = total_bytes(grads)
+        g_per_dev = dist.per_device_bytes(grads)
+        for d, nbytes in g_per_dev.items():
+            assert nbytes <= gfull * 1.30 / 8, (
+                f"stage-2 grads on {d}: {nbytes}B of {gfull}B")
+        # params NOT sharded at stage 2
+        p_per_dev = dist.per_device_bytes(params)
+        pfull = total_bytes(params)
+        for d, nbytes in p_per_dev.items():
+            assert nbytes >= pfull * 0.99
+
+    def test_stage3_beats_stage1_memory(self):
+        """The headline claim: stage-3 per-device param+moment footprint is
+        a small fraction of stage-1's."""
+        def footprint(stage_cls):
+            mesh = make_mesh(8, names=["dp"])
+            model = build_mlp()
+            params = list(model.parameters())
+            opt = dist.shard_optimizer(
+                pt.optimizer.AdamW(learning_rate=1e-3, parameters=params),
+                stage_cls("dp", mesh))
+            x = pt.to_tensor(np.random.randn(8, 64).astype("float32"))
+            model(x).sum().backward()
+            opt.step()
+            tensors = list(model.parameters())
+            for acc_map in opt._inner._accumulators.values():
+                tensors.extend(a for a in acc_map.values()
+                               if hasattr(a, "addressable_shards"))
+            return max(dist.per_device_bytes(tensors).values())
+
+        s1 = footprint(dist.ShardingStage1)
+        s3 = footprint(dist.ShardingStage3)
+        # stage-1 keeps params replicated (params ≈ 1/3 of p+m1+m2 bytes);
+        # stage-3 shards everything: expect <= ~45% of stage-1's footprint
+        assert s3 <= 0.45 * s1, (s1, s3)
+
+
+class TestZeroParity:
+    @pytest.mark.parametrize("stage_cls", [dist.ShardingStage1,
+                                           dist.ShardingStage2,
+                                           dist.ShardingStage3])
+    def test_training_matches_unsharded(self, stage_cls):
+        mesh = make_mesh(8, names=["dp"])
+        rng = np.random.default_rng(0)
+        xin = rng.normal(size=(8, 64)).astype("float32")
+        tgt = rng.normal(size=(8, 8)).astype("float32")
+
+        def run(shard):
+            model = build_mlp(seed=11)
+            params = list(model.parameters())
+            opt = pt.optimizer.AdamW(learning_rate=1e-2, parameters=params)
+            if shard:
+                opt = dist.shard_optimizer(opt, stage_cls("dp", mesh))
+            losses = []
+            for _ in range(5):
+                loss = ((model(pt.to_tensor(xin))
+                         - pt.to_tensor(tgt)) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            return losses
+
+        base = run(False)
+        sharded = run(True)
+        np.testing.assert_allclose(sharded, base, rtol=2e-4, atol=1e-5)
+        assert sharded[-1] < sharded[0]
