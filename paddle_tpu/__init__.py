@@ -49,6 +49,11 @@ from . import profiler  # noqa
 from . import static  # noqa
 from . import inference  # noqa
 from . import vision  # noqa
+from . import quantization  # noqa
+from . import sparse  # noqa
+from . import geometric  # noqa
+from . import audio  # noqa
+from . import text  # noqa
 from . import distribution  # noqa
 from . import hapi  # noqa
 from .hapi import Model, summary  # noqa

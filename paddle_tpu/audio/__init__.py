@@ -1,0 +1,7 @@
+"""paddle.audio parity (reference: python/paddle/audio/__init__.py)."""
+from . import backends  # noqa
+from . import features  # noqa
+from . import functional  # noqa
+from .backends import load, save, info  # noqa
+
+__all__ = ["backends", "features", "functional", "load", "save", "info"]

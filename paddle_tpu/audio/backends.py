@@ -1,0 +1,93 @@
+"""paddle.audio.backends parity: wav load/save (reference:
+python/paddle/audio/backends/wave_backend.py — stdlib `wave`-based IO, the
+same no-external-deps choice)."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._op import unwrap, wrap
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+_backend = "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return _backend
+
+
+def set_backend(name: str):
+    global _backend
+    if name not in list_available_backends():
+        raise ValueError(f"unknown audio backend {name!r}")
+    _backend = name
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (Tensor[channels, samples] float32 in [-1, 1], sample_rate)."""
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        sw = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[sw]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if sw == 1:
+        data = data.astype(np.int16) - 128
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * sw - 1))
+    out = data.astype(np.float32)
+    if normalize:
+        out = out / scale
+    if channels_first:
+        out = out.T
+    return wrap(np.ascontiguousarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    arr = np.asarray(unwrap(src) if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    scaled = np.clip(arr, -1.0, 1.0) * (2 ** (bits_per_sample - 1) - 1)
+    if bits_per_sample == 8:
+        # 8-bit WAV is UNSIGNED PCM with a 128 offset
+        pcm = (scaled + 128).astype(np.uint8)
+    else:
+        pcm = scaled.astype({16: np.int16,
+                             32: np.int32}[bits_per_sample])
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())

@@ -1,0 +1,105 @@
+"""paddle.audio.features parity (reference:
+python/paddle/audio/features/layers.py — Spectrogram:24,
+MelSpectrogram:106, LogMelSpectrogram:206, MFCC:309). Composed from the
+stft op + fbank/DCT matmuls, all jit-able."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.base import Layer
+from ..ops._op import unwrap, wrap
+from ..ops.fft_ops import stft
+from .functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+
+class Spectrogram(Layer):
+    """reference: layers.py:24."""
+
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window="hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        mag = jnp.abs(unwrap(spec))
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return wrap(mag)
+
+
+class MelSpectrogram(Layer):
+    """reference: layers.py:106."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window="hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)          # [..., freq, frames]
+        mel = jnp.matmul(unwrap(self.fbank), unwrap(spec))
+        return wrap(mel)
+
+
+class LogMelSpectrogram(Layer):
+    """reference: layers.py:206."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window="hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """reference: layers.py:309."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length=None, win_length=None, window="hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, htk: bool = False,
+                 norm="slaney", ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)     # [..., n_mels, frames]
+        mfcc = jnp.matmul(jnp.swapaxes(unwrap(self.dct), 0, 1),
+                          unwrap(logmel))
+        return wrap(mfcc)

@@ -1,0 +1,147 @@
+"""paddle.audio.functional parity (reference:
+python/paddle/audio/functional/functional.py + window.py). All pure jnp —
+fbank/DCT matrices are precomputed host-side constants applied via matmul
+(MXU-friendly), exactly how the reference composes them."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._op import unwrap, wrap
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def _maybe_tensor(x, out):
+    from ..core.tensor import Tensor
+    return wrap(out) if isinstance(x, Tensor) else float(out) \
+        if np.ndim(out) == 0 else wrap(out)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """reference: functional.py:24 (slaney by default, htk optional)."""
+    from ..core.tensor import Tensor
+    f = unwrap(freq) if isinstance(freq, Tensor) else freq
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep, mel)
+    return _maybe_tensor(freq, mel)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """reference: functional.py:80."""
+    from ..core.tensor import Tensor
+    m = unwrap(mel) if isinstance(mel, Tensor) else mel
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                       hz)
+    return _maybe_tensor(mel, hz)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    lo = unwrap(hz_to_mel(f_min, htk))
+    hi = unwrap(hz_to_mel(f_max, htk))
+    mels = jnp.linspace(lo, hi, n_mels)
+    return wrap(unwrap(mel_to_hz(wrap(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    return wrap(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2,
+                             dtype=dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm="slaney", dtype="float32"):
+    """reference: functional.py:188 — [n_mels, 1 + n_fft//2] triangles."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = unwrap(fft_frequencies(sr, n_fft, "float32"))
+    melfreqs = unwrap(mel_frequencies(n_mels + 2, f_min, f_max, htk,
+                                      "float32"))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return wrap(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """reference: functional.py:261."""
+    s = unwrap(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return wrap(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho", dtype="float32"):
+    """reference: functional.py:305 — [n_mels, n_mfcc] DCT-II basis."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(math.sqrt(1.0 / (4.0 * n_mels)))
+        dct = dct.at[:, 1:].multiply(math.sqrt(1.0 / (2.0 * n_mels)))
+    return wrap(dct.astype(dtype))
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype="float32"):
+    """reference: window.py get_window (hann/hamming/blackman/...)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length if fftbins else win_length - 1
+    i = np.arange(win_length)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * i / n)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * i / n)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * i / n)
+             + 0.08 * np.cos(4 * np.pi * i / n))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2.0 * i / n - 1.0)
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(win_length)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((i - n / 2.0) / std) ** 2)
+    elif name == "triang":
+        w = 1.0 - np.abs((i - n / 2.0) / ((win_length + 1) / 2.0))
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    return wrap(jnp.asarray(w.astype(dtype)))

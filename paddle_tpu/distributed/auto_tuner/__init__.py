@@ -1,0 +1,198 @@
+"""paddle.distributed.auto_tuner parity: parallel-config search.
+
+Reference capability: python/paddle/distributed/auto_tuner/{tuner.py:21
+AutoTuner (search_once/add_cfg loop), prune.py (prune_by_mp/pp/mbs/
+sharding), search.py GridSearch, recorder.py}. TPU-native redesign: the
+candidate space is factorizations dp*mp*pp*sharding == num chips with
+micro-batch divisors; pruning uses an analytic HBM model (params/optimizer
+state sharded per axis + activation bytes per microbatch) against the
+chip's HBM budget, plus the reference's heuristic rules (mp within a
+host's chip count, pp dividing layers). The measurement loop is caller-
+driven exactly like the reference: search_once() -> run trial -> add_cfg.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AutoTuner", "generate_candidates", "estimate_memory_bytes",
+           "prune_by_memory", "default_cost"]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def generate_candidates(tuner_cfg: Dict) -> List[Dict]:
+    """All dp/mp/pp/sharding factorizations of world size × micro-batch
+    divisors (reference: search.py GridSearch.all_tasks over the same
+    dimension lists)."""
+    world = int(tuner_cfg.get("num_gpus", tuner_cfg.get("num_chips", 8)))
+    gbs = int(tuner_cfg.get("global_batch_size", 8))
+    mp_cands = tuner_cfg.get("mp_degree", "auto")
+    pp_cands = tuner_cfg.get("pp_degree", "auto")
+    dp_cands = tuner_cfg.get("dp_degree", "auto")
+    sh_cands = tuner_cfg.get("sharding_degree", "auto")
+    mbs_cands = tuner_cfg.get("micro_batch_size", "auto")
+
+    def cand(spec):
+        return _divisors(world) if spec in ("auto", None) else \
+            [int(v) for v in spec]
+
+    out = []
+    for mp, pp, dp, sh in itertools.product(
+            cand(mp_cands), cand(pp_cands), cand(dp_cands), cand(sh_cands)):
+        if mp * pp * dp * sh != world:
+            continue
+        local_bs = gbs // max(dp, 1)
+        if gbs % max(dp, 1) != 0:
+            continue
+        for mbs in (_divisors(local_bs) if mbs_cands in ("auto", None)
+                    else [int(v) for v in mbs_cands]):
+            if local_bs % mbs != 0:
+                continue
+            out.append({"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                        "sharding_degree": sh, "sharding_stage":
+                        int(tuner_cfg.get("sharding_stage", 1)),
+                        "micro_batch_size": mbs,
+                        "acc_steps": local_bs // mbs,
+                        "use_recompute":
+                        bool(tuner_cfg.get("use_recompute", False))})
+    return out
+
+
+def estimate_memory_bytes(cfg: Dict, model_cfg: Dict) -> float:
+    """Analytic per-chip HBM model (reference: prune.py prune_by_memory's
+    estimated usage): sharded params + grads + optimizer moments +
+    activation bytes for one microbatch through the local pp stage."""
+    n_params = float(model_cfg.get("num_params", 1e9))
+    layers = int(model_cfg.get("num_layers", 32))
+    hidden = int(model_cfg.get("hidden_size", 4096))
+    seq = int(model_cfg.get("seq_length", 2048))
+    bytes_per = 2.0 if model_cfg.get("dtype", "bfloat16") in (
+        "bfloat16", "float16") else 4.0
+
+    mp, pp, sh = cfg["mp_degree"], cfg["pp_degree"], cfg["sharding_degree"]
+    stage = cfg.get("sharding_stage", 1)
+    local_params = n_params / (mp * pp)
+    param_b = local_params * bytes_per
+    if stage >= 3:
+        param_b /= sh
+    grad_b = local_params * bytes_per / (sh if stage >= 2 else 1)
+    # master weights + two Adam moments in fp32
+    opt_b = local_params * 12.0 / sh
+    # activation bytes ≈ mbs * seq * hidden * layers_local * c
+    # (c≈18 for a transformer block without remat, ≈2 with full remat)
+    c = 2.0 if cfg.get("use_recompute") else 18.0
+    act_b = (cfg["micro_batch_size"] * seq * hidden
+             * (layers / pp) * c * bytes_per / mp)
+    # 1F1B keeps up to pp in-flight microbatch activations on stage 0
+    act_b *= min(pp, cfg.get("acc_steps", 1))
+    return param_b + grad_b + opt_b + act_b
+
+
+def prune_by_memory(cands: List[Dict], tuner_cfg: Dict) -> List[Dict]:
+    model_cfg = tuner_cfg.get("model_cfg", {})
+    budget = float(tuner_cfg.get("max_mem_usage",
+                                 tuner_cfg.get("hbm_bytes", 95e9)))
+    kept = []
+    for c in cands:
+        est = estimate_memory_bytes(c, model_cfg)
+        c["estimated_memory_bytes"] = est
+        if est <= budget:
+            kept.append(c)
+    return kept
+
+
+def _prune_heuristics(cands: List[Dict], tuner_cfg: Dict) -> List[Dict]:
+    """The reference's rule pruners (prune_by_mp/pp): mp stays within one
+    host's chips (ICI, not DCN); pp must divide the layer count."""
+    chips_per_host = int(tuner_cfg.get("gpus_per_node",
+                                       tuner_cfg.get("chips_per_host", 4)))
+    layers = int(tuner_cfg.get("model_cfg", {}).get("num_layers", 32))
+    out = []
+    for c in cands:
+        if c["mp_degree"] > chips_per_host:
+            continue
+        if layers % c["pp_degree"] != 0:
+            continue
+        out.append(c)
+    return out
+
+
+def default_cost(cfg: Dict, model_cfg: Dict) -> float:
+    """Relative step-time model for ranking (lower is better): compute
+    splits over dp*sh; mp pays all-reduce overhead; pp pays bubble
+    (p-1)/m; small micro-batches under-utilize the MXU."""
+    dp_ways = cfg["dp_degree"] * cfg["sharding_degree"]
+    compute = 1.0 / (dp_ways * cfg["mp_degree"] * cfg["pp_degree"])
+    mp_comm = 0.08 * (cfg["mp_degree"] - 1) / max(cfg["mp_degree"], 1) \
+        * compute
+    m = cfg["acc_steps"]
+    bubble = (cfg["pp_degree"] - 1) / max(m, 1) * compute
+    mxu_eff = min(1.0, cfg["micro_batch_size"] / 4.0) * 0.3 + 0.7
+    recompute_cost = 1.33 if cfg.get("use_recompute") else 1.0
+    return (compute + mp_comm + bubble) * recompute_cost / mxu_eff
+
+
+class AutoTuner:
+    """reference: tuner.py:21 — iterate candidate configs best-first;
+    the caller measures each (launch a trial) and reports back."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        cands = generate_candidates(self.tuner_cfg)
+        cands = _prune_heuristics(cands, self.tuner_cfg)
+        cands = prune_by_memory(cands, self.tuner_cfg)
+        model_cfg = self.tuner_cfg.get("model_cfg", {})
+        cands.sort(key=lambda c: default_cost(c, model_cfg))
+        self._cands = cands
+        self._idx = 0
+        self.history: List[Dict] = []
+        self.cur_task_id = 0
+
+    @property
+    def candidates(self) -> List[Dict]:
+        return list(self._cands)
+
+    def search_once(self) -> Optional[Dict]:
+        """Next config to try, or None when exhausted."""
+        if self._idx >= len(self._cands):
+            return None
+        cfg = self._cands[self._idx]
+        self._idx += 1
+        self.cur_task_id += 1
+        return dict(cfg)
+
+    def add_cfg(self, cfg: Dict):
+        """Record a measured trial (cfg must carry the metric key,
+        default 'time')."""
+        self.history.append(dict(cfg))
+
+    def get_best(self, metric: str = "time", mode: str = "min") -> Optional[Dict]:
+        runs = [h for h in self.history if metric in h
+                and h[metric] is not None]
+        if not runs:
+            return None
+        pick = min if mode == "min" else max
+        return pick(runs, key=lambda h: h[metric])
+
+    def tune(self, run_fn: Callable[[Dict], float], max_trials: int = 0,
+             metric: str = "time", mode: str = "min") -> Optional[Dict]:
+        """Convenience measurement loop: run_fn(cfg) -> metric value
+        (None/exception = failed trial, recorded and skipped)."""
+        trials = 0
+        while True:
+            if max_trials and trials >= max_trials:
+                break
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            trials += 1
+            try:
+                val = run_fn(cfg)
+            except Exception:
+                val = None
+            cfg[metric] = val
+            self.add_cfg(cfg)
+        return self.get_best(metric, mode)

@@ -1,0 +1,166 @@
+"""paddle.geometric parity: graph message passing + segment reductions.
+
+Reference capability: python/paddle/geometric/ (message_passing/send_recv.py
+send_u_recv / send_ue_recv / send_uv, math.py segment_* — phi graph_send_*
+kernels). TPU-native redesign: everything is jax.ops.segment_sum-family
+over gathered node features — XLA lowers segment ops to sorted scatter
+adds that vectorize on the VPU; num_segments is static so shapes stay
+compile-friendly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "reindex_graph", "sample_neighbors",
+]
+
+
+def _seg(vals, ids, num, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(vals, ids, num)
+    if pool == "mean":
+        s = jax.ops.segment_sum(vals, ids, num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids, num)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (vals.ndim - 1))
+    if pool == "max":
+        return jax.ops.segment_max(vals, ids, num,
+                                   indices_are_sorted=False)
+    if pool == "min":
+        return jax.ops.segment_min(vals, ids, num,
+                                   indices_are_sorted=False)
+    raise ValueError(f"unknown pool_type {pool!r}")
+
+
+def _finite(x):
+    # segment_max/min yield -inf/+inf for empty segments; paddle yields 0
+    return jnp.where(jnp.isfinite(x), x, 0.0)
+
+
+@op_fn(name="send_u_recv", nondiff_args=(1, 2))
+def _send_u_recv(x, src_index, dst_index, *, reduce_op="sum",
+                 out_size=None):
+    num = out_size if out_size is not None else x.shape[0]
+    vals = x[src_index]
+    out = _seg(vals, dst_index, num, reduce_op)
+    if reduce_op in ("max", "min"):
+        out = _finite(out)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce into dst (reference:
+    message_passing/send_recv.py send_u_recv)."""
+    return _send_u_recv(x, src_index, dst_index,
+                        reduce_op=reduce_op, out_size=out_size)
+
+
+@op_fn(name="send_ue_recv", nondiff_args=(2, 3))
+def _send_ue_recv(x, y, src_index, dst_index, *, message_op="add",
+                  reduce_op="sum", out_size=None):
+    num = out_size if out_size is not None else x.shape[0]
+    xs = x[src_index]
+    msg = {"add": lambda a, b: a + b,
+           "sub": lambda a, b: a - b,
+           "mul": lambda a, b: a * b,
+           "div": lambda a, b: a / b}[message_op](xs, y)
+    out = _seg(msg, dst_index, num, reduce_op)
+    if reduce_op in ("max", "min"):
+        out = _finite(out)
+    return out
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node feature x[src] with edge feature y, reduce into dst
+    (reference: send_ue_recv)."""
+    return _send_ue_recv(x, y, src_index, dst_index, message_op=message_op,
+                         reduce_op=reduce_op, out_size=out_size)
+
+
+@op_fn(name="send_uv", nondiff_args=(2, 3))
+def _send_uv(x, y, src_index, dst_index, *, message_op="add"):
+    xs = x[src_index]
+    yd = y[dst_index]
+    return {"add": lambda a, b: a + b,
+            "sub": lambda a, b: a - b,
+            "mul": lambda a, b: a * b,
+            "div": lambda a, b: a / b}[message_op](xs, yd)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    return _send_uv(x, y, src_index, dst_index, message_op=message_op)
+
+
+def _segment_api(pool):
+    @op_fn(name=f"segment_{pool}", nondiff_args=(1,))
+    def _op(data, segment_ids, *, num=None):
+        n = num if num is not None else int(jnp.max(segment_ids)) + 1
+        out = _seg(data, segment_ids, n, pool)
+        if pool in ("max", "min"):
+            out = _finite(out)
+        return out
+
+    def api(data, segment_ids, name=None):
+        ids = unwrap(segment_ids)
+        import numpy as np
+        n = int(np.asarray(jnp.max(jnp.asarray(ids)))) + 1
+        return _op(data, segment_ids, num=n)
+    return api
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_max = _segment_api("max")
+segment_min = _segment_api("min")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference:
+    geometric/reindex.py reindex_graph). Eager (data-dependent sizes)."""
+    import numpy as np
+    xa = np.asarray(unwrap(x))
+    nb = np.asarray(unwrap(neighbors))
+    uniq = {}
+    for v in xa.tolist():
+        uniq.setdefault(v, len(uniq))
+    for v in nb.tolist():
+        uniq.setdefault(v, len(uniq))
+    nodes = np.array(list(uniq.keys()), dtype=xa.dtype)
+    reindex_src = np.array([uniq[v] for v in nb.tolist()], dtype=np.int64)
+    cnt = np.asarray(unwrap(count))
+    reindex_dst = np.repeat(np.arange(len(xa), dtype=np.int64), cnt)
+    return (wrap(jnp.asarray(reindex_src)),
+            wrap(jnp.asarray(reindex_dst)), wrap(jnp.asarray(nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on CSC (reference:
+    geometric/sampling/neighbors.py). Eager host sampling — graph prep is
+    input-pipeline work, not device work."""
+    import numpy as np
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    seeds = np.asarray(unwrap(input_nodes))
+    rng = np.random.default_rng()
+    out_n, out_c = [], []
+    for s in seeds.tolist():
+        lo, hi = int(cp[s]), int(cp[s + 1])
+        neigh = r[lo:hi]
+        if 0 <= sample_size < len(neigh):
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+    out_neighbors = np.concatenate(out_n) if out_n else np.array([], r.dtype)
+    out_count = np.array(out_c, dtype=np.int64)
+    return wrap(jnp.asarray(out_neighbors)), wrap(jnp.asarray(out_count))

@@ -1,0 +1,24 @@
+"""paddle.quantization parity: QAT (fake-quant) + PTQ (observe/convert).
+
+Reference capability: python/paddle/quantization/{config.py:60 QuantConfig,
+qat.py:23 QAT, ptq.py PTQ, observers/abs_max.py, quanters/abs_max.py,
+wrapper.py}. TPU-native redesign: fake-quant is a pure function
+(quantize→round→dequantize with a straight-through estimator via
+jax.lax.stop_gradient), so QAT'd models trace/jit/shard exactly like
+float models — there is no kernel swap, only op insertion; conversion
+emits int8 weight + float scale pairs the way the reference's
+quantize-convert pass does.
+"""
+from .config import QuantConfig  # noqa
+from .observers import AbsmaxObserver, AVGObserver  # noqa
+from .quanters import FakeQuanterWithAbsMaxObserver  # noqa
+from .qat import QAT  # noqa
+from .ptq import PTQ  # noqa
+from .wrapper import ObserveWrapper, QuantedLinear  # noqa
+from .functional import fake_quant_dequant, quant, dequant  # noqa
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "AVGObserver",
+    "FakeQuanterWithAbsMaxObserver", "ObserveWrapper", "QuantedLinear",
+    "fake_quant_dequant", "quant", "dequant",
+]

@@ -1,0 +1,55 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py:60).
+
+Maps layers to (activation, weight) quanter/observer factories by layer
+instance, by type, or by name prefix."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..nn.layer.base import Layer
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._default = SingleLayerConfig(activation, weight) \
+            if (activation is not None or weight is not None) else None
+        self._by_layer: List[Tuple[Layer, SingleLayerConfig]] = []
+        self._by_type: List[Tuple[type, SingleLayerConfig]] = []
+        self._by_name: List[Tuple[str, SingleLayerConfig]] = []
+
+    # reference API surface ------------------------------------------------
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer.append((l, SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._by_type.append((t, SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._by_name.append((n, SingleLayerConfig(activation, weight)))
+
+    # resolution -----------------------------------------------------------
+    def config_for(self, name: str, layer: Layer) -> Optional[SingleLayerConfig]:
+        for l, cfg in self._by_layer:
+            if l is layer:
+                return cfg
+        for n, cfg in self._by_name:
+            if name == n or name.startswith(n + "."):
+                return cfg
+        for t, cfg in self._by_type:
+            if isinstance(layer, t):
+                return cfg
+        return self._default

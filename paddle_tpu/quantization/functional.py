@@ -1,0 +1,43 @@
+"""Quantization math (pure JAX; the phi fake_quantize_* kernel family,
+paddle/phi/kernels/fake_quantize_kernel.h, as functions)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._op import op_fn
+
+
+def _qrange(bits: int):
+    return float(2 ** (bits - 1) - 1)
+
+
+@op_fn(name="fake_quant_dequant")
+def _fqdq(x, scale, *, bits=8):
+    """Quantize-dequantize with straight-through gradient (reference:
+    FakeQuantAbsMax — the QAT training op)."""
+    bound = _qrange(bits)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bound), -bound, bound)
+    y = q * s / bound
+    # straight-through estimator: forward uses y, backward passes through
+    return x + jax.lax.stop_gradient(y - x)
+
+
+def fake_quant_dequant(x, scale, bits=8):
+    return _fqdq(x, scale, bits=bits)
+
+
+def quant(x, scale, bits=8):
+    """float -> int (reference: quantize_linear)."""
+    from ..ops._op import unwrap, wrap
+    bound = _qrange(bits)
+    s = jnp.maximum(unwrap(scale), 1e-9)
+    q = jnp.clip(jnp.round(unwrap(x) / s * bound), -bound, bound)
+    return wrap(q.astype(jnp.int8 if bits <= 8 else jnp.int32))
+
+
+def dequant(q, scale, bits=8):
+    from ..ops._op import unwrap, wrap
+    bound = _qrange(bits)
+    return wrap(unwrap(q).astype(jnp.float32) * unwrap(scale) / bound)

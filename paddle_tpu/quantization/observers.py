@@ -1,0 +1,92 @@
+"""Observers: collect activation/weight statistics during calibration.
+
+Reference: python/paddle/quantization/observers/abs_max.py (AbsmaxObserver)
+and the imperative AVG observer. Observers are factories (reference
+factory.py ObserverFactory): calling `_instance(layer)` yields a live
+observer bound to one layer."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class _ObserverBase:
+    """Live observer: tracks a scale; quantized bit width fixed at 8."""
+
+    bits = 8
+
+    def __init__(self):
+        self._scale = None
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scale(self):
+        """None until something was observed — callers (convert) fall back
+        to the weight's own abs-max rather than a silent scale of 1."""
+        if self._scale is None:
+            return None
+        return float(self._scale)
+
+    def __call__(self, x):
+        self.observe(x)
+        return x
+
+
+class _Factory:
+    """Reference factory.py: configs hold factories; instances bind at
+    quantize time."""
+
+    def __init__(self, cls, **kwargs):
+        self._cls = cls
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls._make(**self._kwargs)
+
+
+class AbsmaxObserver(_Factory):
+    """Per-tensor abs-max calibration (reference: observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(AbsmaxObserver, quant_bits=quant_bits)
+
+    @staticmethod
+    def _make(quant_bits=8):
+        ob = _AbsmaxLive()
+        ob.bits = quant_bits
+        return ob
+
+
+class _AbsmaxLive(_ObserverBase):
+    def observe(self, x: Tensor):
+        m = float(jnp.max(jnp.abs(x._data if isinstance(x, Tensor) else x)))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class AVGObserver(_Factory):
+    """Running-average abs-max (reference: imperative avg observer)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(AVGObserver, quant_bits=quant_bits)
+
+    @staticmethod
+    def _make(quant_bits=8):
+        ob = _AvgLive()
+        ob.bits = quant_bits
+        return ob
+
+
+class _AvgLive(_ObserverBase):
+    def __init__(self):
+        super().__init__()
+        self._n = 0
+
+    def observe(self, x: Tensor):
+        m = float(jnp.max(jnp.abs(x._data if isinstance(x, Tensor) else x)))
+        self._n += 1
+        if self._scale is None:
+            self._scale = m
+        else:
+            self._scale += (m - self._scale) / self._n

@@ -1,0 +1,17 @@
+"""PTQ: observe with calibration data, then convert (reference:
+quantization/ptq.py — PTQ.quantize inserts observers; sample data flows
+through; convert freezes scales)."""
+from __future__ import annotations
+
+from .config import QuantConfig
+from .qat import Quantization
+
+
+class PTQ(Quantization):
+    """Post-training quantization (reference: ptq.py)."""
+
+    def quantize(self, model, inplace=False):
+        import copy
+        target = model if inplace else copy.deepcopy(model)
+        target.eval()
+        return self._wrap_model(target)

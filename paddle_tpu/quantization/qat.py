@@ -1,0 +1,57 @@
+"""QAT: insert fake-quant operators per QuantConfig (reference:
+quantization/qat.py:23 — QAT.quantize walks sublayers and wraps the
+configured ones)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.base import Layer
+from .config import QuantConfig
+from .wrapper import ObserveWrapper, QuantedLinear
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _wrap_model(self, model: Layer):
+        for name, sub in list(model.named_sublayers()):
+            cfg = self._config.config_for(name, sub)
+            if cfg is None or (cfg.activation is None and cfg.weight is None):
+                continue
+            if any(True for _ in sub.named_sublayers()):
+                continue   # only leaf layers get wrapped
+            act = cfg.activation._instance(sub) if cfg.activation else None
+            wt = cfg.weight._instance(sub) if cfg.weight else None
+            wrapper = ObserveWrapper(sub, act, wt)
+            # re-bind on the parent
+            parent = model
+            parts = name.split(".")
+            for p in parts[:-1]:
+                parent = getattr(parent, p)
+            setattr(parent, parts[-1], wrapper)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Swap observed wrappers for quantized inference layers
+        (reference: quantize.py convert)."""
+        from ..nn import Linear
+        target = model if inplace else copy.deepcopy(model)
+        for name, sub in list(target.named_sublayers()):
+            if isinstance(sub, ObserveWrapper) and isinstance(sub.inner,
+                                                             Linear):
+                q = QuantedLinear.from_observed(sub)
+                parent = target
+                parts = name.split(".")
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                setattr(parent, parts[-1], q)
+        return target
+
+
+class QAT(Quantization):
+    """Quantization-aware training (reference: qat.py:23)."""
+
+    def quantize(self, model: Layer, inplace=False):
+        target = model if inplace else copy.deepcopy(model)
+        return self._wrap_model(target)

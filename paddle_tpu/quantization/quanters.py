@@ -1,0 +1,57 @@
+"""Quanters: trainable fake-quant operators for QAT.
+
+Reference: python/paddle/quantization/quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver — moving-average abs-max scale + fake
+quant-dequant with straight-through gradients)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .functional import fake_quant_dequant
+from .observers import _Factory
+
+
+class FakeQuanterWithAbsMaxObserver(_Factory):
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8):
+        super().__init__(FakeQuanterWithAbsMaxObserver,
+                         moving_rate=moving_rate, quant_bits=quant_bits)
+
+    @staticmethod
+    def _make(moving_rate=0.9, quant_bits=8):
+        return _FakeQuantLive(moving_rate, quant_bits)
+
+
+class _FakeQuantLive:
+    """Live QAT quanter: updates a moving-average scale in training and
+    applies fake quant-dequant (gradients flow straight through)."""
+
+    def __init__(self, moving_rate=0.9, bits=8):
+        self.moving_rate = moving_rate
+        self.bits = bits
+        self._scale = None
+        self.training = True
+
+    def scale(self):
+        return None if self._scale is None else float(self._scale)
+
+    def __call__(self, x: Tensor) -> Tensor:
+        import jax
+
+        arr = x._data if isinstance(x, Tensor) else x
+        m = jnp.max(jnp.abs(arr))
+        if isinstance(m, jax.core.Tracer):
+            # under jit/to_static tracing the host-side moving average
+            # can't update; use the current batch's abs-max dynamically
+            # (stateless — the compiled QAT path stays fully functional)
+            s = jnp.maximum(jax.lax.stop_gradient(m), 1e-9)
+            return fake_quant_dequant(x, s, bits=self.bits)
+        if self.training:
+            mv = float(m)
+            if self._scale is None:
+                self._scale = mv
+            else:
+                k = self.moving_rate
+                self._scale = k * self._scale + (1 - k) * mv
+        s = self._scale if self._scale is not None else float(m)
+        return fake_quant_dequant(x, jnp.float32(s), bits=self.bits)

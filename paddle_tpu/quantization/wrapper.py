@@ -1,0 +1,83 @@
+"""Quantizing layer wrappers (reference: quantization/wrapper.py
+ObserveWrapper + the quanted nn layers in nn/quant/). The wrapper
+intercepts a layer's forward: activation observer/quanter on the input,
+weight quanter on the kernel."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.base import Layer
+from .functional import dequant, fake_quant_dequant, quant
+
+
+class ObserveWrapper(Layer):
+    """Wrap a layer with (activation, weight) observers/quanters."""
+
+    def __init__(self, inner: Layer, activation=None, weight=None):
+        super().__init__()
+        self._inner_layer = inner
+        self._act = activation
+        self._wt = weight
+
+    @property
+    def inner(self):
+        return self._inner_layer
+
+    def forward(self, x, *args, **kwargs):
+        if self._act is not None:
+            x = self._act(x)
+        if self._wt is not None and hasattr(self._inner_layer, "weight"):
+            w = self._inner_layer.weight
+            orig = w._data
+            fq = self._wt(Tensor(orig))
+            w._data = fq._data if isinstance(fq, Tensor) else fq
+            try:
+                return self._inner_layer(x, *args, **kwargs)
+            finally:
+                w._data = orig
+        return self._inner_layer(x, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._inner_layer.parameters(include_sublayers)
+
+    def weight_scale(self):
+        return self._wt.scale() if self._wt is not None else None
+
+    def activation_scale(self):
+        return self._act.scale() if self._act is not None else None
+
+
+class QuantedLinear(Layer):
+    """Converted inference layer: int8 weight + scales (reference:
+    nn/quant/qat/linear.py converted form). The matmul runs on the
+    dequantized weight — on TPU the int8 weight is the memory/IO win; XLA
+    fuses the dequant multiply into the matmul epilogue."""
+
+    def __init__(self, qweight, w_scale, bias=None, act_scale=None, bits=8):
+        super().__init__()
+        self.qweight = qweight              # int8 Tensor [in, out]
+        self.w_scale = float(w_scale)
+        self.act_scale = act_scale
+        self.bias = bias
+        self.bits = bits
+
+    def forward(self, x):
+        w = dequant(self.qweight, jnp.float32(self.w_scale), self.bits)
+        if self.act_scale is not None:
+            x = fake_quant_dequant(x, jnp.float32(self.act_scale),
+                                   bits=self.bits)
+        y = x.matmul(w) if isinstance(x, Tensor) else Tensor(x).matmul(w)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+    @staticmethod
+    def from_observed(wrapper: ObserveWrapper, bits=8):
+        inner = wrapper.inner
+        w_scale = wrapper.weight_scale()
+        if w_scale is None:     # never calibrated: use the weight's abs-max
+            w_scale = float(jnp.max(jnp.abs(inner.weight._data)))
+        qw = quant(inner.weight, jnp.float32(w_scale), bits)
+        return QuantedLinear(qw, w_scale, getattr(inner, "bias", None),
+                             wrapper.activation_scale(), bits)

@@ -1,0 +1,383 @@
+"""paddle.sparse parity: COO/CSR sparse tensors + ops + nn.
+
+Reference capability: python/paddle/sparse/ (5.2K LoC — creation, unary/
+binary math, matmul, masked ops, sparse nn layers over phi sparse
+kernels, paddle/phi/core/sparse_coo_tensor.h). TPU-native redesign:
+storage is jax.experimental.sparse BCOO/BCSR — XLA lowers sparse ops to
+dense-friendly gather/scatter/segment kernels, which is how sparsity is
+actually profitable on the MXU (no cuSPARSE analogue needed). The Tensor
+facade keeps paddle's API: SparseCooTensor/SparseCsrTensor behave like
+Tensors with .indices()/.values()/.to_dense().
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._op import unwrap, wrap
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "relu", "abs", "sin", "tanh",
+    "sqrt", "square", "pow", "neg", "cast", "transpose", "sum",
+    "coalesce", "nn",
+]
+
+
+class SparseCooTensor(Tensor):
+    """COO sparse tensor (reference: phi/core/sparse_coo_tensor.h) backed
+    by a BCOO array in ``_sp``; ``_data`` holds the dense view lazily."""
+
+    def __init__(self, bcoo):
+        self._sp = bcoo
+        super().__init__(None)
+        self._data = None
+
+    # -- paddle surface ----------------------------------------------------
+    def indices(self) -> Tensor:
+        return wrap(self._sp.indices.T)     # paddle: [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return wrap(self._sp.data)
+
+    def nnz(self) -> int:
+        return int(self._sp.nse)
+
+    def to_dense(self) -> Tensor:
+        return wrap(self._sp.todense())
+
+    def to_sparse_csr(self):
+        dense = self._sp.todense()
+        return sparse_csr_tensor_from_dense(dense)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._sp.sum_duplicates())
+
+    @property
+    def shape(self):
+        return list(self._sp.shape)
+
+    @property
+    def dtype(self):
+        return self._sp.dtype
+
+    @property
+    def ndim(self):
+        return self._sp.ndim
+
+    def numpy(self):
+        return np.asarray(self._sp.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor(Tensor):
+    """CSR sparse tensor (reference: phi/core/sparse_csr_tensor.h) backed
+    by BCSR."""
+
+    def __init__(self, bcsr):
+        self._sp = bcsr
+        super().__init__(None)
+        self._data = None
+
+    def crows(self) -> Tensor:
+        return wrap(self._sp.indptr)
+
+    def cols(self) -> Tensor:
+        return wrap(self._sp.indices)
+
+    def values(self) -> Tensor:
+        return wrap(self._sp.data)
+
+    def nnz(self) -> int:
+        return int(self._sp.nse)
+
+    def to_dense(self) -> Tensor:
+        return wrap(self._sp.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return sparse_coo_tensor_from_dense(self._sp.todense())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    @property
+    def shape(self):
+        return list(self._sp.shape)
+
+    @property
+    def dtype(self):
+        return self._sp.dtype
+
+    @property
+    def ndim(self):
+        return self._sp.ndim
+
+    def numpy(self):
+        return np.asarray(self._sp.todense())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation (reference: sparse/creation.py)
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(unwrap(indices))           # [ndim, nnz] (paddle)
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in jnp.max(idx, axis=1))
+    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_coo_tensor_from_dense(dense):
+    return SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(dense)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    crows = jnp.asarray(unwrap(crows))
+    cols = jnp.asarray(unwrap(cols))
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    bcsr = jsparse.BCSR((vals, cols, crows), shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def sparse_csr_tensor_from_dense(dense):
+    return SparseCsrTensor(jsparse.BCSR.fromdense(jnp.asarray(dense)))
+
+
+def _to_sp(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x._sp
+    return jnp.asarray(unwrap(x))
+
+
+def _rewrap(sp, like):
+    if isinstance(like, SparseCsrTensor):
+        if isinstance(sp, jsparse.BCSR):
+            return SparseCsrTensor(sp)
+        return SparseCsrTensor(jsparse.BCSR.fromdense(sp.todense()
+                               if hasattr(sp, "todense") else sp))
+    if isinstance(sp, jsparse.BCOO):
+        return SparseCooTensor(sp)
+    if isinstance(sp, jsparse.BCSR):
+        return SparseCooTensor(jsparse.BCOO.fromdense(sp.todense()))
+    return SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(sp)))
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# elementwise (reference: sparse/unary.py, binary.py) — value-space ops
+# keep the sparsity pattern; zero-preserving by construction
+# ---------------------------------------------------------------------------
+
+def _unary(fn):
+    def op(x, name=None):
+        sp = x._sp
+        if isinstance(sp, jsparse.BCSR):
+            new = jsparse.BCSR((fn(sp.data), sp.indices, sp.indptr),
+                               shape=sp.shape)
+        else:
+            new = jsparse.BCOO((fn(sp.data), sp.indices), shape=sp.shape)
+        return _rewrap(new, x)
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+abs = _unary(jnp.abs)
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+neg = _unary(jnp.negative)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    vd = convert_dtype(value_dtype) if value_dtype is not None else None
+    return _unary(lambda v: v.astype(vd) if vd is not None else v)(x)
+
+
+def _dense(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x._sp.todense()
+    return jnp.asarray(unwrap(x))
+
+
+def _is_sp(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _same_pattern(a, b) -> bool:
+    if isinstance(a, jsparse.BCOO) and isinstance(b, jsparse.BCOO):
+        return (a.indices.shape == b.indices.shape
+                and bool(jnp.all(a.indices == b.indices)))
+    if isinstance(a, jsparse.BCSR) and isinstance(b, jsparse.BCSR):
+        return (a.indices.shape == b.indices.shape
+                and bool(jnp.all(a.indices == b.indices))
+                and bool(jnp.all(a.indptr == b.indptr)))
+    return False
+
+
+def _value_space(sp, data):
+    if isinstance(sp, jsparse.BCSR):
+        return jsparse.BCSR((data, sp.indices, sp.indptr), shape=sp.shape)
+    return jsparse.BCOO((data, sp.indices), shape=sp.shape)
+
+
+def _binary(fn, concat_ok=False):
+    """Binary op staying sparse where possible: same-pattern operands and
+    scalars run in value space; sparse+sparse add/sub unions indices via
+    concat + sum_duplicates; everything else (dense operand, sparse*sparse
+    intersection) falls back to dense — the reference's sparse kernels
+    have the same structural cases (phi/kernels/sparse/elementwise_*)."""
+
+    def op(x, y, name=None):
+        if _is_sp(x) and jnp.ndim(unwrap(y) if not _is_sp(y) else 0) == 0 \
+                and not _is_sp(y):
+            return _rewrap(_value_space(x._sp, fn(x._sp.data, unwrap(y))), x)
+        if _is_sp(x) and _is_sp(y):
+            a, b = x._sp, y._sp
+            if _same_pattern(a, b):
+                return _rewrap(_value_space(a, fn(a.data, b.data)), x)
+            if concat_ok:
+                aco = a if isinstance(a, jsparse.BCOO) else \
+                    jsparse.BCOO.fromdense(a.todense())
+                bco = b if isinstance(b, jsparse.BCOO) else \
+                    jsparse.BCOO.fromdense(b.todense())
+                bdata = fn(jnp.zeros_like(bco.data), bco.data)
+                merged = jsparse.BCOO(
+                    (jnp.concatenate([aco.data, bdata]),
+                     jnp.concatenate([aco.indices, bco.indices])),
+                    shape=aco.shape).sum_duplicates()
+                return _rewrap(merged, x)
+        dense = fn(_dense(x), _dense(y))
+        return _rewrap(jsparse.BCOO.fromdense(dense), x if _is_sp(x) else y)
+
+    return op
+
+
+add = _binary(jnp.add, concat_ok=True)
+subtract = _binary(jnp.subtract, concat_ok=True)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+# ---------------------------------------------------------------------------
+# matmul / reductions (reference: sparse/matmul.py)
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (the TPU-profitable direction; XLA lowers
+    BCOO matmul to gather+segment-sum)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = x._sp @ _dense(y)
+        return wrap(out.todense() if hasattr(out, "todense") else out)
+    out = jnp.asarray(unwrap(x)) @ _dense(y)
+    return wrap(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's nonzeros (reference:
+    sparse/matmul.py masked_matmul — the SDDMM kernel)."""
+    xa, ya = jnp.asarray(unwrap(x)), jnp.asarray(unwrap(y))
+    msp = mask._sp if isinstance(mask, (SparseCooTensor, SparseCsrTensor)) \
+        else jsparse.BCOO.fromdense(jnp.asarray(unwrap(mask)))
+    if isinstance(msp, jsparse.BCSR):
+        msp = jsparse.BCOO.fromdense(msp.todense())
+    rows = msp.indices[:, 0]
+    cols = msp.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xa[rows, :], ya[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, msp.indices),
+                                        shape=(xa.shape[0], ya.shape[1])))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = _dense(x).sum(axis=axis, keepdims=keepdim)
+    return wrap(d)
+
+
+def transpose(x, perm, name=None):
+    dense = jnp.transpose(_dense(x), perm)
+    return _rewrap(jsparse.BCOO.fromdense(dense), x)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+# ---------------------------------------------------------------------------
+# sparse nn (reference: sparse/nn — ReLU layer + Linear-ish)
+# ---------------------------------------------------------------------------
+
+class _SparseNN:
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over CSR nonzeros (reference:
+        sparse/nn/functional/activation.py softmax)."""
+
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            sp = x._sp
+            if isinstance(sp, jsparse.BCSR):
+                dense = sp.todense()
+                neg_inf = jnp.where(dense == 0, -jnp.inf, dense)
+                sm = jax.nn.softmax(neg_inf, axis=-1)
+                sm = jnp.where(dense == 0, 0.0, sm)
+                return SparseCsrTensor(jsparse.BCSR.fromdense(sm))
+            dense = sp.todense()
+            neg_inf = jnp.where(dense == 0, -jnp.inf, dense)
+            sm = jax.nn.softmax(neg_inf, axis=-1)
+            sm = jnp.where(dense == 0, 0.0, sm)
+            return SparseCooTensor(jsparse.BCOO.fromdense(sm))
+
+
+nn = _SparseNN()

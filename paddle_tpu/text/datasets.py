@@ -1,0 +1,174 @@
+"""paddle.text.datasets parity surface (reference:
+python/paddle/text/datasets/ — Imdb, Imikolov, Conll05st, Movielens,
+UCIHousing, WMT14, WMT16).
+
+These are download-and-parse datasets; this environment has no network
+egress, so construction requires ``data_file=`` pointing at a local copy
+(the loaders' parse paths are real and tested with synthetic files);
+download-less construction raises with instructions, mirroring the
+reference's DATA_HOME contract without silent network access."""
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16"]
+
+
+def _need_file(name, data_file):
+    if data_file is None or not os.path.exists(data_file):
+        raise RuntimeError(
+            f"{name}: automatic download is unavailable in this "
+            "environment; pass data_file= pointing at a local copy "
+            "(same archive format as the reference dataset)")
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: text/datasets/imdb.py — tar.gz of
+    pos/neg review files -> (ids, label))."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.mode = mode
+        data_file = _need_file("Imdb", data_file)
+        # reference semantics (text/datasets/imdb.py:115): cutoff is a
+        # FREQUENCY threshold (keep words with freq > cutoff), and the
+        # vocabulary is built over train AND test splits
+        pat_mode = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        pat_all = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        word_freq: dict = {}
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if not pat_all.match(m.name):
+                    continue
+                text = tf.extractfile(m).read().decode(
+                    "utf-8", errors="ignore").lower()
+                tokens = re.sub(r"[^a-z0-9 ]", " ", text).split()
+                for t in tokens:
+                    word_freq[t] = word_freq.get(t, 0) + 1
+                if pat_mode.match(m.name):
+                    docs.append(tokens)
+                    labels.append(0 if "/pos/" in m.name else 1)
+        vocab = [w for w, c in sorted(word_freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(t, unk) for t in d],
+                              dtype=np.int64) for d in docs]
+        self.labels = np.array(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (reference: text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=1):
+        data_file = _need_file("Imikolov", data_file)
+        name = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        word_freq: dict = {}
+        lines: List[List[str]] = []
+        with tarfile.open(data_file) as tf:
+            f = tf.extractfile(name)
+            for line in f.read().decode().splitlines():
+                toks = line.strip().split()
+                lines.append(toks)
+                for t in toks:
+                    word_freq[t] = word_freq.get(t, 0) + 1
+        word_freq = {w: c for w, c in word_freq.items()
+                     if c >= min_word_freq and w != "<s>"}
+        word_idx = {w: i for i, (w, _) in enumerate(
+            sorted(word_freq.items(), key=lambda kv: (-kv[1], kv[0])))}
+        word_idx["<unk>"] = len(word_idx)
+        self.word_idx = word_idx
+        unk = word_idx["<unk>"]
+        self.data = []
+        for toks in lines:
+            seq = ([word_idx.get("<s>", unk)]
+                   + [word_idx.get(t, unk) for t in toks]
+                   + [word_idx.get("<e>", unk)])
+            if data_type.upper() == "NGRAM":
+                for i in range(window_size, len(seq)):
+                    self.data.append(np.array(seq[i - window_size:i + 1],
+                                              dtype=np.int64))
+            else:
+                self.data.append(np.array(seq, dtype=np.int64))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference: text/datasets/uci_housing.py
+    — 13 features + target, feature-normalized)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        data_file = _need_file("UCIHousing", data_file)
+        raw = np.loadtxt(data_file).astype(np.float32)
+        maxs, mins = raw.max(axis=0), raw.min(axis=0)
+        avgs = raw.mean(axis=0)
+        span = np.where(maxs - mins == 0, 1, maxs - mins)
+        feats = (raw[:, :-1] - avgs[:-1]) / span[:-1]
+        n = len(raw)
+        split = int(n * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:split], raw[:split, -1:]
+        else:
+            self.x, self.y = feats[split:], raw[split:, -1:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class _StubDataset(Dataset):
+    _NAME = "dataset"
+
+    def __init__(self, data_file=None, **kwargs):
+        _need_file(self._NAME, data_file)
+        raise NotImplementedError(
+            f"{self._NAME} parsing is not implemented in this build; the "
+            "reference loader depends on dataset-specific archives")
+
+    def __getitem__(self, idx):
+        raise IndexError
+
+    def __len__(self):
+        return 0
+
+
+class Conll05st(_StubDataset):
+    _NAME = "Conll05st"
+
+
+class Movielens(_StubDataset):
+    _NAME = "Movielens"
+
+
+class WMT14(_StubDataset):
+    _NAME = "WMT14"
+
+
+class WMT16(_StubDataset):
+    _NAME = "WMT16"
