@@ -44,9 +44,12 @@ def generate_candidates(tuner_cfg: Dict) -> List[Dict]:
             cand(mp_cands), cand(pp_cands), cand(dp_cands), cand(sh_cands)):
         if mp * pp * dp * sh != world:
             continue
-        local_bs = gbs // max(dp, 1)
-        if gbs % max(dp, 1) != 0:
+        # dp AND sharding both split the batch (reference prune_by_mbs
+        # divides the global batch by dp*sharding)
+        dp_ways = max(dp * sh, 1)
+        if gbs % dp_ways != 0:
             continue
+        local_bs = gbs // dp_ways
         for mbs in (_divisors(local_bs) if mbs_cands in ("auto", None)
                     else [int(v) for v in mbs_cands]):
             if local_bs % mbs != 0:

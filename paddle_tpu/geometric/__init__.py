@@ -101,17 +101,24 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 
 def _segment_api(pool):
     @op_fn(name=f"segment_{pool}", nondiff_args=(1,))
-    def _op(data, segment_ids, *, num=None):
-        n = num if num is not None else int(jnp.max(segment_ids)) + 1
-        out = _seg(data, segment_ids, n, pool)
+    def _op(data, segment_ids, *, num):
+        out = _seg(data, segment_ids, num, pool)
         if pool in ("max", "min"):
             out = _finite(out)
         return out
 
     def api(data, segment_ids, name=None):
-        ids = unwrap(segment_ids)
+        import jax.core
         import numpy as np
-        n = int(np.asarray(jnp.max(jnp.asarray(ids)))) + 1
+        ids = unwrap(segment_ids)
+        if isinstance(ids, jax.core.Tracer):
+            # under jit the id values are unknown: use the static upper
+            # bound (rows of data) so shapes stay compile-time constant
+            n = unwrap(data).shape[0]
+        elif np.asarray(ids).size == 0:
+            n = 0
+        else:
+            n = int(np.max(np.asarray(ids))) + 1
         return _op(data, segment_ids, num=n)
     return api
 
@@ -152,15 +159,23 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     r = np.asarray(unwrap(row))
     cp = np.asarray(unwrap(colptr))
     seeds = np.asarray(unwrap(input_nodes))
+    eid_arr = np.arange(len(r), dtype=np.int64) if eids is None \
+        else np.asarray(unwrap(eids))
     rng = np.random.default_rng()
-    out_n, out_c = [], []
+    out_n, out_c, out_e = [], [], []
     for s in seeds.tolist():
         lo, hi = int(cp[s]), int(cp[s + 1])
-        neigh = r[lo:hi]
-        if 0 <= sample_size < len(neigh):
-            neigh = rng.choice(neigh, size=sample_size, replace=False)
-        out_n.append(neigh)
-        out_c.append(len(neigh))
+        sel = np.arange(lo, hi)
+        if 0 <= sample_size < len(sel):
+            sel = rng.choice(sel, size=sample_size, replace=False)
+        out_n.append(r[sel])
+        out_e.append(eid_arr[sel])
+        out_c.append(len(sel))
     out_neighbors = np.concatenate(out_n) if out_n else np.array([], r.dtype)
     out_count = np.array(out_c, dtype=np.int64)
-    return wrap(jnp.asarray(out_neighbors)), wrap(jnp.asarray(out_count))
+    res = (wrap(jnp.asarray(out_neighbors)), wrap(jnp.asarray(out_count)))
+    if return_eids:
+        out_eids = np.concatenate(out_e) if out_e \
+            else np.array([], np.int64)
+        return res + (wrap(jnp.asarray(out_eids)),)
+    return res

@@ -1,0 +1,278 @@
+"""DiT — Diffusion Transformer family (the BASELINE.json DiT/SD3 config).
+
+Reference capability: the PaddleMIX DiT/SD3 recipes trained through the
+reference stack (conv patchify + adaLN-Zero transformer blocks +
+timestep/label conditioning). TPU-native design: same functional-core
+pattern as models/llama.py — stacked per-block params under lax.scan with
+optional remat, GSPMD param_specs over ('dp','fsdp','tp'); patchify is a
+reshape-einsum (not a conv) so the whole model is matmuls on the MXU.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DiTConfig", "dit_tiny", "dit_xl_2", "init_params", "forward",
+    "loss_fn", "param_specs", "make_train_step", "count_params",
+    "adamw_init",
+]
+
+
+@dataclasses.dataclass
+class DiTConfig:
+    image_size: int = 32          # latent spatial size (32 = 256px VAE/8)
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def dit_tiny(**kw) -> DiTConfig:
+    base = dict(image_size=8, patch_size=2, in_channels=4, hidden_size=64,
+                num_hidden_layers=2, num_attention_heads=4, num_classes=10,
+                dtype=jnp.float32, remat=False)
+    base.update(kw)
+    return DiTConfig(**base)
+
+
+def dit_xl_2(**kw) -> DiTConfig:
+    """DiT-XL/2 shapes (the headline DiT config)."""
+    base = dict(image_size=32, patch_size=2, hidden_size=1152,
+                num_hidden_layers=28, num_attention_heads=16)
+    base.update(kw)
+    return DiTConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_params(config: DiTConfig, key) -> Dict[str, Any]:
+    c = config
+    D = c.hidden_size
+    L = c.num_hidden_layers
+    pdim = c.patch_size * c.patch_size * c.in_channels
+    F = int(D * c.mlp_ratio)
+    ks = jax.random.split(key, 12)
+
+    def nrm(k, shape, std=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * std
+                ).astype(c.dtype)
+
+    return {
+        "patch_w": nrm(ks[0], (pdim, D)),
+        "patch_b": jnp.zeros((D,), c.dtype),
+        "pos": nrm(ks[1], (c.num_patches, D)),
+        # timestep MLP (sinusoidal freq embed -> 2-layer MLP)
+        "t_w1": nrm(ks[2], (256, D)),
+        "t_b1": jnp.zeros((D,), c.dtype),
+        "t_w2": nrm(ks[3], (D, D)),
+        "t_b2": jnp.zeros((D,), c.dtype),
+        # label embedding (+1 row: classifier-free-guidance null class)
+        "y_embed": nrm(ks[4], (c.num_classes + 1, D)),
+        "blocks": {
+            # adaLN-Zero: 6 modulation vectors per block from conditioning;
+            # final projection starts at ZERO (identity residual at init)
+            "mod_w": jnp.zeros((L, D, 6 * D), c.dtype),
+            "mod_b": jnp.zeros((L, 6 * D), c.dtype),
+            "qkv_w": nrm(ks[5], (L, D, 3 * D)),
+            "qkv_b": jnp.zeros((L, 3 * D), c.dtype),
+            "proj_w": nrm(ks[6], (L, D, D)),
+            "proj_b": jnp.zeros((L, D), c.dtype),
+            "mlp_w1": nrm(ks[7], (L, D, F)),
+            "mlp_b1": jnp.zeros((L, F), c.dtype),
+            "mlp_w2": nrm(ks[8], (L, F, D)),
+            "mlp_b2": jnp.zeros((L, D), c.dtype),
+        },
+        # final adaLN + zero-init output projection to patch pixels
+        "final_mod_w": jnp.zeros((D, 2 * D), c.dtype),
+        "final_mod_b": jnp.zeros((2 * D,), c.dtype),
+        "final_w": jnp.zeros((D, pdim), c.dtype),
+        "final_b": jnp.zeros((pdim,), c.dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# pieces
+# ---------------------------------------------------------------------------
+
+def timestep_embedding(t, dim=256, max_period=10000.0):
+    """Sinusoidal timestep features [B, dim] (DiT convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def patchify(x, config: DiTConfig):
+    """[B, C, H, W] -> [B, N, p*p*C] (einops-style reshape)."""
+    c = config
+    B, C, H, W = x.shape
+    p = c.patch_size
+    x = x.reshape(B, C, H // p, p, W // p, p)
+    x = jnp.transpose(x, (0, 2, 4, 3, 5, 1))        # B, h, w, p, p, C
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def unpatchify(x, config: DiTConfig):
+    c = config
+    B, N, _ = x.shape
+    p = c.patch_size
+    hw = c.image_size // p
+    x = x.reshape(B, hw, hw, p, p, c.in_channels)
+    x = jnp.transpose(x, (0, 5, 1, 3, 2, 4))
+    return x.reshape(B, c.in_channels, hw * p, hw * p)
+
+
+def _ln(x):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype)
+
+
+def _modulate(x, shift, scale):
+    return x * (1 + scale[:, None, :]) + shift[:, None, :]
+
+
+def _block(x, cond, bp, config: DiTConfig):
+    c = config
+    B, N, D = x.shape
+    nh, hd = c.num_attention_heads, c.head_dim
+    mod = jax.nn.silu(cond) @ bp["mod_w"] + bp["mod_b"]     # [B, 6D]
+    sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mod, 6, axis=-1)
+
+    h = _modulate(_ln(x), sh1, sc1)
+    qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+    q, k, v = jnp.split(qkv.reshape(B, N, 3, nh, hd), 3, axis=2)
+    q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]            # [B, N, nh, hd]
+    from ..nn.functional.attention import sdpa_raw
+    a = sdpa_raw(q, k, v, is_causal=False).reshape(B, N, D)
+    x = x + g1[:, None, :] * (a @ bp["proj_w"] + bp["proj_b"])
+
+    h = _modulate(_ln(x), sh2, sc2)
+    h = jax.nn.gelu(h @ bp["mlp_w1"] + bp["mlp_b1"], approximate=True)
+    x = x + g2[:, None, :] * (h @ bp["mlp_w2"] + bp["mlp_b2"])
+    return x
+
+
+def forward(params, x, t, y, config: DiTConfig, *,
+            mesh: Optional[Mesh] = None):
+    """Noise prediction: x [B,C,H,W] latents, t [B] timesteps, y [B]
+    labels -> [B,C,H,W]."""
+    c = config
+    p = params
+    h = patchify(x.astype(c.dtype), c) @ p["patch_w"] + p["patch_b"]
+    h = h + p["pos"][None]
+
+    temb = timestep_embedding(t).astype(c.dtype)
+    cond = jax.nn.silu(temb @ p["t_w1"] + p["t_b1"]) @ p["t_w2"] + p["t_b2"]
+    cond = cond + jnp.take(p["y_embed"], y, axis=0)
+
+    def step(carry, bp):
+        return _block(carry, cond, bp, c), None
+
+    step_fn = jax.checkpoint(step, prevent_cse=False) if c.remat else step
+    h, _ = lax.scan(step_fn, h, p["blocks"])
+
+    fmod = jax.nn.silu(cond) @ p["final_mod_w"] + p["final_mod_b"]
+    fsh, fsc = jnp.split(fmod, 2, axis=-1)
+    h = _modulate(_ln(h), fsh, fsc)
+    out = h @ p["final_w"] + p["final_b"]
+    return unpatchify(out.astype(jnp.float32), c)
+
+
+def _alpha_bar_table(tmax: int = 1000):
+    """cumprod(1 - beta_t) for the linear DDPM schedule (a compile-time
+    constant table, indexed by traced t)."""
+    betas = jnp.linspace(1e-4, 0.02, tmax)
+    return jnp.cumprod(1.0 - betas)
+
+
+def loss_fn(params, batch, config: DiTConfig, *,
+            mesh: Optional[Mesh] = None):
+    """DDPM epsilon-prediction MSE: batch = (x0, t, y, noise), t integer
+    timesteps in [0, 1000) (the DiT training objective)."""
+    x0, t, y, noise = batch
+    abar = jnp.take(_alpha_bar_table(), t.astype(jnp.int32)
+                    )[:, None, None, None]
+    xt = jnp.sqrt(abar) * x0 + jnp.sqrt(1 - abar) * noise
+    pred = forward(params, xt, t, y, config, mesh=mesh)
+    return jnp.mean((pred - noise) ** 2)
+
+
+def param_specs(config: DiTConfig) -> Dict[str, Any]:
+    """('dp','fsdp','tp') placements: attention/MLP matmuls column/row
+    split on tp, the other dim on fsdp."""
+    return {
+        "patch_w": P("fsdp", "tp"),
+        "patch_b": P(None),
+        "pos": P(None, "fsdp"),
+        "t_w1": P("fsdp", "tp"), "t_b1": P(None),
+        "t_w2": P("fsdp", "tp"), "t_b2": P(None),
+        "y_embed": P(None, "fsdp"),
+        "blocks": {
+            "mod_w": P(None, "fsdp", "tp"), "mod_b": P(None, None),
+            "qkv_w": P(None, "fsdp", "tp"), "qkv_b": P(None, None),
+            "proj_w": P(None, "tp", "fsdp"), "proj_b": P(None, None),
+            "mlp_w1": P(None, "fsdp", "tp"), "mlp_b1": P(None, None),
+            "mlp_w2": P(None, "tp", "fsdp"), "mlp_b2": P(None, None),
+        },
+        "final_mod_w": P("fsdp", "tp"), "final_mod_b": P(None),
+        "final_w": P("fsdp", None), "final_b": P(None),
+    }
+
+
+def count_params(config: DiTConfig) -> int:
+    import numpy as np
+    dummy = jax.eval_shape(lambda: init_params(config, jax.random.key(0)))
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(dummy)))
+
+
+def adamw_init(params):
+    from .llama import adamw_init as _ai
+    return _ai(params)
+
+
+def make_train_step(config: DiTConfig, mesh: Optional[Mesh] = None, *,
+                    lr: float = 1e-4):
+    from .llama import _adamw_update
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, config, mesh=mesh))(params)
+        params, opt_state = _adamw_update(params, grads, opt_state, lr)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step)
+    specs = param_specs(config)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda s: isinstance(s, P))
+
+    def placed(params, opt_state, batch):
+        params = jax.lax.with_sharding_constraint(params, pshard)
+        return step(params, opt_state, batch)
+
+    return jax.jit(placed)

@@ -1,0 +1,302 @@
+"""Mixture-of-Experts decoder LM family (DeepSeekMoE / Qwen2-MoE /
+ERNIE-4.5-style, the BASELINE.json EP configs).
+
+Reference capability: the PaddleNLP llm/ MoE recipes trained through the
+reference's expert-parallel stack (incubate/distributed/models/moe/
+moe_layer.py dispatch/combine + gate, fleet expert-parallel groups).
+TPU-native design: GShard DENSE dispatch/combine — routing becomes two
+einsums against a one-hot combine tensor, so shapes stay static under jit
+and the expert axis shards over the mesh's 'ep' dimension (expert weights
+are [E, ...] arrays with E on 'ep'; XLA turns the dispatch einsum into an
+all-to-all over ICI). Fine-grained experts + a shared expert follow the
+DeepSeekMoE shape; top-k routing carries the switch-style load-balancing
+auxiliary loss.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .llama import _rms, apply_rope
+from ..nn.functional.attention import rope_tables as _rope_tables, sdpa_raw
+
+__all__ = [
+    "MoEConfig", "moe_tiny", "deepseek_moe_16b", "qwen2_moe_a14b",
+    "init_params", "forward", "loss_fn", "param_specs", "make_train_step",
+    "count_params", "adamw_init",
+]
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 1408        # per routed expert
+    shared_intermediate_size: int = 2816  # shared-expert MLP width
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 64
+    num_experts_per_tok: int = 6
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    router_aux_loss_coef: float = 0.001
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def moe_tiny(**kw) -> MoEConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=32,
+                shared_intermediate_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=4,
+                num_experts=4, num_experts_per_tok=2,
+                max_position_embeddings=128, dtype=jnp.float32,
+                remat=False)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+def deepseek_moe_16b(**kw) -> MoEConfig:
+    """DeepSeekMoE-16B shapes (BASELINE config)."""
+    base = dict(vocab_size=102400, hidden_size=2048,
+                intermediate_size=1408, shared_intermediate_size=2816,
+                num_hidden_layers=28, num_attention_heads=16,
+                num_key_value_heads=16, num_experts=64,
+                num_experts_per_tok=6, max_position_embeddings=4096)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+def qwen2_moe_a14b(**kw) -> MoEConfig:
+    """Qwen2-MoE-A14B shapes (BASELINE config)."""
+    base = dict(vocab_size=151936, hidden_size=3584,
+                intermediate_size=2560, shared_intermediate_size=20480,
+                num_hidden_layers=28, num_attention_heads=28,
+                num_key_value_heads=4, num_experts=64,
+                num_experts_per_tok=8, max_position_embeddings=32768,
+                rope_theta=1000000.0)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_params(config: MoEConfig, key) -> Dict[str, Any]:
+    c = config
+    hd, nh, nkv = c.head_dim, c.num_attention_heads, c.num_key_value_heads
+    L, D, Fe, Fs = (c.num_hidden_layers, c.hidden_size,
+                    c.intermediate_size, c.shared_intermediate_size)
+    E, V = c.num_experts, c.vocab_size
+    ks = jax.random.split(key, 12)
+
+    def nrm(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02
+                ).astype(c.dtype)
+
+    return {
+        "embed": nrm(ks[0], (V, D)),
+        "layers": {
+            "ln1": jnp.ones((L, D), c.dtype),
+            "wq": nrm(ks[1], (L, D, nh * hd)),
+            "wk": nrm(ks[2], (L, D, nkv * hd)),
+            "wv": nrm(ks[3], (L, D, nkv * hd)),
+            "wo": nrm(ks[4], (L, nh * hd, D)),
+            "ln2": jnp.ones((L, D), c.dtype),
+            # router in float32 (routing logits are precision-sensitive)
+            "router": jax.random.normal(ks[5], (L, D, E),
+                                        jnp.float32) * 0.02,
+            # routed experts: [L, E, ...] with E on the ep mesh axis
+            "e_gate": nrm(ks[6], (L, E, D, Fe)),
+            "e_up": nrm(ks[7], (L, E, D, Fe)),
+            "e_down": nrm(ks[8], (L, E, Fe, D)),
+            # shared expert (always on — DeepSeekMoE)
+            "s_gate": nrm(ks[9], (L, D, Fs)),
+            "s_up": nrm(ks[10], (L, D, Fs)),
+            "s_down": nrm(ks[11], (L, Fs, D)),
+        },
+        "ln_f": jnp.ones((D,), c.dtype),
+        "lm_head": nrm(jax.random.fold_in(key, 7), (V, D)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# MoE block
+# ---------------------------------------------------------------------------
+
+def _moe_mlp(h, lp, config: MoEConfig, mesh):
+    """GShard dense dispatch: combine[t, e] carries top-k router weights;
+    expert compute is an einsum over the (sharded) expert axis. Returns
+    (out, aux_loss)."""
+    c = config
+    B, S, D = h.shape
+    T = B * S
+    x = h.reshape(T, D)
+
+    logits = (x.astype(jnp.float32) @ lp["router"])         # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = lax.top_k(probs, c.num_experts_per_tok)    # [T, k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)     # renormalize
+    combine = jnp.zeros((T, c.num_experts), jnp.float32).at[
+        jnp.arange(T)[:, None], topi].set(topv)             # [T, E]
+
+    # switch-style load-balance aux loss (reference: moe gate aux)
+    me = jnp.mean(probs, axis=0)                            # [E]
+    ce = jnp.mean((combine > 0).astype(jnp.float32), axis=0)
+    aux = c.num_experts * jnp.sum(me * ce)
+
+    constrain = (lambda a, spec: lax.with_sharding_constraint(
+        a, NamedSharding(mesh, spec))) if mesh is not None \
+        else (lambda a, spec: a)
+
+    # dispatch with the BINARY routing mask (each selected expert sees the
+    # unscaled token), combine with the router weights — gates scale
+    # expert OUTPUTS, the DeepSeekMoE/GShard semantics (scaling the input
+    # of a nonlinear expert would compute a different function)
+    dispatch = (combine > 0).astype(c.dtype)                # [T, E]
+    xe = jnp.einsum("td,te->etd", x.astype(c.dtype), dispatch)
+    xe = constrain(xe, P("ep", None, None))
+    g = jnp.einsum("etd,edf->etf", xe, lp["e_gate"])
+    u = jnp.einsum("etd,edf->etf", xe, lp["e_up"])
+    y = jnp.einsum("etf,efd->etd", jax.nn.silu(g) * u, lp["e_down"])
+    y = constrain(y, P("ep", None, None))
+    routed = jnp.einsum("etd,te->td", y.astype(jnp.float32),
+                        combine).astype(c.dtype)            # weighted combine
+
+    sg = x @ lp["s_gate"]
+    su = x @ lp["s_up"]
+    shared = (jax.nn.silu(sg) * su) @ lp["s_down"]
+
+    return (routed + shared).reshape(B, S, D).astype(h.dtype), aux
+
+
+def _block(x, lp, cos, sin, config: MoEConfig, mesh):
+    c = config
+    B, S, D = x.shape
+    nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+
+    h = _rms(x, lp["ln1"], c.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, nh * hd)
+    x = x + a @ lp["wo"]
+
+    h = _rms(x, lp["ln2"], c.rms_norm_eps)
+    moe_out, aux = _moe_mlp(h, lp, c, mesh)
+    return x + moe_out, aux
+
+
+def forward(params, ids, config: MoEConfig, *,
+            mesh: Optional[Mesh] = None):
+    """Returns (logits [B,S,V], aux_loss scalar)."""
+    c = config
+    x = jnp.take(params["embed"], ids, axis=0)
+    cos, sin = _rope_tables(ids.shape[1], c.head_dim, theta=c.rope_theta)
+
+    def step(carry, lp):
+        y, aux = _block(carry, lp, cos, sin, c, mesh)
+        return y, aux
+
+    if c.remat:
+        step = jax.checkpoint(step, prevent_cse=False)
+    x, auxes = lax.scan(step, x, params["layers"])
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, jnp.sum(auxes)
+
+
+def loss_fn(params, batch, config: MoEConfig, *,
+            mesh: Optional[Mesh] = None):
+    if isinstance(batch, (tuple, list)):
+        inp, labels = batch
+    else:
+        inp, labels = batch[:, :-1], batch[:, 1:]
+    logits, aux = forward(params, inp, config, mesh=mesh)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold) + config.router_aux_loss_coef * aux
+
+
+# ---------------------------------------------------------------------------
+# sharding + train step
+# ---------------------------------------------------------------------------
+
+def param_specs(config: MoEConfig) -> Dict[str, Any]:
+    """Placements over a ('dp','fsdp','ep','tp') mesh: expert weights put
+    E on 'ep' (expert parallelism) and the expert FFN dims on 'tp'/'fsdp';
+    dense weights follow the Megatron/fsdp layout of the llama family."""
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "ln2": P(None, None),
+            "router": P(None, "fsdp", None),
+            "e_gate": P(None, "ep", "fsdp", "tp"),
+            "e_up": P(None, "ep", "fsdp", "tp"),
+            "e_down": P(None, "ep", "tp", "fsdp"),
+            "s_gate": P(None, "fsdp", "tp"),
+            "s_up": P(None, "fsdp", "tp"),
+            "s_down": P(None, "tp", "fsdp"),
+        },
+        "ln_f": P(None),
+        "lm_head": P("tp", "fsdp"),
+    }
+
+
+def count_params(config: MoEConfig) -> int:
+    import numpy as np
+    c = config
+    dummy = jax.eval_shape(lambda: init_params(c, jax.random.key(0)))
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(dummy)))
+
+
+def adamw_init(params):
+    from .llama import adamw_init as _ai
+    return _ai(params)
+
+
+def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
+                    lr: float = 1e-4):
+    """Jitted AdamW train step; with a mesh, params/opt-state placements
+    come from param_specs and the batch shards over ('dp','fsdp')."""
+    from .llama import _adamw_update
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, config, mesh=mesh))(params)
+        params, opt_state = _adamw_update(params, grads, opt_state, lr)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step)
+
+    specs = param_specs(config)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda s: isinstance(s, P))
+
+    def placed(params, opt_state, batch):
+        params = jax.lax.with_sharding_constraint(params, pshard)
+        batch = jax.lax.with_sharding_constraint(
+            batch, NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        return step(params, opt_state, batch)
+
+    return jax.jit(placed)

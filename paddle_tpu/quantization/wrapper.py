@@ -25,6 +25,11 @@ class ObserveWrapper(Layer):
         return self._inner_layer
 
     def forward(self, x, *args, **kwargs):
+        # propagate train/eval mode to the live quanters (Layer.eval()
+        # flips self.training; the quanter objects are not sublayers)
+        for q in (self._act, self._wt):
+            if q is not None and hasattr(q, "training"):
+                q.training = self.training
         if self._act is not None:
             x = self._act(x)
         if self._wt is not None and hasattr(self._inner_layer, "weight"):
@@ -56,11 +61,26 @@ class QuantedLinear(Layer):
 
     def __init__(self, qweight, w_scale, bias=None, act_scale=None, bits=8):
         super().__init__()
-        self.qweight = qweight              # int8 Tensor [in, out]
-        self.w_scale = float(w_scale)
-        self.act_scale = act_scale
+        # buffers so state_dict round-trips the quantized weights + scales
+        self.register_buffer("qweight", qweight)   # int8 Tensor [in, out]
+        self.register_buffer(
+            "w_scale_t", Tensor(jnp.float32(float(w_scale))))
+        if act_scale is not None:
+            self.register_buffer(
+                "act_scale_t", Tensor(jnp.float32(float(act_scale))))
+        else:
+            self.act_scale_t = None
         self.bias = bias
         self.bits = bits
+
+    @property
+    def w_scale(self):
+        return float(self.w_scale_t._data)
+
+    @property
+    def act_scale(self):
+        return None if self.act_scale_t is None \
+            else float(self.act_scale_t._data)
 
     def forward(self, x):
         w = dequant(self.qweight, jnp.float32(self.w_scale), self.bits)

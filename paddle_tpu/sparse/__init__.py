@@ -268,16 +268,18 @@ def _value_space(sp, data):
     return jsparse.BCOO((data, sp.indices), shape=sp.shape)
 
 
-def _binary(fn, concat_ok=False):
-    """Binary op staying sparse where possible: same-pattern operands and
-    scalars run in value space; sparse+sparse add/sub unions indices via
-    concat + sum_duplicates; everything else (dense operand, sparse*sparse
-    intersection) falls back to dense — the reference's sparse kernels
-    have the same structural cases (phi/kernels/sparse/elementwise_*)."""
+def _binary(fn, concat_ok=False, scalar_value_space=False):
+    """Binary op staying sparse where possible: same-pattern operands (and,
+    for mul/div only, scalars — add/sub with a scalar changes implicit
+    zeros and must densify) run in value space; sparse+sparse add/sub
+    unions indices via concat + sum_duplicates; everything else (dense
+    operand, sparse*sparse intersection) falls back to dense — the
+    reference's sparse kernels have the same structural cases
+    (phi/kernels/sparse/elementwise_*)."""
 
     def op(x, y, name=None):
-        if _is_sp(x) and jnp.ndim(unwrap(y) if not _is_sp(y) else 0) == 0 \
-                and not _is_sp(y):
+        if scalar_value_space and _is_sp(x) and not _is_sp(y) \
+                and jnp.ndim(unwrap(y)) == 0:
             return _rewrap(_value_space(x._sp, fn(x._sp.data, unwrap(y))), x)
         if _is_sp(x) and _is_sp(y):
             a, b = x._sp, y._sp
@@ -302,8 +304,8 @@ def _binary(fn, concat_ok=False):
 
 add = _binary(jnp.add, concat_ok=True)
 subtract = _binary(jnp.subtract, concat_ok=True)
-multiply = _binary(jnp.multiply)
-divide = _binary(jnp.divide)
+multiply = _binary(jnp.multiply, scalar_value_space=True)
+divide = _binary(jnp.divide, scalar_value_space=True)
 
 
 # ---------------------------------------------------------------------------
