@@ -10,6 +10,8 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa
                         RowParallelLinear, VocabParallelEmbedding)
 from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,  # noqa
                         SharedLayerDesc)
+from . import elastic  # noqa
+from .elastic import ElasticManager, run_elastic  # noqa
 from . import pipeline_schedules  # noqa
 from .pipeline_runtime import PipelineParallel  # noqa
 from .recompute import recompute, recompute_sequential  # noqa

@@ -1,0 +1,83 @@
+"""Elastic training: fault detection + automatic job restart.
+
+Reference capability: python/paddle/distributed/fleet/elastic/manager.py:124
+(ElasticManager — watches workers via etcd heartbeats, relaunches the job
+on failure up to a restart budget, scale-in/out between bounds).
+TPU-native redesign: there is no etcd — fault detection IS the launch
+controller's fail-fast watcher (launch/main.py), and elasticity is a
+restart policy wrapped around it. Scale-in support: on each restart the
+manager can shrink to the largest viable worker count within
+[min_nproc, nproc] (the reference's np=min:max band).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+__all__ = ["ElasticManager", "ElasticStatus", "run_elastic"]
+
+
+class ElasticStatus:
+    """reference: elastic/manager.py ElasticStatus enum."""
+    COMPLETED = "completed"
+    RESTART = "restart"
+    ERROR = "error"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Restart policy around the launch controller (reference:
+    ElasticManager.run/watch loop)."""
+
+    def __init__(self, max_restarts: int = 3, min_nproc: Optional[int] = None,
+                 restart_delay: float = 1.0,
+                 launcher: Optional[Callable] = None):
+        self.max_restarts = int(max_restarts)
+        self.min_nproc = min_nproc
+        self.restart_delay = restart_delay
+        if launcher is None:
+            from ..launch.main import launch as launcher
+        self._launch = launcher
+        self.restarts = 0
+        self.events = []   # (timestamp, status, detail)
+
+    def _record(self, status, detail):
+        self.events.append((time.time(), status, detail))
+
+    def run(self, script: str, script_args: Sequence[str] = (),
+            nproc_per_node: int = 1, **launch_kwargs) -> int:
+        """Run the job; on worker failure relaunch (same size, then
+        scale-in toward min_nproc when repeated failures suggest a sick
+        worker). Returns the final exit code (0 = completed)."""
+        nproc = nproc_per_node
+        while True:
+            rc = self._launch(script, script_args,
+                              nproc_per_node=nproc, **launch_kwargs)
+            if rc == 0:
+                self._record(ElasticStatus.COMPLETED, {"nproc": nproc})
+                return 0
+            if self.restarts >= self.max_restarts:
+                self._record(ElasticStatus.ERROR,
+                             {"nproc": nproc, "rc": rc,
+                              "reason": "restart budget exhausted"})
+                return rc
+            self.restarts += 1
+            # scale-in after half the budget is burned (reference scale-in
+            # when a peer stays unhealthy)
+            if (self.min_nproc is not None and nproc > self.min_nproc
+                    and self.restarts > self.max_restarts // 2):
+                nproc = max(self.min_nproc, nproc - 1)
+            self._record(ElasticStatus.RESTART,
+                         {"nproc": nproc, "rc": rc,
+                          "attempt": self.restarts})
+            time.sleep(self.restart_delay)
+
+
+def run_elastic(script: str, script_args: Sequence[str] = (),
+                nproc_per_node: int = 1, max_restarts: int = 3,
+                min_nproc: Optional[int] = None, **launch_kwargs) -> int:
+    """Functional form (reference: the `--elastic_level` launch path)."""
+    return ElasticManager(max_restarts=max_restarts,
+                          min_nproc=min_nproc).run(
+        script, script_args, nproc_per_node=nproc_per_node,
+        **launch_kwargs)

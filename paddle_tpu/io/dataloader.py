@@ -1,17 +1,25 @@
 """DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py +
-python/paddle/io/reader.py DataLoader).
+python/paddle/io/reader.py DataLoader + worker.py subprocess workers).
 
-TPU-native design: the loader produces host numpy batches on background
-threads (double-buffered prefetch) and converts to device arrays at yield
-time. Threads replace the reference's shared-memory worker *processes*: on
-TPU hosts the input pipeline is IO/CPU-light relative to the device step, and
-the GIL is released during numpy/jax conversion. num_workers>0 selects the
-threaded prefetcher; 0 is fully synchronous (debug mode, like the reference's
+TPU-native design: the loader produces host numpy batches in the
+background and converts to device arrays at yield time. Two worker modes:
+
+- ``worker_mode="thread"`` (default): double-buffered prefetch threads.
+  On TPU hosts the input pipeline is usually IO/CPU-light relative to the
+  device step and numpy/jax conversion releases the GIL.
+- ``worker_mode="process"``: true subprocess workers with an ordered
+  reassembly buffer — the reference's _DataLoaderIterMultiProcess design
+  (worker.py) for Python-heavy per-sample transforms (conv/vision
+  pipelines) that the GIL would serialize. Workers exchange numpy only
+  (no jax in children); fork start keeps datasets zero-copy on Linux.
+
+num_workers=0 is fully synchronous (debug mode, like the reference's
 single-process mode).
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as mp
 import queue
 import threading
 from typing import Callable, Optional
@@ -25,25 +33,32 @@ from .sampler import BatchSampler
 __all__ = ["DataLoader", "default_collate_fn"]
 
 
-def default_collate_fn(batch):
-    """Stack samples into batch arrays (reference:
-    dataloader/collate.py default_collate_fn)."""
+def _collate(batch, leaf_stack, recurse):
+    """Shared recursive collate skeleton; ``leaf_stack`` owns the array
+    leaves (jax in the parent, numpy-only in subprocess workers)."""
     sample = batch[0]
-    if isinstance(sample, Tensor):
-        import jax.numpy as jnp
-        return to_tensor(jnp.stack([b._data for b in batch]))
-    if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
+    if isinstance(sample, (Tensor, np.ndarray)):
+        return leaf_stack(batch)
     if isinstance(sample, (int, float, np.integer, np.floating)):
-        return to_tensor(np.asarray(batch))
+        return leaf_stack([np.asarray(b) for b in batch])
     if isinstance(sample, (str, bytes)):
         return list(batch)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+        return {k: recurse([b[k] for b in batch]) for k in sample}
     if isinstance(sample, (tuple, list)):
-        transposed = list(zip(*batch))
-        return [default_collate_fn(list(items)) for items in transposed]
+        return [recurse(list(items)) for items in zip(*batch)]
     return batch
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch Tensors (reference:
+    dataloader/collate.py default_collate_fn)."""
+    def leaf(items):
+        if isinstance(items[0], Tensor):
+            import jax.numpy as jnp
+            return to_tensor(jnp.stack([b._data for b in items]))
+        return to_tensor(np.stack(items))
+    return _collate(batch, leaf, default_collate_fn)
 
 
 class _ThreadedPrefetcher:
@@ -96,6 +111,145 @@ class _ThreadedPrefetcher:
                     break
 
 
+def _np_collate(batch):
+    """Worker-side collate: numpy-only. Tensor samples are REJECTED — a
+    forked child calling into the inherited jax runtime can deadlock on
+    its locks; process-mode datasets must return numpy/python samples."""
+    def leaf(items):
+        if isinstance(items[0], Tensor):
+            raise TypeError(
+                "worker_mode='process' datasets must return numpy arrays "
+                "or python scalars, not paddle Tensors (jax cannot run "
+                "safely inside forked DataLoader workers); return "
+                "np.ndarray from __getitem__ or use worker_mode='thread'")
+        return np.stack(items)
+    return _collate(batch, leaf, _np_collate)
+
+
+def _to_tensor_tree(x):
+    if isinstance(x, np.ndarray):
+        return to_tensor(x)
+    if isinstance(x, dict):
+        return {k: _to_tensor_tree(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_to_tensor_tree(v) for v in x]
+    return x
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        import traceback
+        self.msg = f"{type(exc).__name__}: {exc}\n" + traceback.format_exc()
+
+
+def _process_worker_loop(dataset, index_q, result_q, worker_init_fn, wid,
+                         ship_raw):
+    """One subprocess worker (reference: io/dataloader/worker.py
+    _worker_loop): pull (seq, indices), push (seq, numpy batch). With
+    ``ship_raw`` (user collate_fn), the raw sample list is shipped and
+    the parent applies the user's collate."""
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        task = index_q.get()
+        if task is None:
+            return
+        seq, idxs = task
+        try:
+            samples = [dataset[i] for i in idxs]
+            batch = samples if ship_raw else _np_collate(samples)
+        except BaseException as e:   # surface in the parent
+            result_q.put((seq, _WorkerError(e)))
+            continue
+        result_q.put((seq, batch))
+
+
+class _ProcessPrefetcher:
+    """Ordered multi-process batch pipeline: an index queue feeds workers,
+    results reassemble in submission order (the reference's out-of-order
+    queue + reorder logic in dataloader_iter.py)."""
+
+    def __init__(self, dataset, batches, num_workers, prefetch_factor,
+                 worker_init_fn, collate_fn=None, timeout=0):
+        self._dataset = dataset
+        self._batches = batches
+        self._n = num_workers
+        self._depth = max(2, prefetch_factor) * num_workers
+        self._init_fn = worker_init_fn
+        # non-default collate runs in the PARENT over raw shipped samples
+        # (a user fn may build Tensors — jax must stay out of the workers)
+        self._collate = collate_fn
+        self._timeout = timeout or None
+
+    def __iter__(self):
+        ctx = mp.get_context(
+            "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        ship_raw = self._collate is not None
+        workers = [ctx.Process(
+            target=_process_worker_loop,
+            args=(self._dataset, index_q, result_q, self._init_fn, w,
+                  ship_raw),
+            daemon=True) for w in range(self._n)]
+        for w in workers:
+            w.start()
+        try:
+            submitted = 0
+            received = 0
+            buf = {}
+            total = len(self._batches)
+            # prime the pipeline
+            while submitted < min(self._depth, total):
+                index_q.put((submitted, self._batches[submitted]))
+                submitted += 1
+            next_seq = 0
+            deadline = (None if self._timeout is None
+                        else __import__("time").time() + self._timeout)
+            while next_seq < total:
+                while next_seq not in buf and received < total:
+                    # bounded waits so a dead worker (OOM-kill, segfault)
+                    # raises instead of deadlocking the train loop
+                    # (reference: dataloader_iter.py worker health polls)
+                    try:
+                        seq, data = result_q.get(timeout=1.0)
+                    except queue.Empty:
+                        dead = [w for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) died unexpectedly "
+                                f"(exitcodes "
+                                f"{[w.exitcode for w in dead]}) — likely "
+                                "killed (OOM?) or crashed in native code")
+                        if deadline is not None and \
+                                __import__("time").time() > deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self._timeout}s waiting for a batch")
+                        continue
+                    buf[seq] = data
+                    received += 1
+                    if submitted < total:
+                        index_q.put((submitted, self._batches[submitted]))
+                        submitted += 1
+                data = buf.pop(next_seq)
+                next_seq += 1
+                if isinstance(data, _WorkerError):
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{data.msg}")
+                if ship_raw:
+                    yield self._collate(data)
+                else:
+                    yield _to_tensor_tree(data)
+        finally:
+            for _ in workers:
+                index_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+
+
 class DataLoader:
     """paddle.io.DataLoader parity surface."""
 
@@ -105,12 +259,18 @@ class DataLoader:
                  drop_last: bool = False, collate_fn=None,
                  num_workers: int = 0, use_buffer_reader: bool = True,
                  prefetch_factor: int = 2, use_shared_memory: bool = True,
-                 timeout: int = 0, worker_init_fn=None, persistent_workers=False):
+                 timeout: int = 0, worker_init_fn=None,
+                 persistent_workers=False, worker_mode: str = "thread"):
+        if worker_mode not in ("thread", "process"):
+            raise ValueError("worker_mode must be 'thread' or 'process'")
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.worker_mode = worker_mode
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -151,6 +311,23 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        if self.num_workers > 0 and self.worker_mode == "process":
+            if self._iterable_mode or self.batch_sampler is None:
+                raise ValueError(
+                    "worker_mode='process' requires a map-style dataset "
+                    "with batching (IterableDataset / batch_size=None "
+                    "cannot be index-partitioned across workers); use "
+                    "worker_mode='thread'")
+            batches = [list(b) for b in self.batch_sampler]
+            user_collate = (self.collate_fn
+                            if self.collate_fn is not default_collate_fn
+                            else None)
+            return iter(_ProcessPrefetcher(self.dataset, batches,
+                                           self.num_workers,
+                                           self.prefetch_factor,
+                                           self.worker_init_fn,
+                                           collate_fn=user_collate,
+                                           timeout=self.timeout))
         if self.num_workers > 0:
             return iter(_ThreadedPrefetcher(self._raw_iter,
                                             self.num_workers,

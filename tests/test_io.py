@@ -141,3 +141,149 @@ class TestDataLoader:
     def test_len(self):
         dl = DataLoader(RangeDataset(10), batch_size=3)
         assert len(dl) == 4
+
+
+class _SquareDataset:
+    """Top-level (picklable) dataset for process-worker tests."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        import numpy as _np
+        return (_np.full((3,), i, "float32"), _np.int64(i * i))
+
+    def __len__(self):
+        return self.n
+
+
+class TestProcessWorkers:
+    def test_process_mode_matches_sync(self):
+        import paddle_tpu.io as io
+        ds = _SquareDataset(32)
+        sync = list(io.DataLoader(ds, batch_size=4, shuffle=False))
+        procs = list(io.DataLoader(ds, batch_size=4, shuffle=False,
+                                   num_workers=2, worker_mode="process"))
+        assert len(procs) == len(sync) == 8
+        for (xs, ys), (xp, yp) in zip(sync, procs):
+            np.testing.assert_allclose(np.asarray(xs.numpy()),
+                                       np.asarray(xp.numpy()))
+            np.testing.assert_allclose(np.asarray(ys.numpy()),
+                                       np.asarray(yp.numpy()))
+
+    def test_process_mode_preserves_order(self):
+        import paddle_tpu.io as io
+        ds = _SquareDataset(40)
+        out = list(io.DataLoader(ds, batch_size=5, shuffle=False,
+                                 num_workers=3, worker_mode="process"))
+        firsts = [int(np.asarray(b[0].numpy())[0, 0]) for b in out]
+        assert firsts == [0, 5, 10, 15, 20, 25, 30, 35]
+
+    def test_worker_error_propagates(self):
+        import paddle_tpu.io as io
+
+        class Bad(_SquareDataset):
+            def __getitem__(self, i):
+                if i == 7:
+                    raise ValueError("poison sample")
+                return super().__getitem__(i)
+
+        dl = io.DataLoader(Bad(16), batch_size=4, shuffle=False,
+                           num_workers=2, worker_mode="process")
+        with pytest.raises(RuntimeError, match="poison"):
+            list(dl)
+
+
+class TestElastic:
+    def test_restarts_until_success(self, tmp_path):
+        from paddle_tpu.distributed.fleet import ElasticManager
+        calls = []
+
+        def fake_launch(script, script_args, nproc_per_node, **kw):
+            calls.append(nproc_per_node)
+            return 0 if len(calls) >= 3 else 1
+
+        m = ElasticManager(max_restarts=5, launcher=fake_launch,
+                           restart_delay=0.0)
+        rc = m.run("train.py", nproc_per_node=4)
+        assert rc == 0 and len(calls) == 3 and m.restarts == 2
+        assert m.events[-1][1] == "completed"
+
+    def test_budget_exhausted_returns_failure(self):
+        from paddle_tpu.distributed.fleet import ElasticManager
+        m = ElasticManager(max_restarts=2,
+                           launcher=lambda *a, **k: 7, restart_delay=0.0)
+        rc = m.run("train.py", nproc_per_node=2)
+        assert rc == 7 and m.restarts == 2
+        assert m.events[-1][1] == "error"
+
+    def test_scale_in_toward_min(self):
+        from paddle_tpu.distributed.fleet import ElasticManager
+        sizes = []
+
+        def fake_launch(script, script_args, nproc_per_node, **kw):
+            sizes.append(nproc_per_node)
+            return 1
+
+        m = ElasticManager(max_restarts=4, min_nproc=2,
+                           launcher=fake_launch, restart_delay=0.0)
+        m.run("train.py", nproc_per_node=4)
+        assert sizes[0] == 4 and sizes[-1] < 4 and min(sizes) >= 2
+
+    def test_real_elastic_restart(self, tmp_path):
+        """End-to-end: a worker that fails on the first run and succeeds
+        after a marker file exists (the transient-fault pattern)."""
+        from paddle_tpu.distributed.fleet import run_elastic
+        marker = tmp_path / "ok"
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').write('x')\n"
+            "    sys.exit(1)\n"
+            "print('ELASTIC_DONE')\n")
+        rc = run_elastic(str(script), nproc_per_node=1, max_restarts=2)
+        assert rc == 0
+
+    def test_process_mode_user_collate_runs_in_parent(self):
+        import paddle_tpu.io as io
+        import paddle_tpu as ptm
+
+        def my_collate(samples):
+            xs = np.stack([s[0] for s in samples])
+            return {"doubled": ptm.to_tensor(xs * 2)}
+
+        dl = io.DataLoader(_SquareDataset(12), batch_size=4, shuffle=False,
+                           num_workers=2, worker_mode="process",
+                           collate_fn=my_collate)
+        out = list(dl)
+        assert set(out[0]) == {"doubled"}
+        np.testing.assert_allclose(np.asarray(out[0]["doubled"].numpy())[:, 0],
+                                   [0, 2, 4, 6])
+
+    def test_process_mode_rejects_iterable(self):
+        import paddle_tpu.io as io
+        from paddle_tpu.io.dataset import IterableDataset
+
+        class It(IterableDataset):
+            def __iter__(self):
+                yield np.zeros(2, "float32")
+
+        dl = io.DataLoader(It(), batch_size=2, num_workers=2,
+                           worker_mode="process")
+        with pytest.raises(ValueError, match="process"):
+            iter(dl)
+
+    def test_process_mode_rejects_tensor_samples(self):
+        import paddle_tpu.io as io
+        import paddle_tpu as ptm
+
+        class TDs(_SquareDataset):
+            def __getitem__(self, i):
+                return ptm.to_tensor(np.zeros(2, "float32"))
+
+        dl = io.DataLoader(TDs(8), batch_size=2, num_workers=1,
+                           worker_mode="process")
+        with pytest.raises(RuntimeError, match="numpy"):
+            list(dl)
