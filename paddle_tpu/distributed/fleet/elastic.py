@@ -48,7 +48,10 @@ class ElasticManager:
             nproc_per_node: int = 1, **launch_kwargs) -> int:
         """Run the job; on worker failure relaunch (same size, then
         scale-in toward min_nproc when repeated failures suggest a sick
-        worker). Returns the final exit code (0 = completed)."""
+        worker). Returns the final exit code (0 = completed). The restart
+        budget is per-job: each run() starts fresh."""
+        self.restarts = 0
+        self.events = []
         nproc = nproc_per_node
         while True:
             rc = self._launch(script, script_args,

@@ -203,10 +203,13 @@ class _ProcessPrefetcher:
             while submitted < min(self._depth, total):
                 index_q.put((submitted, self._batches[submitted]))
                 submitted += 1
+            import time as _time
             next_seq = 0
-            deadline = (None if self._timeout is None
-                        else __import__("time").time() + self._timeout)
             while next_seq < total:
+                # per-BATCH timeout (paddle semantics): the clock restarts
+                # once each awaited batch arrives
+                deadline = (None if self._timeout is None
+                            else _time.time() + self._timeout)
                 while next_seq not in buf and received < total:
                     # bounded waits so a dead worker (OOM-kill, segfault)
                     # raises instead of deadlocking the train loop
@@ -222,7 +225,7 @@ class _ProcessPrefetcher:
                                 f"{[w.exitcode for w in dead]}) — likely "
                                 "killed (OOM?) or crashed in native code")
                         if deadline is not None and \
-                                __import__("time").time() > deadline:
+                                _time.time() > deadline:
                             raise RuntimeError(
                                 f"DataLoader timed out after "
                                 f"{self._timeout}s waiting for a batch")

@@ -19,7 +19,8 @@ from ...ops._op import op_fn
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
            "sdpa_reference", "sdpa_raw", "apply_rotary_emb",
-           "fused_rotary_position_embedding"]
+           "fused_rotary_position_embedding", "flash_attn_unpadded",
+           "segment_ids_from_cu_seqlens"]
 
 # Filled by paddle_tpu.kernels at import time with a pallas implementation;
 # signature (q, k, v, bias, causal, scale) -> out. None = use XLA path.
@@ -184,3 +185,92 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 @op_fn(name="fused_rope")
 def _rope_op(x, c, s, *, neox: bool = True):
     return rope_raw(x, c, s, neox=neox)
+
+
+# ---------------------------------------------------------------------------
+# varlen / unpadded attention (long-context aux, SURVEY §5)
+# ---------------------------------------------------------------------------
+
+def segment_ids_from_cu_seqlens(cu_seqlens, total):
+    """[0, l1, l1+l2, ...] -> per-token segment ids [total] (tokens past
+    the last boundary get a padding segment of -1)."""
+    import jax.numpy as jnp
+    pos = jnp.arange(total)
+    # segment id = number of boundaries <= pos, minus 1
+    seg = jnp.searchsorted(cu_seqlens, pos, side="right") - 1
+    nseg = cu_seqlens.shape[0] - 1
+    return jnp.where(seg < nseg, seg, -1)
+
+
+def _local_positions(cu_seqlens, seg, total):
+    """Per-token offset within its own segment (padding tokens get 0)."""
+    import jax.numpy as jnp
+    starts = jnp.take(cu_seqlens, jnp.clip(seg, 0, None))
+    return jnp.arange(total) - starts
+
+
+@op_fn(name="flash_attn_varlen")
+def _flash_varlen(q, k, v, seg_q, seg_k, pos_q, pos_k, *, causal, scale):
+    """Packed ragged attention: q/k/v [T, H, D] with per-token segment
+    ids; tokens attend only within their segment (block-diagonal mask),
+    optionally causal inside each segment.
+
+    Reference capability: nn/functional/flash_attention.py
+    flash_attn_unpadded (cu_seqlens varlen kernel). TPU-native: the
+    packed layout IS the TPU-friendly form (one dense [T, T] score tile
+    set, no padding waste); the segment mask keeps shapes static so jit
+    never recompiles across batches of different ragged lengths — the
+    same masking strategy as jax's splash-attention segment ids."""
+    import jax
+    import jax.numpy as jnp
+    t, h, d = q.shape
+    hk = k.shape[1]
+    if hk != h:                              # GQA
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    same = (seg_q[:, None] == seg_k[None, :]) & (seg_q[:, None] >= 0)
+    if causal:
+        # SEGMENT-LOCAL positions: q and k of the same sequence can sit at
+        # different global offsets when cu_seqlens_q != cu_seqlens_k
+        same = same & (pos_q[:, None] >= pos_k[None, :])
+    s = jnp.where(same[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (padding) produce uniform probs; zero them out
+    p = jnp.where(same[None], p, 0.0)
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """paddle.nn.functional.flash_attn_unpadded parity: packed [T, H, D]
+    tensors + cu_seqlens prefix sums -> (out, None)."""
+    from ...ops._op import unwrap, wrap
+    if dropout:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is not implemented on "
+            "the varlen path (pass dropout=0.0)")
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded: return_softmax=True is not supported "
+            "(the packed softmax is never materialized)")
+    cq, ck = unwrap(cu_seqlens_q), unwrap(cu_seqlens_k)
+    tq = unwrap(query).shape[0]
+    tk = unwrap(key).shape[0]
+    seg_q = segment_ids_from_cu_seqlens(cq, tq)
+    seg_k = segment_ids_from_cu_seqlens(ck, tk)
+    out = _flash_varlen(query, key, value, wrap(seg_q), wrap(seg_k),
+                        wrap(_local_positions(cq, seg_q, tq)),
+                        wrap(_local_positions(ck, seg_k, tk)),
+                        causal=bool(causal), scale=scale)
+    return out, None
+
+
+flash_attn_varlen_qkvpacked = None  # reserved name (reference exports it)

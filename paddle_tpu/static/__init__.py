@@ -34,12 +34,16 @@ from ..core.dtype import convert_dtype
 from ..core.tensor import Parameter, Tensor
 from ..jit.api import InputSpec  # noqa  (paddle.static.InputSpec)
 from .ir import Operator, Program, Var, _ParamRef
+from .passes import (PassManager, constant_folding,  # noqa
+                     dead_code_elimination, prune_for_fetch)
 
 __all__ = [
     "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "append_backward",
     "save_inference_model", "load_inference_model", "InputSpec",
     "global_scope", "scope_guard", "name_scope", "cpu_places", "Variable",
+    "PassManager", "constant_folding", "dead_code_elimination",
+    "prune_for_fetch",
 ]
 
 Variable = Var

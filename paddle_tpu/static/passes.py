@@ -1,0 +1,113 @@
+"""Program passes: the pass-manager slice of the reference's PIR layer.
+
+Reference capability: paddle/pir/ pass infrastructure + the common
+transforms (dead-code elimination, constant folding —
+paddle/fluid/pir/transforms/dead_code_elimination_pass.cc,
+constant_folding_pass.cc). TPU-native scope note: XLA already performs
+DCE/folding/fusion inside every compiled executable; these passes exist
+for the PROGRAM level — pruning what the Executor must replay and what
+save_inference_model serializes (smaller artifacts, no recompute of
+constant subgraphs), mirroring how the reference prunes programs before
+serving.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from .ir import Operator, Program, Var, _ParamRef
+
+__all__ = ["dead_code_elimination", "constant_folding", "PassManager",
+           "prune_for_fetch"]
+
+
+def prune_for_fetch(program: Program, fetch_vars: Sequence[Var]
+                    ) -> List[Operator]:
+    """The op slice actually needed for ``fetch_vars`` (reference: the
+    Program.prune used by save_inference_model)."""
+    needed = {v.name for v in fetch_vars}
+    kept: List[Operator] = []
+    for op in reversed(program.global_block.ops):
+        if any(o.name in needed for o in op.outputs):
+            kept.append(op)
+            for v in op.inputs:
+                needed.add(v.name)
+            for e in op.kwargs.values():
+                if isinstance(e, Var):
+                    needed.add(e.name)
+    kept.reverse()
+    return kept
+
+
+def dead_code_elimination(program: Program,
+                          fetch_vars: Sequence[Var]) -> int:
+    """Drop ops whose outputs can't reach any fetch var. Returns the
+    number of removed ops (reference: dead_code_elimination_pass.cc)."""
+    blk = program.global_block
+    kept = prune_for_fetch(program, fetch_vars)
+    removed = len(blk.ops) - len(kept)
+    keep_ids = {id(op) for op in kept}
+    for op in blk.ops:
+        if id(op) not in keep_ids:
+            for v in op.outputs:
+                blk.vars.pop(v.name, None)
+    blk.ops = kept
+    program._jit_cache.clear()
+    return removed
+
+
+def constant_folding(program: Program, freeze_params: bool = False) -> int:
+    """Constant folding (reference: constant_folding_pass.cc).
+
+    Structural note: in this IR, folding of feed-independent subgraphs
+    happens AT BUILD TIME by construction — an op whose inputs are all
+    concrete executes eagerly and never enters the program (the dispatcher
+    only records when a symbolic value is involved), so there is nothing
+    feed-independent left to fold afterwards. The pass therefore has one
+    real job, matching the reference's inference-freezing use:
+    ``freeze_params=True`` bakes each live parameter's CURRENT value into
+    the op templates (after which weight updates no longer affect this
+    program — the serving freeze before save_inference_model). Returns
+    the number of frozen parameter references."""
+    if not freeze_params:
+        return 0
+    blk = program.global_block
+    frozen = 0
+    for op in blk.ops:
+        for pos, entry in enumerate(op.arg_template):
+            if isinstance(entry, _ParamRef):
+                op.arg_template[pos] = entry.param._data
+                frozen += 1
+        for k, e in list(op.kwargs.items()):
+            if isinstance(e, _ParamRef):
+                op.kwargs[k] = e.param._data
+                frozen += 1
+    program._jit_cache.clear()
+    return frozen
+
+
+class PassManager:
+    """reference: pir pass manager — ordered pass pipeline over a
+    Program. Entries are pass names or (name, options) pairs, e.g.
+    ``PassManager(["dce", ("constant_folding", {"freeze_params": True})])``.
+    """
+
+    def __init__(self, passes: Sequence = ("dce",)):
+        self._passes = []
+        for p in passes:
+            if isinstance(p, str):
+                self._passes.append((p, {}))
+            else:
+                name, opts = p
+                self._passes.append((name, dict(opts)))
+
+    def run(self, program: Program, fetch_vars: Sequence[Var] = ()):
+        stats = {}
+        for name, opts in self._passes:
+            if name == "constant_folding":
+                stats[name] = constant_folding(program, **opts)
+            elif name in ("dead_code_elimination", "dce"):
+                stats[name] = dead_code_elimination(program, fetch_vars,
+                                                    **opts)
+            else:
+                raise ValueError(f"unknown pass {name!r}")
+        return stats

@@ -287,3 +287,12 @@ class TestElastic:
                            worker_mode="process")
         with pytest.raises(RuntimeError, match="numpy"):
             list(dl)
+
+    def test_elastic_budget_resets_per_run(self):
+        from paddle_tpu.distributed.fleet import ElasticManager
+        seq = iter([1, 1, 0, 1, 1, 0])    # two jobs, 2 retries each
+        m = ElasticManager(max_restarts=3,
+                           launcher=lambda *a, **k: next(seq),
+                           restart_delay=0.0)
+        assert m.run("a.py") == 0 and m.restarts == 2
+        assert m.run("b.py") == 0 and m.restarts == 2

@@ -457,3 +457,96 @@ class TestParityFixes:
         ob_p, hb_p = gru(t(xfull[:, :4]))
         np.testing.assert_allclose(ob.numpy()[0, :4], ob_p.numpy()[0],
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestVarlenAttention:
+    def test_unpadded_matches_per_sequence(self):
+        """Packed ragged attention == per-sequence dense attention."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(0)
+        lens = [5, 3, 8]
+        T, H, D = sum(lens), 2, 16
+        q = rng.normal(size=(T, H, D)).astype("float32")
+        k = rng.normal(size=(T, H, D)).astype("float32")
+        v = rng.normal(size=(T, H, D)).astype("float32")
+        cu = np.cumsum([0] + lens).astype("int32")
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True)
+        out = np.asarray(out.numpy())
+        import jax.numpy as jnp
+        for i, L in enumerate(lens):
+            lo, hi = cu[i], cu[i + 1]
+            ref = F.sdpa_reference(jnp.asarray(q[None, lo:hi]),
+                                   jnp.asarray(k[None, lo:hi]),
+                                   jnp.asarray(v[None, lo:hi]), causal=True)
+            np.testing.assert_allclose(out[lo:hi], np.asarray(ref)[0],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_padding_tokens_zero(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(1)
+        T, H, D = 8, 1, 8
+        q = rng.normal(size=(T, H, D)).astype("float32")
+        cu = np.array([0, 5], "int32")   # tokens 5..7 are padding
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(cu), paddle.to_tensor(cu))
+        np.testing.assert_allclose(np.asarray(out.numpy())[5:], 0.0)
+
+    def test_segment_ids(self):
+        import paddle_tpu.nn.functional as F
+        import jax.numpy as jnp
+        seg = F.segment_ids_from_cu_seqlens(jnp.array([0, 2, 5]), 7)
+        np.testing.assert_array_equal(np.asarray(seg),
+                                      [0, 0, 1, 1, 1, -1, -1])
+
+    def test_varlen_grad_flows(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(2)
+        q = paddle.to_tensor(rng.normal(size=(6, 1, 8)).astype("float32"),
+                             stop_gradient=False)
+        cu = paddle.to_tensor(np.array([0, 3, 6], "int32"))
+        out, _ = F.flash_attn_unpadded(q, q, q, cu, cu, causal=True)
+        out.sum().backward()
+        assert np.isfinite(q.grad.numpy()).all()
+
+    def test_varlen_causal_differing_cu_seqlens(self):
+        """Causal masking is SEGMENT-LOCAL: q and k prefix sums differ."""
+        import jax.numpy as jnp
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(4)
+        lens_q, lens_k = [2, 2], [3, 3]
+        cq = np.cumsum([0] + lens_q).astype("int32")
+        ck = np.cumsum([0] + lens_k).astype("int32")
+        H, D = 1, 8
+        q = rng.normal(size=(sum(lens_q), H, D)).astype("float32")
+        k = rng.normal(size=(sum(lens_k), H, D)).astype("float32")
+        v = rng.normal(size=(sum(lens_k), H, D)).astype("float32")
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cq), paddle.to_tensor(ck), causal=True)
+        out = np.asarray(out.numpy())
+        assert np.abs(out).max() > 0      # no fully-masked rows
+        # per-sequence reference with local causal alignment
+        for i in range(2):
+            qs = q[cq[i]:cq[i+1]]
+            ks = k[ck[i]:ck[i+1]]
+            vs = v[ck[i]:ck[i+1]]
+            s = np.einsum("qhd,khd->hqk", qs, ks) / np.sqrt(D)
+            mask = np.arange(len(qs))[:, None] >= np.arange(len(ks))[None, :]
+            s = np.where(mask[None], s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            ref = np.einsum("hqk,khd->qhd", p, vs)
+            np.testing.assert_allclose(out[cq[i]:cq[i+1]], ref,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_varlen_unsupported_options_raise(self):
+        import paddle_tpu.nn.functional as F
+        q = paddle.to_tensor(np.zeros((4, 1, 8), "float32"))
+        cu = paddle.to_tensor(np.array([0, 4], "int32"))
+        with pytest.raises(NotImplementedError, match="dropout"):
+            F.flash_attn_unpadded(q, q, q, cu, cu, dropout=0.1)
+        with pytest.raises(NotImplementedError, match="softmax"):
+            F.flash_attn_unpadded(q, q, q, cu, cu, return_softmax=True)

@@ -278,3 +278,75 @@ class TestReviewRegressions:
                          fetch_list=[out, out2])
         np.testing.assert_allclose(o1, np.where(mn, 0.0, xin))
         np.testing.assert_allclose(o2, np.where(mn, xin, o1))
+
+
+class TestPasses:
+    def test_dce_removes_unfetched(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            y = pt.exp(x)
+            dead = pt.tanh(x) * 3.0      # never fetched
+            z = y + 1.0
+        n_before = len(main.ops())
+        removed = static.dead_code_elimination(main, [z._symbolic])
+        assert removed >= 2 and len(main.ops()) < n_before
+        exe = static.Executor()
+        xin = np.random.randn(2, 2).astype("float32")
+        (out,) = exe.run(main, feed={"x": xin}, fetch_list=[z])
+        np.testing.assert_allclose(out, np.exp(xin) + 1.0, rtol=1e-5)
+
+    def test_build_time_folding_by_construction(self):
+        """Ops on concrete values execute at build time — the constant
+        subgraph never enters the program (folding by construction)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            c = pt.exp(pt.to_tensor(np.ones(2, "float32")))  # eager, folded
+            z = x + c
+        assert len(main.ops()) == 1          # only the add was recorded
+        (out,) = static.Executor().run(main,
+                                       feed={"x": np.zeros(2, "float32")},
+                                       fetch_list=[z])
+        np.testing.assert_allclose(out, np.exp(np.ones(2)), rtol=1e-5)
+
+    def test_constant_folding_freezes_params(self):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(2, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 2], "float32")
+            y = lin(x)
+        frozen = static.constant_folding(main, freeze_params=True)
+        assert frozen >= 2                   # weight + bias baked
+        exe = static.Executor()
+        xin = np.ones((1, 2), "float32")
+        (before,) = exe.run(main, feed={"x": xin}, fetch_list=[y])
+        lin.weight.set_value(pt.to_tensor(lin.weight.numpy() * 5))
+        (after,) = exe.run(main, feed={"x": xin}, fetch_list=[y])
+        np.testing.assert_allclose(before, after)   # frozen: update ignored
+
+    def test_pass_manager(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            dead = pt.sin(x)
+            z = pt.cos(x)
+        pm = static.PassManager(["constant_folding", "dce"])
+        stats = pm.run(main, [z._symbolic])
+        assert stats["dce"] >= 1
+        (out,) = static.Executor().run(main,
+                                       feed={"x": np.zeros(2, "float32")},
+                                       fetch_list=[z])
+        np.testing.assert_allclose(out, np.ones(2), rtol=1e-6)
+
+    def test_pass_manager_options(self):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(2, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 2], "float32")
+            y = lin(x)
+        stats = static.PassManager(
+            [("constant_folding", {"freeze_params": True})]).run(main)
+        assert stats["constant_folding"] >= 2
