@@ -1,0 +1,115 @@
+"""Vision functional ops: grid_sample / affine_grid.
+
+Reference capability: python/paddle/nn/functional/vision.py (grid_sample
+backed by phi grid_sample_kernel, affine_grid). TPU-native: bilinear
+sampling is expressed as four gathers + a lerp — XLA lowers the gathers to
+vectorized dynamic-slices, and the whole op is differentiable through the
+gathers (no custom backward kernel needed).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops._op import op_fn
+
+__all__ = ["grid_sample", "affine_grid"]
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+@op_fn(name="grid_sample")
+def _grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    """x [N, C, H, W], grid [N, Hg, Wg, 2] in [-1, 1] -> [N, C, Hg, Wg]."""
+    n, c, h, w = x.shape
+    gx = _unnormalize(grid[..., 0], w, align_corners)    # [N, Hg, Wg]
+    gy = _unnormalize(grid[..., 1], h, align_corners)
+
+    def clip_or_reflect(v, size):
+        if padding_mode == "border":
+            return jnp.clip(v, 0, size - 1), None
+        if padding_mode == "reflection":
+            # reflect about the pixel CENTERS (align_corners=True:
+            # [0, size-1]) or the pixel BORDERS (False: [-0.5, size-0.5])
+            # — the reference reflect_coordinates semantics
+            lo = 0.0 if align_corners else -0.5
+            hi = (size - 1.0) if align_corners else (size - 0.5)
+            span = hi - lo
+            v = jnp.mod(jnp.abs(v - lo), 2 * span)
+            v = jnp.where(v >= span, 2 * span - v, v) + lo
+            return jnp.clip(v, 0, size - 1), None
+        # zeros: keep raw coords, mask out-of-bounds later
+        return v, (v >= -1) & (v <= size)
+
+    gx, _ = (gx, None) if padding_mode == "zeros" else clip_or_reflect(gx, w)
+    gy, _ = (gy, None) if padding_mode == "zeros" else clip_or_reflect(gy, h)
+
+    if mode == "nearest":
+        ix = jnp.round(gx).astype(jnp.int32)
+        iy = jnp.round(gy).astype(jnp.int32)
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        batch = jnp.arange(n)[:, None, None]
+        out = x[batch, :, iyc, ixc]                     # [N, Hg, Wg, C]
+        out = jnp.where(valid[..., None], out, 0.0)
+        return jnp.moveaxis(out, -1, 1)
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0
+    wy1 = gy - y0
+    wx0, wy0 = 1.0 - wx1, 1.0 - wy1
+
+    def sample(ix, iy):
+        valid = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix.astype(jnp.int32), 0, w - 1)
+        iyc = jnp.clip(iy.astype(jnp.int32), 0, h - 1)
+        batch = jnp.arange(n)[:, None, None]
+        v = x[batch, :, iyc, ixc]                       # [N, Hg, Wg, C]
+        return jnp.where(valid[..., None], v, 0.0)
+
+    out = (sample(x0, y0) * (wx0 * wy0)[..., None]
+           + sample(x1, y0) * (wx1 * wy0)[..., None]
+           + sample(x0, y1) * (wx0 * wy1)[..., None]
+           + sample(x1, y1) * (wx1 * wy1)[..., None])
+    return jnp.moveaxis(out, -1, 1)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be bilinear/nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"bad padding_mode {padding_mode!r}")
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                        align_corners=align_corners)
+
+
+@op_fn(name="affine_grid")
+def _affine_grid(theta, *, out_shape, align_corners=True):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (reference:
+    functional/vision.py affine_grid)."""
+    n, _, h, w = out_shape
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+    else:
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+    gx, gy = jnp.meshgrid(xs, ys)                       # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)           # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base, theta)      # [N, H, W, 2]
+    return out
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    out_shape = [int(s) for s in out_shape]
+    return _affine_grid(theta, out_shape=tuple(out_shape),
+                        align_corners=align_corners)

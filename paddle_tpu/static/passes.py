@@ -106,6 +106,11 @@ class PassManager:
             if name == "constant_folding":
                 stats[name] = constant_folding(program, **opts)
             elif name in ("dead_code_elimination", "dce"):
+                if not fetch_vars:
+                    raise ValueError(
+                        "dead_code_elimination needs fetch_vars — with an "
+                        "empty fetch set EVERY op is dead and the whole "
+                        "program would be deleted")
                 stats[name] = dead_code_elimination(program, fetch_vars,
                                                     **opts)
             else:

@@ -1,0 +1,575 @@
+"""paddle.vision.ops parity: detection/vision operators.
+
+Reference capability: python/paddle/vision/ops.py (nms, roi_align,
+roi_pool, psroi_pool, box_coder, prior_box, yolo_box,
+distribute_fpn_proposals, deform_conv2d — phi detection kernels).
+TPU-native notes: pooled/aligned ops are bilinear gathers (differentiable,
+jit-able, MXU-adjacent); NMS and FPN distribution have data-dependent
+output sizes, so they run eagerly on host numpy — the same
+host-side role they play in the reference's CPU kernels (suppression is
+input-pipeline work, not device work).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.base import Layer
+from ..ops._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "nms", "roi_align", "roi_pool", "psroi_pool", "box_coder", "prior_box",
+    "yolo_box", "distribute_fpn_proposals", "deform_conv2d",
+    "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D",
+]
+
+
+# ---------------------------------------------------------------------------
+# NMS (eager host op — variable-size output, reference: ops.py nms)
+# ---------------------------------------------------------------------------
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS; with scores, keeps by descending score; with categories,
+    suppression is per-category (batched NMS). Returns kept indices."""
+    b = np.asarray(unwrap(boxes))
+    n = len(b)
+    if scores is not None:
+        order = np.argsort(-np.asarray(unwrap(scores)))
+    else:
+        order = np.arange(n)
+    cats = None if category_idxs is None else np.asarray(
+        unwrap(category_idxs))
+
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = np.maximum(0.0, x2 - x1) * np.maximum(0.0, y2 - y1)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1)
+        yy1 = np.maximum(y1[i], y1)
+        xx2 = np.minimum(x2[i], x2)
+        yy2 = np.minimum(y2[i], y2)
+        inter = np.maximum(0.0, xx2 - xx1) * np.maximum(0.0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        over = iou > iou_threshold
+        if cats is not None:
+            over &= cats == cats[i]
+        over[i] = False
+        suppressed |= over
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return wrap(jnp.asarray(keep))
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling family (differentiable bilinear gathers)
+# ---------------------------------------------------------------------------
+
+@op_fn(name="roi_align_op", nondiff_args=(1, 2))
+def _roi_align(x, boxes, boxes_num, *, output_size, spatial_scale,
+               sampling_ratio, aligned):
+    """x [N, C, H, W], boxes [R, 4] (x1,y1,x2,y2), boxes_num [N] ->
+    [R, C, ph, pw] (reference: roi_align phi kernel semantics)."""
+    n, c, h, w = x.shape
+    ph, pw = output_size
+    r = boxes.shape[0]
+    # map each roi to its batch image via the boxes_num prefix sum
+    roi_batch = jnp.searchsorted(jnp.cumsum(boxes_num),
+                                 jnp.arange(r), side="right")
+    offset = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale
+    x1 = bx[:, 0] - offset
+    y1 = bx[:, 1] - offset
+    x2 = bx[:, 2] - offset
+    y2 = bx[:, 3] - offset
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, ph, pw, s, s]
+    iy = jnp.arange(ph)
+    ix = jnp.arange(pw)
+    sy = (jnp.arange(s) + 0.5) / s
+    sx = (jnp.arange(s) + 0.5) / s
+    ys = (y1[:, None, None] + (iy[None, :, None] + sy[None, None, :])
+          * bin_h[:, None, None])                      # [R, ph, s]
+    xs = (x1[:, None, None] + (ix[None, :, None] + sx[None, None, :])
+          * bin_w[:, None, None])                      # [R, pw, s]
+
+    def bilinear(img, yy, xx):
+        """img [C, H, W]; yy [ph, s]; xx [pw, s] -> [C, ph, pw] (mean over
+        the s*s samples per bin)."""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy1 = yy - y0
+        wx1 = xx - x0
+
+        def g(iyv, ixv):
+            oky = (iyv >= 0) & (iyv < h)
+            okx = (ixv >= 0) & (ixv < w)
+            iyc = jnp.clip(iyv.astype(jnp.int32), 0, h - 1)
+            ixc = jnp.clip(ixv.astype(jnp.int32), 0, w - 1)
+            # [C, ph, s, pw, s]
+            v = img[:, iyc[:, :, None, None], ixc[None, None, :, :]]
+            m = (oky[:, :, None, None] & okx[None, None, :, :])
+            return v * m[None]
+
+        w00 = ((1 - wy1)[:, :, None, None] * (1 - wx1)[None, None, :, :])
+        w01 = ((1 - wy1)[:, :, None, None] * wx1[None, None, :, :])
+        w10 = (wy1[:, :, None, None] * (1 - wx1)[None, None, :, :])
+        w11 = (wy1[:, :, None, None] * wx1[None, None, :, :])
+        acc = (g(y0, x0) * w00[None] + g(y0, x0 + 1) * w01[None]
+               + g(y0 + 1, x0) * w10[None] + g(y0 + 1, x0 + 1) * w11[None])
+        return acc.mean(axis=(2, 4))                   # mean over s, s
+
+    imgs = x[roi_batch]                                # [R, C, H, W]
+    out = jax.vmap(bilinear)(imgs, ys, xs)             # [R, C, ph, pw]
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    sr = int(sampling_ratio)
+    if sr <= 0:
+        # reference uses an adaptive ceil(roi_extent / output_size) per
+        # RoI; static shapes need ONE count, so take the ceil over the
+        # largest concrete RoI (bounded), falling back to 4 under tracing
+        import jax.core as _jc
+        ba = unwrap(boxes)
+        if isinstance(ba, _jc.Tracer):
+            sr = 4
+        else:
+            b = np.asarray(ba)
+            if len(b) == 0:
+                sr = 1
+            else:
+                ext_h = (b[:, 3] - b[:, 1]) * spatial_scale / output_size[0]
+                ext_w = (b[:, 2] - b[:, 0]) * spatial_scale / output_size[1]
+                sr = int(np.clip(np.ceil(max(ext_h.max(), ext_w.max(),
+                                             1.0)), 1, 8))
+    return _roi_align(x, boxes, boxes_num, output_size=tuple(output_size),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=sr, aligned=bool(aligned))
+
+
+@op_fn(name="roi_pool_op", nondiff_args=(1, 2))
+def _roi_pool(x, boxes, boxes_num, *, output_size, spatial_scale):
+    """Max pooling per RoI bin (reference: roi_pool kernel)."""
+    n, c, h, w = x.shape
+    ph, pw = output_size
+    r = boxes.shape[0]
+    roi_batch = jnp.searchsorted(jnp.cumsum(boxes_num),
+                                 jnp.arange(r), side="right")
+    bx = jnp.round(boxes * spatial_scale)
+    x1 = bx[:, 0]
+    y1 = bx[:, 1]
+    rw = jnp.maximum(bx[:, 2] - x1 + 1, 1.0)
+    rh = jnp.maximum(bx[:, 3] - y1 + 1, 1.0)
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def pool_one(img, x1i, y1i, rwi, rhi):
+        # bin id of each pixel row/col (or -1 outside the roi)
+        bin_y = jnp.floor((ys - y1i) / (rhi / ph))
+        bin_x = jnp.floor((xs - x1i) / (rwi / pw))
+        out = jnp.full((c, ph, pw), -jnp.inf)
+        ybin = jnp.clip(bin_y, 0, ph - 1).astype(jnp.int32)
+        xbin = jnp.clip(bin_x, 0, pw - 1).astype(jnp.int32)
+        oky = (ys >= y1i) & (bin_y >= 0) & (bin_y < ph)
+        okx = (xs >= x1i) & (bin_x >= 0) & (bin_x < pw)
+        mask = oky[:, None] & okx[None, :]
+        vals = jnp.where(mask[None], img, -jnp.inf)    # [C, H, W]
+        # scatter-max into bins
+        flat_bins = (ybin[:, None] * pw + xbin[None, :]).reshape(-1)
+        flat = vals.reshape(c, -1)
+        out = jax.vmap(lambda row: jax.ops.segment_max(
+            row, flat_bins, ph * pw))(flat)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out.reshape(c, ph, pw)
+
+    imgs = x[roi_batch]
+    return jax.vmap(pool_one)(imgs, x1, y1, rw, rh)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_pool(x, boxes, boxes_num, output_size=tuple(output_size),
+                     spatial_scale=float(spatial_scale))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: psroi_pool): input
+    channels C = out_c * ph * pw; bin (i, j) reads channel group
+    (i*pw + j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xa = unwrap(x)
+    c = xa.shape[1]
+    if c % (ph * pw) != 0:
+        raise ValueError(
+            f"psroi_pool needs channels divisible by {ph * pw}, got {c}")
+    out_c = c // (ph * pw)
+    # average-align each position-sensitive group then pick its own bin
+    aligned = roi_align(x, boxes, boxes_num, output_size,
+                        spatial_scale=spatial_scale, sampling_ratio=2,
+                        aligned=False)
+    al = unwrap(aligned)                       # [R, C, ph, pw]
+    r = al.shape[0]
+    al = al.reshape(r, ph * pw, out_c, ph, pw)
+    # out[r, c, i, j] = al[r, i*pw + j, c, i, j] — full advanced indexing
+    # (all axes indexed together) keeps the broadcast shape [R,out_c,ph,pw]
+    ri = jnp.arange(r)[:, None, None, None]
+    ci = jnp.arange(out_c)[None, :, None, None]
+    ii = jnp.arange(ph)[None, None, :, None]
+    jj = jnp.arange(pw)[None, None, None, :]
+    out = al[ri, ii * pw + jj, ci, ii, jj]     # [R, out_c, ph, pw]
+    return wrap(out)
+
+
+# ---------------------------------------------------------------------------
+# box utilities
+# ---------------------------------------------------------------------------
+
+@op_fn(name="box_coder_op")
+def _box_coder(prior_box, target_box, prior_box_var, *, code_type,
+               box_normalized, axis):
+    """encode_center_size / decode_center_size (reference: ops.py
+    box_coder)."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph_ = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph_ * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph_[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph_[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)     # [T, P, 4]
+        if prior_box_var is not None:
+            out = out / prior_box_var[None, :, :]
+        return out
+    # decode: deltas [P, 4] or [N, P, 4]; ``axis`` selects which dim of a
+    # 3-D target the priors broadcast along (reference box_coder axis)
+    d = target_box
+    if prior_box_var is not None:
+        d = d * prior_box_var
+    if d.ndim == 3:
+        expand = (slice(None), None) if axis == 0 else (None, slice(None))
+        pw = pw[expand]
+        ph_ = ph_[expand]
+        pcx = pcx[expand]
+        pcy = pcy[expand]
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph_ + pcy
+    bw = jnp.exp(d[..., 2]) * pw
+    bh = jnp.exp(d[..., 3]) * ph_
+    return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                      cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    pv = None
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            pv = jnp.broadcast_to(jnp.asarray(prior_box_var, jnp.float32),
+                                  unwrap(prior_box).shape)
+        else:
+            pv = unwrap(prior_box_var)
+    return _box_coder(prior_box, target_box, wrap(pv) if pv is not None
+                      else None, code_type=code_type,
+                      box_normalized=box_normalized, axis=axis)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference: ops.py prior_box)."""
+    fh, fw = unwrap(input).shape[2:]
+    ih, iw = unwrap(image).shape[2:]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for s in min_sizes:
+        boxes.append((s, s))
+        ar_boxes = [(s * np.sqrt(ar), s / np.sqrt(ar))
+                    for ar in ars if abs(ar - 1.0) >= 1e-6]
+        max_boxes = []
+        if max_sizes:
+            for ms in max_sizes:
+                d = np.sqrt(s * ms)
+                max_boxes.append((d, d))
+        # paddle default (flag False): [min, aspect_ratios..., max];
+        # flag True is the Caffe [min, max, aspect_ratios...] ordering
+        if min_max_aspect_ratios_order:
+            boxes.extend(max_boxes)
+            boxes.extend(ar_boxes)
+        else:
+            boxes.extend(ar_boxes)
+            boxes.extend(max_boxes)
+    nb = len(boxes)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    out = np.zeros((fh, fw, nb, 4), np.float32)
+    for k, (bw, bh) in enumerate(boxes):
+        out[:, :, k, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, k, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, k, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, k, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(var))
+
+
+@op_fn(name="yolo_box_op", nondiff_args=(1,))
+def _yolo_box(x, img_size, *, anchors, class_num, conf_thresh,
+              downsample_ratio, clip_bbox, scale_x_y):
+    """Decode YOLO head output [N, na*(5+nc), H, W] -> (boxes, scores)
+    (reference: ops.py yolo_box)."""
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    nc = class_num
+    x = x.reshape(n, na, 5 + nc, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)
+    grid_y = jnp.arange(h, dtype=jnp.float32)
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    bx = (sx + grid_x[None, None, None, :]) / w
+    by = (sy + grid_y[None, None, :, None]) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    in_w = w * downsample_ratio
+    in_h = h * downsample_ratio
+    bw = jnp.exp(x[:, :, 2]) * aw[None, :, None, None] / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah[None, :, None, None] / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    mask = conf > conf_thresh
+    img_h = img_size[:, 0].astype(jnp.float32)
+    img_w = img_size[:, 1].astype(jnp.float32)
+    x1 = (bx - bw / 2) * img_w[:, None, None, None]
+    y1 = (by - bh / 2) * img_h[:, None, None, None]
+    x2 = (bx + bw / 2) * img_w[:, None, None, None]
+    y2 = (by + bh / 2) * img_h[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, img_h[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, img_w[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, img_h[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)       # [N, na, H, W, 4]
+    boxes = boxes * mask[..., None]
+    boxes = boxes.reshape(n, na * h * w, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+    scores = scores.reshape(n, na * h * w, nc)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None,
+             iou_aware=False, iou_aware_factor=0.5):
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box: iou_aware=True (the [N, na*(6+nc)] channel layout "
+            "with conf^(1-f)*iou^f scoring) is not implemented")
+    return _yolo_box(x, img_size, anchors=tuple(anchors),
+                     class_num=int(class_num), conf_thresh=conf_thresh,
+                     downsample_ratio=downsample_ratio,
+                     clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference: ops.py
+    distribute_fpn_proposals). Eager: level membership is data-dependent."""
+    rois = np.asarray(unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore = [], []
+    idx_order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.flatnonzero(lvl == L)
+        outs.append(wrap(jnp.asarray(rois[sel])))
+        idx_order.append(sel)
+    order = np.concatenate(idx_order) if idx_order else np.array([], np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    rois_num_per = [wrap(jnp.asarray(np.asarray([len(i)], np.int32)))
+                    for i in idx_order]
+    return outs, wrap(jnp.asarray(restore.reshape(-1, 1))), rois_num_per
+
+
+# ---------------------------------------------------------------------------
+# deformable conv (grid_sample composition)
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: ops.py deform_conv2d) composed
+    from bilinear sampling at offset positions + a dense matmul —
+    the TPU-friendly im2col formulation."""
+    from ..nn.functional.vision import grid_sample
+    xa = unwrap(x)
+    off = unwrap(offset)
+    wt = unwrap(weight)
+    n, cin, h, w = xa.shape
+    cout, cin_g, kh, kw = wt.shape
+    sh = sw = stride if isinstance(stride, int) else None
+    if sh is None:
+        sh, sw = stride
+    p = padding if isinstance(padding, int) else padding[0]
+    dh = dw_ = dilation if isinstance(dilation, int) else None
+    if dh is None:
+        dh, dw_ = dilation
+    oh = (h + 2 * p - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * p - dw_ * (kw - 1) - 1) // sw + 1
+
+    base_y = jnp.arange(oh) * sh - p
+    base_x = jnp.arange(ow) * sw - p
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw_
+    # absolute sample positions [oh, ow, kh, kw]
+    pos_y = base_y[:, None, None, None] + ky[None, None, :, None]
+    pos_x = base_x[None, :, None, None] + kx[None, None, None, :]
+    off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+    # paddle offset layout: [dg, kh*kw, (dy, dx), oh, ow]
+    dy = off[:, :, :, 0].reshape(n, deformable_groups, kh, kw, oh, ow)
+    dx = off[:, :, :, 1].reshape(n, deformable_groups, kh, kw, oh, ow)
+    sy = pos_y[None, None].transpose(0, 1, 4, 5, 2, 3) + dy  # broadcast
+    sx = pos_x[None, None].transpose(0, 1, 4, 5, 2, 3) + dx
+    # normalize to [-1, 1] for grid_sample (align_corners=True)
+    gy = 2.0 * sy / jnp.maximum(h - 1, 1) - 1.0
+    gx = 2.0 * sx / jnp.maximum(w - 1, 1) - 1.0
+    # [n, dg, kh, kw, oh, ow] -> sample each (kh, kw) tap: grid
+    # [n, kh*kw*oh, ow, 2] per deformable group
+    cg = cin // deformable_groups
+    cols = []
+    for g in range(deformable_groups):
+        grid = jnp.stack([gx[:, g], gy[:, g]], axis=-1)   # [n,kh,kw,oh,ow,2]
+        grid = grid.transpose(0, 1, 3, 2, 4, 5).reshape(
+            n, kh * oh, kw * ow, 2)
+        xg = xa[:, g * cg:(g + 1) * cg]
+        samp = grid_sample(wrap(xg), wrap(grid), align_corners=True)
+        samp = unwrap(samp).reshape(n, cg, kh, oh, kw, ow)
+        cols.append(samp.transpose(0, 1, 2, 4, 3, 5))     # [n,cg,kh,kw,oh,ow]
+    col = jnp.concatenate(cols, axis=1)                   # [n,cin,kh,kw,oh,ow]
+    if mask is not None:
+        m = unwrap(mask).reshape(n, deformable_groups, kh, kw, oh, ow)
+        m = jnp.repeat(m, cg, axis=1)
+        col = col * m
+    col = col.reshape(n, cin * kh * kw, oh * ow)
+    wmat = wt.reshape(cout, cin_g * kh * kw)
+    if groups == 1:
+        out = jnp.einsum("ok,nkp->nop", wmat, col)
+    else:
+        col = col.reshape(n, groups, (cin // groups) * kh * kw, oh * ow)
+        wmat = wmat.reshape(groups, cout // groups, -1)
+        out = jnp.einsum("gok,ngkp->ngop", wmat, col).reshape(
+            n, cout, oh * ow)
+    out = out.reshape(n, cout, oh, ow)
+    if bias is not None:
+        out = out + unwrap(bias)[None, :, None, None]
+    return wrap(out)
+
+
+# ---------------------------------------------------------------------------
+# layer wrappers (reference: ops.py RoIAlign/RoIPool/PSRoIPool/DeformConv2D)
+# ---------------------------------------------------------------------------
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        kh, kw = kernel_size
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)

@@ -1,0 +1,307 @@
+"""paddle.vision.ops + grid_sample/affine_grid tests.
+
+Reference strategy: test/legacy_test/test_nms_op.py, test_roi_align_op.py,
+test_grid_sampler_op.py — numpy references on small inputs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as vops
+import paddle_tpu.nn.functional as F
+
+
+def t(x, sg=True):
+    return pt.to_tensor(np.asarray(x), stop_gradient=sg)
+
+
+class TestNMS:
+    def test_basic_suppression(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         "float32")
+        scores = np.array([0.9, 0.8, 0.7], "float32")
+        keep = vops.nms(t(boxes), 0.5, scores=t(scores))
+        np.testing.assert_array_equal(np.asarray(keep.numpy()), [0, 2])
+
+    def test_categories_batched(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+        scores = np.array([0.9, 0.8], "float32")
+        cats = np.array([0, 1], "int64")
+        keep = vops.nms(t(boxes), 0.5, scores=t(scores),
+                        category_idxs=t(cats), categories=[0, 1])
+        assert len(keep.numpy()) == 2     # different classes: both kept
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 10, 10]],
+                         "float32")
+        scores = np.array([0.1, 0.9, 0.5], "float32")
+        keep = vops.nms(t(boxes), 0.5, scores=t(scores), top_k=2)
+        np.testing.assert_array_equal(np.asarray(keep.numpy()), [1, 2])
+
+
+class TestRoIOps:
+    def test_roi_align_uniform_image(self):
+        x = np.ones((1, 2, 8, 8), "float32")
+        boxes = np.array([[0, 0, 4, 4]], "float32")
+        out = vops.roi_align(t(x), t(boxes), t(np.array([1], "int32")), 2)
+        assert out.shape == [1, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 2, 2, 2)),
+                                   rtol=1e-5)
+
+    def test_roi_align_gradient_flows(self):
+        x = t(np.random.randn(1, 1, 8, 8).astype("float32"), sg=False)
+        boxes = t(np.array([[1, 1, 6, 6]], "float32"))
+        out = vops.roi_align(x, boxes, t(np.array([1], "int32")), 2)
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.abs(x.grad.numpy()).sum() > 0
+
+    def test_roi_align_linear_ramp(self):
+        # value = column index; aligned bilinear average of a linear ramp
+        # equals the ramp at bin centers
+        xv = np.tile(np.arange(8, dtype="float32")[None, None, None, :],
+                     (1, 1, 8, 1))
+        boxes = np.array([[0, 0, 7, 7]], "float32")
+        out = vops.roi_align(t(xv), t(boxes), t(np.array([1], "int32")),
+                             output_size=7, sampling_ratio=1,
+                             aligned=False)
+        got = out.numpy()[0, 0, 3]        # middle row
+        assert got[0] < got[-1]
+        np.testing.assert_allclose(np.diff(got), np.diff(got)[0] *
+                                   np.ones(6), rtol=1e-3)
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), "float32")
+        x[0, 0, 2, 2] = 5.0
+        x[0, 0, 6, 6] = 7.0
+        boxes = np.array([[0, 0, 7, 7]], "float32")
+        out = vops.roi_pool(t(x), t(boxes), t(np.array([1], "int32")), 2)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[5.0, 0.0], [0.0, 7.0]])
+
+    def test_psroi_pool_shapes(self):
+        x = np.random.randn(1, 2 * 2 * 3, 8, 8).astype("float32")
+        boxes = np.array([[0, 0, 7, 7]], "float32")
+        out = vops.psroi_pool(t(x), t(boxes), t(np.array([1], "int32")), 2)
+        assert out.shape == [1, 3, 2, 2]
+
+    def test_roi_layers(self):
+        x = t(np.random.randn(1, 2, 8, 8).astype("float32"))
+        boxes = t(np.array([[0, 0, 4, 4]], "float32"))
+        bn = t(np.array([1], "int32"))
+        assert vops.RoIAlign(2)(x, boxes, bn).shape == [1, 2, 2, 2]
+        assert vops.RoIPool(2)(x, boxes, bn).shape == [1, 2, 2, 2]
+
+
+class TestBoxOps:
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], "float32")
+        targets = np.array([[1, 1, 12, 9], [4, 6, 22, 30]], "float32")
+        enc = vops.box_coder(t(priors), [1.0, 1.0, 1.0, 1.0], t(targets),
+                             code_type="encode_center_size")
+        # decode the diagonal (each target against its own prior)
+        deltas = np.asarray(enc.numpy())[np.arange(2), np.arange(2)]
+        dec = vops.box_coder(t(priors), [1.0, 1.0, 1.0, 1.0],
+                             t(deltas), code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec.numpy()), targets,
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_prior_box(self):
+        feat = t(np.zeros((1, 8, 4, 4), "float32"))
+        img = t(np.zeros((1, 3, 32, 32), "float32"))
+        boxes, var = vops.prior_box(feat, img, min_sizes=[8.0],
+                                    aspect_ratios=[2.0], clip=True)
+        assert boxes.shape[:2] == [4, 4] and boxes.shape[-1] == 4
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        assert var.shape == boxes.shape
+
+    def test_yolo_box_shapes(self):
+        na, nc, h, w = 2, 3, 4, 4
+        x = t(np.random.randn(1, na * (5 + nc), h, w).astype("float32"))
+        img = t(np.array([[64, 64]], "int32"))
+        boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                                      class_num=nc, conf_thresh=0.0,
+                                      downsample_ratio=16)
+        assert boxes.shape == [1, na * h * w, 4]
+        assert scores.shape == [1, na * h * w, nc]
+        assert float(boxes.numpy().max()) <= 64.0
+
+    def test_distribute_fpn(self):
+        rois = np.array([[0, 0, 16, 16],      # small -> low level
+                         [0, 0, 200, 200]], "float32")
+        outs, restore, nums = vops.distribute_fpn_proposals(
+            t(rois), 2, 5, 4, 224)
+        sizes = [len(o.numpy()) for o in outs]
+        assert sum(sizes) == 2 and sizes[0] >= 1
+        r = np.asarray(restore.numpy()).reshape(-1)
+        order = np.concatenate([o.numpy() for o in outs if len(o.numpy())])
+        np.testing.assert_allclose(order[r], rois)
+
+
+class TestGridSample:
+    def test_identity_grid(self):
+        x = np.random.randn(1, 2, 5, 5).astype("float32")
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                             indexing="ij")
+        grid = np.stack([xs, ys], -1)[None].astype("float32")
+        out = F.grid_sample(t(x), t(grid))
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-5, atol=1e-5)
+
+    def test_zeros_padding(self):
+        x = np.ones((1, 1, 4, 4), "float32")
+        grid = np.full((1, 1, 1, 2), -3.0, "float32")   # far outside
+        out = F.grid_sample(t(x), t(grid))
+        np.testing.assert_allclose(out.numpy(), 0.0)
+
+    def test_border_padding(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        grid = np.full((1, 1, 1, 2), 5.0, "float32")
+        out = F.grid_sample(t(x), t(grid), padding_mode="border")
+        np.testing.assert_allclose(out.numpy().ravel(), [15.0])
+
+    def test_nearest_mode(self):
+        x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+        grid = np.array([[[[1.0, 1.0]]]], "float32")
+        out = F.grid_sample(t(x), t(grid), mode="nearest")
+        np.testing.assert_allclose(out.numpy().ravel(), [3.0])
+
+    def test_affine_grid_identity(self):
+        theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32")
+        grid = F.affine_grid(t(theta), [1, 1, 3, 3])
+        assert grid.shape == [1, 3, 3, 2]
+        np.testing.assert_allclose(grid.numpy()[0, 0, 0], [-1, -1],
+                                   atol=1e-6)
+        np.testing.assert_allclose(grid.numpy()[0, 2, 2], [1, 1],
+                                   atol=1e-6)
+
+    def test_grid_sample_grad(self):
+        x = t(np.random.randn(1, 1, 4, 4).astype("float32"), sg=False)
+        grid = t(np.zeros((1, 2, 2, 2), "float32"), sg=False)
+        out = F.grid_sample(x, grid)
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.isfinite(grid.grad.numpy()).all()
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 2, 6, 6)).astype("float32")
+        w = rng.normal(size=(3, 2, 3, 3)).astype("float32")
+        oh = ow = 6
+        offset = np.zeros((1, 2 * 3 * 3, oh, ow), "float32")
+        out = vops.deform_conv2d(t(x), t(offset), t(w), padding=1)
+        # reference: plain conv with same padding
+        import jax
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_deform_layer(self):
+        layer = vops.DeformConv2D(2, 4, 3, padding=1)
+        x = t(np.random.randn(1, 2, 6, 6).astype("float32"))
+        offset = t(np.zeros((1, 2 * 9, 6, 6), "float32"))
+        out = layer(x, offset)
+        assert out.shape == [1, 4, 6, 6]
+
+
+class TestReviewRegressions:
+    def test_psroi_pool_values(self):
+        """Each output bin (i, j) must read channel group i*pw+j."""
+        ph = pw = 2
+        out_c = 3
+        c = out_c * ph * pw
+        # channel value = its group index g (constant map per channel)
+        x = np.zeros((1, c, 8, 8), "float32")
+        for g in range(ph * pw):
+            x[0, g * out_c:(g + 1) * out_c] = g + 1
+        # NOTE paddle layout: groups consecutive? reference uses
+        # channel = (g * out_c + oc); we fill accordingly
+        x = np.zeros((1, c, 8, 8), "float32")
+        for g in range(ph * pw):
+            for oc in range(out_c):
+                x[0, g * out_c + oc] = 10 * g + oc
+        boxes = np.array([[0, 0, 7, 7]], "float32")
+        out = vops.psroi_pool(t(x), t(boxes), t(np.array([1], "int32")), 2)
+        o = np.asarray(out.numpy())  # [1, out_c, 2, 2]
+        for i in range(ph):
+            for j in range(pw):
+                g = i * pw + j
+                for oc in range(out_c):
+                    # reshape(r, ph*pw, out_c, ...) maps group g, chan oc
+                    # to input channel g*out_c+oc with value 10g+oc
+                    np.testing.assert_allclose(o[0, oc, i, j],
+                                               10 * g + oc, rtol=1e-5)
+
+    def test_grid_sample_reflection_not_align_corners(self):
+        # reference semantics: reflect about pixel borders (-0.5, size-0.5)
+        x = np.arange(4, dtype="float32").reshape(1, 1, 1, 4)
+        # gx=-3.0 unnormalized for size=4, align_corners=False:
+        # coord = ((-3+1)*4-1)/2 = -4.5 -> reflect -> ...
+        grid = np.zeros((1, 1, 1, 2), "float32")
+        grid[..., 0] = -3.0
+        grid[..., 1] = 0.0
+        out = F.grid_sample(t(x), t(grid), padding_mode="reflection",
+                            align_corners=False)
+        # unnormalized x = -4.5; reflect about [-0.5, 3.5]: |x-lo|=4 mod 8
+        # = 4 >= span -> 8-4=4 -> +lo = 3.5 -> clip 3 -> value 3
+        np.testing.assert_allclose(out.numpy().ravel(), [3.0], atol=1e-5)
+
+    def test_deform_conv_dilation_used(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(1, 1, 9, 9)).astype("float32")
+        w = rng.normal(size=(1, 1, 3, 3)).astype("float32")
+        offset = np.zeros((1, 18, 5, 5), "float32")
+        out = vops.deform_conv2d(t(x), t(offset), t(w), padding=0,
+                                 dilation=2)
+        import jax
+        import jax.numpy as jnp
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+            rhs_dilation=(2, 2),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        assert out.shape == list(np.asarray(ref).shape)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_yolo_iou_aware_raises(self):
+        x = t(np.zeros((1, 2 * 8, 4, 4), "float32"))
+        with pytest.raises(NotImplementedError, match="iou_aware"):
+            vops.yolo_box(x, t(np.array([[64, 64]], "int32")),
+                          anchors=[10, 13, 16, 30], class_num=3,
+                          iou_aware=True)
+
+    def test_box_coder_decode_axis1(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], "float32")
+        deltas = np.zeros((3, 2, 4), "float32")   # N=3 targets, P=2 priors
+        dec = vops.box_coder(t(priors), [1, 1, 1, 1], t(deltas),
+                             code_type="decode_center_size", axis=1)
+        assert dec.shape == [3, 2, 4]
+        # zero deltas decode back to the priors themselves
+        for nidx in range(3):
+            np.testing.assert_allclose(np.asarray(dec.numpy())[nidx],
+                                       priors, rtol=1e-5)
+
+    def test_prior_box_default_order(self):
+        feat = t(np.zeros((1, 8, 1, 1), "float32"))
+        img = t(np.zeros((1, 3, 32, 32), "float32"))
+        boxes, _ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                  max_sizes=[16.0], aspect_ratios=[2.0])
+        b = np.asarray(boxes.numpy())[0, 0]   # [nb, 4]
+        widths = (b[:, 2] - b[:, 0]) * 32
+        # default order: min(8), ar=2 (w=8*sqrt2), max(sqrt(8*16)=11.3)
+        np.testing.assert_allclose(widths[0], 8.0, rtol=1e-4)
+        np.testing.assert_allclose(widths[1], 8 * np.sqrt(2), rtol=1e-4)
+        np.testing.assert_allclose(widths[2], np.sqrt(8 * 16), rtol=1e-4)
+
+    def test_pass_manager_dce_requires_fetch(self):
+        from paddle_tpu import static
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("xg", [2], "float32")
+            y = pt.exp(x)
+        with pytest.raises(ValueError, match="fetch"):
+            static.PassManager(["dce"]).run(main)
