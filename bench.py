@@ -139,50 +139,78 @@ def main():
         return
 
     on_tpu = dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
-    # Single-chip benchmark config: a 4-layer 8B-shaped slice on TPU
-    # (fits one chip's HBM with remat), tiny on CPU fallback.
+    # The axon tunnel's chipless compile helper needs the accelerator type
+    # spelled out or it can bail with exit code 1 on large programs.
+    if on_tpu and "v5 lite" in (dev.device_kind or "").lower():
+        os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+
+    # Single-chip benchmark ladder: 8B-shaped decoder slices sized to one
+    # chip's HBM (v5e = 16G: f32 adam moments cap the param count at ~1.1B;
+    # "full" remat because "dots" blows the compile-time HBM plan). Each rung
+    # is tried in order; a rung that OOMs or fails to compile steps down so
+    # a memory regression degrades the number instead of zeroing it.
     if on_tpu:
-        cfg = L.llama_3_8b(num_hidden_layers=4)
-        batch, seq, iters = 4, 2048, 10
+        ladder = [
+            (dict(num_hidden_layers=4, vocab_size=32000,
+                  remat_policy="full"), 4, 2048, 20),
+            (dict(num_hidden_layers=3, vocab_size=32000,
+                  remat_policy="full"), 2, 2048, 20),
+            (dict(num_hidden_layers=2, vocab_size=16000,
+                  remat_policy="full"), 2, 1024, 10),
+        ]
     else:
-        cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
-        batch, seq, iters = 4, 128, 5
+        ladder = [(None, 4, 128, 5)]
 
     preflight = _preflight_kernels(on_tpu)
 
-    try:
-        # One jitted program builds params + opt state directly on device.
-        @jax.jit
-        def init():
-            p = L.init_params(cfg, jax.random.PRNGKey(0))
-            return p, L.adamw_init(p)
+    last_err = None
+    for cfg_kw, batch, seq, iters in ladder:
+        if cfg_kw is None:
+            cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+        else:
+            cfg = L.llama_3_8b(**cfg_kw)
+        try:
+            # One jitted program builds params + opt state directly on device.
+            @jax.jit
+            def init():
+                p = L.init_params(cfg, jax.random.PRNGKey(0))
+                return p, L.adamw_init(p)
 
-        params, opt_state = init()
-        jax.block_until_ready(params["embed"])
+            params, opt_state = init()
+            jax.block_until_ready(params["embed"])
 
-        step = L.make_train_step(cfg, lr=1e-4)
-        ids = jnp.asarray(np.random.default_rng(0).integers(
-            0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+            step = L.make_train_step(cfg, lr=1e-4)
+            ids = jnp.asarray(np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
 
-        # warmup/compile — and record which attention kernel got traced in
-        kernels.reset_dispatch_stats()
-        params, opt_state, loss = step(params, opt_state, ids)
-        jax.block_until_ready(loss)
-        stats = kernels.dispatch_stats()
-        flash_missed = on_tpu and stats["flash"] == 0
-        if flash_missed:
-            # Fast path missed: still bench, but flag it in the JSON line
-            # (not just stderr) so the record shows the degraded path.
-            sys.stderr.write(
-                f"WARNING: pallas flash kernel did not engage: {stats}\n")
-
-        t0 = time.perf_counter()
-        for _ in range(iters):
+            # warmup/compile — and record which attention kernel got traced in
+            kernels.reset_dispatch_stats()
             params, opt_state, loss = step(params, opt_state, ids)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
-    except Exception as e:
-        _fail(metric, f"{type(e).__name__}: {e}")
+            float(loss)  # hard sync: block_until_ready is unreliable via axon
+            stats = kernels.dispatch_stats()
+            flash_missed = on_tpu and stats["flash"] == 0
+            if flash_missed:
+                # Fast path missed: still bench, but flag it in the JSON line
+                # (not just stderr) so the record shows the degraded path.
+                sys.stderr.write(
+                    f"WARNING: pallas flash kernel did not engage: {stats}\n")
+
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                params, opt_state, loss = step(params, opt_state, ids)
+            final_loss = float(loss)  # device->host fetch = full pipeline drain
+            dt = time.perf_counter() - t0
+            break
+        except Exception as e:
+            last_err = f"{type(e).__name__}: {e}"
+            sys.stderr.write(
+                f"bench rung {cfg_kw} failed, stepping down: {last_err[:300]}\n")
+            # Release the failed rung's HBM (params + adam moments) and its
+            # executable before trying a smaller rung.
+            params = opt_state = step = init = ids = loss = None
+            jax.clear_caches()
+    else:
+        _fail(metric, f"all bench rungs failed; last: {last_err}")
         return
 
     tokens = batch * seq * iters
@@ -200,8 +228,9 @@ def main():
         "extra": {"mfu": round(mfu, 4), "params": n_params,
                   "platform": dev.platform, "batch": batch, "seq": seq,
                   "layers": cfg.num_hidden_layers,
+                  "vocab": cfg.vocab_size,
                   "flash_dispatch": stats,
-                  "loss": float(loss)},
+                  "loss": final_loss},
     }
     if preflight:
         payload["extra"]["kernel_preflight_failures"] = preflight

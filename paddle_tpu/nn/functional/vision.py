@@ -31,22 +31,20 @@ def _grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros",
 
     def clip_or_reflect(v, size):
         if padding_mode == "border":
-            return jnp.clip(v, 0, size - 1), None
-        if padding_mode == "reflection":
-            # reflect about the pixel CENTERS (align_corners=True:
-            # [0, size-1]) or the pixel BORDERS (False: [-0.5, size-0.5])
-            # — the reference reflect_coordinates semantics
-            lo = 0.0 if align_corners else -0.5
-            hi = (size - 1.0) if align_corners else (size - 0.5)
-            span = hi - lo
-            v = jnp.mod(jnp.abs(v - lo), 2 * span)
-            v = jnp.where(v >= span, 2 * span - v, v) + lo
-            return jnp.clip(v, 0, size - 1), None
-        # zeros: keep raw coords, mask out-of-bounds later
-        return v, (v >= -1) & (v <= size)
+            return jnp.clip(v, 0, size - 1)
+        # reflection: reflect about the pixel CENTERS (align_corners=True:
+        # [0, size-1]) or the pixel BORDERS (False: [-0.5, size-0.5])
+        # — the reference reflect_coordinates semantics
+        lo = 0.0 if align_corners else -0.5
+        hi = (size - 1.0) if align_corners else (size - 0.5)
+        span = hi - lo
+        v = jnp.mod(jnp.abs(v - lo), 2 * span)
+        v = jnp.where(v >= span, 2 * span - v, v) + lo
+        return jnp.clip(v, 0, size - 1)
 
-    gx, _ = (gx, None) if padding_mode == "zeros" else clip_or_reflect(gx, w)
-    gy, _ = (gy, None) if padding_mode == "zeros" else clip_or_reflect(gy, h)
+    if padding_mode != "zeros":   # zeros: raw coords, masked at sample time
+        gx = clip_or_reflect(gx, w)
+        gy = clip_or_reflect(gy, h)
 
     if mode == "nearest":
         ix = jnp.round(gx).astype(jnp.int32)

@@ -188,7 +188,6 @@ def _roi_pool(x, boxes, boxes_num, *, output_size, spatial_scale):
         # bin id of each pixel row/col (or -1 outside the roi)
         bin_y = jnp.floor((ys - y1i) / (rhi / ph))
         bin_x = jnp.floor((xs - x1i) / (rwi / pw))
-        out = jnp.full((c, ph, pw), -jnp.inf)
         ybin = jnp.clip(bin_y, 0, ph - 1).astype(jnp.int32)
         xbin = jnp.clip(bin_x, 0, pw - 1).astype(jnp.int32)
         oky = (ys >= y1i) & (bin_y >= 0) & (bin_y < ph)
@@ -276,14 +275,18 @@ def _box_coder(prior_box, target_box, prior_box_var, *, code_type,
     # decode: deltas [P, 4] or [N, P, 4]; ``axis`` selects which dim of a
     # 3-D target the priors broadcast along (reference box_coder axis)
     d = target_box
-    if prior_box_var is not None:
-        d = d * prior_box_var
     if d.ndim == 3:
-        expand = (slice(None), None) if axis == 0 else (None, slice(None))
+        # axis=0: priors broadcast to [1, M, 4] (align with target dim 1);
+        # axis=1: priors broadcast to [N, 1, 4] (align with target dim 0).
+        expand = (None, slice(None)) if axis == 0 else (slice(None), None)
         pw = pw[expand]
         ph_ = ph_[expand]
         pcx = pcx[expand]
         pcy = pcy[expand]
+        if prior_box_var is not None:
+            d = d * prior_box_var[expand]
+    elif prior_box_var is not None:
+        d = d * prior_box_var
     cx = d[..., 0] * pw + pcx
     cy = d[..., 1] * ph_ + pcy
     bw = jnp.exp(d[..., 2]) * pw
@@ -433,8 +436,16 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     order = np.concatenate(idx_order) if idx_order else np.array([], np.int64)
     restore = np.empty_like(order)
     restore[order] = np.arange(len(order))
-    rois_num_per = [wrap(jnp.asarray(np.asarray([len(i)], np.int32)))
-                    for i in idx_order]
+    if rois_num is not None:
+        # batched input: per-level outputs carry per-image counts [B]
+        counts = np.asarray(unwrap(rois_num)).reshape(-1).astype(np.int64)
+        img_id = np.repeat(np.arange(len(counts)), counts)
+        rois_num_per = [wrap(jnp.asarray(np.bincount(
+            img_id[i], minlength=len(counts)).astype(np.int32)))
+            for i in idx_order]
+    else:
+        rois_num_per = [wrap(jnp.asarray(np.asarray([len(i)], np.int32)))
+                        for i in idx_order]
     return outs, wrap(jnp.asarray(restore.reshape(-1, 1))), rois_num_per
 
 
@@ -457,15 +468,17 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     sh = sw = stride if isinstance(stride, int) else None
     if sh is None:
         sh, sw = stride
-    p = padding if isinstance(padding, int) else padding[0]
+    ph_ = pw_ = padding if isinstance(padding, int) else None
+    if ph_ is None:
+        ph_, pw_ = padding
     dh = dw_ = dilation if isinstance(dilation, int) else None
     if dh is None:
         dh, dw_ = dilation
-    oh = (h + 2 * p - dh * (kh - 1) - 1) // sh + 1
-    ow = (w + 2 * p - dw_ * (kw - 1) - 1) // sw + 1
+    oh = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw_ - dw_ * (kw - 1) - 1) // sw + 1
 
-    base_y = jnp.arange(oh) * sh - p
-    base_x = jnp.arange(ow) * sw - p
+    base_y = jnp.arange(oh) * sh - ph_
+    base_x = jnp.arange(ow) * sw - pw_
     ky = jnp.arange(kh) * dh
     kx = jnp.arange(kw) * dw_
     # absolute sample positions [oh, ow, kh, kw]

@@ -274,15 +274,31 @@ class TestReviewRegressions:
                           anchors=[10, 13, 16, 30], class_num=3,
                           iou_aware=True)
 
-    def test_box_coder_decode_axis1(self):
+    def test_box_coder_decode_axis0(self):
+        # axis=0 (the Paddle default): PriorBox [M,4] broadcasts to
+        # [1, M, 4] against TargetBox [N, M, 4].
         priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], "float32")
-        deltas = np.zeros((3, 2, 4), "float32")   # N=3 targets, P=2 priors
+        deltas = np.zeros((3, 2, 4), "float32")   # N=3 targets, M=2 priors
         dec = vops.box_coder(t(priors), [1, 1, 1, 1], t(deltas),
-                             code_type="decode_center_size", axis=1)
+                             code_type="decode_center_size", axis=0)
         assert dec.shape == [3, 2, 4]
         # zero deltas decode back to the priors themselves
         for nidx in range(3):
             np.testing.assert_allclose(np.asarray(dec.numpy())[nidx],
+                                       priors, rtol=1e-5)
+
+    def test_box_coder_decode_axis1(self):
+        # axis=1: PriorBox [N,4] broadcasts to [N, 1, 4] against
+        # TargetBox [N, M, 4] (priors align with target dim 0).
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25],
+                           [2, 2, 6, 8]], "float32")
+        deltas = np.zeros((3, 4, 4), "float32")   # N=3, M=4
+        dec = vops.box_coder(t(priors), [1, 1, 1, 1], t(deltas),
+                             code_type="decode_center_size", axis=1)
+        assert dec.shape == [3, 4, 4]
+        # zero deltas decode each row back to its own prior
+        for midx in range(4):
+            np.testing.assert_allclose(np.asarray(dec.numpy())[:, midx],
                                        priors, rtol=1e-5)
 
     def test_prior_box_default_order(self):
