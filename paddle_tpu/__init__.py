@@ -59,8 +59,34 @@ from . import hapi  # noqa
 from .hapi import Model, summary  # noqa
 from .hapi import callbacks  # noqa
 from .framework.io import load, save  # noqa
+from .framework.compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
+                               LazyGuard, TPUPlace, batch,
+                               disable_signal_handler, finfo, flops, iinfo,
+                               set_printoptions)
+from .framework.random import (get_rng_state as get_cuda_rng_state,  # noqa
+                               set_rng_state as set_cuda_rng_state)
+from .core.state import grad_enabled as is_grad_enabled  # noqa
+from .nn import ParamAttr  # noqa
+from .distributed.parallel import DataParallel  # noqa
+
+# paddle.bool / paddle.dtype aliases (reference: paddle.dtype vocabulary)
+bool = bool_  # noqa: A001
+import numpy as _np
+dtype = _np.dtype
 
 import jax as _jax
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: static check in utils.py):
+    ints, or a 1-D integer list/tuple with at most one -1."""
+    if isinstance(shape, (list, tuple)):
+        # NB: builtins.sum — paddle.sum (the tensor op) shadows it here
+        import builtins
+        neg = builtins.sum(1 for s in shape
+                           if isinstance(s, int) and s < 0)
+        if neg > 1:
+            raise ValueError(f"shape can carry at most one -1, got {shape}")
 
 
 def is_compiled_with_cuda() -> bool:

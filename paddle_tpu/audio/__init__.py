@@ -4,4 +4,7 @@ from . import features  # noqa
 from . import functional  # noqa
 from .backends import load, save, info  # noqa
 
-__all__ = ["backends", "features", "functional", "load", "save", "info"]
+from . import datasets  # noqa
+
+__all__ = ["backends", "features", "functional", "load", "save", "info",
+           "datasets"]

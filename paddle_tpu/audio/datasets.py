@@ -1,0 +1,121 @@
+"""paddle.audio.datasets parity: ESC50, TESS.
+
+Reference capability: python/paddle/audio/datasets/{esc50,tess}.py —
+download-and-parse audio classification datasets. No network egress here:
+construction requires ``data_file=`` (ESC50: the extracted archive dir
+with meta/esc50.csv + audio/; TESS: the extracted dir of
+<emotion>/<name>.wav). Feature modes mirror the reference ('raw',
+'mfcc', 'spectrogram', 'melspectrogram', 'logmelspectrogram')."""
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+
+def _need_dir(name, path):
+    if path is None or not os.path.isdir(path):
+        raise RuntimeError(
+            f"{name}: automatic download is unavailable in this "
+            "environment; pass data_file= pointing at the extracted "
+            "dataset directory")
+    return path
+
+
+class _AudioClsDataset(Dataset):
+    def __init__(self, feat_type="raw", **feat_kwargs):
+        if feat_type not in ("raw", "mfcc", "spectrogram",
+                             "melspectrogram", "logmelspectrogram"):
+            raise ValueError(f"unknown feat_type {feat_type!r}")
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._files = []     # (path, label)
+
+    def _load_wave(self, path):
+        from .backends import load
+
+        wav, sr = load(path)
+        return np.asarray(wav), sr
+
+    def _extract(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav.astype(np.float32)
+        from ..core.tensor import Tensor
+        from . import features
+
+        x = Tensor(wav.reshape(1, -1).astype(np.float32))
+        if self.feat_type == "mfcc":
+            f = features.MFCC(sr=sr, **self.feat_kwargs)
+        elif self.feat_type == "spectrogram":
+            f = features.Spectrogram(**self.feat_kwargs)
+        elif self.feat_type == "melspectrogram":
+            f = features.MelSpectrogram(sr=sr, **self.feat_kwargs)
+        else:
+            f = features.LogMelSpectrogram(sr=sr, **self.feat_kwargs)
+        return np.asarray(f(x).numpy())[0]
+
+    def __getitem__(self, idx):
+        path, label = self._files[idx]
+        wav, sr = self._load_wave(path)
+        return self._extract(wav, sr), np.int64(label)
+
+    def __len__(self):
+        return len(self._files)
+
+
+class ESC50(_AudioClsDataset):
+    """ESC-50 environmental sounds (reference: audio/datasets/esc50.py).
+    5-fold split: mode='train' keeps folds != split, 'dev' keeps the
+    split fold."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_file=None, archive=None, **feat_kwargs):
+        super().__init__(feat_type, **feat_kwargs)
+        root = _need_dir("ESC50", data_file)
+        meta = os.path.join(root, "meta", "esc50.csv")
+        if not os.path.exists(meta):
+            raise RuntimeError(f"ESC50: missing meta file {meta}")
+        with open(meta, newline="") as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                keep = (fold != split) if mode == "train" else (fold == split)
+                if keep:
+                    self._files.append(
+                        (os.path.join(root, "audio", row["filename"]),
+                         int(row["target"])))
+
+
+class TESS(_AudioClsDataset):
+    """Toronto emotional speech set (reference: audio/datasets/tess.py).
+    Labels from the emotion directory names; n_folds split by index."""
+
+    _EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral",
+                 "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_file=None, archive=None, **feat_kwargs):
+        super().__init__(feat_type, **feat_kwargs)
+        root = _need_dir("TESS", data_file)
+        all_files = []
+        for dirpath, _, names in sorted(os.walk(root)):
+            for name in sorted(names):
+                if not name.lower().endswith(".wav"):
+                    continue
+                low = name.lower()
+                label = None
+                for i, emo in enumerate(self._EMOTIONS):
+                    if emo in low:
+                        label = i
+                        break
+                if label is not None:
+                    all_files.append((os.path.join(dirpath, name), label))
+        for i, item in enumerate(all_files):
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                self._files.append(item)

@@ -53,3 +53,90 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else:
             out.append(g if isinstance(g, Tensor) else Tensor(g))
     return out
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense Jacobian d(ys)/d(xs) via repeated taped vjps (reference:
+    python/paddle/autograd/autograd.py jacobian — lazily evaluated there,
+    materialized here; rows are unit-cotangent backward passes).
+
+    Returns a Tensor of shape ys.shape + xs.shape (or a nested list when
+    ys/xs are sequences)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    multi_y = isinstance(ys, (list, tuple))
+    multi_x = isinstance(xs, (list, tuple))
+    ys_l = list(ys) if multi_y else [ys]
+    xs_l = list(xs) if multi_x else [xs]
+
+    rows_per_y = []
+    for y in ys_l:
+        ysize = int(np_prod(y._data.shape))
+        flat_rows = []
+        for i in range(ysize):
+            cot = jnp.zeros((ysize,), y._data.dtype).at[i].set(1.0)
+            gs = grad([y], xs_l,
+                      grad_outputs=[Tensor(cot.reshape(y._data.shape))],
+                      retain_graph=True, allow_unused=True)
+            flat_rows.append([None if g is None else g._data.reshape(-1)
+                              for g in gs])
+        per_x = []
+        for xi, x in enumerate(xs_l):
+            xsize = int(np_prod(x._data.shape))
+            rows = [r[xi] if r[xi] is not None
+                    else jnp.zeros((xsize,), x._data.dtype)
+                    for r in flat_rows]
+            jac = jnp.stack(rows).reshape(
+                tuple(y._data.shape) + tuple(x._data.shape))
+            per_x.append(Tensor(jac))
+        rows_per_y.append(per_x if multi_x else per_x[0])
+    return rows_per_y if multi_y else rows_per_y[0]
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Dense Hessian of a scalar ``ys`` w.r.t. ``xs``: jacobian of the
+    create_graph'd gradient (reference: autograd.py hessian)."""
+    from ..core.tensor import Tensor
+
+    multi_x = isinstance(xs, (list, tuple))
+    xs_l = list(xs) if multi_x else [xs]
+    g = grad([ys], xs_l, create_graph=True, retain_graph=True,
+             allow_unused=False)
+    if not multi_x:
+        return jacobian(g[0], xs_l[0])
+    return [[jacobian(gi, xj) for xj in xs_l] for gi in g]
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks over PyLayer saved
+    tensors (reference: python/paddle/autograd/saved_tensors_hooks.py).
+    pack_hook(tensor) -> handle runs at save time; unpack_hook(handle) ->
+    tensor at backward time."""
+
+    _stack = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._stack.append((self.pack_hook,
+                                           self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._stack.pop()
+        return False
+
+    @classmethod
+    def current(cls):
+        return cls._stack[-1] if cls._stack else None

@@ -29,10 +29,22 @@ class PyLayerContext:
 
     def save_for_backward(self, *tensors):
         """Stash tensors for the backward pass. Only for Tensors; anything
-        else can simply be stored as a ctx attribute."""
-        self._saved = tuple(tensors)
+        else can simply be stored as a ctx attribute. Honors any active
+        ``autograd.saved_tensors_hooks`` (pack at save time)."""
+        from . import saved_tensors_hooks
+
+        hooks = saved_tensors_hooks.current()
+        if hooks is not None:
+            self._saved = tuple(hooks[0](t) for t in tensors)
+            self._unpack_hook = hooks[1]   # capture for backward time
+        else:
+            self._saved = tuple(tensors)
+            self._unpack_hook = None
 
     def saved_tensor(self):
+        unpack = getattr(self, "_unpack_hook", None)
+        if unpack is not None:
+            return [unpack(h) for h in self._saved]
         return list(self._saved)
 
     def set_materialize_grads(self, value: bool):

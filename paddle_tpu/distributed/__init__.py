@@ -38,3 +38,8 @@ from .spawn import spawn  # noqa
 from .parallel import DataParallel  # noqa
 from . import checkpoint  # noqa
 from .checkpoint import load_state_dict, save_state_dict  # noqa
+from . import io  # noqa
+from .compat import (CountFilterEntry, DistAttr, DistModel,  # noqa
+                     InMemoryDataset, ParallelMode, ProbabilityEntry,
+                     QueueDataset, ShowClickEntry, Strategy, gloo_barrier,
+                     gloo_init_parallel_env, gloo_release, split, to_static)

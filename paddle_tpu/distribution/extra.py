@@ -1,0 +1,403 @@
+"""Distribution long tail: Binomial, Cauchy, ContinuousBernoulli,
+ExponentialFamily, Independent, MultivariateNormal,
+TransformedDistribution.
+
+Reference capability: python/paddle/distribution/{binomial,cauchy,
+continuous_bernoulli,exponential_family,independent,multivariate_normal,
+transformed_distribution}.py. All math is jnp over jax.random draws from
+the shared framework key chain.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..framework import random as frandom
+from . import Distribution, _raw, _wrap
+
+__all__ = ["Binomial", "Cauchy", "ContinuousBernoulli",
+           "ExponentialFamily", "Independent", "MultivariateNormal",
+           "TransformedDistribution"]
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    exponential_family.py). Subclasses expose natural parameters and the
+    log-normalizer; the Bregman-divergence entropy identity
+    H = F(eta) - <eta, dF/deta> comes for free via jax.grad."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nparams = [jnp.asarray(p, jnp.float32)
+                   for p in self._natural_parameters]
+        lg = self._log_normalizer(*nparams)
+        grads = jax.grad(lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+                         argnums=tuple(range(len(nparams))))(*nparams)
+        ent = lg - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return _wrap(ent)
+
+
+class Binomial(Distribution):
+    """reference: binomial.py — counts in [0, total_count]."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _raw(total_count).astype(jnp.float32)
+        self.probs = _raw(probs).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        out = jax.random.binomial(
+            frandom.next_key(),
+            jnp.broadcast_to(self.total_count, self._shape(shape)),
+            jnp.broadcast_to(self.probs, self._shape(shape)))
+        return _wrap(out)
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        n, p = self.total_count, self.probs
+        logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                - jsp.gammaln(n - v + 1))
+        eps = 1e-12
+        return _wrap(logc + v * jnp.log(p + eps)
+                     + (n - v) * jnp.log1p(-p + eps))
+
+    def entropy(self):
+        """Exact by enumeration over the (static) max count — TPU-friendly
+        closed loop, no sampling."""
+        nmax = int(jnp.max(self.total_count))
+        ks = jnp.arange(nmax + 1, dtype=jnp.float32)
+        shape = (nmax + 1,) + (1,) * max(len(self._batch_shape), 0)
+        kcol = ks.reshape(shape)
+        n, p = self.total_count, self.probs
+        eps = 1e-12
+        logc = (jsp.gammaln(n + 1) - jsp.gammaln(kcol + 1)
+                - jsp.gammaln(jnp.maximum(n - kcol, 0) + 1))
+        lp = logc + kcol * jnp.log(p + eps) + \
+            (n - kcol) * jnp.log1p(-p + eps)
+        valid = kcol <= n
+        pr = jnp.where(valid, jnp.exp(lp), 0.0)
+        return _wrap(-jnp.sum(pr * jnp.where(valid, lp, 0.0), axis=0))
+
+
+class Cauchy(Distribution):
+    """reference: cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def sample(self, shape=(), name=None):
+        return self.rsample(shape)
+
+    def rsample(self, shape=(), name=None):
+        u = jax.random.uniform(frandom.next_key(), self._shape(shape),
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        z = (v - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale)
+                     - jnp.log1p(z ** 2))
+
+    def cdf(self, value):
+        v = _raw(value).astype(jnp.float32)
+        return _wrap(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def entropy(self):
+        e = jnp.log(4 * math.pi * self.scale)
+        return _wrap(jnp.broadcast_to(e, self._batch_shape))
+
+    def kl_divergence(self, other):
+        """Closed form (Chyzak & Nielsen 2019): log[((s1+s2)^2 +
+        (l1-l2)^2) / (4 s1 s2)]."""
+        if not isinstance(other, Cauchy):
+            from . import kl_divergence as _kl
+
+            return _kl(self, other)
+        num = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+        return _wrap(jnp.log(num / (4 * self.scale * other.scale)))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: continuous_bernoulli.py — support (0, 1), parameter
+    ``probs`` (lambda), normalizing constant C(lambda)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _raw(probs).astype(jnp.float32)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _log_const(self):
+        """log C(lambda); Taylor expansion near 0.5 (reference's numerical
+        guard)."""
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.6)
+        logc = jnp.log(
+            jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            / jnp.abs(1.0 - 2.0 * safe))
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(self._outside(), logc, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.6)
+        m = safe / (2.0 * safe - 1.0) + \
+            1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return _wrap(jnp.where(self._outside(), m, taylor))
+
+    @property
+    def variance(self):
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.6)
+        t = 1.0 - 2.0 * safe
+        v = safe * (safe - 1.0) / (t * t) + \
+            1.0 / (2.0 * jnp.arctanh(t)) ** 2
+        x = (p - 0.5) ** 2
+        taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x) * x
+        return _wrap(jnp.where(self._outside(), v, taylor))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(frandom.next_key(), self._shape(shape),
+                               minval=1e-6, maxval=1.0 - 1e-6)
+        p = self.probs
+        safe = jnp.where(self._outside(), p, 0.6)
+        icdf = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return _wrap(jnp.where(self._outside(), icdf, u))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return _wrap(v * jnp.log(p) + (1.0 - v) * jnp.log1p(-p)
+                     + self._log_const())
+
+    def entropy(self):
+        # H = -E[log p(X)] = -(mean*log p + (1-mean)*log(1-p) + log C)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        m = _raw(self.mean)
+        return _wrap(-(m * jnp.log(p) + (1.0 - m) * jnp.log1p(-p)
+                       + self._log_const()))
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims of a base distribution as event dims
+    (reference: independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bshape = base.batch_shape
+        if self._rank > len(bshape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self._rank} exceeds base batch "
+                f"rank {len(bshape)}")
+        split = len(bshape) - self._rank
+        super().__init__(bshape[:split],
+                         bshape[split:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _raw(self.base.log_prob(value))
+        axes = tuple(range(lp.ndim - self._rank, lp.ndim))
+        return _wrap(jnp.sum(lp, axis=axes) if axes else lp)
+
+    def entropy(self):
+        e = _raw(self.base.entropy())
+        axes = tuple(range(e.ndim - self._rank, e.ndim))
+        return _wrap(jnp.sum(e, axis=axes) if axes else e)
+
+
+class MultivariateNormal(Distribution):
+    """reference: multivariate_normal.py — parameterized by loc and any
+    one of covariance_matrix / precision_matrix / scale_tril. Internally
+    everything rides the Cholesky factor (TPU: triangular solves +
+    matmuls)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "Exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be specified")
+        if scale_tril is not None:
+            self._scale_tril = _raw(scale_tril).astype(jnp.float32)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(
+                _raw(covariance_matrix).astype(jnp.float32))
+        else:
+            prec = _raw(precision_matrix).astype(jnp.float32)
+            self._scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self.loc.shape[-1]
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._scale_tril.shape[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def scale_tril(self):
+        return _wrap(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        lt = self._scale_tril
+        return _wrap(lt @ jnp.swapaxes(lt, -1, -2))
+
+    @property
+    def precision_matrix(self):
+        return _wrap(jnp.linalg.inv(_raw(self.covariance_matrix)))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            self.loc, self._batch_shape + self._event_shape))
+
+    @property
+    def variance(self):
+        var = jnp.sum(self._scale_tril ** 2, axis=-1)
+        return _wrap(jnp.broadcast_to(
+            var, self._batch_shape + self._event_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(frandom.next_key(), out_shape)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i",
+                                           self._scale_tril, eps))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        d = self._event_shape[0]
+        diff = v - self.loc
+        lt = jnp.broadcast_to(
+            self._scale_tril, diff.shape[:-1] + self._scale_tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(
+            lt, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, axis=-1)
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._scale_tril, axis1=-2,
+                                              axis2=-1)), axis=-1)
+        return _wrap(-0.5 * (maha + d * math.log(2 * math.pi)) - logdet)
+
+    def entropy(self):
+        d = self._event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._scale_tril, axis1=-2,
+                                              axis2=-1)), axis=-1)
+        e = 0.5 * d * (1.0 + math.log(2 * math.pi)) + logdet
+        return _wrap(jnp.broadcast_to(e, self._batch_shape))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, MultivariateNormal):
+            from . import kl_divergence as _kl
+
+            return _kl(self, other)
+        d = self._event_shape[0]
+        l0, l1 = self._scale_tril, other._scale_tril
+        m = jax.scipy.linalg.solve_triangular(l1, l0, lower=True)
+        tr = jnp.sum(m ** 2, axis=(-2, -1))
+        diff = other.loc - self.loc
+        l1b = jnp.broadcast_to(l1, diff.shape[:-1] + l1.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(
+            l1b, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, axis=-1)
+        ld0 = jnp.sum(jnp.log(jnp.diagonal(l0, axis1=-2, axis2=-1)), axis=-1)
+        ld1 = jnp.sum(jnp.log(jnp.diagonal(l1, axis1=-2, axis2=-1)), axis=-1)
+        return _wrap(0.5 * (tr + maha - d) + ld1 - ld0)
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of a base distribution through a chain of transforms
+    (reference: transformed_distribution.py). Transforms come from
+    paddle.distribution.transform (forward / inverse /
+    forward_log_det_jacobian)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - _raw(t.forward_log_det_jacobian(x))
+            y = x
+        return _wrap(lp + _raw(self.base.log_prob(y)))

@@ -1,0 +1,291 @@
+"""Probability transforms (bijectors).
+
+Reference capability: python/paddle/distribution/transform.py — the 13
+transform classes with forward / inverse / *_log_det_jacobian and
+forward_shape / inverse_shape. All math is elementwise jnp; log-dets are
+closed-form (no autodiff needed at call time).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import _raw, _wrap
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    _type = "bijection"
+
+    def forward(self, x):
+        return _wrap(self._forward(_raw(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_raw(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_raw(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _raw(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (surjection; inverse picks the positive branch)."""
+
+    _type = "surjection"
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _raw(power).astype(jnp.float32)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not a bijection; inverse = log
+    up to an additive constant — the reference makes the same choice)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not injective; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^(K-1) -> K-simplex via stick breaking."""
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), jnp.cumprod(1 - z, axis=-1)],
+            axis=-1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1.0 - jnp.concatenate(
+            [jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], axis=-1)
+        z = y[..., :-1] / jnp.maximum(rest, 1e-30)
+        k = y.shape[-1] - 1
+        offset = y.shape[-1] - 1 - jnp.arange(k, dtype=y.dtype)
+        return jnp.log(z / jnp.maximum(1 - z, 1e-30)) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # |det J| = prod_i sigma'(u_i) * prod_{j<i}(1 - z_j)
+        #         = prod_i (1 - z_i) * y_i  (triangular Jacobian)
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        u = x - jnp.log(offset)
+        y = self._forward(x)
+        detail = jnp.log(y[..., :-1] + 1e-30) - jax.nn.softplus(u)
+        return jnp.sum(detail, axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + _raw(t.forward_log_det_jacobian(x))
+            x = t.forward(x)
+        return _wrap(total)
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Sums the base transform's log-det over trailing event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        lad = _raw(self.base.forward_log_det_jacobian(x))
+        axes = tuple(range(lad.ndim - self._rank, lad.ndim))
+        return _wrap(jnp.sum(lad, axis=axes) if axes else lad)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms to slices along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, method, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [_raw(getattr(t, method)(_wrap(p.squeeze(self.axis))))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("forward", x)
+
+    def _inverse(self, y):
+        return self._map("inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)

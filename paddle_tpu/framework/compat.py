@@ -1,0 +1,201 @@
+"""Top-level framework compatibility surface: places, dtype info,
+print options, reader batching, FLOPs estimation, lazy init.
+
+Reference capability: python/paddle/base/core places (phi::Place bindings),
+python/paddle/framework/framework.py set_printoptions, python/paddle/batch.py,
+python/paddle/hapi/dynamic_flops.py, python/paddle/nn/initializer/lazy_init.py.
+TPU-native: places map onto jax devices (CPU host / TPU accelerator); FLOPs
+estimation walks a traced jaxpr and counts dot/conv FLOPs analytically
+instead of per-layer hooks.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+
+# -- places (reference: phi::CPUPlace / GPUPlace pybind) --------------------
+
+class Place:
+    """Device handle. Equality is by (kind, id) like the reference."""
+    _kind = "undefined"
+
+    def __init__(self, id: int = 0):
+        self._id = int(id)
+
+    def get_device_id(self) -> int:
+        return self._id
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self._kind == other._kind
+                and self._id == other._id)
+
+    def __hash__(self):
+        return hash((self._kind, self._id))
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})"
+
+    def jax_device(self):
+        kind = "cpu" if self._kind == "cpu" else None
+        devs = jax.devices(kind) if kind else jax.devices()
+        return devs[min(self._id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace(Place):
+    """Accelerator place. On this framework the accelerator is the TPU;
+    the CUDA name is kept for API-compatible checkpoint/config code."""
+    _kind = "accelerator"
+
+
+class CUDAPinnedPlace(Place):
+    _kind = "cpu_pinned"
+
+    def __repr__(self):
+        return "Place(cpu_pinned)"
+
+
+class TPUPlace(Place):
+    _kind = "accelerator"
+
+
+# -- dtype info -------------------------------------------------------------
+
+def finfo(dtype):
+    from ..core.dtype import convert_dtype
+    return np.finfo(np.dtype(convert_dtype(dtype)))
+
+
+def iinfo(dtype):
+    from ..core.dtype import convert_dtype
+    return np.iinfo(np.dtype(convert_dtype(dtype)))
+
+
+# -- printing ---------------------------------------------------------------
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (reference: framework.py set_printoptions).
+    Tensor reprs render through numpy, so numpy's printoptions are the
+    single source of truth."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# -- reader batching (reference: python/paddle/batch.py) --------------------
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+# -- FLOPs estimation (reference: hapi/dynamic_flops.py) --------------------
+
+_FLOP_OPS = {"dot_general", "conv_general_dilated"}
+
+
+def _jaxpr_flops(jaxpr) -> int:
+    total = 0
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            dnums = eqn.params["dimension_numbers"]
+            (lc, _), (lb, _) = dnums
+            lhs = eqn.invars[0].aval.shape
+            out = eqn.outvars[0].aval.shape
+            k = int(np.prod([lhs[i] for i in lc])) if lc else 1
+            total += 2 * int(np.prod(out, dtype=np.int64)) * k
+        elif prim == "conv_general_dilated":
+            rhs = eqn.invars[1].aval.shape
+            out = eqn.outvars[0].aval.shape
+            dn = eqn.params["dimension_numbers"]
+            cout_idx = dn.out_spec[1]
+            spatial = [s for i, s in enumerate(out)
+                       if i not in (dn.out_spec[0], cout_idx)]
+            cin_k = int(np.prod([rhs[i] for i in range(len(rhs))
+                                 if i != dn.rhs_spec[0]], dtype=np.int64))
+            total += 2 * int(np.prod(spatial, dtype=np.int64)) \
+                * out[dn.out_spec[0]] * out[cout_idx] * cin_k // rhs[dn.rhs_spec[0]]
+        for sub in eqn.params.values():
+            if hasattr(sub, "jaxpr"):
+                total += _jaxpr_flops(sub.jaxpr)
+    return total
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """Analytic FLOPs of one forward pass (reference signature:
+    hapi/dynamic_flops.py flops). Counts matmul/conv multiply-adds from
+    the traced jaxpr — no per-layer hooks needed."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    def run(x):
+        out = net(Tensor(x))
+        return out._data if isinstance(out, Tensor) else out
+
+    x = jnp.zeros(tuple(input_size), jnp.float32)
+    closed = jax.make_jaxpr(run)(x)
+    total = _jaxpr_flops(closed.jaxpr)
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total
+
+
+# -- lazy init (reference: nn/initializer/lazy_init.py LazyGuard) -----------
+
+class LazyGuard:
+    """Context manager deferring parameter materialisation. Under XLA
+    param init is already lazy until jit execution, so the guard only
+    needs to mark the scope (kept for API parity)."""
+    _active = False
+
+    def __enter__(self):
+        type(self)._active = True
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._active = False
+        return False
+
+
+def disable_signal_handler():
+    """Reference parity (pybind disable_signal_handler): the JAX runtime
+    installs no catching handlers to remove — no-op."""
+
+
+@contextlib.contextmanager
+def _noop_ctx():
+    yield

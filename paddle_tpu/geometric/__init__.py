@@ -17,7 +17,8 @@ from ..ops._op import op_fn, unwrap, wrap
 __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_max", "segment_min",
-    "reindex_graph", "sample_neighbors",
+    "reindex_graph", "sample_neighbors", "reindex_heter_graph",
+    "weighted_sample_neighbors",
 ]
 
 
@@ -168,6 +169,73 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
         sel = np.arange(lo, hi)
         if 0 <= sample_size < len(sel):
             sel = rng.choice(sel, size=sample_size, replace=False)
+        out_n.append(r[sel])
+        out_e.append(eid_arr[sel])
+        out_c.append(len(sel))
+    out_neighbors = np.concatenate(out_n) if out_n else np.array([], r.dtype)
+    out_count = np.array(out_c, dtype=np.int64)
+    res = (wrap(jnp.asarray(out_neighbors)), wrap(jnp.asarray(out_count)))
+    if return_eids:
+        out_eids = np.concatenate(out_e) if out_e \
+            else np.array([], np.int64)
+        return res + (wrap(jnp.asarray(out_eids)),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference: geometric/reindex.py
+    reindex_heter_graph): one shared node mapping across per-edge-type
+    neighbor lists."""
+    import numpy as np
+    xa = np.asarray(unwrap(x))
+    nbs = [np.asarray(unwrap(n)) for n in neighbors]
+    cnts = [np.asarray(unwrap(c)) for c in count]
+    uniq = {}
+    for v in xa.tolist():
+        uniq.setdefault(v, len(uniq))
+    for nb in nbs:
+        for v in nb.tolist():
+            uniq.setdefault(v, len(uniq))
+    nodes = np.array(list(uniq.keys()), dtype=xa.dtype)
+    reindex_src = np.concatenate(
+        [np.array([uniq[v] for v in nb.tolist()], np.int64) for nb in nbs]
+    ) if nbs else np.array([], np.int64)
+    reindex_dst = np.concatenate(
+        [np.repeat(np.arange(len(xa), dtype=np.int64), c) for c in cnts]
+    ) if cnts else np.array([], np.int64)
+    return (wrap(jnp.asarray(reindex_src)), wrap(jnp.asarray(reindex_dst)),
+            wrap(jnp.asarray(nodes)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased neighbor sampling on CSC (reference:
+    geometric/sampling/neighbors.py weighted_sample_neighbors). Host-side
+    sampling without replacement, probability proportional to weight."""
+    import numpy as np
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    w = np.asarray(unwrap(edge_weight)).astype(np.float64)
+    seeds = np.asarray(unwrap(input_nodes))
+    eid_arr = np.arange(len(r), dtype=np.int64) if eids is None \
+        else np.asarray(unwrap(eids))
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for s in seeds.tolist():
+        lo, hi = int(cp[s]), int(cp[s + 1])
+        sel = np.arange(lo, hi)
+        if 0 <= sample_size < len(sel):
+            p = w[lo:hi]
+            if p.sum() > 0:
+                p = p / p.sum()
+                # without-replacement draws can't exceed the number of
+                # positively-weighted neighbors
+                k = min(sample_size, int(np.count_nonzero(p)))
+                sel = rng.choice(sel, size=k, replace=False, p=p)
+            else:
+                sel = rng.choice(sel, size=sample_size, replace=False)
         out_n.append(r[sel])
         out_e.append(eid_arr[sel])
         out_c.append(len(sel))

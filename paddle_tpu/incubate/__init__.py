@@ -1,3 +1,10 @@
 """paddle.incubate parity surface (fused ops, MoE, experimental APIs)."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                        graph_sample_neighbors, graph_send_recv,
+                        identity_loss, segment_max, segment_mean,
+                        segment_min, segment_sum, softmax_mask_fuse,
+                        softmax_mask_fuse_upper_triangle)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,141 @@
+"""Incubate graph/fused-softmax surface.
+
+Reference capability: python/paddle/incubate/operators/graph_khop_sampler.py,
+graph_reindex.py, graph_sample_neighbors.py, graph_send_recv.py,
+softmax_mask_fuse.py, softmax_mask_fuse_upper_triangle.py, identity_loss.
+
+TPU-native: the fused-softmax pair is expressed as mask+softmax and left
+to XLA fusion (the reference's CUDA kernel exists to fuse exactly this);
+graph sampling delegates to the geometric package's host-side samplers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
+    "graph_send_recv", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "identity_loss",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]
+
+from ..geometric import (segment_max, segment_mean,  # noqa: E402,F401
+                         segment_min, segment_sum)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name of geometric.send_u_recv (reference:
+    incubate/operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference:
+    incubate/operators/graph_khop_sampler.py): chain sample_neighbors hop
+    by hop, then reindex the union."""
+    import numpy as np
+
+    from ..geometric import sample_neighbors
+
+    seeds = input_nodes
+    hop_seeds, all_neighbors, all_counts, all_eids = [], [], [], []
+    for size in sample_sizes:
+        res = sample_neighbors(row, colptr, seeds, sample_size=size,
+                               eids=sorted_eids,
+                               return_eids=return_eids)
+        nb, cnt = res[0], res[1]
+        hop_seeds.append(np.asarray(unwrap(seeds)))
+        all_neighbors.append(np.asarray(unwrap(nb)))
+        all_counts.append(np.asarray(unwrap(cnt)))
+        if return_eids:
+            all_eids.append(np.asarray(unwrap(res[2])))
+        seeds = nb
+    nb_cat = np.concatenate(all_neighbors)
+    cnt_cat = np.concatenate(all_counts)
+    # unified id space: query nodes first (reference reindex contract),
+    # then newly discovered neighbors in first-seen order
+    uniq = {}
+    for v in np.asarray(unwrap(input_nodes)).tolist():
+        uniq.setdefault(v, len(uniq))
+    for hs in hop_seeds[1:]:
+        for v in hs.tolist():
+            uniq.setdefault(v, len(uniq))
+    for v in nb_cat.tolist():
+        uniq.setdefault(v, len(uniq))
+    nodes = np.fromiter(uniq.keys(), np.int64, len(uniq))
+    src = np.array([uniq[v] for v in nb_cat.tolist()], np.int64)
+    dst_global = np.concatenate(
+        [np.repeat(hs, c) for hs, c in zip(hop_seeds, all_counts)]) \
+        if hop_seeds else np.array([], np.int64)
+    dst = np.array([uniq[v] for v in dst_global.tolist()], np.int64)
+    out = (wrap(jnp.asarray(src)), wrap(jnp.asarray(dst)),
+           wrap(jnp.asarray(nodes)),
+           wrap(jnp.asarray(cnt_cat.astype(np.int64))))
+    if return_eids:
+        out = out + (wrap(jnp.asarray(np.concatenate(all_eids))),)
+    return out
+
+
+@op_fn(nondiff_args=(1,))
+def _softmax_mask_fuse(x, mask):
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) — left to XLA fusion (the reference CUDA kernel
+    fuses exactly this; reference softmax_mask_fuse.py)."""
+    return _softmax_mask_fuse(x, mask)
+
+
+@op_fn
+def _softmax_mask_fuse_ut(x):
+    s = x.shape[-1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    return jax.nn.softmax(jnp.where(causal, x, -1e4), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference
+    softmax_mask_fuse_upper_triangle.py)."""
+    return _softmax_mask_fuse_ut(x)
+
+
+@op_fn
+def _identity_loss(x, *, reduction):
+    if reduction == 0 or reduction == "none":
+        return x
+    if reduction == 1 or reduction == "sum":
+        return jnp.sum(x)
+    return jnp.mean(x)
+
+
+def identity_loss(x, reduction="none"):
+    """Marks a loss for IPU pipelines in the reference (identity op with
+    optional reduce); here simply that reduce."""
+    return _identity_loss(x, reduction=reduction)

@@ -1,0 +1,96 @@
+"""Incubate optimizer wrappers: LookAhead, ModelAverage.
+
+Reference capability: python/paddle/incubate/optimizer/lookahead.py,
+modelaverage.py. Both wrap an inner optimizer and maintain slow/averaged
+copies of the parameters host-side between jitted inner steps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: every k inner steps, slow weights move toward the
+    fast weights by alpha and the fast weights reset to the slow copy."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for p in (self.inner_optimizer._parameter_list or [])
+                if not p.stop_gradient]
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        params = self._params()
+        if self._slow is None:
+            self._slow = [p._data for p in params]
+        if self._step_count % self.k == 0:
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference
+    modelaverage.py): apply()/restore() swap averaged weights in and out."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._parameters = list(parameters or [])
+        self._sum = [jnp.zeros_like(p._data) for p in self._parameters]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        for i, p in enumerate(self._parameters):
+            self._sum[i] = self._sum[i] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = [p._data for p in self._parameters]
+        for i, p in enumerate(self._parameters):
+            p._data = (self._sum[i] / self._count).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._parameters, self._backup):
+            p._data = b
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

@@ -1,5 +1,6 @@
 """paddle.io parity surface (reference: python/paddle/io/__init__.py)."""
-from .dataloader import DataLoader, default_collate_fn  # noqa
+from .dataloader import (DataLoader, WorkerInfo, default_collate_fn,  # noqa
+                         get_worker_info)
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa
                       IterableDataset, Subset, TensorDataset, random_split)
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa

@@ -142,12 +142,35 @@ class _WorkerError:
         self.msg = f"{type(exc).__name__}: {exc}\n" + traceback.format_exc()
 
 
+class WorkerInfo:
+    """Worker-side metadata (reference: io/dataloader/worker.py
+    WorkerInfo): id, num_workers, seed, dataset."""
+
+    def __init__(self, id, num_workers, seed, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker, returns that worker's WorkerInfo;
+    None in the main process (reference: io/dataloader/worker.py
+    get_worker_info)."""
+    return _worker_info
+
+
 def _process_worker_loop(dataset, index_q, result_q, worker_init_fn, wid,
-                         ship_raw):
+                         ship_raw, num_workers=0, seed=0):
     """One subprocess worker (reference: io/dataloader/worker.py
     _worker_loop): pull (seq, indices), push (seq, numpy batch). With
     ``ship_raw`` (user collate_fn), the raw sample list is shipped and
     the parent applies the user's collate."""
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, seed + wid, dataset)
     if worker_init_fn is not None:
         worker_init_fn(wid)
     while True:
@@ -190,7 +213,7 @@ class _ProcessPrefetcher:
         workers = [ctx.Process(
             target=_process_worker_loop,
             args=(self._dataset, index_q, result_q, self._init_fn, w,
-                  ship_raw),
+                  ship_raw, self._n),
             daemon=True) for w in range(self._n)]
         for w in workers:
             w.start()

@@ -1,3 +1,31 @@
 """paddle.jit parity surface (reference: python/paddle/jit/__init__.py)."""
 from .api import (InputSpec, StaticFunction, TranslatedLayer,  # noqa
                   enable_to_static, load, not_to_static, save, to_static)
+
+
+# -- verbosity/logging controls (reference: jit/dy2static/logging_utils.py) -
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Set how much transformed code is logged (parity surface; trace-based
+    capture has one level of 'transformed code' — the jaxpr)."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level
+
+
+_ignored_modules = set()
+
+
+def ignore_module(modules):
+    """Mark modules whose functions are never treated as user code during
+    capture (reference: jit/api.py ignore_module)."""
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    _ignored_modules.update(modules)

@@ -191,3 +191,20 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: python/paddle/metric/metrics.py
+    accuracy): input [N, C] scores, label [N] or [N, 1] -> scalar."""
+    import jax.numpy as jnp
+
+    from .ops._op import unwrap, wrap
+
+    pred = unwrap(input)
+    lab = unwrap(label).reshape(-1)
+    topk = jnp.argsort(-pred, axis=-1)[:, :k]
+    hit = jnp.any(topk == lab[:, None], axis=1)
+    return wrap(jnp.mean(hit.astype(jnp.float32)))
+
+
+__all__.append("accuracy")

@@ -1,0 +1,251 @@
+"""Seq2seq decoding: Decoder base, BeamSearchDecoder, dynamic_decode.
+
+Reference capability: python/paddle/nn/decode.py (Decoder:42,
+BeamSearchDecoder:153, dynamic_decode:674 imperative path).
+
+TPU-native design: the decode loop is an eager host loop over jitted cell
+steps (the eager imperative path of the reference); every per-step tensor
+op is static-shaped [batch*beam, ...] so each step hits the same compiled
+program. A fully-fused lax.while_loop variant can wrap a Decoder whose
+step is pure, but the API surface here mirrors the reference's imperative
+semantics (early exit when all beams finish).
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._op import unwrap, wrap
+from .functional.extras import gather_tree
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _map_structure(fn, *trees):
+    """Structure map treating Tensors (and lists used as accumulators) as
+    leaves — unlike jax.tree.map, which would descend into the registered
+    Tensor pytree."""
+    t0 = trees[0]
+    if isinstance(t0, tuple) and hasattr(t0, "_fields"):    # namedtuple
+        return type(t0)(*(_map_structure(fn, *vals)
+                          for vals in zip(*trees)))
+    if isinstance(t0, (tuple, list)) and not isinstance(t0, _Acc):
+        return type(t0)(_map_structure(fn, *vals) for vals in zip(*trees))
+    if isinstance(t0, dict):
+        return {k: _map_structure(fn, *(t[k] for t in trees)) for k in t0}
+    return fn(*trees)
+
+
+class _Acc(list):
+    """Per-leaf step accumulator (a list subclass the structure mapper
+    treats as a leaf — reference decode.py ArrayWrapper)."""
+
+
+def _flatten_structure(tree):
+    if isinstance(tree, (tuple, list)):
+        out = []
+        for v in tree:
+            out.extend(_flatten_structure(v))
+        return out
+    if isinstance(tree, dict):
+        out = []
+        for k in tree:
+            out.extend(_flatten_structure(tree[k]))
+        return out
+    return [tree]
+
+
+class Decoder:
+    """Base decoder interface (reference decode.py:42): initialize / step /
+    finalize + tracks_own_finished."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN-style cell (reference decode.py:153).
+
+    cell: callable (inputs [B*W, I], states) -> (cell_out [B*W, H], states)
+    embedding_fn: token ids -> embeddings; output_fn: projects cell output
+    to vocab logits.
+    """
+
+    class OutputWrapper(collections.namedtuple(
+            "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))):
+        pass
+
+    class StateWrapper(collections.namedtuple(
+            "StateWrapper", ("cell_states", "log_probs", "finished",
+                             "lengths"))):
+        pass
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- shape utilities (reference decode.py:241-327) ----------------------
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        a = unwrap(x)
+        a = jnp.repeat(a[:, None], beam_size, axis=1)
+        return wrap(a.reshape((-1,) + a.shape[2:]))
+
+    def _split_batch_beams(self, x):
+        a = unwrap(x)
+        return wrap(a.reshape((-1, self.beam_size) + a.shape[1:]))
+
+    def _merge_batch_beams(self, x):
+        a = unwrap(x)
+        return wrap(a.reshape((-1,) + a.shape[2:]))
+
+    def _expand_to_beam_size(self, x):
+        a = unwrap(x)
+        return wrap(jnp.repeat(a[:, None], self.beam_size, axis=1))
+
+    def _mask_probs(self, probs, finished):
+        """Finished beams emit only end_token with prob 1 (reference
+        decode.py:329)."""
+        noend = jnp.full((probs.shape[-1],), -1e18, probs.dtype)
+        noend = noend.at[self.end_token].set(0.0)
+        fin = finished.astype(bool)[..., None]
+        return jnp.where(fin, noend[None, None, :], probs)
+
+    def _gather(self, x, indices):
+        b = indices.shape[0]
+        return x[jnp.arange(b)[:, None], indices]
+
+    # -- decoder interface --------------------------------------------------
+
+    def initialize(self, initial_cell_states):
+        cell_states = _map_structure(self._expand_to_beam_size,
+                                     initial_cell_states)
+        batch = unwrap(_flatten_structure(cell_states)[0]).shape[0]
+        # cell states run merged [batch*beam, ...] between steps
+        cell_states = _map_structure(self._merge_batch_beams, cell_states)
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e18] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jax.dtypes.canonicalize_dtype(jnp.int64))
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jax.dtypes.canonicalize_dtype(jnp.int64))
+        inputs = wrap(init_ids)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        states = self.StateWrapper(cell_states, wrap(log_probs),
+                                   wrap(finished), wrap(lengths))
+        return inputs, states, wrap(finished)
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        import jax
+
+        logits = unwrap(logits)                      # [B, W, V]
+        step_log_probs = jax.nn.log_softmax(logits, axis=-1)
+        step_log_probs = self._mask_probs(step_log_probs,
+                                          unwrap(beam_state.finished))
+        log_probs = unwrap(beam_state.log_probs)[..., None] + step_log_probs
+        vocab = log_probs.shape[-1]
+        batch = log_probs.shape[0]
+        flat = log_probs.reshape(batch, -1)
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jax.dtypes.canonicalize_dtype(jnp.int64))     # beam index
+        token = (top_idx % vocab).astype(jax.dtypes.canonicalize_dtype(jnp.int64))
+
+        prev_fin = self._gather(unwrap(beam_state.finished), parent)
+        next_fin = prev_fin | (token == self.end_token)
+        next_len = self._gather(unwrap(beam_state.lengths), parent) + \
+            (~prev_fin).astype(jax.dtypes.canonicalize_dtype(jnp.int64))
+
+        next_cell_states = _map_structure(
+            lambda s: wrap(self._gather(
+                unwrap(self._split_batch_beams(s)), parent).reshape(
+                    (-1,) + unwrap(s).shape[1:])),
+            next_cell_states)
+        output = self.OutputWrapper(wrap(top_scores), wrap(token),
+                                    wrap(parent))
+        state = self.StateWrapper(next_cell_states, wrap(top_scores),
+                                  wrap(next_fin), wrap(next_len))
+        return output, state
+
+    def step(self, time, inputs, states, **kwargs):
+        merged = self._merge_batch_beams(inputs) \
+            if unwrap(inputs).ndim > 1 else inputs
+        cell_out, next_cell_states = self.cell(merged, states.cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = self._split_batch_beams(cell_out)
+        output, next_states = self._beam_search_step(
+            time, logits, next_cell_states, states)
+        next_inputs = output.predicted_ids
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(next_inputs)
+        return output, next_states, next_inputs, next_states.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs.*: [T, B, W] stacked; backtrace with gather_tree
+        preds = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return self.OutputWrapper(outputs.scores, preds,
+                                  outputs.parent_ids), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until all beams finish or ``max_step_num`` steps
+    (reference decode.py:674 imperative semantics)."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs_acc = None
+    time = 0
+    seq_len = None
+    while True:
+        outputs, next_states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        if seq_len is None:
+            seq_len = getattr(next_states, "lengths", None)
+        else:
+            seq_len = getattr(next_states, "lengths", seq_len)
+        if step_outputs_acc is None:
+            step_outputs_acc = _map_structure(lambda t: _Acc([t]), outputs)
+        else:
+            _map_structure(lambda acc, t: acc.append(t),
+                           step_outputs_acc, outputs)
+        states = next_states
+        time += 1
+        fin = np.asarray(unwrap(finished))
+        if fin.all() or (max_step_num is not None and time > max_step_num):
+            break
+    stacked = _map_structure(
+        lambda acc: wrap(jnp.stack([unwrap(t) for t in acc], axis=0)),
+        step_outputs_acc)
+    final_outputs, final_states = decoder.finalize(stacked, states, seq_len)
+    if not output_time_major:
+        final_outputs = _map_structure(
+            lambda t: wrap(jnp.swapaxes(unwrap(t), 0, 1)), final_outputs)
+    if return_length:
+        return final_outputs, final_states, seq_len
+    return final_outputs, final_states

@@ -10,6 +10,7 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 from .activation import __all__ as _a
 from .common import __all__ as _c
@@ -19,6 +20,7 @@ from .norm import __all__ as _n
 from .loss import __all__ as _l
 from .attention import __all__ as _at
 from .vision import __all__ as _v
+from .extras import __all__ as _x
 
 __all__ = list(_a) + list(_c) + list(_cv) + list(_p) + list(_n) + \
-    list(_l) + list(_at) + list(_v)
+    list(_l) + list(_at) + list(_v) + list(_x)

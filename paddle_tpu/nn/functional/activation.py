@@ -208,3 +208,28 @@ def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
     from ...framework import random as frandom
     return _gumbel_softmax_op(x, temperature=temperature, hard=hard,
                               axis=axis, key=frandom.next_key())
+
+
+# -- inplace variants (reference: activation.py relu_/elu_/... aliases) -----
+
+def _inplace(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(x, *args, **kwargs):
+        from ...ops import _adopt, _snapshot
+        return _adopt(x, fn(_snapshot(x), *args, **kwargs))
+    wrapper.__name__ = fn.__name__ + "_"
+    return wrapper
+
+
+relu_ = _inplace(relu)
+elu_ = _inplace(elu)
+tanh_ = _inplace(tanh)
+hardtanh_ = _inplace(hardtanh)
+leaky_relu_ = _inplace(leaky_relu)
+softmax_ = _inplace(softmax)
+thresholded_relu_ = _inplace(thresholded_relu)
+
+__all__ += ["relu_", "elu_", "tanh_", "hardtanh_", "leaky_relu_",
+            "softmax_", "thresholded_relu_"]

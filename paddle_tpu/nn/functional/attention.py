@@ -20,7 +20,8 @@ from ...ops._op import op_fn
 __all__ = ["scaled_dot_product_attention", "flash_attention",
            "sdpa_reference", "sdpa_raw", "apply_rotary_emb",
            "fused_rotary_position_embedding", "flash_attn_unpadded",
-           "segment_ids_from_cu_seqlens"]
+           "segment_ids_from_cu_seqlens", "flash_attn_qkvpacked",
+           "flash_attn_varlen_qkvpacked", "flash_attention_with_sparse_mask"]
 
 # Filled by paddle_tpu.kernels at import time with a pallas implementation;
 # signature (q, k, v, bias, causal, scale) -> out. None = use XLA path.
@@ -273,4 +274,61 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     return out, None
 
 
-flash_attn_varlen_qkvpacked = None  # reserved name (reference exports it)
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, *, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """paddle flash_attn_qkvpacked parity (flash_attention.py:303):
+    qkv [B, S, 3, H, D] -> (out, None)."""
+    from ...ops._op import unwrap, wrap
+    qkva = unwrap(qkv)
+    q, k, v = (wrap(qkva[:, :, i]) for i in range(3))
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True, name=None):
+    """paddle flash_attn_varlen_qkvpacked parity (flash_attention.py:594):
+    packed qkv [T, 3, H, D] + cu_seqlens -> (out, None)."""
+    from ...ops._op import unwrap, wrap
+    qkva = unwrap(qkv)
+    q, k, v = (wrap(qkva[:, i]) for i in range(3))
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax)
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=False, return_softmax=False,
+                                     return_softmax_lse=False,
+                                     return_seed_offset=False, training=True,
+                                     name=None):
+    """paddle flash_attention_with_sparse_mask parity
+    (flash_attention.py:844). ``attn_mask_start_row_indices`` [B, H, S]
+    gives, per key column j, the first query row that may NOT attend to it
+    (rows >= start are masked). Composed with the causal mask when
+    ``is_causal``; evaluated as a dense masked softmax (MXU path)."""
+    from ...ops._op import unwrap, wrap
+    if return_softmax or return_softmax_lse or return_seed_offset:
+        raise NotImplementedError(
+            "flash_attention_with_sparse_mask: softmax/lse/seed returns "
+            "are not materialized on this path")
+    q = unwrap(query)
+    starts = unwrap(attn_mask_start_row_indices)
+    sq = q.shape[1]
+    rows = jnp.arange(sq)
+    allowed = rows[None, None, :, None] < starts[:, :, None, :]  # [B,H,Sq,Sk]
+    if is_causal:
+        allowed = allowed & (rows[:, None] >= rows[None, :])[None, None]
+    mask = wrap(allowed)
+    out = scaled_dot_product_attention(
+        query, key, value, mask,
+        dropout_p=dropout_p if training else 0.0, is_causal=False,
+        training=training)
+    return out, None

@@ -1,0 +1,588 @@
+"""Functional long tail: unpooling, fractional pooling, sequence losses
+(CTC / RNN-T), hierarchical sigmoid, margin losses, beam-search utilities,
+sparse attention, temporal shift.
+
+Reference capability: python/paddle/nn/functional/loss.py (ctc_loss:1835,
+rnnt_loss:1983, hsigmoid_loss:886, multi_margin_loss:3902,
+triplet_margin_with_distance_loss:3616, margin_cross_entropy:2110),
+functional/extension.py (sequence_mask/gather_tree/temporal_shift),
+functional/sparse_attention.py, functional/common.py class_center_sample,
+phi/kernels/funcs/pooling.h (fractional index math, unpool scatter).
+
+TPU-native design notes:
+- CTC and RNN-T are lax.scan dynamic programs in the log semiring; the
+  RNN-T inner (label-axis) recurrence is solved in closed form with
+  cumlogsumexp, so each scan step is a vectorised row update (no O(U)
+  sequential inner loop — the wavefront rides the VPU).
+- Fractional pooling boundaries depend only on static shapes and the host
+  random u, so patch gathers stay static-shaped for XLA.
+- sparse_attention keeps the reference's CSR layout at the API and
+  materialises the mask densely — on TPU the dense masked softmax is the
+  fast path (MXU) for the sizes this API targets; block-sparse long-context
+  runs ride kernels/ring_attention and varlen flash instead.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
+    "multi_margin_loss", "triplet_margin_with_distance_loss",
+    "hsigmoid_loss", "pairwise_distance", "sequence_mask", "temporal_shift",
+    "class_center_sample", "margin_cross_entropy", "gather_tree",
+    "sparse_attention", "ctc_loss", "rnnt_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# unpooling (reference: phi/kernels/funcs/unpooling.h — scatter by mask)
+# ---------------------------------------------------------------------------
+
+def _unpool(x, indices, nsp, kernel_size, stride, padding, output_size,
+            data_format):
+    if data_format not in ("NCL", "NCHW", "NCDHW"):
+        raise ValueError(f"max_unpool: unsupported data_format {data_format}")
+    k = (kernel_size,) * nsp if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = k if stride is None else (
+        (stride,) * nsp if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * nsp if isinstance(padding, int) else tuple(padding)
+    spatial = x.shape[2:]
+    if output_size is None:
+        out_sp = tuple((spatial[i] - 1) * s[i] - 2 * p[i] + k[i]
+                       for i in range(nsp))
+    else:
+        out_sp = tuple(output_size[-nsp:])
+    n, c = x.shape[:2]
+    flat = int(np.prod(out_sp))
+    xf = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, flat), x.dtype)
+    out = out.at[jnp.arange(n)[:, None, None],
+                 jnp.arange(c)[None, :, None], idx].set(xf)
+    return out.reshape((n, c) + out_sp)
+
+
+@op_fn(nondiff_args=(1,))
+def _unpool_op(x, indices, *, nsp, kernel_size, stride, padding,
+               output_size, data_format):
+    return _unpool(x, indices, nsp, kernel_size, stride, padding,
+                   output_size, data_format)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool_op(x, indices, nsp=1, kernel_size=kernel_size,
+                      stride=stride, padding=padding,
+                      output_size=output_size, data_format=data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool_op(x, indices, nsp=2, kernel_size=kernel_size,
+                      stride=stride, padding=padding,
+                      output_size=output_size, data_format=data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool_op(x, indices, nsp=3, kernel_size=kernel_size,
+                      stride=stride, padding=padding,
+                      output_size=output_size, data_format=data_format)
+
+
+# ---------------------------------------------------------------------------
+# fractional max pooling (reference: pooling.h FractionalStartIndex/EndIndex)
+# ---------------------------------------------------------------------------
+
+def _fractional_bounds(inp, out, ksize, u):
+    """Host-side window bounds per output index (reference pooling.h:106-139
+    math, identically)."""
+    alpha = inp / out
+    if not ksize:
+        base = inp // out
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (inp + 1 - base) / alpha - (out - 1)
+        u = u * min(u_max1, u_max2)
+    idx = np.arange(out)
+    start = ((idx + u) * alpha).astype(np.int64) - int(u * alpha)
+    if ksize:
+        end = start + ksize
+    else:
+        end = ((idx + 1 + u) * alpha).astype(np.int64) - int(u * alpha)
+    start = np.clip(start, 0, inp - 1)
+    end = np.clip(end, 1, inp)
+    return start, end
+
+
+def _fractional_pool(x, nsp, output_size, kernel_size, random_u, return_mask,
+                     data_format):
+    if data_format not in ("NCHW", "NCDHW"):
+        raise ValueError(f"fractional pool: bad data_format {data_format}")
+    spatial = unwrap(x).shape[2:]
+    osz = ((output_size,) * nsp if isinstance(output_size, int)
+           else tuple(output_size))
+    ksz = ((None,) * nsp if kernel_size is None else
+           ((kernel_size,) * nsp if isinstance(kernel_size, int)
+            else tuple(kernel_size)))
+    if random_u is None:
+        random_u = float(np.random.default_rng().uniform(0.01, 0.99))
+    u = float(random_u)
+    starts, lens = [], []
+    for d in range(nsp):
+        st, en = _fractional_bounds(spatial[d], osz[d], ksz[d], u)
+        starts.append(tuple(int(v) for v in st))
+        lens.append(tuple(int(v) for v in en - st))
+    return _fractional_pool_op(x, nsp=nsp, osz=osz, starts=tuple(starts),
+                               lens=tuple(lens), return_mask=return_mask)
+
+
+@op_fn
+def _fractional_pool_op(x, *, nsp, osz, starts, lens, return_mask):
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    wmax = [max(ln) for ln in lens]
+    # gather window patches per dim: result [..., o_d, w_d]
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    patches = x
+    for d in range(nsp):
+        ax = 2 + d            # current dim position (before windows appended)
+        pos = (jnp.asarray(starts[d])[:, None]
+               + jnp.arange(wmax[d])[None, :])           # [o, w]
+        valid = jnp.arange(wmax[d])[None, :] < jnp.asarray(lens[d])[:, None]
+        pos_c = jnp.clip(pos, 0, spatial[d] - 1)
+        patches = jnp.take(patches, pos_c.reshape(-1), axis=ax)
+        new_shape = (patches.shape[:ax] + (osz[d], wmax[d])
+                     + patches.shape[ax + 1:])
+        patches = patches.reshape(new_shape)
+        # mask invalid window cells, move window axis to the end
+        bshape = [1] * patches.ndim
+        bshape[ax], bshape[ax + 1] = osz[d], wmax[d]
+        patches = jnp.where(valid.reshape(bshape), patches, neg)
+        patches = jnp.moveaxis(patches, ax + 1, -1)
+    # patches: [N, C, o1..onsp, w1..wnsp]
+    wdims = tuple(range(patches.ndim - nsp, patches.ndim))
+    out = jnp.max(patches, axis=wdims)
+    if not return_mask:
+        return out
+    flat_w = patches.reshape(patches.shape[:-nsp] + (-1,))
+    am = jnp.argmax(flat_w, axis=-1)                     # [N, C, o1..onsp]
+    # decode patch-local argmax into the global flat spatial index
+    coords = []
+    rem = am
+    for d in reversed(range(nsp)):
+        coords.insert(0, rem % wmax[d])
+        rem = rem // wmax[d]
+    flat_idx = jnp.zeros_like(am)
+    for d in range(nsp):
+        st = jnp.asarray(starts[d])
+        shape = [1] * am.ndim
+        shape[2 + d] = osz[d]
+        gpos = st.reshape(shape) + coords[d]
+        flat_idx = flat_idx * spatial[d] + gpos
+    return out, flat_idx.astype(jnp.int32)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, 2, output_size, kernel_size, random_u,
+                            return_mask, "NCHW")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, 3, output_size, kernel_size, random_u,
+                            return_mask, "NCDHW")
+
+
+# ---------------------------------------------------------------------------
+# margin losses
+# ---------------------------------------------------------------------------
+
+@op_fn(nondiff_args=(1,))
+def _multi_margin(input, label, weight=None, *, p=1, margin=1.0,
+                  reduction="mean"):
+    n, c = input.shape
+    target = input[jnp.arange(n), label]                  # [N]
+    diff = jnp.maximum(margin - target[:, None] + input, 0.0)
+    if p != 1:
+        diff = diff ** p
+    if weight is not None:
+        diff = diff * weight[label][:, None]
+    # exclude the true-class term
+    diff = diff.at[jnp.arange(n), label].set(0.0)
+    return _reduce(jnp.sum(diff, axis=1) / c, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    return _multi_margin(input, label, weight, p=p, margin=margin,
+                         reduction=reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from ...ops import maximum, mean, minimum
+    from ...ops import sum as t_sum
+
+    dist = distance_function if distance_function is not None \
+        else pairwise_distance
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = minimum(d_neg, dist(positive, negative))
+    # taped Tensor arithmetic end to end (a custom distance_function keeps
+    # its autograd path)
+    loss = maximum(d_pos - d_neg + margin, wrap(jnp.zeros((), jnp.float32)))
+    if reduction == "mean":
+        return mean(loss)
+    if reduction == "sum":
+        return t_sum(loss)
+    return loss
+
+
+@op_fn
+def _pairwise_distance(x, y, *, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return _pairwise_distance(x, y, p=float(p), epsilon=epsilon,
+                              keepdim=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid (reference: loss.py:886 + phi MatrixBitCodeFunctor)
+# ---------------------------------------------------------------------------
+
+@op_fn(nondiff_args=(1,))
+def _hsigmoid(input, label, weight, bias=None, path_table=None,
+              path_code=None, *, num_classes):
+    if path_table is None:
+        # default complete binary tree (reference SimpleCode): for class c,
+        # code = c + num_classes; internal node at step j is
+        # (code >> (L - j)) - 1, branch bit is (code >> (L - 1 - j)) & 1
+        code = label + num_classes
+        max_len = int(np.ceil(np.log2(num_classes))) + 1
+        length = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+        j = jnp.arange(max_len)
+        shift_idx = jnp.maximum(length[:, None] - j[None, :], 0)
+        shift_bit = jnp.maximum(length[:, None] - 1 - j[None, :], 0)
+        node = (code[:, None] >> shift_idx) - 1             # [N, L]
+        bit = (code[:, None] >> shift_bit) & 1
+        valid = j[None, :] < length[:, None]
+    else:
+        node = path_table
+        bit = path_code
+        valid = node >= 0
+    node_c = jnp.clip(node, 0, weight.shape[0] - 1)
+    w = weight[node_c]                                      # [N, L, D]
+    score = jnp.einsum("nd,nld->nl", input, w)
+    if bias is not None:
+        score = score + bias.reshape(-1)[node_c]
+    t = bit.astype(score.dtype)
+    # BCE-with-logits per tree edge: softplus(s) - t*s
+    per_edge = jnp.where(valid, jax.nn.softplus(score) - t * score, 0.0)
+    return jnp.sum(per_edge, axis=1, keepdims=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    return _hsigmoid(input, label, weight, bias, path_table, path_code,
+                     num_classes=int(num_classes))
+
+
+# ---------------------------------------------------------------------------
+# sequence utilities
+# ---------------------------------------------------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import convert_dtype
+
+    xa = unwrap(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(xa))
+    mask = jnp.arange(maxlen) < xa[..., None]
+    return wrap(mask.astype(convert_dtype(dtype)))
+
+
+@op_fn
+def _temporal_shift(x, *, seg_num, shift_ratio, data_format):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    # slide fold channels backward in time, next fold forward, rest stay
+    back = jnp.concatenate([xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])],
+                           axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                           xr[:, :-1, fold:2 * fold]], axis=1)
+    out = jnp.concatenate([back, fwd, xr[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"temporal_shift: bad data_format {data_format}")
+    return _temporal_shift(x, seg_num=int(seg_num),
+                           shift_ratio=float(shift_ratio),
+                           data_format=data_format)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: extension.py:131, phi gather_tree
+    kernel). ids/parents: [max_time, batch, beam]."""
+
+    ia = unwrap(ids)
+    pa = unwrap(parents)
+    t_max, batch, beam = ia.shape
+    binit = jnp.broadcast_to(jnp.arange(beam), (batch, beam))
+
+    def step(carry_beam, xs):
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, carry_beam, axis=1)
+        next_beam = jnp.take_along_axis(step_parents, carry_beam, axis=1)
+        return next_beam, out
+
+    _, outs = lax.scan(step, binit, (ia[::-1], pa[::-1]))
+    return wrap(outs[::-1])
+
+
+# ---------------------------------------------------------------------------
+# class-center sampling + margin softmax (reference: common.py:2104,
+# loss.py:2110 — the PartialFC / ArcFace training pair)
+# ---------------------------------------------------------------------------
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: all positives plus random negatives up to
+    ``num_samples``. Eager/host op (the sampled set is data-dependent by
+    design; the reference kernel is host-driven too)."""
+    la = np.asarray(unwrap(label))
+    pos = np.unique(la)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos,
+                                assume_unique=True)
+        extra = np.random.default_rng().choice(
+            neg_pool, size=num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (wrap(jnp.asarray(remap[la])),
+            wrap(jnp.asarray(sampled.astype(np.int64))))
+
+
+@op_fn(nondiff_args=(1,))
+def _margin_ce(logits, label, *, margin1, margin2, margin3, scale,
+               return_softmax, reduction):
+    n = logits.shape[0]
+    cos = jnp.clip(logits[jnp.arange(n), label], -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    mod = logits.at[jnp.arange(n), label].set(target)
+    mod = mod * scale
+    logp = jax.nn.log_softmax(mod, axis=-1)
+    loss = -logp[jnp.arange(n), label][:, None]
+    if reduction is not None:
+        loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    return _margin_ce(logits, label, margin1=float(margin1),
+                      margin2=float(margin2), margin3=float(margin3),
+                      scale=float(scale), return_softmax=bool(return_softmax),
+                      reduction=reduction)
+
+
+# ---------------------------------------------------------------------------
+# sparse attention (reference: functional/sparse_attention.py — CSR layout)
+# ---------------------------------------------------------------------------
+
+@op_fn(nondiff_args=(3, 4))
+def _sparse_attention(query, key, value, offset, columns,
+                      key_padding_mask=None, attn_mask=None):
+    b, h, s, d = query.shape
+    scores = jnp.einsum("bhqd,bhkd->bhqk", query, key) / jnp.sqrt(
+        jnp.asarray(d, query.dtype))
+    # CSR (offset [B,H,S+1], columns [B,H,nnz]) -> dense allowed mask
+    def one(off, cols):
+        rows = jnp.searchsorted(off[1:], jnp.arange(cols.shape[0]),
+                                side="right")
+        m = jnp.zeros((s, s), bool).at[rows, cols].set(True)
+        return m
+    mask = jax.vmap(jax.vmap(one))(offset, columns)   # [B,H,S,S]
+    neg = jnp.asarray(-1e9, scores.dtype)
+    scores = jnp.where(mask, scores, neg)
+    if key_padding_mask is not None:
+        scores = jnp.where(key_padding_mask[:, None, None, :] != 0,
+                           scores, neg)
+    if attn_mask is not None:
+        scores = jnp.where(attn_mask[None, None] != 0, scores, neg)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, value)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    return _sparse_attention(query, key, value, sparse_csr_offset,
+                             sparse_csr_columns, key_padding_mask, attn_mask)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: loss.py:1835 / warpctc) — log-semiring lax.scan
+# ---------------------------------------------------------------------------
+
+@op_fn(nondiff_args=(1, 2, 3))
+def _ctc_loss(log_probs, labels, input_lengths, label_lengths, *, blank,
+              norm_by_times, reduction):
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    t_max, n, _ = lp.shape
+    s_max = labels.shape[1]
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+    # extended sequence with interleaved blanks: z [N, 2S+1]
+    ext = jnp.full((n, 2 * s_max + 1), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ez = 2 * s_max + 1
+    # allowed skip: z[s] != blank and z[s] != z[s-2]
+    zshift = jnp.concatenate([jnp.full((n, 2), blank, labels.dtype),
+                              ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != zshift)
+
+    emit = jnp.take_along_axis(
+        lp.transpose(1, 0, 2),                     # [N, T, C]
+        jnp.broadcast_to(ext[:, None, :], (n, t_max, ez)), axis=2)
+
+    a0 = jnp.full((n, ez), neg_inf)
+    a0 = a0.at[:, 0].set(emit[:, 0, 0])
+    a0 = a0.at[:, 1].set(jnp.where(s_max > 0, emit[:, 0, 1], neg_inf))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((n, 1), neg_inf), alpha[:, :-1]],
+                                axis=1)
+        prev2 = jnp.concatenate([jnp.full((n, 2), neg_inf), alpha[:, :-2]],
+                                axis=1)
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + emit[:, t]
+        # freeze rows past their input length
+        new = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, t_max))
+    # final: logaddexp of positions 2L and 2L-1
+    l2 = 2 * label_lengths
+    last = jnp.take_along_axis(alpha, l2[:, None], axis=1)[:, 0]
+    last1 = jnp.take_along_axis(alpha, jnp.maximum(l2 - 1, 0)[:, None],
+                                axis=1)[:, 0]
+    last1 = jnp.where(label_lengths > 0, last1, neg_inf)
+    nll = -jnp.logaddexp(last, last1)
+    if norm_by_times:
+        nll = nll / input_lengths.astype(nll.dtype)
+    if reduction == "mean":
+        # warpctc convention: per-sample loss / label_length, then mean
+        return jnp.mean(nll / jnp.maximum(
+            label_lengths.astype(nll.dtype), 1.0))
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    return _ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                     blank=int(blank), norm_by_times=bool(norm_by_times),
+                     reduction=reduction)
+
+
+# ---------------------------------------------------------------------------
+# RNN-T loss (reference: loss.py:1983 / warp-transducer)
+# ---------------------------------------------------------------------------
+
+@op_fn(nondiff_args=(1, 2, 3))
+def _rnnt_loss(input, label, input_lengths, label_lengths, *, blank,
+               reduction):
+    lp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+    b, t_max, u1, _ = lp.shape
+    u_max = u1 - 1
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+    blank_lp = lp[..., blank]                        # [B, T, U+1]
+    lab_lp = jnp.take_along_axis(
+        lp[:, :, :u_max, :],
+        jnp.broadcast_to(label[:, None, :, None].astype(jnp.int32),
+                         (b, t_max, u_max, 1)), axis=3)[..., 0]  # [B,T,U]
+    # mask label positions beyond the label length
+    uvalid = jnp.arange(u_max)[None, :] < label_lengths[:, None]
+    lab_lp = jnp.where(uvalid[:, None, :], lab_lp, neg_inf)
+
+    # alpha rows via closed-form inner recurrence:
+    # alpha_t[u] = logaddexp(c[u], alpha_t[u-1] + l[u-1])
+    #            = L[u] + logcumsumexp(c - L)[u],  L = exclusive cumsum of l
+    def row_solve(c, l):
+        big_l = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.float32), jnp.cumsum(l, axis=1)], axis=1)
+        z = jnp.maximum(c - big_l, -1e30)   # keep -inf arithmetic finite
+        return big_l + lax.cumlogsumexp(z, axis=1)
+
+    a0 = row_solve(jnp.concatenate(
+        [jnp.zeros((b, 1), jnp.float32),
+         jnp.full((b, u_max), neg_inf)], axis=1), lab_lp[:, 0])
+
+    def step(alpha, t):
+        c = alpha + blank_lp[:, t - 1]               # emit blank from t-1
+        new = row_solve(c, lab_lp[:, t])
+        new = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, t_max))
+    # loss = -(alpha[T-1, U] + blank[T-1, U])
+    ti = jnp.maximum(input_lengths - 1, 0)
+    final_a = jnp.take_along_axis(
+        alpha, label_lengths[:, None], axis=1)[:, 0]
+    final_b = jnp.take_along_axis(
+        jnp.take_along_axis(blank_lp, ti[:, None, None], axis=1)[:, 0],
+        label_lengths[:, None], axis=1)[:, 0]
+    return _reduce(-(final_a + final_b), reduction)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """Transducer loss. ``fastemit_lambda`` is accepted for signature
+    parity; the FastEmit regularizer reweights gradients inside the
+    warp-transducer backward and does not change the NLL value computed
+    here (loss-value parity holds at lambda=0 semantics)."""
+    return _rnnt_loss(input, label, input_lengths, label_lengths,
+                      blank=int(blank), reduction=reduction)

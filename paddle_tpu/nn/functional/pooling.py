@@ -122,25 +122,118 @@ def avg_pool3d(x, *, kernel_size, stride=None, padding=0, exclusive=True,
                  exclusive, ceil_mode)
 
 
-@op_fn
-def max_pool1d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCL"):
-    return _pool(x, 1, kernel_size, stride, padding, data_format, "max",
-                 ceil_mode=ceil_mode)
+def _max_pool_mask(x, nsp, kernel, stride, padding, ceil_mode, data_format):
+    """Max pool + argmax mask (flat input-spatial index per N,C — the
+    reference return_mask semantics that max_unpool consumes). Patch
+    extraction keeps everything static-shaped for XLA."""
+    if not data_format.startswith("NC"):
+        raise ValueError(
+            f"return_mask requires channel-first layout, got {data_format}")
+    k = _tuplize(kernel, nsp)
+    s = _tuplize(stride if stride is not None else kernel, nsp)
+    pad = _pad_cfg(padding, nsp, data_format, x.ndim)
+    dims, strides = _window(nsp, k, s, data_format)
+    if isinstance(pad, str):
+        pad_seq = lax.padtype_to_pads(x.shape, dims, strides, pad)
+    else:
+        pad_seq = list(pad)
+    if ceil_mode:
+        pad_seq = list(pad_seq)
+        for ax in range(x.ndim):
+            kk, ss = dims[ax], strides[ax]
+            if kk == 1 and ss == 1:
+                continue
+            pl, pr = pad_seq[ax]
+            span = x.shape[ax] + pl + pr - kk
+            out_ceil = -(-span // ss) + 1
+            needed = (out_ceil - 1) * ss + kk - (x.shape[ax] + pl + pr)
+            if needed > 0:
+                pad_seq[ax] = (pl, pr + needed)
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    out_sp = tuple(
+        (spatial[d] + sum(pad_seq[2 + d]) - k[d]) // s[d] + 1
+        for d in range(nsp))
+    # window gather per spatial dim (exact arithmetic — no conv/matmul
+    # precision involved); invalid (padding) cells masked to -inf
+    patches = x
+    for d in range(nsp):
+        ax = 2 + d
+        pos = (jnp.arange(out_sp[d])[:, None] * s[d] - pad_seq[ax][0]
+               + jnp.arange(k[d])[None, :])             # [o, k]
+        valid = (pos >= 0) & (pos < spatial[d])
+        pos_c = jnp.clip(pos, 0, spatial[d] - 1)
+        patches = jnp.take(patches, pos_c.reshape(-1), axis=ax)
+        patches = patches.reshape(patches.shape[:ax] + (out_sp[d], k[d])
+                                  + patches.shape[ax + 1:])
+        bshape = [1] * patches.ndim
+        bshape[ax], bshape[ax + 1] = out_sp[d], k[d]
+        patches = jnp.where(valid.reshape(bshape), patches, neg)
+        patches = jnp.moveaxis(patches, ax + 1, -1)
+    flatp = patches.reshape((n, c) + out_sp + (int(np.prod(k)),))
+    out = jnp.max(flatp, axis=-1)
+    am = jnp.argmax(flatp, axis=-1)                  # [N, C, *out_sp]
+    # decode: window origin + in-window offset -> flat input index
+    flat_idx = jnp.zeros_like(am)
+    rem = am
+    coords = []
+    for d in reversed(range(nsp)):
+        coords.insert(0, rem % k[d])
+        rem = rem // k[d]
+    for d in range(nsp):
+        shape = [1] * am.ndim
+        shape[2 + d] = out_sp[d]
+        origin = (jnp.arange(out_sp[d]) * s[d]
+                  - pad_seq[2 + d][0]).reshape(shape)
+        gpos = jnp.clip(origin + coords[d], 0, spatial[d] - 1)
+        flat_idx = flat_idx * spatial[d] + gpos
+    return out, flat_idx.astype(jnp.int32)
 
 
 @op_fn
-def max_pool2d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCHW"):
-    return _pool(x, 2, kernel_size, stride, padding, data_format, "max",
-                 ceil_mode=ceil_mode)
+def _max_pool_mask_op(x, *, nsp, kernel_size, stride, padding, ceil_mode,
+                      data_format):
+    return _max_pool_mask(x, nsp, kernel_size, stride, padding, ceil_mode,
+                          data_format)
+
+
+def _max_pool(x, nsp, kernel_size, stride, padding, return_mask, ceil_mode,
+              data_format):
+    if return_mask:
+        return _max_pool_mask_op(x, nsp=nsp, kernel_size=kernel_size,
+                                 stride=stride, padding=padding,
+                                 ceil_mode=ceil_mode,
+                                 data_format=data_format)
+    return _max_pool_plain(x, nsp=nsp, kernel_size=kernel_size,
+                           stride=stride, padding=padding,
+                           ceil_mode=ceil_mode, data_format=data_format)
 
 
 @op_fn
-def max_pool3d(x, *, kernel_size, stride=None, padding=0, ceil_mode=False,
-               data_format="NCDHW"):
-    return _pool(x, 3, kernel_size, stride, padding, data_format, "max",
+def _max_pool_plain(x, *, nsp, kernel_size, stride, padding, ceil_mode,
+                    data_format):
+    return _pool(x, nsp, kernel_size, stride, padding, data_format, "max",
                  ceil_mode=ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool(x, 1, kernel_size, stride, padding, return_mask,
+                     ceil_mode, data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, 2, kernel_size, stride, padding, return_mask,
+                     ceil_mode, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, 3, kernel_size, stride, padding, return_mask,
+                     ceil_mode, data_format)
 
 
 def _adaptive(x, nsp, output_size, data_format, kind):

@@ -208,3 +208,30 @@ class Dirac(Initializer):
             for i in range(min(og, in_c)):
                 w[(g * og + i, i) + centers] = 1.0
         return jnp.asarray(w, dtype=dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed conv weights
+    (reference: nn/initializer/Bilinear): weight [C_out, C_in, kH, kW]
+    gets the separable triangle filter that linearly interpolates."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear expects a 4-D conv weight shape, got {shape}")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            center = f - 1 if k % 2 == 1 else f - 0.5
+            return 1 - np.abs(np.arange(k) - center) / f
+
+        kernel = np.outer(tri(kh), tri(kw)).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for o in range(shape[0]):
+            for i in range(shape[1]):
+                w[o, i] = kernel
+        return jnp.asarray(w, dtype=dtype)
+
+
+__all__.append("Bilinear")

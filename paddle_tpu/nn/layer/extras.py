@@ -1,0 +1,174 @@
+"""Layer classes for the functional long tail.
+
+Reference capability: python/paddle/nn/layer/pooling.py (MaxUnPool*,
+FractionalMaxPool*), layer/loss.py (CTCLoss:1300-ish, RNNTLoss,
+MultiMarginLoss, TripletMarginWithDistanceLoss, HSigmoidLoss),
+layer/activation.py (Softmax2D).
+"""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Uniform
+from .base import Layer
+
+__all__ = [
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "MultiMarginLoss", "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+    "CTCLoss", "RNNTLoss", "Softmax2D",
+]
+
+
+class _MaxUnPool(Layer):
+    _nsp = 2
+    _fn = None
+    _default_df = "NCHW"
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format or self._default_df
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, self.kernel_size, self.stride,
+                        self.padding, self.data_format, self.output_size)
+
+    def extra_repr(self):
+        return (f"kernel_size={self.kernel_size}, stride={self.stride}, "
+                f"padding={self.padding}")
+
+
+class MaxUnPool1D(_MaxUnPool):
+    _default_df = "NCL"
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    _default_df = "NCDHW"
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class _FractionalMaxPool(Layer):
+    _fn = None
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return self._fn(x, self.output_size, self.kernel_size,
+                        self.random_u, self.return_mask)
+
+    def extra_repr(self):
+        return f"output_size={self.output_size}"
+
+
+class FractionalMaxPool2D(_FractionalMaxPool):
+    _fn = staticmethod(F.fractional_max_pool2d)
+
+
+class FractionalMaxPool3D(_FractionalMaxPool):
+    _fn = staticmethod(F.fractional_max_pool3d)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference layer/loss.py HSigmoidLoss): owns
+    the [num_classes-1, feature_size] internal-node weight table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        std = 1.0 / (feature_size ** 0.5)
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            (rows, feature_size), attr=weight_attr,
+            default_initializer=Uniform(-std, std))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (rows, 1), attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-std, std))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference
+    layer/activation.py Softmax2D: softmax at axis=-3)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3D/4D input, got {x.ndim}D")
+        return F.softmax(x, axis=-3)

@@ -15,6 +15,7 @@ from .linalg import *  # noqa
 from .linalg_ext import *  # noqa
 from .logic import *  # noqa
 from .random import *  # noqa
+from .misc_ext import *  # noqa
 from . import fft_ops  # noqa  (namespaced under paddle_tpu.fft)
 
 from ..core.tensor import Tensor
@@ -310,7 +311,7 @@ _INPLACE_NAMES = [
     "polygamma", "pow", "put_along_axis", "reciprocal", "remainder",
     "renorm", "reshape", "round", "rsqrt", "scale", "scatter", "sigmoid",
     "sin", "sinc", "sinh", "sqrt", "squeeze", "subtract", "tan", "tanh",
-    "tril", "triu", "trunc", "unsqueeze",
+    "tril", "triu", "trunc", "unsqueeze", "erf", "square", "index_add",
     # NOT "where": where_(cond, x, y) mutates x (arg 1), not the condition,
     # so the generic first-arg adoption would corrupt the bool cond tensor
 ]

@@ -1,0 +1,104 @@
+"""Remaining top-level tensor API parity: stack variants, combinations,
+pdist, *_like random, binomial/standard_gamma sampling.
+
+Reference capability: python/paddle/tensor/manipulation.py (hstack/vstack/
+dstack/column_stack/row_stack), math.py (combinations, pdist),
+random.py (randint_like, binomial, standard_gamma).
+TPU-native: jnp compositions; sampling via jax.random with the global
+framework key chain.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_key
+from ._op import op_fn, unwrap, wrap
+
+__all__ = [
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "combinations", "pdist", "randint_like", "binomial", "standard_gamma",
+]
+
+
+def _seq(xs):
+    return [unwrap(x) for x in xs]
+
+
+def hstack(x, name=None):
+    return wrap(jnp.hstack(_seq(x)))
+
+
+def vstack(x, name=None):
+    return wrap(jnp.vstack(_seq(x)))
+
+
+row_stack = vstack
+
+
+def dstack(x, name=None):
+    return wrap(jnp.dstack(_seq(x)))
+
+
+def column_stack(x, name=None):
+    return wrap(jnp.column_stack(_seq(x)))
+
+
+@op_fn(differentiable=False)
+def _combinations(x, *, r=2, with_replacement=False):
+    """All r-combinations of the elements of 1-D ``x`` — [C, r].
+
+    Index tuples are enumerated host-side from the static length (the
+    combinatorial structure is shape-only), then gathered on device.
+    """
+    import itertools
+
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    tuples = list(gen(range(n), r))
+    if not tuples:
+        return jnp.zeros((0, r), x.dtype)
+    return x[jnp.asarray(tuples, jnp.int32)]
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    return _combinations(x, r=int(r), with_replacement=bool(with_replacement))
+
+
+@op_fn
+def pdist(x, p=2.0):
+    """Condensed pairwise distance of [N, D] rows — [N*(N-1)/2]."""
+    n = x.shape[0]
+    iu, ju = jnp.triu_indices(n, k=1)
+    diff = x[iu] - x[ju]
+    if p == 2.0:
+        # sqrt of clamped sumsq: grad-safe at 0 and MXU-friendly
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 1e-24))
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    xa = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    from ..core.dtype import convert_dtype
+    dt = convert_dtype(dtype) if dtype is not None else xa.dtype
+    out = jax.random.randint(next_key(), xa.shape, int(low), int(high))
+    return wrap(out.astype(dt))
+
+
+def binomial(count, prob, name=None):
+    """Sample Binomial(count, prob) elementwise (reference: random.py
+    binomial). Uses jax.random.binomial (Stirling/inversion on device)."""
+    c = unwrap(count).astype(jnp.float32)
+    pr = unwrap(prob).astype(jnp.float32)
+    out = jax.random.binomial(next_key(), c, pr)
+    return wrap(out.astype(jax.dtypes.canonicalize_dtype(jnp.int64)))
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) elementwise (reference: random.py
+    standard_gamma)."""
+    xa = unwrap(x)
+    return wrap(jax.random.gamma(next_key(), xa))

@@ -1,6 +1,7 @@
 """paddle.optimizer parity surface
 (reference: python/paddle/optimizer/__init__.py)."""
 from . import lr  # noqa
+from .extra import ASGD, Adadelta, LBFGS, NAdam, RAdam, Rprop  # noqa
 from .optimizer import (Adagrad, Adam, Adamax, AdamW, ClipGradByGlobalNorm,  # noqa
                         ClipGradByNorm, ClipGradByValue, L1Decay, L2Decay,
                         Lamb, Momentum, Optimizer, RMSProp, SGD)

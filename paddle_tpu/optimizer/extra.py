@@ -1,0 +1,295 @@
+"""Optimizer long tail: ASGD, Adadelta, NAdam, RAdam, Rprop, LBFGS.
+
+Reference capability: python/paddle/optimizer/{asgd,adadelta,nadam,radam,
+rprop,lbfgs}.py. Update math per the reference kernels
+(paddle/phi/kernels/*_kernel.h); every rule is a pure jnp expression
+dispatched through the shared Optimizer machinery so it jits/fuses like
+the built-ins. LBFGS is closure-driven (two-loop recursion + optional
+strong-Wolfe line search) over the flattened parameter vector.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["ASGD", "Adadelta", "NAdam", "RAdam", "Rprop", "LBFGS"]
+
+
+class ASGD(Optimizer):
+    """Averaged SGD over the last ``batch_num`` gradients (reference:
+    optimizer/asgd.py; phi asgd_kernel: d <- d - y_i + g, y_i <- g,
+    param <- param - lr/n * d)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        if batch_num <= 0:
+            raise ValueError(f"batch_num must be positive, got {batch_num}")
+        self._batch_num = int(batch_num)
+
+    def _init_state(self, p):
+        return {"d": jnp.zeros_like(p._data),
+                "ys": jnp.zeros((self._batch_num,) + tuple(p._data.shape),
+                                p._data.dtype)}
+
+    def _update(self, param, grad, state, lr, step):
+        i = (step - 1) % self._batch_num     # step counts from 1
+        d = state["d"] - state["ys"][i] + grad
+        ys = state["ys"].at[i].set(grad)
+        n = float(min(step, self._batch_num))
+        new_p = param - lr / n * d
+        return new_p, {"d": d, "ys": ys}
+
+
+class Adadelta(Optimizer):
+    """reference: optimizer/adadelta.py (accumulated grad^2 and update^2
+    windows, rho decay)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p._data),
+                "avg_sq_update": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr, step):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_sq_grad"] + (1 - rho) * jnp.square(grad)
+        upd = grad * jnp.sqrt(state["avg_sq_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state["avg_sq_update"] + (1 - rho) * jnp.square(upd)
+        return param - lr * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference: optimizer/nadam.py; momentum schedule
+    mu_t = beta1 * (1 - 0.5 * 0.96^(t*momentum_decay)))."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data),
+                "v": jnp.zeros_like(p._data),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr, step):
+        t = jnp.float32(step)
+        b1, b2 = self._b1, self._b2
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        m_hat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                 + (1 - mu_t) * grad / (1 - mu_prod))
+        v_hat = v / (1 - b2 ** t)
+        new_p = param - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new_p, {"m": m, "v": v, "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: optimizer/radam.py): falls back to
+    un-adapted momentum while the variance estimate is unrectifiable."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data), "v": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr, step):
+        t = jnp.float32(step)
+        b1, b2 = self._b1, self._b2
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2 ** t / (1.0 - b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        v_hat = jnp.sqrt(v / (1 - b2 ** t))
+        adaptive = r * m_hat / (v_hat + self._eps)
+        plain = m_hat
+        new_p = param - lr * jnp.where(rho_t > 5.0, adaptive, plain)
+        return new_p, {"m": m, "v": v}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: optimizer/rprop.py): per-weight step
+    sizes grown/shrunk by sign agreement; gradients only steer sign."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._lr0 = learning_rate if isinstance(learning_rate, float) \
+            else 0.001
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p._data),
+                "step_size": jnp.full_like(p._data, self._lr0)}
+
+    def _update(self, param, grad, state, lr, step):
+        sign = jnp.sign(grad * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        size = jnp.clip(state["step_size"] * factor, self._lr_min,
+                        self._lr_max)
+        # on sign flip the step is skipped and the stored grad zeroed
+        eff_grad = jnp.where(sign < 0, 0.0, grad)
+        new_p = param - jnp.sign(eff_grad) * size
+        return new_p, {"prev_grad": eff_grad, "step_size": size}
+
+
+class LBFGS:
+    """Limited-memory BFGS with optional strong-Wolfe line search
+    (reference: optimizer/lbfgs.py). Closure-driven: ``step(closure)``
+    re-evaluates the loss as the line search probes points. State rides
+    the flattened parameter vector; the two-loop recursion is pure jnp."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._parameters = list(parameters or [])
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    def _flat(self, arrays):
+        return jnp.concatenate([a.reshape(-1) for a in arrays])
+
+    def _set_params(self, flat):
+        off = 0
+        for p in self._parameters:
+            n = int(p._data.size)
+            p._data = flat[off:off + n].reshape(p._data.shape) \
+                .astype(p._data.dtype)
+            off += n
+
+    def _eval(self, closure):
+        loss = closure()
+        grads = self._flat([jnp.asarray(p.grad._data) if p.grad is not None
+                            else jnp.zeros_like(p._data)
+                            for p in self._parameters])
+        return float(loss._data), grads
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure):
+        loss0, g = self._eval(closure)
+        evals = 1
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            d = self._direction(g)
+            x0 = self._flat([p._data for p in self._parameters])
+            t = self._lr
+            if self._line_search == "strong_wolfe":
+                t, loss_new, g_new, n_ev = self._strong_wolfe(
+                    closure, x0, d, loss0, g, t)
+                evals += n_ev
+            else:
+                self._set_params(x0 + t * d)
+                for p in self._parameters:
+                    p.clear_grad()
+                loss_new, g_new = self._eval(closure)
+                evals += 1
+            s = self._flat([p._data for p in self._parameters]) - x0
+            yv = g_new - g
+            if float(jnp.dot(s, yv)) > 1e-10:
+                self._s.append(s)
+                self._y.append(yv)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(loss_new - loss0) < self._tol_change:
+                loss0, g = loss_new, g_new
+                break
+            loss0, g = loss_new, g_new
+            if evals >= self._max_eval:
+                break
+        return Tensor(jnp.asarray(loss0, jnp.float32))
+
+    def _strong_wolfe(self, closure, x0, d, f0, g0, t, c1=1e-4, c2=0.9,
+                      max_ls=10):
+        dg0 = float(jnp.dot(g0, d))
+        evals = 0
+        t_lo, t_hi = 0.0, None
+        f_prev, t_prev = f0, 0.0
+        for _ in range(max_ls):
+            self._set_params(x0 + t * d)
+            for p in self._parameters:
+                p.clear_grad()
+            f_t, g_t = self._eval(closure)
+            evals += 1
+            dg_t = float(jnp.dot(g_t, d))
+            if f_t > f0 + c1 * t * dg0 or f_t >= f_prev:
+                t_hi = t
+                t = (t_lo + t_hi) / 2.0
+            elif abs(dg_t) <= -c2 * dg0:
+                return t, f_t, g_t, evals
+            elif dg_t >= 0:
+                t_hi = t
+                t = (t_lo + t_hi) / 2.0
+            else:
+                t_lo, f_prev, t_prev = t, f_t, t
+                t = t * 2.0 if t_hi is None else (t_lo + t_hi) / 2.0
+        return t, f_t, g_t, evals
+
+    def clear_grad(self):
+        for p in self._parameters:
+            p.clear_grad()
+
+    def state_dict(self):
+        return {"s": [np_array(s) for s in self._s],
+                "y": [np_array(y) for y in self._y]}
+
+
+def np_array(x):
+    import numpy as np
+
+    return np.asarray(x)

@@ -410,3 +410,61 @@ class Profiler:
         table = "\n".join(lines)
         print(table)
         return table
+
+
+class SortedKeys(Enum):
+    """Summary-table sort keys (reference: profiler/profiler.py
+    SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Summary view selection (reference: profiler/profiler.py
+    SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory writing the raw trace dict as a pickled
+    protobuf-stand-in artifact (reference: profiler.py export_protobuf;
+    the chrome-trace JSON remains the interchange format on this
+    runtime)."""
+    import os
+    import pickle
+    import socket
+    import time as _time
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{socket.gethostname()}"
+        path = os.path.join(
+            dir_name,
+            f"{name}_time_{int(_time.time() * 1000)}.paddle_trace.pb")
+        json_path = path + ".json"
+        _get_recorder().export(json_path, name)
+        with open(json_path) as f:
+            trace = json.load(f)
+        os.remove(json_path)
+        with open(path, "wb") as f:
+            pickle.dump(trace, f)
+        prof.last_export_path = path
+
+    return handler
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

@@ -22,3 +22,39 @@ __all__ = [
     "FakeQuanterWithAbsMaxObserver", "ObserveWrapper", "QuantedLinear",
     "fake_quant_dequant", "quant", "dequant",
 ]
+
+from .observers import _Factory, _ObserverBase as BaseObserver  # noqa: F401,E402
+
+
+def quanter(name):
+    """Class decorator registering a custom quanter under ``name`` and
+    giving it a config-time factory (reference:
+    quantization/factory.py quanter)."""
+
+    def deco(cls):
+        import sys
+
+        class _BoundFactory(_Factory):
+            def __init__(self, **kwargs):
+                super().__init__(cls, **kwargs)
+
+        _BoundFactory.__name__ = name
+        setattr(sys.modules[__name__], name, _BoundFactory)
+        return cls
+
+    return deco
+
+
+class BaseQuanter:
+    """Base for trainable fake-quant layers (reference:
+    quantization/base_quanter.py): subclass and implement forward;
+    scales() / zero_points() expose the learned quant params."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+__all__ += ["BaseObserver", "BaseQuanter", "quanter"]

@@ -26,7 +26,9 @@ __all__ = [
     "SparseCsrTensor", "is_same_shape", "add", "subtract", "multiply",
     "divide", "matmul", "masked_matmul", "relu", "abs", "sin", "tanh",
     "sqrt", "square", "pow", "neg", "cast", "transpose", "sum",
-    "coalesce", "nn",
+    "coalesce", "nn", "asin", "asinh", "atan", "atanh", "sinh", "tan",
+    "deg2rad", "rad2deg", "isnan", "reshape", "slice", "mv", "addmm",
+    "pca_lowrank", "expm1", "log1p",
 ]
 
 
@@ -383,3 +385,60 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# -- unary long tail (reference: sparse/unary.py full op list) --------------
+
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan = _unary(jnp.isnan)
+
+
+def reshape(x, shape, name=None):
+    """Reshape via dense roundtrip (pattern changes arbitrarily —
+    reference sparse/unary.py reshape does an index remap; on TPU the
+    dense detour is the XLA-fusable form at these sizes)."""
+    d = _dense(x).reshape(tuple(int(s) for s in shape))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.fromdense(d))
+    return SparseCooTensor(jsparse.BCOO.fromdense(d))
+
+
+def slice(x, axes, starts, ends, name=None):
+    import builtins
+
+    d = _dense(x)
+    idx = [builtins.slice(None)] * d.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[int(ax)] = builtins.slice(int(st), int(en))
+    d = d[tuple(idx)]
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.fromdense(d))
+    return SparseCooTensor(jsparse.BCOO.fromdense(d))
+
+
+def mv(x, vec, name=None):
+    """sparse [M, N] @ dense vector [N] -> dense [M] (reference:
+    sparse/matmul.py mv)."""
+    out = x._sp @ jnp.asarray(unwrap(vec))
+    return wrap(out.todense() if hasattr(out, "todense") else out)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference: sparse/matmul.py addmm)."""
+    prod = _dense(x) @ _dense(y)
+    return wrap(beta * _dense(input) + alpha * prod)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA of a sparse matrix via the dense linalg path
+    (reference: sparse/multiary.py pca_lowrank)."""
+    from .. import linalg as _linalg
+    return _linalg.pca_lowrank(wrap(_dense(x)), q=q, center=center,
+                               niter=niter)

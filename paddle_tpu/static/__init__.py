@@ -43,8 +43,13 @@ __all__ = [
     "save_inference_model", "load_inference_model", "InputSpec",
     "global_scope", "scope_guard", "name_scope", "cpu_places", "Variable",
     "PassManager", "constant_folding", "dead_code_elimination",
-    "prune_for_fetch",
+    "prune_for_fetch", "nn",
 ]
+
+from .compat import *  # noqa: F401,F403,E402
+from .compat import __all__ as _compat_all
+
+__all__ += list(_compat_all)
 
 Variable = Var
 
@@ -288,3 +293,6 @@ def load_inference_model(path_prefix: str, executor, **kwargs):
         meta = pickle.load(f)
     prog = _LoadedProgram(exported, meta["feed_names"], meta["fetch_names"])
     return [prog, meta["feed_names"], meta["fetch_names"]]
+
+
+from . import nn  # noqa: E402  (static.nn layer builders)

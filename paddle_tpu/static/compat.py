@@ -1,0 +1,373 @@
+"""Static-graph surface completion: program/persistable serialization,
+parameter builders, gradients, metrics, EMA, CompiledProgram,
+device/py_func utilities.
+
+Reference capability: python/paddle/static/io.py (save/load/serialize/
+normalize), python/paddle/static/nn/common.py (create_parameter),
+base/backward.py gradients, incubate ExponentialMovingAverage,
+static/amp WeightNormParamAttr, compiler.py (BuildStrategy,
+CompiledProgram), base/layers Print/py_func/device_guard.
+
+TPU-native notes: a Program here is a recorded pure-op graph compiled by
+XLA at Executor.run; serialization uses the same StableHLO-artifact path
+as jit.save, and "persistables" are the eager Parameters the build
+captured (state_dict-style npz)."""
+from __future__ import annotations
+
+import contextlib
+import io as _io
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "create_parameter", "create_global_var", "gradients", "py_func",
+    "Print", "device_guard", "accuracy", "auc", "BuildStrategy",
+    "CompiledProgram", "ExponentialMovingAverage", "WeightNormParamAttr",
+    "cuda_places", "xpu_places", "save", "load", "save_to_file",
+    "load_from_file", "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables",
+    "normalize_program", "load_program_state", "set_program_state",
+    "ctr_metric_bundle", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard",
+]
+
+
+# -- parameter/var builders (reference: static/nn/common.py) ----------------
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import Constant, XavierNormal
+
+    init = default_initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    data = init(tuple(int(s) for s in shape), convert_dtype(dtype))
+    p = Parameter(data)
+    p.name = name or f"create_parameter_{id(p)}"
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    v = Parameter(jnp.full(tuple(int(s) for s in shape), value,
+                           convert_dtype(dtype)))
+    v.name = name or f"global_var_{id(v)}"
+    v.stop_gradient = True
+    return v
+
+
+# -- gradients (reference: base/backward.py gradients) ----------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic-gradient parity: returns grads of targets w.r.t. inputs.
+    On this runtime the recorded program is differentiable eagerly, so
+    this is paddle.grad in static clothing."""
+    from .. import autograd
+
+    grads = autograd.grad(targets, inputs,
+                          grad_outputs=target_gradients,
+                          retain_graph=True, allow_unused=True)
+    return grads
+
+
+# -- host-callback ops ------------------------------------------------------
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python op (reference: base/layers/nn.py py_func). Eager
+    runtime: call through immediately; ``out`` gives the result template.
+    """
+    res = func(*x) if isinstance(x, (list, tuple)) else func(x)
+    return res if res is not None else out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference: static/nn/control_flow.py Print):
+    prints and forwards the tensor."""
+    arr = input.numpy() if hasattr(input, "numpy") else np.asarray(input)
+    parts = []
+    if message:
+        parts.append(message)
+    if print_tensor_name and getattr(input, "name", None):
+        parts.append(f"name: {input.name}")
+    if print_tensor_shape:
+        parts.append(f"shape: {list(arr.shape)}")
+    if print_tensor_type:
+        parts.append(f"dtype: {arr.dtype}")
+    flat = np.asarray(arr).reshape(-1)[:summarize]
+    parts.append(f"data: {flat}")
+    print("  ".join(str(p) for p in parts))
+    return input
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference: static device_guard — pins ops to a device in the
+    program. Placement is XLA's under this runtime; the guard is recorded
+    for API parity and otherwise inert."""
+    yield
+
+
+# -- static metrics (reference: static/nn/metric.py) ------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC (reference: static/nn/metric.py auc). Returns
+    (auc_value, batch_auc, [state placeholders])."""
+    from ..metric import Auc as _Auc
+
+    m = _Auc(num_thresholds=num_thresholds, curve=curve)
+    m.update(input, label)
+    v = Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+    return v, v, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle serves the parameter-server CTR pipeline, "
+        "which is out of scope on this runtime (docs/CAPABILITY_DELTA.md)")
+
+
+# -- EMA (reference: static/ExponentialMovingAverage) -----------------------
+
+class ExponentialMovingAverage:
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._params = None
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+
+    def _ensure(self):
+        if self._params is None:
+            from . import default_main_program
+
+            self._params = list(default_main_program()._params())
+
+    def update(self):
+        self._ensure()
+        self._step += 1
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            prev = self._ema.get(id(p), p._data)
+            self._ema[id(p)] = d * prev + (1.0 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._ensure()
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            if id(p) in self._ema:
+                p._data = self._ema[id(p)].astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+class WeightNormParamAttr:
+    """Weight-normalized parameter attribute (reference:
+    static/WeightNormParamAttr). Carries dim + the usual ParamAttr
+    fields; nn.utils.weight_norm applies the reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+# -- compiled program / strategies ------------------------------------------
+
+class BuildStrategy:
+    """Graph-build knobs (reference: compiler.py BuildStrategy). XLA owns
+    fusion/scheduling here, so the knobs record and report but the
+    compiled result is always the fused XLA program."""
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.build_cuda_graph = False
+
+    def __repr__(self):
+        return f"BuildStrategy({self.__dict__})"
+
+
+class CompiledProgram:
+    """reference: compiler.py CompiledProgram — wraps a Program with a
+    build strategy. Executor.run accepts it transparently."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_program"), item)
+
+
+# -- places -----------------------------------------------------------------
+
+def cuda_places(device_ids=None):
+    """Accelerator place list (TPU chips under this runtime)."""
+    from ..framework.compat import CUDAPlace
+
+    n = len(jax.devices())
+    ids = range(n) if device_ids is None else device_ids
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# -- program/persistable serialization (reference: static/io.py) ------------
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the feed->fetch slice (reference io.py normalize_program).
+    Programs here are already pure recorded graphs; pruning = pass."""
+    from .passes import prune_for_fetch
+
+    return prune_for_fetch(program, fetch_vars)
+
+
+def _owning_program(vars_, fallback=None):
+    for v in vars_ or []:
+        sym = getattr(v, "_symbolic", v)
+        prog = getattr(sym, "program", None)
+        if prog is not None:
+            return prog
+    if fallback is not None:
+        return fallback
+    from . import default_main_program
+
+    return default_main_program()
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    prog = _owning_program(list(fetch_vars or []) + list(feed_vars or []))
+    return pickle.dumps({"kind": "paddle_tpu_program",
+                         "program": prog._serializable(fetch_vars)})
+
+
+def deserialize_program(data):
+    from .ir import _program_from_serializable
+
+    payload = pickle.loads(data)
+    if payload.get("kind") != "paddle_tpu_program":
+        raise ValueError("not a serialized paddle_tpu program")
+    return _program_from_serializable(payload["program"])
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    prog = _owning_program(list(fetch_vars or []) + list(feed_vars or []))
+    state = {f"p{i}": np.asarray(p._data)
+             for i, p in enumerate(prog._params())}
+    buf = _io.BytesIO()
+    np.savez(buf, **state)
+    return buf.getvalue()
+
+
+def deserialize_persistables(program, data, executor=None):
+    buf = _io.BytesIO(data)
+    loaded = np.load(buf)
+    for i, p in enumerate(program._params()):
+        key = f"p{i}"
+        if key in loaded:
+            p._data = jnp.asarray(loaded[key]).astype(p._data.dtype)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_prefix, protocol=4, **configs):
+    """Save params + program (reference: static/io.py save →
+    .pdparams/.pdmodel pair)."""
+    state = {f"p{i}": np.asarray(p._data)
+             for i, p in enumerate(program._params())}
+    with open(model_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    with open(model_prefix + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for i, p in enumerate(program._params()):
+        key = f"p{i}"
+        if key in state:
+            p._data = jnp.asarray(state[key]).astype(p._data.dtype)
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    return state
+
+
+def set_program_state(program, state_dict):
+    for i, p in enumerate(program._params()):
+        key = f"p{i}"
+        if key in state_dict:
+            p._data = jnp.asarray(state_dict[key]).astype(p._data.dtype)
+
+
+# -- IPU (unsupported hardware: explicit gate, reference static/ipu) --------
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU hardware is not supported by this TPU-native runtime "
+            "(docs/CAPABILITY_DELTA.md)")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU hardware is not supported by this TPU-native runtime "
+            "(docs/CAPABILITY_DELTA.md)")
+
+
+def ipu_shard_guard(*a, **k):
+    raise NotImplementedError(
+        "IPU hardware is not supported by this TPU-native runtime")
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError(
+        "IPU hardware is not supported by this TPU-native runtime")

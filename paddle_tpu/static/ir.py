@@ -259,3 +259,110 @@ class Program:
             self._jit_cache[key] = entry
         jitted, refs = entry
         return jitted(feed_arrays, [r.param._data for r in refs])
+
+
+# -- serialization (reference: static/io.py serialize_program) --------------
+
+def _program_params(program) -> list:
+    """Distinct live Parameters in first-use order."""
+    return [r.param for r in program.param_refs()]
+
+
+def _program_serializable(program, fetch_vars=None) -> dict:
+    """A picklable description of the program: op fns resolve by module
+    path, parameters materialize to numpy (re-bound on load)."""
+    import numpy as _np
+
+    params = _program_params(program)
+    pidx = {id(p): i for i, p in enumerate(params)}
+
+    def enc(entry):
+        if isinstance(entry, _ParamRef):
+            return ("__param__", pidx[id(entry.param)])
+        if isinstance(entry, Var):
+            return ("__var__", entry.name)
+        if hasattr(entry, "dtype") and hasattr(entry, "shape") \
+                and not isinstance(entry, (int, float, bool)):
+            try:
+                return ("__array__", _np.asarray(entry))
+            except Exception:
+                return ("__raw__", entry)
+        return ("__raw__", entry)
+
+    ops_out = []
+    for op in program.global_block.ops:
+        ops_out.append({
+            "type": op.type,
+            "fn": f"{op.fn.__module__}:{op.fn.__qualname__}",
+            "arg_template": [enc(e) for e in op.arg_template],
+            "var_positions": list(op.var_positions),
+            "kwargs": {k: enc(v) for k, v in op.kwargs.items()},
+            "inputs": [v.name for v in op.inputs],
+            "outputs": [(v.name, list(v.shape), str(v.dtype), v.slot)
+                        for v in op.outputs],
+        })
+    return {
+        "feeds": {k: (list(v.shape), str(v.dtype), list(v.none_axes))
+                  for k, v in program.feed_vars.items()},
+        "params": [_np.asarray(p._data) for p in params],
+        "ops": ops_out,
+        "fetch": [getattr(f, "_symbolic", f).name for f in fetch_vars]
+        if fetch_vars else [],
+    }
+
+
+def _program_from_serializable(payload) -> "Program":
+    import importlib
+
+    import jax.numpy as _jnp
+
+    from ..core.tensor import Parameter
+
+    prog = Program()
+    params = [Parameter(_jnp.asarray(a)) for a in payload["params"]]
+    for name, (shape, dtype, none_axes) in payload["feeds"].items():
+        var = Var(name, shape, dtype, prog, none_axes=tuple(none_axes))
+        prog.feed_vars[name] = var
+        prog.global_block.vars[name] = var
+
+    def dec(entry):
+        tag, val = entry
+        if tag == "__param__":
+            return _ParamRef(params[val])
+        if tag == "__var__":
+            return prog.global_block.vars[val]
+        if tag == "__array__":
+            return _jnp.asarray(val)
+        return val
+
+    for od in payload["ops"]:
+        mod_name, qual = od["fn"].split(":")
+        fn = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            fn = getattr(fn, part)
+        outputs = []
+        for name, shape, dtype, slot in od["outputs"]:
+            var = Var(name, shape, dtype, prog, slot=slot)
+            prog.global_block.vars[name] = var
+            outputs.append(var)
+        inputs = [prog.global_block.vars[n] for n in od["inputs"]]
+        op = Operator(od["type"], fn,
+                      [dec(e) for e in od["arg_template"]],
+                      od["var_positions"],
+                      {k: dec(v) for k, v in od["kwargs"].items()},
+                      inputs, outputs)
+        for v in outputs:
+            v.producer = op
+        prog.global_block.ops.append(op)
+    prog._loaded_fetch = [prog.global_block.vars[n]
+                          for n in payload.get("fetch", [])]
+    return prog
+
+
+def _install_serialization():
+    Program._params = lambda self: _program_params(self)
+    Program._serializable = \
+        lambda self, fetch_vars=None: _program_serializable(self, fetch_vars)
+
+
+_install_serialization()

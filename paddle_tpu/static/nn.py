@@ -1,0 +1,368 @@
+"""paddle.static.nn: static-graph layer builders.
+
+Reference capability: python/paddle/static/nn/common.py (fc, conv2d,
+batch_norm, ...), control_flow.py (cond/case/switch_case/while_loop,
+static_pylayer), sequence_lod.py (sequence_* — LoD-era ops).
+
+TPU-native redesign: under program_guard every eager op records into the
+Program, so these builders simply instantiate the corresponding nn Layer
+(parameters are created eagerly, exactly like the reference's
+startup-program initialization) and call it on the symbolic input.
+Control flow delegates to lax.cond/scan through the recorded pure fns.
+LoD sequence ops are parameter-server-era (docs/CAPABILITY_DELTA.md) and
+raise with that pointer.
+"""
+from __future__ import annotations
+
+from .. import nn as _nn
+from .compat import py_func  # noqa: F401  (re-export, reference parity)
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate",
+]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import ops
+
+    if num_flatten_dims != 1:
+        x = ops.flatten(x, start_axis=num_flatten_dims)
+    in_f = x.shape[-1]
+    layer = _nn.Linear(in_f, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, **kwargs):
+    raise NotImplementedError(
+        "sparse_embedding targets the parameter-server distributed table "
+        "(out of scope — docs/CAPABILITY_DELTA.md); use static.nn.embedding")
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    ch_axis = 1 if data_layout == "NCHW" else -1
+    ch = input.shape[ch_axis]
+    layer = _nn.BatchNorm(ch, momentum=momentum, epsilon=epsilon,
+                          param_attr=param_attr, bias_attr=bias_attr,
+                          data_layout=data_layout,
+                          use_global_stats=use_global_stats,
+                          is_test=is_test)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = input.shape[begin_norm_axis:]
+    layer = _nn.LayerNorm(list(shape), epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    ch = input.shape[1 if data_layout == "NCHW" else -1]
+    layer = _nn.GroupNorm(groups, ch, epsilon=epsilon,
+                          weight_attr=param_attr, bias_attr=bias_attr,
+                          data_format=data_layout)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    ch = input.shape[1]
+    layer = _nn.InstanceNorm2D(ch, epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Normalize by running statistics without learned affine (reference:
+    static/nn/common.py data_norm, a PS-era CTR layer). Approximated by
+    instance statistics here."""
+    from .. import ops
+
+    mean = ops.mean(input, axis=0, keepdim=True)
+    var = ops.mean((input - mean) ** 2, axis=0, keepdim=True)
+    out = (input - mean) / ops.sqrt(var + epsilon)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    ch = input.shape[1 if data_format == "NCHW" else -1]
+    layer = _nn.Conv2D(ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    ch = input.shape[1 if data_format == "NCDHW" else -1]
+    layer = _nn.Conv3D(ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    ch = input.shape[1 if data_format == "NCHW" else -1]
+    layer = _nn.Conv2DTranspose(ch, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    ch = input.shape[1 if data_format == "NCDHW" else -1]
+    layer = _nn.Conv3DTranspose(ch, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import DeformConv2D
+
+    ch = x.shape[1]
+    layer = DeformConv2D(ch, num_filters, filter_size, stride=stride,
+                         padding=padding, dilation=dilation, groups=groups,
+                         deformable_groups=deformable_groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1 if data_format == "NCHW" else -1]
+    else:
+        import numpy as np
+
+        num = int(np.prod(x.shape[1:]))
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                      data_format=data_format)
+    return layer(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    layer = _nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    layer = _nn.SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                             eps=eps)
+    return layer(weight)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference: static/nn/common.py row_conv,
+    DeepSpeech2). [B, T, D] with a (future_context+1, D) filter."""
+    from .. import ops
+    from ..core.tensor import Parameter
+    from ..nn.initializer import XavierNormal
+    import jax.numpy as jnp
+
+    d = input.shape[-1]
+    k = future_context_size + 1
+    w = Parameter(XavierNormal()((k, d)))
+
+    def _row(x, w):
+        pads = [(0, 0), (0, k - 1), (0, 0)]
+        xp = jnp.pad(x, pads)
+        out = 0.0
+        for i in range(k):
+            out = out + xp[:, i:i + x.shape[1]] * w[i]
+        return out
+
+    from ..ops._op import op_fn
+
+    out = op_fn(name="row_conv")(_row)(input, w)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    raise NotImplementedError(
+        "nce rides the PS-era sampled-softmax tables; use "
+        "paddle.nn.functional.margin_cross_entropy or hsigmoid_loss "
+        "(docs/CAPABILITY_DELTA.md)")
+
+
+# -- control flow (lax-native) ----------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    from .. import ops
+    from ..ops._op import unwrap, wrap
+    import jax
+
+    p = unwrap(pred)
+    # eager/static both: route through lax.cond on the recorded path
+    import jax.numpy as jnp
+
+    if hasattr(p, "item") and not isinstance(p, jax.core.Tracer):
+        return true_fn() if bool(p) else false_fn()
+    return jax.lax.cond(p.reshape(()), lambda _: true_fn(),
+                        lambda _: false_fn(), operand=None)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        arr = pred.numpy() if hasattr(pred, "numpy") else pred
+        if bool(arr):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(branch_index.numpy()) if hasattr(branch_index, "numpy") \
+        else int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Host-driven while loop with the reference signature. Eager: plain
+    python loop (each iteration's ops run/record); for a fused device
+    loop use jax.lax.while_loop inside a jitted fn."""
+    vars_ = list(loop_vars)
+    while bool(cond(*vars_).numpy()):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference: control_flow.py static_pylayer — custom forward/backward
+    pair inside a static program. Routed through the eager PyLayer."""
+    from ..autograd import PyLayer
+
+    class _Static(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            out = forward_fn(*args)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            if backward_fn is None:
+                raise RuntimeError("static_pylayer: no backward_fn")
+            return backward_fn(*grads)
+
+    return _Static.apply(*inputs)
+
+
+# -- LoD sequence ops (PS/LoD-era; see docs/CAPABILITY_DELTA.md) ------------
+
+def _lod_gate(name):
+    def stub(*args, **kwargs):
+        raise NotImplementedError(
+            f"sequence op '{name}' depends on LoD tensors, a retired "
+            "representation (docs/CAPABILITY_DELTA.md). Use dense padded "
+            "batches with paddle.nn.functional.sequence_mask / varlen "
+            "flash attention instead.")
+    stub.__name__ = name
+    return stub
+
+
+sequence_conv = _lod_gate("sequence_conv")
+sequence_softmax = _lod_gate("sequence_softmax")
+sequence_pool = _lod_gate("sequence_pool")
+sequence_first_step = _lod_gate("sequence_first_step")
+sequence_last_step = _lod_gate("sequence_last_step")
+sequence_slice = _lod_gate("sequence_slice")
+sequence_expand = _lod_gate("sequence_expand")
+sequence_expand_as = _lod_gate("sequence_expand_as")
+sequence_pad = _lod_gate("sequence_pad")
+sequence_unpad = _lod_gate("sequence_unpad")
+sequence_reshape = _lod_gate("sequence_reshape")
+sequence_scatter = _lod_gate("sequence_scatter")
+sequence_enumerate = _lod_gate("sequence_enumerate")

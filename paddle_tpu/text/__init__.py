@@ -16,7 +16,12 @@ from ..core.tensor import Tensor
 from ..nn.layer.base import Layer
 from ..ops._op import op_fn, unwrap, wrap
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa
+                       UCIHousing, WMT14, WMT16)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Conll05st",
+           "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+           "WMT16"]
 
 
 @op_fn(name="viterbi_decode", differentiable=False)

@@ -3,3 +3,55 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+
+
+# -- image backend selection (reference: vision/image.py) -------------------
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """reference: vision/image.py set_image_backend — 'pil', 'cv2', or
+    'tensor' selects what image_load / dataset loaders return."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"image backend must be pil/cv2/tensor, got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file via the selected backend (reference:
+    vision/image.py image_load)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        try:
+            import cv2
+
+            return cv2.imread(path)
+        except ImportError as e:
+            raise RuntimeError("cv2 backend requested but OpenCV is not "
+                               "installed") from e
+    try:
+        from PIL import Image
+
+        img = Image.open(path)
+        if backend == "pil":
+            return img
+        import numpy as _np
+
+        from ..core.tensor import Tensor
+        arr = _np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return Tensor(arr.transpose(2, 0, 1))
+    except ImportError as e:
+        raise RuntimeError(
+            "image_load needs Pillow for the pil/tensor backends") from e
+
+
+__all__ = ["models", "ops", "transforms", "datasets", "set_image_backend",
+           "get_image_backend", "image_load"]

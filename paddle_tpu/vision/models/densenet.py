@@ -1,0 +1,122 @@
+"""DenseNet (reference: vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return ops.concat([x, out], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(in_c + i * growth_rate, growth_rate, bn_size,
+                        dropout) for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(
+            nn.BatchNorm2D(in_c),
+            nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.AvgPool2D(2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        num_init, growth_rate, block_cfg = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        c = num_init
+        for i, n in enumerate(block_cfg):
+            blocks.append(_DenseBlock(n, c, growth_rate, bn_size, dropout))
+            c = c + n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c = c // 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(c)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm_final(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, start_axis=1)
+            x = self.fc(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access, unavailable here")
+    return DenseNet(layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

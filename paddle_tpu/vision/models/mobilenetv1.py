@@ -1,0 +1,70 @@
+"""MobileNetV1 (reference: vision/models/mobilenetv1.py) — depthwise
+separable convs; the depthwise step is a grouped conv XLA maps directly."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class ConvBNRelu(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, padding=1, groups=1):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU())
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c1, out_c2, scale, stride):
+        super().__init__()
+        c1 = int(out_c1 * scale)
+        c2 = int(out_c2 * scale)
+        self.dw = ConvBNRelu(in_c, c1, stride=stride, groups=in_c)
+        self.pw = ConvBNRelu(c1, c2, kernel=1, padding=0)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)  # noqa: E731
+        self.conv1 = ConvBNRelu(3, s(32), stride=2)
+        cfg = [
+            (s(32), 32, 64, 1), (s(64), 64, 128, 2),
+            (s(128), 128, 128, 1), (s(128), 128, 256, 2),
+            (s(256), 256, 256, 1), (s(256), 256, 512, 2),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 1024, 2),
+            (s(1024), 1024, 1024, 1),
+        ]
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(in_c, c1, c2, scale, st)
+            for in_c, c1, c2, st in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, start_axis=1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access, unavailable here")
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,150 @@
+"""ShuffleNetV2 (reference: vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = x.reshape([n, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([n, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride, groups=1, act="relu"):
+        pad = kernel // 2
+        layers = [nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=pad,
+                            groups=groups, bias_attr=False),
+                  nn.BatchNorm2D(out_c)]
+        if act:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class ConvBN(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride, groups=1):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=kernel // 2,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c))
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                ConvBNAct(branch, branch, 1, 1, act=act),
+                ConvBN(branch, branch, 3, 1, groups=branch),
+                ConvBNAct(branch, branch, 1, 1, act=act))
+        else:
+            self.branch1 = nn.Sequential(
+                ConvBN(in_c, in_c, 3, stride, groups=in_c),
+                ConvBNAct(in_c, branch, 1, 1, act=act))
+            self.branch2 = nn.Sequential(
+                ConvBNAct(in_c, branch, 1, 1, act=act),
+                ConvBN(branch, branch, 3, stride, groups=branch),
+                ConvBNAct(branch, branch, 1, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = ops.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = _STAGE_OUT[scale]
+        repeats = [4, 8, 4]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = ConvBNAct(3, cfg[0], 3, 2, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = cfg[0]
+        for i, rep in enumerate(repeats):
+            out_c = cfg[i + 1]
+            stage = [InvertedResidual(in_c, out_c, 2, act)]
+            for _ in range(rep - 1):
+                stage.append(InvertedResidual(out_c, out_c, 1, act))
+            stages.append(nn.Sequential(*stage))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = ConvBNAct(in_c, cfg[-1], 1, 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(cfg[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, start_axis=1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access, unavailable here")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

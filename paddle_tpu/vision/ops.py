@@ -586,3 +586,327 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              self.stride, self.padding, self.dilation,
                              self.deformable_groups, self.groups, mask)
+
+
+# ---------------------------------------------------------------------------
+# image IO (reference: ops.py read_file/decode_jpeg) + detection long tail
+# ---------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    """Read raw file bytes as a uint8 tensor (reference: ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return wrap(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference: ops.py
+    decode_jpeg, nvjpeg kernel). Host-side decode via Pillow/matplotlib —
+    image IO is input-pipeline work, not device work."""
+    raw = bytes(np.asarray(unwrap(x)).astype(np.uint8).tobytes())
+    import io as _io
+    arr = None
+    try:
+        from PIL import Image
+
+        img = Image.open(_io.BytesIO(raw))
+        if mode == "gray":
+            img = img.convert("L")
+        elif mode == "rgb":
+            img = img.convert("RGB")
+        arr = np.asarray(img)
+    except ImportError:
+        try:
+            import matplotlib.image as mpimg
+
+            arr = mpimg.imread(_io.BytesIO(raw), format="jpeg")
+            if arr.dtype != np.uint8:
+                arr = (arr * 255).astype(np.uint8)
+        except ImportError as e:
+            raise RuntimeError(
+                "decode_jpeg needs Pillow or matplotlib for host-side "
+                "decode; neither is importable") from e
+    if arr.ndim == 2:
+        arr = arr[None]                    # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)       # [C, H, W]
+    return wrap(jnp.asarray(arr))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference: ops.py matrix_nms, SOLOv2): soft decay of
+    scores by pairwise IoU — fully vectorized (no sequential suppression),
+    which is exactly the TPU-friendly formulation."""
+    bx = np.asarray(unwrap(bboxes)).astype(np.float64)   # [N, M, 4]
+    sc = np.asarray(unwrap(scores)).astype(np.float64)   # [N, C, M]
+    n, c, m = sc.shape
+    out_rois, out_idx, out_num = [], [], []
+    norm = 0.0 if normalized else 1.0
+    for b in range(n):
+        dets, idxs = [], []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[b, cls]
+            keep = np.flatnonzero(s > score_threshold)
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes = bx[b, order]
+            ss = s[order]
+            # pairwise IoU of the sorted candidates
+            x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
+            y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
+            x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
+            y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
+            inter = (np.clip(x2 - x1 + norm, 0, None)
+                     * np.clip(y2 - y1 + norm, 0, None))
+            area = ((boxes[:, 2] - boxes[:, 0] + norm)
+                    * (boxes[:, 3] - boxes[:, 1] + norm))
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, k=1)
+            iou_cmax = iou.max(axis=0)                    # [k] per candidate
+            # decay_j = min over suppressors i of f(iou[i,j]) / f(cmax[i])
+            # (cmax indexed by the suppressor ROW — SOLOv2 eq. 5)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1.0 - iou) / np.maximum(1.0 - iou_cmax[:, None],
+                                                  1e-10)).min(axis=0)
+            decayed = ss * decay
+            ok = decayed >= post_threshold
+            for j in np.flatnonzero(ok):
+                dets.append([cls, decayed[j], *boxes[j]])
+                idxs.append(order[j] + b * m)
+        if dets:
+            dets = np.asarray(dets)
+            srt = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[srt]
+            idxs = np.asarray(idxs)[srt]
+        else:
+            dets = np.zeros((0, 6))
+            idxs = np.zeros((0,), np.int64)
+        out_rois.append(dets)
+        out_idx.append(idxs)
+        out_num.append(len(dets))
+    rois = wrap(jnp.asarray(np.concatenate(out_rois)
+                            if out_rois else np.zeros((0, 6)),
+                            jnp.float32))
+    res = (rois,)
+    if return_index:
+        res = res + (wrap(jnp.asarray(np.concatenate(out_idx).astype(
+            np.int64) if out_idx else np.zeros(0, np.int64))),)
+    if return_rois_num:
+        res = res + (wrap(jnp.asarray(np.asarray(out_num, np.int32))),)
+    return res if len(res) > 1 else res[0]
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: ops.py generate_proposals):
+    decode anchors by deltas, clip, filter small, NMS per image."""
+    sc = np.asarray(unwrap(scores))          # [N, A, H, W]
+    bd = np.asarray(unwrap(bbox_deltas))     # [N, 4A, H, W]
+    ims = np.asarray(unwrap(img_size))       # [N, 2] (h, w)
+    anc = np.asarray(unwrap(anchors)).reshape(-1, 4)      # [AHW?, 4]
+    var = np.asarray(unwrap(variances)).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+    rois_out, num_out, score_out = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)              # [HWA]
+        d = bd[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # anchors arrive [H, W, A, 4] flattened
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_top, d_top = s[order], d[order]
+        anc_top, var_top = anc[order], var[order]
+        aw = anc_top[:, 2] - anc_top[:, 0] + offset
+        ah = anc_top[:, 3] - anc_top[:, 1] + offset
+        acx = anc_top[:, 0] + aw * 0.5
+        acy = anc_top[:, 1] + ah * 0.5
+        cx = var_top[:, 0] * d_top[:, 0] * aw + acx
+        cy = var_top[:, 1] * d_top[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(var_top[:, 2] * d_top[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(var_top[:, 3] * d_top[:, 3], 10.0))
+        px1 = cx - bw * 0.5
+        py1 = cy - bh * 0.5
+        px2 = cx + bw * 0.5 - offset
+        py2 = cy + bh * 0.5 - offset
+        ih, iw = ims[b]
+        px1 = np.clip(px1, 0, iw - offset)
+        py1 = np.clip(py1, 0, ih - offset)
+        px2 = np.clip(px2, 0, iw - offset)
+        py2 = np.clip(py2, 0, ih - offset)
+        keep = np.flatnonzero(((px2 - px1 + offset) >= min_size)
+                              & ((py2 - py1 + offset) >= min_size))
+        props = np.stack([px1, py1, px2, py2], axis=1)[keep]
+        ps = s_top[keep]
+        # greedy hard NMS
+        order2 = np.argsort(-ps)
+        sel = []
+        while order2.size:
+            i = order2[0]
+            sel.append(i)
+            if len(sel) >= post_nms_top_n:
+                break
+            rest = order2[1:]
+            xx1 = np.maximum(props[i, 0], props[rest, 0])
+            yy1 = np.maximum(props[i, 1], props[rest, 1])
+            xx2 = np.minimum(props[i, 2], props[rest, 2])
+            yy2 = np.minimum(props[i, 3], props[rest, 3])
+            inter = (np.clip(xx2 - xx1 + offset, 0, None)
+                     * np.clip(yy2 - yy1 + offset, 0, None))
+            a1 = ((props[i, 2] - props[i, 0] + offset)
+                  * (props[i, 3] - props[i, 1] + offset))
+            a2 = ((props[rest, 2] - props[rest, 0] + offset)
+                  * (props[rest, 3] - props[rest, 1] + offset))
+            iou = inter / np.maximum(a1 + a2 - inter, 1e-10)
+            order2 = rest[iou <= nms_thresh]
+        rois_out.append(props[sel])
+        score_out.append(ps[sel])
+        num_out.append(len(sel))
+    rois = wrap(jnp.asarray(np.concatenate(rois_out) if rois_out
+                            else np.zeros((0, 4)), jnp.float32))
+    rscores = wrap(jnp.asarray(np.concatenate(score_out) if score_out
+                               else np.zeros((0,)), jnp.float32))
+    if return_rois_num:
+        return rois, rscores, wrap(jnp.asarray(
+            np.asarray(num_out, np.int32)))
+    return rois, rscores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: ops.py yolo_loss; phi
+    yolov3_loss kernel): decode predictions, match ground truth to the
+    best anchor, sum coordinate + objectness + class losses. Pure jnp —
+    differentiable end to end (taped through the op dispatcher)."""
+    if gt_score is None:
+        gs = jnp.ones(unwrap(gt_label).shape, jnp.float32)
+        gt_score = wrap(gs)
+    return _yolo_loss_op(x, gt_box, gt_label, gt_score,
+                         anchors=tuple(anchors),
+                         anchor_mask=tuple(anchor_mask),
+                         class_num=int(class_num),
+                         ignore_thresh=float(ignore_thresh),
+                         downsample_ratio=int(downsample_ratio),
+                         use_label_smooth=bool(use_label_smooth),
+                         scale_x_y=float(scale_x_y))
+
+
+@op_fn(name="yolo_loss_op", nondiff_args=(1, 2, 3))
+def _yolo_loss_op(xa, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                  class_num, ignore_thresh, downsample_ratio,
+                  use_label_smooth, scale_x_y):
+    gb = gt_box.astype(jnp.float32)              # [N, B, 4] (cx cy w h)
+    gl = gt_label                                # [N, B]
+    gsc = gt_score.astype(jnp.float32)           # [N, B] (mixup weights)
+    n, _, h, w = xa.shape
+    na = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]
+    pred = xa.reshape(n, na, 5 + class_num, h, w)
+    # scale_x_y (YOLOv4 grid sensitivity): x*s - 0.5*(s-1)
+    px = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+    py = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+    pw = pred[:, :, 2]
+    ph_ = pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]
+    input_size = downsample_ratio * h
+
+    gx = gb[..., 0]                              # normalized cx
+    gy = gb[..., 1]
+    gw = gb[..., 2]
+    gh = gb[..., 3]
+    valid = (gw > 0) & (gl >= 0)
+    gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+    # best anchor per gt by wh IoU against ALL anchors (reference rule),
+    # then only gts whose best anchor is in this level's mask contribute
+    gwh = jnp.stack([gw * input_size, gh * input_size], -1)  # [N,B,2]
+    inter = (jnp.minimum(gwh[..., None, 0], an_all[None, None, :, 0])
+             * jnp.minimum(gwh[..., None, 1], an_all[None, None, :, 1]))
+    union = (gwh[..., 0] * gwh[..., 1])[..., None] \
+        + (an_all[:, 0] * an_all[:, 1])[None, None] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,B]
+    mask_arr = jnp.asarray(anchor_mask)
+    in_level = (best[..., None] == mask_arr[None, None]).any(-1) & valid
+    level_idx = jnp.argmax(
+        best[..., None] == mask_arr[None, None], axis=-1)   # anchor slot
+
+    bidx = jnp.arange(n)[:, None].repeat(gb.shape[1], 1)
+    tx = gx * w - gi
+    ty = gy * h - gj
+    tw = jnp.log(jnp.maximum(gwh[..., 0], 1e-9)
+                 / an[level_idx][..., 0])
+    th = jnp.log(jnp.maximum(gwh[..., 1], 1e-9)
+                 / an[level_idx][..., 1])
+    scale = 2.0 - gw * gh                         # box size weighting
+
+    sel = (bidx, level_idx, gj, gi)
+    wsel = jnp.where(in_level, scale, 0.0)
+    loss_xy = (wsel * ((px[sel] - tx) ** 2 + (py[sel] - ty) ** 2)).sum(1)
+    loss_wh = (wsel * ((pw[sel] - jnp.where(in_level, tw, 0.0)) ** 2
+                       + (ph_[sel] - jnp.where(in_level, th, 0.0)) ** 2)
+               ).sum(1)
+    # objectness: positives at assigned cells (weighted by gt_score for
+    # mixup); negatives elsewhere EXCEPT cells whose predicted box
+    # overlaps any gt above ignore_thresh (reference noobj_mask rule)
+    obj_t = jnp.zeros((n, na, h, w))
+    obj_t = obj_t.at[sel].max(jnp.where(in_level, gsc, 0.0))
+    # decode predicted boxes (normalized) for the ignore-mask IoU
+    cell_x = (jnp.arange(w)[None, None, None, :] + px) / w
+    cell_y = (jnp.arange(h)[None, None, :, None] + py) / h
+    bw_p = jnp.exp(jnp.clip(pw, -10, 10)) * an[None, :, 0, None, None] \
+        / input_size
+    bh_p = jnp.exp(jnp.clip(ph_, -10, 10)) * an[None, :, 1, None, None] \
+        / input_size
+    # IoU of every cell box against every gt: [N, na, h, w, B]
+    px1 = cell_x - bw_p / 2
+    px2 = cell_x + bw_p / 2
+    py1 = cell_y - bh_p / 2
+    py2 = cell_y + bh_p / 2
+    qx1 = (gx - gw / 2)[:, None, None, None, :]
+    qx2 = (gx + gw / 2)[:, None, None, None, :]
+    qy1 = (gy - gh / 2)[:, None, None, None, :]
+    qy2 = (gy + gh / 2)[:, None, None, None, :]
+    iw = jnp.maximum(jnp.minimum(px2[..., None], qx2)
+                     - jnp.maximum(px1[..., None], qx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(py2[..., None], qy2)
+                     - jnp.maximum(py1[..., None], qy1), 0.0)
+    inter_c = iw * ih
+    area_p = (bw_p * bh_p)[..., None]
+    area_g = (gw * gh)[:, None, None, None, :]
+    iou_c = inter_c / jnp.maximum(area_p + area_g - inter_c, 1e-10)
+    iou_c = jnp.where(valid[:, None, None, None, :], iou_c, 0.0)
+    ignore = (jnp.max(iou_c, axis=-1) > ignore_thresh) & (obj_t <= 0)
+    obj_logits = pobj
+    obj_loss_map = jnp.maximum(obj_logits, 0) - obj_logits * obj_t \
+        + jnp.log1p(jnp.exp(-jnp.abs(obj_logits)))
+    obj_loss_map = jnp.where(ignore, 0.0, obj_loss_map)
+    loss_obj = obj_loss_map.sum((1, 2, 3))
+    # classification at positive cells
+    smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(jnp.clip(gl, 0, class_num - 1), class_num)
+    cls_t = onehot * (1.0 - smooth) + smooth / class_num \
+        if use_label_smooth else onehot
+    cl = pcls.transpose(0, 1, 3, 4, 2)[sel]       # [N, B, class_num]
+    cls_map = jnp.maximum(cl, 0) - cl * cls_t \
+        + jnp.log1p(jnp.exp(-jnp.abs(cl)))
+    loss_cls = (jnp.where(in_level[..., None], cls_map, 0.0)).sum((1, 2))
+    return loss_xy + loss_wh + loss_obj + loss_cls
+
+
+__all__ += ["read_file", "decode_jpeg", "matrix_nms", "generate_proposals",
+            "yolo_loss"]

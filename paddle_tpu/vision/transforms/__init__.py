@@ -5,8 +5,14 @@ TPU-native notes: transforms run host-side on numpy HWC images in the
 DataLoader workers (same stage as the reference's CPU transforms); the
 device never sees per-sample python work."""
 from .transforms import (BaseTransform, BrightnessTransform, CenterCrop,  # noqa
-                         ColorJitter, Compose, ContrastTransform, Normalize,
-                         Pad, RandomCrop, RandomHorizontalFlip,
-                         RandomResizedCrop, RandomRotation, RandomVerticalFlip,
-                         Resize, ToTensor, Transpose)
+                         ColorJitter, Compose, ContrastTransform, Grayscale,
+                         HueTransform, Normalize, Pad, RandomAffine,
+                         RandomCrop, RandomErasing, RandomHorizontalFlip,
+                         RandomPerspective, RandomResizedCrop, RandomRotation,
+                         RandomVerticalFlip, Resize, SaturationTransform,
+                         ToTensor, Transpose)
+from .functional import (adjust_brightness, adjust_contrast, adjust_hue,  # noqa
+                         adjust_saturation, affine, center_crop, crop,
+                         erase, hflip, normalize, pad, perspective, resize,
+                         rotate, to_grayscale, to_tensor, vflip)
 from . import functional  # noqa

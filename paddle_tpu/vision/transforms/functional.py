@@ -139,25 +139,7 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     # inverse-map each output pixel to source coordinates
     ys = cos * (yy - ocy) + sin * (xx - ocx) + cy
     xs = -sin * (yy - ocy) + cos * (xx - ocx) + cx
-    out = np.full((nh, nw, arr.shape[2]), fill, dtype=arr.dtype)
-    if interpolation == "bilinear":
-        y0 = np.floor(ys).astype(int)
-        x0 = np.floor(xs).astype(int)
-        valid = (y0 >= 0) & (y0 < h - 1) & (x0 >= 0) & (x0 < w - 1)
-        y0c = np.clip(y0, 0, h - 2)
-        x0c = np.clip(x0, 0, w - 2)
-        wy = (ys - y0c)[..., None]
-        wx = (xs - x0c)[..., None]
-        interp = (arr[y0c, x0c] * (1 - wy) * (1 - wx)
-                  + arr[y0c, x0c + 1] * (1 - wy) * wx
-                  + arr[y0c + 1, x0c] * wy * (1 - wx)
-                  + arr[y0c + 1, x0c + 1] * wy * wx)
-        out[valid] = interp[valid].astype(arr.dtype)
-    else:
-        yi = np.round(ys).astype(int)
-        xi = np.round(xs).astype(int)
-        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        out[valid] = arr[yi[valid], xi[valid]]
+    out = _inverse_sample(arr, ys, xs, interpolation, fill)
     if squeeze:
         out = out[:, :, 0]
     return out
@@ -232,3 +214,117 @@ def to_grayscale(img, num_output_channels=1):
     elif arr.ndim == 3:
         gray = gray[..., None]
     return gray
+
+
+def _inverse_sample(arr, ys, xs, interpolation, fill):
+    """Sample arr (HWC) at float source coords (ys, xs) with
+    nearest/bilinear, fill outside."""
+    h, w = arr.shape[:2]
+    nh, nw = ys.shape
+    out = np.full((nh, nw, arr.shape[2]), fill, dtype=arr.dtype)
+    if interpolation == "bilinear":
+        # validity by the real coordinate (inclusive of the last row/col);
+        # the interpolation corners clip to h-2/w-2 so ys==h-1 reads the
+        # last row with weight 1
+        valid = (ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1)
+        y0c = np.clip(np.floor(ys).astype(int), 0, h - 2)
+        x0c = np.clip(np.floor(xs).astype(int), 0, w - 2)
+        wy = np.clip(ys - y0c, 0.0, 1.0)[..., None]
+        wx = np.clip(xs - x0c, 0.0, 1.0)[..., None]
+        interp = (arr[y0c, x0c] * (1 - wy) * (1 - wx)
+                  + arr[y0c, x0c + 1] * (1 - wy) * wx
+                  + arr[y0c + 1, x0c] * wy * (1 - wx)
+                  + arr[y0c + 1, x0c + 1] * wy * wx)
+        out[valid] = interp[valid].astype(arr.dtype)
+    else:
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """2D affine: rotate(angle) @ shear @ scale, then translate
+    (reference: transforms/functional.py affine — same parameterization
+    as torchvision). Host-side inverse mapping."""
+    arr = _as_np(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else \
+        (center[1], center[0])
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward matrix (x right, y down): T * C * R * Sh * Sc * C^-1
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = scale * np.array([[a, b], [c, d]])
+    # inverse map: src = M^-1 (dst - center - translate) + center
+    minv = np.linalg.inv(m)
+    yy, xx = np.mgrid[0:h, 0:w]
+    dx = xx - cx - translate[0]
+    dy = yy - cy - translate[1]
+    xs = minv[0, 0] * dx + minv[0, 1] * dy + cx
+    ys = minv[1, 0] * dx + minv[1, 1] * dy + cy
+    out = _inverse_sample(arr, ys, xs, interpolation, fill)
+    return out[:, :, 0] if squeeze else out
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography mapping endpoints -> startpoints."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coeffs = np.linalg.lstsq(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64), rcond=None)[0]
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp given 4 source and 4 destination corner points
+    (reference: transforms/functional.py perspective)."""
+    arr = _as_np(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    co = _perspective_coeffs(startpoints, endpoints)
+    yy, xx = np.mgrid[0:h, 0:w]
+    denom = co[6] * xx + co[7] * yy + 1.0
+    xs = (co[0] * xx + co[1] * yy + co[2]) / denom
+    ys = (co[3] * xx + co[4] * yy + co[5]) / denom
+    out = _inverse_sample(arr, ys, xs, interpolation, fill)
+    return out[:, :, 0] if squeeze else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the rectangle [i:i+h, j:j+w] with value ``v`` (reference:
+    transforms/functional.py erase). Works on HWC numpy or Tensor CHW."""
+    from ...core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        arr = img._data
+        val = jnp.broadcast_to(jnp.asarray(v, arr.dtype),
+                               arr.shape[:-2] + (h, w))
+        new = arr.at[..., i:i + h, j:j + w].set(val)
+        if inplace:
+            img._data = new
+            return img
+        return Tensor(new)
+    arr = _as_np(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out

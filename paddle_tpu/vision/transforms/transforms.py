@@ -1,6 +1,7 @@
 """Transform classes (reference: vision/transforms/transforms.py)."""
 from __future__ import annotations
 
+import math
 import numbers
 import random
 from typing import Sequence
@@ -264,3 +265,127 @@ class ColorJitter(BaseTransform):
         for t in ts:
             img = t(img)
         return img
+
+
+class Grayscale(BaseTransform):
+    """reference: transforms.py Grayscale."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms.py RandomAffine — random rotation,
+    translation, scale and shear in the given ranges."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+            translate = (tx, ty)
+        else:
+            translate = (0.0, 0.0)
+        scale = random.uniform(*self.scale) if self.scale is not None else 1.0
+        if self.shear is not None:
+            sh = self.shear
+            if np.isscalar(sh):
+                shear = (random.uniform(-sh, sh), 0.0)
+            elif len(sh) == 2:
+                shear = (random.uniform(sh[0], sh[1]), 0.0)
+            else:
+                shear = (random.uniform(sh[0], sh[1]),
+                         random.uniform(sh[2], sh[3]))
+        else:
+            shear = (0.0, 0.0)
+        return F.affine(img, angle, translate, scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return np.asarray(img)
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        hw, hh = int(d * w / 2), int(d * h / 2)
+        tl = (random.randint(0, hw), random.randint(0, hh))
+        tr = (w - 1 - random.randint(0, hw), random.randint(0, hh))
+        br = (w - 1 - random.randint(0, hw), h - 1 - random.randint(0, hh))
+        bl = (random.randint(0, hw), h - 1 - random.randint(0, hh))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [tl, tr, br, bl]
+        return F.perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py RandomErasing — erase a random rectangle
+    with value or noise."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        arr = np.asarray(img) if not hasattr(img, "_data") else img
+        if random.random() >= self.prob:
+            return arr
+        if hasattr(arr, "_data"):
+            h, w = arr.shape[-2], arr.shape[-1]        # CHW tensor
+            ch = arr.shape[-3]
+        else:
+            h, w = arr.shape[:2]
+            ch = arr.shape[2] if arr.ndim == 3 else 1
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = math.exp(random.uniform(math.log(self.ratio[0]),
+                                             math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * aspect)))
+            ew = int(round(math.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                if self.value == "random":
+                    v = np.random.default_rng().normal(
+                        size=(eh, ew, ch)).astype(np.float32)
+                    if hasattr(arr, "_data"):
+                        v = v.transpose(2, 0, 1)
+                else:
+                    v = self.value
+                return F.erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr

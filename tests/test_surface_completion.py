@@ -1,0 +1,615 @@
+"""Tests for the API-surface completion sweep part 2: distributions,
+optimizers, vision transforms/models, static extras, sparse long tail,
+incubate graph ops, distributed compat."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(x, **kw):
+    return paddle.to_tensor(x, **kw)
+
+
+class TestDistributions:
+    def test_multivariate_normal(self):
+        import scipy.stats as st
+
+        from paddle_tpu.distribution import MultivariateNormal
+
+        loc = np.array([1.0, -1.0], "float32")
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        d = MultivariateNormal(t(loc), covariance_matrix=t(cov))
+        x = np.array([[0.0, 0.0], [1.0, -1.0]], "float32")
+        want = st.multivariate_normal(loc, cov).logpdf(x)
+        np.testing.assert_allclose(np.asarray(d.log_prob(t(x)).numpy()),
+                                   want, rtol=1e-4)
+        want_ent = st.multivariate_normal(loc, cov).entropy()
+        np.testing.assert_allclose(float(d.entropy().numpy()), want_ent,
+                                   rtol=1e-4)
+        s = d.sample((500,))
+        assert s.shape == [500, 2]
+        # KL(d, d) == 0
+        np.testing.assert_allclose(float(d.kl_divergence(d).numpy()), 0.0,
+                                   atol=1e-5)
+
+    def test_cauchy(self):
+        import scipy.stats as st
+
+        from paddle_tpu.distribution import Cauchy
+
+        d = Cauchy(t(0.5), t(2.0))
+        x = np.array([0.0, 1.0, 5.0], "float32")
+        np.testing.assert_allclose(np.asarray(d.log_prob(t(x)).numpy()),
+                                   st.cauchy(0.5, 2.0).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(d.cdf(t(x)).numpy()),
+                                   st.cauchy(0.5, 2.0).cdf(x), rtol=1e-5)
+        with pytest.raises(ValueError):
+            d.mean
+
+    def test_binomial(self):
+        import scipy.stats as st
+
+        from paddle_tpu.distribution import Binomial
+
+        d = Binomial(t(10.0), t(0.3))
+        k = np.array([0.0, 3.0, 10.0], "float32")
+        np.testing.assert_allclose(np.asarray(d.log_prob(t(k)).numpy()),
+                                   st.binom(10, 0.3).logpmf(k), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   st.binom(10, 0.3).entropy(), rtol=1e-3)
+
+    def test_independent(self):
+        from paddle_tpu.distribution import Independent, Normal
+
+        base = Normal(t(np.zeros(3, "float32")), t(np.ones(3, "float32")))
+        d = Independent(base, 1)
+        assert d.event_shape == (3,)
+        lp = d.log_prob(t(np.zeros(3, "float32")))
+        np.testing.assert_allclose(
+            float(lp.numpy()),
+            float(np.sum(np.asarray(base.log_prob(
+                t(np.zeros(3, "float32"))).numpy()))), rtol=1e-6)
+
+    def test_transformed(self):
+        import scipy.stats as st
+
+        from paddle_tpu.distribution import (ExpTransform, Normal,
+                                             TransformedDistribution)
+
+        d = TransformedDistribution(Normal(t(0.0), t(1.0)),
+                                    [ExpTransform()])
+        x = np.array([0.5, 1.0, 2.0], "float32")
+        np.testing.assert_allclose(np.asarray(d.log_prob(t(x)).numpy()),
+                                   st.lognorm(s=1.0).logpdf(x), rtol=1e-4)
+
+    def test_transforms_roundtrip(self):
+        from paddle_tpu.distribution import (AffineTransform,
+                                             SigmoidTransform,
+                                             StickBreakingTransform,
+                                             TanhTransform)
+
+        x = np.array([-1.5, 0.2, 2.0], "float32")
+        for tr in [AffineTransform(t(1.0), t(2.0)), SigmoidTransform(),
+                   TanhTransform()]:
+            y = tr.forward(t(x))
+            back = tr.inverse(y)
+            np.testing.assert_allclose(np.asarray(back.numpy()), x,
+                                       rtol=1e-4, atol=1e-5)
+        sb = StickBreakingTransform()
+        y = sb.forward(t(x))
+        arr = np.asarray(y.numpy())
+        assert arr.shape == (4,)
+        np.testing.assert_allclose(arr.sum(), 1.0, rtol=1e-5)
+        back = sb.inverse(y)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_continuous_bernoulli(self):
+        from paddle_tpu.distribution import ContinuousBernoulli
+
+        d = ContinuousBernoulli(t(np.array([0.3], "float32")))
+        lp = d.log_prob(t(np.array([0.5], "float32")))
+        assert np.isfinite(float(lp.numpy()))
+        m = float(d.mean.numpy())
+        assert 0.0 < m < 0.5
+        s = d.sample((200,))
+        arr = np.asarray(s.numpy())
+        assert ((arr > 0) & (arr < 1)).all()
+
+
+def _fit(opt_cls, steps=150, **kw):
+    rng = np.random.default_rng(0)
+    xw = rng.normal(size=(32, 4)).astype("float32")
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], "float32")
+    yv = xw @ true_w
+    lin = nn.Linear(4, 1)
+    opt = opt_cls(learning_rate=kw.pop("lr", 0.1),
+                  parameters=lin.parameters(), **kw)
+    for _ in range(steps):
+        loss = nn.functional.mse_loss(lin(t(xw)), t(yv))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy())
+
+
+class TestNewOptimizers:
+    def test_adadelta(self):
+        from paddle_tpu.optimizer import Adadelta
+
+        # adadelta's step size bootstraps from sqrt(eps): slow start,
+        # monotone progress is the property to check
+        start = _fit(Adadelta, steps=1, lr=1.0)
+        assert _fit(Adadelta, steps=800, lr=1.0) < 0.25 * start
+
+    def test_nadam(self):
+        from paddle_tpu.optimizer import NAdam
+
+        assert _fit(NAdam, lr=0.1) < 0.1
+
+    def test_radam(self):
+        from paddle_tpu.optimizer import RAdam
+
+        assert _fit(RAdam, lr=0.1) < 0.1
+
+    def test_asgd(self):
+        from paddle_tpu.optimizer import ASGD
+
+        assert _fit(ASGD, lr=0.05, batch_num=4) < 0.5
+
+    def test_rprop(self):
+        from paddle_tpu.optimizer import Rprop
+
+        assert _fit(Rprop, lr=0.01) < 0.5
+
+    def test_lbfgs(self):
+        from paddle_tpu.optimizer import LBFGS
+
+        rng = np.random.default_rng(1)
+        xw = rng.normal(size=(32, 4)).astype("float32")
+        true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], "float32")
+        yv = xw @ true_w
+        lin = nn.Linear(4, 1)
+        opt = LBFGS(learning_rate=1.0, max_iter=20,
+                    line_search_fn="strong_wolfe",
+                    parameters=lin.parameters())
+
+        def closure():
+            opt.clear_grad()
+            loss = nn.functional.mse_loss(lin(t(xw)), t(yv))
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            final = opt.step(closure)
+        assert float(final.numpy()) < 1e-2
+
+    def test_lookahead_modelaverage(self):
+        from paddle_tpu.incubate import LookAhead, ModelAverage
+        from paddle_tpu.optimizer import SGD
+
+        rng = np.random.default_rng(2)
+        xw = rng.normal(size=(16, 3)).astype("float32")
+        yv = xw @ np.array([[1.0], [2.0], [-1.0]], "float32")
+        lin = nn.Linear(3, 1)
+        inner = SGD(learning_rate=0.1, parameters=lin.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        ma = ModelAverage(0.15, parameters=lin.parameters())
+        for _ in range(60):
+            loss = nn.functional.mse_loss(lin(t(xw)), t(yv))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+        assert float(loss.numpy()) < 0.2
+        before = lin.weight.numpy().copy()
+        ma.apply()
+        ma.restore()
+        np.testing.assert_allclose(lin.weight.numpy(), before)
+
+
+class TestVisionSurface:
+    def test_affine_perspective_erase(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = np.arange(5 * 5 * 3, dtype="uint8").reshape(5, 5, 3)
+        # identity affine returns the image
+        out = T.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0))
+        np.testing.assert_array_equal(out, img)
+        # identity perspective
+        pts = [(0, 0), (4, 0), (4, 4), (0, 4)]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_array_equal(out, img)
+        er = T.erase(img, 1, 1, 2, 2, 0)
+        assert (er[1:3, 1:3] == 0).all() and er[0, 0, 0] == img[0, 0, 0]
+
+    def test_random_transform_classes(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = np.random.default_rng(0).integers(
+            0, 255, (8, 8, 3)).astype("uint8")
+        assert T.Grayscale()(img).shape[:2] == (8, 8)
+        assert T.RandomAffine(10, translate=(0.1, 0.1),
+                              scale=(0.9, 1.1), shear=5)(img).shape == \
+            img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+        out = T.RandomErasing(prob=1.0)(img)
+        assert out.shape == img.shape
+
+    def test_new_model_families_forward(self):
+        import paddle_tpu.vision.models as M
+
+        x = t(np.random.default_rng(0).normal(size=(1, 3, 64, 64))
+              .astype("float32"))
+        assert M.mobilenet_v1(num_classes=10)(x).shape == [1, 10]
+        assert M.mobilenet_v3_small(num_classes=10)(x).shape == [1, 10]
+        assert M.squeezenet1_1(num_classes=10)(x).shape == [1, 10]
+        assert M.shufflenet_v2_x0_25(num_classes=10)(x).shape == [1, 10]
+        y = M.densenet121(num_classes=10)(x)
+        assert y.shape == [1, 10]
+
+    def test_alexnet_googlenet_inception(self):
+        import paddle_tpu.vision.models as M
+
+        x = t(np.random.default_rng(0).normal(size=(1, 3, 224, 224))
+              .astype("float32"))
+        assert M.alexnet(num_classes=7)(x).shape == [1, 7]
+        out, a1, a2 = M.googlenet(num_classes=7)(x)
+        assert out.shape == [1, 7] and a1.shape == [1, 7]
+        x2 = t(np.random.default_rng(0).normal(size=(1, 3, 299, 299))
+               .astype("float32"))
+        assert M.inception_v3(num_classes=7)(x2).shape == [1, 7]
+
+    def test_resnext_wide(self):
+        import paddle_tpu.vision.models as M
+
+        x = t(np.random.default_rng(0).normal(size=(1, 3, 64, 64))
+              .astype("float32"))
+        assert M.resnext50_32x4d(num_classes=5)(x).shape == [1, 5]
+        assert M.wide_resnet50_2(num_classes=5)(x).shape == [1, 5]
+
+    def test_vision_ops_new(self):
+        import paddle_tpu.vision.ops as vops
+
+        # matrix_nms: two overlapping boxes, one distinct
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                           [20, 20, 30, 30]]], "float32")
+        scores = np.array([[[0.9, 0.85, 0.8]]], "float32")
+        rois, num = vops.matrix_nms(t(boxes), t(scores), 0.1, 0.01,
+                                    10, 10, background_label=-1)
+        assert np.asarray(num.numpy())[0] >= 2
+        r = np.asarray(rois.numpy())
+        assert r.shape[1] == 6
+
+    def test_yolo_loss_differentiable(self):
+        import paddle_tpu.vision.ops as vops
+
+        rng = np.random.default_rng(3)
+        na, nc, h, w = 3, 4, 4, 4
+        x = t(rng.normal(size=(2, na * (5 + nc), h, w))
+              .astype("float32"), stop_gradient=False)
+        gt = t(np.array([[[0.5, 0.5, 0.3, 0.4]]] * 2, "float32"))
+        gl = t(np.array([[1]] * 2, "int32"))
+        loss = vops.yolo_loss(x, gt, gl, anchors=[10, 13, 16, 30, 33, 23],
+                              anchor_mask=[0, 1, 2], class_num=nc,
+                              ignore_thresh=0.5, downsample_ratio=32)
+        assert loss.shape == [2]
+        loss.sum().backward()
+        assert np.isfinite(np.asarray(x.grad.numpy())).all()
+
+    def test_read_file(self, tmp_path):
+        import paddle_tpu.vision.ops as vops
+
+        p = tmp_path / "blob.bin"
+        p.write_bytes(b"\x01\x02\x03")
+        out = vops.read_file(str(p))
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [1, 2, 3])
+
+
+class TestStaticExtras:
+    def test_create_parameter_and_gradients(self):
+        import paddle_tpu.static as static
+
+        w = static.create_parameter([3, 2], "float32")
+        assert w.shape == [3, 2]
+        gv = static.create_global_var([1], 2.5, "float32")
+        np.testing.assert_allclose(np.asarray(gv.numpy()), [2.5])
+
+    def test_program_serialize_roundtrip(self):
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            lin = nn.Linear(3, 2)
+            y = lin(x)
+            loss = paddle.mean(y)
+        data = static.serialize_program([x], [loss])
+        prog2 = static.deserialize_program(data)
+        exe = static.Executor()
+        arr = np.random.default_rng(0).normal(size=(4, 3)).astype("float32")
+        (o1,) = exe.run(main, feed={"x": arr}, fetch_list=[loss])
+        (o2,) = exe.run(prog2, feed={"x": arr},
+                        fetch_list=[prog2._loaded_fetch[0]])
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+    def test_static_save_load(self, tmp_path):
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            lin = nn.Linear(3, 2)
+            y = lin(x)
+        prefix = str(tmp_path / "model")
+        static.save(main, prefix)
+        old = lin.weight.numpy().copy()
+        lin.weight.set_value(np.zeros_like(old))
+        static.load(main, prefix)
+        np.testing.assert_allclose(lin.weight.numpy(), old)
+
+    def test_static_nn_builders(self):
+        import paddle_tpu.static as static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [None, 6], "float32")
+                h = static.nn.fc(x, 4, activation="relu")
+                img = static.data("img", [None, 3, 8, 8], "float32")
+                c = static.nn.conv2d(img, 4, 3, padding=1)
+                ln = static.nn.layer_norm(h)
+            exe = static.Executor()
+            arr = np.random.default_rng(0).normal(size=(2, 6)) \
+                .astype("float32")
+            im = np.random.default_rng(1).normal(size=(2, 3, 8, 8)) \
+                .astype("float32")
+            (hv, cv, lv) = exe.run(main, feed={"x": arr, "img": im},
+                                   fetch_list=[h, c, ln])
+            assert hv.shape == (2, 4) and (hv >= 0).all()
+            assert cv.shape == (2, 4, 8, 8)
+            assert lv.shape == (2, 4)
+        finally:
+            paddle.disable_static()
+
+    def test_ema(self):
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            lin = nn.Linear(2, 1)
+            y = lin(x)
+        ema = static.ExponentialMovingAverage(0.5)
+        with static.program_guard(main):
+            ema.update()
+        w0 = lin.weight.numpy().copy()
+        lin.weight.set_value(w0 + 1.0)
+        with static.program_guard(main):
+            ema.update()
+        with static.program_guard(main):
+            with ema.apply():
+                applied = lin.weight.numpy().copy()
+        restored = lin.weight.numpy()
+        np.testing.assert_allclose(restored, w0 + 1.0)
+        assert not np.allclose(applied, restored)
+
+    def test_compiled_program_and_places(self):
+        import paddle_tpu.static as static
+
+        cp = static.CompiledProgram(static.Program(),
+                                    static.BuildStrategy())
+        assert cp.ops() == []
+        assert len(static.cuda_places()) >= 1
+
+
+class TestSparseLongTail:
+    def test_sparse_unaries_and_matvec(self):
+        import paddle_tpu.sparse as sp
+
+        dense = np.array([[0.0, 0.5], [0.25, 0.0]], "float32")
+        s = sp.sparse_coo_tensor_from_dense(t(dense))
+        np.testing.assert_allclose(
+            np.asarray(sp.asin(s).to_dense().numpy()),
+            np.arcsin(dense), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sp.tan(s).to_dense().numpy()),
+            np.where(dense != 0, np.tan(dense), 0.0), rtol=1e-5)
+        v = np.array([1.0, 2.0], "float32")
+        np.testing.assert_allclose(np.asarray(sp.mv(s, t(v)).numpy()),
+                                   dense @ v, rtol=1e-5)
+        r = sp.reshape(s, [1, 4])
+        assert list(r.shape) == [1, 4]
+        sl = sp.slice(s, [0], [0], [1])
+        assert list(sl.shape) == [1, 2]
+        out = sp.addmm(t(np.ones((2, 2), "float32")), s,
+                       t(np.ones((2, 2), "float32")), beta=2.0)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   2.0 + dense @ np.ones((2, 2)), rtol=1e-5)
+
+
+class TestIncubateGraph:
+    def test_segment_and_send_recv(self):
+        import paddle_tpu.incubate as inc
+
+        data = t(np.array([[1.0], [2.0], [3.0]], "float32"))
+        ids = t(np.array([0, 0, 1], "int64"))
+        np.testing.assert_allclose(
+            np.asarray(inc.segment_sum(data, ids).numpy()),
+            [[3.0], [3.0]])
+        x = t(np.eye(3, dtype="float32"))
+        out = inc.graph_send_recv(x, t(np.array([0, 1], "int64")),
+                                  t(np.array([2, 2], "int64")))
+        np.testing.assert_allclose(np.asarray(out.numpy())[2],
+                                   [1.0, 1.0, 0.0])
+
+    def test_softmax_mask_fuse(self):
+        import scipy.special as ssp
+
+        import paddle_tpu.incubate as inc
+
+        x = np.random.default_rng(0).normal(size=(1, 1, 3, 3)) \
+            .astype("float32")
+        m = np.zeros_like(x)
+        np.testing.assert_allclose(
+            np.asarray(inc.softmax_mask_fuse(t(x), t(m)).numpy()),
+            ssp.softmax(x, axis=-1), rtol=1e-5)
+        ut = inc.softmax_mask_fuse_upper_triangle(t(x))
+        arr = np.asarray(ut.numpy())[0, 0]
+        assert arr[0, 1] == 0.0 and arr[0, 0] == 1.0
+
+    def test_khop_sampler(self):
+        import paddle_tpu.incubate as inc
+
+        # CSC graph: 3 nodes, edges into each node from the next
+        row = t(np.array([1, 2, 0], "int64"))
+        colptr = t(np.array([0, 1, 2, 3], "int64"))
+        seeds = t(np.array([0], "int64"))
+        src, dst, nodes, counts = inc.graph_khop_sampler(
+            row, colptr, seeds, [1, 1])
+        assert len(np.asarray(nodes.numpy())) >= 1
+
+
+class TestDistributedCompat:
+    def test_strategy_and_parallel_mode(self):
+        import paddle_tpu.distributed as dist
+
+        s = dist.Strategy({"sharding": {"enable": True, "stage": 2}})
+        assert s.sharding.enable and s.sharding.stage == 2
+        assert dist.ParallelMode.PIPELINE_PARALLEL == 2
+
+    def test_dist_model_train(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.optimizer import SGD
+
+        lin = nn.Linear(3, 1)
+        loss_fn = nn.MSELoss()
+        opt = SGD(learning_rate=0.1, parameters=lin.parameters())
+        model, _ = dist.to_static(lin, None, loss_fn, opt)
+        model.train()
+        x = t(np.random.default_rng(0).normal(size=(8, 3)).astype("float32"))
+        y = t(np.zeros((8, 1), "float32"))
+        l0 = float(model(x, y).numpy())
+        for _ in range(20):
+            last = float(model(x, y).numpy())
+        assert last < l0
+        sd = model.state_dict()
+        assert "weight" in sd
+
+    def test_gloo_shims_and_ps_gates(self):
+        import paddle_tpu.distributed as dist
+
+        dist.gloo_init_parallel_env(0, 1, "127.0.0.1:1234")
+        dist.gloo_release()
+        with pytest.raises(NotImplementedError):
+            dist.InMemoryDataset()
+
+    def test_persistables_roundtrip(self, tmp_path):
+        import paddle_tpu.distributed.io as dio
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            lin = nn.Linear(2, 2)
+            y = lin(x)
+        dio.save_persistables(None, str(tmp_path), main)
+        old = lin.weight.numpy().copy()
+        lin.weight.set_value(np.zeros_like(old))
+        dio.load_persistables(None, str(tmp_path), main)
+        np.testing.assert_allclose(lin.weight.numpy(), old)
+
+
+class TestMiscSurface:
+    def test_metric_accuracy_fn(self):
+        from paddle_tpu.metric import accuracy
+
+        pred = t(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+        label = t(np.array([1, 1], "int64"))
+        np.testing.assert_allclose(float(accuracy(pred, label).numpy()),
+                                   0.5)
+
+    def test_amp_supported(self):
+        assert paddle.amp.is_bfloat16_supported() is True
+        assert paddle.amp.is_float16_supported() in (True, False)
+
+    def test_saved_tensors_hooks(self):
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+        packed = []
+
+        def pack(x):
+            packed.append(True)
+            return x.numpy()
+
+        def unpack(h):
+            return t(np.asarray(h))
+
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor()
+                return g * 2.0 * x
+
+        with saved_tensors_hooks(pack, unpack):
+            x = t([3.0], stop_gradient=False)
+            y = Sq.apply(x)
+        y.backward()
+        assert packed and float(x.grad.numpy()[0]) == 6.0
+
+    def test_jacobian_hessian(self):
+        from paddle_tpu.autograd import hessian, jacobian
+
+        x = t(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+        y = (x * x).sum()
+        h = hessian(y, x)
+        np.testing.assert_allclose(np.asarray(h.numpy()),
+                                   2.0 * np.eye(2), rtol=1e-5)
+        x2 = t(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+        y2 = x2 * x2
+        j = jacobian(y2, x2)
+        np.testing.assert_allclose(np.asarray(j.numpy()),
+                                   np.diag([2.0, 4.0]), rtol=1e-5)
+
+    def test_get_worker_info_main(self):
+        import paddle_tpu.io as pio
+
+        assert pio.get_worker_info() is None
+
+    def test_quanter_surface(self):
+        import paddle_tpu.quantization as q
+
+        assert issubclass(q.AbsmaxObserver, object)
+        assert q.BaseQuanter is not None
+
+    def test_initializer_bilinear(self):
+        from paddle_tpu.nn.initializer import Bilinear
+
+        w = Bilinear()((2, 2, 4, 4))
+        arr = np.asarray(w)
+        assert arr.shape == (2, 2, 4, 4)
+        # symmetric triangle filter
+        np.testing.assert_allclose(arr[0, 0], arr[0, 0][::-1, ::-1])
+
+    def test_profiler_enums_and_protobuf(self, tmp_path):
+        import paddle_tpu.profiler as prof
+
+        assert prof.SortedKeys.CPUTotal is not None
+        assert prof.SummaryView.OverView is not None
+        p = prof.Profiler(on_trace_ready=prof.export_protobuf(
+            str(tmp_path)))
+        p.start()
+        with prof.RecordEvent("step"):
+            _ = paddle.to_tensor([1.0]) + 1.0
+        p.stop()
+        import os
+
+        assert any(f.endswith(".pb") for f in os.listdir(tmp_path))
