@@ -147,3 +147,8 @@ def _monkeypatch_tensor_repr():
 
 # Pallas kernels self-select on TPU backends (KernelFactory-style dispatch).
 kernels.auto_register()
+
+# Composite/creation/inplace op families join the dispatch registry
+# (reference OpInfoMap parity; ops/composite.py).
+from .ops import composite as _composite
+_composite.register_composites()

@@ -83,15 +83,73 @@ class _Program:
 
 class StaticFunction:
     """Callable wrapper produced by ``to_static``
-    (reference: dy2static/program_translator.py StaticFunction)."""
+    (reference: dy2static/program_translator.py StaticFunction).
+
+    ``bucket_batch=True`` enables batch-dim bucketing for INFERENCE
+    paths: inputs whose leading dim varies are padded up to the next
+    power-of-two bucket so XLA compiles one program per bucket instead
+    of one per concrete batch — the TPU-native answer to the reference's
+    symbolic-shape engine (static shapes, bounded recompiles). Outputs
+    carrying the padded batch are sliced back.
+
+    Contract: outputs must be row-wise in the batch — cross-batch
+    reductions (batch-mean losses, BatchNorm training stats) would see
+    the zero pad rows. When gradient recording is live the padding is
+    skipped automatically (training uses exact shapes)."""
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
-                 input_spec=None, build_strategy=None, full_graph=True):
+                 input_spec=None, build_strategy=None, full_graph=True,
+                 bucket_batch=False, bucket_sizes=None):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
         self._programs: Dict[tuple, _Program] = {}
+        self._bucket_batch = bool(bucket_batch)
+        self._bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
         functools.update_wrapper(self, fn)
+
+    def _bucket_of(self, n: int) -> int:
+        if self._bucket_sizes:
+            for b in self._bucket_sizes:
+                if n <= b:
+                    return b
+            return n          # beyond the largest bucket: run unbucketed
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    def _apply_bucketing(self, args):
+        """Pad every Tensor arg's leading dim from the common batch size
+        to its bucket; returns (padded_args, real_batch or None,
+        padded_batch).
+
+        Bucketing is an INFERENCE-path feature (serving variable batch):
+        the padded rows flow through the function, so outputs must be
+        row-wise in the batch; and because padding rebuilds inputs, it
+        only engages while grad recording is off (paddle.no_grad() /
+        eval serving) — training always uses exact shapes (correct beats
+        fewer compiles). Closure-captured parameters are invisible here,
+        so grad state is the only safe gate."""
+        if state.grad_enabled():
+            return args, None, None
+        batches = {a._data.shape[0] for a in args
+                   if isinstance(a, Tensor) and a._data.ndim > 0}
+        if len(batches) != 1:
+            return args, None, None
+        (n,) = batches
+        b = self._bucket_of(int(n))
+        if b == n:
+            return args, None, None
+        import jax.numpy as _jnp
+
+        def pad(a):
+            if isinstance(a, Tensor) and a._data.ndim > 0 \
+                    and a._data.shape[0] == n:
+                widths = [(0, b - n)] + [(0, 0)] * (a._data.ndim - 1)
+                return Tensor(_jnp.pad(a._data, widths))
+            return a
+        return tuple(pad(a) for a in args), int(n), int(b)
 
     # -- helpers -------------------------------------------------------------
     def _named_params(self):
@@ -159,6 +217,22 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._fn(*args, **kwargs)
+        real_batch = None
+        if self._bucket_batch and not kwargs:
+            args, real_batch, padded_batch = self._apply_bucketing(args)
+        if real_batch is not None:
+            out = self.__wrapped_call(args, kwargs)
+
+            def unpad(o):
+                if isinstance(o, Tensor) and o._data.ndim > 0 \
+                        and o._data.shape[0] == padded_batch:
+                    return Tensor(o._data[:real_batch])
+                return o
+            return jax.tree_util.tree_map(
+                unpad, out, is_leaf=lambda x: isinstance(x, Tensor))
+        return self.__wrapped_call(args, kwargs)
+
+    def __wrapped_call(self, args, kwargs):
         key = self._cache_key(args, kwargs)
         prog = self._programs.get(key)
         if prog is None:
@@ -240,19 +314,25 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """paddle.jit.to_static parity (reference: jit/api.py:136)."""
+              backend=None, full_graph=True, bucket_batch=False,
+              bucket_sizes=None, **kwargs):
+    """paddle.jit.to_static parity (reference: jit/api.py:136).
+    ``bucket_batch``/``bucket_sizes``: see StaticFunction — pad variable
+    leading dims to buckets so XLA recompiles O(log max_batch) times."""
+    extra = dict(bucket_batch=bucket_batch, bucket_sizes=bucket_sizes)
 
     def decorate(obj):
         if isinstance(obj, Layer):
             sf = StaticFunction(obj.forward, layer=obj,
-                                input_spec=input_spec)
+                                input_spec=input_spec, **extra)
             obj.forward = sf
             return obj
         layer = getattr(obj, "__self__", None)
         if isinstance(layer, Layer):
-            return StaticFunction(obj, layer=layer, input_spec=input_spec)
-        return StaticFunction(obj, layer=None, input_spec=input_spec)
+            return StaticFunction(obj, layer=layer, input_spec=input_spec,
+                                  **extra)
+        return StaticFunction(obj, layer=None, input_spec=input_spec,
+                              **extra)
 
     if function is not None:
         return decorate(function)

@@ -3,5 +3,6 @@ trained through the framework — SURVEY.md §7 phase 8)."""
 from . import dit  # noqa: F401
 from . import llama  # noqa: F401
 from . import moe  # noqa: F401
+from . import ocr  # noqa: F401
 
-__all__ = ["llama", "moe", "dit"]
+__all__ = ["llama", "moe", "dit", "ocr"]

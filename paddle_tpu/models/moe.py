@@ -89,6 +89,21 @@ def qwen2_moe_a14b(**kw) -> MoEConfig:
     return MoEConfig(**base)
 
 
+def ernie_4_5_a3b(**kw) -> MoEConfig:
+    """ERNIE-4.5-style fine-grained MoE shapes (BASELINE north-star
+    config family): many small routed experts + an always-on shared
+    expert, GQA attention — same structural recipe this MoE core
+    implements for DeepSeekMoE."""
+    base = dict(vocab_size=103424, hidden_size=2560,
+                intermediate_size=1536, shared_intermediate_size=3072,
+                num_hidden_layers=28, num_attention_heads=20,
+                num_key_value_heads=4, num_experts=64,
+                num_experts_per_tok=6, max_position_embeddings=131072,
+                rope_theta=500000.0)
+    base.update(kw)
+    return MoEConfig(**base)
+
+
 # ---------------------------------------------------------------------------
 # params
 # ---------------------------------------------------------------------------

@@ -1,0 +1,106 @@
+"""PP-OCR-style text recognition model family (conv workload of the
+BASELINE config matrix).
+
+Reference capability: the PP-OCRv4 recognition recipe (ecosystem
+PaddleOCR; in-tree the reference provides its building blocks — conv/
+bn kernels, CTC loss, LSTM). Architecture: a MobileNetV3-ish conv
+backbone collapsing height, a BiLSTM sequence neck, and a CTC head —
+the classic CRNN/PP-OCR rec pipeline, trained with
+paddle.nn.functional.ctc_loss.
+
+TPU-native notes: the backbone is NCHW convs XLA lays out for the MXU;
+the recurrent neck is a lax.scan (nn.LSTM); the whole train step jits
+into one XLA program (see make_train_step).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+
+
+@dataclass
+class OCRRecConfig:
+    image_height: int = 32
+    in_channels: int = 3
+    num_classes: int = 97           # charset + blank (index 0)
+    hidden_size: int = 96           # BiLSTM width
+    backbone_channels: tuple = (32, 64, 128, 256)
+    dtype: str = "float32"
+
+
+def ocr_rec_tiny(**kw) -> OCRRecConfig:
+    base = dict(image_height=16, num_classes=12, hidden_size=16,
+                backbone_channels=(8, 12, 16, 24))
+    base.update(kw)
+    return OCRRecConfig(**base)
+
+
+def pp_ocrv4_rec(**kw) -> OCRRecConfig:
+    """PP-OCRv4 mobile rec shapes."""
+    return OCRRecConfig(**kw)
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, 3, stride=stride, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.Hardswish())
+
+
+class OCRRecognizer(nn.Layer):
+    """[N, C, H, W] image -> [N, W', num_classes] per-timestep logits.
+
+    Strides collapse H to 1 while keeping W resolution (the PP-OCR rec
+    backbone discipline: horizontal stride stays 1 after the stem)."""
+
+    def __init__(self, config: OCRRecConfig = None, **kw):
+        super().__init__()
+        c = config or OCRRecConfig(**kw)
+        self.config = c
+        chans = c.backbone_channels
+        blocks = [_ConvBNAct(c.in_channels, chans[0], stride=2)]
+        in_c = chans[0]
+        for out_c in chans[1:]:
+            # downsample height only: (2, 1) stride keeps sequence length
+            blocks.append(_ConvBNAct(in_c, out_c, stride=(2, 1)))
+            in_c = out_c
+        self.backbone = nn.Sequential(*blocks)
+        self.pool = nn.AdaptiveAvgPool2D((1, None))
+        self.neck = nn.LSTM(in_c, c.hidden_size, direction="bidirect")
+        self.head = nn.Linear(2 * c.hidden_size, c.num_classes)
+
+    def forward(self, x):
+        feat = self.backbone(x)                      # [N, C, h', W/2]
+        feat = self.pool(feat)                       # [N, C, 1, W/2]
+        n, ch, _, wseq = feat.shape
+        seq = feat.reshape([n, ch, wseq]).transpose([0, 2, 1])  # [N,T,C]
+        out, _ = self.neck(seq)                      # [N, T, 2H]
+        return self.head(out)                        # [N, T, classes]
+
+
+def ctc_train_step(model: OCRRecognizer, optimizer):
+    """Build an eager train-step closure: (images, labels, label_lens) ->
+    loss. The CTC loss rides the taped log-semiring scan
+    (nn/functional/extras.py ctc_loss)."""
+    import numpy as np
+
+    from .. import to_tensor
+    from ..nn import functional as F
+
+    def step(images, labels, label_lens):
+        logits = model(images)                       # [N, T, C]
+        t_len = logits.shape[1]
+        n = logits.shape[0]
+        log_probs = logits.transpose([1, 0, 2])      # [T, N, C]
+        input_lens = to_tensor(np.full((n,), t_len, "int32"))
+        loss = F.ctc_loss(log_probs, labels, input_lens, label_lens,
+                          blank=0)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return loss
+
+    return step

@@ -237,13 +237,18 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 def _adaptive(x, nsp, output_size, data_format, kind):
-    out = _tuplize(output_size, nsp)
     if data_format.startswith("NC"):
         spatial = x.shape[2:2 + nsp]
         sp_axes = list(range(2, 2 + nsp))
     else:
         spatial = x.shape[1:1 + nsp]
         sp_axes = list(range(1, 1 + nsp))
+    # reference semantics: None entries keep the input extent
+    if isinstance(output_size, (tuple, list)):
+        output_size = tuple(
+            spatial[i] if output_size[i] is None else output_size[i]
+            for i in range(nsp))
+    out = _tuplize(output_size, nsp)
     # evenly divisible fast path: reshape + reduce (single XLA reduce).
     if all(spatial[i] % out[i] == 0 for i in range(nsp)):
         shape = list(x.shape)

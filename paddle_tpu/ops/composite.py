@@ -1,0 +1,80 @@
+"""Composite-op registration: surface ops implemented as compositions of
+primitive ops into the kernel registry.
+
+Reference capability: the op registry in the reference spans both
+primitive kernels (phi/kernels) and composite/codegen'd API ops
+(paddle/phi/api/yaml/ops.yaml + generated composites). Here primitives
+register via @op_fn; this module registers the composition-implemented
+surface (creation, manipulation-by-composition, inplace families,
+random fills) so the dispatch registry reflects the full op surface the
+way the reference's OpInfoMap does. Each entry dispatches to the live
+eager implementation — kernels/__init__.py fallbacks and trace counters
+see them like any other op.
+"""
+from __future__ import annotations
+
+from ._op import _OP_REGISTRY
+
+# Names whose implementation is a composition over registered primitives
+# (or a creation/random routine). Grouped as the reference yaml groups
+# its op defs.
+_COMPOSITE_NAMES = [
+    # creation
+    "arange", "empty", "empty_like", "eye", "full", "assign",
+    "create_tensor", "diag_embed", "meshgrid", "tril_indices",
+    "triu_indices",
+    # random
+    "bernoulli", "binomial", "gumbel", "standard_gamma", "randint_like",
+    # manipulation compositions
+    "atleast_1d", "atleast_2d", "atleast_3d", "broadcast_tensors",
+    "chunk", "column_stack", "dstack", "hstack", "vstack", "row_stack",
+    "dsplit", "hsplit", "vsplit", "expand_as", "as_strided",
+    "diagonal_scatter", "crop", "moveaxis", "rot90", "select_scatter",
+    "slice_scatter", "view", "view_as", "unflatten",
+    # math compositions
+    "addmm", "allclose", "bmm", "cdist", "complex", "corrcoef", "cov",
+    "cummax", "cummin", "cumulative_trapezoid", "diff", "dist",
+    "equal_all", "frexp", "histogram", "histogramdd", "hypot",
+    "increment", "inner", "outer", "kron", "lerp", "logaddexp",
+    "log_normal", "lstsq", "lu", "lu_unpack", "matrix_power", "median",
+    "nanmean", "nanmedian", "nansum", "nanquantile", "pdist", "polar",
+    "quantile", "trapezoid", "vander", "combinations", "logspace",
+    "multi_dot", "slogdet", "histogram_bin_edges",
+    # indexing / search compositions
+    "index_fill", "index_put", "index_sample", "index_select",
+    "masked_select", "mode", "searchsorted", "take_along_axis",
+    "put_along_axis", "top_p_sampling", "unique_consecutive",
+    # linalg surface
+    "cholesky_solve", "eigh", "eigvalsh", "householder_product",
+    "matrix_rank", "ormqr", "pinv", "triangular_solve",
+]
+
+
+def register_composites():
+    """Install every present composite into the op registry (idempotent;
+    names already claimed by an @op_fn primitive are left alone)."""
+    import paddle_tpu as _paddle
+
+    added = 0
+    for name in _COMPOSITE_NAMES:
+        if name in _OP_REGISTRY:
+            continue
+        fn = getattr(_paddle, name, None)
+        if fn is None or not callable(fn):
+            continue
+        if not hasattr(fn, "op_name"):    # aliases keep their first name
+            fn.op_name = name
+        _OP_REGISTRY[name] = fn
+        added += 1
+
+    # inplace family: every registered x_ over a registered base
+    for name in list(vars(_paddle)):
+        if name.endswith("_") and not name.startswith("_"):
+            fn = getattr(_paddle, name)
+            if callable(fn) and not isinstance(fn, type) \
+                    and name not in _OP_REGISTRY:
+                if not hasattr(fn, "op_name"):
+                    fn.op_name = name
+                _OP_REGISTRY[name] = fn
+                added += 1
+    return added

