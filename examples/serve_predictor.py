@@ -1,0 +1,36 @@
+"""Save a model with paddle.jit.save and serve it through
+paddle.inference.Predictor — the deployment path (StableHLO artifact).
+
+Run:  python examples/serve_predictor.py
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+
+# 1. train-side: build + save
+net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+path = os.path.join(tempfile.mkdtemp(), "model")
+jit.save(net, path, input_spec=[jit.InputSpec([None, 4], "float32")])
+print("saved:", path)
+
+# 2. serve-side (fresh process in real deployments)
+config = inference.Config(path)
+predictor = inference.create_predictor(config)
+x = np.random.default_rng(0).normal(size=(3, 4)).astype("float32")
+(in_name,) = predictor.get_input_names()
+predictor.get_input_handle(in_name).copy_from_cpu(x)
+predictor.run()
+(out_name,) = predictor.get_output_names()
+out = predictor.get_output_handle(out_name).copy_to_cpu()
+print("served output:", out.shape)
+
+# parity with the live layer
+want = net(paddle.to_tensor(x)).numpy()
+np.testing.assert_allclose(out, want, rtol=1e-5)
+print("parity with eager: OK")

@@ -1,0 +1,35 @@
+"""Train a CNN with the high-level Model API (hapi) on synthetic data.
+
+Run:  python examples/train_vision_hapi.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset, DataLoader
+from paddle_tpu.vision.models import mobilenet_v3_small
+
+
+class SynthImages(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(n, 3, 32, 32)).astype("float32")
+        self.y = rng.integers(0, 10, (n, 1)).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+net = mobilenet_v3_small(num_classes=10)
+model = paddle.Model(net)
+model.prepare(
+    optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters()),
+    loss=nn.CrossEntropyLoss(),
+    metrics=paddle.metric.Accuracy())
+model.fit(DataLoader(SynthImages(), batch_size=16, shuffle=True),
+          epochs=1, verbose=1)
+print("hapi training OK")

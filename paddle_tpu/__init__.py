@@ -152,3 +152,17 @@ kernels.auto_register()
 # (reference OpInfoMap parity; ops/composite.py).
 from .ops import composite as _composite
 _composite.register_composites()
+
+# round-3 namespace completion: device/callbacks/hub/onnx/regularizer/
+# tensor/reader aliases + amp.debugging + utils surface
+from . import device  # noqa: E402
+# NB: `from . import callbacks` would be satisfied by the hapi.callbacks
+# attribute bound above; import the real top-level module explicitly.
+callbacks = _importlib.import_module(".callbacks", __name__)
+from . import hub  # noqa: E402
+from . import onnx  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import tensor  # noqa: E402
+from . import reader  # noqa: E402
+from . import utils  # noqa: E402
+from .amp import debugging as _amp_debugging  # noqa: E402,F401

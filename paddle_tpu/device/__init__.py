@@ -1,0 +1,201 @@
+"""paddle.device parity: device query/selection, streams, events.
+
+Reference capability: python/paddle/device/__init__.py (set_device,
+synchronize, Stream/Event, stream_guard) + device/cuda/.
+
+TPU-native mapping: devices are jax devices; "gpu"/"cuda" names map to
+the accelerator (TPU here); streams collapse to XLA's single ordered
+stream per core — Stream/Event keep the API with record/synchronize
+expressed over jax.block_until_ready (the reference semantics of
+"everything issued so far is done").
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..framework.compat import CPUPlace, CUDAPlace, Place, TPUPlace
+
+__all__ = [
+    "get_all_device_type", "get_all_custom_device_type",
+    "get_available_device", "get_available_custom_device",
+    "get_cudnn_version", "get_device", "set_device", "is_compiled_with_cinn",
+    "is_compiled_with_cuda", "is_compiled_with_custom_device",
+    "is_compiled_with_distribute", "is_compiled_with_ipu",
+    "is_compiled_with_rocm", "is_compiled_with_xpu", "IPUPlace", "XPUPlace",
+    "Stream", "Event", "current_stream", "set_stream", "stream_guard",
+    "synchronize", "cuda",
+]
+
+_current_device = None
+
+
+def get_all_device_type():
+    kinds = {"cpu"}
+    for d in jax.devices():
+        kinds.add("gpu" if d.platform in ("tpu", "axon", "gpu") else
+                  d.platform)
+    return sorted(kinds)
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    out = []
+    for i, _ in enumerate(jax.devices()):
+        out.append(f"gpu:{i}")
+    out.append("cpu")
+    return out
+
+
+def get_available_custom_device():
+    return []
+
+
+def get_cudnn_version():
+    return None            # no cuDNN in a TPU build
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    d = jax.devices()[0]
+    plat = "gpu" if d.platform in ("tpu", "axon") else d.platform
+    return f"{plat}:{d.id}"
+
+
+def set_device(device):
+    global _current_device
+    if isinstance(device, Place):
+        device = ("cpu" if isinstance(device, CPUPlace)
+                  else f"gpu:{device.get_device_id()}")
+    _current_device = str(device)
+    return _current_device
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type):
+    return False
+
+
+def is_compiled_with_distribute():
+    return True            # XLA collectives are always in the build
+
+
+class IPUPlace(Place):
+    _kind = "ipu"
+
+    def __init__(self, id: int = 0):
+        raise NotImplementedError(
+            "IPU hardware is not supported by this TPU-native runtime")
+
+
+class XPUPlace(Place):
+    _kind = "xpu"
+
+
+class Event:
+    """Device event (reference: device/__init__.py Event). On XLA's
+    single-stream model, record() marks the point after all issued work;
+    synchronize()/query() resolve through block-until-ready."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event):
+        return 0.0
+
+
+class Stream:
+    """Device stream (reference: device/__init__.py Stream). XLA runs one
+    ordered stream per core; this handle preserves the API."""
+
+    def __init__(self, device=None, priority=2, blocking=False):
+        self.device = device
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_default_stream = Stream()
+_stream_stack = []
+
+
+def current_stream(device=None):
+    return _stream_stack[-1] if _stream_stack else _default_stream
+
+
+def set_stream(stream):
+    global _default_stream
+    prev = current_stream()
+    _default_stream = stream
+    return prev
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    _stream_stack.append(stream)
+    try:
+        yield
+    finally:
+        _stream_stack.pop()
+
+
+def synchronize(device=None):
+    """Block until all issued device work completes (reference
+    semantics; XLA: wait on a trivially-committed computation)."""
+    try:
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.zeros(()))
+    except Exception:
+        pass
+
+
+from . import cuda  # noqa: E402,F401

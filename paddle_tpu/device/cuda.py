@@ -1,0 +1,85 @@
+"""paddle.device.cuda parity — the accelerator namespace. On this
+runtime "cuda" is the accelerator alias for the TPU (kept so reference
+device-management code runs unchanged).
+
+Reference capability: python/paddle/device/cuda/__init__.py. Memory
+queries surface jax device memory_stats when the backend provides them
+(TPU runtime does; the CPU fallback reports zeros).
+"""
+from __future__ import annotations
+
+import jax
+
+from . import Event, Stream, current_stream, stream_guard, synchronize  # noqa
+
+__all__ = ["Stream", "Event", "current_stream", "device_count",
+           "empty_cache", "get_device_capability", "get_device_name",
+           "get_device_properties", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "synchronize"]
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def empty_cache():
+    """XLA owns the allocator; deallocating framework-side caches is a
+    no-op by design (recorded in docs/CAPABILITY_DELTA.md)."""
+
+
+def _dev(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[min(device, len(devs) - 1)]
+    return devs[0]
+
+
+def get_device_name(device=None):
+    return _dev(device).device_kind
+
+
+def get_device_capability(device=None):
+    return (0, 0)          # CUDA compute capability has no TPU analogue
+
+
+class _Props:
+    def __init__(self, d, stats):
+        self.name = d.device_kind
+        self.major, self.minor = 0, 0
+        self.total_memory = int(stats.get("bytes_limit", 0))
+        self.multi_processor_count = 1
+
+    def __repr__(self):
+        return (f"_gpuDeviceProperties(name='{self.name}', "
+                f"total_memory={self.total_memory // (1024 ** 2)}MB)")
+
+
+def _stats(device=None):
+    try:
+        return _dev(device).memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def get_device_properties(device=None):
+    return _Props(_dev(device), _stats(device))
+
+
+def memory_allocated(device=None):
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    return int(_stats(device).get("bytes_reserved", 0)
+               or _stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)

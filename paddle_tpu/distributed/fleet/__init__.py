@@ -22,3 +22,112 @@ from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa
 # meta_parallel namespace parity (reference: fleet/meta_parallel/__init__.py
 # exports the mpu layers too).
 from . import mp_layers as meta_parallel  # noqa
+
+
+# -- PS-era role makers / data generators (reference: fleet/base/
+# role_maker.py, fleet/data_generator) — parameter-server machinery,
+# recorded as out of scope (docs/CAPABILITY_DELTA.md); Role/UtilBase are
+# kept live because collective mode uses them too.
+
+class Role:
+    """reference: role_maker.py Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UtilBase:
+    """reference: fleet/base/util_factory.py UtilBase — cross-worker
+    helpers. Multi-process: host values ride the KV-store object
+    collectives; single-process they are local identities."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ..env import get_world_size
+
+        if get_world_size() <= 1:
+            return input
+        gathered = self.all_gather(input, comm_world)
+        if mode == "sum":
+            out = gathered[0]
+            for g in gathered[1:]:
+                out = out + g
+            return out
+        if mode == "max":
+            return max(gathered)
+        if mode == "min":
+            return min(gathered)
+        raise ValueError(f"all_reduce: unknown mode {mode!r}")
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _barrier
+
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import all_gather_object
+        from ..env import get_world_size
+
+        if get_world_size() <= 1:
+            return [input]
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        from ..env import get_rank, get_world_size
+
+        n = get_world_size()
+        r = get_rank()
+        return files[r::n]
+
+
+class PaddleCloudRoleMaker:
+    """Collective role maker (reference: role_maker.py
+    PaddleCloudRoleMaker): answers rank/size questions from the
+    jax.distributed environment; PS mode raises."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server role negotiation is out of scope "
+                "(docs/CAPABILITY_DELTA.md); use is_collective=True")
+        self._util = UtilBase()
+
+    def _worker_index(self):
+        from ..env import get_rank
+
+        return get_rank()
+
+    def _worker_num(self):
+        from ..env import get_world_size
+
+        return get_world_size()
+
+    def _is_worker(self):
+        return True
+
+    def _role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._kwargs = kwargs
+
+
+def _ps_gate(name):
+    class _Gated:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} feeds the parameter-server dataset pipeline, "
+                "out of scope on this runtime "
+                "(docs/CAPABILITY_DELTA.md)")
+    _Gated.__name__ = name
+    return _Gated
+
+
+MultiSlotDataGenerator = _ps_gate("MultiSlotDataGenerator")
+MultiSlotStringDataGenerator = _ps_gate("MultiSlotStringDataGenerator")

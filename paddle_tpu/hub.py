@@ -1,0 +1,57 @@
+"""paddle.hub parity: load models from a hubconf.py (reference:
+python/paddle/hub.py help/list/load).
+
+No network egress here, so only ``source='local'`` works: ``repo_dir``
+is a directory containing ``hubconf.py`` whose public callables are the
+hub entry points. GitHub sources raise with that explanation.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["help", "list", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir, source):
+    if source != "local":
+        raise NotImplementedError(
+            f"paddle.hub source={source!r} requires network access, "
+            "unavailable in this environment; clone the repo and use "
+            "source='local'")
+    path = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not found in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not found in {repo_dir}")
+    return fn(**kwargs)

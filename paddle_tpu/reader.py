@@ -1,0 +1,88 @@
+"""paddle.reader parity (reference: python/paddle/reader/decorator.py —
+generator-composition utilities predating paddle.io; kept for old
+recipes)."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn"]
+
+
+def cache(reader):
+    data = []
+
+    def cached():
+        if not data:
+            data.extend(reader())
+        return iter(data)
+
+    return cached
+
+
+def map_readers(func, *readers):
+    def mapped():
+        for items in zip(*(r() for r in readers)):
+            yield func(*items)
+
+    return mapped
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*(r() for r in readers))
+
+    return chained
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.get("check_alignment", True)
+
+    def composed():
+        iters = [r() for r in readers]
+        for items in (zip(*iters) if check_alignment
+                      else itertools.zip_longest(*iters)):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return composed
+
+
+def buffered(reader, size):
+    def buffered_reader():
+        it = reader()
+        while True:
+            chunk = tuple(itertools.islice(it, size))
+            if not chunk:
+                return
+            yield from chunk
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader

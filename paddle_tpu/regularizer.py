@@ -1,0 +1,6 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py):
+L1Decay / L2Decay — the coupled weight-decay regularizers consumed by
+optimizer ``weight_decay=`` and per-param ``ParamAttr.regularizer``."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

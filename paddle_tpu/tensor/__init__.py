@@ -1,0 +1,13 @@
+"""paddle.tensor namespace parity (reference: python/paddle/tensor/ —
+the per-domain op modules re-exported flat). The ops package is the
+single source; this module aliases it so ``paddle.tensor.creation`` /
+``paddle.tensor.math`` style imports from reference recipes resolve."""
+from ..ops import *  # noqa: F401,F403
+from ..ops import (creation, linalg, logic, manipulation, math,  # noqa
+                   random, reduction)
+
+# reference submodule aliases
+search = logic
+attribute = logic
+stat = reduction
+einsum = math

@@ -1,1 +1,84 @@
 
+"""paddle.utils parity: deprecation decorator, version gate, install
+check, lazy import (reference: python/paddle/utils/__init__.py), plus
+the unique_name / dlpack / download submodules."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = ["deprecated", "require_version", "run_check", "try_import",
+           "unique_name", "dlpack", "download", "cpp_extension"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API deprecated (reference: utils/deprecated.py): warns on
+    call; level>=2 raises."""
+
+    def decorator(fn):
+        msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use {update_to} instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range (reference:
+    utils/__init__.py require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+
+
+def run_check():
+    """Smoke-check the install (reference: utils/install_check.py
+    run_check): run a tiny compiled matmul on the available device."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    out = jax.jit(lambda a, b: a @ b)(jnp.ones((2, 3)), jnp.ones((3, 2)))
+    assert out.shape == (2, 2)
+    print(f"paddle_tpu is installed successfully! device: "
+          f"{d.platform}:{d.id} ({d.device_kind})")
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module or raise a helpful error (reference:
+    utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name}. Install it to "
+                       f"use this feature.") from e

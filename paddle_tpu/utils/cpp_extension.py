@@ -82,3 +82,14 @@ def setup(name: str, ext_modules=None, **kwargs):
     return [load(f"{name}_{i}", e.sources,
                  extra_cxx_flags=e.extra_compile_args)
             for i, e in enumerate(exts)]
+
+
+def CUDAExtension(*args, **kwargs):
+    """reference: cpp_extension.CUDAExtension — nvcc-compiled extensions.
+    This is a TPU build with no CUDA toolchain; use CppExtension (g++)
+    for host code and Pallas for device kernels
+    (docs/CAPABILITY_DELTA.md)."""
+    raise NotImplementedError(
+        "CUDAExtension requires the CUDA toolchain; this TPU-native build "
+        "compiles host extensions with CppExtension (g++) and device "
+        "kernels with Pallas")

@@ -1,0 +1,41 @@
+"""paddle.utils.dlpack parity (reference:
+python/paddle/utils/dlpack.py): zero-copy tensor interchange. JAX arrays
+speak the DLPack protocol natively (`__dlpack__`), so torch/numpy/cupy
+consumers interoperate directly."""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _Carrier:
+    """Holds a DLPack capsule plus its device so consumers that require
+    the full protocol (__dlpack__ AND __dlpack_device__) can ingest it.
+    The capsule is single-use, like the reference's."""
+
+    def __init__(self, capsule, device):
+        self._capsule = capsule
+        self._device = device
+
+    def __dlpack__(self, stream=None, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return self._device
+
+
+def to_dlpack(x):
+    from ..ops._op import unwrap
+
+    arr = unwrap(x)
+    return _Carrier(arr.__dlpack__(), arr.__dlpack_device__())
+
+
+def from_dlpack(dlpack):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if hasattr(dlpack, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(dlpack))
+    # bare capsule (e.g. from torch.utils.dlpack.to_dlpack): assume host
+    return Tensor(jnp.from_dlpack(_Carrier(dlpack, (1, 0))))

@@ -1,0 +1,21 @@
+"""paddle.utils.download parity (reference:
+python/paddle/utils/download.py get_weights_path_from_url). No network
+egress in this environment: resolves only paths already present in the
+local weights cache and raises with instructions otherwise."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"downloading {url} requires network access, unavailable in this "
+        f"environment; place the file at {path} manually")

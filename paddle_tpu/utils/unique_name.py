@@ -1,0 +1,42 @@
+"""paddle.utils.unique_name parity (reference:
+python/paddle/utils/unique_name.py): generate / guard / switch over a
+per-context name counter."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = defaultdict(int)
+
+    def __call__(self, key):
+        n = self.ids[key]
+        self.ids[key] += 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

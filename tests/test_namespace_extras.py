@@ -1,0 +1,406 @@
+"""Tests for the second namespace-completion batch: device, callbacks,
+hub, regularizer, tensor/reader aliases, amp.debugging, utils
+(unique_name/dlpack/deprecated), incubate fused layers + autograd."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(x, **kw):
+    return paddle.to_tensor(x, **kw)
+
+
+class TestDeviceNamespace:
+    def test_queries(self):
+        assert "cpu" in paddle.device.get_all_device_type()
+        assert paddle.device.cuda.device_count() >= 1
+        assert isinstance(paddle.device.get_device(), str)
+        assert paddle.device.is_compiled_with_cuda() is False
+        assert paddle.device.is_compiled_with_distribute() is True
+        assert paddle.device.get_cudnn_version() is None
+
+    def test_streams_events(self):
+        s = paddle.device.Stream()
+        ev = s.record_event()
+        assert ev.query() is True
+        with paddle.device.stream_guard(s):
+            assert paddle.device.current_stream() is s
+        paddle.device.synchronize()
+        ev.synchronize()
+
+    def test_cuda_memory_queries(self):
+        assert paddle.device.cuda.memory_allocated() >= 0
+        props = paddle.device.cuda.get_device_properties()
+        assert hasattr(props, "total_memory")
+
+    def test_set_device(self):
+        assert paddle.device.set_device("cpu") == "cpu"
+
+
+class TestCallbacksNamespace:
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.optimizer import SGD
+
+        lin = nn.Linear(2, 1)
+        opt = SGD(learning_rate=1.0, parameters=lin.parameters())
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=1, verbose=0)
+
+        class _M:
+            _optimizer = opt
+        cb.model = _M()
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})   # no improvement -> wait=1 >= patience
+        assert abs(opt.get_lr() - 0.5) < 1e-9
+
+    def test_tracker_callbacks_gated(self):
+        v = paddle.callbacks.VisualDL("/tmp/vdl")
+        with pytest.raises(RuntimeError):
+            v.on_train_batch_end(0, {"loss": 1.0})
+
+
+class TestHubAndUtils:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    'a tiny hub model'\n"
+            "    return {'scale': scale}\n")
+        assert "tiny_model" in paddle.hub.list(str(tmp_path))
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+        assert paddle.hub.load(str(tmp_path), "tiny_model",
+                               scale=3) == {"scale": 3}
+        with pytest.raises(NotImplementedError):
+            paddle.hub.list("owner/repo", source="github")
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        with unique_name.guard():
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+        x = t(np.arange(6, dtype="float32").reshape(2, 3))
+        cap = to_dlpack(x)
+        y = from_dlpack(cap)
+        np.testing.assert_allclose(np.asarray(y.numpy()), x.numpy())
+
+    def test_deprecated_and_versions(self):
+        from paddle_tpu.utils import deprecated, require_version, try_import
+
+        @deprecated(update_to="new_api", since="0.1")
+        def old():
+            return 7
+
+        with pytest.warns(DeprecationWarning):
+            assert old() == 7
+        require_version("0.0.1")
+        with pytest.raises(Exception):
+            require_version("999.0.0")
+        assert try_import("math") is not None
+        with pytest.raises(ImportError):
+            try_import("definitely_not_a_module_xyz")
+
+    def test_cuda_extension_gated(self):
+        from paddle_tpu.utils.cpp_extension import CUDAExtension
+
+        with pytest.raises(NotImplementedError):
+            CUDAExtension(sources=["x.cu"])
+
+    def test_onnx_gate_saves_stablehlo(self, tmp_path):
+        import paddle_tpu.jit as jit
+
+        lin = nn.Linear(3, 2)
+        sf = jit.to_static(lin, input_spec=[
+            jit.InputSpec([None, 3], "float32")])
+        with pytest.raises(NotImplementedError, match="StableHLO"):
+            paddle.onnx.export(lin, str(tmp_path / "m.onnx"),
+                               input_spec=[jit.InputSpec([None, 3],
+                                                         "float32")])
+
+    def test_reader_composition(self):
+        r = paddle.reader.firstn(
+            paddle.reader.shuffle(lambda: iter(range(10)), 5), 4)
+        assert len(list(r())) == 4
+        m = paddle.reader.map_readers(lambda a, b: a + b,
+                                      lambda: iter([1, 2]),
+                                      lambda: iter([10, 20]))
+        assert list(m()) == [11, 22]
+
+
+class TestAmpDebugging:
+    def test_operator_stats(self, capsys):
+        from paddle_tpu.amp import debugging as dbg
+
+        with dbg.collect_operator_stats():
+            _ = t([1.0]) + t([2.0])
+            _ = t([[1.0, 2.0]]) @ t([[1.0], [2.0]])
+        out = capsys.readouterr().out
+        assert "op list" in out and "calls:" in out
+
+    def test_check_numerics(self):
+        from paddle_tpu.amp import debugging as dbg
+
+        dbg.check_numerics(t([1.0, 2.0]))    # clean passes
+        with pytest.raises(RuntimeError, match="nan"):
+            dbg.check_numerics(t([float("nan")]))
+
+    def test_tensor_checker_toggle(self):
+        from paddle_tpu.amp import debugging as dbg
+        from paddle_tpu.core.flags import get_flags
+
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(enable=True))
+        assert get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        dbg.disable_tensor_checker()
+        assert not get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+
+
+class TestIncubateFused:
+    def test_fused_linear_matmul_bias(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+        from paddle_tpu.incubate.nn.functional import fused_matmul_bias
+
+        lin = FusedLinear(4, 3)
+        x = t(np.random.default_rng(0).normal(size=(2, 4)).astype("float32"))
+        out = lin(x)
+        want = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-5)
+        out2 = fused_matmul_bias(x, t(lin.weight.numpy()),
+                                 t(lin.bias.numpy()))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), want,
+                                   rtol=1e-5)
+
+    def test_fused_feedforward_and_mha(self):
+        from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                            FusedMultiHeadAttention,
+                                            FusedTransformerEncoderLayer)
+
+        x = t(np.random.default_rng(1).normal(size=(2, 5, 8))
+              .astype("float32"))
+        ffn = FusedFeedForward(8, 16, dropout_rate=0.0)
+        ffn.eval()
+        assert ffn(x).shape == [2, 5, 8]
+        mha = FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0)
+        mha.eval()
+        assert mha(x).shape == [2, 5, 8]
+        enc = FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+        enc.eval()
+        assert enc(x).shape == [2, 5, 8]
+
+    def test_fused_ec_moe(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+
+        moe = FusedEcMoe(8, 16, num_experts=4)
+        x = t(np.random.default_rng(2).normal(size=(2, 3, 8))
+              .astype("float32"))
+        assert moe(x).shape == [2, 3, 8]
+
+    def test_masked_mha_decode(self):
+        from paddle_tpu.incubate.nn.functional import \
+            masked_multihead_attention
+
+        b, nh, hd, t_max = 2, 2, 4, 6
+        rng = np.random.default_rng(3)
+        x = t(rng.normal(size=(b, 3 * nh * hd)).astype("float32"))
+        cache = t(np.zeros((2, b, nh, t_max, hd), "float32"))
+        out, new_cache = masked_multihead_attention(
+            x, cache_kv=cache,
+            sequence_lengths=t(np.zeros((b,), "int32")))
+        assert out.shape == [b, nh * hd]
+        assert new_cache.shape == [2, b, nh, t_max, hd]
+        # at step 0 attention sees only the just-written kv -> out == v
+        qkv = x.numpy().reshape(b, 3, nh, hd)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   qkv[:, 2].reshape(b, -1), rtol=1e-5)
+
+    def test_varlen_memory_efficient(self):
+        from paddle_tpu.incubate.nn.functional import \
+            variable_length_memory_efficient_attention
+
+        rng = np.random.default_rng(4)
+        q = t(rng.normal(size=(1, 2, 4, 8)).astype("float32"))
+        k = t(rng.normal(size=(1, 2, 4, 8)).astype("float32"))
+        v = t(rng.normal(size=(1, 2, 4, 8)).astype("float32"))
+        out = variable_length_memory_efficient_attention(
+            q, k, v, t(np.array([4], "int32")), t(np.array([4], "int32")))
+        assert out.shape == [1, 2, 4, 8]
+
+    def test_fused_dropout_add_and_bias_ln(self):
+        from paddle_tpu.incubate.nn import (
+            FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd)
+
+        x = t(np.ones((2, 4), "float32"))
+        y = t(np.full((2, 4), 2.0, "float32"))
+        fda = FusedDropoutAdd(p=0.0)
+        np.testing.assert_allclose(np.asarray(fda(x, y).numpy()),
+                                   np.full((2, 4), 3.0))
+        ln = FusedBiasDropoutResidualLayerNorm(4, dropout_rate=0.0)
+        ln.eval()
+        assert ln(x, y).shape == [2, 4]
+
+
+class TestIncubateAutograd:
+    def test_vjp_jvp(self):
+        from paddle_tpu.incubate.autograd import jvp, vjp
+
+        def f(x):
+            return x * x
+
+        x = t(np.array([2.0, 3.0], "float32"))
+        out, grads = vjp(f, x)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 9.0])
+        np.testing.assert_allclose(np.asarray(grads[0].numpy()),
+                                   [4.0, 6.0])
+        out, tangent = jvp(f, x, t(np.array([1.0, 0.0], "float32")))
+        np.testing.assert_allclose(np.asarray(tangent.numpy()), [4.0, 0.0])
+
+    def test_jacobian_hessian_objects(self):
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+
+        def f(x):
+            return (x * x).sum()
+
+        x = t(np.array([1.0, 2.0], "float32"))
+        h = Hessian(f, x)
+        np.testing.assert_allclose(np.asarray(h[:].numpy()),
+                                   2.0 * np.eye(2), rtol=1e-5)
+
+        def g(x):
+            return x * 3.0
+
+        j = Jacobian(g, x)
+        np.testing.assert_allclose(np.asarray(j[:].numpy()),
+                                   3.0 * np.eye(2), rtol=1e-5)
+
+    def test_prim_toggles(self):
+        from paddle_tpu.incubate import autograd as ia
+
+        ia.enable_prim()
+        ia.disable_prim()
+
+
+class TestFleetRoleMakers:
+    def test_collective_role_maker(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm._worker_num() >= 1
+        assert rm._is_worker()
+        util = fleet.UtilBase()
+        assert util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+        with pytest.raises(NotImplementedError):
+            fleet.MultiSlotDataGenerator()
+        with pytest.raises(NotImplementedError):
+            fleet.PaddleCloudRoleMaker(is_collective=False)
+
+
+class TestFusedGradFlow:
+    def test_fused_mha_trains_qkv(self):
+        """Review regression: the fused MHA block must deliver gradients
+        to the qkv projection (it previously severed the tape)."""
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        mha = FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0)
+        x = t(np.random.default_rng(5).normal(size=(2, 4, 8))
+              .astype("float32"))
+        loss = mha(x).sum()
+        loss.backward()
+        g = np.asarray(mha.qkv_weight.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        assert np.abs(np.asarray(mha.linear_weight.grad.numpy())).sum() > 0
+
+    def test_varlen_padded_rows_zero(self):
+        from paddle_tpu.incubate.nn.functional import \
+            variable_length_memory_efficient_attention
+
+        rng = np.random.default_rng(6)
+        q = t(rng.normal(size=(1, 2, 4, 8)).astype("float32"))
+        k = t(rng.normal(size=(1, 2, 4, 8)).astype("float32"))
+        v = t(rng.normal(size=(1, 2, 4, 8)).astype("float32"))
+        out = variable_length_memory_efficient_attention(
+            q, k, v, t(np.array([2], "int32")), t(np.array([2], "int32")))
+        arr = np.asarray(out.numpy())
+        assert np.isfinite(arr).all()
+        np.testing.assert_allclose(arr[:, :, 2:], 0.0)
+        # additive mask is honored
+        bias = np.zeros((1, 2, 4, 4), "float32")
+        bias[..., 0] = -1e9        # forbid key 0
+        out_m = variable_length_memory_efficient_attention(
+            q, k, v, t(np.array([2], "int32")), t(np.array([2], "int32")),
+            mask=t(bias))
+        assert not np.allclose(np.asarray(out_m.numpy())[:, :, :2],
+                               arr[:, :, :2])
+
+    def test_multi_transformer_decode_with_cache(self):
+        from paddle_tpu.incubate.nn.functional import \
+            fused_multi_transformer
+
+        rng = np.random.default_rng(7)
+        d, nh, hd, t_max = 8, 2, 4, 6
+
+        def mk(*shape):
+            return t(rng.normal(size=shape).astype("float32") * 0.1)
+
+        ws = dict(
+            ln_scales=[t(np.ones(d, "float32"))],
+            ln_biases=[t(np.zeros(d, "float32"))],
+            qkv_weights=[mk(3, nh, hd, d)],
+            qkv_biases=[t(np.zeros(3 * d, "float32"))],
+            linear_weights=[mk(d, d)],
+            linear_biases=[t(np.zeros(d, "float32"))],
+            ffn_ln_scales=[t(np.ones(d, "float32"))],
+            ffn_ln_biases=[t(np.zeros(d, "float32"))],
+            ffn1_weights=[mk(d, 16)],
+            ffn1_biases=[t(np.zeros(16, "float32"))],
+            ffn2_weights=[mk(16, d)],
+            ffn2_biases=[t(np.zeros(d, "float32"))],
+        )
+        x = mk(2, 1, d)
+        caches = [t(np.zeros((2, 2, nh, t_max, hd), "float32"))]
+        out, new_caches = fused_multi_transformer(
+            x, cache_kvs=caches, time_step=t(np.array([0], "int32")),
+            **ws)
+        assert out.shape == [2, 1, d]
+        assert new_caches[0].shape == [2, 2, nh, t_max, hd]
+        # the cache now holds this step's k/v at position 0
+        assert np.abs(np.asarray(new_caches[0].numpy())[:, :, :, 0]).sum() > 0
+        assert np.abs(np.asarray(new_caches[0].numpy())[:, :, :, 1:]).sum() == 0
+
+    def test_masked_mha_rotary(self):
+        from paddle_tpu.incubate.nn.functional import \
+            masked_multihead_attention
+
+        b, nh, hd, t_max = 1, 1, 4, 4
+        rng = np.random.default_rng(8)
+        x = t(rng.normal(size=(b, 3 * nh * hd)).astype("float32"))
+        cache = t(np.zeros((2, b, nh, t_max, hd), "float32"))
+        rot = np.zeros((b, 1, 1, t_max, hd), "float32")
+        rot[..., 0::2] = 1.0          # cos=1, sin=0 -> identity rotation
+        out_id, _ = masked_multihead_attention(
+            x, cache_kv=cache, rotary_tensor=t(rot),
+            sequence_lengths=t(np.zeros((b,), "int32")))
+        out_none, _ = masked_multihead_attention(
+            x, cache_kv=cache,
+            sequence_lengths=t(np.zeros((b,), "int32")))
+        np.testing.assert_allclose(np.asarray(out_id.numpy()),
+                                   np.asarray(out_none.numpy()), rtol=1e-5)
+        rot2 = np.zeros_like(rot)
+        rot2[..., 1::2] = 1.0         # cos=0, sin=1 -> real rotation
+        _, cache_rot = masked_multihead_attention(
+            x, cache_kv=cache, rotary_tensor=t(rot2),
+            sequence_lengths=t(np.zeros((b,), "int32")))
+        _, cache_none = masked_multihead_attention(
+            x, cache_kv=cache,
+            sequence_lengths=t(np.zeros((b,), "int32")))
+        # k is written to the cache rotated: (t1,t2) -> (-t2, t1)
+        k_rot = np.asarray(cache_rot.numpy())[0, 0, 0, 0]
+        k_raw = np.asarray(cache_none.numpy())[0, 0, 0, 0]
+        np.testing.assert_allclose(k_rot[0::2], -k_raw[1::2], rtol=1e-5)
+        np.testing.assert_allclose(k_rot[1::2], k_raw[0::2], rtol=1e-5)
