@@ -44,8 +44,12 @@ class ReduceLROnPlateau(Callback):
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
         if self._cooldown_counter > 0:
+            # in cooldown: track the best but don't accumulate patience
             self._cooldown_counter -= 1
             self._wait = 0
+            if self._better(cur, self._best):
+                self._best = cur
+            return
         if self._better(cur, self._best):
             self._best = cur
             self._wait = 0

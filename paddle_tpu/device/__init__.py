@@ -45,9 +45,13 @@ def get_all_custom_device_type():
 
 def get_available_device():
     out = []
-    for i, _ in enumerate(jax.devices()):
-        out.append(f"gpu:{i}")
-    out.append("cpu")
+    for d in jax.devices():
+        plat = "gpu" if d.platform in ("tpu", "axon", "gpu") else d.platform
+        name = f"{plat}:{d.id}"
+        if name not in out:
+            out.append(name)
+    if "cpu" not in {n.split(":")[0] for n in out}:
+        out.append("cpu")
     return out
 
 

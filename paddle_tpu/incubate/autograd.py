@@ -13,6 +13,7 @@ __all__ = ["vjp", "jvp", "Jacobian", "Hessian", "disable_prim",
            "enable_prim", "forward_grad", "grad"]
 
 import jax
+import numpy as np
 
 from ..core.tensor import Tensor
 
@@ -64,14 +65,31 @@ def jvp(func, xs, v=None):
 
 class Jacobian:
     """Lazy functional Jacobian (reference: incubate/autograd/functional
-    Jacobian): J = Jacobian(func, xs); J[:] materializes."""
+    Jacobian): J = Jacobian(func, xs); J[:] materializes. A list xs
+    yields the block matrix [d f/d x0 | d f/d x1 | ...] like the
+    reference (columns concatenated over inputs)."""
 
     def __init__(self, func, xs, is_batched=False):
-        xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
-        self._mat = jax.jacrev(_pure(func))(*[x._data for x in xs_l])
-        if isinstance(self._mat, tuple):
-            self._mat = self._mat[0]
-        # collapse to 2D [out_size, in_size] (batched: keep batch axis)
+        import jax.numpy as jnp
+
+        multi = isinstance(xs, (list, tuple))
+        xs_l = list(xs) if multi else [xs]
+        arrays = [x._data for x in xs_l]
+        mats = jax.jacrev(_pure(func),
+                          argnums=tuple(range(len(arrays))))(*arrays)
+        if not isinstance(mats, tuple):
+            mats = (mats,)
+        if not multi:
+            self._mat = mats[0]
+        else:
+            # block matrix: rows = flattened output, columns concatenated
+            # over every input's flattened size
+            blocks = []
+            for m, a in zip(mats, arrays):
+                out_nd = m.ndim - a.ndim
+                out_size = int(np.prod(m.shape[:out_nd])) if out_nd else 1
+                blocks.append(m.reshape(out_size, -1))
+            self._mat = jnp.concatenate(blocks, axis=1)
         self._is_batched = is_batched
 
     @property
@@ -89,12 +107,23 @@ class Jacobian:
 
 class Hessian(Jacobian):
     def __init__(self, func, xs, is_batched=False):
-        xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
-        self._mat = jax.hessian(_pure(func))(*[x._data for x in xs_l])
-        if isinstance(self._mat, tuple):
-            self._mat = self._mat[0]
-            if isinstance(self._mat, tuple):
-                self._mat = self._mat[0]
+        import jax.numpy as jnp
+
+        multi = isinstance(xs, (list, tuple))
+        xs_l = list(xs) if multi else [xs]
+        arrays = [x._data for x in xs_l]
+        h = jax.hessian(_pure(func),
+                        argnums=tuple(range(len(arrays))))(*arrays)
+        if not multi:
+            self._mat = h[0][0] if isinstance(h, tuple) else h
+        else:
+            # block Hessian: H[i][j] = d^2 f / d x_i d x_j flattened
+            rows = []
+            for i, ai in enumerate(arrays):
+                cols = [h[i][j].reshape(int(np.prod(ai.shape)), -1)
+                        for j in range(len(arrays))]
+                rows.append(jnp.concatenate(cols, axis=1))
+            self._mat = jnp.concatenate(rows, axis=0)
         self._is_batched = is_batched
 
 

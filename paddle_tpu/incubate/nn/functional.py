@@ -178,6 +178,12 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     op end to end, so every weight (qkv included) receives gradients."""
     from ...framework import random as frandom
 
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: cached decode is served by "
+            "fused_multi_transformer(cache_kvs=...) / "
+            "masked_multihead_attention, which return the updated cache")
+
     need_key = (training and (dropout_rate > 0.0
                               or attn_dropout_rate > 0.0))
     keys = frandom.next_key() if need_key else None
@@ -257,22 +263,17 @@ def fused_ec_moe(x, gate_weight, expert_weight1, expert_bias1,
     incubate/nn/functional/fused_ec_moe.py): softmax gate over experts,
     every expert computes, outputs mix by gate prob — the einsum form
     the TPU MXU likes."""
-    from ... import nn
-    from ...ops._op import op_fn
+    return _fused_ec_moe_op(x, gate_weight, expert_weight1, expert_bias1,
+                            expert_weight2, expert_bias2, act=act_type)
 
-    @op_fn(name="fused_ec_moe_inner")
-    def _moe(x, gw, w1, b1, w2, b2, *, act):
-        import jax
-        import jax.numpy as jnp
 
-        probs = jax.nn.softmax(x @ gw, axis=-1)        # [B, S, E]
-        h = jnp.einsum("bsd,edf->bsef", x, w1) + b1[None, None]
-        h = jax.nn.gelu(h) if act == "gelu" else jnp.maximum(h, 0)
-        o = jnp.einsum("bsef,efd->bsed", h, w2) + b2[None, None]
-        return jnp.einsum("bse,bsed->bsd", probs, o)
-
-    return _moe(x, gate_weight, expert_weight1, expert_bias1,
-                expert_weight2, expert_bias2, act=act_type)
+@op_fn(name="fused_ec_moe_inner")
+def _fused_ec_moe_op(x, gw, w1, b1, w2, b2, *, act):
+    probs = jax.nn.softmax(x @ gw, axis=-1)        # [B, S, E]
+    h = jnp.einsum("bsd,edf->bsef", x, w1) + b1[None, None]
+    h = jax.nn.gelu(h) if act == "gelu" else jnp.maximum(h, 0)
+    o = jnp.einsum("bsef,efd->bsed", h, w2) + b2[None, None]
+    return jnp.einsum("bse,bsed->bsd", probs, o)
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
@@ -442,10 +443,16 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     ql = unwrap(seq_lens).reshape(-1)
     kl = unwrap(kv_seq_lens).reshape(-1)
     qv = jnp.arange(sq)[None, :] < ql[:, None]       # [B, Sq]
-    kv = jnp.arange(sk)[None, :] < kl[:, None]       # [B, Sk]
+    # pre-cache keys (a shared prompt prefix) are always attendable; the
+    # per-sample kv length counts keys after the prefix
+    kidx = jnp.arange(sk)[None, :]
+    kv = (kidx < pre_cache_length) | \
+        (kidx - pre_cache_length < kl[:, None])      # [B, Sk]
     allowed = qv[:, None, :, None] & kv[:, None, None, :]
     if causal:
-        allowed = allowed & (jnp.arange(sq)[:, None]
+        # decode alignment: the last query row attends all keys
+        # (q_idx + (sk - sq) >= k_idx — cf. sdpa_reference tril(k=sk-sq))
+        allowed = allowed & (jnp.arange(sq)[:, None] + (sk - sq)
                              >= jnp.arange(sk)[None, :])[None, None]
     if mask is not None:
         # additive mask composes with the length mask: fold it into a

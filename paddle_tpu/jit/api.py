@@ -81,6 +81,12 @@ class _Program:
         self.out_tree_store = out_tree_store
 
 
+class _GraphBreak(Exception):
+    """Raised at trace time when the user function branches on a tensor
+    value; full_graph=False converts it into an eager fallback (the
+    reference's SOT graph-break semantics)."""
+
+
 class StaticFunction:
     """Callable wrapper produced by ``to_static``
     (reference: dy2static/program_translator.py StaticFunction).
@@ -106,6 +112,11 @@ class StaticFunction:
         self._programs: Dict[tuple, _Program] = {}
         self._bucket_batch = bool(bucket_batch)
         self._bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
+        # full_graph=False: a capture failure (data-dependent Python
+        # branch) becomes a graph break — that signature runs eagerly
+        # with a one-time warning, like the reference's SOT fallback.
+        self._full_graph = bool(full_graph)
+        self._eager_keys: set = set()
         functools.update_wrapper(self, fn)
 
     def _bucket_of(self, n: int) -> int:
@@ -190,7 +201,7 @@ class StaticFunction:
                         out = fn(*arg_arrays, **kwarg_arrays)
                     except (jax.errors.TracerBoolConversionError,
                             jax.errors.ConcretizationTypeError) as e:
-                        raise RuntimeError(
+                        raise _GraphBreak(
                             "to_static: the function branches on a tensor "
                             "VALUE, which trace-based capture cannot "
                             "record (the reference's SOT guards exist for "
@@ -198,7 +209,10 @@ class StaticFunction:
                             "branch with paddle_tpu.where / lax.cond, or "
                             "keep it out of the to_static region. Python "
                             "branches on non-tensor values are baked at "
-                            "trace time per input signature.") from e
+                            "trace time per input signature. "
+                            "(full_graph=False falls back to eager "
+                            "execution instead of raising — the "
+                            "reference's SOT graph-break behavior.)") from e
                 new_buffers = {n: b._data for n, b in named_buffers}
                 flat, tree = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))
@@ -234,6 +248,24 @@ class StaticFunction:
 
     def __wrapped_call(self, args, kwargs):
         key = self._cache_key(args, kwargs)
+        if key in self._eager_keys:
+            return self._fn(*args, **kwargs)
+        try:
+            return self.__compiled_call(key, args, kwargs)
+        except _GraphBreak as e:
+            if self._full_graph:
+                raise RuntimeError(str(e)) from e
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in {getattr(self._fn, '__name__', self._fn)} "
+                "(data-dependent Python branch); this input signature "
+                "runs eagerly (full_graph=False)", stacklevel=3)
+            self._eager_keys.add(key)
+            self._programs.pop(key, None)
+            return self._fn(*args, **kwargs)
+
+    def __compiled_call(self, key, args, kwargs):
         prog = self._programs.get(key)
         if prog is None:
             prog = self._build_program(args, kwargs)
@@ -318,8 +350,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
               bucket_sizes=None, **kwargs):
     """paddle.jit.to_static parity (reference: jit/api.py:136).
     ``bucket_batch``/``bucket_sizes``: see StaticFunction — pad variable
-    leading dims to buckets so XLA recompiles O(log max_batch) times."""
-    extra = dict(bucket_batch=bucket_batch, bucket_sizes=bucket_sizes)
+    leading dims to buckets so XLA recompiles O(log max_batch) times.
+    ``full_graph=False``: data-dependent Python branches become graph
+    breaks (eager fallback with a warning) instead of errors — the
+    reference's SOT capture mode."""
+    extra = dict(bucket_batch=bucket_batch, bucket_sizes=bucket_sizes,
+                 full_graph=full_graph)
 
     def decorate(obj):
         if isinstance(obj, Layer):

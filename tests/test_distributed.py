@@ -418,3 +418,26 @@ class TestNoSyncAccumulation:
             np.testing.assert_allclose(acc, full, rtol=1e-4, atol=1e-6)
         finally:
             dist.set_mesh(None)
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_roundtrip(self, tmp_path):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        sd = {"w": paddle.to_tensor(np.arange(12, dtype="float32")
+                                    .reshape(3, 4)),
+              "step": 7}
+        handle = ckpt.async_save_state_dict(sd, str(tmp_path / "ck"))
+        # caller may mutate immediately after return
+        sd["w"].set_value(np.zeros((3, 4), "float32"))
+        handle.result(timeout=60)
+        assert handle.done()
+        target = {"w": paddle.to_tensor(np.zeros((3, 4), "float32")),
+                  "step": 0}
+        ckpt.load_state_dict(target, str(tmp_path / "ck"))
+        np.testing.assert_allclose(
+            np.asarray(target["w"].numpy()),
+            np.arange(12, dtype="float32").reshape(3, 4))

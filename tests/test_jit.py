@@ -271,3 +271,55 @@ class TestBatchBucketing:
             o = sf(2.0, paddle.to_tensor(np.ones((3, 2), "float32")))
         np.testing.assert_allclose(np.asarray(o.numpy()),
                                    np.full((3, 2), 2.0))
+
+
+class TestGraphBreakFallback:
+    def test_full_graph_false_falls_back(self):
+        import warnings
+
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+
+        def branchy(x):
+            if float(x.sum().numpy() if hasattr(x.sum(), 'numpy')
+                     else x.sum()) > 0:   # data-dependent python branch
+                return x * 2.0
+            return x - 1.0
+
+        def branchy_traced(x):
+            # under tracing x.sum() is a tracer; bool() raises
+            s = x.sum()
+            if s > 0:
+                return x * 2.0
+            return x - 1.0
+
+        sf = jit.to_static(branchy_traced, full_graph=False)
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = sf(x)
+        assert any("graph break" in str(wi.message) for wi in w)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.full((2, 2), 2.0))
+        # second call with the same signature: silent eager, no rewarn
+        out2 = sf(x)
+        np.testing.assert_allclose(np.asarray(out2.numpy()),
+                                   np.full((2, 2), 2.0))
+
+    def test_full_graph_true_raises(self):
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+
+        def branchy(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x
+
+        sf = jit.to_static(branchy, full_graph=True)
+        with pytest.raises(RuntimeError, match="branches on a tensor"):
+            sf(paddle.to_tensor(np.ones((2, 2), "float32")))

@@ -404,3 +404,79 @@ class TestFusedGradFlow:
         k_raw = np.asarray(cache_none.numpy())[0, 0, 0, 0]
         np.testing.assert_allclose(k_rot[0::2], -k_raw[1::2], rtol=1e-5)
         np.testing.assert_allclose(k_rot[1::2], k_raw[0::2], rtol=1e-5)
+
+
+class TestReviewRegressions2:
+    def test_jacobian_multi_input_block(self):
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+
+        def f(x, y):
+            return (x * x).sum() + 3.0 * (y * y).sum()
+
+        x = t(np.array([1.0, 2.0], "float32"))
+        y = t(np.array([3.0], "float32"))
+        j = Jacobian(f, [x, y])
+        np.testing.assert_allclose(np.asarray(j[:].numpy()),
+                                   [[2.0, 4.0, 18.0]], rtol=1e-5)
+        h = Hessian(f, [x, y])
+        want = np.diag([2.0, 2.0, 6.0])
+        np.testing.assert_allclose(np.asarray(h[:].numpy()), want,
+                                   rtol=1e-5)
+
+    def test_reduce_lr_cooldown(self):
+        from paddle_tpu.optimizer import SGD
+
+        lin = nn.Linear(2, 1)
+        opt = SGD(learning_rate=1.0, parameters=lin.parameters())
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=1, cooldown=3, verbose=0)
+
+        class _M:
+            _optimizer = opt
+        cb.model = _M()
+        for _ in range(5):       # plateau through the cooldown window
+            cb.on_eval_end({"loss": 1.0})
+        # one reduction at step 2, then 3 cooldown evals absorb the rest
+        assert abs(opt.get_lr() - 0.5) < 1e-9
+
+    def test_varlen_decode_causal_alignment(self):
+        from paddle_tpu.incubate.nn.functional import \
+            variable_length_memory_efficient_attention as vl
+
+        rng = np.random.default_rng(9)
+        # decode shape: one query over 4 cached keys -> all attendable
+        q = t(rng.normal(size=(1, 1, 1, 8)).astype("float32"))
+        k = t(rng.normal(size=(1, 1, 4, 8)).astype("float32"))
+        v = t(rng.normal(size=(1, 1, 4, 8)).astype("float32"))
+        out = vl(q, k, v, t(np.array([1], "int32")),
+                 t(np.array([4], "int32")), causal=True)
+        # equals full (non-causal) attention for the single last-row query
+        want = vl(q, k, v, t(np.array([1], "int32")),
+                  t(np.array([4], "int32")), causal=False)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want.numpy()), rtol=1e-5)
+
+    def test_fused_mha_cache_gate(self):
+        from paddle_tpu.incubate.nn.functional import \
+            fused_multi_head_attention
+
+        with pytest.raises(NotImplementedError, match="cached decode"):
+            fused_multi_head_attention(
+                t(np.zeros((1, 2, 8), "float32")),
+                t(np.zeros((3, 2, 4, 8), "float32")),
+                t(np.zeros((8, 8), "float32")),
+                cache_kv=t(np.zeros((2, 1, 2, 4, 4), "float32")))
+
+    def test_async_result_timeout_raises(self, tmp_path):
+        import threading
+        import time as _time
+
+        from paddle_tpu.distributed.checkpoint import AsyncSaveHandle
+
+        box = []
+        th = threading.Thread(target=lambda: _time.sleep(1.5))
+        th.start()
+        h = AsyncSaveHandle(th, box)
+        with pytest.raises(TimeoutError):
+            h.result(timeout=0.05)
+        h.result(timeout=10)     # completes cleanly afterwards
