@@ -141,10 +141,6 @@ def in_static_mode() -> bool:
     return _static_mode
 
 
-def _monkeypatch_tensor_repr():
-    pass
-
-
 # Pallas kernels self-select on TPU backends (KernelFactory-style dispatch).
 kernels.auto_register()
 

@@ -189,3 +189,35 @@ class TestDistribution:
         m = D.Multinomial(10, np.array([0.2, 0.3, 0.5], "float32"))
         s = m.sample([50]).numpy()
         assert (s.sum(-1) == 10).all()
+
+
+class TestReduceLROnPlateauWithFit:
+    def test_plateau_callback_reduces_lr_through_fit(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Zeros(Dataset):
+            def __getitem__(self, i):
+                return (np.zeros(4, "float32"), np.zeros(1, "float32"))
+
+            def __len__(self):
+                return 8
+
+        net = nn.Linear(4, 1)
+        # weights start at a fixed point of the data (all-zero targets &
+        # inputs): loss is constant -> guaranteed plateau
+        net.weight.set_value(np.zeros((4, 1), "float32"))
+        net.bias.set_value(np.zeros((1,), "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=net.parameters())
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=nn.MSELoss())
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                                patience=1, verbose=0)
+        loader = DataLoader(Zeros(), batch_size=4)
+        model.fit(loader, eval_data=loader, epochs=4, verbose=0,
+                  callbacks=[cb])
+        assert opt.get_lr() < 1.0
