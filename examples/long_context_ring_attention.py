@@ -1,0 +1,44 @@
+"""Long-context training via ring attention (sequence/context
+parallelism over the mesh — the SEP capability).
+
+Run (8 simulated devices):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/long_context_ring_attention.py
+
+Each device holds a sequence shard; keys/values rotate around the ring
+(ppermute over ICI on real hardware) with an online-softmax accumulator,
+so attention over the FULL sequence is computed without any device ever
+holding all of it.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.kernels.ring_attention import ring_attention
+
+devs = jax.devices()
+mesh = Mesh(np.array(devs), ("sp",))
+B, S, H, D = 2, 8 * 128, 4, 32          # sequence 1024 over 8 shards
+rng = np.random.default_rng(0)
+q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32) * 0.1
+           for _ in range(3))
+
+sharded = NamedSharding(mesh, P(None, "sp", None, None))
+qs, ks, vs = (jax.device_put(t, sharded) for t in (q, k, v))
+
+out = ring_attention(qs, ks, vs, mesh, axis="sp",
+                                    causal=True)
+jax.block_until_ready(out)
+
+# exact parity with single-device attention ([B,S,H,D] -> heads-major)
+qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / jnp.sqrt(jnp.float32(D))
+mask = jnp.tril(jnp.ones((S, S), bool))
+ref = jnp.einsum("bhqk,bhkd->bhqd",
+                 jax.nn.softmax(jnp.where(mask, logits, -jnp.inf)), vh)
+ref = jnp.swapaxes(ref, 1, 2)
+np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4,
+                           atol=2e-5)
+print(f"ring attention over {len(devs)} sequence shards: exact parity OK "
+      f"(seq={S}, per-device {S // len(devs)})")
