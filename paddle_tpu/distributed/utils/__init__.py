@@ -1,0 +1,12 @@
+"""paddle.distributed.utils parity (log + process helpers)."""
+from __future__ import annotations
+
+__all__ = []
+
+
+def get_logger(level="INFO", name="paddle_tpu.distributed"):
+    import logging
+
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    return logger

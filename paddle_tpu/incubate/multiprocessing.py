@@ -1,0 +1,5 @@
+"""paddle.incubate.multiprocessing parity (reference: shared-memory
+tensor reductions for torch-style mp). Tensors here pickle via numpy
+(see io/dataloader.py subprocess workers), so the standard library
+multiprocessing works directly — this module re-exports it."""
+from multiprocessing import *  # noqa: F401,F403

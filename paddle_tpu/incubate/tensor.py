@@ -1,0 +1,8 @@
+"""paddle.incubate.tensor parity (reference exposes segment math under
+incubate.tensor.math)."""
+from . import graph_ops as _g
+
+segment_sum = _g.segment_sum
+segment_mean = _g.segment_mean
+segment_max = _g.segment_max
+segment_min = _g.segment_min

@@ -6,6 +6,8 @@ from . import initializer  # noqa
 from .layer import *  # noqa: F401,F403
 from .layer.base import Layer  # noqa
 from .layer.rnn import _RNNCellBase as RNNCellBase  # noqa
+from . import utils  # noqa
+from . import quant  # noqa
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa
 from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa
                          ClipGradByValue)

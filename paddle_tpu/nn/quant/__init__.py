@@ -1,0 +1,119 @@
+"""paddle.nn.quant parity: weight-only quantization primitives.
+
+Reference capability: python/paddle/nn/quant/quantized_linear.py
+(weight_quantize/weight_dequantize/weight_only_linear/llm_int8_linear)
++ quant_layers Stub. TPU-native: per-output-channel absmax int8 — the
+int8 weights stream from HBM at half/quarter the bytes and dequantize
+into the bf16 matmul (XLA fuses the scale multiply); int4 packs two
+nibbles per int8 byte.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._op import op_fn, unwrap, wrap
+
+__all__ = ["Stub", "weight_quantize", "weight_dequantize",
+           "weight_only_linear", "llm_int8_linear"]
+
+
+class Stub:
+    """Quantization insertion point (reference: quant_layers Stub): a
+    placeholder a QuantConfig maps to an observer/quanter at
+    quantize-time."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+    __call__ = forward
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Quantize a [in, out] weight to int8/int4 per output channel
+    (reference: quantized_linear.py weight_quantize). Returns
+    (quantized_weight, scale)."""
+    w = unwrap(x).astype(jnp.float32)
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    absmax = jnp.max(jnp.abs(w), axis=0)            # per out-channel
+    if algo == "weight_only_int4":
+        if w.shape[0] % 2:
+            raise ValueError(
+                "weight_only_int4 packs two rows per byte; in_features "
+                f"must be even, got {w.shape[0]} — pad the weight first")
+        scale = absmax / 7.0
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-10)), -8, 7) \
+            .astype(jnp.int8)
+        # pack two int4 per byte along the input dim
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        packed = (lo | hi).astype(jnp.int8)
+        return wrap(packed), wrap(scale)
+    scale = absmax / 127.0
+    q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-10)), -127, 127) \
+        .astype(jnp.int8)
+    return wrap(q), wrap(scale)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+    """Inverse of weight_quantize (reference: quantized_linear.py
+    weight_dequantize)."""
+    from ...core.dtype import convert_dtype
+
+    q = unwrap(x)
+    s = unwrap(scale).astype(jnp.float32)
+    if algo == "weight_only_int4":
+        lo = (q << 4).astype(jnp.int8) >> 4     # sign-extend low nibble
+        hi = q >> 4                              # arithmetic shift: high
+        full = jnp.stack([lo, hi], axis=1).reshape(-1, q.shape[1])
+        w = full.astype(jnp.float32) * s[None, :]
+    else:
+        w = q.astype(jnp.float32) * s[None, :]
+    return wrap(w.astype(convert_dtype(out_dtype)))
+
+
+@op_fn(name="weight_only_linear_op", nondiff_args=(1,))
+def _wol_op(x, qweight, scale, bias=None, *, algo, in_features):
+    if algo == "weight_only_int4":
+        lo = (qweight << 4).astype(jnp.int8) >> 4
+        hi = qweight >> 4
+        full = jnp.stack([lo, hi], axis=1).reshape(-1, qweight.shape[1])
+        w = full[:in_features].astype(x.dtype) * scale[None, :].astype(x.dtype)
+    else:
+        w = qweight.astype(x.dtype) * scale[None, :].astype(x.dtype)
+    out = x @ w
+    return out + bias if bias is not None else out
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """Linear with int8/int4 weights dequantized into the matmul
+    (reference: quantized_linear.py weight_only_linear)."""
+    algo = "weight_only_int4" if weight_dtype == "int4" \
+        else "weight_only_int8"
+    in_features = unwrap(x).shape[-1]
+    return _wol_op(x, weight, weight_scale, bias, algo=algo,
+                   in_features=int(in_features))
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8()-shaped linear (reference: quantized_linear.py
+    llm_int8_linear). The reference decomposes outlier input columns
+    onto an fp16 copy of the weight to dodge int8 GEMM saturation; here
+    the int8 weight dequantizes into a bf16/f32 MXU matmul, so the
+    decomposition collapses algebraically (x_reg@W + x_out@W == x@W) —
+    one full-precision-accumulate matmul is the whole kernel.
+    ``threshold`` is accepted for signature parity."""
+    xa = unwrap(x)
+    q = unwrap(weight)
+    s = unwrap(weight_scale).astype(jnp.float32)
+    w = (q.astype(jnp.float32) * s[None, :]).astype(xa.dtype)
+    out = xa @ w
+    if bias is not None:
+        out = out + unwrap(bias)
+    return wrap(out)

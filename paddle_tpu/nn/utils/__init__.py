@@ -1,0 +1,196 @@
+"""paddle.nn.utils parity: weight/spectral norm reparameterizations,
+gradient clipping helpers, parameter flattening.
+
+Reference capability: python/paddle/nn/utils/ (weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_norm_.py, clip_grad_value_.py,
+transform_parameters.py). Reparameterizations install a forward pre-hook
+that recomputes the weight from (g, v) before every forward — the same
+hook discipline as the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except_dim(w, dim):
+    # dim=None: whole-tensor norm (scalar g, reference semantics)
+    axes = tuple(range(w.ndim)) if dim is None else \
+        tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        # taped computation: ||v|| along all dims except `dim`
+        # (dim=None: whole-tensor norm, scalar g)
+        axes = tuple(range(len(v.shape))) if self.dim is None else \
+            tuple(i for i in range(len(v.shape)) if i != self.dim)
+        vn = (v * v).sum(axis=axes, keepdim=True).sqrt()
+        return v * (g / vn)
+
+    def __call__(self, layer, inputs):
+        w = self.compute(layer)
+        setattr(layer, self.name, w)
+        return inputs
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference:
+    weight_norm_hook.py). g and v become the trainable parameters; the
+    effective weight is recomputed in a forward pre-hook."""
+    w = getattr(layer, name)
+    warr = w._data
+    g0 = _norm_except_dim(warr, dim)
+    g = Parameter(g0)
+    v = Parameter(warr)
+    # replace the original parameter with the pair
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    hook = _WeightNormHook(name, dim)
+    setattr(layer, name, hook.compute(layer))
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold (g, v) back into a plain parameter (reference:
+    weight_norm_hook.py remove_weight_norm)."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"no weight_norm hook on parameter {name!r}")
+    hook, handle = hooks.pop(name)
+    w = hook.compute(layer)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.add_parameter(name, Parameter(w._data))
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def compute(self, layer):
+        w = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        warr = w._data
+        if self.dim != 0:
+            perm = [self.dim] + [i for i in range(warr.ndim)
+                                 if i != self.dim]
+            warr = jnp.transpose(warr, perm)
+        mat = warr.reshape(warr.shape[0], -1)
+        uv = u._data
+        # n_power_iterations=0 is legal: sigma from the persisted u with
+        # one v solve, no u update
+        vv = mat.T @ uv
+        vv = vv / (jnp.linalg.norm(vv) + self.eps)
+        for _ in range(self.n):
+            uv = mat @ vv
+            uv = uv / (jnp.linalg.norm(uv) + self.eps)
+            vv = mat.T @ uv
+            vv = vv / (jnp.linalg.norm(vv) + self.eps)
+        u._data = uv                       # persistent power-iter state
+        sigma = uv @ mat @ vv
+        return w / sigma
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name, self.compute(layer))
+        return inputs
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization reparameterization (reference:
+    spectral_norm_hook.py): weight / sigma_max, sigma estimated by
+    persistent power iteration."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith("Transpose") else 0
+    warr = w._data
+    rows = warr.shape[dim]
+    rng = np.random.default_rng(0)
+    u = Parameter(jnp.asarray(rng.normal(size=(rows,)), warr.dtype)
+                  / np.sqrt(rows))
+    u.stop_gradient = True
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.add_parameter(name + "_orig", Parameter(warr))
+    layer.add_parameter(name + "_u", u)
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    setattr(layer, name, hook.compute(layer))
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip (reference:
+    clip_grad_norm_.py). Returns the total norm."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    grads = [p.grad._data for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"gradient norm is non-finite ({float(total)}); cannot clip")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = p.grad._data * scale.astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place element clip of grads to [-clip_value, clip_value]
+    (reference: clip_grad_value_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Concatenate flattened parameters (reference:
+    transform_parameters.py)."""
+    params = list(parameters)
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in params]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write a flat vector back into the parameter list."""
+    params = list(parameters)
+    off = 0
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in params:
+        n = int(np.prod(p._data.shape)) if p._data.ndim else 1
+        p._data = v[off:off + n].reshape(p._data.shape).astype(p._data.dtype)
+        off += n

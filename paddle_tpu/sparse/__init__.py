@@ -357,34 +357,11 @@ def coalesce(x, name=None):
 # sparse nn (reference: sparse/nn — ReLU layer + Linear-ish)
 # ---------------------------------------------------------------------------
 
-class _SparseNN:
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
+# sparse.nn is a real subpackage (sparse/nn/) with Layer classes +
+# functional; import explicitly (attribute would shadow the submodule)
+import importlib as _importlib
 
-    class Softmax:
-        """Row-wise softmax over CSR nonzeros (reference:
-        sparse/nn/functional/activation.py softmax)."""
-
-        def __init__(self, axis=-1):
-            self.axis = axis
-
-        def __call__(self, x):
-            sp = x._sp
-            if isinstance(sp, jsparse.BCSR):
-                dense = sp.todense()
-                neg_inf = jnp.where(dense == 0, -jnp.inf, dense)
-                sm = jax.nn.softmax(neg_inf, axis=-1)
-                sm = jnp.where(dense == 0, 0.0, sm)
-                return SparseCsrTensor(jsparse.BCSR.fromdense(sm))
-            dense = sp.todense()
-            neg_inf = jnp.where(dense == 0, -jnp.inf, dense)
-            sm = jax.nn.softmax(neg_inf, axis=-1)
-            sm = jnp.where(dense == 0, 0.0, sm)
-            return SparseCooTensor(jsparse.BCOO.fromdense(sm))
-
-
-nn = _SparseNN()
+nn = _importlib.import_module(".nn", __name__)
 
 
 # -- unary long tail (reference: sparse/unary.py full op list) --------------

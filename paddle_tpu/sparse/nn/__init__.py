@@ -1,0 +1,192 @@
+"""paddle.sparse.nn parity: sparse Layer classes over the functional
+surface (reference: python/paddle/sparse/nn/layer/)."""
+from __future__ import annotations
+
+from ...nn.initializer import Constant, XavierUniform
+from ...nn.layer.base import Layer
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv2D", "Conv3D",
+           "SubmConv2D", "SubmConv3D", "MaxPool3D", "BatchNorm",
+           "SyncBatchNorm"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class _SparseConv(Layer):
+    _nsp = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None,
+                 data_format=None, key=None):
+        super().__init__()
+        nsp = self._nsp
+        k = (kernel_size,) * nsp if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format or ("NDHWC" if nsp == 3 else "NHWC")
+        # reference layout: [*k, Cin/groups, Cout]
+        self.weight = self.create_parameter(
+            k + (in_channels // groups, out_channels), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        fn = {(2, False): F.conv2d, (3, False): F.conv3d,
+              (2, True): F.subm_conv2d, (3, True): F.subm_conv3d}[
+            (self._nsp, self._subm)]
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_SparseConv):
+    _nsp = 2
+
+
+class Conv3D(_SparseConv):
+    _nsp = 3
+
+
+class SubmConv2D(_SparseConv):
+    _nsp = 2
+    _subm = True
+
+
+class SubmConv3D(_SparseConv):
+    _nsp = 3
+    _subm = True
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm over channel-last nonzero values (reference:
+    sparse/nn/layer/norm.py BatchNorm): statistics over the stored
+    values per channel."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        import jax.numpy as jnp
+
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        from jax.experimental import sparse as jsparse
+
+        from .. import SparseCooTensor
+
+        sp = x._sp
+        vals = sp.data
+        c = self.weight._data.shape[0]
+        if vals.ndim == 2:
+            # n_dense=1 layout: values [nnz, C]
+            if self.training:
+                mu = jnp.mean(vals, axis=0)
+                var = jnp.var(vals, axis=0)
+            else:
+                mu, var = self._mean._data, self._variance._data
+            new = ((vals - mu) / jnp.sqrt(var + self.epsilon)
+                   * self.weight._data + self.bias._data)
+        else:
+            # fully-sparse layout: values [nnz], channel = last coordinate
+            ch = sp.indices[:, -1]
+            if self.training:
+                cnt = jnp.maximum(
+                    jax.ops.segment_sum(jnp.ones_like(vals), ch, c), 1.0)
+                mu = jax.ops.segment_sum(vals, ch, c) / cnt
+                var = jax.ops.segment_sum(
+                    (vals - mu[ch]) ** 2, ch, c) / cnt
+            else:
+                mu, var = self._mean._data, self._variance._data
+            new = ((vals - mu[ch]) / jnp.sqrt(var[ch] + self.epsilon)
+                   * self.weight._data[ch] + self.bias._data[ch])
+        if self.training:
+            self._mean._data = (self.momentum * self._mean._data
+                                + (1 - self.momentum) * mu)
+            self._variance._data = (self.momentum * self._variance._data
+                                    + (1 - self.momentum) * var)
+        return SparseCooTensor(jsparse.BCOO((new, sp.indices),
+                                            shape=sp.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse batch norm. Under the single-controller
+    mesh model, batch statistics computed inside a jitted sharded
+    program are already global (XLA inserts the reductions) — matching
+    the reference's converted SyncBatchNorm semantics."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer.weight.shape[0],
+                                momentum=layer.momentum,
+                                epsilon=layer.epsilon)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._mean._data = layer._mean._data
+            new._variance._data = layer._variance._data
+            return new
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer

@@ -1,0 +1,192 @@
+"""paddle.sparse.nn.functional parity.
+
+Reference capability: python/paddle/sparse/nn/functional/ (conv.py
+conv2d/conv3d/subm_conv*, pooling.py max_pool3d, activation.py,
+transformer.py attention). TPU-native realization: sparse activations
+run in value space over the nonzeros (pattern preserved); sparse
+convolution evaluates as dense conv on the materialized tensor with the
+result re-sparsified — on TPU the dense conv IS the fast path at the
+occupancies these APIs see (XLA/MXU), and submanifold variants mask the
+output back to the input's active sites (the defining subm property).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d",
+           "subm_conv2d_igemm", "subm_conv3d_igemm", "max_pool3d",
+           "relu", "relu6", "leaky_relu", "softmax", "attention"]
+
+
+def _parent():
+    from ... import sparse as S
+
+    return S
+
+
+def relu(x, name=None):
+    return _parent().relu(x)
+
+
+def relu6(x, name=None):
+    S = _parent()
+    return S._unary(lambda v: jnp.clip(v, 0, 6.0))(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    S = _parent()
+    return S._unary(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the sparsity pattern (reference:
+    sparse/nn/functional/activation.py softmax): zeros stay zero, the
+    stored entries of each row renormalize among themselves. Only the
+    last axis is supported, like the reference."""
+    if axis not in (-1, len(x.shape) - 1):
+        raise ValueError(
+            f"sparse softmax only supports the last axis, got {axis}")
+    S = _parent()
+    from jax.experimental import sparse as jsparse
+
+    sp = x._sp
+    dense = sp.todense()
+    neg_inf = jnp.where(dense == 0, -jnp.inf, dense)
+    sm = jax.nn.softmax(neg_inf, axis=-1)
+    sm = jnp.where(dense == 0, 0.0, sm)
+    if isinstance(sp, jsparse.BCSR):
+        return S.SparseCsrTensor(jsparse.BCSR.fromdense(sm))
+    return S.SparseCooTensor(jsparse.BCOO.fromdense(sm))
+
+
+def _dense_conv(x, weight, bias, stride, padding, dilation, groups, nsp,
+                subm, data_format):
+    """Dense-detour sparse conv: densify -> lax conv -> re-sparsify.
+    x: SparseCooTensor with dense shape [N, *spatial, C] (reference
+    NDHWC/NHWC layouts); weight [*k, C/groups, M]."""
+    S = _parent()
+    import numpy as np
+
+    dense = x._sp.todense()
+    w = weight._data if hasattr(weight, "_data") else jnp.asarray(weight)
+    k_sp = w.shape[:nsp]
+    # NHWC/NDHWC -> NC* for lax, conv, then back
+    perm_in = (0, nsp + 1) + tuple(range(1, nsp + 1))
+    xc = jnp.transpose(dense, perm_in)
+    # weight [*k, Cin/g, M] -> [M, Cin/g, *k]
+    wc = jnp.transpose(w, (nsp + 1, nsp) + tuple(range(nsp)))
+    if isinstance(stride, int):
+        stride = (stride,) * nsp
+    if isinstance(dilation, int):
+        dilation = (dilation,) * nsp
+    if subm:
+        # submanifold: same spatial size, output active only at input's
+        # active sites
+        pads = [((k - 1) // 2 * d, (k - 1) // 2 * d)
+                for k, d in zip(k_sp, dilation)]
+        stride = (1,) * nsp
+    elif isinstance(padding, int):
+        pads = [(padding * 1, padding * 1)] * nsp
+    else:
+        pads = [(p, p) if isinstance(p, int) else tuple(p)
+                for p in padding]
+    out = jax.lax.conv_general_dilated(
+        xc, wc, window_strides=stride, padding=pads,
+        rhs_dilation=dilation, feature_group_count=groups)
+    if bias is not None:
+        b = bias._data if hasattr(bias, "_data") else jnp.asarray(bias)
+        out = out + b.reshape((1, -1) + (1,) * nsp)
+    perm_out = (0,) + tuple(range(2, nsp + 2)) + (1,)
+    out = jnp.transpose(out, perm_out)
+    if subm:
+        # mask to the input's active sites (any-channel occupancy)
+        occupied = jnp.any(dense != 0, axis=-1, keepdims=True)
+        out = jnp.where(occupied, out, 0.0)
+    from jax.experimental import sparse as jsparse
+
+    return S.SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       3, False, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       2, False, data_format)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       3, True, data_format)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       2, True, data_format)
+
+
+# igemm variants: the reference's implicit-GEMM kernel selection — same
+# math, different GPU kernel; here they are the same lowering
+subm_conv2d_igemm = subm_conv2d
+subm_conv3d_igemm = subm_conv3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse 3D max pool (reference: sparse/nn/functional/pooling.py):
+    dense-detour reduce_window over NDHWC."""
+    S = _parent()
+    from jax import lax
+    from jax.experimental import sparse as jsparse
+
+    sp = x._sp
+    dense = sp.todense()
+    # max over STORED values only (reference sparse pooling): inactive
+    # sites must not inject zeros into the max — mask them to -inf via
+    # the occupancy pattern, then zero windows with no active site
+    n_idx = sp.indices.shape[1]
+    ones = jnp.ones((sp.indices.shape[0],), dense.dtype)
+    occ = jsparse.BCOO((ones, sp.indices),
+                       shape=sp.shape[:n_idx]).todense()
+    occ = occ.reshape(occ.shape + (1,) * (dense.ndim - occ.ndim))
+    masked = jnp.where(occ > 0, dense, -jnp.inf)
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = k if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dims = (1,) + k + (1,)
+    strides = (1,) + s + (1,)
+    pads = [(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)]
+    out = lax.reduce_window(masked, -jnp.inf, lax.max, dims, strides, pads)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return S.SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference:
+    sparse/nn/functional/transformer.py attention): the CSR sparse_mask
+    selects which logits exist. Delegates to the dense masked softmax
+    (the TPU fast path) honoring the mask's pattern."""
+    from ...nn.functional.extras import sparse_attention as _sa
+
+    crows = sparse_mask.crows()
+    cols = sparse_mask.cols()
+    import numpy as np
+
+    b, h, s, _ = query.shape
+    off = np.tile(np.asarray(crows.numpy())[None, None], (b, h, 1))
+    cc = np.tile(np.asarray(cols.numpy())[None, None], (b, h, 1))
+    from ...core.tensor import Tensor
+
+    return _sa(query, key, value, Tensor(jnp.asarray(off)),
+               Tensor(jnp.asarray(cc)), key_padding_mask, attn_mask)

@@ -310,5 +310,10 @@ class TestScaleLowering:
         lowered = step.lower(pshape, oshape, ids)
         text = lowered.as_text()
         assert "sharding" in text          # GSPMD annotations present
-        # per-(fsdp,tp)-shard weight: 8192x28672 gate sharded 4x2
-        assert lowered is not None
+        # the gate projection's declared placement shards the ffn dim on
+        # tp and the hidden dim on fsdp (ZeRO-3 + Megatron TP)
+        from jax.sharding import PartitionSpec as P
+
+        specs = L.param_specs(cfg)
+        assert specs["layers"]["gate"] == P(None, "fsdp", "tp")
+        assert specs["embed"] == P("tp", "fsdp")

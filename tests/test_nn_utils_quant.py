@@ -1,0 +1,213 @@
+"""Tests for nn.utils (weight/spectral norm, grad clip, vector
+transforms), nn.quant (weight-only int8/int4), and sparse.nn."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(x, **kw):
+    return paddle.to_tensor(x, **kw)
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip_and_training(self):
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, "weight")
+        x = t(np.random.default_rng(0).normal(size=(2, 4))
+              .astype("float32"))
+        out = lin(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   x.numpy() @ w0 + lin.bias.numpy(),
+                                   rtol=1e-5)
+        # g and v are the trainable parameters now
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        loss = lin(x).sum()
+        loss.backward()
+        assert np.abs(names["weight_g"].grad.numpy()).sum() > 0
+        nn.utils.remove_weight_norm(lin, "weight")
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+    def test_spectral_norm_bounds_sv(self):
+        lin = nn.Linear(6, 6)
+        lin.weight.set_value((np.eye(6) * 5.0).astype("float32"))
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=20)
+        _ = lin(t(np.ones((1, 6), "float32")))
+        sv = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
+        assert abs(sv - 1.0) < 1e-3
+
+    def test_clip_helpers(self):
+        p = paddle.create_parameter([3], "float32")
+        (t(np.ones(3, "float32")) * p * 100.0).sum().backward()
+        total = nn.utils.clip_grad_norm_([p], max_norm=1.0)
+        assert float(total.numpy()) > 1.0
+        assert abs(np.linalg.norm(p.grad.numpy()) - 1.0) < 1e-4
+        nn.utils.clip_grad_value_([p], 0.1)
+        assert np.abs(p.grad.numpy()).max() <= 0.1 + 1e-7
+
+    def test_vector_transforms(self):
+        lin = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        w0 = lin.weight.numpy().copy()
+        nn.utils.vector_to_parameters(vec * 2.0, lin.parameters())
+        np.testing.assert_allclose(lin.weight.numpy(), 2.0 * w0, rtol=1e-6)
+
+
+class TestWeightOnlyQuant:
+    def test_int8_roundtrip_and_linear(self):
+        from paddle_tpu.nn.quant import (weight_dequantize,
+                                         weight_only_linear,
+                                         weight_quantize)
+
+        w = np.random.default_rng(1).normal(size=(8, 4)).astype("float32")
+        q, s = weight_quantize(t(w))
+        assert np.asarray(q.numpy()).dtype == np.int8
+        deq = weight_dequantize(q, s, out_dtype="float32")
+        np.testing.assert_allclose(np.asarray(deq.numpy()), w,
+                                   atol=np.abs(w).max() / 100)
+        x = t(np.random.default_rng(2).normal(size=(2, 8))
+              .astype("float32"))
+        out = weight_only_linear(x, q, weight_scale=s)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   x.numpy() @ w, rtol=0.05, atol=0.05)
+
+    def test_int4_pack_roundtrip(self):
+        from paddle_tpu.nn.quant import (weight_dequantize,
+                                         weight_only_linear,
+                                         weight_quantize)
+
+        w = np.random.default_rng(3).normal(size=(6, 5)).astype("float32")
+        q4, s4 = weight_quantize(t(w), algo="weight_only_int4")
+        assert np.asarray(q4.numpy()).shape[0] == 3    # packed pairs
+        deq = weight_dequantize(q4, s4, algo="weight_only_int4",
+                                out_dtype="float32")
+        np.testing.assert_allclose(np.asarray(deq.numpy()), w,
+                                   atol=np.abs(w).max() / 6)
+        x = t(np.random.default_rng(4).normal(size=(2, 6))
+              .astype("float32"))
+        out = weight_only_linear(x, q4, weight_scale=s4,
+                                 weight_dtype="int4")
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   x.numpy() @ w, rtol=0.25, atol=0.4)
+
+    def test_llm_int8(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+
+        w = np.random.default_rng(5).normal(size=(4, 3)).astype("float32")
+        q, s = weight_quantize(t(w), algo="llm.int8")
+        x = np.random.default_rng(6).normal(size=(2, 4)).astype("float32")
+        x[0, 1] = 20.0                 # outlier column
+        out = llm_int8_linear(t(x), q, weight_scale=s)
+        np.testing.assert_allclose(np.asarray(out.numpy()), x @ w,
+                                   rtol=0.05, atol=0.2)
+
+
+class TestSparseNN:
+    def test_activations_and_softmax(self):
+        import paddle_tpu.sparse as sp
+
+        dense = np.array([[0.0, 7.0], [2.0, 0.0]], "float32")
+        x = sp.sparse_coo_tensor_from_dense(t(dense))
+        out = sp.nn.ReLU6()(x)
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                                   [[0.0, 6.0], [2.0, 0.0]])
+        sm = sp.nn.functional.softmax(x)
+        arr = np.asarray(sm.to_dense().numpy())
+        np.testing.assert_allclose(arr, [[0.0, 1.0], [1.0, 0.0]])
+
+    def test_subm_conv_preserves_sites(self):
+        import paddle_tpu.sparse as sp
+
+        img = np.zeros((1, 4, 4, 2), "float32")
+        img[0, 1, 1] = [1.0, 2.0]
+        xs = sp.sparse_coo_tensor_from_dense(t(img))
+        conv = sp.nn.SubmConv2D(2, 3, 3)
+        out = np.asarray(conv(xs).to_dense().numpy())
+        assert out.shape == (1, 4, 4, 3)
+        # output only at the input's active site (submanifold property)
+        mask = np.zeros((4, 4), bool)
+        mask[1, 1] = True
+        assert (np.abs(out[0][~mask]).sum()) == 0.0
+
+    def test_sparse_conv_and_pool(self):
+        import paddle_tpu.sparse as sp
+
+        img = np.zeros((1, 4, 4, 4, 2), "float32")
+        img[0, 1, 1, 1] = [1.0, -1.0]
+        xs = sp.sparse_coo_tensor_from_dense(t(img))
+        conv = sp.nn.Conv3D(2, 3, 2, stride=2)
+        out = conv(xs)
+        assert list(out.shape) == [1, 2, 2, 2, 3]
+        pooled = sp.nn.MaxPool3D(2, 2)(xs)
+        assert list(pooled.shape) == [1, 2, 2, 2, 2]
+
+    def test_sparse_batchnorm(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.default_rng(7)
+        dense = rng.normal(size=(2, 3, 3, 3, 4)).astype("float32")
+        xs = sp.sparse_coo_tensor_from_dense(t(dense))
+        bn = sp.nn.BatchNorm(4)
+        out = bn(xs)
+        assert list(out.shape) == list(dense.shape)
+        sync = sp.nn.SyncBatchNorm.convert_sync_batchnorm(bn)
+        assert isinstance(sync, sp.nn.SyncBatchNorm)
+
+
+class TestReviewRegressions3:
+    def test_sparse_maxpool_negative_actives(self):
+        import paddle_tpu.sparse as sp
+
+        img = np.zeros((1, 2, 2, 2, 2), "float32")
+        img[0, 0, 0, 0] = [1.0, -1.0]       # all-negative channel 1
+        xs = sp.sparse_coo_tensor_from_dense(t(img))
+        out = np.asarray(sp.nn.MaxPool3D(2, 2)(xs).to_dense().numpy())
+        # max over STORED values: channel 1's true max is -1, not 0
+        np.testing.assert_allclose(out[0, 0, 0, 0], [1.0, -1.0])
+
+    def test_sync_bn_keeps_stats(self):
+        import jax.numpy as jnp
+
+        import paddle_tpu.sparse as sp
+
+        bn = sp.nn.BatchNorm(2)
+        bn._mean._data = jnp.asarray([5.0, 6.0])
+        sync = sp.nn.SyncBatchNorm.convert_sync_batchnorm(bn)
+        np.testing.assert_allclose(np.asarray(sync._mean._data), [5.0, 6.0])
+
+    def test_spectral_norm_zero_iters(self):
+        lin = nn.Linear(3, 3)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=0)
+        out = lin(t(np.ones((1, 3), "float32")))
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+    def test_weight_norm_dim_none_scalar_g(self):
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, "weight", dim=None)
+        g = dict(lin.named_parameters())["weight_g"]
+        assert int(np.prod(g.shape)) == 1           # whole-tensor norm
+        x = t(np.random.default_rng(0).normal(size=(2, 4))
+              .astype("float32"))
+        np.testing.assert_allclose(np.asarray(lin(x).numpy()),
+                                   x.numpy() @ w0 + lin.bias.numpy(),
+                                   rtol=1e-5)
+
+    def test_int4_odd_in_features_raises(self):
+        from paddle_tpu.nn.quant import weight_quantize
+
+        w = np.zeros((5, 3), "float32")
+        with pytest.raises(ValueError, match="even"):
+            weight_quantize(t(w), algo="weight_only_int4")
+
+    def test_sparse_softmax_axis_guard(self):
+        import paddle_tpu.sparse as sp
+
+        x = sp.sparse_coo_tensor_from_dense(
+            t(np.eye(2, dtype="float32")))
+        with pytest.raises(ValueError, match="last axis"):
+            sp.nn.functional.softmax(x, axis=0)
