@@ -160,5 +160,6 @@ from . import onnx  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import tensor  # noqa: E402
 from . import reader  # noqa: E402
+from . import version  # noqa: E402
 from . import utils  # noqa: E402
 from .amp import debugging as _amp_debugging  # noqa: E402,F401

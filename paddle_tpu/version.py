@@ -1,0 +1,51 @@
+"""paddle.version parity (reference: generated at build by setup.py —
+python/paddle/__init__.py:16 imports full_version/commit/cuda()/etc.).
+This build is CUDA-free by design; device queries answer for the TPU."""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+cinn_version = "False"
+tensorrt_version = None
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("tpu: True")
+    print("cuda: False")
+    print("cudnn: False")
+
+
+def cuda():
+    return "False"
+
+
+def cudnn():
+    return "False"
+
+
+def nccl():
+    return "False"
+
+
+def xpu():
+    return "False"
+
+
+def xpu_xccl():
+    return "False"
+
+
+def cinn():
+    return "False"
+
+
+def tpu():
+    return "True"
