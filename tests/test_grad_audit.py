@@ -149,3 +149,124 @@ def test_binary_grads():
         num = _num_grad(lambda arr: f_a(arr), a.copy())
         np.testing.assert_allclose(ga, num, rtol=2e-2, atol=2e-3,
                                    err_msg=f"d/da mismatch for {name}")
+
+
+def _fd_check(fn, x, rtol=3e-2, atol=3e-3, eps=1e-3):
+    analytic = _tape_grad(fn, x.astype(np.float64))
+
+    def f(arr):
+        return fn(paddle.to_tensor(arr.astype("float32"))).numpy()
+
+    numerical = _num_grad(f, x.astype(np.float64).copy(), eps=eps)
+    np.testing.assert_allclose(analytic, numerical, rtol=rtol, atol=atol)
+
+
+class TestComplexOpGrads:
+    """Finite-difference checks for the structurally complex ops added in
+    round 3 (scan-based losses, window gathers, samplers)."""
+
+    def test_ctc_loss_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        labels = paddle.to_tensor(rng.integers(1, 4, (2, 2)).astype("int32"))
+        il = paddle.to_tensor(np.array([5, 4], "int32"))
+        ll = paddle.to_tensor(np.array([2, 1], "int32"))
+
+        def fn(t):
+            return F.ctc_loss(t, labels, il, ll, blank=0, reduction="sum")
+
+        _fd_check(fn, rng.normal(size=(5, 2, 4)), rtol=5e-2, atol=5e-3)
+
+    def test_rnnt_loss_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(1)
+        label = paddle.to_tensor(rng.integers(1, 3, (1, 2)).astype("int32"))
+        il = paddle.to_tensor(np.array([3], "int32"))
+        ll = paddle.to_tensor(np.array([2], "int32"))
+
+        def fn(t):
+            return F.rnnt_loss(t, label, il, ll, blank=0, reduction="sum")
+
+        _fd_check(fn, rng.normal(size=(1, 3, 3, 3)), rtol=5e-2, atol=5e-3)
+
+    def test_hsigmoid_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(2)
+        label = paddle.to_tensor(rng.integers(0, 6, (3,)).astype("int64"))
+        w = paddle.to_tensor(rng.normal(size=(5, 4)).astype("float32"))
+
+        def fn(t):
+            return F.hsigmoid_loss(t, label, 6, w).sum()
+
+        _fd_check(fn, rng.normal(size=(3, 4)))
+
+    def test_multi_margin_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(3)
+        label = paddle.to_tensor(rng.integers(0, 4, (3,)).astype("int64"))
+
+        def fn(t):
+            return F.multi_margin_loss(t, label, p=2, reduction="sum")
+
+        _fd_check(fn, rng.normal(size=(3, 4)))
+
+    def test_fractional_pool_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(4)
+
+        def fn(t):
+            return F.fractional_max_pool2d(t, 2, random_u=0.4).sum()
+
+        # distinct values so the argmax is fd-stable
+        x = rng.permutation(36).reshape(1, 1, 6, 6).astype(np.float64)
+        _fd_check(fn, x, eps=1e-2)
+
+    def test_max_pool_mask_path_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(5)
+
+        def fn(t):
+            out, _ = F.max_pool2d(t, 2, 2, return_mask=True)
+            return out.sum()
+
+        x = rng.permutation(16).reshape(1, 1, 4, 4).astype(np.float64)
+        _fd_check(fn, x, eps=1e-2)
+
+    def test_grid_sample_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(6)
+        grid = paddle.to_tensor(
+            (rng.uniform(-0.8, 0.8, (1, 3, 3, 2))).astype("float32"))
+
+        def fn(t):
+            return F.grid_sample(t, grid).sum()
+
+        _fd_check(fn, rng.normal(size=(1, 2, 4, 4)))
+
+    def test_fused_mha_input_grad(self):
+        from paddle_tpu.incubate.nn.functional import \
+            fused_multi_head_attention
+
+        rng = np.random.default_rng(7)
+        qkvw = paddle.to_tensor(
+            (rng.normal(size=(3, 2, 4, 8)) * 0.2).astype("float32"))
+        lw = paddle.to_tensor(
+            (rng.normal(size=(8, 8)) * 0.2).astype("float32"))
+        lns = paddle.to_tensor(np.ones(8, "float32"))
+        lnb = paddle.to_tensor(np.zeros(8, "float32"))
+
+        def fn(t):
+            return fused_multi_head_attention(
+                t, qkvw, lw, ln_scale=lns, ln_bias=lnb,
+                dropout_rate=0.0, attn_dropout_rate=0.0,
+                training=False).sum()
+
+        _fd_check(fn, rng.normal(size=(1, 3, 8)) * 0.5, rtol=5e-2,
+                  atol=5e-3)
