@@ -6,20 +6,143 @@ Metric: training tokens/sec on a Llama block stack sized to fit the chip,
 plus model FLOPs utilisation (MFU) computed from the 6*N*tokens estimate.
 vs_baseline is MFU / 0.40 (BASELINE.json north star: >=40% MFU).
 
-Hardened against shared-TPU backend flakes: backend init is probed with
-retries, and any failure still emits a parseable JSON line (value 0 +
-error detail) instead of a stack dump. Param/optimizer init runs inside a
-single jitted program (no eager op-by-op device traffic). The run records
-whether the Pallas flash-attention kernel actually engaged at the bench
-shapes (kernels.dispatch_stats) and flags a fallback in the JSON output so
-a silent fallback can't quietly cost MFU unnoticed.
+Un-hangable by construction (round-3 lesson: BENCH_r03 was rc=124 because
+only backend *init* had a watchdog while compile/run/`float(loss)` could
+block forever through a dead tunnel relay):
+
+1. A daemon watchdog THREAD (not SIGALRM — a signal handler cannot
+   interrupt a blocked PJRT C call, but a thread can ``os._exit``) enforces
+   a global deadline plus per-stage budgets (init / preflight / compile /
+   timed loop). On expiry it prints the JSON failure line naming the stage
+   that hung, flushes, and exits. Every path emits exactly one JSON line.
+2. Before claiming the TPU, the axon tunnel relay is probed with a 2s TCP
+   connect to its known loopback ports. A dead relay fails in seconds with
+   a structured error instead of a 25-minute hang into rc=124.
+3. On TPU, at most TWO ladder rungs are attempted (first choice + one
+   fallback) so a degraded tunnel can't triple the hang exposure.
+
+Param/optimizer init runs inside a single jitted program (no eager
+op-by-op device traffic). The run records whether the Pallas
+flash-attention kernel actually engaged at the bench shapes
+(kernels.dispatch_stats) and flags a fallback in the JSON output so a
+silent fallback can't quietly cost MFU unnoticed.
 """
 import json
 import os
+import socket
 import sys
+import threading
 import time
 
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Watchdog: global + per-stage deadlines enforced from a daemon thread.
+# ---------------------------------------------------------------------------
+
+_T0 = time.monotonic()
+try:
+    _GLOBAL_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "840"))
+except ValueError:   # bad override must not crash before the JSON line
+    _GLOBAL_DEADLINE_S = 840.0   # 14 min
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+_STAGE = {"name": "startup", "deadline": _T0 + _GLOBAL_DEADLINE_S}
+_METRIC = "llama_train_tokens_per_sec_per_chip"
+
+
+def _emit(payload):
+    """Print the single JSON result line (exactly once, race-safe)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+    print(json.dumps(payload))
+    sys.stdout.flush()
+    return True
+
+
+def _fail(msg, **extra):
+    payload = {"metric": _METRIC, "value": 0.0, "unit": "tokens/s",
+               "vs_baseline": 0.0, "error": msg[-2000:],
+               "elapsed_s": round(time.monotonic() - _T0, 1)}
+    if extra:
+        payload["extra"] = extra
+    # NOTE: this record means the CURRENT run FAILED (value 0.0). The
+    # pointer below names an UNVERIFIED self-measured result from an
+    # earlier session, kept only so a reader can find the provenance
+    # trail — it says nothing about this run's health.
+    self_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_SELF_r03.json")
+    if os.path.exists(self_path):
+        payload["see_also"] = (
+            "THIS RUN FAILED (value=0.0). BENCH_SELF_r03.json is an "
+            "unverified, self-measured on-chip result from an earlier "
+            "session (45.75% MFU, recorded before a tunnel outage); it "
+            "does not reflect the current run.")
+    _emit(payload)
+
+
+def _stage(name, budget_s):
+    """Enter a named stage with its own time budget (watchdog-enforced)."""
+    # Deadline BEFORE name: the watchdog polls without a lock, and the new
+    # name paired with an already-expired old deadline would kill a
+    # healthy run at a stage boundary.
+    _STAGE["deadline"] = min(time.monotonic() + budget_s,
+                             _T0 + _GLOBAL_DEADLINE_S)
+    _STAGE["name"] = name
+
+
+def _watchdog():
+    while True:
+        time.sleep(1.0)
+        now = time.monotonic()
+        if now > _STAGE["deadline"]:
+            _fail(f"deadline exceeded in stage '{_STAGE['name']}' "
+                  f"(global budget {_GLOBAL_DEADLINE_S:.0f}s); the bench "
+                  f"process was killed by its own watchdog instead of "
+                  f"hanging into the driver's timeout",
+                  stage=_STAGE["name"])
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(2)
+
+
+def _arm_watchdog():
+    # Armed from main(), not at import: importing bench (e.g. in a unit
+    # test) must not schedule an os._exit or a spurious JSON line.
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+
+# ---------------------------------------------------------------------------
+# Tunnel relay liveness probe.
+# ---------------------------------------------------------------------------
+
+# Loopback ports the axon tunnel relay listens on (observed from the relay
+# process; stable across sessions). One open port == relay alive. An
+# unrelated listener on these ports would defeat the probe, but in this
+# container they belong to the relay alone — and a false "alive" is still
+# bounded by the backend-init stage budget, just slower to diagnose.
+_RELAY_PORTS = (8082, 8083, 8087, 8102, 8103, 8107, 8112, 8113, 8117)
+
+
+def _axon_tunnel_expected():
+    """True when this process will try to reach the TPU through the axon
+    loopback relay (sitecustomize registers the 'axon' PJRT plugin)."""
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) and \
+        "axon" in os.environ.get("JAX_PLATFORMS", "")
+
+
+def _relay_alive(timeout=2.0):
+    for port in _RELAY_PORTS:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=timeout).close()
+            return True
+        except OSError:
+            continue
+    return False
 
 
 def _peak_flops(dev) -> float:
@@ -35,50 +158,19 @@ def _peak_flops(dev) -> float:
     return 459e12   # assume v5p (BASELINE.json north-star hardware)
 
 
-def _emit(payload):
-    print(json.dumps(payload))
-
-
-def _fail(metric, msg):
-    payload = {"metric": metric, "value": 0.0, "unit": "tokens/s",
-               "vs_baseline": 0.0, "error": msg[-2000:]}
-    # If a prior successful on-chip measurement exists in-tree (taken
-    # before a tunnel outage), point the record at it.
-    self_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_SELF_r03.json")
-    if os.path.exists(self_path):
-        payload["see_also"] = (
-            "BENCH_SELF_r03.json — self-measured on-chip result from "
-            "earlier in the session (45.75% MFU), recorded before the "
-            "TPU tunnel outage")
-    _emit(payload)
-
-
-def _probe_backend(retries=3, delay=10.0, hang_timeout=180):
+def _probe_backend(retries=2, delay=5.0):
     """Initialize the jax backend with retries (shared-TPU tunnel can be
-    transiently unavailable). A SIGALRM watchdog converts an init *hang*
-    (observed failure mode of the tunnel) into an exception so the caller
-    can still emit the JSON error line. Returns the first device."""
-    import signal
-
+    transiently unavailable). The watchdog thread bounds a hang; this
+    only needs to turn init *errors* into retries."""
     import jax
 
     last = None
     for i in range(retries):
-        def _alarm(signum, frame):
-            raise TimeoutError(
-                f"backend init hang (> {hang_timeout}s)")
-
-        old = signal.signal(signal.SIGALRM, _alarm)
-        signal.alarm(hang_timeout)
         try:
             return jax.devices()[0]
-        except Exception as e:  # init failure OR watchdog timeout
+        except Exception as e:
             last = e
             time.sleep(delay * (i + 1))
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
     raise RuntimeError(f"backend init failed after {retries} tries: {last}")
 
 
@@ -132,9 +224,32 @@ def _preflight_kernels(on_tpu):
 
 
 def main():
-    metric = "llama_train_tokens_per_sec_per_chip"
     try:
-        if "--smoke" in sys.argv:
+        _main()
+    except BaseException as e:   # every path must emit the one JSON line
+        _fail(f"unhandled {type(e).__name__}: {e}")
+        raise
+
+
+def _main():
+    smoke = "--smoke" in sys.argv
+    _arm_watchdog()
+
+    _stage("relay-probe", 30)
+    # Probe even under --smoke: when the axon sitecustomize has registered
+    # the tunnel plugin, backend init blocks on a dead relay even for the
+    # CPU platform (see memory: axon-tunnel-failure-modes) — fail fast
+    # rather than burn the init budget.
+    if _axon_tunnel_expected() and not _relay_alive():
+        _fail("tpu tunnel relay dead: no relay loopback port "
+              f"{_RELAY_PORTS[0]}-{_RELAY_PORTS[-1]} accepts connections; "
+              "refusing to touch the backend (init would hang). "
+              "Re-run when the tunnel is restored.")
+        return
+
+    _stage("backend-init", 180)
+    try:
+        if smoke:
             # CPU smoke: don't claim the shared TPU chip.
             import jax
             jax.config.update("jax_platforms", "cpu")
@@ -145,7 +260,7 @@ def main():
         from paddle_tpu import kernels
         from paddle_tpu.models import llama as L
     except Exception as e:
-        _fail(metric, f"{type(e).__name__}: {e}")
+        _fail(f"{type(e).__name__}: {e}")
         return
 
     on_tpu = dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
@@ -156,21 +271,22 @@ def main():
 
     # Single-chip benchmark ladder: 8B-shaped decoder slices sized to one
     # chip's HBM (v5e = 16G: f32 adam moments cap the param count at ~1.1B;
-    # "full" remat because "dots" blows the compile-time HBM plan). Each rung
-    # is tried in order; a rung that OOMs or fails to compile steps down so
-    # a memory regression degrades the number instead of zeroing it.
+    # "full" remat because "dots" blows the compile-time HBM plan). On TPU
+    # at most TWO rungs are attempted (first choice + one fallback): a rung
+    # that OOMs or fails to compile steps down once so a memory regression
+    # degrades the number instead of zeroing it, but a degraded tunnel
+    # can't accumulate three compile-hang exposures.
     if on_tpu:
         ladder = [
             (dict(num_hidden_layers=4, vocab_size=32000,
                   remat_policy="full"), 4, 2048, 20),
             (dict(num_hidden_layers=3, vocab_size=32000,
                   remat_policy="full"), 2, 2048, 20),
-            (dict(num_hidden_layers=2, vocab_size=16000,
-                  remat_policy="full"), 2, 1024, 10),
         ]
     else:
         ladder = [(None, 4, 128, 5)]
 
+    _stage("kernel-preflight", 150)
     preflight = _preflight_kernels(on_tpu)
 
     last_err = None
@@ -180,6 +296,7 @@ def main():
         else:
             cfg = L.llama_3_8b(**cfg_kw)
         try:
+            _stage("init+compile", 480)
             # One jitted program builds params + opt state directly on device.
             @jax.jit
             def init():
@@ -205,10 +322,11 @@ def main():
                 sys.stderr.write(
                     f"WARNING: pallas flash kernel did not engage: {stats}\n")
 
+            _stage("timed-loop", 240)
             t0 = time.perf_counter()
             for _ in range(iters):
                 params, opt_state, loss = step(params, opt_state, ids)
-            final_loss = float(loss)  # device->host fetch = full pipeline drain
+            final_loss = float(loss)  # device->host fetch = pipeline drain
             dt = time.perf_counter() - t0
             break
         except Exception as e:
@@ -220,9 +338,10 @@ def main():
             params = opt_state = step = init = ids = loss = None
             jax.clear_caches()
     else:
-        _fail(metric, f"all bench rungs failed; last: {last_err}")
+        _fail(f"all bench rungs failed; last: {last_err}")
         return
 
+    _stage("report", 30)
     tokens = batch * seq * iters
     tps = tokens / dt
     # 6ND (fwd+bwd) -> standard MFU (remat recompute not credited)
@@ -231,7 +350,7 @@ def main():
     peak = _peak_flops(dev) if on_tpu else 1e12   # CPU nominal
     mfu = tps * flops_per_token / peak
     payload = {
-        "metric": metric,
+        "metric": _METRIC,
         "value": round(tps, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
@@ -240,7 +359,10 @@ def main():
                   "layers": cfg.num_hidden_layers,
                   "vocab": cfg.vocab_size,
                   "flash_dispatch": stats,
-                  "loss": final_loss},
+                  # NaN/inf would make the line unparseable as strict JSON
+                  "loss": final_loss if np.isfinite(final_loss)
+                  else repr(final_loss),
+                  "elapsed_s": round(time.monotonic() - _T0, 1)},
     }
     if preflight:
         payload["extra"]["kernel_preflight_failures"] = preflight
