@@ -1,2 +1,2 @@
 from . import dtype, flags, state  # noqa
-from .tensor import Parameter, Tensor, to_tensor  # noqa
+from .tensor import Parameter, Tensor, is_tracer, to_tensor  # noqa

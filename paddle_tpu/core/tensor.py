@@ -374,6 +374,14 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def is_tracer(x) -> bool:
+    """True when ``x`` (a raw jax value, not a Tensor facade) is an
+    abstract tracer — i.e. we're inside jit/vmap/grad tracing and its
+    concrete value is unavailable. Single home for the idiom so a jax
+    relocation of ``Tracer`` touches one line."""
+    return isinstance(x, jax.core.Tracer)
+
+
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
     """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
     del place  # device placement is handled by jax; sharding via dist API

@@ -88,6 +88,14 @@ class Binomial(Distribution):
     def entropy(self):
         """Exact by enumeration over the (static) max count — TPU-friendly
         closed loop, no sampling."""
+        from ..core import is_tracer
+        if is_tracer(self.total_count):
+            raise ValueError(
+                "Binomial.entropy() enumerates outcomes up to "
+                "max(total_count), which must be concrete — it cannot run "
+                "under jit tracing with a traced total_count (data-"
+                "dependent loop bound). Construct the distribution with a "
+                "concrete total_count or compute entropy eagerly.")
         nmax = int(jnp.max(self.total_count))
         ks = jnp.arange(nmax + 1, dtype=jnp.float32)
         shape = (nmax + 1,) + (1,) * max(len(self._batch_shape), 0)

@@ -73,20 +73,45 @@ class AbsTransform(Transform):
 
 
 class AffineTransform(Transform):
-    """y = loc + scale * x."""
+    """y = loc + scale * x.
+
+    loc/scale keep their own dtype and are cast to the operand's dtype at
+    call time (so bfloat16/float64 inputs don't get silently mixed with
+    float32 params), and forward_shape/inverse_shape broadcast the event
+    shape against the param shapes like the reference does."""
 
     def __init__(self, loc, scale):
-        self.loc = _raw(loc).astype(jnp.float32)
-        self.scale = _raw(scale).astype(jnp.float32)
+        self.loc = jnp.asarray(_raw(loc))
+        self.scale = jnp.asarray(_raw(scale))
+
+    @staticmethod
+    def _op_dtype(x):
+        # Floating operands keep their dtype; integer operands promote to
+        # float32 (casting float params to an int dtype would truncate
+        # scale=0.5 to 0).
+        return x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) \
+            else jnp.float32
 
     def _forward(self, x):
-        return self.loc + self.scale * x
+        dt = self._op_dtype(x)
+        return self.loc.astype(dt) + self.scale.astype(dt) * x.astype(dt)
 
     def _inverse(self, y):
-        return (y - self.loc) / self.scale
+        dt = self._op_dtype(y)
+        return (y.astype(dt) - self.loc.astype(dt)) / self.scale.astype(dt)
 
     def _forward_log_det_jacobian(self, x):
-        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+        scale = self.scale.astype(self._op_dtype(x))
+        shape = jnp.broadcast_shapes(x.shape, scale.shape)
+        return jnp.broadcast_to(jnp.log(jnp.abs(scale)), shape)
+
+    def forward_shape(self, shape):
+        return jnp.broadcast_shapes(tuple(shape), self.loc.shape,
+                                    self.scale.shape)
+
+    def inverse_shape(self, shape):
+        return jnp.broadcast_shapes(tuple(shape), self.loc.shape,
+                                    self.scale.shape)
 
 
 class ExpTransform(Transform):

@@ -112,7 +112,8 @@ def _segment_api(pool):
         import jax.core
         import numpy as np
         ids = unwrap(segment_ids)
-        if isinstance(ids, jax.core.Tracer):
+        from ..core import is_tracer
+        if is_tracer(ids):
             # under jit the id values are unknown: use the static upper
             # bound (rows of data) so shapes stay compile-time constant
             n = unwrap(data).shape[0]

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import os
 import queue
 import threading
 from typing import Callable, Optional
@@ -168,9 +169,17 @@ def _process_worker_loop(dataset, index_q, result_q, worker_init_fn, wid,
     """One subprocess worker (reference: io/dataloader/worker.py
     _worker_loop): pull (seq, indices), push (seq, numpy batch). With
     ``ship_raw`` (user collate_fn), the raw sample list is shipped and
-    the parent applies the user's collate."""
+    the parent applies the user's collate. ``seed`` is the loader's
+    per-epoch base seed; WorkerInfo.seed = base + wid (so it differs
+    across workers AND across epochs/runs, like the reference's
+    base_seed + worker_id), and the worker's stdlib/numpy RNGs are
+    seeded from it before worker_init_fn runs."""
     global _worker_info
-    _worker_info = WorkerInfo(wid, num_workers, seed + wid, dataset)
+    wseed = (seed + wid) & 0xFFFFFFFF
+    _worker_info = WorkerInfo(wid, num_workers, wseed, dataset)
+    import random as _random
+    _random.seed(wseed)
+    np.random.seed(wseed)
     if worker_init_fn is not None:
         worker_init_fn(wid)
     while True:
@@ -210,10 +219,15 @@ class _ProcessPrefetcher:
         index_q = ctx.Queue()
         result_q = ctx.Queue()
         ship_raw = self._collate is not None
+        # Fresh base seed per epoch (each __iter__ call) so worker RNG
+        # streams differ across epochs — drawn from the parent's numpy
+        # stream so np.random.seed()/paddle.seed() keeps whole runs
+        # reproducible (os.urandom would not be).
+        base_seed = int(np.random.randint(0, 2**31 - 1))
         workers = [ctx.Process(
             target=_process_worker_loop,
             args=(self._dataset, index_q, result_q, self._init_fn, w,
-                  ship_raw, self._n),
+                  ship_raw, self._n, base_seed),
             daemon=True) for w in range(self._n)]
         for w in workers:
             w.start()

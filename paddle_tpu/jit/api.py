@@ -236,14 +236,35 @@ class StaticFunction:
             args, real_batch, padded_batch = self._apply_bucketing(args)
         if real_batch is not None:
             out = self.__wrapped_call(args, kwargs)
+            # Ranks of the padded inputs: an output that is batch-major
+            # normally keeps one of these ranks. Slicing an output whose
+            # leading dim merely COINCIDES with the bucket size (e.g. a
+            # [num_classes, ...] table where num_classes == bucket) would
+            # silently truncate it — warn when the rank heuristic says the
+            # sliced output doesn't look like any padded input.
+            in_ranks = {a._data.ndim for a in args
+                        if isinstance(a, Tensor) and a._data.ndim > 0}
+            odd_ranks = []
 
             def unpad(o):
                 if isinstance(o, Tensor) and o._data.ndim > 0 \
                         and o._data.shape[0] == padded_batch:
+                    if o._data.ndim not in in_ranks:
+                        odd_ranks.append(o._data.ndim)
                     return Tensor(o._data[:real_batch])
                 return o
-            return jax.tree_util.tree_map(
+            out = jax.tree_util.tree_map(
                 unpad, out, is_leaf=lambda x: isinstance(x, Tensor))
+            if odd_ranks:   # warn AFTER tree_map so file:line is the caller
+                import warnings
+
+                warnings.warn(
+                    "to_static bucketing: sliced output(s) of rank(s) "
+                    f"{sorted(set(odd_ranks))} whose leading dim == bucket "
+                    f"size {padded_batch} but whose rank matches no padded "
+                    "input — if such an output is not batch-major, disable "
+                    "bucket_batch for this function", stacklevel=2)
+            return out
         return self.__wrapped_call(args, kwargs)
 
     def __wrapped_call(self, args, kwargs):

@@ -320,6 +320,13 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
     xa = unwrap(x)
     if maxlen is None:
+        from ...core import is_tracer
+        if is_tracer(xa):
+            raise ValueError(
+                "sequence_mask(maxlen=None) must read the max length from "
+                "the data, which is impossible under jit/to_static tracing "
+                "(data-dependent output shape). Pass an explicit maxlen, "
+                "or call it eagerly.")
         maxlen = int(jnp.max(xa))
     mask = jnp.arange(maxlen) < xa[..., None]
     return wrap(mask.astype(convert_dtype(dtype)))

@@ -40,7 +40,8 @@ class _FakeQuantLive:
 
         arr = x._data if isinstance(x, Tensor) else x
         m = jnp.max(jnp.abs(arr))
-        if isinstance(m, jax.core.Tracer):
+        from ..core import is_tracer
+        if is_tracer(m):
             # under jit/to_static tracing the host-side moving average
             # can't update; use the current batch's abs-max dynamically
             # (stateless — the compiled QAT path stays fully functional)

@@ -281,7 +281,8 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     # eager/static both: route through lax.cond on the recorded path
     import jax.numpy as jnp
 
-    if hasattr(p, "item") and not isinstance(p, jax.core.Tracer):
+    from ..core import is_tracer
+    if hasattr(p, "item") and not is_tracer(p):
         return true_fn() if bool(p) else false_fn()
     return jax.lax.cond(p.reshape(()), lambda _: true_fn(),
                         lambda _: false_fn(), operand=None)

@@ -149,9 +149,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         # reference uses an adaptive ceil(roi_extent / output_size) per
         # RoI; static shapes need ONE count, so take the ceil over the
         # largest concrete RoI (bounded), falling back to 4 under tracing
-        import jax.core as _jc
+        from ..core import is_tracer
         ba = unwrap(boxes)
-        if isinstance(ba, _jc.Tracer):
+        if is_tracer(ba):
             sr = 4
         else:
             b = np.asarray(ba)

@@ -492,3 +492,75 @@ class TestOpRegistry:
         assert out.shape == [4]
         assert get_op("allclose") is not None
         assert get_op("bmm") is not None
+
+
+class TestAdviceR3Fixes:
+    """Regressions for the round-3 advisor findings (ADVICE.md r3)."""
+
+    def test_worker_seed_differs_across_epochs(self):
+        # WorkerInfo.seed must be base_seed + wid with a fresh base per
+        # epoch, not a constant equal to the worker id.
+        from paddle_tpu.io import DataLoader
+
+        seen = []
+
+        class DS:
+            def __getitem__(self, i):
+                from paddle_tpu.io.dataloader import get_worker_info
+                return np.float32(get_worker_info().seed)
+
+            def __len__(self):
+                return 4
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=1,
+                        worker_mode="process")
+        for _ in range(2):
+            for batch in dl:
+                seen.append(int(np.asarray(batch.numpy())[0]))
+        assert seen[0] != 0 or seen[1] != 0
+        assert seen[0] != seen[1]   # fresh base seed per epoch
+
+    def test_affine_transform_preserves_dtype_and_broadcasts_shape(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distribution import AffineTransform
+        import paddle_tpu as paddle
+
+        tr = AffineTransform(paddle.to_tensor([0.0, 1.0]),
+                             paddle.to_tensor([1.0, 2.0]))
+        y = tr.forward(paddle.to_tensor(np.ones((3, 2), np.float16)))
+        assert y._data.dtype == jnp.float16
+        assert tr.forward_shape((3, 1)) == (3, 2)
+        assert tr.inverse_shape((2,)) == (2,)
+        ld = tr.forward_log_det_jacobian(paddle.to_tensor(
+            np.ones((3, 1), np.float32)))
+        assert list(ld.shape) == [3, 2]
+
+    def test_sequence_mask_traced_without_maxlen_raises(self):
+        import jax
+        import pytest
+
+        from paddle_tpu.nn.functional import sequence_mask
+        import paddle_tpu as paddle
+
+        assert sequence_mask(paddle.to_tensor([2, 3]), maxlen=None) \
+            .shape == [2, 3]
+
+        def f(x):
+            return sequence_mask(x, maxlen=None)._data
+
+        with pytest.raises(ValueError, match="explicit maxlen"):
+            jax.jit(f)(np.array([2, 3]))
+
+    def test_binomial_entropy_traced_raises(self):
+        import jax
+        import pytest
+
+        from paddle_tpu.distribution import Binomial
+        import paddle_tpu as paddle
+
+        def f(n):
+            return Binomial(n, paddle.to_tensor(0.5)).entropy()._data
+
+        with pytest.raises(ValueError, match="concrete total_count"):
+            jax.jit(f)(np.array(4.0, np.float32))
