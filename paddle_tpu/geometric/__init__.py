@@ -109,7 +109,6 @@ def _segment_api(pool):
         return out
 
     def api(data, segment_ids, name=None):
-        import jax.core
         import numpy as np
         ids = unwrap(segment_ids)
         from ..core import is_tracer

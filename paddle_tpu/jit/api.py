@@ -249,7 +249,11 @@ class StaticFunction:
             def unpad(o):
                 if isinstance(o, Tensor) and o._data.ndim > 0 \
                         and o._data.shape[0] == padded_batch:
-                    if o._data.ndim not in in_ranks:
+                    # Reduced-rank outputs ([B] predictions from [B, F]
+                    # inputs) are normal batch-major shapes; only an
+                    # output of HIGHER rank than every padded input looks
+                    # like a non-batch table caught by coincidence.
+                    if o._data.ndim > max(in_ranks):
                         odd_ranks.append(o._data.ndim)
                     return Tensor(o._data[:real_batch])
                 return o

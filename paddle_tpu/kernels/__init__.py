@@ -19,13 +19,16 @@ import jax
 import jax.numpy as jnp
 
 from . import flash_attention as _fa
+from . import fused_ce as _fce
 from . import rms_norm as _rn
 from .ring_attention import ring_attention  # noqa
 
 flash_attention = _fa.flash_attention
 fused_rms_norm = _rn.rms_norm
+fused_cross_entropy = _fce.fused_cross_entropy
 
-__all__ = ["flash_attention", "fused_rms_norm", "ring_attention",
+__all__ = ["flash_attention", "fused_rms_norm", "fused_cross_entropy",
+           "dispatched_fused_ce", "ring_attention",
            "register", "unregister", "dispatch_stats", "reset_dispatch_stats"]
 
 # Trace-time dispatch counters (reference capability: the KernelFactory's
@@ -35,7 +38,8 @@ __all__ = ["flash_attention", "fused_rms_norm", "ring_attention",
 # fast path actually engaged at their shapes instead of silently falling
 # back (a silent `supported()` miss would quietly cost MFU).
 _DISPATCH_STATS = {"flash": 0, "flash_fallback": 0,
-                   "rms": 0, "rms_fallback": 0}
+                   "rms": 0, "rms_fallback": 0,
+                   "fused_ce": 0, "fused_ce_fallback": 0}
 
 
 def dispatch_stats() -> dict:
@@ -83,6 +87,30 @@ def _make_rms_dispatch(tpu_only: bool):
         _DISPATCH_STATS["rms"] += 1
         return _rn.rms_norm(x, w, eps).astype(out_dtype)
     return dispatch
+
+
+def dispatched_fused_ce(x, head, labels, *, vocab_chunk=4096,
+                        reduction="mean"):
+    """Blockwise CE with the same counter discipline as flash/rms: the
+    trace records whether the memory-efficient path engaged, and an
+    unsupported shape falls back to the materialising xent (identical
+    math) instead of erroring. Works on every backend (it is pure
+    jnp/lax, not pallas), so there is no tpu_only gate."""
+    if _fce.supported(x, head, labels):
+        _DISPATCH_STATS["fused_ce"] += 1
+        return _fce.fused_cross_entropy(
+            x, head, labels, vocab_chunk=vocab_chunk, reduction=reduction)
+    _DISPATCH_STATS["fused_ce_fallback"] += 1
+    logits = jnp.einsum("...d,vd->...v", x, head,
+                        preferred_element_type=jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    per_tok = logz - gold
+    if reduction == "mean":
+        return jnp.mean(per_tok)
+    if reduction == "sum":
+        return jnp.sum(per_tok)
+    return per_tok
 
 
 def register(flash: bool = True, rms: bool = True, tpu_only: bool = False):

@@ -1,0 +1,162 @@
+"""Blockwise (memory-efficient) softmax cross-entropy for the LM head.
+
+Reference capability: the fused cross-entropy hot path —
+paddle/phi/kernels/gpu/cross_entropy_kernel.cu (softmax+xent in one pass)
+and python/paddle/nn/functional/loss.py:2110 margin_cross_entropy's
+dedicated kernel route. There the fusion saves a softmax round-trip; here
+the win is bigger: the [B*S, V] logits tensor NEVER exists in HBM.
+
+TPU-native design (NOT a port): a `lax.scan` over vocabulary chunks.
+
+- forward: for each chunk of the head matrix, one [N, D] x [D, Vb] matmul
+  (rides the MXU in bf16, f32 accumulation) feeds an online-softmax
+  update (running max `m`, running sum-of-exp `s`, gathered gold logit),
+  the same recurrence the flash-attention kernel uses along K. Peak HBM
+  for the loss is O(N * Vb) instead of O(N * V).
+- backward: custom_vjp recomputes each logit chunk (rematerialisation —
+  trade one extra matmul pass for never storing softmax), forms
+  d_logits = (softmax - onehot) * g on the fly, and contracts it
+  immediately into dx and the chunk's dhead rows.
+
+FLOPs: 8*N*D*V vs 6*N*D*V for the materialising path (+1 matmul pass in
+bwd); HBM traffic for the head drops from ~3 reads/writes of [N, V] f32
+to zero. At Llama shapes (V = 32k-128k) the loss path is HBM-bound, so
+this is a net win on TPU — and it makes vocab sizes that previously
+OOM'd (128k at 16G HBM) feasible.
+
+Chunking is over the STATIC vocab axis, so everything stays
+fixed-shape for XLA; the chunk count is `ceil(V / vocab_chunk)` with the
+tail chunk masked, never a dynamic shape.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fused_cross_entropy", "supported"]
+
+_NEG = -1e30   # large-negative instead of -inf: keeps XLA's max/exp exact
+               # for masked lanes without generating inf-inf = nan paths
+
+
+def supported(x, head, labels) -> bool:
+    """Shape guard for the dispatcher: 2D-flattenable x, matching head."""
+    return (x.ndim >= 2 and head.ndim == 2
+            and x.shape[-1] == head.shape[-1]
+            and labels.shape == x.shape[:-1])
+
+
+def _pad_head(head, vocab_chunk):
+    v = head.shape[0]
+    k = -(-v // vocab_chunk)            # ceil
+    pad = k * vocab_chunk - v
+    if pad:
+        head = jnp.pad(head, ((0, pad), (0, 0)))
+    return head.reshape(k, vocab_chunk, head.shape[-1]), v
+
+
+def _chunk_logits(x, head_chunk, base, valid_v):
+    """[N, Vb] f32 logits for one head chunk, padded rows masked."""
+    logits = jnp.einsum("nd,vd->nv", x, head_chunk,
+                        preferred_element_type=jnp.float32)
+    vb = head_chunk.shape[0]
+    col = base + jnp.arange(vb)
+    return jnp.where(col[None, :] < valid_v, logits, _NEG)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _blockwise_ce(x, headc, labels, valid_v):
+    """Per-token CE loss [N] from x [N, D], headc [K, Vb, D], labels [N]."""
+    loss, _ = _blockwise_ce_fwd(x, headc, labels, valid_v)
+    return loss
+
+
+def _blockwise_ce_fwd(x, headc, labels, valid_v):
+    n = x.shape[0]
+    k, vb, _ = headc.shape
+
+    def body(carry, inp):
+        m, s, gold = carry
+        i, hc = inp
+        base = i * vb
+        logits = _chunk_logits(x, hc, base, valid_v)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = labels - base
+        in_chunk = (local >= 0) & (local < vb)
+        gl = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, vb - 1)[:, None], axis=-1)[:, 0]
+        gold = jnp.where(in_chunk, gl, gold)
+        return (m_new, s, gold), None
+
+    init = (jnp.full((n,), _NEG, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.full((n,), _NEG, jnp.float32))
+    (m, s, gold), _ = lax.scan(body, init, (jnp.arange(k), headc))
+    lse = m + jnp.log(s)
+    loss = lse - gold
+    return loss, (x, headc, labels, lse)
+
+
+def _blockwise_ce_bwd(valid_v, res, g):
+    x, headc, labels, lse = res
+    k, vb, d = headc.shape
+
+    def body(dx, inp):
+        i, hc = inp
+        base = i * vb
+        logits = _chunk_logits(x, hc, base, valid_v)
+        p = jnp.exp(logits - lse[:, None])          # masked cols -> ~0
+        local = labels - base
+        in_chunk = (local >= 0) & (local < vb)
+        onehot = (jnp.clip(local, 0, vb - 1)[:, None]
+                  == jnp.arange(vb)[None, :]) & in_chunk[:, None]
+        d_logits = ((p - onehot.astype(p.dtype)) * g[:, None]).astype(x.dtype)
+        dx = dx + jnp.einsum("nv,vd->nd", d_logits, hc,
+                             preferred_element_type=jnp.float32)
+        dhc = jnp.einsum("nv,nd->vd", d_logits, x,
+                         preferred_element_type=jnp.float32)
+        return dx, dhc.astype(headc.dtype)
+
+    dx, dheadc = lax.scan(body, jnp.zeros(x.shape, jnp.float32),
+                          (jnp.arange(k), headc))
+    return dx.astype(x.dtype), dheadc, None
+
+
+_blockwise_ce.defvjp(_blockwise_ce_fwd, _blockwise_ce_bwd)
+
+
+def fused_cross_entropy(x, head, labels, *, vocab_chunk: int = 4096,
+                        reduction: str = "mean"):
+    """Softmax cross-entropy of ``x @ head.T`` against integer ``labels``
+    without materialising the logits.
+
+    Args:
+      x: [..., D] hidden states (any float dtype; matmuls accumulate f32).
+      head: [V, D] output-projection matrix.
+      labels: integer [...] gold class ids.
+      vocab_chunk: vocab tile size (static; tail chunk masked).
+      reduction: "mean" | "sum" | "none".
+    """
+    if not jnp.issubdtype(jnp.asarray(labels).dtype, jnp.integer):
+        # the materialising path's take_along_axis would reject float
+        # labels too — don't silently floor soft/smoothed targets
+        raise TypeError(
+            f"fused_cross_entropy: labels must be integer class ids, got "
+            f"{jnp.asarray(labels).dtype} (soft labels are not supported)")
+    n = 1
+    for s in x.shape[:-1]:
+        n *= s
+    xf = x.reshape(n, x.shape[-1])
+    lf = labels.reshape(n).astype(jnp.int32)
+    headc, valid_v = _pad_head(head, min(vocab_chunk, head.shape[0]))
+    loss = _blockwise_ce(xf, headc, lf, valid_v)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss.reshape(labels.shape)

@@ -66,6 +66,12 @@ class LlamaConfig:
     # non-batch matmul outputs (reference recompute's selective checkpointing
     # — fewer recomputed FLOPs, higher MFU, modest extra HBM).
     remat_policy: str = "dots"
+    # Blockwise lm-head cross entropy (kernels/fused_ce.py): the [B,S,V]
+    # logits never hit HBM. Engaged on the single-device path; the GSPMD
+    # multi-device loss keeps the einsum head (vocab-parallel sharding of
+    # the scan-chunked head is not yet wired).
+    fused_ce: bool = True
+    fused_ce_chunk: int = 4096
 
     @property
     def head_dim(self) -> int:
@@ -182,9 +188,9 @@ def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     return x
 
 
-def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
-            mesh: Optional[Mesh] = None):
-    """Logits [B, S, V] from token ids [B, S]. Pure; jit/shard-ready."""
+def forward_hidden(params, ids, config: LlamaConfig, *, sp: bool = False,
+                   mesh: Optional[Mesh] = None):
+    """Final hidden states [B, S, D] (post ln_f) from token ids [B, S]."""
     c = config
     x = jnp.take(params["embed"], ids, axis=0)
     cos, sin = rope_tables(c, ids.shape[1])
@@ -200,21 +206,45 @@ def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
                   if c.remat_policy == "dots" else None)
         step = jax.checkpoint(step, prevent_cse=False, policy=policy)
     x, _ = lax.scan(step, x, params["layers"])
-    x = _rms(x, params["ln_f"], c.rms_norm_eps)
-    head = params["embed"] if c.tie_word_embeddings else params["lm_head"]
+    return _rms(x, params["ln_f"], c.rms_norm_eps)
+
+
+def _head(params, config: LlamaConfig):
+    return params["embed"] if config.tie_word_embeddings \
+        else params["lm_head"]
+
+
+def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
+            mesh: Optional[Mesh] = None):
+    """Logits [B, S, V] from token ids [B, S]. Pure; jit/shard-ready."""
+    x = forward_hidden(params, ids, config, sp=sp, mesh=mesh)
     # logits in float32 for a stable softmax-xent
-    return jnp.einsum("bsd,vd->bsv", x, head,
+    return jnp.einsum("bsd,vd->bsv", x, _head(params, config),
                       preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, batch, config: LlamaConfig, *, sp: bool = False,
             mesh: Optional[Mesh] = None):
-    """Causal-LM cross entropy. batch = (ids [B,S+1]) or (inp, labels)."""
+    """Causal-LM cross entropy. batch = (ids [B,S+1]) or (inp, labels).
+
+    Single-device: blockwise fused CE (kernels/fused_ce.py) — the [B,S,V]
+    logits never materialise in HBM (the reference's
+    cross_entropy_kernel.cu capability, rebuilt as an online-softmax scan
+    over vocab chunks). Multi-device (mesh): einsum logits + stable xent,
+    which GSPMD shards vocab-parallel.
+    """
     if isinstance(batch, (tuple, list)):
         inp, labels = batch
     else:
         inp, labels = batch[:, :-1], batch[:, 1:]
-    logits = forward(params, inp, config, sp=sp, mesh=mesh)
+    c = config
+    if c.fused_ce and mesh is None:
+        from ..kernels import dispatched_fused_ce
+
+        x = forward_hidden(params, inp, c, sp=sp, mesh=mesh)
+        return dispatched_fused_ce(x, _head(params, c), labels,
+                                   vocab_chunk=c.fused_ce_chunk)
+    logits = forward(params, inp, c, sp=sp, mesh=mesh)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - gold)
