@@ -69,7 +69,12 @@ def _make_flash_dispatch(tpu_only: bool):
             _DISPATCH_STATS["flash_fallback"] += 1
             return _att.sdpa_reference(q, k, v, causal=causal, scale=scale)
         _DISPATCH_STATS["flash"] += 1
-        return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
+        # shapes are static at trace time -> per-shape tuned block sizes
+        # (measured once, cached to disk; defaults off-TPU)
+        from . import autotune as _at
+        bq, bk = _at.flash_blocks(q.shape, k.shape, q.dtype, causal)
+        return _fa.flash_attention(q, k, v, causal=causal, scale=scale,
+                                   block_q=bq, block_k=bk)
     return dispatch
 
 

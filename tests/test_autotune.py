@@ -1,0 +1,164 @@
+"""kernels/autotune.py — block-size autotune cache (the phi
+autotune/cache.h analogue). CPU tests use an injected measure fn (timing
+interpret-mode pallas would be meaningless); the real measurement path
+runs on TPU via scripts/tpu_smoke.py."""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import autotune as at
+
+
+class TestCandidates:
+    def test_default_first_and_legal(self):
+        cands = at.flash_candidates(8, 2048, 2048, 128, jnp.bfloat16)
+        assert cands[0] == (128, 128)
+        assert len(cands) > 1
+        for bq, bk in cands:
+            assert 2048 % bq == 0 and 2048 % bk == 0
+            assert at._vmem_bytes(bq, bk, 128) <= at._VMEM_BUDGET
+
+    def test_short_seq_clamps(self):
+        cands = at.flash_candidates(8, 256, 256, 128, jnp.bfloat16)
+        assert all(bq <= 256 and bk <= 256 for bq, bk in cands)
+
+    def test_never_empty(self):
+        assert at.flash_candidates(8, 8, 8, 64, jnp.float32)
+
+
+class TestFlashBlocks:
+    def _call(self, cache, measure, sq=2048, sk=2048):
+        return at.flash_blocks((2, sq, 4, 128), (2, sk, 2, 128),
+                               jnp.bfloat16, True,
+                               measure=measure, cache=cache)
+
+    def test_measures_once_then_cached(self, tmp_path):
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        calls = []
+
+        def measure(bq, bk):
+            calls.append((bq, bk))
+            return 1.0 if (bq, bk) != (256, 128) else 0.5
+
+        assert self._call(cache, measure) == (256, 128)
+        n = len(calls)
+        assert n >= 2
+        assert self._call(cache, measure) == (256, 128)
+        assert len(calls) == n   # cache hit, no re-measure
+
+    def test_persists_to_disk(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        cache = at.AutotuneCache(path)
+        self._call(cache, lambda bq, bk: float(bq))   # smallest bq wins
+        disk = json.load(open(path))
+        (key,) = disk.keys()
+        assert key.startswith("flash:")
+        assert disk[key]["blocks"] == [128, 128]
+        # a brand-new cache instance (fresh process) reads the winner
+        cache2 = at.AutotuneCache(path)
+        calls = []
+        got = self._call(cache2, lambda bq, bk: calls.append(1) or 1.0)
+        assert got == (128, 128) and not calls
+
+    def test_failing_candidates_drop_out(self, tmp_path):
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+
+        def measure(bq, bk):
+            if (bq, bk) == (128, 128):
+                raise RuntimeError("compile failed")
+            return float(bq + bk)
+
+        got = self._call(cache, measure)
+        assert got != (128, 128)
+
+    def test_all_fail_caches_default_once(self, tmp_path):
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        calls = []
+
+        def measure(bq, bk):
+            calls.append(1)
+            raise RuntimeError("boom")
+
+        assert self._call(cache, measure) == (128, 128)
+        n = len(calls)
+        # the failed sweep must not repeat: default was cached
+        assert self._call(cache, measure) == (128, 128)
+        assert len(calls) == n
+
+    def test_cached_mode_never_measures(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "cached")
+        path = str(tmp_path / "c.json")
+        cache = at.AutotuneCache(path)
+        calls = []
+        # miss -> defaults, no measurement even with a measure fn given
+        got = self._call(cache, lambda bq, bk: calls.append(1) or 1.0)
+        assert got == (128, 128) and not calls
+        # pre-tuned entry -> honored
+        at._USED.clear()
+        cache2 = at.AutotuneCache(path)
+        self._seed(cache2, (256, 128))
+        got = self._call(cache2, lambda bq, bk: calls.append(1) or 1.0)
+        assert got == (256, 128) and not calls
+        assert any(v["source"] == "cache" for v in at.used_blocks().values())
+
+    def _seed(self, cache, blocks):
+        key = ("flash:cpu:bfloat16:b2h4kv2:q2048k2048d128:c1")
+        cache.put(key, {"blocks": list(blocks), "us": 1.0, "candidates": 2})
+
+    def test_concurrent_put_merges_disk(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        a = at.AutotuneCache(path)
+        b = at.AutotuneCache(path)
+        a.put("k1", {"blocks": [128, 128]})
+        b.put("k2", {"blocks": [256, 128]})   # b never saw k1 at load time
+        disk = json.load(open(path))
+        assert set(disk) == {"k1", "k2"}
+
+    def test_disabled_flag_returns_defaults(self, tmp_path, monkeypatch):
+        from paddle_tpu.core import flags
+        flags.set_flags({"use_autotune": False})
+        try:
+            calls = []
+            got = self._call(at.AutotuneCache(str(tmp_path / "c.json")),
+                             lambda bq, bk: calls.append(1) or 1.0)
+            assert got == (128, 128) and not calls
+        finally:
+            flags.set_flags({"use_autotune": True})
+
+    def test_off_tpu_without_injected_measure_returns_defaults(self,
+                                                              tmp_path):
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        got = at.flash_blocks((2, 2048, 4, 128), (2, 2048, 2, 128),
+                              jnp.bfloat16, True, cache=cache)
+        assert got == (128, 128)
+
+
+class TestBf16Moments:
+    def test_bf16_moments_halve_bytes_and_still_train(self):
+        import jax
+
+        from paddle_tpu.models import llama as L
+
+        cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        opt32 = L.adamw_init(params)
+        opt16 = L.adamw_init(params, moment_dtype=jnp.bfloat16)
+
+        def nbytes(tree):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(tree))
+
+        assert nbytes(opt16["m"]) * 2 == nbytes(opt32["m"])
+
+        step = L.make_train_step(cfg, lr=1e-3)
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 33)), jnp.int32)
+        losses = []
+        opt = opt16
+        for _ in range(5):
+            params, opt, loss = step(params, opt, ids)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert jax.tree.leaves(opt["m"])[0].dtype == jnp.bfloat16

@@ -2,6 +2,7 @@
 Llama core vs eager Layer model, sharded hybrid-parallel train step on the
 8-device CPU mesh (the reference's N-local-process strategy, SURVEY.md §4).
 """
+import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -79,7 +80,11 @@ class TestShardedLlama:
 
     def test_sharded_step_matches_single_device(self):
         """Hybrid dp/fsdp/tp(+sp) sharded loss == single-device loss."""
-        cfg = tiny()
+        # fused_ce=False: the single-device ref must compute the SAME
+        # einsum loss the GSPMD path uses, else adam amplifies the
+        # blockwise-vs-materialised rounding delta past the tolerance
+        # (fused-vs-einsum equivalence is tested in test_fused_ce.py)
+        cfg = dataclasses.replace(tiny(), fused_ce=False)
         mesh = self._mesh()
         params = L.init_params(cfg, jax.random.PRNGKey(0))
         ids = jnp.asarray(np.random.default_rng(0).integers(
