@@ -1,0 +1,105 @@
+"""Worker for the multi-process COMPILED-collective training test (run
+via the launch CLI, not collected by pytest).
+
+Reference pattern: test/legacy_test/test_collective_api_base.py:113 — the
+core multi-rank check is a real train step whose gradient reduction
+crosses process boundaries, compared against single-process math. Here:
+each of W processes hosts 2 virtual CPU devices; a 2W-device ("dp",)
+mesh spans all of them; one jitted SGD step on dp-sharded data makes XLA
+emit a cross-process all-reduce for the gradient (SPMD over gloo, not
+host-side object exchange). Every rank recomputes the same training
+single-process and asserts parity.
+"""
+import os
+import sys
+
+# 2 local virtual CPU devices per process -> 2*world global devices
+# across the cluster. Must be set before jax import; strip any inherited
+# device-count flag (e.g. conftest's =8) rather than relying on
+# last-occurrence-wins parsing.
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+
+STEPS = 5
+LR = 0.1
+N, D = 16, 4        # 16 rows: 4 per device across 4 devices
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=(D,)).astype(np.float32)
+    Y = X @ w_true
+    return X, Y
+
+
+def _step_fn(w, x, y):
+    def loss_fn(w):
+        return jnp.mean((x @ w - y) ** 2)
+
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - LR * g, loss
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert jax.process_count() == world, "jax.distributed did not initialize"
+    devs = jax.devices()
+    assert len(devs) == 2 * world, \
+        f"expected {2 * world} global devices, got {len(devs)}"
+
+    mesh = Mesh(np.array(devs), ("dp",))
+    repl = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("dp"))
+
+    X, Y = _data()
+    # each process feeds only ITS rows; the global array spans all procs
+    lo, hi = rank * (N // world), (rank + 1) * (N // world)
+    gx = jax.make_array_from_process_local_data(row, X[lo:hi])
+    gy = jax.make_array_from_process_local_data(row, Y[lo:hi])
+
+    jitted = jax.jit(_step_fn, in_shardings=(repl, row, row),
+                     out_shardings=(repl, repl))
+    w = jax.device_put(jnp.zeros((D,), jnp.float32), repl)
+    # AOT-compile ONCE; the loop reuses the same executable and the HLO
+    # check reads its text (no second trace/compile)
+    step = jitted.lower(w, gx, gy).compile()
+    hlo = step.as_text()
+    assert "all-reduce" in hlo or "reduce-scatter" in hlo, \
+        "no cross-device reduction in the compiled train step"
+    losses = []
+    for _ in range(STEPS):
+        w, loss = step(w, gx, gy)
+        losses.append(float(loss))   # cross-process fetch = sync
+
+    assert losses[-1] < losses[0] * 0.5, losses
+
+    # single-process oracle: identical math on the full batch
+    wref = jnp.zeros((D,), jnp.float32)
+    for _ in range(STEPS):
+        wref, _ = _step_fn(wref, jnp.asarray(X), jnp.asarray(Y))
+    np.testing.assert_allclose(np.asarray(w), np.asarray(wref),
+                               rtol=1e-5, atol=1e-6)
+
+    dist.barrier()
+    print(f"DIST_TRAIN_OK rank={rank} loss0={losses[0]:.4f} "
+          f"lossN={losses[-1]:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,17 @@ WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "_mp_worker.py")
 
 
+def _read_worker_logs(log_dir, nprocs):
+    """Full content of every workerlog (assert against ALL of it; callers
+    truncate only when printing a failure)."""
+    logs = ""
+    for rank in range(nprocs):
+        p = os.path.join(log_dir, f"workerlog.{rank}")
+        if os.path.exists(p):
+            logs += f"--- rank {rank} ---\n" + open(p).read()
+    return logs
+
+
 class TestLaunchCLI:
     def test_cli_help(self):
         r = subprocess.run(
@@ -36,13 +47,33 @@ class TestLaunchCLI:
              WORKER, str(tmp_path / "ckpt")],
             capture_output=True, text=True, timeout=420,
             env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
-        logs = ""
-        for rank in (0, 1):
-            p = os.path.join(log_dir, f"workerlog.{rank}")
-            if os.path.exists(p):
-                logs += f"--- rank {rank} ---\n" + open(p).read()[-3000:]
-        assert r.returncode == 0, logs
-        assert "MP_OK rank=0" in logs and "MP_OK rank=1" in logs, logs
+        logs = _read_worker_logs(log_dir, 2)
+        assert r.returncode == 0, logs[-6000:]
+        assert "MP_OK rank=0" in logs and "MP_OK rank=1" in logs, \
+            logs[-6000:]
+
+    @pytest.mark.parametrize("nprocs", [2, 4])
+    def test_cross_process_compiled_collective_training(self, tmp_path,
+                                                        nprocs):
+        """A jitted DP train step whose gradient all-reduce crosses
+        process boundaries (reference pattern:
+        test_collective_api_base.py:113): N processes x 2 virtual CPU
+        devices form one ("dp",) mesh; the worker asserts the compiled
+        HLO contains a cross-replica reduction AND that the final
+        weights match single-process training exactly."""
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_dist_train_worker.py")
+        log_dir = str(tmp_path / "logs")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", str(nprocs), "--log_dir", log_dir,
+             worker],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+        logs = _read_worker_logs(log_dir, nprocs)
+        assert r.returncode == 0, logs[-6000:]
+        for rank in range(nprocs):
+            assert f"DIST_TRAIN_OK rank={rank}" in logs, logs[-6000:]
 
     def test_failing_worker_fails_fast(self, tmp_path):
         bad = tmp_path / "bad.py"
