@@ -7,6 +7,7 @@ from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
                         segment_min, segment_sum, softmax_mask_fuse,
                         softmax_mask_fuse_upper_triangle)
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import tensor  # noqa: F401
 from . import multiprocessing  # noqa: F401

@@ -1,0 +1,148 @@
+"""Automatic SParsity (2:4 structured sparsity) workflow.
+
+Reference capability: python/paddle/incubate/asp/{__init__,asp,
+supported_layer_list}.py — prune supported layers' weights to an n:m
+pattern, remember the masks, and guarantee the pattern survives training
+by re-masking after every optimizer step.
+
+TPU-native design: masks are plain arrays applied with one fused
+multiply after ``step()`` (XLA fuses it into the update); there are no
+mask Variables or program-insertion passes — the dynamic-graph workflow
+(decorate -> prune_model -> train) is the whole story, matching how the
+reference's dygraph path behaves (asp.py:216 decorate, asp.py:302
+prune_model). Sparse-tensor-core acceleration is a GPU feature; on TPU
+the value of 2:4 pruning is model compression + the training recipe, and
+that is what this provides (recorded in docs/CAPABILITY_DELTA.md).
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import (CheckMethod, MaskAlgo, calculate_density, check_mask_1d,
+                    check_mask_2d, check_sparsity, create_mask,
+                    get_mask_1d, get_mask_2d_best, get_mask_2d_greedy)
+
+__all__ = [
+    "calculate_density",
+    "decorate",
+    "prune_model",
+    "set_excluded_layers",
+    "reset_excluded_layers",
+    "add_supported_layer",
+]
+
+# parameter-name suffixes eligible for pruning (reference
+# supported_layer_list.py: fc/linear/conv weights, never biases/norms)
+_SUPPORTED_TYPES = {"Linear", "Conv2D", "Conv1D"}
+_EXTRA_SUPPORTED: set = set()
+_EXCLUDED_NAMES: set = set()
+# live (weakref(param), device mask) pairs — weakrefs so a freed model's
+# masks die with it (an id()-keyed dict could hand a recycled id a stale
+# mask) and dead entries are swept on every apply
+_MASK_REFS: list = []
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by name) from ASP pruning/masking
+    (reference asp.py:40; main_program accepted for API parity)."""
+    _EXCLUDED_NAMES.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    """Clear the exclusion list (reference asp.py:127)."""
+    _EXCLUDED_NAMES.clear()
+
+
+def add_supported_layer(layer):
+    """Register an extra layer TYPE (class or class name) whose 2D+
+    weights ASP may prune (reference supported_layer_list.py)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", type(layer).__name__)
+    _EXTRA_SUPPORTED.add(name)
+
+
+def _prunable_params(model):
+    """(name, param) pairs ASP handles: weights (ndim >= 2) of supported
+    layer types, not excluded."""
+    out = []
+    for lname, layer in model.named_sublayers(include_self=True):
+        tname = type(layer).__name__
+        if tname not in _SUPPORTED_TYPES and tname not in _EXTRA_SUPPORTED:
+            continue
+        for pname, p in layer.named_parameters(prefix=lname):
+            if p is None or len(p.shape) < 2:
+                continue
+            if not pname.endswith("weight"):
+                continue
+            if pname in _EXCLUDED_NAMES or \
+                    getattr(p, "name", None) in _EXCLUDED_NAMES:
+                continue
+            out.append((pname, p))
+    return out
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported layers of ``model`` to the n:m pattern in place;
+    returns {param name: mask}. ``with_mask=True`` records the masks so
+    a decorated optimizer keeps re-applying them during training
+    (reference asp.py:302)."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    masks = {}
+    for name, p in _prunable_params(model):
+        mask = create_mask(p, func_name=algo, n=n, m=m)
+        dmask = jnp.asarray(mask, p._data.dtype)   # device-resident
+        p._data = p._data * dmask                  # fused multiply, no
+        masks[name] = mask                         # host round-trip
+        if with_mask:
+            try:
+                _MASK_REFS.append((weakref.ref(p), dmask))
+            except TypeError:      # non-weakrefable param object
+                _MASK_REFS.append((lambda p=p: p, dmask))
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Optimizer wrapper: after every ``step()``, re-apply the recorded
+    masks so updates cannot resurrect pruned weights (reference
+    asp.py:912 — there via appended masking ops; here one masked
+    multiply per pruned param)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _apply_masks(self):
+        params = {id(p): p for p in (self._optimizer._parameter_list or [])}
+        dead = []
+        for i, (ref, dmask) in enumerate(_MASK_REFS):
+            p = ref()
+            if p is None:
+                dead.append(i)
+                continue
+            if id(p) in params:
+                # one fused device multiply; stays lazy, no host sync
+                p._data = p._data * dmask.astype(p._data.dtype)
+        for i in reversed(dead):
+            _MASK_REFS.pop(i)
+
+    def step(self):
+        self._optimizer.step()
+        self._apply_masks()
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._optimizer.minimize(loss, *args, **kwargs)
+        self._apply_masks()
+        return out
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer`` so sparsity survives training (reference
+    asp.py:216)."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,219 @@
+"""n:m structured-sparsity mask math (vectorised, TPU-first).
+
+Reference capability: python/paddle/incubate/asp/utils.py — per-group
+top-|w| mask generation (mask_1d), 2D tile patterns (mask_2d_greedy /
+mask_2d_best), the matching checkers, and calculate_density.
+
+TPU-native design (not a port): the reference loops Python over groups
+and permutation tables; here every algorithm is one vectorised jnp
+program —
+- mask_1d: reshape to [-1, m], rank each group by |w| with argsort, keep
+  the top n. One gather, no loops.
+- mask_2d_best: enumerate (host-side, once, cached) all valid m x m 0/1
+  patterns with exactly n per row AND per column, then score every m x m
+  tile against every pattern with a single [tiles, m*m] @ [m*m, patterns]
+  matmul (MXU-shaped) and pick the argmax pattern per tile.
+- mask_2d_greedy: the reference's row-then-column greedy selection,
+  vectorised over tiles.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+from enum import Enum
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MaskAlgo", "CheckMethod", "calculate_density",
+           "get_mask_1d", "check_mask_1d", "get_mask_2d_greedy",
+           "get_mask_2d_best", "check_mask_2d", "create_mask",
+           "check_sparsity"]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo):
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D \
+            else CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros in ``x`` (reference utils.py:78)."""
+    a = np.asarray(getattr(x, "_data", x))
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def _pad_cols(a: np.ndarray, mult: int) -> np.ndarray:
+    pad = (-a.shape[1]) % mult
+    if pad:
+        a = np.pad(a, ((0, 0), (0, pad)))
+    return a
+
+
+def get_mask_1d(mat, n: int = 2, m: int = 4) -> np.ndarray:
+    """0/1 mask ZEROING the ``n`` smallest-|.| entries of every group of
+    ``m`` consecutive elements along the last axis (reference n:m
+    semantics, utils.py:184 — n is the pruned count, so n=2, m=4 keeps
+    2 of every 4)."""
+    a = np.asarray(mat, np.float32)
+    rows, cols = a.shape
+    ap = _pad_cols(a, m)
+    g = jnp.abs(jnp.asarray(ap)).reshape(-1, m)
+    # rank positions per group; the m-n largest by magnitude survive
+    order = jnp.argsort(-g, axis=1)
+    keep = jnp.zeros_like(g, dtype=bool)
+    keep = keep.at[jnp.arange(g.shape[0])[:, None],
+                   order[:, :m - n]].set(True)
+    mask = np.asarray(keep).reshape(rows, -1)[:, :cols]
+    return mask.astype(a.dtype)
+
+
+def check_mask_1d(mat, n: int = 2, m: int = 4) -> bool:
+    """Every m-group along the last axis has at most ``m - n`` nonzeros
+    (at least n pruned), matching the reference checker."""
+    a = _pad_cols(np.asarray(mat), m)
+    groups = (a != 0).reshape(-1, m).sum(axis=1)
+    return bool((groups <= m - n).all())
+
+
+@functools.lru_cache(maxsize=8)
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m*m 0/1 patterns with exactly ``m - n`` ones per row AND
+    column — n entries pruned per row/column, the reference's n:m
+    semantics (host-side, cached; 90 patterns for 2:4)."""
+    row_choices = list(itertools.combinations(range(m), m - n))
+    pats = []
+    for rows in itertools.product(row_choices, repeat=m):
+        col_counts = np.zeros(m, np.int32)
+        for r in rows:
+            col_counts[list(r)] += 1
+        if (col_counts == n).all():
+            p = np.zeros((m, m), np.float32)
+            for i, r in enumerate(rows):
+                p[i, list(r)] = 1.0
+            pats.append(p.reshape(-1))
+    return np.stack(pats)                     # [P, m*m]
+
+
+def _tile_view(a: np.ndarray, m: int):
+    """Pad to multiples of m and return tiles [T, m, m] + geometry."""
+    r = (-a.shape[0]) % m
+    c = (-a.shape[1]) % m
+    ap = np.pad(a, ((0, r), (0, c)))
+    R, C = ap.shape
+    tiles = ap.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3) \
+        .reshape(-1, m, m)
+    return tiles, ap.shape
+
+
+def _tiles_to_mat(tiles: np.ndarray, padded_shape, m: int, out_shape):
+    R, C = padded_shape
+    mat = tiles.reshape(R // m, C // m, m, m).transpose(0, 2, 1, 3) \
+        .reshape(R, C)
+    return mat[:out_shape[0], :out_shape[1]]
+
+
+def get_mask_2d_best(mat, n: int = 2, m: int = 4) -> np.ndarray:
+    """Per m x m tile, the valid n-per-row-and-column pattern maximising
+    the retained |w| mass — chosen for ALL tiles with one matmul."""
+    a = np.asarray(mat, np.float32)
+    tiles, padded = _tile_view(np.abs(a), m)
+    pats = _valid_2d_patterns(n, m)           # [P, m*m]
+    scores = jnp.asarray(tiles.reshape(len(tiles), -1)) @ \
+        jnp.asarray(pats.T)                    # [T, P]
+    best = np.asarray(jnp.argmax(scores, axis=1))
+    mask_tiles = pats[best].reshape(-1, m, m)
+    return _tiles_to_mat(mask_tiles, padded, m, a.shape).astype(
+        np.asarray(mat).dtype)
+
+
+def get_mask_2d_greedy(mat, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy per-tile selection: walk the tile's entries in decreasing
+    |w| order, keep an entry while its row and column each still have
+    budget ``m - n`` (n pruned per row/column). Vectorised over tiles
+    (the walk is over m*m entries, not over tiles)."""
+    a = np.asarray(mat, np.float32)
+    keep = m - n
+    tiles, padded = _tile_view(np.abs(a), m)
+    t = tiles.reshape(len(tiles), -1)          # [T, m*m]
+    order = np.argsort(-t, axis=1)             # per-tile ranking
+    mask = np.zeros_like(t)
+    row_used = np.zeros((len(t), m), np.int32)
+    col_used = np.zeros((len(t), m), np.int32)
+    tix = np.arange(len(t))
+    for k in range(m * m):
+        pos = order[:, k]
+        r, c = pos // m, pos % m
+        ok = (row_used[tix, r] < keep) & (col_used[tix, c] < keep)
+        mask[tix, pos] = np.where(ok, 1.0, mask[tix, pos])
+        row_used[tix, r] += ok
+        col_used[tix, c] += ok
+    return _tiles_to_mat(mask.reshape(-1, m, m), padded, m,
+                         a.shape).astype(np.asarray(mat).dtype)
+
+
+def check_mask_2d(mat, n: int = 2, m: int = 4) -> bool:
+    """Every m x m tile has at most ``m - n`` nonzeros per row and per
+    column (n pruned per row/column)."""
+    a = np.asarray(mat)
+    tiles, _ = _tile_view((a != 0).astype(np.int32), m)
+    return bool((tiles.sum(axis=2) <= m - n).all()
+                and (tiles.sum(axis=1) <= m - n).all())
+
+
+def _to_2d(a: np.ndarray):
+    """Reference create_mask grouping (utils.py:498): 1D -> (1, -1);
+    2D as-is; 3D -> (s0*s1, s2); 4D -> transpose(0, 1, 3, 2) then
+    (s0*s1*s3, s2), so groups run along the SAME axis the reference
+    prunes (masks are checkpoint-compatible both ways). Returns the 2D
+    view plus an inverse fn mapping a 2D mask back to the input shape."""
+    shape = a.shape
+    if a.ndim == 1:
+        return a.reshape(1, -1), lambda mk: mk.reshape(shape)
+    if a.ndim == 2:
+        return a, lambda mk: mk
+    if a.ndim == 3:
+        return a.reshape(shape[0] * shape[1], shape[2]), \
+            lambda mk: mk.reshape(shape)
+    if a.ndim == 4:
+        t = a.transpose(0, 1, 3, 2)
+        return t.reshape(-1, shape[2]), \
+            lambda mk: mk.reshape(shape[0], shape[1], shape[3],
+                                  shape[2]).transpose(0, 1, 3, 2)
+    raise ValueError(
+        f"n:m sparsity masks support tensors of dim 1-4, got {a.ndim}D")
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n: int = 2,
+                m: int = 4) -> np.ndarray:
+    """Mask for a weight tensor with the reference's per-rank grouping
+    (see _to_2d)."""
+    if isinstance(func_name, str):
+        func_name = MaskAlgo[func_name.upper().replace("GET_MASK_", "")] \
+            if func_name.upper().startswith("GET_MASK_") \
+            else MaskAlgo(f"get_{func_name}" if not
+                          func_name.startswith("get_") else func_name)
+    a = np.asarray(getattr(tensor, "_data", tensor))
+    a2, back = _to_2d(a)
+    fn = globals()[func_name.value]
+    return back(fn(a2, n=n, m=m))
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n: int = 2,
+                   m: int = 4) -> bool:
+    if isinstance(func_name, str):
+        func_name = CheckMethod(func_name if func_name.startswith("check_")
+                                else f"check_{func_name}")
+    a = np.asarray(getattr(tensor, "_data", tensor))
+    a2, _ = _to_2d(a)
+    return globals()[func_name.value](a2, n=n, m=m)

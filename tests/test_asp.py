@@ -1,0 +1,178 @@
+"""incubate.asp (2:4 structured sparsity) — mask math, prune_model,
+decorate training guarantee. Reference: python/paddle/incubate/asp/."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.asp import utils as au
+
+
+class TestMaskMath:
+    def test_mask_1d_keeps_top_magnitudes(self):
+        mat = np.array([[1.0, -5.0, 2.0, 0.5, 9.0, 0.1, -0.2, 3.0]])
+        mask = au.get_mask_1d(mat, 2, 4)
+        np.testing.assert_array_equal(
+            mask, [[0, 1, 1, 0, 1, 0, 0, 1]])
+        assert au.check_mask_1d(mat * mask, 2, 4)
+        assert not au.check_mask_1d(mat, 2, 4)
+
+    def test_mask_1d_density_exact(self):
+        rng = np.random.default_rng(0)
+        mat = rng.normal(size=(32, 64)).astype(np.float32)
+        mask = au.get_mask_1d(mat, 2, 4)
+        assert au.calculate_density(mat * mask) == pytest.approx(0.5)
+
+    def test_valid_2d_pattern_count_2_4(self):
+        # combinatorics: 4x4 0/1 matrices with exactly two 1s per row and
+        # column = permanent of all-ones config = 90
+        assert len(au._valid_2d_patterns(2, 4)) == 90
+
+    def test_mask_2d_best_valid_and_better_than_greedy(self):
+        rng = np.random.default_rng(1)
+        mat = rng.normal(size=(16, 16)).astype(np.float32)
+        best = au.get_mask_2d_best(mat, 2, 4)
+        greedy = au.get_mask_2d_greedy(mat, 2, 4)
+        assert au.check_mask_2d(mat * best, 2, 4)
+        assert au.check_mask_2d(mat * greedy, 2, 4)
+        assert np.abs(mat * best).sum() >= np.abs(mat * greedy).sum() - 1e-6
+
+    def test_mask_2d_rejects_1d_violations_pattern(self):
+        # a matrix whose 4x4 tile has a column of 4 large values: 2D mask
+        # must keep only 2 of them
+        mat = np.zeros((4, 4), np.float32)
+        mat[:, 0] = [9, 8, 7, 6]
+        mask = au.get_mask_2d_best(mat, 2, 4)
+        assert mask[:, 0].sum() == 2
+
+    def test_non_divisible_shapes(self):
+        rng = np.random.default_rng(2)
+        mat = rng.normal(size=(5, 7)).astype(np.float32)
+        m1 = au.get_mask_1d(mat, 2, 4)
+        assert m1.shape == mat.shape
+        m2 = au.get_mask_2d_greedy(mat, 2, 4)
+        assert m2.shape == mat.shape
+
+    def test_create_mask_conv_kernel(self):
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=(8, 4, 3, 3)).astype(np.float32)
+        mask = au.create_mask(w, au.MaskAlgo.MASK_1D, 2, 4)
+        assert mask.shape == w.shape
+        assert au.check_sparsity(w * mask, au.CheckMethod.CHECK_1D, 2, 4)
+
+    def test_n_is_pruned_count(self):
+        # reference n:m semantics: n entries PRUNED per group of m, so
+        # 1:4 keeps 3 of 4 (density 0.75), not 1 of 4
+        rng = np.random.default_rng(4)
+        mat = rng.normal(size=(8, 16)).astype(np.float32)
+        mask = au.get_mask_1d(mat, 1, 4)
+        assert au.calculate_density(mask) == pytest.approx(0.75)
+        assert au.check_mask_1d(mat * mask, 1, 4)
+        # a reference-valid 1:4 group (3 nonzeros of 4) passes the check
+        assert au.check_mask_1d(np.array([[0.0, 1.0, 5.0, 4.0]]), 1, 4)
+
+    def test_conv_grouping_matches_reference_transpose(self):
+        # 4D masks group along axis 2 after transpose(0,1,3,2) —
+        # reference utils.py:498 create_mask semantics
+        w = np.arange(2 * 3 * 4 * 4, dtype=np.float32).reshape(2, 3, 4, 4)
+        mask = au.create_mask(w, au.MaskAlgo.MASK_1D, 2, 4)
+        ref = au.get_mask_1d(
+            w.transpose(0, 1, 3, 2).reshape(-1, 4), 2, 4) \
+            .reshape(2, 3, 4, 4).transpose(0, 1, 3, 2)
+        np.testing.assert_array_equal(mask, ref)
+        with pytest.raises(ValueError, match="dim 1-4"):
+            au.create_mask(np.zeros((2, 2, 2, 2, 2), np.float32))
+
+    def test_check_method_mapping(self):
+        assert au.CheckMethod.get_checking_method(
+            au.MaskAlgo.MASK_1D) == au.CheckMethod.CHECK_1D
+        assert au.CheckMethod.get_checking_method(
+            au.MaskAlgo.MASK_2D_BEST) == au.CheckMethod.CHECK_2D
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(16, 32)
+        self.linear2 = nn.Linear(32, 8)
+        self.norm = nn.LayerNorm(8)
+
+    def forward(self, x):
+        return self.norm(self.linear2(self.linear1(x)))
+
+
+class TestWorkflow:
+    def setup_method(self):
+        asp.reset_excluded_layers()
+        asp._MASK_REFS.clear()
+
+    def test_prune_model_sparsifies_linear_only(self):
+        net = _Net()
+        masks = asp.prune_model(net, mask_algo="mask_1d")
+        assert len(masks) == 2   # both Linears, never the LayerNorm
+        for _, p in [("w1", net.linear1.weight), ("w2", net.linear2.weight)]:
+            assert au.check_sparsity(p.numpy(), au.CheckMethod.CHECK_1D)
+            assert au.calculate_density(p.numpy()) == pytest.approx(0.5)
+
+    def test_excluded_layers_respected(self):
+        net = _Net()
+        names = [n for n, _ in asp._prunable_params(net)]
+        asp.set_excluded_layers([names[0]])
+        masks = asp.prune_model(net)
+        assert len(masks) == 1
+        asp.reset_excluded_layers()
+        assert len(asp.prune_model(_Net())) == 2
+
+    def test_decorated_training_keeps_sparsity_and_learns(self):
+        rng = np.random.default_rng(0)
+        net = _Net()
+        opt = asp.decorate(optimizer.AdamW(
+            learning_rate=1e-2, parameters=net.parameters()))
+        asp.prune_model(net)
+        X = paddle.to_tensor(rng.normal(size=(32, 16)).astype("float32"))
+        Y = paddle.to_tensor(rng.normal(size=(32, 8)).astype("float32"))
+        first = last = None
+        for _ in range(15):
+            loss = ((net(X) - Y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first
+        # the 2:4 pattern survived every update
+        for p in (net.linear1.weight, net.linear2.weight):
+            assert au.check_sparsity(p.numpy(), au.CheckMethod.CHECK_1D)
+            assert au.calculate_density(p.numpy()) == pytest.approx(0.5)
+        # and the UNPRUNED layer trained normally (no accidental masking)
+        assert au.calculate_density(net.norm.weight.numpy()) > 0.9
+
+    def test_add_supported_layer(self):
+        class Custom(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = paddle.core.Parameter(
+                    np.random.default_rng(0).normal(size=(8, 8))
+                    .astype("float32"))
+
+            def forward(self, x):
+                return x @ self.weight
+
+        class Holder(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c = Custom()
+
+            def forward(self, x):
+                return self.c(x)
+
+        net = Holder()
+        assert not asp.prune_model(net)      # unknown type: untouched
+        asp.add_supported_layer(Custom)
+        try:
+            masks = asp.prune_model(net)
+            assert len(masks) == 1
+            assert au.check_sparsity(net.c.weight.numpy())
+        finally:
+            asp._EXTRA_SUPPORTED.discard("Custom")
