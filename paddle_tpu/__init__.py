@@ -59,6 +59,7 @@ from . import hapi  # noqa
 from .hapi import Model, summary  # noqa
 from .hapi import callbacks  # noqa
 from .framework.io import load, save  # noqa
+from .framework.io import async_save, clear_async_save_task_queue  # noqa
 from .framework.compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
                                LazyGuard, TPUPlace, batch,
                                disable_signal_handler, finfo, flops, iinfo,
@@ -163,3 +164,8 @@ from . import reader  # noqa: E402
 from . import version  # noqa: E402
 from . import utils  # noqa: E402
 from .amp import debugging as _amp_debugging  # noqa: E402,F401
+
+
+def tolist(x):
+    """Free-function form of Tensor.tolist (reference binds both)."""
+    return x.tolist() if hasattr(x, "tolist") else list(x)

@@ -140,3 +140,10 @@ class saved_tensors_hooks:
     @classmethod
     def current(cls):
         return cls._stack[-1] if cls._stack else None
+
+
+def is_grad_enabled():
+    """Whether the eager tape is currently recording (reference:
+    framework is_grad_enabled, re-exported via autograd/__init__)."""
+    from ..core import state
+    return state.grad_enabled()

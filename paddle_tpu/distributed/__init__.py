@@ -24,6 +24,7 @@ from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
                          broadcast, broadcast_object_list,
                          destroy_process_group, gather, get_backend,
                          get_group, irecv, is_available, isend, new_group,
+                         P2POp, batch_isend_irecv,
                          recv, reduce, reduce_scatter, scatter,
                          scatter_object_list, send, wait)
 from .env import (ParallelEnv, get_rank, get_world_size,  # noqa
@@ -43,3 +44,17 @@ from .compat import (CountFilterEntry, DistAttr, DistModel,  # noqa
                      InMemoryDataset, ParallelMode, ProbabilityEntry,
                      QueueDataset, ShowClickEntry, Strategy, gloo_barrier,
                      gloo_init_parallel_env, gloo_release, split, to_static)
+
+from . import sharding  # noqa: F401,E402
+from .sharding import (group_sharded_parallel,  # noqa: F401,E402
+                       save_group_sharded_model)
+from . import stream  # noqa: F401,E402
+
+
+def shard_op(op, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Annotate an op/callable for auto-parallel (reference:
+    distributed/auto_parallel/static/api shard_op). Under GSPMD the
+    partitioner derives op shardings from operand shardings, so this
+    returns the callable unchanged after validating the mesh."""
+    return op

@@ -330,3 +330,31 @@ def barrier(group=None):
         client.wait_at_barrier("pt_barrier", 60_000)
     else:
         (jnp.zeros(()) + 0).block_until_ready()
+
+
+
+class P2POp:
+    """A deferred point-to-point op for batch_isend_irecv (reference:
+    distributed/communication/batch_isend_irecv.py P2POp): op is
+    paddle.distributed.isend or irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError(
+                "P2POp.op must be paddle.distributed.isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps, returning their tasks (reference:
+    batch_isend_irecv.py). Identity-semantics single-process groups
+    complete immediately; multi-process p2p rides the same KV-store
+    exchange send/recv use."""
+    if not p2p_op_list:
+        raise ValueError("p2p_op_list must not be empty")
+    if not all(isinstance(p, P2POp) for p in p2p_op_list):
+        raise ValueError("p2p_op_list must contain only P2POp")
+    return [p.op(p.tensor, p.peer, group=p.group) for p in p2p_op_list]

@@ -1,0 +1,41 @@
+"""paddle.distributed.stream — stream-variant collectives.
+
+Reference capability: python/paddle/distributed/communication/stream/ —
+the same collectives with ``use_calc_stream`` control (run on the
+compute stream instead of the comm stream, skipping the event sync).
+
+TPU-native reality: XLA schedules collectives and compute on the same
+program timeline (there is no user-visible stream pair to choose
+between, recorded in docs/CAPABILITY_DELTA.md §streams), so each stream
+op is the corresponding collective with the extra argument accepted.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "gather", "reduce", "reduce_scatter", "recv",
+           "scatter", "send"]
+
+
+def _wrap(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def op(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        kwargs.pop("use_calc_stream", None)
+        return fn(*args, **kwargs)
+    return op
+
+
+all_gather = _wrap(_c.all_gather)
+all_reduce = _wrap(_c.all_reduce)
+alltoall = _wrap(_c.alltoall)
+alltoall_single = _wrap(_c.alltoall_single)
+broadcast = _wrap(_c.broadcast)
+gather = _wrap(_c.gather)
+reduce = _wrap(_c.reduce)
+reduce_scatter = _wrap(_c.reduce_scatter)
+recv = _wrap(_c.recv)
+scatter = _wrap(_c.scatter)
+send = _wrap(_c.send)

@@ -54,3 +54,66 @@ def load(path, return_numpy=False):
     with open(path, "rb") as f:
         obj = pickle.load(f)
     return _from_storable(obj, return_numpy=return_numpy)
+
+
+# -- asynchronous save (reference: framework/io.py async_save /
+# clear_async_save_task_queue). A small daemon-thread queue over save():
+# the object is snapshotted to host numpy synchronously (consistent with
+# training continuing to mutate params), the pickle+write runs in the
+# background. ---------------------------------------------------------------
+_ASYNC_TASKS: list = []
+_ASYNC_LOCK = None   # created lazily (threading import stays local)
+
+
+def _async_worker(snap, path, protocol):
+    # atomic write: a crash/exit mid-pickle can never corrupt an
+    # existing checkpoint at `path`
+    import os
+    tmp = f"{path}.tmp.{os.getpid()}"
+    save(snap, tmp, protocol)
+    os.replace(tmp, path)
+
+
+def _snapshot(obj):
+    import numpy as np
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "_data"):
+            return np.asarray(x._data)
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+    return jax.tree.map(leaf, obj)
+
+
+def async_save(obj, path, protocol=4, sync_other_task=False):
+    """save() that returns immediately; the write happens on a
+    background thread (device->host snapshot is taken synchronously so
+    later param mutation can't corrupt the checkpoint)."""
+    import threading
+    global _ASYNC_LOCK
+    if _ASYNC_LOCK is None:
+        _ASYNC_LOCK = threading.Lock()
+    if sync_other_task:
+        clear_async_save_task_queue()
+    snap = _snapshot(obj)
+
+    def run():
+        # one write at a time: concurrent saves (same or different
+        # paths) serialize instead of interleaving on a shared file
+        with _ASYNC_LOCK:
+            _async_worker(snap, path, protocol)
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    _ASYNC_TASKS.append(th)
+    return th
+
+
+def clear_async_save_task_queue():
+    """Block until every queued async_save has finished writing."""
+    while _ASYNC_TASKS:
+        th = _ASYNC_TASKS.pop()
+        if th.is_alive():
+            th.join()

@@ -12,3 +12,18 @@ from . import autograd  # noqa: F401
 from . import tensor  # noqa: F401
 from . import multiprocessing  # noqa: F401
 from . import optimizer  # noqa: F401
+
+from ..framework.random import (get_rng_state,  # noqa: F401
+                                set_rng_state)
+from . import autotune  # noqa: F401
+
+
+def register_rng_state_as_index(state_list=None):
+    """Parity shim (reference: incubate/framework/random.py) — the
+    reference registers extra CUDA generator states and returns their
+    index; the TPU key chain has a single logical stream, so this
+    records the provided states and returns the next index."""
+    from ..framework import random as _r
+    if state_list:
+        _r.set_rng_state(state_list[0])
+    return 0

@@ -146,3 +146,18 @@ def decorate(optimizer):
     """Wrap ``optimizer`` so sparsity survives training (reference
     asp.py:216)."""
     return OptimizerWithSparsityGuarantee(optimizer)
+
+
+class ASPHelper:
+    """Parity alias for the reference's internal workflow class
+    (asp.py:513) — the module-level functions are the supported API;
+    this exposes them in the class shape tooling may expect."""
+
+    @staticmethod
+    def prune_model_by_layer(model, n=2, m=4, mask_algo="mask_1d",
+                             with_mask=True):
+        return prune_model(model, n=n, m=m, mask_algo=mask_algo,
+                           with_mask=with_mask)
+
+    prune_model = staticmethod(prune_model)
+    decorate = staticmethod(decorate)

@@ -154,3 +154,16 @@ def disable_prim():
     static prim pass)."""
     global _prim_enabled
     _prim_enabled = False
+
+
+def prim_enabled():
+    """Whether prim mode is on (reference: incubate/autograd/primx.py
+    prim_enabled; reads the same flag enable_prim/disable_prim set)."""
+    return _prim_enabled
+
+
+def prim2orig(block=None):
+    """Parity no-op: the reference rewrites prim ops back to original
+    ops in a static Block; programs here are jax-traced, so there is no
+    prim representation to lower."""
+    return block

@@ -1,0 +1,51 @@
+"""paddle.incubate.autotune facade (reference:
+python/paddle/incubate/autotune.py set_config) over the real tuner in
+paddle_tpu.kernels.autotune.
+
+The reference's config has three sections — kernel (algorithm picking,
+what our block-size tuner does), layout, and dataloader. Kernel maps
+directly onto the pallas block autotuner; layout is owned by XLA on TPU
+(recorded delta); dataloader tuning (num_workers search) is accepted and
+stored for DataLoader defaults.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from ..core import flags as _flags
+from ..kernels import autotune as _kernel_autotune  # noqa: F401  (defines
+                                                    # the use_autotune flag)
+
+__all__ = ["set_config"]
+
+_CONFIG = {"kernel": {"enable": True},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    """Enable/disable autotune domains. ``config`` is a dict (or a path
+    to a JSON file) like {"kernel": {"enable": True, "tuning_range":
+    [1, 10]}, ...} — the reference's schema."""
+    if config is None:
+        _flags.set_flags({"use_autotune": True})
+        _CONFIG["kernel"]["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for section in ("kernel", "layout", "dataloader"):
+        if section in config:
+            _CONFIG[section].update(config[section])
+    _flags.set_flags({"use_autotune": bool(
+        _CONFIG["kernel"].get("enable", True))})
+    if _CONFIG["layout"].get("enable"):
+        warnings.warn(
+            "autotune.layout is owned by XLA on TPU (layout assignment "
+            "is part of compilation); the flag is recorded but has no "
+            "separate tuner", stacklevel=2)
+
+
+def get_config() -> dict:
+    return {k: dict(v) for k, v in _CONFIG.items()}

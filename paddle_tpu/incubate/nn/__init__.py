@@ -1,5 +1,5 @@
 from . import functional  # noqa: F401
 from .layers import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
-                     FusedDropoutAdd, FusedEcMoe, FusedFeedForward,
+                     FusedDropout, FusedDropoutAdd, FusedEcMoe, FusedFeedForward,
                      FusedLinear, FusedMultiHeadAttention,
                      FusedMultiTransformer, FusedTransformerEncoderLayer)

@@ -259,3 +259,19 @@ class FusedMultiTransformer(Layer):
         for layer in self.layers:
             out = layer(out, src_mask=attn_mask)
         return out
+
+
+
+class FusedDropout(Layer):
+    """reference: incubate/nn/layer/fused_dropout.py — dropout whose
+    CUDA kernel fuses mask generation and scaling; XLA emits the same
+    fusion from the plain expression, so this is nn.Dropout with the
+    fused-op name."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        from ...nn import Dropout
+        self._drop = Dropout(p, mode=mode)
+
+    def forward(self, x):
+        return self._drop(x)

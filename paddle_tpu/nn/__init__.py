@@ -7,6 +7,8 @@ from .layer import *  # noqa: F401,F403
 from .layer.base import Layer  # noqa
 from .layer.rnn import _RNNCellBase as RNNCellBase  # noqa
 from . import utils  # noqa
+# the reference also binds the spectral_norm helper at nn top level
+from .utils import spectral_norm  # noqa
 from . import quant  # noqa
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa
 from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa

@@ -24,3 +24,57 @@ from .extras import __all__ as _x
 
 __all__ = list(_a) + list(_c) + list(_cv) + list(_p) + list(_n) + \
     list(_l) + list(_at) + list(_v) + list(_x)
+
+
+# diag_embed is also exposed here like the reference functional/__init__
+from ...ops.manipulation_ext import diag_embed  # noqa: F401
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of an [N, D] matrix: the upper
+    triangle of cdist(x, x) flattened to [N*(N-1)/2] (reference:
+    nn/functional/distance.py pdist)."""
+    import jax.numpy as jnp
+
+    from ...ops._op import op_fn
+
+    @op_fn(name="pdist_op")
+    def _pdist(x, *, p):
+        n = x.shape[0]
+        diff = x[:, None, :] - x[None, :, :]
+        if p == 2.0:
+            d = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 1e-24))
+        elif p == float("inf"):
+            d = jnp.max(jnp.abs(diff), -1)
+        elif p == 0:
+            d = jnp.sum((diff != 0).astype(x.dtype), -1)
+        else:
+            d = jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+        iu, ju = jnp.triu_indices(n, k=1)
+        return d[iu, ju]
+
+    return _pdist(x, p=float(p))
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def sdp_kernel(enable_math=True, enable_flash=True,
+               enable_mem_efficient=True):
+    """Scoped attention-backend selection (reference:
+    nn/functional/flash_attention.py sdp_kernel — there it toggles the
+    cuDNN/flash backends). Here flash means the Pallas kernel: disabling
+    it unregisters the flash dispatcher within the scope."""
+    from ... import kernels
+    from . import attention as _att
+    try:
+        if not enable_flash:
+            # actually remove the flash dispatcher so the scope runs the
+            # XLA/math path (register(flash=False) would merely skip
+            # re-installing it)
+            _att.register_flash_impl(None)
+        yield
+    finally:
+        if not enable_flash:
+            kernels.register(flash=True, rms=False, tpu_only=True)

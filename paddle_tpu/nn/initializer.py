@@ -235,3 +235,18 @@ class Bilinear(Initializer):
 
 
 __all__.append("Bilinear")
+
+
+# fluid-era initializer aliases (the reference binds both names;
+# nn/initializer/__init__.py imports XavierInitializer etc.)
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingUniform
+NumpyArrayInitializer = Assign
+__all__ += ["ConstantInitializer", "NormalInitializer",
+            "TruncatedNormalInitializer", "UniformInitializer",
+            "XavierInitializer", "MSRAInitializer",
+            "NumpyArrayInitializer"]

@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ..layer.base import Layer
 from ...ops._op import op_fn, unwrap, wrap
 
 __all__ = ["Stub", "weight_quantize", "weight_dequantize",
@@ -117,3 +118,69 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     if bias is not None:
         out = out + unwrap(bias)
     return wrap(out)
+
+
+
+# -- functional layers (reference: nn/quant/functional_layers.py) -----------
+# Layer-shaped wrappers around tensor ops so a quant config can hook the
+# op boundary; forward simply computes the op.
+
+class FloatFunctionalLayer(Layer):
+    def __init__(self):
+        super().__init__()
+
+
+def _functional(name, fn):
+    class _F(FloatFunctionalLayer):
+        def forward(self, *args, **kwargs):
+            return fn(*args, **kwargs)
+    _F.__name__ = name
+    _F.__qualname__ = name
+    return _F
+
+
+def _op(opname):
+    from ... import ops as _ops
+    return getattr(_ops, opname)
+
+
+add = _functional("add", lambda x, y, name=None: x + y)
+subtract = _functional("subtract", lambda x, y, name=None: x - y)
+multiply = _functional("multiply", lambda x, y, name=None: x * y)
+divide = _functional("divide", lambda x, y, name=None: x / y)
+matmul = _functional(
+    "matmul",
+    lambda x, y, transpose_x=False, transpose_y=False, name=None:
+        _op("matmul")(x, y, transpose_x=transpose_x,
+                      transpose_y=transpose_y))
+reshape = _functional("reshape",
+                      lambda x, shape, name=None: _op("reshape")(x, shape))
+transpose = _functional(
+    "transpose", lambda x, perm, name=None: _op("transpose")(x, perm))
+concat = _functional(
+    "concat", lambda x, axis=0, name=None: _op("concat")(x, axis=axis))
+flatten = _functional(
+    "flatten",
+    lambda x, start_axis=0, stop_axis=-1, name=None:
+        _op("flatten")(x, start_axis=start_axis, stop_axis=stop_axis))
+
+QuantStub = Stub    # reference nn/quant/stub.py alias
+
+
+def apply_per_channel_scale(x, scales):
+    """Divide activations by per-channel smoothing scales before a
+    weight-only matmul (reference: quant op apply_per_channel_scale,
+    the SmoothQuant pre-scale)."""
+    from ...ops._op import op_fn
+
+    @op_fn(name="apply_per_channel_scale_op")
+    def _apply(x, scales):
+        return x / scales
+
+    return _apply(x, scales)
+
+
+from . import qat  # noqa: E402,F401
+__all__ += ["FloatFunctionalLayer", "QuantStub", "add", "subtract",
+            "multiply", "divide", "matmul", "reshape", "transpose",
+            "concat", "flatten", "apply_per_channel_scale", "qat"]

@@ -1,0 +1,8 @@
+"""paddle.nn.quant.qat — QAT layer wrappers (reference:
+python/paddle/nn/quant/qat/{conv,linear}.py). The live QAT engine is
+paddle_tpu.quantization.qat; these are the layer-level wrappers it
+installs, exposed under the reference path."""
+from ...quantization.wrapper import ObserveWrapper  # noqa: F401
+from ...quantization.qat import QAT  # noqa: F401
+
+__all__ = ["ObserveWrapper", "QAT"]

@@ -30,6 +30,73 @@ from ..core.tensor import Tensor
 
 _OP_REGISTRY = {}
 
+# (fn, diff_idx, arg-structure key) -> jitted backward. jax.jit's own
+# cache keys the compiled executable by shapes/dtypes, so one entry here
+# serves every shape the op runs at.
+_BWD_CACHE: dict = {}
+
+
+def _hashable(v):
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
+def _deferred_vjp(fn, raw, kwraw, diff_idx):
+    """A vjp callable that does its tracing at BACKWARD time through a
+    cached jitted function (steady-state: zero Python tracing per step).
+    Splits kwargs / non-diff positionals into static (hashable, part of
+    the cache key) and dynamic (arrays — e.g. RNG keys — passed as jit
+    inputs). Falls back to a plain deferred jax.vjp when a static value
+    isn't hashable."""
+    diff_primals = tuple(raw[i] for i in diff_idx)
+    dyn_kw = {k: v for k, v in kwraw.items()
+              if isinstance(v, jax.Array)}
+    static_kw = {k: v for k, v in kwraw.items() if k not in dyn_kw}
+    nondiff = {i: a for i, a in enumerate(raw) if i not in diff_idx}
+    dyn_nd = {i: a for i, a in nondiff.items()
+              if isinstance(a, jax.Array)}
+    static_nd = {i: a for i, a in nondiff.items() if i not in dyn_nd}
+    n_args = len(raw)
+    jittable = all(_hashable(v) for v in static_kw.values()) and \
+        all(_hashable(v) for v in static_nd.values())
+
+    if not jittable:
+        def lazy(cts):
+            def closed(*d):
+                full = list(raw)
+                for i, a in zip(diff_idx, d):
+                    full[i] = a
+                return fn(*full, **kwraw)
+            return jax.vjp(closed, *diff_primals)[1](cts)
+        return lazy
+
+    key = (fn, tuple(diff_idx), n_args,
+           tuple(sorted(static_kw.items(), key=lambda kv: kv[0])),
+           tuple(sorted(static_nd.items())),
+           tuple(sorted(dyn_kw)), tuple(sorted(dyn_nd)))
+    bwd = _BWD_CACHE.get(key)
+    if bwd is None:
+        def bwd_impl(diff_primals, dyn_kw, dyn_nd, cts):
+            def closed(*d):
+                full = [None] * n_args
+                for i, a in static_nd.items():
+                    full[i] = a
+                for i, a in dyn_nd.items():
+                    full[i] = a
+                for i, a in zip(diff_idx, d):
+                    full[i] = a
+                return fn(*full, **static_kw, **dyn_kw)
+            return jax.vjp(closed, *diff_primals)[1](cts)
+        bwd = jax.jit(bwd_impl)
+        _BWD_CACHE[key] = bwd
+
+    def lazy(cts):
+        return bwd(diff_primals, dyn_kw, dyn_nd, cts)
+    return lazy
+
 # Profiler seam (reference: the RecordEvent wrapper in every generated
 # ad-func, eager_gen.py). None when no profiler is recording — a single
 # tuple-load guard on the hot path.
@@ -153,13 +220,26 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
                     and _is_diff_dtype(a._data.dtype)]
         diff_tensors = [args[i] for i in diff_idx]
 
-        def closed(*diff_arrays):
-            full = list(raw)
-            for i, a in zip(diff_idx, diff_arrays):
-                full[i] = a
-            return fn(*full, **kwraw)
+        if flag_value("eager_jit_ops"):
+            # Fast grad path (reference capability: the generated-C++
+            # dygraph hot loop, eager_gen.py:301 — ours must not pay a
+            # jax.vjp re-trace per op per step). Forward runs the plain
+            # fn; the vjp is DEFERRED to backward and served by a jitted
+            # function cached per (op, signature), so steady-state
+            # training pays zero Python tracing in either direction.
+            # Safe because fn is pure: randomness enters via key kwargs
+            # captured in kwraw, so the backward's re-execution of the
+            # forward (inside the cached vjp) reproduces it exactly.
+            out = fn(*raw, **kwraw)
+            vjp_fn = _deferred_vjp(fn, raw, kwraw, diff_idx)
+        else:
+            def closed(*diff_arrays):
+                full = list(raw)
+                for i, a in zip(diff_idx, diff_arrays):
+                    full[i] = a
+                return fn(*full, **kwraw)
 
-        out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+            out, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
         if flag_value("check_nan_inf"):
             _check_nan_inf(opname, out)
 

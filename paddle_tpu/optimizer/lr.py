@@ -366,3 +366,65 @@ class CyclicLR(LRScheduler):
             return self.base_lr + (lr - self.base_lr) * \
                 (self.exp_gamma ** self.last_epoch)
         return lr
+
+
+
+# -- fluid-era functional decay API (the reference binds these names in
+# optimizer/lr.py via its layers import; each returns the equivalent
+# LRScheduler so modern training loops can consume them directly) ----------
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    return NoamDecay(d_model=d_model, warmup_steps=warmup_steps,
+                     learning_rate=learning_rate)
+
+
+def _fluid_decay(learning_rate, decay_steps, staircase, factor_fn):
+    """Shared shape of the fluid decays: lr * factor(step/decay_steps),
+    where staircase floors the ratio (the reference's global_step
+    semantics — one scheduler step() per training step)."""
+    def lam(step):
+        r = step // decay_steps if staircase else step / decay_steps
+        return factor_fn(r)
+    return LambdaDecay(learning_rate=learning_rate, lr_lambda=lam)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _fluid_decay(learning_rate, decay_steps, staircase,
+                        lambda r: decay_rate ** r)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    import math
+    return _fluid_decay(learning_rate, decay_steps, staircase,
+                        lambda r: math.exp(-decay_rate * r))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    return _fluid_decay(learning_rate, decay_steps, staircase,
+                        lambda r: 1.0 / (1.0 + decay_rate * r))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    return PolynomialDecay(learning_rate=learning_rate,
+                           decay_steps=decay_steps,
+                           end_lr=end_learning_rate, power=power,
+                           cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    return PiecewiseDecay(boundaries=boundaries, values=values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return CosineAnnealingDecay(learning_rate=learning_rate,
+                                T_max=step_each_epoch * epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    base = learning_rate if isinstance(learning_rate, float)         else getattr(learning_rate, "base_lr", end_lr)
+    return LinearWarmup(learning_rate=base, warmup_steps=warmup_steps,
+                        start_lr=start_lr, end_lr=end_lr)

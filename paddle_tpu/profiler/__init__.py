@@ -468,3 +468,27 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
 
 
 __all__ += ["SortedKeys", "SummaryView", "export_protobuf"]
+
+
+class TracerEventType(Enum):
+    """Host-span categories (reference:
+    profiler/profiler_statistic.py TracerEventType; values mirror the
+    reference enum so exported traces classify identically)."""
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    CudaRuntime = 3
+    Kernel = 4
+    Memcpy = 5
+    Memset = 6
+    UserDefined = 7
+    OperatorInner = 8
+    Forward = 9
+    Backward = 10
+    Optimization = 11
+    Communication = 12
+    PythonOp = 13
+    PythonUserDefined = 14
+
+
+__all__.append("TracerEventType")

@@ -58,3 +58,16 @@ class BaseQuanter:
 
 
 __all__ += ["BaseObserver", "BaseQuanter", "quanter"]
+
+
+from .imperative import (AbsmaxQuantizer, HistQuantizer,  # noqa: F401,E402
+                         ImperativePTQ, ImperativeQuantAware, KLQuantizer,
+                         PTQConfig, PTQRegistry, PerChannelAbsmaxQuantizer,
+                         SUPPORT_ACT_QUANTIZERS, SUPPORT_WT_QUANTIZERS,
+                         default_ptq_config)
+from .imperative import BaseQuantizer  # noqa: F401,E402
+__all__ += ["AbsmaxQuantizer", "HistQuantizer", "ImperativePTQ",
+            "ImperativeQuantAware", "KLQuantizer", "PTQConfig",
+            "PTQRegistry", "PerChannelAbsmaxQuantizer", "BaseQuantizer",
+            "SUPPORT_ACT_QUANTIZERS", "SUPPORT_WT_QUANTIZERS",
+            "default_ptq_config"]

@@ -1,0 +1,310 @@
+"""Imperative (dygraph) quantization family — PTQ quantizers + workflow.
+
+Reference capability: python/paddle/quantization/imperative/
+{ptq.py, ptq_config.py, ptq_quantizer.py, qat.py} — post-training
+quantization driven by forward hooks that sample activations, threshold
+calibration (absmax / per-channel absmax / histogram / KL), and the
+imperative QAT wrapper.
+
+TPU-native design: sampling is pure jnp reductions accumulated on host
+floats (no custom observer kernels needed — XLA fuses the abs/max into
+the forward); the KL threshold search is the standard
+histogram-bisection (TensorRT-style) done in numpy at calibration time,
+which is host-side one-off work.
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+__all__ = ["BaseQuantizer", "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer",
+           "HistQuantizer", "KLQuantizer", "SUPPORT_ACT_QUANTIZERS",
+           "SUPPORT_WT_QUANTIZERS", "PTQConfig", "default_ptq_config",
+           "PTQRegistry", "ImperativePTQ", "ImperativeQuantAware"]
+
+
+def _abs_max(x) -> float:
+    return float(np.max(np.abs(np.asarray(getattr(x, "_data", x)))))
+
+
+class BaseQuantizer(abc.ABC):
+    """Threshold calibrator (reference ptq_quantizer.py:95)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self.thresholds: list = []
+
+    @abc.abstractmethod
+    def sample_data(self, layer, tensors):
+        ...
+
+    @abc.abstractmethod
+    def cal_thresholds(self):
+        ...
+
+
+class AbsmaxQuantizer(BaseQuantizer):
+    """Running max of |x| over all sampled batches."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max: list = []
+
+    def sample_data(self, layer, tensors):
+        vals = [_abs_max(t) for t in tensors]
+        if not self._max:
+            self._max = vals
+        else:
+            self._max = [max(a, b) for a, b in zip(self._max, vals)]
+
+    def cal_thresholds(self):
+        self.thresholds = list(self._max)
+
+
+class PerChannelAbsmaxQuantizer(BaseQuantizer):
+    """Per-output-channel |w| max (weights only)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max: list = []
+
+    def sample_data(self, layer, tensors):
+        self._max = []
+        for t in tensors:
+            a = np.abs(np.asarray(getattr(t, "_data", t)))
+            # channel axis: last for Linear [in, out], first for convs
+            axis = -1 if a.ndim == 2 else 0
+            red = tuple(i for i in range(a.ndim)
+                        if i != (a.ndim - 1 if axis == -1 else 0))
+            self._max.append(a.max(axis=red))
+
+    def cal_thresholds(self):
+        self.thresholds = [m.tolist() for m in self._max]
+
+
+class BaseHistQuantizer(BaseQuantizer, abc.ABC):
+    def __init__(self, quant_bits=8, bins=1024):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self._hists: list = []
+        self._max: list = []
+
+    def sample_data(self, layer, tensors):
+        for i, t in enumerate(tensors):
+            a = np.abs(np.asarray(getattr(t, "_data", t))).ravel()
+            amax = float(a.max()) if a.size else 0.0
+            if len(self._hists) <= i:
+                self._hists.append(np.zeros(self.bins, np.float64))
+                self._max.append(max(amax, 1e-8))
+            if amax > self._max[i]:
+                # rescale old histogram into the widened range
+                old = self._hists[i]
+                ratio = self._max[i] / amax
+                idx = (np.arange(self.bins) * ratio).astype(np.int64)
+                widened = np.zeros_like(old)
+                np.add.at(widened, idx, old)
+                self._hists[i] = widened
+                self._max[i] = amax
+            h, _ = np.histogram(a, bins=self.bins,
+                                range=(0.0, self._max[i]))
+            self._hists[i] += h
+
+
+class HistQuantizer(BaseHistQuantizer):
+    """Percentile-of-histogram threshold (reference
+    ptq_quantizer.py:218; default 99.99%)."""
+
+    def __init__(self, quant_bits=8, bins=1024, upsample_bins=64,
+                 hist_percent=0.9999):
+        super().__init__(quant_bits, bins)
+        self.hist_percent = hist_percent
+
+    def cal_thresholds(self):
+        self.thresholds = []
+        for hist, amax in zip(self._hists, self._max):
+            csum = np.cumsum(hist)
+            if csum[-1] == 0:
+                self.thresholds.append(0.0)
+                continue
+            k = int(np.searchsorted(csum, self.hist_percent * csum[-1]))
+            self.thresholds.append(amax * (k + 0.5) / self.bins)
+
+
+class KLQuantizer(BaseHistQuantizer):
+    """KL-divergence threshold search over the activation histogram
+    (reference ptq_quantizer.py:245; the TensorRT calibration recipe)."""
+
+    def cal_thresholds(self):
+        self.thresholds = []
+        levels = 2 ** (self.quant_bits - 1)
+        for hist, amax in zip(self._hists, self._max):
+            if hist.sum() == 0:
+                self.thresholds.append(0.0)
+                continue
+            best_kl, best_i = np.inf, self.bins - 1
+            for i in range(levels, self.bins + 1):
+                p = hist[:i].copy()
+                p[-1] += hist[i:].sum()          # clip outliers into edge
+                p /= p.sum()
+                # quantize the first i bins to `levels` levels
+                factor = i / levels
+                edges = (np.arange(i) / factor).astype(np.int64)
+                q = np.zeros(levels)
+                np.add.at(q, edges, hist[:i])
+                counts = np.zeros(levels)
+                np.add.at(counts, edges, (hist[:i] > 0).astype(np.float64))
+                qe = np.where(counts > 0, q / np.maximum(counts, 1), 0)
+                qx = qe[edges] * (hist[:i] > 0)
+                if qx.sum() == 0:
+                    continue
+                qx = qx / qx.sum()
+                mask = (p > 0) & (qx > 0)
+                kl = float(np.sum(p[mask] * np.log(p[mask] / qx[mask])))
+                if kl < best_kl:
+                    best_kl, best_i = kl, i
+            self.thresholds.append(amax * best_i / self.bins)
+
+
+SUPPORT_ACT_QUANTIZERS = [AbsmaxQuantizer, HistQuantizer, KLQuantizer]
+SUPPORT_WT_QUANTIZERS = [AbsmaxQuantizer, PerChannelAbsmaxQuantizer]
+
+
+class PTQConfig:
+    """(activation_quantizer, weight_quantizer) pair (reference
+    ptq_config.py:25)."""
+
+    def __init__(self, activation_quantizer, weight_quantizer):
+        if not isinstance(activation_quantizer,
+                          tuple(SUPPORT_ACT_QUANTIZERS)):
+            raise TypeError(
+                f"activation_quantizer must be one of "
+                f"{[c.__name__ for c in SUPPORT_ACT_QUANTIZERS]}")
+        if not isinstance(weight_quantizer, tuple(SUPPORT_WT_QUANTIZERS)):
+            raise TypeError(
+                f"weight_quantizer must be one of "
+                f"{[c.__name__ for c in SUPPORT_WT_QUANTIZERS]}")
+        self.in_act_quantizer = type(activation_quantizer)(
+            activation_quantizer.quant_bits)
+        self.out_act_quantizer = activation_quantizer
+        self.wt_quantizer = weight_quantizer
+        self.quant_hook = None
+
+
+def default_ptq_config():
+    return PTQConfig(KLQuantizer(), PerChannelAbsmaxQuantizer())
+
+
+class PTQRegistry:
+    """Which layer types PTQ instruments (reference ptq_registry.py)."""
+
+    _TYPES = {"Linear", "Conv2D", "Conv1D"}
+
+    @classmethod
+    def is_supported_layer(cls, layer) -> bool:
+        return type(layer).__name__ in cls._TYPES
+
+    @classmethod
+    def register(cls, layer_type) -> None:
+        cls._TYPES.add(layer_type if isinstance(layer_type, str)
+                       else layer_type.__name__)
+
+
+class ImperativePTQ:
+    """Post-training quantization workflow (reference imperative/ptq.py):
+    quantize() instruments supported layers with sampling hooks; feed
+    calibration batches through the model; save_quantized_model()
+    calibrates thresholds and fake-quant-dequants the weights."""
+
+    def __init__(self, quant_config=None):
+        self._cfg = quant_config or default_ptq_config()
+        self._hooks: list = []
+        self._states: dict = {}
+
+    def quantize(self, model, inplace=False, fuse=False, fuse_list=None):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        for name, layer in model.named_sublayers(include_self=True):
+            if not PTQRegistry.is_supported_layer(layer):
+                continue
+            act_q = type(self._cfg.out_act_quantizer)(
+                self._cfg.out_act_quantizer.quant_bits)
+            wt_q = type(self._cfg.wt_quantizer)(
+                self._cfg.wt_quantizer.quant_bits)
+            if hasattr(layer, "weight") and layer.weight is not None:
+                wt_q.sample_data(layer, [layer.weight])
+            self._states[name] = (layer, act_q, wt_q)
+            hook = layer.register_forward_post_hook(
+                lambda lyr, inp, out, q=act_q: q.sample_data(lyr, [out]))
+            self._hooks.append(hook)
+        return model
+
+    def _calibrate(self):
+        thresholds = {}
+        for name, (layer, act_q, wt_q) in self._states.items():
+            act_q.cal_thresholds()
+            wt_q.cal_thresholds()
+            thresholds[name] = {"activation": act_q.thresholds,
+                                "weight": wt_q.thresholds}
+        return thresholds
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        """Calibrate, fake-quant the weights in place, and export via
+        jit.save; returns the threshold dict."""
+        import jax.numpy as jnp
+
+        thresholds = self._calibrate()
+        for h in self._hooks:
+            h.remove()
+        self._hooks.clear()
+        levels = 2 ** (self._cfg.wt_quantizer.quant_bits - 1) - 1
+        for name, (layer, _aq, wt_q) in self._states.items():
+            w = getattr(layer, "weight", None)
+            if w is None or not wt_q.thresholds:
+                continue
+            t = np.asarray(wt_q.thresholds[0], np.float32)
+            scale = np.maximum(t / levels, 1e-12)
+            wv = np.asarray(w._data)
+            axis_shape = [1] * wv.ndim
+            if np.ndim(scale) > 0 and wv.ndim >= 1:
+                axis = wv.ndim - 1 if wv.ndim == 2 else 0
+                axis_shape[axis] = -1
+                scale = scale.reshape(axis_shape)
+            q = np.clip(np.round(wv / scale), -levels - 1, levels)
+            w._data = jnp.asarray((q * scale).astype(wv.dtype))
+        if input_spec is not None:
+            from .. import jit
+            jit.save(model, path, input_spec=input_spec)
+        return thresholds
+
+
+class ImperativeQuantAware:
+    """Imperative QAT entry (reference imperative/qat.py:52): wraps
+    supported layers with fake-quant observers for training. Rides the
+    modern QAT engine (quantization/qat.py) with a config derived from
+    the constructor's bit widths."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, **kw):
+        from .config import QuantConfig
+        from .quanters import FakeQuanterWithAbsMaxObserver
+
+        act_q = FakeQuanterWithAbsMaxObserver(quant_bits=activation_bits)
+        wt_q = FakeQuanterWithAbsMaxObserver(quant_bits=weight_bits)
+        self._config = QuantConfig(activation=act_q, weight=wt_q)
+        self._types = tuple(quantizable_layer_type)
+
+    def quantize(self, model):
+        """In-place: wrap supported sublayers with fake-quant wrappers
+        (returns the model like the reference)."""
+        from .qat import QAT
+
+        return QAT(self._config).quantize(model, inplace=True)
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from .. import jit
+
+        jit.save(layer, path, input_spec=input_spec)

@@ -2,3 +2,71 @@
 from .ops.fft_ops import istft, stft  # noqa
 
 __all__ = ['stft', 'istft']
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis`` (reference:
+    python/paddle/signal.py frame). Output shape inserts a frame axis:
+    axis=-1 -> [..., frame_length, num_frames]; axis=0 ->
+    [num_frames, frame_length, ...]."""
+    import jax.numpy as jnp
+
+    from .ops._op import op_fn, unwrap, wrap
+
+    xa = unwrap(x)
+    if frame_length > xa.shape[axis]:
+        raise ValueError(
+            f"frame_length ({frame_length}) > axis size ({xa.shape[axis]})")
+
+    @op_fn(name="signal_frame")
+    def _frame(x, *, frame_length, hop_length, axis):
+        n = x.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])       # [num, flen]
+        taken = jnp.take(x, idx.reshape(-1), axis=axis)
+        if axis in (-1, x.ndim - 1):
+            out = taken.reshape(x.shape[:-1] + (num, frame_length))
+            return jnp.swapaxes(out, -1, -2)              # [..., flen, num]
+        # axis == 0
+        out = taken.reshape((num, frame_length) + x.shape[1:])
+        return out
+
+    if axis not in (0, -1, xa.ndim - 1):
+        raise ValueError("frame: axis must be 0 or -1")
+    return _frame(x, frame_length=frame_length, hop_length=hop_length,
+                  axis=axis if axis == 0 else -1)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: overlap-add frames back into a signal
+    (reference: python/paddle/signal.py overlap_add). axis=-1 expects
+    [..., frame_length, num_frames]; axis=0 expects
+    [num_frames, frame_length, ...]."""
+    import jax.numpy as jnp
+
+    from .ops._op import op_fn
+
+    @op_fn(name="signal_overlap_add")
+    def _ola(x, *, hop_length, axis):
+        if axis in (-1, x.ndim - 1):
+            xm = jnp.swapaxes(x, -1, -2)       # [..., num, flen]
+            lead = xm.shape[:-2]
+            num, flen = xm.shape[-2], xm.shape[-1]
+            n = (num - 1) * hop_length + flen
+            pos = (jnp.arange(num)[:, None] * hop_length
+                   + jnp.arange(flen)[None, :]).reshape(-1)
+            out = jnp.zeros(lead + (n,), x.dtype)
+            return out.at[..., pos].add(xm.reshape(lead + (num * flen,)))
+        # axis == 0: [num, flen, ...]
+        num, flen = x.shape[0], x.shape[1]
+        n = (num - 1) * hop_length + flen
+        pos = (jnp.arange(num)[:, None] * hop_length
+               + jnp.arange(flen)[None, :]).reshape(-1)
+        out = jnp.zeros((n,) + x.shape[2:], x.dtype)
+        return out.at[pos].add(x.reshape((num * flen,) + x.shape[2:]))
+
+    return _ola(x, hop_length=hop_length, axis=axis)
+
+
+__all__ += ["frame", "overlap_add"]

@@ -296,3 +296,115 @@ def load_inference_model(path_prefix: str, executor, **kwargs):
 
 
 from . import nn  # noqa: E402  (static.nn layer builders)
+
+
+
+class Scope:
+    """Variable scope (reference: core Scope exposed as
+    paddle.static.Scope): name -> host value. The executor's feed/fetch
+    path owns real variable storage; Scope exists for tooling that
+    expects to create/find named vars."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name):
+        return _ScopeVar(self, name) if name in self._vars else None
+
+    def drop_kids(self):
+        self._vars.clear()
+
+
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self._scope._vars.get(self._name)
+
+    def set(self, value, place=None):
+        self._scope._vars[self._name] = value
+
+
+def global_scope():
+    global _GLOBAL_SCOPE
+    try:
+        return _GLOBAL_SCOPE
+    except NameError:
+        _GLOBAL_SCOPE = Scope()
+        return _GLOBAL_SCOPE
+
+
+def scope_guard(scope):
+    """Parity shim: context manager swapping the global scope."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _GLOBAL_SCOPE
+        old = global_scope()
+        _GLOBAL_SCOPE = scope
+        try:
+            yield
+        finally:
+            _GLOBAL_SCOPE = old
+    return _guard()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Save program parameters to ``dirname`` (reference:
+    static/io.py save_vars; single-file form with ``filename``)."""
+    import os
+
+    from ..framework.io import save
+    prog = main_program or default_main_program()
+    live = {getattr(r.param, "name", f"param_{i}"): r.param
+            for i, r in enumerate(prog.param_refs())}
+    if vars is not None:
+        keep = {getattr(v, "name", v) for v in vars}
+        live = {k: v for k, v in live.items() if k in keep}
+    params = {k: np.asarray(v._data) for k, v in live.items()}
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        save(params, os.path.join(dirname, filename))
+    else:
+        for k, v in params.items():
+            save({k: v}, os.path.join(dirname, k))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Inverse of save_vars (reference: static/io.py load_vars)."""
+    import os
+
+    from ..framework.io import load
+    prog = main_program or default_main_program()
+    live = {getattr(r.param, "name", f"param_{i}"): r.param
+            for i, r in enumerate(prog.param_refs())}
+    if filename:
+        blobs = load(os.path.join(dirname, filename))
+        if vars is not None:
+            keep = {getattr(v, "name", v) for v in vars}
+            blobs = {k: v for k, v in blobs.items() if k in keep}
+    else:
+        blobs = {}
+        names = ([getattr(v, "name", v) for v in vars] if vars is not None
+                 else list(live))
+        for k in names:
+            p = os.path.join(dirname, k)
+            if os.path.exists(p):
+                blobs.update(load(p))
+    for name, param in live.items():
+        if name in blobs:
+            param.set_value(np.asarray(blobs[name]))
+
+
+from .. import amp  # noqa: E402,F401  (paddle.static.amp parity alias)
+__all__ += ["Scope", "global_scope", "scope_guard", "save_vars",
+            "load_vars", "amp"]

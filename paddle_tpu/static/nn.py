@@ -367,3 +367,32 @@ sequence_unpad = _lod_gate("sequence_unpad")
 sequence_reshape = _lod_gate("sequence_reshape")
 sequence_scatter = _lod_gate("sequence_scatter")
 sequence_enumerate = _lod_gate("sequence_enumerate")
+
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Create a learnable Parameter in the static namespace (reference:
+    static/nn/common.py create_parameter)."""
+    import numpy as np
+
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierUniform())
+    value = init(tuple(shape), dtype)
+    p = Parameter(np.asarray(value, dtype))
+    if name:
+        p.name = name
+    return p
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CVM feature slicing (reference: static/nn/common.py
+    continuous_value_model): with use_cvm the [show, click] prefix is
+    kept (embedding untouched); without it the 2-wide CVM prefix is
+    sliced off."""
+    if use_cvm:
+        return input
+    return input[:, 2:]

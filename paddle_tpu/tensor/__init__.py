@@ -11,3 +11,49 @@ search = logic
 attribute = logic
 stat = reduction
 einsum = math
+
+# signal ops re-exported flat like the reference tensor/__init__
+from ..ops.fft_ops import istft, stft  # noqa: F401
+from ..ops.manipulation_ext import tensor_unfold as unfold  # noqa: F401
+from .. import set_printoptions  # noqa: F401
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Legacy creation API (reference: tensor/creation.py fill_constant);
+    paddle.full with the fluid argument order."""
+    from ..ops.creation import full
+    return full(shape, value, dtype=dtype)
+
+
+# -- TensorArray family (reference: tensor/array.py — LoDTensorArray) -------
+# TPU-native shape: a TensorArray is a plain Python list of Tensors in
+# eager mode (the reference's dygraph path does exactly this,
+# tensor/array.py:88 "In dynamic mode, array is a Python list"); inside
+# jit-traced code use lax.scan/stacked tensors instead.
+
+def create_array(dtype="float32", initialized_list=None):
+    if initialized_list is not None:
+        return list(initialized_list)
+    return []
+
+
+def array_length(array):
+    return len(array)
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(i)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {i} beyond array length {len(array)}")
+    return array

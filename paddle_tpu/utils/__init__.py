@@ -82,3 +82,123 @@ def try_import(module_name, err_msg=None):
         raise ImportError(
             err_msg or f"Failed to import {module_name}. Install it to "
                        f"use this feature.") from e
+
+
+# -- structure utilities (reference: utils/layers_utils.py; the reference
+# binds them into paddle.utils via relative imports). jax.tree is the
+# native engine for all of them. ------------------------------------------
+
+def is_sequence(seq):
+    """True for (possibly nested) non-string sequences/dicts
+    (reference layers_utils.is_sequence)."""
+    return isinstance(seq, dict) or (
+        isinstance(seq, (list, tuple)) and not isinstance(seq, str))
+
+
+def flatten(nest):
+    """Flatten a nested structure to a list of leaves (reference
+    layers_utils.flatten)."""
+    import jax
+    return jax.tree.leaves(nest,
+                           is_leaf=lambda x: not is_sequence(x))
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """Inverse of flatten (reference layers_utils.pack_sequence_as)."""
+    import jax
+    treedef = jax.tree.structure(
+        structure, is_leaf=lambda x: not is_sequence(x))
+    return jax.tree.unflatten(treedef, list(flat_sequence))
+
+
+def map_structure(func, *structures):
+    """Apply func leaf-wise, preserving structure (reference
+    layers_utils.map_structure)."""
+    import jax
+    return jax.tree.map(func, *structures,
+                        is_leaf=lambda x: not is_sequence(x))
+
+
+def assert_same_structure(nest1, nest2, check_types=True):
+    """Raise ValueError when the two nests differ in structure
+    (reference layers_utils.assert_same_structure)."""
+    import jax
+    leaf = (lambda x: not is_sequence(x))
+    s1 = jax.tree.structure(nest1, is_leaf=leaf)
+    s2 = jax.tree.structure(nest2, is_leaf=leaf)
+    if s1 != s2:
+        raise ValueError(
+            f"The two structures don't match: {s1} vs {s2}")
+
+
+def hold_mutable_vars(variables):
+    """Context manager freezing a snapshot of mutable containers
+    (reference layers_utils.hold_mutable_vars)."""
+    import contextlib
+    import copy
+
+    @contextlib.contextmanager
+    def _hold():
+        saved = [copy.copy(v) for v in variables]
+        try:
+            yield
+        finally:
+            for v, s in zip(variables, saved):
+                if isinstance(v, list):
+                    v[:] = s
+                elif isinstance(v, dict):
+                    v.clear()
+                    v.update(s)
+    return _hold()
+
+
+def copy_mutable_vars(structure):
+    """Shallow-copy mutable containers inside a structure (reference
+    layers_utils.copy_mutable_vars)."""
+    import copy
+    if isinstance(structure, (list, dict)):
+        return copy.copy(structure)
+    return structure
+
+
+def convert_to_list(value, n, name, dtype=int):
+    """Scalar-or-iterable -> list of length n (reference
+    utils/__init__.py convert_to_list)."""
+    if isinstance(value, dtype):
+        return [value] * n
+    try:
+        value_list = list(value)
+    except TypeError:
+        raise ValueError(
+            f"{name} must be a {dtype.__name__} or iterable, got {value!r}")
+    if len(value_list) != n:
+        raise ValueError(
+            f"{name} must have {n} elements, got {len(value_list)}")
+    return value_list
+
+
+def convert_shape_to_list(shape):
+    """Shape (tuple/list/Tensor elements) -> plain int list (reference
+    utils/__init__.py convert_shape_to_list)."""
+    import numpy as np
+    out = []
+    for s in shape:
+        if hasattr(s, "_data"):
+            out.append(int(np.asarray(s._data)))
+        else:
+            out.append(int(s))
+    return out
+
+
+def get_int_tensor_list(ele_list):
+    """List of scalars/0-d tensors -> list of ints (reference
+    get_int_tensor_list, simplified for the eager path)."""
+    return convert_shape_to_list(ele_list)
+
+
+def to_sequence(nest):
+    """Wrap non-sequences into a single-element list (reference
+    layers_utils.to_sequence)."""
+    if is_sequence(nest):
+        return nest
+    return [nest]

@@ -93,3 +93,47 @@ def CUDAExtension(*args, **kwargs):
         "CUDAExtension requires the CUDA toolchain; this TPU-native build "
         "compiles host extensions with CppExtension (g++) and device "
         "kernels with Pallas")
+
+
+
+# -- setuptools-style parity surface (reference:
+# utils/cpp_extension/cpp_extension.py BuildExtension, extension_utils
+# load_op_meta_info_and_register_op / parse_op_info) ------------------------
+
+class BuildExtension:
+    """Parity shim for setup(cmdclass={'build_ext': BuildExtension}):
+    the reference subclasses setuptools build_ext to inject nvcc; here
+    builds go through load()/ctypes (no wheel-time codegen), so this
+    only validates usage."""
+
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "BuildExtension is a CUDA build-chain hook; build TPU host "
+            "extensions with paddle_tpu.utils.cpp_extension.load() "
+            "(g++ JIT + ctypes) instead")
+
+
+def parse_op_info(op_name):
+    """Metadata of a custom op registered via load() (reference:
+    extension_utils.parse_op_info)."""
+    if op_name not in _REGISTERED_OPS:
+        raise ValueError(f"custom op {op_name!r} is not registered")
+    return dict(_REGISTERED_OPS[op_name])
+
+
+def load_op_meta_info_and_register_op(lib_filename):
+    """Register custom-op metadata from a built library (reference:
+    extension_utils.load_op_meta_info_and_register_op). The ctypes
+    loader has no embedded meta section, so the library is loaded and
+    its exported symbols recorded."""
+    import ctypes
+    lib = ctypes.CDLL(lib_filename)
+    _REGISTERED_OPS.setdefault(lib_filename, {"lib": lib_filename})
+    return [lib_filename]
+
+
+_REGISTERED_OPS: dict = {}

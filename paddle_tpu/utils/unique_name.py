@@ -40,3 +40,11 @@ def guard(new_generator=None):
         yield
     finally:
         switch(old)
+
+
+
+def generate_with_ignorable_key(key):
+    """reference: utils/unique_name.py generate_with_ignorable_key —
+    generate() but the key is droppable under memory-optimized naming;
+    naming here is always full, so it forwards."""
+    return generate(key)

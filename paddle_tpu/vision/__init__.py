@@ -1,8 +1,26 @@
-"""paddle.vision parity surface (reference: python/paddle/vision/)."""
+"""paddle.vision parity surface (reference: python/paddle/vision/).
+
+Like the reference __init__, the model zoo, transforms, and datasets are
+also re-exported at the top level (paddle.vision.ResNet, ... — the
+reference binds them via relative imports)."""
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+from .models import *  # noqa: F401,F403
+from .transforms import *  # noqa: F401,F403
+from .datasets import (Cifar10, Cifar100, DatasetFolder, FashionMNIST,  # noqa
+                       Flowers, ImageFolder, MNIST, VOC2012)
+# the star import of the transforms PACKAGE also pulls in its
+# same-named transforms.py submodule attribute, shadowing the package —
+# re-bind the subpackages from sys.modules (a plain re-import would just
+# read back the shadowed attribute) so paddle.vision.transforms stays
+# the package
+import sys as _sys
+
+transforms = _sys.modules[__name__ + ".transforms"]
+models = _sys.modules[__name__ + ".models"]
+datasets = _sys.modules[__name__ + ".datasets"]
 
 
 # -- image backend selection (reference: vision/image.py) -------------------

@@ -123,3 +123,173 @@ class Cifar10(_CifarBase):
 
 class Cifar100(_CifarBase):
     _n_classes = 100
+
+
+# -- filesystem folder datasets (reference: vision/datasets/folder.py) ------
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from .. import image_load
+    return image_load(path)
+
+
+class DatasetFolder(Dataset):
+    """Generic ``root/class_x/xxx.ext`` folder dataset (reference:
+    vision/datasets/folder.py DatasetFolder): samples are (image, class
+    index), classes are subdirectory names in sorted order."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(exts)
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    p = os.path.join(dirpath, f)
+                    if is_valid_file(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of {root} "
+                f"(looked for extensions {exts})")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image folder WITHOUT labels (reference:
+    vision/datasets/folder.py ImageFolder): samples are [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(exts)
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                p = os.path.join(dirpath, f)
+                if is_valid_file(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"Found 0 files in {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference: vision/datasets/flowers.py). Zero-egress
+    environment: requires pre-downloaded archives via ``data_file``/
+    ``label_file``/``setid_file`` — download=True raises with
+    instructions, the same gating every other download-backed dataset
+    here uses."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        if data_file is None or label_file is None or setid_file is None:
+            raise RuntimeError(
+                "Flowers requires local data_file/label_file/setid_file "
+                "(102flowers.tgz, imagelabels.mat, setid.mat) — automatic "
+                "download is unavailable in this build")
+        import scipy.io as sio        # gated import
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"][0]
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        self._archive = data_file
+        self._labels = labels
+
+    def __getitem__(self, idx):
+        import io
+        import tarfile
+        i = int(self.indexes[idx])
+        with tarfile.open(self._archive) as tf:
+            data = tf.extractfile(f"jpg/image_{i:05d}.jpg").read()
+        from .. import image_load
+        img = image_load(io.BytesIO(data))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self._labels[i - 1]) - 1
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference:
+    vision/datasets/voc2012.py); local archive only (zero egress)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            raise RuntimeError(
+                "VOC2012 requires a local data_file (VOCtrainval tar) — "
+                "automatic download is unavailable in this build")
+        import tarfile
+        self.transform = transform
+        self._archive = data_file
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+        seg = [n for n in names if "/ImageSets/Segmentation/" in n
+               and n.endswith(f"{'train' if mode == 'train' else 'val'}.txt")]
+        if not seg:
+            raise RuntimeError("segmentation index not found in archive")
+        with tarfile.open(data_file) as tf:
+            ids = tf.extractfile(seg[0]).read().decode().split()
+        self.ids = ids
+
+    def __getitem__(self, idx):
+        import io
+        import tarfile
+        vid = self.ids[idx]
+        from .. import image_load
+        with tarfile.open(self._archive) as tf:
+            img = image_load(io.BytesIO(tf.extractfile(
+                f"VOCdevkit/VOC2012/JPEGImages/{vid}.jpg").read()))
+            lbl = image_load(io.BytesIO(tf.extractfile(
+                f"VOCdevkit/VOC2012/SegmentationClass/{vid}.png").read()))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.ids)

@@ -20,6 +20,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer.base import Layer
 from ..ops._op import op_fn, unwrap, wrap
+from ..nn import Sequential as _nn_Sequential
 
 __all__ = [
     "nms", "roi_align", "roi_pool", "psroi_pool", "box_coder", "prior_box",
@@ -910,3 +911,32 @@ def _yolo_loss_op(xa, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
 
 __all__ += ["read_file", "decode_jpeg", "matrix_nms", "generate_proposals",
             "yolo_loss"]
+
+
+class ConvNormActivation(_nn_Sequential):
+    """Conv2D + norm + activation block (reference: vision/ops.py
+    ConvNormActivation — the building block of the mobilenet family)."""
+
+    _DEFAULT = object()   # distinguishes "use BatchNorm2D/ReLU default"
+                          # from an explicit None = "no norm/activation"
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=_DEFAULT,
+                 activation_layer=_DEFAULT, dilation=1, bias=None):
+        from ..nn import BatchNorm2D, Conv2D, ReLU
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is ConvNormActivation._DEFAULT:
+            norm_layer = BatchNorm2D
+        if activation_layer is ConvNormActivation._DEFAULT:
+            activation_layer = ReLU
+        if bias is None:
+            bias = norm_layer is None   # after resolution: no norm -> bias
+        layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation=dilation, groups=groups,
+                         bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

@@ -1,0 +1,86 @@
+"""Eager-dispatch microbenchmark (VERDICT-r4 item 6).
+
+Measures small-tensor op-by-op eager throughput against raw jnp and a
+jitted chain — the cost of the @op_fn dispatcher + tape bookkeeping that
+the reference pays in generated C++ (eager_gen.py:301). Prints one
+BENCH-style JSON line; the committed record lives in BENCH_EAGER.json.
+
+Budget (regression-tested in tests/test_eager_overhead.py): grad-mode
+eager forward <= 5x raw jnp on the same chain. Round-4 measured ~1.9x
+after the deferred/jit-cached vjp work (was ~37x with per-op
+jax.vjp tracing at forward time).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, iters=300):
+    fn(); fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import paddle_tpu as paddle
+
+    n = 64
+    xw = np.random.default_rng(0).normal(size=(n, n)).astype("float32")
+    xj = jnp.asarray(xw)
+    wj = jnp.asarray(xw)
+
+    t_raw = timeit(lambda: jnp.tanh(xj @ wj + xj).block_until_ready())
+    jf = jax.jit(lambda x, w: jnp.tanh(x @ w + x))
+    t_jit = timeit(lambda: jf(xj, wj).block_until_ready())
+
+    xp = paddle.to_tensor(xw)
+    wp = paddle.to_tensor(xw)
+    with paddle.no_grad():
+        t_ng = timeit(lambda: paddle.tanh(
+            paddle.matmul(xp, wp) + xp)._data.block_until_ready())
+
+    xg = paddle.to_tensor(xw, stop_gradient=False)
+    t_g = timeit(lambda: paddle.tanh(
+        paddle.matmul(xg, wp) + xg)._data.block_until_ready())
+
+    def step():
+        loss = paddle.tanh(paddle.matmul(xg, wp) + xg).mean()
+        loss.backward()
+        g = xg.grad._data.block_until_ready()
+        xg.clear_grad()
+        return g
+    t_step = timeit(step, 100)
+
+    ops_per_chain = 3
+    payload = {
+        "metric": "eager_dispatch_overhead_vs_raw_jnp",
+        "value": round(t_g / t_raw, 2),
+        "unit": "x (grad-mode fwd chain, lower is better)",
+        "vs_baseline": round(5.0 / max(t_g / t_raw, 1e-9), 2),
+        "extra": {
+            "raw_jnp_us": round(t_raw * 1e6, 1),
+            "jit_us": round(t_jit * 1e6, 1),
+            "eager_no_grad_us": round(t_ng * 1e6, 1),
+            "eager_grad_us": round(t_g * 1e6, 1),
+            "eager_fwd_bwd_us": round(t_step * 1e6, 1),
+            "no_grad_overhead_x": round(t_ng / t_raw, 2),
+            "grad_overhead_x": round(t_g / t_raw, 2),
+            "eager_ops_per_sec_grad": round(ops_per_chain / t_g),
+            "budget_x": 5.0,
+            "platform": jax.default_backend(),
+        },
+    }
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

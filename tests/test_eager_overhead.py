@@ -1,0 +1,79 @@
+"""Eager-dispatch overhead budget (VERDICT-r4 item 6).
+
+The reference's dygraph hot loop is generated C++ (eager_gen.py:301);
+ours is Python @op_fn dispatch + tape bookkeeping with a deferred,
+jit-cached vjp. Budget: grad-mode eager forward must stay within 8x raw
+jnp on a small op chain (measured ~1.9-2.7x on this box; the budget
+leaves headroom for CI noise while still catching a return of the
+per-op-retrace regime, which measured ~37x)."""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+BUDGET_X = 8.0
+
+
+def _best_of(fn, rounds=3, iters=60):
+    fn(); fn()
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+class TestEagerOverheadBudget:
+    def test_grad_mode_forward_within_budget(self):
+        n = 64
+        xw = np.random.default_rng(0).normal(size=(n, n)).astype("float32")
+        xj = jnp.asarray(xw)
+        t_raw = _best_of(
+            lambda: jnp.tanh(xj @ xj + xj).block_until_ready())
+
+        xg = paddle.to_tensor(xw, stop_gradient=False)
+        wp = paddle.to_tensor(xw)
+        t_g = _best_of(lambda: paddle.tanh(
+            paddle.matmul(xg, wp) + xg)._data.block_until_ready())
+        assert t_g / t_raw < BUDGET_X, \
+            f"eager grad-mode overhead {t_g / t_raw:.1f}x > {BUDGET_X}x"
+
+    def test_deferred_vjp_backward_correct(self):
+        # the overhead fix defers vjp to backward through a jit cache —
+        # make sure a mixed chain (matmul + add + tanh + mean) still
+        # produces the exact jax.grad result, twice (cache-hit path)
+        import jax
+
+        xw = np.random.default_rng(1).normal(size=(8, 8)).astype("float32")
+
+        c = jnp.asarray(xw).T   # constant operand (stop_gradient below)
+
+        def jax_ref(x):
+            return jnp.mean(jnp.tanh(x @ c + x))
+
+        want = jax.grad(jax_ref)(jnp.asarray(xw))
+        for _ in range(2):
+            xt = paddle.to_tensor(xw, stop_gradient=False)
+            y = paddle.tanh(
+                paddle.matmul(xt, paddle.to_tensor(xw).t()) + xt).mean()
+            y.backward()
+            np.testing.assert_allclose(np.asarray(xt.grad.numpy()), want,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_dropout_deferred_vjp_mask_consistent(self):
+        # randomness enters ops via key kwargs; the deferred backward
+        # re-executes the forward with the SAME key, so grad must be
+        # exactly mask/keep_prob (0/scale pattern matching the output)
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.ones((64, 64), "float32"),
+                             stop_gradient=False)
+        y = F.dropout(x, p=0.5, training=True)
+        y.sum().backward()
+        out = np.asarray(y.numpy())
+        g = np.asarray(x.grad.numpy())
+        np.testing.assert_allclose(g, np.where(out != 0, 2.0, 0.0))
