@@ -32,8 +32,13 @@ _OP_REGISTRY = {}
 
 # (fn, diff_idx, arg-structure key) -> jitted backward. jax.jit's own
 # cache keys the compiled executable by shapes/dtypes, so one entry here
-# serves every shape the op runs at.
+# serves every shape the op runs at. Bounded: an op fed a NEW hashable
+# scalar kwarg every step (annealed dropout p, per-step clip bound, ...)
+# would otherwise leak one jitted backward per distinct value — at the
+# cap the oldest entries (insertion order) are evicted, dropping their
+# jit caches with them.
 _BWD_CACHE: dict = {}
+_BWD_CACHE_MAX = 2048
 
 
 def _hashable(v):
@@ -91,6 +96,8 @@ def _deferred_vjp(fn, raw, kwraw, diff_idx):
                 return fn(*full, **static_kw, **dyn_kw)
             return jax.vjp(closed, *diff_primals)[1](cts)
         bwd = jax.jit(bwd_impl)
+        while len(_BWD_CACHE) >= _BWD_CACHE_MAX:
+            _BWD_CACHE.pop(next(iter(_BWD_CACHE)))
         _BWD_CACHE[key] = bwd
 
     def lazy(cts):
