@@ -199,3 +199,51 @@ class AutoTuner:
             cfg[metric] = val
             self.add_cfg(cfg)
         return self.get_best(metric, mode)
+
+
+def run_trial_subprocess(cfg: Dict, tuner_cfg: Dict,
+                         timeout: float = 300.0) -> Dict:
+    """Measure one config in a FRESH process (reference tuner launches a
+    real distributed trial per config, tuner.py:21 / utils.py
+    gen_new_args): the child builds a dp x sharding x mp virtual mesh
+    and times a jitted sharded train step. Returns the child's JSON
+    record ({"ok", "time", "tokens_per_sec", "error"})."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    payload = json.dumps({"cfg": cfg,
+                          "model_cfg": tuner_cfg.get("model_cfg", {})})
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.auto_tuner.trial", payload],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        return json.loads(line)
+    except Exception as e:
+        err = f"trial runner: {type(e).__name__}: {e}"
+        if r is not None:   # keep the child's actual failure visible
+            err += (f" [rc={r.returncode}] "
+                    f"stderr: ...{(r.stderr or '')[-400:]}")
+        return {"ok": False, "time": None, "error": err[:800]}
+
+
+def write_history_csv(history: List[Dict], path: str) -> None:
+    """Trial history as CSV (reference: recorder.py RecordTable
+    store_history)."""
+    import csv
+
+    keys: List[str] = []
+    for h in history:
+        for k in h:
+            if k not in keys:
+                keys.append(k)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=keys)
+        w.writeheader()
+        for h in history:
+            w.writerow(h)

@@ -1,0 +1,71 @@
+"""End-to-end auto-tune run: generate -> prune -> launch real trials ->
+CSV history + best-config report.
+
+    python -m paddle_tpu.distributed.auto_tuner [--max-trials N]
+        [--out-dir DIR] [--devices N]
+
+(reference: `python -m paddle.distributed.launch --auto_tuner_json ...`
+driving auto_tuner/tuner.py; here the trials are sharded virtual-mesh
+train steps so the search runs anywhere, chip or not.)
+"""
+import argparse
+import json
+import os
+import sys
+
+from . import AutoTuner, run_trial_subprocess, write_history_csv
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.auto_tuner")
+    p.add_argument("--max-trials", type=int, default=6)
+    p.add_argument("--out-dir", default="auto_tuner_out")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual device budget per trial")
+    p.add_argument("--trial-timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    tuner_cfg = {
+        "search_space": {
+            "dp_degree": "auto", "sharding_degree": "auto",
+            "mp_degree": "auto", "pp_degree": [1],
+            "micro_batch_size": [1, 2, 4],
+            "use_recompute": [False, True],
+        },
+        "num_gpus": args.devices,
+        "global_batch_size": 8,   # top-level: generate_candidates reads
+                                  # it here for acc_steps/mbs pruning
+        "model_cfg": {"num_layers": 2, "hidden_size": 64,
+                      "intermediate_size": 128, "vocab_size": 256,
+                      "seq_len": 32},
+    }
+    tuner = AutoTuner(tuner_cfg)
+    print(f"{len(tuner.candidates)} candidates after pruning",
+          file=sys.stderr)
+
+    def run_fn(cfg):
+        rec = run_trial_subprocess(cfg, tuner_cfg,
+                                   timeout=args.trial_timeout)
+        cfg["tokens_per_sec"] = rec.get("tokens_per_sec")
+        cfg["error"] = rec.get("error")
+        print(f"trial dp={cfg['dp_degree']} sh={cfg['sharding_degree']} "
+              f"mp={cfg['mp_degree']} mbs={cfg['micro_batch_size']} "
+              f"rc={cfg.get('use_recompute')} -> {rec}", file=sys.stderr)
+        if not rec.get("ok"):
+            raise RuntimeError(rec.get("error") or "trial failed")
+        return rec["time"]
+
+    best = tuner.tune(run_fn, max_trials=args.max_trials)
+    os.makedirs(args.out_dir, exist_ok=True)
+    csv_path = os.path.join(args.out_dir, "history.csv")
+    write_history_csv(tuner.history, csv_path)
+    report = {"best": best, "trials": len(tuner.history),
+              "history_csv": csv_path}
+    with open(os.path.join(args.out_dir, "best_cfg.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0 if best else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""One auto-tuner trial: a REAL training-step measurement in a fresh
+process (reference: auto_tuner/tuner.py:21 + utils.py gen_new_args —
+there each trial launches a distributed training script; here the trial
+jits a sharded Llama train step over a virtual device mesh sized
+dp*sharding*mp and times steady-state steps).
+
+Run as:  python -m paddle_tpu.distributed.auto_tuner.trial '<cfg json>'
+Prints ONE json line: {"ok": bool, "time": sec_per_step|null,
+"tokens_per_sec": ..., "error": ...}.
+"""
+import json
+import os
+import sys
+
+
+def _configure_env(cfg):
+    if cfg.get("pp_degree", 1) != 1:
+        raise ValueError(
+            "trial runner measures dp x sharding x mp meshes only; "
+            "prune pp_degree>1 from the search space (pipeline trials "
+            "need the pipeline runtime, not a flat mesh)")
+    n = cfg["dp_degree"] * cfg["sharding_degree"] * cfg["mp_degree"]
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return n
+
+
+def run(cfg, model_cfg):
+    n = _configure_env(cfg)
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.models import llama as L
+
+    devs = jax.devices()
+    if len(devs) < n:
+        return {"ok": False, "time": None,
+                "error": f"need {n} devices, have {len(devs)}"}
+    dp = cfg["dp_degree"] * cfg["sharding_degree"]
+    mesh = Mesh(np.array(devs[:n]).reshape(
+        cfg["dp_degree"], cfg["sharding_degree"], cfg["mp_degree"]),
+        ("dp", "fsdp", "tp"))
+
+    mcfg = L.llama_tiny(
+        num_hidden_layers=int(model_cfg.get("num_layers", 2)),
+        hidden_size=int(model_cfg.get("hidden_size", 64)),
+        intermediate_size=int(model_cfg.get("intermediate_size", 128)),
+        vocab_size=int(model_cfg.get("vocab_size", 256)),
+        remat=bool(cfg.get("use_recompute", False)))
+    seq = int(model_cfg.get("seq_len", 32))
+    batch = cfg["micro_batch_size"] * dp
+
+    params = L.shard_params(
+        L.init_params(mcfg, jax.random.PRNGKey(0)), mcfg, mesh)
+    step = L.make_train_step(mcfg, mesh, lr=1e-3, donate=False)
+    ids = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, mcfg.vocab_size, (batch, seq + 1)), jnp.int32),
+        NamedSharding(mesh, P(("dp", "fsdp"), None)))
+
+    ost = L.adamw_init(params)
+    params, ost, loss = step(params, ost, ids)   # compile + warmup
+    float(loss)
+    iters = int(os.environ.get("TUNER_TRIAL_ITERS", "3"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, ost, loss = step(params, ost, ids)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    # normalize to time per GLOBAL batch: a small micro-batch needs
+    # acc_steps x more steps for the same work, so raw per-step dt would
+    # systematically favor it
+    acc = int(cfg.get("acc_steps", 1))
+    return {"ok": True, "time": round(dt * acc, 5),
+            "tokens_per_sec": round(batch * seq / dt / max(acc, 1), 1),
+            "error": None}
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    payload = json.loads(argv[0])
+    try:
+        out = run(payload["cfg"], payload.get("model_cfg", {}))
+    except Exception as e:   # the parent needs a parseable line, always
+        out = {"ok": False, "time": None,
+               "error": f"{type(e).__name__}: {e}"[:500]}
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

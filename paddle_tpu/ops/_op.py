@@ -83,6 +83,12 @@ def _deferred_vjp(fn, raw, kwraw, diff_idx):
            tuple(sorted(static_nd.items())),
            tuple(sorted(dyn_kw)), tuple(sorted(dyn_nd)))
     bwd = _BWD_CACHE.get(key)
+    if bwd is not None:
+        # LRU refresh: a hit moves to the end so one op churning fresh
+        # scalar kwargs evicts only its own stale keys, never the other
+        # ops' stable hot backwards
+        _BWD_CACHE.pop(key)
+        _BWD_CACHE[key] = bwd
     if bwd is None:
         def bwd_impl(diff_primals, dyn_kw, dyn_nd, cts):
             def closed(*d):
