@@ -28,6 +28,17 @@ import numpy as np
 from . import dtype as dtypes
 from . import state
 
+# Set by jit/segment.py while a segmented capture is recording: called
+# with a symbolic Tensor whose concrete value Python needs (bool/float/
+# item/numpy on a traced value) — the manager runs the recorded slice
+# and returns the concrete array. None outside segmented capture.
+_SYMBOLIC_CONCRETIZE = None
+
+
+def set_symbolic_concretize_hook(hook):
+    global _SYMBOLIC_CONCRETIZE
+    _SYMBOLIC_CONCRETIZE = hook
+
 
 class Tensor:
     """paddle.Tensor parity surface, backed by jax.Array.
@@ -115,13 +126,13 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        return np.asarray(self._concrete())
 
     def item(self):
-        return self._data.item()
+        return self._concrete().item()
 
     def tolist(self):
-        return np.asarray(self._data).tolist()
+        return np.asarray(self._concrete()).tolist()
 
     def astype(self, dtype):
         from .. import ops
@@ -309,17 +320,34 @@ class Tensor:
         for i in range(len(self)):
             yield self[i]
 
+    def _concrete(self):
+        """The concrete array behind this tensor. For a symbolic tensor
+        (static/segmented capture) this asks the active capture manager
+        to materialize — the graph-break seam of segmented to_static
+        (jit/segment.py); without a manager it raises the static-mode
+        error instead of an opaque ShapeDtypeStruct failure."""
+        if self._symbolic is not None:
+            hook = _SYMBOLIC_CONCRETIZE
+            if hook is not None:
+                return hook(self)
+            raise RuntimeError(
+                "cannot read the concrete value of a symbolic tensor "
+                "while building a static Program; feed it through "
+                "static.Executor.run, or use jit.to_static("
+                "full_graph=False) for data-dependent Python branches")
+        return self._data
+
     def __float__(self):
-        return float(self._data)
+        return float(self._concrete())
 
     def __int__(self):
-        return int(self._data)
+        return int(self._concrete())
 
     def __bool__(self):
-        return bool(self._data)
+        return bool(self._concrete())
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._data)
+        a = np.asarray(self._concrete())
         return a.astype(dtype) if dtype is not None else a
 
     def __repr__(self):

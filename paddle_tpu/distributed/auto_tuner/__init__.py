@@ -226,10 +226,15 @@ def run_trial_subprocess(cfg: Dict, tuner_cfg: Dict,
         return json.loads(line)
     except Exception as e:
         err = f"trial runner: {type(e).__name__}: {e}"
-        if r is not None:   # keep the child's actual failure visible
-            err += (f" [rc={r.returncode}] "
-                    f"stderr: ...{(r.stderr or '')[-400:]}")
-        return {"ok": False, "time": None, "error": err[:800]}
+        stderr = getattr(e, "stderr", None)   # TimeoutExpired carries it
+        if r is not None:
+            err += f" [rc={r.returncode}]"
+            stderr = r.stderr
+        if stderr:   # keep the child's actual failure visible
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode(errors="replace")
+            err += f" stderr: ...{stderr[-400:]}"
+        return {"ok": False, "time": None, "error": err[:900]}
 
 
 def write_history_csv(history: List[Dict], path: str) -> None:

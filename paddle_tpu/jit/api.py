@@ -117,6 +117,8 @@ class StaticFunction:
         # with a one-time warning, like the reference's SOT fallback.
         self._full_graph = bool(full_graph)
         self._eager_keys: set = set()
+        self._segmented_keys: set = set()
+        self._segmented = None
         functools.update_wrapper(self, fn)
 
     def _bucket_of(self, n: int) -> int:
@@ -275,6 +277,8 @@ class StaticFunction:
         key = self._cache_key(args, kwargs)
         if key in self._eager_keys:
             return self._fn(*args, **kwargs)
+        if key in self._segmented_keys:
+            return self.__segmented_call(key, args, kwargs)
         try:
             return self.__compiled_call(key, args, kwargs)
         except _GraphBreak as e:
@@ -282,12 +286,53 @@ class StaticFunction:
                 raise RuntimeError(str(e)) from e
             import warnings
 
+            from ..core import state
+            # mixed capture (reference SOT, jit/sot/translate.py:30):
+            # this signature now runs as compiled segments around the
+            # eager island whenever grads are off; grad-enabled calls
+            # run whole-call eager per call (the recorder does not
+            # tape) — the key is NOT pinned eager, so a later eval call
+            # still gets segmentation.
+            self._segmented_keys.add(key)
+            self._programs.pop(key, None)
+            if not state.grad_enabled():
+                warnings.warn(
+                    "to_static: graph break in "
+                    f"{getattr(self._fn, '__name__', self._fn)} "
+                    "(data-dependent Python branch); this input "
+                    "signature runs as compiled segments around the "
+                    "branch (full_graph=False)", stacklevel=3)
+                return self.__segmented_call(key, args, kwargs)
             warnings.warn(
                 f"to_static: graph break in {getattr(self._fn, '__name__', self._fn)} "
-                "(data-dependent Python branch); this input signature "
-                "runs eagerly (full_graph=False)", stacklevel=3)
+                "(data-dependent Python branch); this call runs eagerly "
+                "(full_graph=False; grads are enabled, and segmented "
+                "capture does not tape — no-grad calls of this "
+                "signature will run as compiled segments)", stacklevel=3)
+            return self._fn(*args, **kwargs)
+
+    def __segmented_call(self, key, args, kwargs):
+        from ..core import state
+        if state.grad_enabled():   # training call on a segmented key
+            return self._fn(*args, **kwargs)
+        if self._segmented is None:
+            from .segment import SegmentedFunction
+            self._segmented = SegmentedFunction(self._fn, self._cache_key)
+        from .segment import SegmentCaptureError
+        try:
+            return self._segmented(args, kwargs)
+        except SegmentCaptureError:
+            # recorder/replay-internal failure degrades to eager; the
+            # user's own exceptions propagate (re-running fn here would
+            # double-execute its side effects)
+            import warnings
+
+            warnings.warn(
+                "to_static: segmented capture failed for "
+                f"{getattr(self._fn, '__name__', self._fn)}; this input "
+                "signature now runs eagerly", stacklevel=2)
+            self._segmented_keys.discard(key)
             self._eager_keys.add(key)
-            self._programs.pop(key, None)
             return self._fn(*args, **kwargs)
 
     def __compiled_call(self, key, args, kwargs):

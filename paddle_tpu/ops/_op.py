@@ -121,6 +121,23 @@ def set_profile_hook(begin, end):
     _PROFILE_HOOK = (begin, end) if begin is not None else None
 
 
+# Active segmented-capture Program (jit/segment.py): while set, EVERY
+# dispatched op records into it — including ops whose inputs are only
+# Parameters or concrete tensors. Parameters encode as live _ParamRefs,
+# so param-derived values stay fresh across weight updates in cached
+# replays (and concretizing one creates a guard on its current value).
+_SEGMENT_PROGRAM = None
+
+
+def set_segment_program(prog):
+    """Returns the previous value (caller restores it — recordings can
+    nest)."""
+    global _SEGMENT_PROGRAM
+    prev = _SEGMENT_PROGRAM
+    _SEGMENT_PROGRAM = prog
+    return prev
+
+
 # Flipped (permanently) by the first static.data() call — gates the
 # symbolic-input scan off the eager hot path.
 _HAS_SYMBOLIC = False
@@ -187,6 +204,9 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
         # OR keyword) means we are inside a static.Program build. The scan
         # is gated on a flag flipped by the first static.data() call, so
         # purely-eager programs pay one global load per dispatch.
+        if _SEGMENT_PROGRAM is not None:
+            return _record_static(_SEGMENT_PROGRAM, opname, fn,
+                                  args, kwargs)
         if _HAS_SYMBOLIC:
             for a in args:
                 if isinstance(a, Tensor) and a._symbolic is not None:

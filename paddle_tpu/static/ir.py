@@ -105,10 +105,16 @@ class Block:
 class Program:
     """Reference: base/framework.py Program / pir Program."""
 
-    def __init__(self):
+    def __init__(self, local_names: bool = False):
         self.blocks = [Block(self)]
         self.feed_vars: Dict[str, Var] = {}
         self._jit_cache: Dict[tuple, Any] = {}
+        # local_names: deterministic per-program var naming (segmented
+        # capture re-records a function per call/path and must produce
+        # identical names each time so compiled slices are reusable; the
+        # default global counter guarantees cross-program uniqueness for
+        # user-built static graphs instead)
+        self._local_counter = itertools.count() if local_names else None
 
     # -- build-side --------------------------------------------------------
     @property
@@ -116,7 +122,9 @@ class Program:
         return self.blocks[0]
 
     def new_var_name(self, hint="tmp"):
-        return f"{hint}_{next(_name_counter)}"
+        counter = self._local_counter if self._local_counter is not None \
+            else _name_counter
+        return f"{hint}_{next(counter)}"
 
     def add_feed(self, name, shape, dtype) -> Tensor:
         from ..ops._op import enable_symbolic_scan

@@ -1,0 +1,244 @@
+"""Adversarial per-op depth testing (VERDICT-r3 LoC diagnostic: "the
+residual gap is depth per op ... dtype sweeps, layout variants, and
+edge-case semantics our jnp one-liners haven't been pushed through. The
+fix is adversarial parity testing").
+
+Oracle: torch CPU (baked into the image), which matches the reference's
+kernel semantics for this op set. Sweeps: dtypes (f32/f16/bf16/i32/i64/
+bool), empty tensors, 0-d scalars, NaN/Inf propagation, negative
+operands of pow/sqrt/log, integer division/modulo sign conventions,
+keepdim reductions, broadcasting corner shapes, argmax ties, softmax
+with -inf rows, clip with crossed bounds."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+F32, F16, BF16 = "float32", "float16", "bfloat16"
+I32, I64 = "int32", "int64"
+
+
+def _t(a, dtype=None):
+    return paddle.to_tensor(np.asarray(a) if dtype is None
+                            else np.asarray(a).astype(dtype))
+
+
+def _torch(a, dtype=None):
+    t = torch.tensor(np.asarray(a))
+    if dtype == BF16:
+        t = t.to(torch.bfloat16)
+    elif dtype == F16:
+        t = t.to(torch.float16)
+    return t
+
+
+def _np(x):
+    if isinstance(x, torch.Tensor):
+        a = x.float().numpy() if x.dtype in (torch.bfloat16,
+                                             torch.float16) else x.numpy()
+    else:
+        a = x.numpy() if hasattr(x, "numpy") else x
+    a = np.asarray(a)
+    # ml_dtypes bfloat16 registers with numpy kind 'V'; name-sniff the
+    # half types and widen for comparison
+    if a.dtype.kind == "f" or a.dtype.name in ("bfloat16", "float16"):
+        return a.astype(np.float64)
+    return a
+
+
+def _close(got, want, dtype=F32):
+    rtol = {F32: 1e-5, F16: 1e-2, BF16: 3e-2}.get(dtype, 0)
+    np.testing.assert_allclose(_np(got), _np(want), rtol=rtol,
+                               atol=rtol, equal_nan=True)
+
+
+BINARY = [("add", torch.add), ("subtract", torch.subtract),
+          ("multiply", torch.multiply), ("divide", torch.divide),
+          ("maximum", torch.maximum), ("minimum", torch.minimum)]
+
+
+class TestBinaryDtypeSweep:
+    @pytest.mark.parametrize("name,tfn", BINARY)
+    @pytest.mark.parametrize("dtype", [F32, F16, BF16])
+    def test_float_dtypes_with_specials(self, name, tfn, dtype):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 5)).astype(np.float32)
+        b = rng.normal(size=(4, 5)).astype(np.float32)
+        a[0, 0], b[0, 1] = np.nan, np.inf
+        got = getattr(paddle, name)(_t(a, dtype), _t(b, dtype))
+        want = tfn(_torch(a, dtype), _torch(b, dtype))
+        assert str(got.dtype).endswith(dtype)
+        _close(got, want, dtype)
+
+    @pytest.mark.parametrize("name,tfn", [("add", torch.add),
+                                          ("multiply", torch.multiply)])
+    def test_int_and_empty_and_scalar(self, name, tfn):
+        a = np.array([[2, -3], [7, 0]], np.int32)
+        got = getattr(paddle, name)(_t(a), _t(a.T.copy()))
+        _close(got, tfn(_torch(a), _torch(a.T.copy())))
+        # empty
+        e = np.zeros((0, 3), np.float32)
+        got = getattr(paddle, name)(_t(e), _t(e))
+        assert tuple(got.shape) == (0, 3)
+        # 0-d
+        got = getattr(paddle, name)(_t(np.float32(2.0)),
+                                    _t(np.float32(3.0)))
+        _close(got, tfn(torch.tensor(2.0), torch.tensor(3.0)))
+
+    def test_integer_division_and_mod_signs(self):
+        # the reference (like python/numpy) floors toward -inf for mod,
+        # and floor_divide floors (torch.floor_divide matches)
+        a = np.array([7, -7, 7, -7], np.int32)
+        b = np.array([3, 3, -3, -3], np.int32)
+        _close(paddle.floor_divide(_t(a), _t(b)),
+               torch.floor_divide(_torch(a), _torch(b)))
+        _close(paddle.mod(_t(a), _t(b)),
+               torch.remainder(_torch(a), _torch(b)))
+
+    def test_pow_negative_base_and_broadcast(self):
+        a = np.array([[-2.0], [3.0]], np.float32)     # [2,1]
+        b = np.array([2.0, 3.0, 0.5], np.float32)     # [3]
+        got = paddle.pow(_t(a), _t(b))                # -> [2,3], nan at
+        want = torch.pow(_torch(a), _torch(b))        # (-2)**0.5
+        _close(got, want)
+
+
+class TestUnaryEdges:
+    @pytest.mark.parametrize("name,tfn,data", [
+        ("sqrt", torch.sqrt, [4.0, 0.0, -1.0, np.inf]),
+        ("log", torch.log, [1.0, 0.0, -1.0, np.e]),
+        ("exp", torch.exp, [0.0, 710.0, -710.0]),     # overflow -> inf
+        ("rsqrt", torch.rsqrt, [4.0, 0.25, 0.0]),
+        ("floor", torch.floor, [1.5, -1.5, -0.0, 2.0]),
+        ("ceil", torch.ceil, [1.5, -1.5, -0.0, 2.0]),
+        ("round", torch.round, [0.5, 1.5, 2.5, -0.5, -1.5]),  # banker's
+        ("tanh", torch.tanh, [0.0, 100.0, -100.0]),
+        ("sigmoid", torch.sigmoid, [0.0, 100.0, -100.0]),
+        ("abs", torch.abs, [-0.0, 1.0, -np.inf]),
+    ])
+    def test_float32_specials(self, name, tfn, data):
+        a = np.asarray(data, np.float32)
+        _close(getattr(paddle, name)(_t(a)), tfn(_torch(a)))
+
+    @pytest.mark.parametrize("dtype", [F16, BF16])
+    def test_half_dtypes_roundtrip(self, dtype):
+        a = np.linspace(-3, 3, 17, dtype=np.float32)
+        got = paddle.tanh(_t(a, dtype))
+        want = torch.tanh(_torch(a, dtype))
+        assert str(got.dtype).endswith(dtype)
+        _close(got, want, dtype)
+
+    def test_sign_negative_zero_and_nan(self):
+        a = np.array([-0.0, 0.0, -3.0, 7.0], np.float32)
+        got = _np(paddle.sign(_t(a)))
+        want = _np(torch.sign(_torch(a)))
+        np.testing.assert_allclose(got, want)
+        # NaN: the reference's Eigen sign is IEEE (nan -> nan); torch
+        # CPU returns 0 here — WE follow the reference
+        assert np.isnan(_np(paddle.sign(_t(np.array([np.nan],
+                                                    np.float32)))))[0]
+
+
+class TestReductionEdges:
+    def test_keepdim_and_empty_axis(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        _close(paddle.sum(_t(a), axis=[0, 2], keepdim=True),
+               torch.sum(_torch(a), dim=(0, 2), keepdim=True))
+        _close(paddle.mean(_t(a), axis=-1),
+               torch.mean(_torch(a), dim=-1))
+
+    def test_reduce_over_empty(self):
+        e = np.zeros((0, 4), np.float32)
+        got = _np(paddle.sum(_t(e), axis=0))
+        np.testing.assert_allclose(got, np.zeros(4))
+        # mean over empty = nan (reference/numpy semantics)
+        m = _np(paddle.mean(_t(e), axis=0))
+        assert np.isnan(m).all()
+
+    def test_max_min_nan_propagation(self):
+        a = np.array([1.0, np.nan, 3.0], np.float32)
+        assert np.isnan(_np(paddle.max(_t(a))))
+        assert np.isnan(_np(paddle.min(_t(a))))
+
+    def test_argmax_first_tie_and_int(self):
+        a = np.array([[1, 5, 5, 0], [7, 7, 2, 7]], np.int32)
+        got = _np(paddle.argmax(_t(a), axis=1))
+        want = _np(torch.argmax(_torch(a), dim=1))
+        np.testing.assert_array_equal(got, want)
+
+    def test_cumsum_dtypes(self):
+        a = np.array([[1, 2], [3, 4]], np.int32)
+        _close(paddle.cumsum(_t(a), axis=0),
+               torch.cumsum(_torch(a), dim=0))
+        f = np.array([0.1, 0.2, np.inf, 1.0], np.float32)
+        _close(paddle.cumsum(_t(f), axis=0),
+               torch.cumsum(_torch(f), dim=0))
+
+
+class TestShapeAndSelectEdges:
+    def test_clip_crossed_bounds(self):
+        # min > max: the reference clamps sequentially (max wins),
+        # matching torch.clamp
+        a = np.array([-5.0, 0.0, 5.0], np.float32)
+        _close(paddle.clip(_t(a), min=2.0, max=1.0),
+               torch.clamp(_torch(a), min=2.0, max=1.0))
+
+    def test_where_dtype_and_broadcast(self):
+        c = np.array([[True], [False]])
+        a = np.array([1.0, 2.0], np.float32)
+        b = np.array([[9.0, 8.0], [7.0, 6.0]], np.float32)
+        _close(paddle.where(_t(c), _t(a), _t(b)),
+               torch.where(_torch(c), _torch(a), _torch(b)))
+
+    def test_concat_empty_member(self):
+        a = np.zeros((0, 3), np.float32)
+        b = np.ones((2, 3), np.float32)
+        got = paddle.concat([_t(a), _t(b)], axis=0)
+        assert tuple(got.shape) == (2, 3)
+
+    def test_gather_and_index_select_bounds(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([2, 0, 2], np.int64)
+        _close(paddle.index_select(_t(a), _t(idx), axis=0),
+               torch.index_select(_torch(a), 0, _torch(idx)))
+
+    def test_topk_values_match(self):
+        a = np.array([3.0, 1.0, 3.0, 2.0], np.float32)
+        vals, _ = paddle.topk(_t(a), k=2)
+        tvals, _ = torch.topk(_torch(a), k=2)
+        _close(vals, tvals)
+
+
+class TestSoftmaxEdges:
+    def test_fully_masked_row(self):
+        a = np.full((2, 3), -np.inf, np.float32)
+        a[0] = [1.0, 2.0, 3.0]
+        got = _np(paddle.nn.functional.softmax(_t(a), axis=-1))
+        want = _np(torch.softmax(_torch(a), dim=-1))
+        np.testing.assert_allclose(got, want, rtol=1e-6, equal_nan=True)
+
+    def test_half_precision_large_logits(self):
+        a = (np.array([[10000.0, 9999.0, 0.0]], np.float32))
+        got = paddle.nn.functional.softmax(_t(a, BF16), axis=-1)
+        want = torch.softmax(_torch(a, BF16), dim=-1)
+        _close(got, want, BF16)
+
+
+class TestCastEdges:
+    @pytest.mark.parametrize("src,dst", [
+        (F32, I32), (F32, "bool"), (I32, F32), ("bool", F32),
+        (F32, BF16), (BF16, F32), (F32, F16),
+    ])
+    def test_cast_matrix(self, src, dst):
+        a = np.array([0.0, 1.0, -1.5, 2.5], np.float32)
+        got = paddle.cast(_t(a, src if src != "bool" else None)
+                          if src != "bool" else _t(a != 0), dst)
+        assert str(got.dtype).endswith(dst)
+
+    def test_float_to_int_truncates_toward_zero(self):
+        a = np.array([1.9, -1.9, 0.5, -0.5], np.float32)
+        got = _np(paddle.cast(_t(a), I32))
+        want = _np(_torch(a).to(torch.int32))
+        np.testing.assert_array_equal(got, want)
