@@ -1,2 +1,2 @@
-from . import dtype, flags, state  # noqa
+from . import dtype, enforce, flags, state  # noqa
 from .tensor import Parameter, Tensor, is_tracer, to_tensor  # noqa

@@ -1,0 +1,130 @@
+"""Structured error discipline — the enforce-macro system.
+
+Reference capability: paddle/common/{errors.h,enforce.h} — every runtime
+check raises a TYPED error carrying one of 12 error codes, with a
+uniform "<Type>Error: <message> [Hint: ...]" rendering
+(PADDLE_ENFORCE_* macros add the failing expression). TPU-native
+redesign: Python exception classes that ALSO subclass the natural
+builtin (InvalidArgumentError is a ValueError, NotFoundError a KeyError,
+UnimplementedError a NotImplementedError, ...) so framework code can
+adopt the typed discipline without breaking callers that catch
+builtins; ``enforce*`` helpers produce the reference's message shape
+with the failed predicate spelled out.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = [
+    "EnforceError", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError",
+    "enforce", "enforce_eq", "enforce_ne", "enforce_gt", "enforce_ge",
+    "enforce_lt", "enforce_le", "enforce_not_none", "enforce_shape",
+]
+
+
+class EnforceError(Exception):
+    """Base of all typed framework errors (reference: EnforceNotMet).
+    ``code`` mirrors common/errors.h ErrorCode."""
+
+    code = 0
+    type_name = "Error"
+
+    def __init__(self, message: str, hint: Optional[str] = None):
+        self.message = message
+        self.hint = hint
+        text = f"{self.type_name}: {message}"
+        if hint:
+            text += f" [Hint: {hint}]"
+        self._text = text
+        super().__init__(text)
+
+    def __str__(self):
+        # KeyError.__str__ (NotFoundError's builtin base) would repr-
+        # quote the message; keep the uniform rendering for every type
+        return self._text
+
+
+def _make(name, code, *bases):
+    cls = type(name, (EnforceError, *bases),
+               {"code": code, "type_name": name.removesuffix("Error")})
+    return cls
+
+
+# each error is ALSO the natural builtin so existing `except ValueError`
+# style callers keep working as the framework adopts the typed raises
+InvalidArgumentError = _make("InvalidArgumentError", 1, ValueError)
+NotFoundError = _make("NotFoundError", 2, KeyError)
+OutOfRangeError = _make("OutOfRangeError", 3, IndexError)
+AlreadyExistsError = _make("AlreadyExistsError", 4)
+ResourceExhaustedError = _make("ResourceExhaustedError", 5, MemoryError)
+PreconditionNotMetError = _make("PreconditionNotMetError", 6,
+                                RuntimeError)
+PermissionDeniedError = _make("PermissionDeniedError", 7)
+ExecutionTimeoutError = _make("ExecutionTimeoutError", 8, TimeoutError)
+UnimplementedError = _make("UnimplementedError", 9, NotImplementedError)
+UnavailableError = _make("UnavailableError", 10, RuntimeError)
+FatalError = _make("FatalError", 11)
+ExternalError = _make("ExternalError", 12)
+
+
+def enforce(cond: Any, message: str,
+            error: type = PreconditionNotMetError,
+            hint: Optional[str] = None):
+    """PADDLE_ENFORCE: raise ``error`` when ``cond`` is falsy."""
+    if not cond:
+        raise error(message, hint)
+
+
+def _cmp(a, b, ok, sym, message, error, hint):
+    if not ok:
+        detail = f"expected {a!r} {sym} {b!r}"
+        raise error(f"{message} ({detail})" if message else detail, hint)
+
+
+def enforce_eq(a, b, message="", error=InvalidArgumentError, hint=None):
+    _cmp(a, b, a == b, "==", message, error, hint)
+
+
+def enforce_ne(a, b, message="", error=InvalidArgumentError, hint=None):
+    _cmp(a, b, a != b, "!=", message, error, hint)
+
+
+def enforce_gt(a, b, message="", error=InvalidArgumentError, hint=None):
+    _cmp(a, b, a > b, ">", message, error, hint)
+
+
+def enforce_ge(a, b, message="", error=InvalidArgumentError, hint=None):
+    _cmp(a, b, a >= b, ">=", message, error, hint)
+
+
+def enforce_lt(a, b, message="", error=InvalidArgumentError, hint=None):
+    _cmp(a, b, a < b, "<", message, error, hint)
+
+
+def enforce_le(a, b, message="", error=InvalidArgumentError, hint=None):
+    _cmp(a, b, a <= b, "<=", message, error, hint)
+
+
+def enforce_not_none(value, name="value", error=NotFoundError, hint=None):
+    if value is None:
+        raise error(f"{name} must not be None", hint)
+    return value
+
+
+def enforce_shape(x, expected, name="tensor",
+                  error=InvalidArgumentError, hint=None):
+    """Shape check with -1/None wildcards per dim (the InferMeta-style
+    dims enforce)."""
+    shape = tuple(getattr(x, "shape", x))
+    expected = tuple(expected)
+    ok = len(shape) == len(expected) and all(
+        e in (-1, None) or int(s) == int(e)
+        for s, e in zip(shape, expected))
+    if not ok:
+        raise error(
+            f"{name} has shape {list(shape)}, expected "
+            f"{[(-1 if e is None else e) for e in expected]}", hint)

@@ -55,6 +55,12 @@ def init_parallel_env():
             jax.distributed.initialize(
                 coordinator_address=f"{coord.split(':')[0]}:{port}",
                 num_processes=nprocs, process_id=pid)
+    # elastic liveness: auto-beat when the launcher asked for it
+    try:
+        from . import heartbeat as _hb
+        _hb.start()
+    except Exception:
+        pass
     _initialized = True
 
 

@@ -1,0 +1,123 @@
+"""Worker liveness heartbeats for elastic training.
+
+Reference capability: distributed/fleet/elastic/manager.py — etcd-lease
+heartbeats give the elastic manager a membership signal, so a wedged or
+silently-dead worker is detected, not just a crashed one. TPU-native
+redesign: one controller per host (launch/main.py) watches per-rank
+heartbeat FILES (mtime = last beat) — no external etcd; the transport is
+the shared filesystem the launcher already owns for worker logs. (A
+multi-host deployment can point PADDLE_HEARTBEAT_DIR at shared storage;
+the beats are tiny O(ranks) touches.)
+
+Two beat sources, two failure classes:
+- AUTO beats: a daemon thread touches the file every interval — detects
+  dead/killed/deadlocked-at-exec processes (the thread dies with them).
+- PROGRESS beats: the training loop calls ``beat(step=n)`` — detects
+  WEDGED-BUT-ALIVE workers (hung collective, stuck IO), which auto
+  beats cannot see. The watcher uses the progress threshold only for
+  workers that have opted in by emitting at least one progress beat.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+_AUTO_SUFFIX = ".alive"
+_PROGRESS_SUFFIX = ".progress"
+_state = {"thread": None, "stop": None, "dir": None, "rank": None}
+
+
+def _touch(path, payload=None):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(payload or {"t": time.time()}))
+    os.replace(tmp, path)
+
+
+def start(dir_path: Optional[str] = None, rank: Optional[int] = None,
+          interval: float = 1.0):
+    """Start the auto-beat daemon thread (idempotent). Called by
+    init_parallel_env when PADDLE_HEARTBEAT_DIR is set."""
+    dir_path = dir_path or os.environ.get("PADDLE_HEARTBEAT_DIR")
+    if not dir_path:
+        return False
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if _state["thread"] is not None and _state["thread"].is_alive():
+        return True
+    os.makedirs(dir_path, exist_ok=True)
+    stop = threading.Event()
+    path = os.path.join(dir_path, f"rank{rank}{_AUTO_SUFFIX}")
+
+    def loop():
+        while not stop.is_set():
+            try:
+                _touch(path)
+            except OSError:
+                pass
+            stop.wait(interval)
+
+    th = threading.Thread(target=loop, daemon=True)
+    th.start()
+    _state.update(thread=th, stop=stop, dir=dir_path, rank=rank)
+    return True
+
+
+def stop():
+    if _state["stop"] is not None:
+        _state["stop"].set()
+        _state["thread"] = None
+
+
+def beat(step: Optional[int] = None):
+    """Emit a PROGRESS beat from the training loop. A worker that emits
+    one opts into wedge detection: the watcher kills the job if its
+    progress beat goes stale."""
+    dir_path = _state["dir"] or os.environ.get("PADDLE_HEARTBEAT_DIR")
+    if not dir_path:
+        return
+    rank = _state["rank"] if _state["rank"] is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    os.makedirs(dir_path, exist_ok=True)
+    _touch(os.path.join(dir_path, f"rank{rank}{_PROGRESS_SUFFIX}"),
+           {"t": time.time(), "step": step})
+
+
+def check_stale(dir_path: str, ranks, auto_timeout: float,
+                progress_timeout: float,
+                started_at: Optional[float] = None) -> Dict[int, str]:
+    """Watcher side: {rank: reason} for every stale worker among
+    ``ranks`` (GLOBAL rank ids — a node's watcher passes its own ranks,
+    node_rank*nproc..+nproc). A rank with no auto beat yet is stale only
+    once ``started_at`` is more than auto_timeout old (a worker can
+    wedge before its first beat — import hang, stuck backend init);
+    progress staleness applies only to ranks that have beaten progress
+    at least once."""
+    now = time.time()
+    stale = {}
+    for rank in ranks:
+        auto = os.path.join(dir_path, f"rank{rank}{_AUTO_SUFFIX}")
+        prog = os.path.join(dir_path, f"rank{rank}{_PROGRESS_SUFFIX}")
+        try:
+            age = now - os.stat(auto).st_mtime
+            if auto_timeout > 0 and age > auto_timeout:
+                stale[rank] = f"no liveness beat for {age:.1f}s"
+                continue
+        except OSError:
+            # never beat at all: stale once the startup grace (one
+            # auto_timeout from job start) is spent
+            if (auto_timeout > 0 and started_at is not None
+                    and now - started_at > auto_timeout):
+                stale[rank] = ("never emitted a liveness beat "
+                               f"({now - started_at:.1f}s since launch)")
+                continue
+        try:
+            page = now - os.stat(prog).st_mtime
+            if progress_timeout > 0 and page > progress_timeout:
+                stale[rank] = f"no training progress for {page:.1f}s"
+        except OSError:
+            pass   # never opted in
+    return stale

@@ -39,6 +39,12 @@ def _parse(argv):
                         "device selection)")
     p.add_argument("--job_id", default="default")
     p.add_argument("script", help="training script to run")
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="seconds without a liveness beat before a worker "
+                        "is declared dead (0 = off)")
+    p.add_argument("--progress_timeout", type=float, default=0.0,
+                   help="seconds without a training-progress beat before "
+                        "an opted-in worker is declared wedged (0 = off)")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
@@ -52,17 +58,31 @@ def _free_port() -> int:
 
 def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
            master=None, log_dir=None, job_id="default",
-           extra_env=None) -> int:
+           extra_env=None, heartbeat_timeout: float = 0.0,
+           progress_timeout: float = 0.0) -> int:
     """Spawn ``nproc_per_node`` worker processes with rendezvous env and
     watch them (reference: CollectiveController.run). Returns the exit
     code: 0 iff every worker exited 0; on any failure the remaining
-    workers are terminated (the watcher's fail-fast)."""
+    workers are terminated (the watcher's fail-fast).
+
+    ``heartbeat_timeout``/``progress_timeout`` (seconds; 0 = off) enable
+    the elastic liveness layer (distributed/heartbeat.py): workers beat
+    per-rank files; a worker whose liveness beat goes stale — or whose
+    training-progress beat goes stale after it opted in — is declared
+    WEDGED and the job is killed (rc=124) so the elastic manager can
+    restart it. This is the reference's etcd-heartbeat membership signal
+    (fleet/elastic/manager.py:124) over the launcher's filesystem."""
     if master is None:
         master = f"127.0.0.1:{_free_port()}"
     host, port = master.rsplit(":", 1)
     world = nnodes * nproc_per_node
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    hb_dir = None
+    if heartbeat_timeout > 0 or progress_timeout > 0:
+        import tempfile
+        hb_dir = os.path.join(log_dir, "heartbeats") if log_dir             else tempfile.mkdtemp(prefix="paddle_hb_")
+        os.makedirs(hb_dir, exist_ok=True)
 
     procs = []
     logs = []
@@ -79,6 +99,8 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
             "MASTER_PORT": port,
             "PADDLE_JOB_ID": str(job_id),
         })
+        if hb_dir:
+            env["PADDLE_HEARTBEAT_DIR"] = hb_dir
         env.update(extra_env or {})
         if log_dir:
             log = open(os.path.join(log_dir, f"workerlog.{rank}"), "wb")
@@ -92,10 +114,40 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
             stdout=out, stderr=err))
 
     rc = 0
+    job_start = time.time()
     try:
+        from .. import heartbeat as _hb
         alive = set(range(len(procs)))
         while alive:
             time.sleep(0.2)
+            if hb_dir:
+                my_ranks = [node_rank * nproc_per_node + l
+                            for l in range(nproc_per_node)]
+                stale = _hb.check_stale(
+                    hb_dir, my_ranks,
+                    auto_timeout=heartbeat_timeout,
+                    progress_timeout=progress_timeout,
+                    started_at=job_start)
+                stale = {r - node_rank * nproc_per_node: why
+                         for r, why in stale.items()}
+                stale = {r: why for r, why in stale.items() if r in alive}
+                if stale:
+                    for r, why in stale.items():
+                        print(f"[launch] rank {r} wedged: {why}; "
+                              "killing job for elastic restart",
+                              file=sys.stderr)
+                    rc = 124
+                    for j in alive:
+                        procs[j].terminate()
+                    deadline = time.time() + 10
+                    for j in alive:
+                        try:
+                            procs[j].wait(max(0.1,
+                                              deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            procs[j].kill()
+                    alive.clear()
+                    break
             for i in list(alive):
                 r = procs[i].poll()
                 if r is None:
@@ -132,7 +184,9 @@ def main(argv=None):
     rc = launch(args.script, args.script_args, nproc_per_node=nproc,
                 nnodes=args.nnodes, node_rank=args.node_rank,
                 master=args.master, log_dir=args.log_dir,
-                job_id=args.job_id)
+                job_id=args.job_id,
+                heartbeat_timeout=args.heartbeat_timeout,
+                progress_timeout=args.progress_timeout)
     sys.exit(rc)
 
 

@@ -37,14 +37,14 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
     accepted for parity — XLA owns comm bucketing (recorded in
     docs/CAPABILITY_DELTA.md).
     """
-    if level not in _LEVELS:
-        raise ValueError(
-            f"level must be one of {sorted(_LEVELS)} (ZeRO 1/2/3), "
-            f"got {level!r}")
+    from ..core import enforce as E
+    E.enforce(level in _LEVELS,
+              f"level must be one of {sorted(_LEVELS)} (ZeRO 1/2/3), "
+              f"got {level!r}", E.InvalidArgumentError)
     if offload:
-        raise NotImplementedError(
-            "offload=True (CPU-placed moments) is not supported: jitted "
-            "updates require device-resident optimizer state")
+        raise E.UnimplementedError(
+            "offload=True (CPU-placed moments) is not supported",
+            hint="jitted updates require device-resident optimizer state")
     stage = _LEVELS[level]()
     optimizer = shard_optimizer(optimizer, stage)
     return model, optimizer, scaler

@@ -301,8 +301,10 @@ class DataLoader:
                  prefetch_factor: int = 2, use_shared_memory: bool = True,
                  timeout: int = 0, worker_init_fn=None,
                  persistent_workers=False, worker_mode: str = "thread"):
-        if worker_mode not in ("thread", "process"):
-            raise ValueError("worker_mode must be 'thread' or 'process'")
+        from ..core import enforce as E
+        E.enforce(worker_mode in ("thread", "process", "native"),
+                  "worker_mode must be 'thread', 'process', or 'native'",
+                  E.InvalidArgumentError)
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -311,6 +313,9 @@ class DataLoader:
         self.worker_mode = worker_mode
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        self._shuffle = bool(shuffle)
+        self._drop_last = bool(drop_last)
+        self._user_batch_sampler = batch_sampler is not None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -351,6 +356,15 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        if self.worker_mode == "native":
+            if self._user_batch_sampler:
+                from ..core import enforce as E
+                raise E.InvalidArgumentError(
+                    "worker_mode='native' drives its own batching/"
+                    "shuffle and cannot honor a custom batch_sampler",
+                    hint="drop batch_sampler (use shuffle=/drop_last=) "
+                         "or use worker_mode='thread'/'process'")
+            return self._native_iter()
         if self.num_workers > 0 and self.worker_mode == "process":
             if self._iterable_mode or self.batch_sampler is None:
                 raise ValueError(
@@ -373,6 +387,40 @@ class DataLoader:
                                             self.num_workers,
                                             self.prefetch_factor))
         return self._raw_iter()
+
+    def _native_iter(self):
+        """worker_mode='native': C++ batch assembly (csrc/datafeed.cc)
+        for row-aligned array datasets — TensorDataset, or any dataset
+        exposing ``numpy_arrays()`` -> tuple of [N, ...] numpy arrays.
+        Shuffle/drop_last honored natively; yields Tensor tuples like
+        the default collate."""
+        import numpy as np
+
+        from .dataset import TensorDataset
+        from .native_feed import NativeArrayFeeder
+
+        if hasattr(self.dataset, "numpy_arrays"):
+            arrays = [np.asarray(a) for a in self.dataset.numpy_arrays()]
+        elif isinstance(self.dataset, TensorDataset):
+            arrays = [np.asarray(getattr(t, "_data", t))
+                      for t in self.dataset.tensors]
+        else:
+            raise TypeError(
+                "worker_mode='native' needs an array-backed dataset "
+                "(TensorDataset or one exposing numpy_arrays()); use "
+                "worker_mode='thread'/'process' for arbitrary map-style "
+                "datasets")
+        if self.batch_size is None:
+            raise ValueError("worker_mode='native' requires batch_size")
+        feeder = NativeArrayFeeder(
+            arrays, self.batch_size, shuffle=self._shuffle,
+            drop_last=self._drop_last,
+            num_threads=max(self.num_workers, 1), epochs=1)
+        try:
+            for batch in feeder:
+                yield tuple(to_tensor(b) for b in batch)
+        finally:
+            feeder.close()
 
     def __len__(self):
         if self._iterable_mode:

@@ -1,0 +1,143 @@
+"""Native (C++) data-feed path for memory-resident datasets.
+
+Reference capability: the C++ DataFeed/Trainer pipeline
+(paddle/fluid/framework/data_feed.cc) — batch assembly off the Python
+interpreter. TPU-native shape: for array-backed datasets (token
+buffers, tabular features — the cases where input speed matters), the
+per-batch hot work is row GATHER + shuffle; csrc/datafeed.cc runs both
+on a C++ worker pool over a ring of reusable buffers, and Python makes
+exactly one ctypes call per batch. Built on demand through
+utils.cpp_extension (g++ JIT, same machinery as the profiler's host
+tracer); anything non-array-backed keeps the Python subprocess/thread
+workers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        from ..utils import cpp_extension
+
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "csrc",
+            "datafeed.cc")
+        lib = cpp_extension.load("paddle_datafeed", [src],
+                                 extra_ldflags=["-lpthread"])
+        lib.df_pipeline_create.restype = ctypes.c_void_p
+        lib.df_pipeline_create.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+        lib.df_pipeline_next.restype = ctypes.c_uint64
+        lib.df_pipeline_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.df_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        lib.df_gather.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False
+
+
+class NativeArrayFeeder:
+    """Iterate shuffled batches of row-aligned numpy arrays, assembled
+    by the C++ pipeline. ``epochs`` bounds iteration (1 = one pass)."""
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = False, drop_last: bool = False,
+                 seed: int = 0, num_threads: int = 2,
+                 prefetch_depth: int = 4, epochs: int = 1):
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = {a.shape[0] for a in arrays}
+        if len(n) != 1:
+            raise ValueError("all arrays must share dim 0")
+        (self._n,) = n
+        if self._n == 0 or batch_size < 1:
+            raise ValueError("need rows and a positive batch size")
+        self._arrays = arrays          # keep alive: C++ reads in place
+        self._batch = int(batch_size)
+        self._drop_last = drop_last
+        self._epochs = int(epochs)
+        lib = _lib()
+        srcs = (ctypes.c_void_p * len(arrays))(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+        row_bytes = (ctypes.c_uint64 * len(arrays))(
+            *[a.nbytes // self._n for a in arrays])
+        self._row_bytes = list(row_bytes)
+        self._handle = lib.df_pipeline_create(
+            srcs, row_bytes, len(arrays), self._n, self._batch,
+            int(drop_last), int(shuffle), seed, self._epochs,
+            num_threads, prefetch_depth)
+        if not self._handle:
+            raise RuntimeError("native datafeed pipeline create failed")
+        self._lib = lib
+
+    def __len__(self):
+        per = self._n // self._batch if self._drop_last else \
+            -(-self._n // self._batch)
+        return per * max(self._epochs, 1)
+
+    def __iter__(self):
+        lib = self._lib
+        bufs = [np.empty((self._batch,) + a.shape[1:], a.dtype)
+                for a in self._arrays]
+        dsts = (ctypes.c_void_p * len(bufs))(
+            *[b.ctypes.data_as(ctypes.c_void_p).value for b in bufs])
+        epoch = ctypes.c_uint64()
+        index = ctypes.c_uint64()
+        remaining = len(self)
+        while remaining > 0:
+            rows = lib.df_pipeline_next(self._handle, dsts,
+                                        ctypes.byref(epoch),
+                                        ctypes.byref(index))
+            if rows == 0:
+                return
+            remaining -= 1
+            yield tuple(b[:rows].copy() for b in bufs)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.df_pipeline_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_gather(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """One multi-row gather through the C++ core (the collate
+    primitive; also the benchmark hook)."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, np.uint64)
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    _lib().df_gather(
+        src.ctypes.data_as(ctypes.c_void_p),
+        src.nbytes // max(src.shape[0], 1),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(idx), out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -150,3 +150,64 @@ class TestAutoTunerTrials:
         assert len(rows) == 2
         assert all(float(row["time"]) > 0 for row in rows)
         assert (out / "best_cfg.json").exists()
+
+
+class TestHeartbeatLiveness:
+    """Elastic liveness (reference etcd-heartbeat membership,
+    fleet/elastic/manager.py:124): a wedged-but-alive worker is detected
+    and the job is killed for restart."""
+
+    def _run(self, tmp_path, body, nprocs=2, **flags):
+        script = tmp_path / "w.py"
+        script.write_text(body)
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node", str(nprocs),
+               "--log_dir", str(tmp_path / "logs")]
+        for k, v in flags.items():
+            cmd += [f"--{k}", str(v)]
+        cmd.append(str(script))
+        import time
+        t0 = time.time()
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=180,
+                           env=dict(os.environ, JAX_PLATFORMS="cpu",
+                                    PYTHONPATH=REPO))
+        return r, time.time() - t0
+
+    def test_wedged_worker_detected_via_progress_beats(self, tmp_path):
+        # rank 1 emits progress beats then wedges (sleeps forever while
+        # its auto-beat thread keeps the process looking alive) — only
+        # the progress timeout can catch this
+        body = (
+            "import os, sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from paddle_tpu.distributed import heartbeat\n"
+            "heartbeat.start()\n"
+            "for i in range(3):\n"
+            "    heartbeat.beat(step=i)\n"
+            "    time.sleep(0.1)\n"
+            "if os.environ['PADDLE_TRAINER_ID'] == '1':\n"
+            "    time.sleep(300)   # wedged: alive but no progress\n"
+            "else:\n"
+            "    for i in range(300):\n"
+            "        heartbeat.beat(step=i)\n"
+            "        time.sleep(0.1)\n")
+        r, dt = self._run(tmp_path, body, progress_timeout=5)
+        assert r.returncode == 124, (r.returncode, r.stderr[-1500:])
+        assert "wedged" in r.stderr
+        assert dt < 60, dt
+
+    def test_healthy_workers_unaffected(self, tmp_path):
+        body = (
+            "import os, sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from paddle_tpu.distributed import heartbeat\n"
+            "heartbeat.start()\n"
+            "for i in range(8):\n"
+            "    heartbeat.beat(step=i)\n"
+            "    time.sleep(0.1)\n")
+        # generous grace: the worker pays a cold paddle_tpu import
+        # (several seconds on a loaded box) before its first beat
+        r, _ = self._run(tmp_path, body, heartbeat_timeout=45,
+                         progress_timeout=45)
+        assert r.returncode == 0, r.stderr[-1500:]

@@ -142,3 +142,46 @@ class TestSaveLoad:
         np.testing.assert_allclose(loaded["w"].numpy(), [[1, 2]])
         assert loaded["step"] == 3
         np.testing.assert_allclose(loaded["nested"][0].numpy(), [5])
+
+
+class TestEnforce:
+    """core.enforce — typed error discipline (reference:
+    paddle/common/{errors.h,enforce.h})."""
+
+    def test_codes_and_builtin_bases(self):
+        from paddle_tpu.core import enforce as E
+
+        assert E.InvalidArgumentError.code == 1
+        assert issubclass(E.InvalidArgumentError, ValueError)
+        assert issubclass(E.NotFoundError, KeyError)
+        assert issubclass(E.UnimplementedError, NotImplementedError)
+        assert issubclass(E.ExecutionTimeoutError, TimeoutError)
+        assert E.ExternalError.code == 12
+
+    def test_message_shape_and_hint(self):
+        from paddle_tpu.core import enforce as E
+
+        with pytest.raises(E.InvalidArgumentError) as ei:
+            E.enforce_eq(3, 4, "axis mismatch", hint="transpose first")
+        msg = str(ei.value)
+        assert msg.startswith("InvalidArgument: axis mismatch")
+        assert "expected 3 == 4" in msg and "[Hint: transpose first]" in msg
+        # typed error still caught as the builtin
+        with pytest.raises(ValueError):
+            E.enforce_gt(1, 2)
+
+    def test_shape_enforce_wildcards(self):
+        import numpy as np
+
+        from paddle_tpu.core import enforce as E
+
+        E.enforce_shape(np.zeros((2, 5)), (-1, 5))
+        with pytest.raises(E.InvalidArgumentError, match="expected"):
+            E.enforce_shape(np.zeros((2, 5)), (2, 4), name="weight")
+
+    def test_enforce_not_none(self):
+        from paddle_tpu.core import enforce as E
+
+        assert E.enforce_not_none(3, "x") == 3
+        with pytest.raises(E.NotFoundError):
+            E.enforce_not_none(None, "param")
