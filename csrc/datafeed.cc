@@ -71,6 +71,11 @@ struct Pipeline {
 
   ~Pipeline() {
     stop.store(true);
+    // lock each waiter's mutex before notifying: a worker that checked
+    // the predicate pre-stop but hasn't blocked yet would otherwise
+    // miss the wakeup and hang the join below
+    { std::lock_guard<std::mutex> g(mu); }
+    { std::lock_guard<std::mutex> g(order_mu); }
     cv_free.notify_all();
     cv_ready.notify_all();
     cv_order.notify_all();

@@ -56,6 +56,20 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _kill_all(procs, alive):
+    """Terminate every still-alive worker: SIGTERM, a shared 10s grace
+    window, then SIGKILL (both failure paths share this shutdown)."""
+    for j in alive:
+        procs[j].terminate()
+    deadline = time.time() + 10
+    for j in alive:
+        try:
+            procs[j].wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            procs[j].kill()
+    alive.clear()
+
+
 def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
            master=None, log_dir=None, job_id="default",
            extra_env=None, heartbeat_timeout: float = 0.0,
@@ -137,16 +151,7 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                               "killing job for elastic restart",
                               file=sys.stderr)
                     rc = 124
-                    for j in alive:
-                        procs[j].terminate()
-                    deadline = time.time() + 10
-                    for j in alive:
-                        try:
-                            procs[j].wait(max(0.1,
-                                              deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            procs[j].kill()
-                    alive.clear()
+                    _kill_all(procs, alive)
                     break
             for i in list(alive):
                 r = procs[i].poll()
@@ -157,16 +162,7 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                     # fail fast: one dead worker kills the job
                     # (reference: watcher peer-failure propagation)
                     rc = r
-                    for j in alive:
-                        procs[j].terminate()
-                    deadline = time.time() + 10
-                    for j in alive:
-                        try:
-                            procs[j].wait(max(0.1,
-                                              deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            procs[j].kill()
-                    alive.clear()
+                    _kill_all(procs, alive)
     except KeyboardInterrupt:
         for pr in procs:
             pr.send_signal(signal.SIGTERM)

@@ -412,9 +412,21 @@ class DataLoader:
                 "datasets")
         if self.batch_size is None:
             raise ValueError("worker_mode='native' requires batch_size")
+        if self.collate_fn is not default_collate_fn:
+            from ..core import enforce as E
+            raise E.InvalidArgumentError(
+                "worker_mode='native' assembles batches in C++ and "
+                "cannot run a custom collate_fn",
+                hint="drop collate_fn or use worker_mode="
+                     "'thread'/'process'")
+        # fresh seed per epoch (drawn from the parent numpy stream so
+        # paddle.seed/np.random.seed keeps runs reproducible) — every
+        # __iter__ reshuffles like the thread/process paths
+        self._native_epoch = getattr(self, "_native_epoch", -1) + 1
+        seed = int(np.random.randint(0, 2**31 - 1)) + self._native_epoch
         feeder = NativeArrayFeeder(
             arrays, self.batch_size, shuffle=self._shuffle,
-            drop_last=self._drop_last,
+            drop_last=self._drop_last, seed=seed,
             num_threads=max(self.num_workers, 1), epochs=1)
         try:
             for batch in feeder:

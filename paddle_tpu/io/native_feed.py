@@ -100,6 +100,13 @@ class NativeArrayFeeder:
         return per * max(self._epochs, 1)
 
     def __iter__(self):
+        if getattr(self, "_consumed", False):
+            raise RuntimeError(
+                "NativeArrayFeeder is one-shot (the C++ pipeline "
+                "prefetches through its epochs once); construct a new "
+                "feeder per pass — DataLoader(worker_mode='native') "
+                "does this for you on every __iter__")
+        self._consumed = True
         lib = self._lib
         bufs = [np.empty((self._batch,) + a.shape[1:], a.dtype)
                 for a in self._arrays]

@@ -105,13 +105,19 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  input_spec=None, build_strategy=None, full_graph=True,
-                 bucket_batch=False, bucket_sizes=None):
+                 bucket_batch=False, bucket_sizes=None,
+                 bucket_seq=False, seq_axis=1, seq_bucket_sizes=None,
+                 seq_pad_value=0):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
         self._programs: Dict[tuple, _Program] = {}
         self._bucket_batch = bool(bucket_batch)
         self._bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
+        self._bucket_seq = bool(bucket_seq)
+        self._seq_axis = int(seq_axis)
+        self._seq_bucket_sizes = sorted(seq_bucket_sizes)             if seq_bucket_sizes else None
+        self._seq_pad_value = seq_pad_value
         # full_graph=False: a capture failure (data-dependent Python
         # branch) becomes a graph break — that signature runs eagerly
         # with a one-time warning, like the reference's SOT fallback.
@@ -121,9 +127,10 @@ class StaticFunction:
         self._segmented = None
         functools.update_wrapper(self, fn)
 
-    def _bucket_of(self, n: int) -> int:
-        if self._bucket_sizes:
-            for b in self._bucket_sizes:
+    @staticmethod
+    def _pick_bucket(n: int, sizes) -> int:
+        if sizes:
+            for b in sizes:
                 if n <= b:
                     return b
             return n          # beyond the largest bucket: run unbucketed
@@ -131,6 +138,9 @@ class StaticFunction:
         while b < n:
             b <<= 1
         return b
+
+    def _bucket_of(self, n: int) -> int:
+        return self._pick_bucket(n, self._bucket_sizes)
 
     def _apply_bucketing(self, args):
         """Pad every Tensor arg's leading dim from the common batch size
@@ -161,6 +171,46 @@ class StaticFunction:
                     and a._data.shape[0] == n:
                 widths = [(0, b - n)] + [(0, 0)] * (a._data.ndim - 1)
                 return Tensor(_jnp.pad(a._data, widths))
+            return a
+        return tuple(pad(a) for a in args), int(n), int(b)
+
+    def _seq_bucket_of(self, n: int) -> int:
+        return self._pick_bucket(n, self._seq_bucket_sizes)
+
+    def _apply_seq_bucketing(self, args):
+        """Pad the sequence axis to its bucket (the reference's dynamic
+        seq-len bucketing policy for serving). SOUND for causal /
+        right-context-free computations only: right-padding cannot
+        change the outputs at real positions of a causal model (position
+        i attends to <= i), so slicing the pad tail back off is EXACT —
+        no mask plumbing needed. Non-causal models must consume an
+        explicit mask themselves or keep bucket_seq off. Inference-only
+        like batch bucketing (skipped while grads record).
+
+        Coincidence hazard (like batch bucketing's): any output whose
+        ``seq_axis`` dim equals the padded bucket is sliced — a feature
+        dim that lands exactly on a bucket (both are often powers of
+        two) would be truncated. Choose ``seq_bucket_sizes`` that avoid
+        the model's feature dims when outputs mix axes."""
+        if state.grad_enabled():
+            return args, None, None
+        axis = self._seq_axis
+        lens = {a._data.shape[axis] for a in args
+                if isinstance(a, Tensor) and a._data.ndim > axis}
+        if len(lens) != 1:
+            return args, None, None
+        (n,) = lens
+        b = self._seq_bucket_of(int(n))
+        if b == n:
+            return args, None, None
+        import jax.numpy as _jnp
+
+        def pad(a):
+            if isinstance(a, Tensor) and a._data.ndim > axis                     and a._data.shape[axis] == n:
+                widths = [(0, 0)] * a._data.ndim
+                widths[axis] = (0, b - n)
+                return Tensor(_jnp.pad(a._data, widths,
+                                       constant_values=self._seq_pad_value))
             return a
         return tuple(pad(a) for a in args), int(n), int(b)
 
@@ -234,8 +284,16 @@ class StaticFunction:
         if not _to_static_enabled:
             return self._fn(*args, **kwargs)
         real_batch = None
+        seq_pad = None
         if self._bucket_batch and not kwargs:
             args, real_batch, padded_batch = self._apply_bucketing(args)
+        if self._bucket_seq and not kwargs:
+            args, real_seq, padded_seq = self._apply_seq_bucketing(args)
+            if real_seq is not None:
+                seq_pad = (self._seq_axis, real_seq, padded_seq)
+        if seq_pad is not None and real_batch is None:
+            out = self.__wrapped_call(args, kwargs)
+            return self._unpad_seq(out, *seq_pad)
         if real_batch is not None:
             out = self.__wrapped_call(args, kwargs)
             # Ranks of the padded inputs: an output that is batch-major
@@ -270,8 +328,20 @@ class StaticFunction:
                     f"size {padded_batch} but whose rank matches no padded "
                     "input — if such an output is not batch-major, disable "
                     "bucket_batch for this function", stacklevel=2)
+            if seq_pad is not None:
+                out = self._unpad_seq(out, *seq_pad)
             return out
         return self.__wrapped_call(args, kwargs)
+
+    def _unpad_seq(self, out, axis, real, padded):
+        def unpad(o):
+            if isinstance(o, Tensor) and o._data.ndim > axis                     and o._data.shape[axis] == padded:
+                idx = [slice(None)] * o._data.ndim
+                idx[axis] = slice(0, real)
+                return Tensor(o._data[tuple(idx)])
+            return o
+        return jax.tree_util.tree_map(
+            unpad, out, is_leaf=lambda x: isinstance(x, Tensor))
 
     def __wrapped_call(self, args, kwargs):
         key = self._cache_key(args, kwargs)
@@ -417,14 +487,22 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, bucket_batch=False,
-              bucket_sizes=None, **kwargs):
+              bucket_sizes=None, bucket_seq=False, seq_axis=1,
+              seq_bucket_sizes=None, seq_pad_value=0, **kwargs):
     """paddle.jit.to_static parity (reference: jit/api.py:136).
     ``bucket_batch``/``bucket_sizes``: see StaticFunction — pad variable
     leading dims to buckets so XLA recompiles O(log max_batch) times.
-    ``full_graph=False``: data-dependent Python branches become graph
-    breaks (eager fallback with a warning) instead of errors — the
-    reference's SOT capture mode."""
+    ``bucket_seq``/``seq_axis``/``seq_bucket_sizes``/``seq_pad_value``:
+    the same policy for the SEQUENCE axis (serving variable-length
+    prompts with O(log max_len) compiles). Exact for causal models
+    (right-padding cannot influence real positions); non-causal
+    functions must consume a mask themselves. ``full_graph=False``:
+    data-dependent Python branches run as compiled segments around the
+    break (jit/segment.py) instead of erroring."""
     extra = dict(bucket_batch=bucket_batch, bucket_sizes=bucket_sizes,
+                 bucket_seq=bucket_seq, seq_axis=seq_axis,
+                 seq_bucket_sizes=seq_bucket_sizes,
+                 seq_pad_value=seq_pad_value,
                  full_graph=full_graph)
 
     def decorate(obj):
