@@ -79,6 +79,12 @@ class NativeArrayFeeder:
         self._arrays = arrays          # keep alive: C++ reads in place
         self._batch = int(batch_size)
         self._drop_last = drop_last
+        if int(epochs) < 1:
+            # epochs=0 means "endless" to the C++ pipeline but __len__/
+            # __iter__ are finite — workers would keep prefetching into
+            # the ring after iteration stopped
+            raise ValueError(
+                f"NativeArrayFeeder: epochs must be >= 1, got {epochs}")
         self._epochs = int(epochs)
         lib = _lib()
         srcs = (ctypes.c_void_p * len(arrays))(
@@ -97,7 +103,7 @@ class NativeArrayFeeder:
     def __len__(self):
         per = self._n // self._batch if self._drop_last else \
             -(-self._n // self._batch)
-        return per * max(self._epochs, 1)
+        return per * self._epochs
 
     def __iter__(self):
         if getattr(self, "_consumed", False):
@@ -141,6 +147,11 @@ def native_gather(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
     primitive; also the benchmark hook)."""
     src = np.ascontiguousarray(src)
     idx = np.ascontiguousarray(indices, np.uint64)
+    if idx.size and int(idx.max()) >= src.shape[0]:
+        # the C++ gather trusts its indices (raw memcpy) — bound them here
+        raise IndexError(
+            f"native_gather: index {int(idx.max())} out of range for "
+            f"{src.shape[0]} rows")
     out = np.empty((len(idx),) + src.shape[1:], src.dtype)
     _lib().df_gather(
         src.ctypes.data_as(ctypes.c_void_p),

@@ -391,7 +391,7 @@ class StaticFunction:
         from .segment import SegmentCaptureError
         try:
             return self._segmented(args, kwargs)
-        except SegmentCaptureError:
+        except SegmentCaptureError as e:
             # recorder/replay-internal failure degrades to eager; the
             # user's own exceptions propagate (re-running fn here would
             # double-execute its side effects)
@@ -399,8 +399,8 @@ class StaticFunction:
 
             warnings.warn(
                 "to_static: segmented capture failed for "
-                f"{getattr(self._fn, '__name__', self._fn)}; this input "
-                "signature now runs eagerly", stacklevel=2)
+                f"{getattr(self._fn, '__name__', self._fn)} ({e}); this "
+                "input signature now runs eagerly", stacklevel=2)
             self._segmented_keys.discard(key)
             self._eager_keys.add(key)
             return self._fn(*args, **kwargs)

@@ -24,9 +24,10 @@ thing, redesigned for the TPU stack:
   tracing — and only re-record on an unseen branch outcome. Float
   guards match by exact value (a concretized float may steer Python
   arbitrarily, so value identity is the only sound guard); bool guards
-  (``if (x > 0):``) give the classic two-way cache. The tree is capped
-  so a pathological continuous guard degrades to per-call recording,
-  never unbounded memory.
+  (``if (x > 0):``) give the classic two-way cache. The tree is capped:
+  a pathological continuous guard saturates it and the signature is
+  pinned back to plain eager by the api layer (never unbounded memory,
+  never perpetual per-call re-recording).
 
 Engages only while grads are off (like batch bucketing: the recorder
 does not tape; training paths keep the eager fallback).
@@ -344,6 +345,16 @@ class SegmentedFunction:
         from ..core import tensor as _ct
         from ..ops import _op as _opmod
 
+        if self._n_paths(sig) >= MAX_PATHS_PER_SIG:
+            # a continuous guard (e.g. ``float(loss)`` differing every
+            # call) would otherwise re-record per call forever — strictly
+            # slower than plain eager. Raising BEFORE fn runs is safe
+            # (no side effects yet); the api layer pins this signature
+            # into its eager set.
+            raise SegmentCaptureError(
+                f"guard tree saturated ({MAX_PATHS_PER_SIG} paths) — a "
+                "continuous guard value is defeating the cache; this "
+                "signature degrades to eager")
         STATS["recordings"] += 1
         rec = _Recorder(self, sig)
         try:
@@ -363,8 +374,7 @@ class SegmentedFunction:
             _opmod.set_segment_program(prev_prog)
         try:
             tree, entries = rec.finalize(out)
-            if self._n_paths(sig) < MAX_PATHS_PER_SIG:
-                rec.graft()
+            rec.graft()
             leaves = [_leaf_value(e, rec.env) for e in entries]
             return jax.tree_util.tree_unflatten(tree, leaves)
         except SegmentCaptureError:

@@ -95,27 +95,23 @@ def _make_rms_dispatch(tpu_only: bool):
 
 
 def dispatched_fused_ce(x, head, labels, *, vocab_chunk=4096,
-                        reduction="mean"):
+                        reduction="mean", ignore_index=-100):
     """Blockwise CE with the same counter discipline as flash/rms: the
     trace records whether the memory-efficient path engaged, and an
     unsupported shape falls back to the materialising xent (identical
-    math) instead of erroring. Works on every backend (it is pure
-    jnp/lax, not pallas), so there is no tpu_only gate."""
+    math, including ignore_index masking and valid-count mean) instead
+    of erroring. Works on every backend (it is pure jnp/lax, not
+    pallas), so there is no tpu_only gate."""
     if _fce.supported(x, head, labels):
         _DISPATCH_STATS["fused_ce"] += 1
         return _fce.fused_cross_entropy(
-            x, head, labels, vocab_chunk=vocab_chunk, reduction=reduction)
+            x, head, labels, vocab_chunk=vocab_chunk, reduction=reduction,
+            ignore_index=ignore_index)
     _DISPATCH_STATS["fused_ce_fallback"] += 1
     logits = jnp.einsum("...d,vd->...v", x, head,
                         preferred_element_type=jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    per_tok = logz - gold
-    if reduction == "mean":
-        return jnp.mean(per_tok)
-    if reduction == "sum":
-        return jnp.sum(per_tok)
-    return per_tok
+    return _fce.masked_xent_from_logits(
+        logits, labels, ignore_index=ignore_index, reduction=reduction)
 
 
 def register(flash: bool = True, rms: bool = True, tpu_only: bool = False):

@@ -36,10 +36,31 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["fused_cross_entropy", "supported"]
+__all__ = ["fused_cross_entropy", "masked_xent_from_logits", "supported"]
 
 _NEG = -1e30   # large-negative instead of -inf: keeps XLA's max/exp exact
                # for masked lanes without generating inf-inf = nan paths
+
+
+def masked_xent_from_logits(logits, labels, *, ignore_index: int = -100,
+                            reduction: str = "mean"):
+    """Materialising xent with the SAME ignore_index semantics as the
+    blockwise kernel: ignored / out-of-range labels contribute zero loss
+    (and zero gradient), ``mean`` divides by the valid count. The one
+    shared definition for every logits-in-HBM call site (dispatcher
+    fallback, multi-device llama loss) so the semantics cannot diverge."""
+    v = logits.shape[-1]
+    valid = (labels != ignore_index) & (labels >= 0) & (labels < v)
+    safe = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    per = jnp.where(valid, logz - gold, 0.0)
+    if reduction == "mean":
+        return jnp.sum(per) / jnp.maximum(
+            jnp.sum(valid.astype(per.dtype)), 1.0)
+    if reduction == "sum":
+        return jnp.sum(per)
+    return per
 
 
 def supported(x, head, labels) -> bool:
@@ -131,9 +152,16 @@ _blockwise_ce.defvjp(_blockwise_ce_fwd, _blockwise_ce_bwd)
 
 
 def fused_cross_entropy(x, head, labels, *, vocab_chunk: int = 4096,
-                        reduction: str = "mean"):
+                        reduction: str = "mean", ignore_index: int = -100):
     """Softmax cross-entropy of ``x @ head.T`` against integer ``labels``
     without materialising the logits.
+
+    Labels equal to ``ignore_index`` — or out of ``[0, V)`` entirely —
+    contribute zero loss and zero gradient, and ``reduction="mean"``
+    divides by the number of VALID tokens (the reference
+    ``F.cross_entropy`` ignore_index semantics, loss.py). Without this,
+    the common -100 padding convention would gather a masked-lane
+    ``-1e30`` gold logit and silently poison the mean with ~1e30.
 
     Args:
       x: [..., D] hidden states (any float dtype; matmuls accumulate f32).
@@ -141,6 +169,7 @@ def fused_cross_entropy(x, head, labels, *, vocab_chunk: int = 4096,
       labels: integer [...] gold class ids.
       vocab_chunk: vocab tile size (static; tail chunk masked).
       reduction: "mean" | "sum" | "none".
+      ignore_index: label value to exclude from loss and gradient.
     """
     if not jnp.issubdtype(jnp.asarray(labels).dtype, jnp.integer):
         # the materialising path's take_along_axis would reject float
@@ -153,10 +182,16 @@ def fused_cross_entropy(x, head, labels, *, vocab_chunk: int = 4096,
         n *= s
     xf = x.reshape(n, x.shape[-1])
     lf = labels.reshape(n).astype(jnp.int32)
+    valid = (lf != ignore_index) & (lf >= 0) & (lf < head.shape[0])
     headc, valid_v = _pad_head(head, min(vocab_chunk, head.shape[0]))
-    loss = _blockwise_ce(xf, headc, lf, valid_v)
+    # invalid rows still compute a (finite) loss against class 0; the
+    # where() zeroes both their loss and — through its vjp — their g,
+    # so the bwd scan's d_logits rows vanish for them
+    loss = _blockwise_ce(xf, headc, jnp.where(valid, lf, 0), valid_v)
+    loss = jnp.where(valid, loss, 0.0)
     if reduction == "mean":
-        return jnp.mean(loss)
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(loss.dtype)), 1.0)
     if reduction == "sum":
         return jnp.sum(loss)
     return loss.reshape(labels.shape)

@@ -245,9 +245,10 @@ def loss_fn(params, batch, config: LlamaConfig, *, sp: bool = False,
         return dispatched_fused_ce(x, _head(params, c), labels,
                                    vocab_chunk=c.fused_ce_chunk)
     logits = forward(params, inp, c, sp=sp, mesh=mesh)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    # identical ignore_index masking to the fused path (one shared
+    # definition — padded labels zero out, mean over valid tokens)
+    from ..kernels.fused_ce import masked_xent_from_logits
+    return masked_xent_from_logits(logits, labels)
 
 
 def param_specs(config: LlamaConfig) -> Dict[str, Any]:

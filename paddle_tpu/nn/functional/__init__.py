@@ -66,8 +66,8 @@ def sdp_kernel(enable_math=True, enable_flash=True,
     nn/functional/flash_attention.py sdp_kernel — there it toggles the
     cuDNN/flash backends). Here flash means the Pallas kernel: disabling
     it unregisters the flash dispatcher within the scope."""
-    from ... import kernels
     from . import attention as _att
+    prev = _att._FLASH_IMPL
     try:
         if not enable_flash:
             # actually remove the flash dispatcher so the scope runs the
@@ -76,5 +76,8 @@ def sdp_kernel(enable_math=True, enable_flash=True,
             _att.register_flash_impl(None)
         yield
     finally:
+        # restore whatever was installed on entry verbatim — a
+        # tpu_only=False registration (interpret-mode tests) or a
+        # deliberately-unregistered state must survive the scope
         if not enable_flash:
-            kernels.register(flash=True, rms=False, tpu_only=True)
+            _att.register_flash_impl(prev)

@@ -425,6 +425,13 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
 
 
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
-    base = learning_rate if isinstance(learning_rate, float)         else getattr(learning_rate, "base_lr", end_lr)
+    if isinstance(learning_rate, (int, float)):
+        base = float(learning_rate)
+    else:
+        base = getattr(learning_rate, "base_lr", None)
+        if base is None:
+            raise TypeError(
+                "linear_lr_warmup: learning_rate must be a number or an "
+                f"LRScheduler with base_lr, got {type(learning_rate).__name__}")
     return LinearWarmup(learning_rate=base, warmup_steps=warmup_steps,
                         start_lr=start_lr, end_lr=end_lr)

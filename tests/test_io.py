@@ -360,3 +360,25 @@ class TestNativeDataFeed:
         idx = rng.integers(0, 20000, 4096).astype(np.uint64)
         got = native_gather(src, idx)
         np.testing.assert_array_equal(got, src[idx])
+
+
+def test_native_feeder_rejects_bad_epochs():
+    # the epochs check fires before the C++ lib is touched — no skip
+    from paddle_tpu.io import native_feed as nf
+    import numpy as np
+    import pytest
+    a = np.arange(12, dtype=np.float32).reshape(6, 2)
+    with pytest.raises(ValueError, match="epochs"):
+        nf.NativeArrayFeeder([a], batch_size=2, epochs=0)
+
+
+def test_native_gather_bounds_checked():
+    from paddle_tpu.io import native_feed as nf
+    if not nf.native_available():
+        import pytest
+        pytest.skip("native datafeed lib unavailable")
+    import numpy as np
+    import pytest
+    a = np.arange(12, dtype=np.float32).reshape(6, 2)
+    with pytest.raises(IndexError, match="out of range"):
+        nf.native_gather(a, np.array([0, 6], dtype=np.uint64))

@@ -550,3 +550,28 @@ class TestVarlenAttention:
             F.flash_attn_unpadded(q, q, q, cu, cu, dropout=0.1)
         with pytest.raises(NotImplementedError, match="softmax"):
             F.flash_attn_unpadded(q, q, q, cu, cu, return_softmax=True)
+
+
+class TestSdpKernelRestore:
+    """ADVICE-r4: sdp_kernel(enable_flash=False) must restore the exact
+    dispatcher installed on entry, not clobber it with a fresh
+    tpu_only=True registration."""
+
+    def test_restores_prior_impl_verbatim(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.nn.functional import attention as att
+
+        prev = att._FLASH_IMPL
+        try:
+            sentinel = lambda *a, **k: None
+            att.register_flash_impl(sentinel)
+            with F.sdp_kernel(enable_flash=False):
+                assert att._FLASH_IMPL is None
+            assert att._FLASH_IMPL is sentinel
+            # deliberately-unregistered state also survives
+            att.register_flash_impl(None)
+            with F.sdp_kernel(enable_flash=False):
+                pass
+            assert att._FLASH_IMPL is None
+        finally:
+            att.register_flash_impl(prev)

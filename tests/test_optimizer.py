@@ -265,3 +265,14 @@ class TestLRParityFixes:
         loss.backward()
         o.step()
         np.testing.assert_allclose(p.numpy(), [1.5, -1.5], rtol=1e-6)
+
+
+def test_linear_lr_warmup_accepts_int_and_rejects_junk():
+    import pytest
+    from paddle_tpu.optimizer import lr as lr_mod
+    s = lr_mod.linear_lr_warmup(1, warmup_steps=4, start_lr=0.0, end_lr=0.5)
+    for _ in range(5):
+        s.step()
+    assert abs(s.get_lr() - 1.0) < 1e-9   # post-warmup base is the int 1
+    with pytest.raises(TypeError, match="linear_lr_warmup"):
+        lr_mod.linear_lr_warmup(object(), 4, 0.0, 0.5)

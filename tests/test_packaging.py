@@ -26,10 +26,14 @@ class TestWheel:
         subprocess.run([sys.executable, "-m", "venv", str(venv)],
                        check=True, timeout=300)
         vpy = venv / "bin" / "python"
+        # PYTHONPATH="" + --force-reinstall: with the repo on the
+        # inherited PYTHONPATH (plus its egg-info), pip would see
+        # "paddle-tpu already installed" and silently skip the wheel
         r = subprocess.run(
             [str(vpy), "-m", "pip", "install", "--no-deps", "--no-index",
-             str(wheels[0])],
-            capture_output=True, text=True, timeout=300)
+             "--force-reinstall", str(wheels[0])],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, PYTHONPATH=""))
         assert r.returncode == 0, r.stderr[-3000:]
 
         # deps (jax, numpy) are baked into the outer environment, not on

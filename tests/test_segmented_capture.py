@@ -205,3 +205,42 @@ class TestSegmentedCorrectnessHardening:
         np.testing.assert_allclose(np.asarray(out_a2.numpy()),
                                    np.asarray(f(_mk(2.0)).numpy()),
                                    rtol=1e-6)
+
+
+class TestGuardSaturation:
+    """ADVICE-r4: a continuous float guard must not degrade to per-call
+    re-recording forever — at MAX_PATHS_PER_SIG the signature is pinned
+    back to plain eager (strictly faster than symbolize+replay)."""
+
+    def test_continuous_guard_pins_eager(self, monkeypatch):
+        monkeypatch.setattr(segment, "MAX_PATHS_PER_SIG", 3)
+        calls = {"n": 0}
+
+        def f(x):
+            calls["n"] += 1
+            s = float(paddle.exp(x).sum())   # differs every call
+            return x * s
+
+        g = pjit.to_static(f, full_graph=False)
+        segment.reset_stats()
+        outs = []
+        with paddle.no_grad():
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for i in range(6):
+                    outs.append(g(_mk(0.1 * (i + 1))))
+        # 3 recordings fill the tree; the 4th call saturates -> eager
+        assert segment.STATS["recordings"] == 3, segment.STATS
+        # correctness never wavers, cached or eager
+        for i, o in enumerate(outs):
+            want = f(_mk(0.1 * (i + 1)))
+            np.testing.assert_allclose(np.asarray(o.numpy()),
+                                       np.asarray(want.numpy()),
+                                       rtol=1e-6)
+        # once pinned, calls go straight to fn (no recorder involvement)
+        n_before = calls["n"]
+        with paddle.no_grad():
+            g(_mk(9.9))
+        assert calls["n"] == n_before + 1
+        assert segment.STATS["recordings"] == 3
