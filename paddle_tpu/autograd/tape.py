@@ -284,7 +284,23 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
 
     # Finalize leaves: fire hooks once on the summed grad, then write .grad
     # (or the sink in paddle.grad mode).
+    from ..core.selected_rows import SelectedRows, SelectedRowsGrad
     for t, acc in leaf_buffer.values():
+        if isinstance(acc, SelectedRows):
+            # row-sparse path (sparse embedding backward). Only the plain
+            # ``loss.backward() -> param.grad`` hot path stays sparse;
+            # hooks and paddle.grad sinks see the dense grad they were
+            # written for (they pay the densify they always paid).
+            if not t._hooks and sink is None and not create_graph:
+                if t.grad is None:
+                    t.grad = SelectedRowsGrad(acc)
+                elif (isinstance(t.grad, SelectedRowsGrad)
+                        and t.grad.is_selected_rows()):
+                    t.grad = SelectedRowsGrad(t.grad.sr + acc)
+                else:
+                    t.grad._data = t.grad._data + acc.to_dense_array()
+                continue
+            acc = acc.to_dense_array()
         gt = acc if create_graph else Tensor(acc)
         if t._hooks:
             for hook in t._hooks:
@@ -323,4 +339,22 @@ def _buffer_leaf(leaf_buffer: dict, t: Tensor, g):
     if entry is None:
         leaf_buffer[id(t)] = [t, g]
     else:
-        entry[1] = entry[1] + g
+        entry[1] = _accum_grad(entry[1], g)
+
+
+def _accum_grad(a, b):
+    """a + b where either side may be a SelectedRows (row-sparse
+    contribution): sparse+sparse concatenates (O(1), coalesced later by
+    the consumer); a mixed pair densifies the sparse side — a jnp array
+    cannot dispatch __radd__ to a foreign object, so the branch is
+    explicit here."""
+    from ..core.selected_rows import SelectedRows
+    a_sp = isinstance(a, SelectedRows)
+    b_sp = isinstance(b, SelectedRows)
+    if a_sp and b_sp:
+        return a + b
+    if a_sp:
+        return a.to_dense_array() + b
+    if b_sp:
+        return a + b.to_dense_array()
+    return a + b

@@ -1,0 +1,198 @@
+"""Row-sparse gradients — the SelectedRows equivalent.
+
+Reference capability: paddle/phi/core/selected_rows.h:1 (a {rows, value}
+pair standing in for a mostly-zero dense tensor), the lookup-table grad
+kernels that emit it (paddle/phi/kernels/cpu/embedding_grad_kernel.cc,
+embedding_sparse_grad_kernel.cc), and the sparse-aware optimizer kernels
+that consume it (adam lazy_mode, the SGD/momentum SelectedRows
+overloads in paddle/phi/kernels/selected_rows/).
+
+TPU-native redesign — NOT a new runtime tensor type. Inside jit/GSPMD
+everything stays dense: XLA's scatter fusion is already the right
+answer for compiled embedding backward, and a custom type can't cross
+the StableHLO boundary anyway. ``SelectedRows`` lives purely at the
+EAGER TAPE level, where the dense alternative is real waste: an
+embedding backward otherwise materialises a [V, D] grad per step
+(V=128k, D=4096 ⇒ 2 GB f32 of HBM traffic) to carry information about
+a few thousand touched rows. Here:
+
+- the sparse embedding backward emits ``SelectedRows(rows, values)``
+  with O(tokens·D) memory;
+- tape accumulation concatenates (O(1) metadata, no densify);
+- ``coalesce()`` merges duplicate ids by segment-sum (sort-free, via a
+  one-hot-free ``.at[].add``) so optimizers see unique rows;
+- optimizers apply O(touched-rows) ``.at[rows]`` updates to param and
+  moments (optimizer.py ``_update_sparse``).
+
+``SelectedRowsGrad`` is the ``param.grad`` facade: a Tensor subclass
+whose dense payload is materialised lazily, so any consumer that was
+written for dense grads (``grad._data``, ``.numpy()``) keeps working —
+it just pays the densify it would always have paid — while
+sparse-aware consumers check ``is_selected_rows()`` first and never
+materialise [V, D].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["SelectedRows", "SelectedRowsGrad"]
+
+
+class SelectedRows:
+    """rows [N] int32 (duplicates allowed until coalesce), values
+    [N, *tail], dense_shape (V, *tail). Semantically the dense tensor
+    ``zeros(dense_shape).at[rows].add(values)``."""
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape):
+        self.rows = rows
+        self.values = values
+        self.dense_shape = tuple(dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.rows.nbytes) + int(self.values.nbytes)
+
+    def to_dense_array(self):
+        dense = jnp.zeros(self.dense_shape, self.values.dtype)
+        # "drop": sentinel rows from coalesce() (== dense_shape[0]) are
+        # discarded rather than clipped onto the last real row
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def coalesce(self) -> "SelectedRows":
+        """Merge duplicate row ids by on-device segment-sum — no host
+        transfer, no dynamic shapes, so it never syncs the dispatch
+        queue (this runs inside every optimizer.step()).
+
+        Returns same-length arrays where slot j < n_unique holds
+        (unique_row_j, summed_values_j) and the remaining slots hold the
+        SENTINEL row id ``dense_shape[0]`` with zero values. The
+        sentinel is one-past-the-end on purpose: gathers clip it to the
+        last row (producing garbage that is then discarded) and
+        ``mode="drop"`` scatters ignore it, so consumers touch exactly
+        the unique rows. When enumerating rows of a coalesced result,
+        filter with ``rows < dense_shape[0]``."""
+        n = int(self.rows.shape[0])
+        if n <= 1:
+            return self
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.values[order]
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(is_start) - 1          # segment index per slot
+        summed = jnp.zeros_like(v).at[seg].add(v)
+        # every slot of a segment writes the SAME row id -> deterministic
+        rows_out = jnp.full((n,), self.dense_shape[0],
+                            self.rows.dtype).at[seg].set(r)
+        return SelectedRows(rows_out, summed, self.dense_shape)
+
+    def with_values(self, values) -> "SelectedRows":
+        return SelectedRows(self.rows, values, self.dense_shape)
+
+    # tape accumulation: SR + SR concatenates; SR + dense densifies.
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.dense_shape != self.dense_shape:
+                raise ValueError(
+                    f"SelectedRows shape mismatch: {self.dense_shape} vs "
+                    f"{other.dense_shape}")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        return self.to_dense_array() + other
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape}, dtype={self.dtype})")
+
+
+class SelectedRowsGrad(Tensor):
+    """The ``param.grad`` produced by a sparse embedding backward.
+
+    Duck-types as a dense Tensor: the first dense-style access
+    (``_data``, ``numpy()``, arithmetic) materialises the dense grad
+    and PERMANENTLY degrades the object to dense (``is_selected_rows()``
+    flips to False) — so a mixed pipeline cannot observe a stale sparse
+    payload after something scaled or rewrote the dense view.
+    Sparse-aware consumers (optimizer.step) branch on
+    ``is_selected_rows()`` and read ``.sr`` without ever densifying.
+    """
+
+    __slots__ = ("_sr", "_dense")
+
+    def __init__(self, sr: SelectedRows):
+        # Tensor.__init__ would route through the _data property and
+        # clobber the sparse payload — initialise the slots directly.
+        self._sr = sr
+        self._dense = None
+        self.stop_gradient = True
+        self.grad = None
+        self.name = None
+        self.persistable = False
+        self._grad_node = None
+        self._output_slot = 0
+        self._hooks = None
+        self._placements = None
+        self._process_mesh = None
+        self._symbolic = None
+
+    # shadows the Tensor._data slot: lazy densify-on-first-touch
+    @property
+    def _data(self):
+        if self._dense is None:
+            self._dense = self._sr.to_dense_array()
+            self._sr = None
+        return self._dense
+
+    @_data.setter
+    def _data(self, v):
+        self._dense = v
+        self._sr = None
+
+    def is_selected_rows(self) -> bool:
+        return self._sr is not None
+
+    @property
+    def sr(self) -> SelectedRows:
+        if self._sr is None:
+            raise RuntimeError(
+                "this grad was densified (a dense-style access degraded "
+                "it); the sparse payload is gone")
+        return self._sr
+
+    # metadata without densifying
+    @property
+    def shape(self):
+        if self._sr is not None:
+            return list(self._sr.dense_shape)
+        return list(self._dense.shape)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return self._sr.dtype if self._sr is not None else self._dense.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def __repr__(self):
+        if self._sr is not None:
+            return f"SelectedRowsGrad({self._sr!r})"
+        return super().__repr__()

@@ -193,9 +193,21 @@ class Tensor:
     def clear_grad(self):
         self.grad = None
 
+    def is_selected_rows(self) -> bool:
+        """True when this tensor is a row-sparse gradient (SelectedRows
+        equivalent, core/selected_rows.py). Reference:
+        paddle/phi/core/selected_rows.h."""
+        return False
+
     def clear_gradient(self, set_to_zero: bool = False):
         if set_to_zero and self.grad is not None:
-            self.grad._data = jnp.zeros_like(self.grad._data)
+            if self.grad.is_selected_rows():
+                # zeroing a row-sparse grad = an empty SelectedRows; the
+                # next backward rebuilds it, so just drop it (densifying
+                # [V, D] zeros here would defeat the representation)
+                self.grad = None
+            else:
+                self.grad._data = jnp.zeros_like(self.grad._data)
         else:
             self.grad = None
 

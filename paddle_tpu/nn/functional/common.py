@@ -34,15 +34,88 @@ def linear(x, weight, bias=None):
     return y
 
 
-@op_fn(nondiff_args=(0,))
-def embedding(ids, weight, *, padding_idx: Optional[int] = None,
-              sparse: bool = False):
-    del sparse  # gather is dense on TPU; SelectedRows grads have no analogue
+@op_fn(name="embedding", nondiff_args=(0,))
+def _embedding_dense(ids, weight, *, padding_idx: Optional[int] = None):
     out = jnp.take(weight, ids, axis=0)
     if padding_idx is not None:
         mask = (ids == padding_idx)[..., None]
         out = jnp.where(mask, 0.0, out)
     return out
+
+
+def embedding(ids, weight, *, padding_idx: Optional[int] = None,
+              sparse: bool = False):
+    """``sparse=True`` emits a row-sparse (SelectedRows-equivalent) grad
+    for ``weight`` on the eager tape: O(tokens·D) instead of a dense
+    [V, D] scatter per step (reference:
+    paddle/phi/kernels/cpu/embedding_sparse_grad_kernel.cc). Engages
+    only in plain eager mode with a LEAF weight — under jit / static
+    capture / segmented capture, or when weight is itself an op output
+    (its cotangent would have to enter a jax.vjp), the dense path runs:
+    XLA's fused scatter is the right compiled answer there."""
+    if sparse and _sparse_grad_applicable(ids, weight):
+        return _embedding_sparse_eager(ids, weight, padding_idx)
+    return _embedding_dense(ids, weight, padding_idx=padding_idx)
+
+
+def _sparse_grad_applicable(ids, weight) -> bool:
+    from ...amp.auto_cast import _amp as _amp_state
+    from ...amp.auto_cast import current_cast_dtype_for
+    from ...core import state as _state
+    from ...core.tensor import is_tracer
+    from ...ops import _op as _opmod
+    if not (isinstance(weight, Tensor) and isinstance(ids, Tensor)):
+        return False
+    if weight.stop_gradient or not _state.grad_enabled():
+        return False          # no grad at all — dense path, same result
+    if weight._grad_node is not None:
+        return False          # non-leaf weight: cotangent feeds a vjp
+    if _amp_state.enabled and current_cast_dtype_for("embedding"):
+        return False          # AMP-listed: only op_fn has the cast seam
+    if _opmod._SEGMENT_PROGRAM is not None:
+        return False          # segmented capture records dense ops
+    if weight._symbolic is not None or ids._symbolic is not None:
+        return False          # static Program build
+    if is_tracer(weight._data) or is_tracer(ids._data):
+        return False          # inside jit tracing
+    return True
+
+
+def _embedding_sparse_eager(ids_t, weight_t, padding_idx):
+    from ...autograd import tape
+    from ...core.flags import flag_value
+    from ...core.selected_rows import SelectedRows
+    from ...ops import _op as _opmod
+
+    ids = ids_t._data
+    w = weight_t._data
+    pure = _embedding_dense.pure_fn      # one definition of the math
+    ph = _opmod._PROFILE_HOOK
+    if ph is not None:
+        ph[0]("embedding_sparse")
+    try:
+        out = pure(ids, w, padding_idx=padding_idx)
+    finally:
+        if ph is not None:
+            ph[1]()
+    if flag_value("check_nan_inf"):
+        _opmod._check_nan_inf("embedding_sparse", out)
+    out_t = wrap(out)
+    tail = w.shape[1:]
+    dense_shape = w.shape
+
+    def vjp_fn(cot):
+        flat_ids = ids.reshape(-1).astype(jnp.int32)
+        vals = cot.reshape((-1,) + tail)
+        if padding_idx is not None:
+            vals = jnp.where((flat_ids == padding_idx)[:, None], 0.0, vals)
+        return (SelectedRows(flat_ids, vals, dense_shape),)
+
+    node = tape.record_node("embedding_sparse", vjp_fn, [weight_t], [out_t])
+    # create_graph / double-backward re-differentiates through the DENSE
+    # pure fn (the sparse vjp is a leaf-grad fast path, not new math)
+    node.pure_spec = (pure, {"padding_idx": padding_idx}, (1,), {0: ids}, 2)
+    return out_t
 
 
 @op_fn(differentiable=False)

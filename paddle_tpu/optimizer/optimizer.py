@@ -143,14 +143,33 @@ class Optimizer:
 
     # -- step ----------------------------------------------------------------
     def step(self):
+        from ..core.selected_rows import SelectedRows
+
         params = [p for p in (self._parameter_list or [])
                   if not p.stop_gradient and p.grad is not None]
         if not params:
             self._global_step += 1
             return
-        grads = [p.grad._data for p in params]
+        # Row-sparse grads (SelectedRows equivalent — sparse embedding
+        # backward) stay sparse through clip and update: coalesce gives
+        # unique rows, so norms over .values equal norms over the dense
+        # grad, and _update_sparse touches O(unique rows) of param/state
+        # (reference: adam lazy_mode + phi/kernels/selected_rows/).
+        grads = [p.grad.sr.coalesce()
+                 if p.grad.is_selected_rows() else p.grad._data
+                 for p in params]
+        if (isinstance(self._grad_clip, ClipGradByValue)
+                and (self._grad_clip.min > 0 or self._grad_clip.max < 0)):
+            # a clip range excluding 0 clamps the implicit zero rows too
+            # — only the dense path can express that
+            grads = [g.to_dense_array() if isinstance(g, SelectedRows)
+                     else g for g in grads]
         if self._grad_clip is not None:
-            grads = self._grad_clip._clip(grads)
+            arrs = [g.values if isinstance(g, SelectedRows) else g
+                    for g in grads]
+            arrs = self._grad_clip._clip(arrs)
+            grads = [g.with_values(a) if isinstance(g, SelectedRows) else a
+                     for g, a in zip(grads, arrs)]
         lr = self.get_lr()
         self._global_step += 1
         step = self._global_step
@@ -163,6 +182,10 @@ class Optimizer:
             st = self._ensure_state(p)
             self._current_param = p
             use_wd = wd if self._use_decay_for(p) else 0.0
+            if isinstance(g, SelectedRows):
+                if self._step_sparse(p, g, st, lr, step, use_wd, is_l1):
+                    continue
+                g = g.to_dense_array()   # optimizer has no sparse rule
             if use_wd and not self._decoupled_wd():
                 # Coupled regularizer-gradient (reference: regularizer.py):
                 # L2 adds coeff*w, L1 adds coeff*sign(w) to the gradient.
@@ -188,6 +211,65 @@ class Optimizer:
 
     def _decoupled_wd(self) -> bool:
         return False
+
+    # -- row-sparse (SelectedRows) update ------------------------------------
+    def _update_sparse(self, param, rows, vals, state, lr, step):
+        """Override to support updates from a row-sparse grad without
+        densifying it. Return (new_param, new_state), or None to make the
+        caller densify and use the dense rule (the always-correct
+        fallback)."""
+        return None
+
+    def _sparse_lazy(self) -> bool:
+        """True = updates (incl. decay) touch ONLY grad rows — the
+        reference's adam ``lazy_mode``. False (default) = state decay
+        spans all rows, making the result EXACTLY equal to the dense
+        update of the scattered grad; the dense [V, D] grad buffer is
+        still never materialised."""
+        return False
+
+    def _step_sparse(self, p, sr, st, lr, step, use_wd, is_l1) -> bool:
+        """Apply one coalesced SelectedRows grad (reference: the
+        phi/kernels/selected_rows/ optimizer kernel family). Coupled
+        regularization (L1/L2 added to the gradient) follows the rows in
+        BOTH modes — matching the reference, which regularizes the
+        SelectedRows gradient itself; decoupled (AdamW) decay follows
+        ``_sparse_lazy()``: all rows by default (dense parity), grad rows
+        only in lazy mode."""
+        if type(self)._update_sparse is Optimizer._update_sparse:
+            return False          # no sparse rule — skip the decay work
+        rows, vals = sr.rows, sr.values
+        if use_wd and not self._decoupled_wd():
+            pr = p._data[rows]
+            reg = jnp.sign(pr) if is_l1 else pr
+            vals = vals + use_wd * reg.astype(vals.dtype)
+        out = self._update_sparse(p._data, rows, vals, st,
+                                  jnp.float32(lr), step)
+        if out is None:
+            return False
+        new_p, new_st = out
+        if use_wd and self._decoupled_wd():
+            lazy = self._sparse_lazy()
+            master = new_st.get("master_weight")
+            if master is not None:
+                src = st.get("master_weight")
+                src = p._data.astype(jnp.float32) if src is None else src
+                if lazy:
+                    decayed = master[rows] - lr * use_wd * src[rows]
+                    master = master.at[rows].set(decayed, mode="drop")
+                else:
+                    master = master - lr * use_wd * src
+                new_st["master_weight"] = master
+                new_p = master.astype(new_p.dtype)
+            elif lazy:
+                new_p = new_p.at[rows].add(
+                    -(lr * use_wd * p._data[rows]).astype(new_p.dtype),
+                    mode="drop")
+            else:
+                new_p = new_p - (lr * use_wd * p._data).astype(new_p.dtype)
+        p._data = new_p.astype(p._data.dtype)
+        self._accumulators[id(p)] = new_st
+        return True
 
     def clear_grad(self, set_to_zero: bool = False):
         for p in self._parameter_list or []:
@@ -239,6 +321,11 @@ class SGD(Optimizer):
     def _update(self, param, grad, state, lr, step):
         return param - lr * grad, state
 
+    def _update_sparse(self, param, rows, vals, state, lr, step):
+        # phi/kernels/selected_rows/ sgd: scatter-subtract touched rows
+        return (param.at[rows].add((-lr * vals).astype(param.dtype),
+                                    mode="drop"), state)
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -259,6 +346,23 @@ class Momentum(Optimizer):
             new_p = param - lr * v
         return new_p, {"velocity": v}
 
+    def _update_sparse(self, param, rows, vals, state, lr, step):
+        # reference momentum SelectedRows kernel semantics: velocity
+        # decays on ALL rows (grad is zero off-rows), so the result is
+        # exactly the dense update — without a dense grad buffer
+        v = self._momentum * state["velocity"]
+        v = v.at[rows].add(vals, mode="drop")
+        if self._nesterov:
+            # dense rule is param - lr*(g + mu*v); g is zero off-rows,
+            # so split it: full-width mu*v term + rows-only g term (no
+            # dense scattered-grad buffer)
+            new_p = (param - (lr * self._momentum * v).astype(param.dtype)
+                     ).at[rows].add(-(lr * vals).astype(param.dtype),
+                                    mode="drop")
+        else:
+            new_p = param - (lr * v).astype(param.dtype)
+        return new_p, {"velocity": v}
+
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
@@ -275,6 +379,12 @@ class Adagrad(Optimizer):
         m = state["moment"] + jnp.square(grad)
         new_p = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
         return new_p, {"moment": m}
+
+    def _update_sparse(self, param, rows, vals, state, lr, step):
+        mr = state["moment"][rows] + jnp.square(vals)
+        upd = lr * vals / (jnp.sqrt(mr) + self._epsilon)
+        return (param.at[rows].add(-upd.astype(param.dtype), mode="drop"),
+                {"moment": state["moment"].at[rows].set(mr, mode="drop")})
 
 
 class RMSProp(Optimizer):
@@ -320,6 +430,13 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         self._amsgrad = amsgrad
         self._multi_precision = multi_precision
+        # lazy_mode only changes behaviour for row-sparse (SelectedRows)
+        # grads: moments/decay touch grad rows only (reference: adam
+        # lazy_mode docs — "only update the element that has gradient")
+        self._lazy = lazy_mode
+
+    def _sparse_lazy(self):
+        return self._lazy
 
     def _init_state(self, p):
         # multi_precision: keep a float32 master copy for bf16/fp16 params
@@ -353,6 +470,59 @@ class Adam(Optimizer):
             return new_w.astype(param.dtype), new_state
         return new_w, new_state
 
+    def _update_sparse(self, param, rows, vals, state, lr, step):
+        # reference: phi/kernels/selected_rows/adam_kernel. Two modes:
+        # lazy_mode=True — moments decay and update ONLY on touched rows
+        # (untouched rows' moments and params bit-identical after the
+        # step); default — moments decay everywhere with the grad
+        # contribution scattered at rows, which is EXACTLY the dense
+        # Adam update of the scattered grad (the [V, D] grad buffer is
+        # still never built).
+        g = vals.astype(jnp.float32)
+        if self._lazy:
+            m1r = self._beta1 * state["moment1"][rows] + \
+                (1 - self._beta1) * g
+            m2r = self._beta2 * state["moment2"][rows] + \
+                (1 - self._beta2) * jnp.square(g)
+            new_state = {"moment1": state["moment1"].at[rows].set(
+                             m1r, mode="drop"),
+                         "moment2": state["moment2"].at[rows].set(
+                             m2r, mode="drop")}
+            vr = m2r
+            if self._amsgrad:
+                vr = jnp.maximum(state["moment2_max"][rows], m2r)
+                new_state["moment2_max"] = \
+                    state["moment2_max"].at[rows].set(vr, mode="drop")
+            bc1 = 1 - self._beta1 ** step
+            bc2 = 1 - self._beta2 ** step
+            master = state.get("master_weight")
+            w_rows = (master if master is not None else param)[rows]
+            upd = lr * (m1r / bc1) / (jnp.sqrt(vr / bc2) + self._epsilon)
+            new_rows = w_rows.astype(jnp.float32) - upd
+            if master is not None:
+                new_state["master_weight"] = master.at[rows].set(
+                    new_rows, mode="drop")
+            return (param.at[rows].set(new_rows.astype(param.dtype),
+                                       mode="drop"), new_state)
+        m1 = (self._beta1 * state["moment1"]).at[rows].add(
+            (1 - self._beta1) * g, mode="drop")
+        m2 = (self._beta2 * state["moment2"]).at[rows].add(
+            (1 - self._beta2) * jnp.square(g), mode="drop")
+        new_state = {"moment1": m1, "moment2": m2}
+        v = m2
+        if self._amsgrad:
+            v = jnp.maximum(state["moment2_max"], m2)
+            new_state["moment2_max"] = v
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        master = state.get("master_weight")
+        w = master if master is not None else param
+        new_w = w - lr * (m1 / bc1) / (jnp.sqrt(v / bc2) + self._epsilon)
+        if master is not None:
+            new_state["master_weight"] = new_w
+            return new_w.astype(param.dtype), new_state
+        return new_w, new_state
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: optimizer/adamw.py)."""
@@ -363,7 +533,7 @@ class AdamW(Adam):
                  lazy_mode=False, multi_precision=False, amsgrad=False,
                  name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip,
+                         weight_decay, grad_clip, lazy_mode=lazy_mode,
                          multi_precision=multi_precision, amsgrad=amsgrad)
         self._apply_decay_param_fun = apply_decay_param_fun
 

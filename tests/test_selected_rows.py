@@ -1,0 +1,349 @@
+"""Row-sparse embedding gradients (SelectedRows equivalent) — VERDICT-r5
+item 3. Reference: paddle/phi/core/selected_rows.h + embedding sparse
+grad kernels + adam lazy_mode.
+
+Contract under test:
+- Embedding(sparse=True).backward produces param.grad with
+  is_selected_rows() True, holding O(tokens) rows/values — never a
+  dense [V, D] array.
+- coalesce() merges duplicate ids; semantics match the dense scatter.
+- Optimizers update O(unique rows): untouched param rows AND untouched
+  moment rows are bit-identical; training parity vs the dense path
+  (SGD exact; Adam vs a lazy-mode oracle).
+- Every dense-style consumer (hooks, clip utils, paddle.grad, exotic
+  optimizers) degrades to a correct dense grad.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core.selected_rows import SelectedRows, SelectedRowsGrad
+
+V, D = 50, 8
+
+
+def _emb(sparse=True, v=V, d=D, seed=0):
+    paddle.seed(seed)
+    return nn.Embedding(v, d, sparse=sparse)
+
+
+def _ids(*vals):
+    return paddle.to_tensor(np.asarray(vals, "int32"))
+
+
+class TestSparseBackward:
+    def test_grad_is_selected_rows_with_flat_ids(self):
+        e = _emb()
+        out = e(_ids(3, 7, 3, 11))
+        out.sum().backward()
+        g = e.weight.grad
+        assert isinstance(g, SelectedRowsGrad) and g.is_selected_rows()
+        assert list(g.shape) == [V, D]          # metadata, no densify
+        assert g.is_selected_rows()             # shape access kept it sparse
+        np.testing.assert_array_equal(np.sort(np.asarray(g.sr.rows)),
+                                      [3, 3, 7, 11])
+        assert g.sr.values.shape == (4, D)
+
+    def test_semantics_match_dense_path(self):
+        ids = np.array([[1, 4, 1], [4, 9, 0]], "int32")
+        es, ed = _emb(True), _emb(False)
+        ed.weight.set_value(np.asarray(es.weight.numpy()))
+        up = np.random.default_rng(0).normal(size=(2, 3, D)).astype("f4")
+        (es(paddle.to_tensor(ids)) * paddle.to_tensor(up)).sum().backward()
+        (ed(paddle.to_tensor(ids)) * paddle.to_tensor(up)).sum().backward()
+        assert es.weight.grad.is_selected_rows()
+        assert not ed.weight.grad.is_selected_rows()
+        np.testing.assert_allclose(
+            np.asarray(es.weight.grad.sr.to_dense_array()),
+            np.asarray(ed.weight.grad.numpy()), rtol=1e-6)
+
+    def test_padding_idx_rows_zeroed(self):
+        e = nn.Embedding(V, D, padding_idx=2, sparse=True)
+        e(_ids(2, 5)).sum().backward()
+        sr = e.weight.grad.sr.coalesce()
+        dense = np.asarray(sr.to_dense_array())
+        np.testing.assert_allclose(dense[2], np.zeros(D))
+        assert float(np.abs(dense[5]).sum()) > 0
+
+    def test_two_backwards_concatenate_then_coalesce(self):
+        e = _emb()
+        e(_ids(1, 2)).sum().backward()
+        e(_ids(2, 3)).sum().backward()
+        g = e.weight.grad
+        assert g.is_selected_rows() and g.sr.rows.shape[0] == 4
+        sr = g.sr.coalesce()
+        rows = np.asarray(sr.rows)
+        assert rows.shape[0] == 4               # static shape kept
+        assert (rows < V).sum() == 3            # {1, 2, 3} + one sentinel
+        assert set(rows[rows < V]) == {1, 2, 3}
+        dense = np.asarray(sr.to_dense_array())
+        np.testing.assert_allclose(dense[2], np.full(D, 2.0))
+
+    def test_memory_at_128k_vocab(self):
+        # the VERDICT memory assertion: grad payload is O(tokens·D),
+        # not O(V·D) — at 128k vocab the dense grad would be 32 MB f32
+        e = nn.Embedding(131072, 64, sparse=True)
+        ids = paddle.to_tensor(
+            np.random.default_rng(1).integers(0, 131072, 256).astype("i4"))
+        e(ids).sum().backward()
+        g = e.weight.grad
+        assert g.is_selected_rows()
+        dense_bytes = 131072 * 64 * 4
+        assert g.sr.nbytes < dense_bytes / 100, (g.sr.nbytes, dense_bytes)
+
+    def test_mixed_dense_use_falls_back_dense(self):
+        e = _emb()
+        loss = e(_ids(1, 2)).sum() + (e.weight * 2.0).sum()
+        loss.backward()
+        g = e.weight.grad
+        assert not g.is_selected_rows()          # mixed -> densified
+        dense = np.asarray(g.numpy())
+        np.testing.assert_allclose(dense[1], np.full(D, 3.0), rtol=1e-6)
+        np.testing.assert_allclose(dense[0], np.full(D, 2.0), rtol=1e-6)
+
+    def test_nonleaf_weight_uses_dense_path(self):
+        e = _emb()
+        w2 = e.weight * 1.0                      # op output, not a leaf
+        out = paddle.nn.functional.embedding(_ids(1), w2, sparse=True)
+        out.sum().backward()
+        assert not e.weight.grad.is_selected_rows()
+
+    def test_hook_sees_dense(self):
+        e = _emb()
+        seen = {}
+        e.weight.register_hook(lambda g: seen.setdefault(
+            "shape", list(g.shape)))
+        e(_ids(4)).sum().backward()
+        assert seen["shape"] == [V, D]
+        assert not e.weight.grad.is_selected_rows()
+
+    def test_paddle_grad_returns_dense(self):
+        e = _emb()
+        out = e(_ids(1, 1)).sum()
+        (g,) = paddle.grad([out], [e.weight])
+        assert not g.is_selected_rows()
+        np.testing.assert_allclose(np.asarray(g.numpy())[1],
+                                   np.full(D, 2.0), rtol=1e-6)
+
+    def test_under_no_grad_and_jit(self):
+        e = _emb()
+        with paddle.no_grad():
+            out = e(_ids(1))
+        assert out.shape == [1, D]
+        f = paddle.jit.to_static(lambda x: e(x).sum())
+        val = f(_ids(1, 2))
+        assert np.isfinite(float(val.numpy()))
+
+    def test_clear_grad_set_to_zero_drops_sparse(self):
+        e = _emb()
+        e(_ids(1)).sum().backward()
+        e.weight.clear_gradient(set_to_zero=True)
+        assert e.weight.grad is None
+
+
+class TestSparseOptimizers:
+    def _fit_pair(self, opt_cls, steps=3, **kw):
+        es, ed = _emb(True, seed=7), _emb(False, seed=7)
+        ed.weight.set_value(np.asarray(es.weight.numpy()))
+        os_, od = (opt_cls(parameters=[es.weight], **kw),
+                   opt_cls(parameters=[ed.weight], **kw))
+        rng = np.random.default_rng(0)
+        for _ in range(steps):
+            ids = paddle.to_tensor(rng.integers(0, V, 6).astype("i4"))
+            for e, o in ((es, os_), (ed, od)):
+                (e(ids) ** 2).sum().backward()
+                o.step()
+                o.clear_grad()
+        return es, ed
+
+    def test_sgd_exact_parity_and_untouched_rows(self):
+        es, ed = self._fit_pair(opt.SGD, learning_rate=0.1)
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()), rtol=1e-6)
+
+    def test_sgd_untouched_rows_bit_identical(self):
+        e = _emb()
+        before = np.asarray(e.weight.numpy()).copy()
+        o = opt.SGD(learning_rate=0.5, parameters=[e.weight])
+        (e(_ids(3, 9)) ** 2).sum().backward()
+        o.step()
+        after = np.asarray(e.weight.numpy())
+        touched = {3, 9}
+        for r in range(V):
+            if r in touched:
+                assert np.abs(after[r] - before[r]).max() > 0
+            else:
+                np.testing.assert_array_equal(after[r], before[r])
+
+    def test_adam_default_exact_dense_parity(self):
+        # lazy_mode=False (default): sparse grads give BIT-level dense
+        # Adam semantics — moments decay everywhere — while the dense
+        # [V, D] grad buffer never exists
+        es, ed = self._fit_pair(opt.Adam, steps=4, learning_rate=0.05)
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_adamw_default_exact_dense_parity(self):
+        es, ed = self._fit_pair(opt.AdamW, steps=4, learning_rate=0.05,
+                                weight_decay=0.1)
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_adam_lazy_oracle_and_moment_rows(self):
+        e = _emb(seed=3)
+        o = opt.Adam(learning_rate=0.1, lazy_mode=True,
+                     parameters=[e.weight])
+        w0 = np.asarray(e.weight.numpy()).astype("f8").copy()
+        (e(_ids(5, 5, 12)) ** 2).sum().backward()
+        sr = e.weight.grad.sr.coalesce()
+        g = np.zeros((V, D))
+        rows_np = np.asarray(sr.rows)
+        real = rows_np < V                       # drop sentinel slots
+        np.add.at(g, rows_np[real], np.asarray(sr.values, "f8")[real])
+        o.step()
+        after = np.asarray(e.weight.numpy())
+        st = o._accumulators[id(e.weight)]
+        m1, m2 = np.asarray(st["moment1"]), np.asarray(st["moment2"])
+        for r in range(V):
+            if r in (5, 12):
+                m1_o = 0.1 * g[r]
+                m2_o = 0.001 * g[r] ** 2
+                upd = 0.1 * (m1_o / 0.1) / (np.sqrt(m2_o / 0.001) + 1e-8)
+                np.testing.assert_allclose(after[r], w0[r] - upd, rtol=1e-4)
+                np.testing.assert_allclose(m1[r], m1_o, rtol=1e-4)
+            else:
+                np.testing.assert_array_equal(after[r], w0[r])
+                np.testing.assert_array_equal(m1[r], np.zeros(D))
+                np.testing.assert_array_equal(m2[r], np.zeros(D))
+
+    def test_adamw_lazy_decay_touched_rows_only(self):
+        e = _emb(seed=5)
+        before = np.asarray(e.weight.numpy()).copy()
+        o = opt.AdamW(learning_rate=0.01, weight_decay=0.5, lazy_mode=True,
+                      parameters=[e.weight])
+        (e(_ids(2)) ** 2).sum().backward()
+        o.step()
+        after = np.asarray(e.weight.numpy())
+        assert np.abs(after[2] - before[2]).max() > 0
+        np.testing.assert_array_equal(after[3], before[3])  # no decay leak
+
+    def test_adagrad_exact_parity(self):
+        # dense Adagrad's moment/update are zero wherever grad is zero,
+        # so lazy == dense exactly (unlike Momentum, where dense keeps
+        # applying stale velocity to untouched rows)
+        es, ed = self._fit_pair(opt.Adagrad, learning_rate=0.05)
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_momentum_exact_dense_parity(self):
+        # momentum is non-lazy (reference SelectedRows momentum kernel):
+        # velocity decays on all rows -> exact dense equivalence
+        es, ed = self._fit_pair(opt.Momentum, steps=3, learning_rate=0.05,
+                                momentum=0.9)
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_rmsprop_falls_back_densified(self):
+        es, ed = self._fit_pair(opt.RMSProp, learning_rate=0.05, rho=0.9)
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()), rtol=1e-6)
+
+    def test_global_norm_clip_mixed_sparse_dense(self):
+        es, dense_p = _emb(True, seed=9), None
+        lin = nn.Linear(D, 4)
+        clip = paddle.nn.ClipGradByGlobalNorm(0.01)
+        o = opt.SGD(learning_rate=1.0, grad_clip=clip,
+                    parameters=[es.weight] + list(lin.parameters()))
+        # all-dense twin
+        ed = _emb(False, seed=9)
+        lin2 = nn.Linear(D, 4)
+        for a, b in zip(lin2.parameters(), lin.parameters()):
+            a.set_value(np.asarray(b.numpy()))
+        o2 = opt.SGD(learning_rate=1.0,
+                     grad_clip=paddle.nn.ClipGradByGlobalNorm(0.01),
+                     parameters=[ed.weight] + list(lin2.parameters()))
+        ids = _ids(1, 2, 3)
+        (lin(es(ids)) ** 2).sum().backward()
+        (lin2(ed(ids)) ** 2).sum().backward()
+        assert es.weight.grad.is_selected_rows()
+        o.step()
+        o2.step()
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_nn_utils_clip_works_on_sparse_via_densify(self):
+        e = _emb()
+        (e(_ids(1, 2)) ** 2).sum().backward()
+        nn.utils.clip_grad_norm_([e.weight], 0.001)
+        assert not e.weight.grad.is_selected_rows()   # degraded, correct
+        assert float(np.linalg.norm(
+            np.asarray(e.weight.grad.numpy()))) <= 0.0011
+
+
+class TestSelectedRowsObject:
+    def test_coalesce_sums_duplicates(self):
+        sr = SelectedRows(jnp.asarray([4, 1, 4], jnp.int32),
+                          jnp.asarray([[1.], [2.], [3.]]), (6, 1))
+        c = sr.coalesce()
+        # static-shape device coalesce: unique rows first, sentinel
+        # (dense_shape[0]) pads the duplicate slots with zero values
+        np.testing.assert_array_equal(np.asarray(c.rows), [1, 4, 6])
+        np.testing.assert_allclose(np.asarray(c.values),
+                                   [[2.], [4.], [0.]])
+        np.testing.assert_allclose(np.asarray(c.to_dense_array()),
+                                   np.asarray(sr.to_dense_array()))
+
+    def test_coalesce_is_pure_device(self):
+        # must be jittable (static shapes, no host round-trip): the
+        # optimizer calls it every step
+        import jax
+
+        def f(rows, vals):
+            return SelectedRows(rows, vals, (6, 1)).coalesce().values
+
+        out = jax.jit(f)(jnp.asarray([4, 1, 4], jnp.int32),
+                         jnp.asarray([[1.], [2.], [3.]]))
+        np.testing.assert_allclose(np.asarray(out), [[2.], [4.], [0.]])
+
+    def test_double_backward_create_graph_densifies(self):
+        # create_graph routes the sparse node through its dense
+        # pure_spec: higher-order grads work, grads come back dense
+        e = _emb()
+        out = (e(_ids(1, 2)) ** 2).sum()
+        (g,) = paddle.grad([out], [e.weight], create_graph=True)
+        assert not g.is_selected_rows()
+        g2 = (g ** 2).sum()
+        (gg,) = paddle.grad([g2], [e.weight])
+        assert list(gg.shape) == [V, D]
+        assert np.isfinite(np.asarray(gg.numpy())).all()
+
+    def test_add_concat_and_shape_mismatch(self):
+        a = SelectedRows(jnp.asarray([0], jnp.int32),
+                         jnp.ones((1, 2)), (4, 2))
+        b = SelectedRows(jnp.asarray([3], jnp.int32),
+                         jnp.ones((1, 2)), (4, 2))
+        assert (a + b).rows.shape[0] == 2
+        c = SelectedRows(jnp.asarray([0], jnp.int32),
+                         jnp.ones((1, 2)), (5, 2))
+        with pytest.raises(ValueError, match="mismatch"):
+            a + c
+
+    def test_grad_facade_densify_degrades_permanently(self):
+        sr = SelectedRows(jnp.asarray([1], jnp.int32),
+                          jnp.ones((1, 3)), (4, 3))
+        g = SelectedRowsGrad(sr)
+        assert g.is_selected_rows()
+        arr = np.asarray(g.numpy())               # dense-style access
+        np.testing.assert_allclose(arr[1], np.ones(3))
+        assert not g.is_selected_rows()
+        with pytest.raises(RuntimeError, match="densified"):
+            g.sr
