@@ -356,35 +356,21 @@ class StaticFunction:
                 raise RuntimeError(str(e)) from e
             import warnings
 
-            from ..core import state
             # mixed capture (reference SOT, jit/sot/translate.py:30):
             # this signature now runs as compiled segments around the
-            # eager island whenever grads are off; grad-enabled calls
-            # run whole-call eager per call (the recorder does not
-            # tape) — the key is NOT pinned eager, so a later eval call
-            # still gets segmentation.
+            # eager island — in BOTH eval and training mode (taped
+            # slices carry cached vjps, segment.py call_taped).
             self._segmented_keys.add(key)
             self._programs.pop(key, None)
-            if not state.grad_enabled():
-                warnings.warn(
-                    "to_static: graph break in "
-                    f"{getattr(self._fn, '__name__', self._fn)} "
-                    "(data-dependent Python branch); this input "
-                    "signature runs as compiled segments around the "
-                    "branch (full_graph=False)", stacklevel=3)
-                return self.__segmented_call(key, args, kwargs)
             warnings.warn(
-                f"to_static: graph break in {getattr(self._fn, '__name__', self._fn)} "
-                "(data-dependent Python branch); this call runs eagerly "
-                "(full_graph=False; grads are enabled, and segmented "
-                "capture does not tape — no-grad calls of this "
-                "signature will run as compiled segments)", stacklevel=3)
-            return self._fn(*args, **kwargs)
+                "to_static: graph break in "
+                f"{getattr(self._fn, '__name__', self._fn)} "
+                "(data-dependent Python branch); this input "
+                "signature runs as compiled segments around the "
+                "branch (full_graph=False)", stacklevel=3)
+            return self.__segmented_call(key, args, kwargs)
 
     def __segmented_call(self, key, args, kwargs):
-        from ..core import state
-        if state.grad_enabled():   # training call on a segmented key
-            return self._fn(*args, **kwargs)
         if self._segmented is None:
             from .segment import SegmentedFunction
             self._segmented = SegmentedFunction(self._fn, self._cache_key)

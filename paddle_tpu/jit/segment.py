@@ -29,8 +29,23 @@ thing, redesigned for the TPU stack:
   pinned back to plain eager by the api layer (never unbounded memory,
   never perpetual per-call re-recording).
 
-Engages only while grads are off (like batch bucketing: the recorder
-does not tape; training paths keep the eager fallback).
+Training mode (grads ON) is served too — the reference's SOT captures
+training functions with graph breaks (jit/sot/translate.py:30, the
+eval-frame hook serves backward()): the recording pass is identical
+(the recorder does not tape), and the grafted compiled path is then
+replayed with each slice taped as ONE GradNode whose vjp is a cached
+jitted function (``_Slice.call_taped``). ``loss.backward()`` flows
+through the chain of slice vjps into the parameters and the inputs,
+with zero Python tracing at steady state. The compiled vjp
+REMATERIALISES the slice forward inside backward (jax.vjp at backward
+time) instead of storing residuals across the host boundary — ~one
+extra fused forward per slice per step, the standard TPU memory/FLOPs
+trade (same family as jax.checkpoint). create_graph (double backward)
+through a segmented call is not supported — the slice nodes carry no
+re-differentiable pure spec; use full_graph=True or eager for that.
+RNG-consuming ops (dropout) bake their key at recording time, matching
+full-graph to_static behaviour: each cached path reuses its recorded
+mask sequence.
 """
 from __future__ import annotations
 
@@ -78,13 +93,69 @@ class _Slice:
             return program._replay_env(dict(feed_arrays), out_vars,
                                        overrides, ops=ops)
 
+        self._run = run
         self._jit = jax.jit(run)
+        # (diff_idx) -> jitted vjp of run; one entry serves every step of
+        # a training loop (jax.jit re-specializes on shape change)
+        self._bwd_cache: Dict[tuple, Any] = {}
         STATS["segments_compiled"] += 1
 
     def __call__(self, env):
         feed = {n: env[n] for n in self.in_names}
         outs = self._jit(feed, [r.param._data for r in self._refs])
         env.update(zip(self.out_names, outs))
+        STATS["segments_executed"] += 1
+
+    def call_taped(self, env):
+        """Training-mode replay: ``env`` maps names -> Tensors and this
+        slice records as ONE GradNode. The vjp is deferred to backward
+        and served by a jitted function cached per diff-signature, so a
+        steady-state train step pays compiled fwd + compiled bwd per
+        slice and no Python tracing."""
+        import jax.numpy as jnp
+
+        from ..autograd import tape as _tape
+
+        feed_t = [env[n] for n in self.in_names]
+        param_t = [r.param for r in self._refs]
+        in_list = feed_t + param_t
+        arrays = [t._data for t in in_list]
+        nf = len(self.in_names)
+        outs = self._jit(dict(zip(self.in_names, arrays[:nf])),
+                         arrays[nf:])
+        out_tensors = [Tensor(o) for o in outs]
+        diff_idx = tuple(
+            i for i, t in enumerate(in_list)
+            if isinstance(t, Tensor) and not t.stop_gradient
+            and jnp.issubdtype(t._data.dtype, jnp.inexact))
+        if diff_idx and out_tensors:
+            bwd = self._bwd_cache.get(diff_idx)
+            if bwd is None:
+                in_names, run = self.in_names, self._run
+
+                def bwd_impl(diff_primals, all_arrays, cts):
+                    def closed(*d):
+                        full = list(all_arrays)
+                        for i, a in zip(diff_idx, d):
+                            full[i] = a
+                        return tuple(run(dict(zip(in_names, full[:nf])),
+                                         list(full[nf:])))
+                    return jax.vjp(closed, *diff_primals)[1](tuple(cts))
+
+                bwd = jax.jit(bwd_impl)
+                self._bwd_cache[diff_idx] = bwd
+            diff_primals = tuple(arrays[i] for i in diff_idx)
+            all_arrays = tuple(arrays)
+
+            def vjp_fn(cts):
+                return bwd(diff_primals, all_arrays,
+                           cts if isinstance(cts, tuple) else (cts,))
+
+            node = _tape.record_node(
+                "segment_slice", vjp_fn,
+                [in_list[i] for i in diff_idx], out_tensors)
+            node.multi_out = True      # vjp always takes the full tuple
+        env.update(zip(self.out_names, out_tensors))
         STATS["segments_executed"] += 1
 
 
@@ -264,14 +335,14 @@ class _Recorder:
         into the owner's guard tree. The freshly built chain REPLACES
         the shared prefix (its fetch sets cover the union of all
         recorded paths' needs); divergent branches hanging off the old
-        prefix are re-attached to the new nodes."""
+        prefix are re-attached to the new nodes. Returns the chain."""
         nodes = self.build_nodes()
         for i in range(len(nodes) - 1):
             nodes[i].children[self.path_values[i]] = nodes[i + 1]
         old = self.owner.paths.get(self.sig)
         self.owner.paths[self.sig] = nodes[0]
         if old is None:
-            return
+            return nodes
         node = old
         for i, v in enumerate(self.path_values):
             for val, child in node.children.items():
@@ -279,14 +350,17 @@ class _Recorder:
                     nodes[i].children[val] = child
             nxt = node.children.get(v)
             if nxt is None:
-                return
+                return nodes
             node = nxt
+        return nodes
 
 
 def _leaf_value(entry, env):
     tag, v = entry
     if tag == "var":
-        return Tensor(env[v])
+        val = env[v]
+        # taped replays keep Tensors (with their grad graph) in the env
+        return val if isinstance(val, Tensor) else Tensor(val)
     return Tensor(v) if isinstance(v, jax.Array) else v
 
 
@@ -313,24 +387,36 @@ class SegmentedFunction:
         return self._record(sig, args, kwargs)
 
     # -- cached fast path --------------------------------------------------
-    def _feed_env(self, args, kwargs):
+    def _feed_env(self, args, kwargs, taped):
         flat, _ = jax.tree_util.tree_flatten(
             (list(args), dict(kwargs)),
             is_leaf=lambda x: isinstance(x, Tensor))
+        if taped:
+            # keep the Tensor handles: they are the GradNode inputs, so
+            # backward() reaches the caller's x.grad / param.grad
+            return {f"leaf{i}": leaf for i, leaf in enumerate(flat)
+                    if isinstance(leaf, Tensor)}
         return {f"leaf{i}": leaf._data for i, leaf in enumerate(flat)
                 if isinstance(leaf, Tensor)}
 
     def _try_cached(self, node, args, kwargs):
-        env = self._feed_env(args, kwargs)
+        from ..core import state
+        taped = state.grad_enabled()
+        env = self._feed_env(args, kwargs, taped)
         try:
             while True:
-                node.slice(env)
+                if taped:
+                    node.slice.call_taped(env)
+                else:
+                    node.slice(env)
                 if node.out_tree is not None:    # leaf
                     leaves = [_leaf_value(e, env)
                               for e in node.out_entries]
                     return jax.tree_util.tree_unflatten(node.out_tree,
                                                         leaves)
-                v = _guard_value(env[node.guard_name])
+                gv = env[node.guard_name]
+                v = _guard_value(gv._data if isinstance(gv, Tensor)
+                                 else gv)
                 child = node.children.get(v)
                 if child is None:
                     return _MISS   # unseen branch outcome -> record
@@ -373,8 +459,21 @@ class SegmentedFunction:
             set_symbolic_concretize_hook(prev_hook)
             _opmod.set_segment_program(prev_prog)
         try:
+            from ..core import state as _state
             tree, entries = rec.finalize(out)
-            rec.graft()
+            nodes = rec.graft()
+            if _state.grad_enabled():
+                # the recording replay does not tape — produce the result
+                # by replaying the JUST-RECORDED chain taped, without
+                # consulting guards (they were already decided by fn with
+                # these very inputs; re-checking them against compiled
+                # slice values could miss on a last-ulp fusion difference
+                # and would re-run fn, double-executing its side effects)
+                env = self._feed_env(args, kwargs, taped=True)
+                for node in nodes:
+                    node.slice.call_taped(env)
+                leaves = [_leaf_value(e, env) for e in entries]
+                return jax.tree_util.tree_unflatten(tree, leaves)
             leaves = [_leaf_value(e, rec.env) for e in entries]
             return jax.tree_util.tree_unflatten(tree, leaves)
         except SegmentCaptureError:

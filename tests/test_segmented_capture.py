@@ -82,21 +82,22 @@ class TestSegmentedCapture:
                                    np.asarray(_fn(_mk(-0.25)).numpy()),
                                    rtol=1e-6)
 
-    def test_grad_enabled_keeps_eager_fallback(self):
+    def test_grad_enabled_segments_and_tapes(self):
+        # VERDICT-r5 item 4: training calls run as compiled segments
+        # too — the slices record as GradNodes, so backward() works
         f = pjit.to_static(_fn, full_graph=False)
         x = paddle.to_tensor(np.full((4, 4), 0.5, "float32"),
                              stop_gradient=False)
-        with pytest.warns(UserWarning, match="eagerly"):
+        with pytest.warns(UserWarning, match="compiled segments"):
             out = f(x)
-        out.sum().backward()                 # the eager path tapes
+        out.sum().backward()
         assert x.grad is not None
-        assert segment.STATS["recordings"] == 0
-        # the signature is NOT pinned eager: a later no-grad call of
-        # the same signature gets segmented capture
-        with paddle.no_grad():
-            with pytest.warns(UserWarning, match="compiled segments"):
-                f(paddle.to_tensor(np.full((4, 4), 0.5, "float32")))
         assert segment.STATS["recordings"] == 1
+        xe = paddle.to_tensor(np.full((4, 4), 0.5, "float32"),
+                              stop_gradient=False)
+        _fn(xe).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   np.asarray(xe.grad.numpy()), rtol=1e-6)
 
     def test_layer_with_params_segmented(self):
         from paddle_tpu import nn
@@ -244,3 +245,121 @@ class TestGuardSaturation:
             g(_mk(9.9))
         assert calls["n"] == n_before + 1
         assert segment.STATS["recordings"] == 3
+
+
+class TestTrainingSegments:
+    """Training-mode segmented capture (VERDICT-r5 item 4): a train step
+    with a data-dependent Python branch runs as compiled segments
+    fwd+bwd; loss AND grads match eager on both branch outcomes."""
+
+    def setup_method(self):
+        segment.reset_stats()
+
+    def _model(self):
+        from paddle_tpu import nn
+        paddle.seed(42)
+        return nn.Linear(4, 4)
+
+    @staticmethod
+    def _step(lin, x):
+        h = lin(x)
+        if h.sum() > 0:                     # graph break under grad
+            out = paddle.tanh(h) * 2.0
+        else:
+            out = paddle.exp(h) * 0.5
+        return (out ** 2).mean()
+
+    def test_loss_and_grad_parity_both_branches(self):
+        lin_s = self._model()
+        lin_e = self._model()
+        # ONE StaticFunction across both branches: the second branch
+        # grafts onto the first recording's tree, and parity must hold
+        # down the multi-path taped tree
+        f = pjit.to_static(
+            lambda x: self._step(lin_s, x), full_graph=False)
+        for pv in (0.6, -0.6):              # both branch outcomes
+            xs = paddle.to_tensor(np.full((3, 4), pv, "float32"),
+                                  stop_gradient=False)
+            xe = paddle.to_tensor(np.full((3, 4), pv, "float32"),
+                                  stop_gradient=False)
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                loss_s = f(xs)
+            loss_e = self._step(lin_e, xe)
+            np.testing.assert_allclose(float(loss_s.numpy()),
+                                       float(loss_e.numpy()), rtol=1e-6)
+            loss_s.backward()
+            loss_e.backward()
+            np.testing.assert_allclose(np.asarray(xs.grad.numpy()),
+                                       np.asarray(xe.grad.numpy()),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(lin_s.weight.grad.numpy()),
+                np.asarray(lin_e.weight.grad.numpy()), rtol=1e-5)
+            lin_s.weight.clear_gradient()
+            lin_e.weight.clear_gradient()
+            lin_s.bias.clear_gradient()
+            lin_e.bias.clear_gradient()
+
+    def test_cached_training_replay_no_rerecord(self):
+        lin = self._model()
+        f = pjit.to_static(lambda x: self._step(lin, x),
+                           full_graph=False)
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(paddle.to_tensor(np.full((3, 4), 0.5, "float32"),
+                               stop_gradient=False)).backward()
+        rec = segment.STATS["recordings"]
+        loss = f(paddle.to_tensor(np.full((3, 4), 0.7, "float32"),
+                                  stop_gradient=False))
+        loss.backward()
+        assert segment.STATS["recordings"] == rec       # cached path
+        assert segment.STATS["cached_path_hits"] >= 1
+
+    def test_full_training_loop_matches_eager(self):
+        from paddle_tpu import optimizer as popt
+        lin_s, lin_e = self._model(), self._model()
+        f = pjit.to_static(lambda x: self._step(lin_s, x),
+                           full_graph=False)
+        os_ = popt.SGD(learning_rate=0.1, parameters=lin_s.parameters())
+        oe = popt.SGD(learning_rate=0.1, parameters=lin_e.parameters())
+        rng = np.random.default_rng(0)
+        import warnings
+        for i in range(6):
+            xv = rng.normal(size=(3, 4)).astype("f4")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ls = f(paddle.to_tensor(xv))
+            le = self._step(lin_e, paddle.to_tensor(xv))
+            ls.backward()
+            le.backward()
+            os_.step(); os_.clear_grad()
+            oe.step(); oe.clear_grad()
+        np.testing.assert_allclose(np.asarray(lin_s.weight.numpy()),
+                                   np.asarray(lin_e.weight.numpy()),
+                                   rtol=1e-5, atol=1e-7)
+        # weights moved (training actually happened)
+        fresh = self._model()
+        assert np.abs(np.asarray(lin_s.weight.numpy())
+                      - np.asarray(fresh.weight.numpy())).max() > 1e-4
+
+    def test_eval_then_train_same_signature(self):
+        # one signature serves both modes: no-grad replay (arrays) and
+        # taped replay (Tensors) share the guard tree and slices
+        lin = self._model()
+        f = pjit.to_static(lambda x: self._step(lin, x),
+                           full_graph=False)
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with paddle.no_grad():
+                v_eval = f(paddle.to_tensor(np.full((3, 4), 0.5, "f4")))
+            rec = segment.STATS["recordings"]
+            loss = f(paddle.to_tensor(np.full((3, 4), 0.5, "f4")))
+        assert segment.STATS["recordings"] == rec       # reused path
+        loss.backward()
+        assert lin.weight.grad is not None
+        np.testing.assert_allclose(float(v_eval.numpy()),
+                                   float(loss.numpy()), rtol=1e-6)
