@@ -84,3 +84,249 @@ def run_elastic(script: str, script_args: Sequence[str] = (),
                           min_nproc=min_nproc).run(
         script, script_args, nproc_per_node=nproc_per_node,
         **launch_kwargs)
+
+
+# -- scale-out / re-admission (reference: ElasticManager watching etcd
+# -- membership, fleet/elastic/manager.py:124: the np=min:max band plus
+# -- _match()-triggered world rebuilds) --------------------------------------
+
+from ..launch.main import RESCALE_RC  # one protocol constant, one home
+
+
+class AdaptiveElasticManager(ElasticManager):
+    """Elastic training with scale-IN on failure and scale-OUT on worker
+    re-admission, resuming each world from the latest checkpoint.
+
+    The reference watches etcd membership: when a node's lease lapses the
+    world restarts smaller; when a (re)joined node registers, the world
+    restarts at the larger size (manager.py:124 `_match` + relaunch).
+    TPU-native transport: no etcd — a DOWN worker is whatever the launch
+    watcher reported (crash rc or heartbeat rc=124), and re-admission is
+    an announcement file in ``membership_dir`` (``worker*.up``, touched
+    by the recovered host's agent) or an automatic ``readmit_after``
+    backoff expiry. A membership GROWTH during a running world triggers a
+    controlled stop (launch control_dir rescale flag, rc=125) and a
+    relaunch at the larger size; workers resume from the latest
+    checkpoint (distributed.checkpoint reshards on load, so 3→2→3-style
+    world changes re-partition state automatically)."""
+
+    def __init__(self, max_restarts: int = 10,
+                 min_nproc: Optional[int] = None,
+                 restart_delay: float = 0.2,
+                 readmit_after: Optional[float] = None,
+                 launcher: Optional[Callable] = None):
+        super().__init__(max_restarts=max_restarts, min_nproc=min_nproc,
+                         restart_delay=restart_delay, launcher=launcher)
+        self.readmit_after = readmit_after
+        self._down_times: list = []      # one entry per currently-down slot
+        self._up_consumed = 0            # how many worker*.up files consumed
+
+    # membership -------------------------------------------------------------
+    def _capacity(self, nproc_target: int, membership_dir) -> int:
+        """Current admissible world size: target minus still-down slots.
+        A down slot is re-admitted by an unconsumed ``worker*.up``
+        announcement or by ``readmit_after`` expiry."""
+        import glob
+        import os
+
+        if membership_dir:
+            ups = sorted(glob.glob(os.path.join(membership_dir,
+                                                "worker*.up")))
+            fresh = len(ups) - self._up_consumed
+            while fresh > 0 and self._down_times:
+                self._down_times.pop(0)
+                self._up_consumed += 1
+                fresh -= 1
+        if self.readmit_after is not None:
+            now = time.time()
+            self._down_times = [t for t in self._down_times
+                                if now - t < self.readmit_after]
+        return max(1, nproc_target - len(self._down_times))
+
+    def run_adaptive(self, script: str, script_args: Sequence[str] = (),
+                     nproc_per_node: int = 1,
+                     membership_dir: Optional[str] = None,
+                     ckpt_dir: Optional[str] = None,
+                     poll_interval: float = 0.5,
+                     **launch_kwargs) -> int:
+        """Run the job with world-size adaptation. Returns 0 when a world
+        completes, else the last failure rc once the restart budget is
+        exhausted. ``ckpt_dir`` is exported as PADDLE_ELASTIC_CKPT_DIR
+        for the load_state/save_state worker helpers."""
+        import os
+        import tempfile
+        import threading
+
+        self.restarts = 0
+        self.events = []
+        self._down_times = []
+        # baseline pre-existing announcements: an up-file left over from
+        # a previous job must not instantly re-admit this job's first
+        # crash
+        self._up_consumed = 0
+        if membership_dir:
+            import glob
+            self._up_consumed = len(glob.glob(
+                os.path.join(membership_dir, "worker*.up")))
+        ctl = tempfile.mkdtemp(prefix="paddle_elastic_ctl_")
+        extra_env = dict(launch_kwargs.pop("extra_env", None) or {})
+        if ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            extra_env["PADDLE_ELASTIC_CKPT_DIR"] = ckpt_dir
+        run_idx = 0
+        rc = 0
+        while True:
+            np_now = self._capacity(nproc_per_node, membership_dir)
+            if self.min_nproc is not None and np_now < self.min_nproc:
+                self._record(ElasticStatus.ERROR,
+                             {"reason": "below min_nproc",
+                              "capacity": np_now})
+                return rc or 1
+            flag = os.path.join(ctl, "rescale")
+            if os.path.exists(flag):
+                os.remove(flag)
+            stop_watch = threading.Event()
+
+            def watch_membership(np_running=np_now):
+                while not stop_watch.is_set():
+                    if self._capacity(nproc_per_node,
+                                      membership_dir) > np_running:
+                        try:
+                            with open(flag, "w"):
+                                pass
+                            return
+                        except OSError as e:
+                            # the re-admission was already consumed by
+                            # _capacity — keep retrying the flag write,
+                            # or the scale-out is silently lost
+                            import sys
+                            print(f"[elastic] rescale flag write failed "
+                                  f"({e}); retrying", file=sys.stderr)
+                    stop_watch.wait(poll_interval)
+
+            watcher = None
+            if np_now < nproc_per_node and (membership_dir
+                                            or self.readmit_after):
+                watcher = threading.Thread(target=watch_membership,
+                                           daemon=True)
+                watcher.start()
+            env = dict(extra_env, PADDLE_ELASTIC_RUN=str(run_idx))
+            kw = dict(launch_kwargs)
+            if kw.get("log_dir"):
+                # one dir per world incarnation — a relaunch must not
+                # overwrite the previous world's workerlogs
+                kw["log_dir"] = os.path.join(kw["log_dir"],
+                                             f"run{run_idx}")
+            try:
+                rc = self._launch(script, script_args,
+                                  nproc_per_node=np_now,
+                                  extra_env=env, control_dir=ctl,
+                                  **kw)
+            finally:
+                stop_watch.set()
+                if watcher:
+                    watcher.join(timeout=5)
+            run_idx += 1
+            if rc == 0:
+                self._record(ElasticStatus.COMPLETED, {"nproc": np_now})
+                return 0
+            if rc == RESCALE_RC and os.path.exists(flag):
+                # controlled stop for scale-out (confirmed by OUR flag —
+                # a worker exiting 125 on its own is a failure, not a
+                # rescale): no budget burn
+                self._record(ElasticStatus.RESTART,
+                             {"nproc": np_now, "reason": "scale-out"})
+                continue
+            if self.restarts >= self.max_restarts:
+                self._record(ElasticStatus.ERROR,
+                             {"nproc": np_now, "rc": rc,
+                              "reason": "restart budget exhausted"})
+                return rc
+            self.restarts += 1
+            self._down_times.append(time.time())
+            self._record(ElasticStatus.RESTART,
+                         {"nproc": np_now, "rc": rc,
+                          "attempt": self.restarts})
+            time.sleep(self.restart_delay)
+
+
+# -- worker-side elastic state (resume across world re-forms) ----------------
+
+def elastic_run_index() -> int:
+    """Which world incarnation this process belongs to (0 = first)."""
+    import os
+    return int(os.environ.get("PADDLE_ELASTIC_RUN", "0"))
+
+
+def save_state(step: int, state_dict, blocking: bool = False,
+               prev_handle=None):
+    """Checkpoint one training step for elastic resume. Uses the
+    distributed async checkpoint (distributed/checkpoint: snapshot now,
+    write in background, shard-aware, reshards on load at a different
+    world size). The ``latest`` pointer advances only after a save
+    completes, so a kill mid-write can never be resumed from.
+
+    Returns a handle; pass it back as ``prev_handle`` on the next call
+    (a 1-deep pipeline: step N's save overlaps step N+1's compute), and
+    call ``finish_saves(handle)`` once after the loop."""
+    import os
+
+    from .. import checkpoint as dckpt
+
+    root = os.environ.get("PADDLE_ELASTIC_CKPT_DIR")
+    if not root:
+        return None
+    if prev_handle is not None:
+        finish_saves(prev_handle)
+    path = os.path.join(root, f"step{step}")
+    handle = _CompletedSave(dckpt.async_save_state_dict(
+        dict(state_dict, __elastic_step__=int(step)), path), step, root)
+    if blocking:
+        finish_saves(handle)
+        return None
+    return handle
+
+
+class _CompletedSave:
+    __slots__ = ("handle", "step", "root")
+
+    def __init__(self, handle, step, root):
+        self.handle, self.step, self.root = handle, step, root
+
+
+def finish_saves(pending) -> bool:
+    """Wait for an in-flight elastic save; rank 0 then advances the
+    ``latest`` pointer atomically."""
+    import os
+
+    from ..env import get_rank
+
+    if pending is None:
+        return False
+    pending.handle.result()
+    if get_rank() == 0:
+        tmp = os.path.join(pending.root, f".latest.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(str(pending.step))
+        os.replace(tmp, os.path.join(pending.root, "latest"))
+    return True
+
+
+def load_state(template_state_dict):
+    """Resume point for an elastic worker: (start_step, state). Loads the
+    newest completed checkpoint into ``template_state_dict`` (sharded
+    values reshard to the CURRENT world's placements), or returns
+    (0, template) on a fresh start."""
+    import os
+
+    from .. import checkpoint as dckpt
+
+    root = os.environ.get("PADDLE_ELASTIC_CKPT_DIR")
+    latest = os.path.join(root, "latest") if root else None
+    if not latest or not os.path.exists(latest):
+        return 0, template_state_dict
+    step = int(open(latest).read().strip())
+    full = dict(template_state_dict, __elastic_step__=0)
+    dckpt.load_state_dict(full, os.path.join(root, f"step{step}"))
+    full.pop("__elastic_step__", None)
+    return step, full

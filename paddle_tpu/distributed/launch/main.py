@@ -70,10 +70,13 @@ def _kill_all(procs, alive):
     alive.clear()
 
 
+RESCALE_RC = 125   # controlled stop for an elastic re-scale (not a failure)
+
+
 def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
            master=None, log_dir=None, job_id="default",
            extra_env=None, heartbeat_timeout: float = 0.0,
-           progress_timeout: float = 0.0) -> int:
+           progress_timeout: float = 0.0, control_dir=None) -> int:
     """Spawn ``nproc_per_node`` worker processes with rendezvous env and
     watch them (reference: CollectiveController.run). Returns the exit
     code: 0 iff every worker exited 0; on any failure the remaining
@@ -132,8 +135,34 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
     try:
         from .. import heartbeat as _hb
         alive = set(range(len(procs)))
+        rescale_flag = os.path.join(control_dir, "rescale") \
+            if control_dir else None
         while alive:
             time.sleep(0.2)
+            # poll exits BEFORE honoring a rescale flag: a world whose
+            # workers all just finished must report success, not be
+            # relaunched because capacity grew in the same instant
+            for i in list(alive):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                alive.discard(i)
+                if r != 0:
+                    # fail fast: one dead worker kills the job
+                    # (reference: watcher peer-failure propagation)
+                    rc = r
+                    _kill_all(procs, alive)
+            if not alive:
+                break
+            if rescale_flag and os.path.exists(rescale_flag):
+                # elastic re-scale request (fleet/elastic.py): stop the
+                # world cleanly so the manager can relaunch at the new
+                # size; workers resume from their latest checkpoint
+                print("[launch] re-scale requested; stopping world for "
+                      "elastic relaunch", file=sys.stderr)
+                rc = RESCALE_RC
+                _kill_all(procs, alive)
+                break
             if hb_dir:
                 my_ranks = [node_rank * nproc_per_node + l
                             for l in range(nproc_per_node)]
@@ -153,16 +182,6 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                     rc = 124
                     _kill_all(procs, alive)
                     break
-            for i in list(alive):
-                r = procs[i].poll()
-                if r is None:
-                    continue
-                alive.discard(i)
-                if r != 0:
-                    # fail fast: one dead worker kills the job
-                    # (reference: watcher peer-failure propagation)
-                    rc = r
-                    _kill_all(procs, alive)
     except KeyboardInterrupt:
         for pr in procs:
             pr.send_signal(signal.SIGTERM)

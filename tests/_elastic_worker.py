@@ -1,0 +1,97 @@
+"""Worker for the elastic scale-in/scale-out test (run via the elastic
+manager, not collected by pytest).
+
+Full-batch deterministic GD sharded over whatever world it wakes up in:
+the global math is identical at any world size, so the loss trajectory
+must be CONTINUOUS across 3->2->3 world re-forms if (and only if)
+checkpoint resume works. Logs one STEP line per step for the test to
+stitch together.
+
+Kill injection: on run 0, the highest rank exits hard at KILL_AT_STEP —
+the crash the elastic manager must absorb.
+"""
+import os
+import sys
+
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import heartbeat
+from paddle_tpu.distributed.fleet import elastic
+
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "12"))
+LR = 0.1
+N, D = 12, 4          # 12 rows: divisible by worlds of 1, 2, 3
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    w_true = rng.normal(size=(D,)).astype(np.float32)
+    return X, X @ w_true
+
+
+def _step_fn(w, x, y):
+    def loss_fn(w):
+        return jnp.mean((x @ w - y) ** 2)
+
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - LR * g, loss
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    run = elastic.elastic_run_index()
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("dp",))
+    repl = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("dp"))
+
+    # resume: reshard-on-load places w for THIS world's mesh
+    start, state = elastic.load_state(
+        {"w": jax.device_put(jnp.zeros((D,), jnp.float32), repl)})
+    w = jax.device_put(jnp.asarray(state["w"]), repl)
+
+    X, Y = _data()
+    lo, hi = rank * (N // world), (rank + 1) * (N // world)
+    gx = jax.make_array_from_process_local_data(row, X[lo:hi])
+    gy = jax.make_array_from_process_local_data(row, Y[lo:hi])
+    step_c = jax.jit(_step_fn, in_shardings=(repl, row, row),
+                     out_shardings=(repl, repl)).lower(w, gx, gy).compile()
+
+    kill_at = int(os.environ.get("KILL_AT_STEP", "-1"))
+    step_sleep = float(os.environ.get("STEP_SLEEP", "0"))
+    pending = None
+    for step in range(start, TOTAL_STEPS):
+        w, loss = step_c(w, gx, gy)
+        heartbeat.beat(step)
+        print(f"STEP run={run} world={world} rank={rank} step={step} "
+              f"loss={float(loss):.6f}", flush=True)
+        pending = elastic.save_state(step + 1, {"w": w},
+                                     prev_handle=pending)
+        if run == 0 and rank == world - 1 and step == kill_at:
+            os._exit(17)      # simulated node failure
+        if step_sleep:
+            import time
+            time.sleep(step_sleep)
+    elastic.finish_saves(pending)
+    dist.barrier()
+    print(f"ELASTIC_DONE run={run} rank={rank} world={world}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
