@@ -14,13 +14,14 @@ import os
 import numpy as np
 
 from ..io.dataset import Dataset
+from ..core import enforce as E
 
 __all__ = ["ESC50", "TESS"]
 
 
 def _need_dir(name, path):
     if path is None or not os.path.isdir(path):
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             f"{name}: automatic download is unavailable in this "
             "environment; pass data_file= pointing at the extracted "
             "dataset directory")
@@ -31,7 +32,7 @@ class _AudioClsDataset(Dataset):
     def __init__(self, feat_type="raw", **feat_kwargs):
         if feat_type not in ("raw", "mfcc", "spectrogram",
                              "melspectrogram", "logmelspectrogram"):
-            raise ValueError(f"unknown feat_type {feat_type!r}")
+            raise E.InvalidArgumentError(f"unknown feat_type {feat_type!r}")
         self.feat_type = feat_type
         self.feat_kwargs = feat_kwargs
         self._files = []     # (path, label)
@@ -79,7 +80,7 @@ class ESC50(_AudioClsDataset):
         root = _need_dir("ESC50", data_file)
         meta = os.path.join(root, "meta", "esc50.csv")
         if not os.path.exists(meta):
-            raise RuntimeError(f"ESC50: missing meta file {meta}")
+            raise E.PreconditionNotMetError(f"ESC50: missing meta file {meta}")
         with open(meta, newline="") as f:
             for row in csv.DictReader(f):
                 fold = int(row["fold"])

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..core import enforce as E
 
 
 class GradNode:
@@ -133,7 +134,7 @@ def _apply_node_taped(node, cot_tensors):
                 full[i] = a
             return fn(*full, **kwraw)
     else:
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             f"create_graph=True: op '{node.name}' recorded no pure call; "
             "its backward cannot be re-differentiated")
     n_out = node.n_outputs
@@ -180,7 +181,7 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
     def _seed(t: Tensor, g):
         if g is None:
             if t.size != 1:
-                raise RuntimeError(
+                raise E.PreconditionNotMetError(
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape}")
             g = jnp.ones_like(t._data)
@@ -192,7 +193,7 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
 
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient:
-            raise RuntimeError("backward() on a tensor with stop_gradient=True")
+            raise E.PreconditionNotMetError("backward() on a tensor with stop_gradient=True")
         g = _seed(t, g)
         node = t._grad_node
         if node is None:
@@ -324,7 +325,7 @@ def run_backward(tensors: List[Tensor], grad_tensors: Optional[List] = None,
 
 def _freed_vjp(name):
     def _err(*_):
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             f"Trying to run backward through {name} a second time, but the "
             "graph was freed. Pass retain_graph=True the first time.")
     return _err

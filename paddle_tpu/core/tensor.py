@@ -27,6 +27,7 @@ import numpy as np
 
 from . import dtype as dtypes
 from . import state
+from . import enforce as E
 
 # Set by jit/segment.py while a segmented capture is recording: called
 # with a symbolic Tensor whose concrete value Python needs (bool/float/
@@ -159,7 +160,7 @@ class Tensor:
             value = value._data
         value = jnp.asarray(value, dtype=self.dtype)
         if tuple(value.shape) != tuple(self._data.shape):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"set_value shape mismatch: tensor {tuple(self._data.shape)} vs value {tuple(value.shape)}")
         self._data = value
 
@@ -342,7 +343,7 @@ class Tensor:
             hook = _SYMBOLIC_CONCRETIZE
             if hook is not None:
                 return hook(self)
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "cannot read the concrete value of a symbolic tensor "
                 "while building a static Program; feed it through "
                 "static.Executor.run, or use jit.to_static("

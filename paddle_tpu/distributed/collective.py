@@ -26,6 +26,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from . import env
+from ..core import enforce as E
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
@@ -340,7 +341,7 @@ class P2POp:
 
     def __init__(self, op, tensor, peer, group=None):
         if op not in (isend, irecv):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 "P2POp.op must be paddle.distributed.isend or irecv")
         self.op = op
         self.tensor = tensor
@@ -354,7 +355,7 @@ def batch_isend_irecv(p2p_op_list):
     complete immediately; multi-process p2p rides the same KV-store
     exchange send/recv use."""
     if not p2p_op_list:
-        raise ValueError("p2p_op_list must not be empty")
+        raise E.InvalidArgumentError("p2p_op_list must not be empty")
     if not all(isinstance(p, P2POp) for p in p2p_op_list):
-        raise ValueError("p2p_op_list must contain only P2POp")
+        raise E.InvalidArgumentError("p2p_op_list must contain only P2POp")
     return [p.op(p.tensor, p.peer, group=p.group) for p in p2p_op_list]

@@ -119,7 +119,7 @@ class AdaptiveElasticManager(ElasticManager):
                          restart_delay=restart_delay, launcher=launcher)
         self.readmit_after = readmit_after
         self._down_times: list = []      # one entry per currently-down slot
-        self._up_consumed = 0            # how many worker*.up files consumed
+        self._up_consumed: set = set()   # consumed worker*.up file paths
 
     # membership -------------------------------------------------------------
     def _capacity(self, nproc_target: int, membership_dir) -> int:
@@ -130,13 +130,16 @@ class AdaptiveElasticManager(ElasticManager):
         import os
 
         if membership_dir:
+            # consumed announcements tracked by FILENAME, not count: a
+            # consumed up-file being deleted later must not swallow a
+            # different worker's future announcement
             ups = sorted(glob.glob(os.path.join(membership_dir,
                                                 "worker*.up")))
-            fresh = len(ups) - self._up_consumed
-            while fresh > 0 and self._down_times:
+            for u in ups:
+                if u in self._up_consumed or not self._down_times:
+                    continue
                 self._down_times.pop(0)
-                self._up_consumed += 1
-                fresh -= 1
+                self._up_consumed.add(u)
         if self.readmit_after is not None:
             now = time.time()
             self._down_times = [t for t in self._down_times
@@ -163,91 +166,97 @@ class AdaptiveElasticManager(ElasticManager):
         # baseline pre-existing announcements: an up-file left over from
         # a previous job must not instantly re-admit this job's first
         # crash
-        self._up_consumed = 0
+        self._up_consumed = set()
         if membership_dir:
             import glob
-            self._up_consumed = len(glob.glob(
+            self._up_consumed = set(glob.glob(
                 os.path.join(membership_dir, "worker*.up")))
         ctl = tempfile.mkdtemp(prefix="paddle_elastic_ctl_")
         extra_env = dict(launch_kwargs.pop("extra_env", None) or {})
         if ckpt_dir:
             os.makedirs(ckpt_dir, exist_ok=True)
             extra_env["PADDLE_ELASTIC_CKPT_DIR"] = ckpt_dir
-        run_idx = 0
-        rc = 0
-        while True:
-            np_now = self._capacity(nproc_per_node, membership_dir)
-            if self.min_nproc is not None and np_now < self.min_nproc:
-                self._record(ElasticStatus.ERROR,
-                             {"reason": "below min_nproc",
-                              "capacity": np_now})
-                return rc or 1
-            flag = os.path.join(ctl, "rescale")
-            if os.path.exists(flag):
-                os.remove(flag)
-            stop_watch = threading.Event()
+        import shutil
+        try:
+            run_idx = 0
+            rc = 0
+            while True:
+                np_now = self._capacity(nproc_per_node, membership_dir)
+                if self.min_nproc is not None and np_now < self.min_nproc:
+                    self._record(ElasticStatus.ERROR,
+                                 {"reason": "below min_nproc",
+                                  "capacity": np_now})
+                    return rc or 1
+                flag = os.path.join(ctl, "rescale")
+                if os.path.exists(flag):
+                    os.remove(flag)
+                stop_watch = threading.Event()
 
-            def watch_membership(np_running=np_now):
-                while not stop_watch.is_set():
-                    if self._capacity(nproc_per_node,
-                                      membership_dir) > np_running:
-                        try:
-                            with open(flag, "w"):
-                                pass
-                            return
-                        except OSError as e:
-                            # the re-admission was already consumed by
-                            # _capacity — keep retrying the flag write,
-                            # or the scale-out is silently lost
-                            import sys
-                            print(f"[elastic] rescale flag write failed "
-                                  f"({e}); retrying", file=sys.stderr)
-                    stop_watch.wait(poll_interval)
+                def watch_membership(np_running=np_now):
+                    while not stop_watch.is_set():
+                        if self._capacity(nproc_per_node,
+                                          membership_dir) > np_running:
+                            try:
+                                with open(flag, "w"):
+                                    pass
+                                return
+                            except OSError as e:
+                                # the re-admission was already consumed by
+                                # _capacity — keep retrying the flag write,
+                                # or the scale-out is silently lost
+                                import sys
+                                print(f"[elastic] rescale flag write failed "
+                                      f"({e}); retrying", file=sys.stderr)
+                        stop_watch.wait(poll_interval)
 
-            watcher = None
-            if np_now < nproc_per_node and (membership_dir
-                                            or self.readmit_after):
-                watcher = threading.Thread(target=watch_membership,
-                                           daemon=True)
-                watcher.start()
-            env = dict(extra_env, PADDLE_ELASTIC_RUN=str(run_idx))
-            kw = dict(launch_kwargs)
-            if kw.get("log_dir"):
-                # one dir per world incarnation — a relaunch must not
-                # overwrite the previous world's workerlogs
-                kw["log_dir"] = os.path.join(kw["log_dir"],
-                                             f"run{run_idx}")
-            try:
-                rc = self._launch(script, script_args,
-                                  nproc_per_node=np_now,
-                                  extra_env=env, control_dir=ctl,
-                                  **kw)
-            finally:
-                stop_watch.set()
-                if watcher:
-                    watcher.join(timeout=5)
-            run_idx += 1
-            if rc == 0:
-                self._record(ElasticStatus.COMPLETED, {"nproc": np_now})
-                return 0
-            if rc == RESCALE_RC and os.path.exists(flag):
-                # controlled stop for scale-out (confirmed by OUR flag —
-                # a worker exiting 125 on its own is a failure, not a
-                # rescale): no budget burn
+                watcher = None
+                if np_now < nproc_per_node and (membership_dir
+                                                or self.readmit_after):
+                    watcher = threading.Thread(target=watch_membership,
+                                               daemon=True)
+                    watcher.start()
+                env = dict(extra_env, PADDLE_ELASTIC_RUN=str(run_idx))
+                kw = dict(launch_kwargs)
+                if kw.get("log_dir"):
+                    # one dir per world incarnation — a relaunch must not
+                    # overwrite the previous world's workerlogs
+                    kw["log_dir"] = os.path.join(kw["log_dir"],
+                                                 f"run{run_idx}")
+                try:
+                    rc = self._launch(script, script_args,
+                                      nproc_per_node=np_now,
+                                      extra_env=env, control_dir=ctl,
+                                      **kw)
+                finally:
+                    stop_watch.set()
+                    if watcher:
+                        watcher.join(timeout=5)
+                run_idx += 1
+                if rc == 0:
+                    self._record(ElasticStatus.COMPLETED, {"nproc": np_now})
+                    return 0
+                if rc == RESCALE_RC and os.path.exists(flag):
+                    # controlled stop for scale-out (confirmed by OUR flag —
+                    # a worker exiting 125 on its own is a failure, not a
+                    # rescale): no budget burn
+                    self._record(ElasticStatus.RESTART,
+                                 {"nproc": np_now, "reason": "scale-out"})
+                    continue
+                if self.restarts >= self.max_restarts:
+                    self._record(ElasticStatus.ERROR,
+                                 {"nproc": np_now, "rc": rc,
+                                  "reason": "restart budget exhausted"})
+                    return rc
+                self.restarts += 1
+                self._down_times.append(time.time())
                 self._record(ElasticStatus.RESTART,
-                             {"nproc": np_now, "reason": "scale-out"})
-                continue
-            if self.restarts >= self.max_restarts:
-                self._record(ElasticStatus.ERROR,
                              {"nproc": np_now, "rc": rc,
-                              "reason": "restart budget exhausted"})
-                return rc
-            self.restarts += 1
-            self._down_times.append(time.time())
-            self._record(ElasticStatus.RESTART,
-                         {"nproc": np_now, "rc": rc,
-                          "attempt": self.restarts})
-            time.sleep(self.restart_delay)
+                              "attempt": self.restarts})
+                time.sleep(self.restart_delay)
+        finally:
+            # the control tempdir (rescale flag) must not leak
+            # across run_adaptive calls
+            shutil.rmtree(ctl, ignore_errors=True)
 
 
 # -- worker-side elastic state (resume across world re-forms) ----------------
@@ -280,7 +289,7 @@ def save_state(step: int, state_dict, blocking: bool = False,
         finish_saves(prev_handle)
     path = os.path.join(root, f"step{step}")
     handle = _CompletedSave(dckpt.async_save_state_dict(
-        dict(state_dict, __elastic_step__=int(step)), path), step, root)
+        dict(state_dict), path), step, root)
     if blocking:
         finish_saves(handle)
         return None
@@ -326,7 +335,6 @@ def load_state(template_state_dict):
     if not latest or not os.path.exists(latest):
         return 0, template_state_dict
     step = int(open(latest).read().strip())
-    full = dict(template_state_dict, __elastic_step__=0)
+    full = dict(template_state_dict)
     dckpt.load_state_dict(full, os.path.join(root, f"step{step}"))
-    full.pop("__elastic_step__", None)
     return step, full

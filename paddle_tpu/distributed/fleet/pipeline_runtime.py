@@ -40,6 +40,7 @@ from ...core.tensor import Tensor
 from ...nn.layer.base import Layer
 from .pipeline_schedules import Action, build_schedule, validate_schedule
 from .pp_layers import PipelineLayer
+from ...core import enforce as E
 
 __all__ = ["PipelineParallel"]
 
@@ -137,7 +138,7 @@ class PipelineParallel:
         self.layer = layer
         self.num_stages = num_stages or parts
         if parts % self.num_stages != 0:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"layer has {parts} parts, not divisible by "
                 f"{self.num_stages} stages")
         self.num_chunks = parts // self.num_stages
@@ -145,7 +146,7 @@ class PipelineParallel:
         self.schedule_name = schedule
         self.loss_fn = loss_fn or layer.loss_fn
         if self.loss_fn is None:
-            raise ValueError("pipeline training requires a loss_fn")
+            raise E.InvalidArgumentError("pipeline training requires a loss_fn")
         self.sched = build_schedule(schedule, self.num_stages, num_micro,
                                     self.num_chunks)
         validate_schedule(self.sched, num_micro, self.num_chunks)
@@ -203,7 +204,7 @@ class PipelineParallel:
         data = _to_array(data)
         labels = _to_array(labels)
         if data.shape[0] % M != 0:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"batch {data.shape[0]} not divisible by {M} micro-batches")
         micro_x = data.reshape(M, data.shape[0] // M, *data.shape[1:])
         micro_y = labels.reshape(M, labels.shape[0] // M, *labels.shape[1:])
@@ -286,7 +287,7 @@ class PipelineParallel:
             if not progressed:
                 stuck = {s: self.sched[s][ptr[s]] for s in range(S)
                          if ptr[s] < len(self.sched[s])}
-                raise RuntimeError(
+                raise E.PreconditionNotMetError(
                     f"pipeline schedule deadlock; waiting on {stuck}")
 
         # write accumulated grads onto Parameters (shared params get

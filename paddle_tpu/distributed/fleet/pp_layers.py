@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional, Sequence
 
 from ...nn.layer.base import Layer
+from ...core import enforce as E
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
 
@@ -61,7 +62,7 @@ class SegmentLayers:
         self.num_parts = num_parts
         self.method = method
         if len(layers) < num_parts:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"cannot split {len(layers)} layers into {num_parts} parts")
 
     def do_segment(self) -> List[int]:
@@ -81,7 +82,7 @@ class SegmentLayers:
                    if getattr(getattr(l, "layer_cls", type(l)),
                               "__name__", "") == name]
             if len(idx) < self.num_parts:
-                raise ValueError(
+                raise E.InvalidArgumentError(
                     f"only {len(idx)} '{name}' layers for "
                     f"{self.num_parts} parts")
             per, extra = divmod(len(idx), self.num_parts)
@@ -123,7 +124,7 @@ class SegmentLayers:
                     target = total / max(remaining_parts, 1)
             bounds.append(n)
             return bounds
-        raise ValueError(f"unknown segment method {self.method}")
+        raise E.InvalidArgumentError(f"unknown segment method {self.method}")
 
 
 class PipelineLayer(Layer):

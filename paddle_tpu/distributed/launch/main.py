@@ -149,9 +149,13 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                 alive.discard(i)
                 if r != 0:
                     # fail fast: one dead worker kills the job
-                    # (reference: watcher peer-failure propagation)
+                    # (reference: watcher peer-failure propagation).
+                    # Break immediately: continuing over the pre-kill
+                    # snapshot would poll the peers _kill_all just
+                    # SIGTERMed and overwrite rc with their -15
                     rc = r
                     _kill_all(procs, alive)
+                    break
             if not alive:
                 break
             if rescale_flag and os.path.exists(rescale_flag):

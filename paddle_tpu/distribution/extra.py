@@ -17,6 +17,7 @@ from jax.scipy import special as jsp
 
 from ..framework import random as frandom
 from . import Distribution, _raw, _wrap
+from ..core import enforce as E
 
 __all__ = ["Binomial", "Cauchy", "ContinuousBernoulli",
            "ExponentialFamily", "Independent", "MultivariateNormal",
@@ -90,7 +91,7 @@ class Binomial(Distribution):
         closed loop, no sampling."""
         from ..core import is_tracer
         if is_tracer(self.total_count):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 "Binomial.entropy() enumerates outcomes up to "
                 "max(total_count), which must be concrete — it cannot run "
                 "under jit tracing with a traced total_count (data-"
@@ -122,15 +123,15 @@ class Cauchy(Distribution):
 
     @property
     def mean(self):
-        raise ValueError("Cauchy distribution has no mean")
+        raise E.InvalidArgumentError("Cauchy distribution has no mean")
 
     @property
     def variance(self):
-        raise ValueError("Cauchy distribution has no variance")
+        raise E.InvalidArgumentError("Cauchy distribution has no variance")
 
     @property
     def stddev(self):
-        raise ValueError("Cauchy distribution has no stddev")
+        raise E.InvalidArgumentError("Cauchy distribution has no stddev")
 
     def sample(self, shape=(), name=None):
         return self.rsample(shape)
@@ -246,7 +247,7 @@ class Independent(Distribution):
         self._rank = int(reinterpreted_batch_rank)
         bshape = base.batch_shape
         if self._rank > len(bshape):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"reinterpreted_batch_rank {self._rank} exceeds base batch "
                 f"rank {len(bshape)}")
         split = len(bshape) - self._rank
@@ -290,7 +291,7 @@ class MultivariateNormal(Distribution):
         given = sum(x is not None for x in
                     (covariance_matrix, precision_matrix, scale_tril))
         if given != 1:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 "Exactly one of covariance_matrix, precision_matrix, "
                 "scale_tril must be specified")
         if scale_tril is not None:

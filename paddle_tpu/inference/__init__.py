@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..core import enforce as E
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PrecisionType", "PlaceType", "get_version"]
@@ -111,15 +112,15 @@ class Tensor:
 
     def copy_from_cpu(self, data: np.ndarray):
         if not self._is_input:
-            raise RuntimeError("copy_from_cpu on an output handle")
+            raise E.PreconditionNotMetError("copy_from_cpu on an output handle")
         self._owner._inputs[self.name] = jnp.asarray(np.asarray(data))
 
     def copy_to_cpu(self) -> np.ndarray:
         if self._is_input:
-            raise RuntimeError("copy_to_cpu on an input handle")
+            raise E.PreconditionNotMetError("copy_to_cpu on an input handle")
         out = self._owner._outputs.get(self.name)
         if out is None:
-            raise RuntimeError("run() the predictor before reading outputs")
+            raise E.PreconditionNotMetError("run() the predictor before reading outputs")
         return np.asarray(out)
 
     def shape(self):
@@ -203,7 +204,7 @@ class Predictor:
                 self._inputs[n] = jnp.asarray(np.asarray(a))
         missing = [n for n in self._input_names if n not in self._inputs]
         if missing:
-            raise RuntimeError(f"inputs not set: {missing}")
+            raise E.PreconditionNotMetError(f"inputs not set: {missing}")
         args = [self._inputs[n] for n in self._input_names]
         if self._kind == "static":
             flat = self._exported.call(*args)

@@ -27,6 +27,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..core import enforce as E
 from ..core.tensor import Tensor, to_tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
@@ -256,14 +257,14 @@ class _ProcessPrefetcher:
                     except queue.Empty:
                         dead = [w for w in workers if not w.is_alive()]
                         if dead:
-                            raise RuntimeError(
+                            raise E.PreconditionNotMetError(
                                 f"DataLoader worker(s) died unexpectedly "
                                 f"(exitcodes "
                                 f"{[w.exitcode for w in dead]}) — likely "
                                 "killed (OOM?) or crashed in native code")
                         if deadline is not None and \
                                 _time.time() > deadline:
-                            raise RuntimeError(
+                            raise E.PreconditionNotMetError(
                                 f"DataLoader timed out after "
                                 f"{self._timeout}s waiting for a batch")
                         continue
@@ -275,7 +276,7 @@ class _ProcessPrefetcher:
                 data = buf.pop(next_seq)
                 next_seq += 1
                 if isinstance(data, _WorkerError):
-                    raise RuntimeError(
+                    raise E.PreconditionNotMetError(
                         f"DataLoader worker failed:\n{data.msg}")
                 if ship_raw:
                     yield self._collate(data)
@@ -301,7 +302,6 @@ class DataLoader:
                  prefetch_factor: int = 2, use_shared_memory: bool = True,
                  timeout: int = 0, worker_init_fn=None,
                  persistent_workers=False, worker_mode: str = "thread"):
-        from ..core import enforce as E
         E.enforce(worker_mode in ("thread", "process", "native"),
                   "worker_mode must be 'thread', 'process', or 'native'",
                   E.InvalidArgumentError)
@@ -358,7 +358,6 @@ class DataLoader:
     def __iter__(self):
         if self.worker_mode == "native":
             if self._user_batch_sampler:
-                from ..core import enforce as E
                 raise E.InvalidArgumentError(
                     "worker_mode='native' drives its own batching/"
                     "shuffle and cannot honor a custom batch_sampler",
@@ -367,7 +366,7 @@ class DataLoader:
             return self._native_iter()
         if self.num_workers > 0 and self.worker_mode == "process":
             if self._iterable_mode or self.batch_sampler is None:
-                raise ValueError(
+                raise E.InvalidArgumentError(
                     "worker_mode='process' requires a map-style dataset "
                     "with batching (IterableDataset / batch_size=None "
                     "cannot be index-partitioned across workers); use "
@@ -411,9 +410,8 @@ class DataLoader:
                 "worker_mode='thread'/'process' for arbitrary map-style "
                 "datasets")
         if self.batch_size is None:
-            raise ValueError("worker_mode='native' requires batch_size")
+            raise E.InvalidArgumentError("worker_mode='native' requires batch_size")
         if self.collate_fn is not default_collate_fn:
-            from ..core import enforce as E
             raise E.InvalidArgumentError(
                 "worker_mode='native' assembles batches in C++ and "
                 "cannot run a custom collate_fn",

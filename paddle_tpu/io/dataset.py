@@ -5,6 +5,7 @@ import bisect
 from typing import Iterable, List, Sequence
 
 import numpy as np
+from ..core import enforce as E
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "ConcatDataset", "Subset", "random_split"]
@@ -34,7 +35,7 @@ class TensorDataset(Dataset):
     def __init__(self, tensors: Sequence):
         lengths = {t.shape[0] for t in tensors}
         if len(lengths) != 1:
-            raise ValueError("all tensors must share dim 0")
+            raise E.InvalidArgumentError("all tensors must share dim 0")
         self.tensors = tensors
 
     def __getitem__(self, idx):
@@ -49,7 +50,7 @@ class ComposeDataset(Dataset):
         self.datasets = list(datasets)
         lengths = {len(d) for d in self.datasets}
         if len(lengths) != 1:
-            raise ValueError("all datasets must have the same length")
+            raise E.InvalidArgumentError("all datasets must have the same length")
 
     def __len__(self):
         return len(self.datasets[0])
@@ -117,7 +118,7 @@ def random_split(dataset: Dataset, lengths: Sequence, generator=None):
         lengths = counts
     total = sum(lengths)
     if total != len(dataset):
-        raise ValueError("sum of lengths != dataset size")
+        raise E.InvalidArgumentError("sum of lengths != dataset size")
     key = generator.next_key() if generator is not None else \
         frandom.default_generator.next_key()
     perm = np.asarray(jax.random.permutation(key, total))

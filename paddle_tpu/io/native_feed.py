@@ -18,6 +18,7 @@ import os
 from typing import Optional, Sequence
 
 import numpy as np
+from ..core import enforce as E
 
 _LIB = None
 
@@ -72,10 +73,10 @@ class NativeArrayFeeder:
         arrays = [np.ascontiguousarray(a) for a in arrays]
         n = {a.shape[0] for a in arrays}
         if len(n) != 1:
-            raise ValueError("all arrays must share dim 0")
+            raise E.InvalidArgumentError("all arrays must share dim 0")
         (self._n,) = n
         if self._n == 0 or batch_size < 1:
-            raise ValueError("need rows and a positive batch size")
+            raise E.InvalidArgumentError("need rows and a positive batch size")
         self._arrays = arrays          # keep alive: C++ reads in place
         self._batch = int(batch_size)
         self._drop_last = drop_last
@@ -83,7 +84,7 @@ class NativeArrayFeeder:
             # epochs=0 means "endless" to the C++ pipeline but __len__/
             # __iter__ are finite — workers would keep prefetching into
             # the ring after iteration stopped
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"NativeArrayFeeder: epochs must be >= 1, got {epochs}")
         self._epochs = int(epochs)
         lib = _lib()
@@ -97,7 +98,7 @@ class NativeArrayFeeder:
             int(drop_last), int(shuffle), seed, self._epochs,
             num_threads, prefetch_depth)
         if not self._handle:
-            raise RuntimeError("native datafeed pipeline create failed")
+            raise E.PreconditionNotMetError("native datafeed pipeline create failed")
         self._lib = lib
 
     def __len__(self):
@@ -107,7 +108,7 @@ class NativeArrayFeeder:
 
     def __iter__(self):
         if getattr(self, "_consumed", False):
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "NativeArrayFeeder is one-shot (the C++ pipeline "
                 "prefetches through its epochs once); construct a new "
                 "feeder per pass — DataLoader(worker_mode='native') "

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...ops._op import op_fn, unwrap, wrap
+from ...core import enforce as E
 
 __all__ = [
     "max_unpool1d", "max_unpool2d", "max_unpool3d",
@@ -56,7 +57,7 @@ def _reduce(loss, reduction):
 def _unpool(x, indices, nsp, kernel_size, stride, padding, output_size,
             data_format):
     if data_format not in ("NCL", "NCHW", "NCDHW"):
-        raise ValueError(f"max_unpool: unsupported data_format {data_format}")
+        raise E.InvalidArgumentError(f"max_unpool: unsupported data_format {data_format}")
     k = (kernel_size,) * nsp if isinstance(kernel_size, int) else tuple(kernel_size)
     s = k if stride is None else (
         (stride,) * nsp if isinstance(stride, int) else tuple(stride))
@@ -132,7 +133,7 @@ def _fractional_bounds(inp, out, ksize, u):
 def _fractional_pool(x, nsp, output_size, kernel_size, random_u, return_mask,
                      data_format):
     if data_format not in ("NCHW", "NCDHW"):
-        raise ValueError(f"fractional pool: bad data_format {data_format}")
+        raise E.InvalidArgumentError(f"fractional pool: bad data_format {data_format}")
     spatial = unwrap(x).shape[2:]
     osz = ((output_size,) * nsp if isinstance(output_size, int)
            else tuple(output_size))
@@ -322,7 +323,7 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     if maxlen is None:
         from ...core import is_tracer
         if is_tracer(xa):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 "sequence_mask(maxlen=None) must read the max length from "
                 "the data, which is impossible under jit/to_static tracing "
                 "(data-dependent output shape). Pass an explicit maxlen, "
@@ -355,7 +356,7 @@ def _temporal_shift(x, *, seg_num, shift_ratio, data_format):
 def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
                    data_format="NCHW"):
     if data_format not in ("NCHW", "NHWC"):
-        raise ValueError(f"temporal_shift: bad data_format {data_format}")
+        raise E.InvalidArgumentError(f"temporal_shift: bad data_format {data_format}")
     return _temporal_shift(x, seg_num=int(seg_num),
                            shift_ratio=float(shift_ratio),
                            data_format=data_format)

@@ -18,6 +18,7 @@ import numpy as np
 from ...core import dtype as dtypes
 from ...core.tensor import Parameter, Tensor
 from .. import initializer as init_mod
+from ...core import enforce as E
 
 __all__ = ["Layer"]
 
@@ -104,12 +105,12 @@ class Layer:
         bufs = self.__dict__.get("_buffers")
         if isinstance(value, Parameter):
             if params is None:
-                raise RuntimeError("call Layer.__init__ before assigning params")
+                raise E.PreconditionNotMetError("call Layer.__init__ before assigning params")
             bufs.pop(name, None) if bufs else None
             params[name] = value
         elif isinstance(value, Layer):
             if subs is None:
-                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+                raise E.PreconditionNotMetError("call Layer.__init__ before assigning sublayers")
             subs[name] = value
         elif params is not None and name in params:
             params[name] = value
@@ -290,7 +291,7 @@ class Layer:
             arr = value._data if isinstance(value, Tensor) else jnp.asarray(
                 np.asarray(value))
             if tuple(arr.shape) != tuple(target._data.shape):
-                raise ValueError(
+                raise E.InvalidArgumentError(
                     f"shape mismatch for {name}: {tuple(arr.shape)} vs "
                     f"{tuple(target._data.shape)}")
             target._data = arr.astype(target._data.dtype)

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Parameter, Tensor
+from ...core import enforce as E
 
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
            "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
@@ -74,7 +75,7 @@ def remove_weight_norm(layer, name="weight"):
     weight_norm_hook.py remove_weight_norm)."""
     hooks = getattr(layer, "_weight_norm_hooks", {})
     if name not in hooks:
-        raise ValueError(f"no weight_norm hook on parameter {name!r}")
+        raise E.InvalidArgumentError(f"no weight_norm hook on parameter {name!r}")
     hook, handle = hooks.pop(name)
     w = hook.compute(layer)
     handle.remove()
@@ -159,7 +160,7 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
             [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
             ** (1.0 / norm_type)
     if error_if_nonfinite and not bool(jnp.isfinite(total)):
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             f"gradient norm is non-finite ({float(total)}); cannot clip")
     scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
     for p in params:

@@ -26,6 +26,7 @@ import threading
 import time
 from enum import Enum
 from typing import Callable, Iterable, Optional
+from ..core import enforce as E
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
@@ -239,7 +240,7 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
                    skip_first: int = 0) -> Callable[[int], ProfilerState]:
     """reference: profiler.py make_scheduler — step_num -> state."""
     if closed < 0 or ready < 0 or record < 1:
-        raise ValueError("closed/ready must be >=0 and record >=1")
+        raise E.InvalidArgumentError("closed/ready must be >=0 and record >=1")
     span = closed + ready + record
 
     def fn(step: int) -> ProfilerState:
@@ -386,7 +387,7 @@ class Profiler:
 
     def export(self, path: str, format: str = "json"):
         if format not in ("json", "chrome"):
-            raise ValueError("only chrome-trace json export is supported")
+            raise E.InvalidArgumentError("only chrome-trace json export is supported")
         _get_recorder().export(path)
         self.last_export_path = path
         return path

@@ -1,5 +1,6 @@
 """paddle.signal namespace parity (reference: python/paddle/signal.py)."""
 from .ops.fft_ops import istft, stft  # noqa
+from .core import enforce as E
 
 __all__ = ['stft', 'istft']
 
@@ -15,7 +16,7 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
 
     xa = unwrap(x)
     if frame_length > xa.shape[axis]:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"frame_length ({frame_length}) > axis size ({xa.shape[axis]})")
 
     @op_fn(name="signal_frame")
@@ -33,7 +34,7 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
         return out
 
     if axis not in (0, -1, xa.ndim - 1):
-        raise ValueError("frame: axis must be 0 or -1")
+        raise E.InvalidArgumentError("frame: axis must be 0 or -1")
     return _frame(x, frame_length=frame_length, hop_length=hop_length,
                   axis=axis if axis == 0 else -1)
 

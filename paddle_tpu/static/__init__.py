@@ -48,6 +48,7 @@ __all__ = [
 
 from .compat import *  # noqa: F401,F403,E402
 from .compat import __all__ as _compat_all
+from ..core import enforce as E
 
 __all__ += list(_compat_all)
 
@@ -156,7 +157,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     the compiled fetch of a grad var is the XLA backward program."""
     var = getattr(loss, "_symbolic", None)
     if var is None:
-        raise ValueError("append_backward needs a program (symbolic) loss")
+        raise E.InvalidArgumentError("append_backward needs a program (symbolic) loss")
     prog: Program = var.program
     fwd_ops = list(prog.global_block.ops)
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 from .ir import Operator, Program, Var, _ParamRef
+from ..core import enforce as E
 
 __all__ = ["dead_code_elimination", "constant_folding", "PassManager",
            "prune_for_fetch"]
@@ -107,12 +108,12 @@ class PassManager:
                 stats[name] = constant_folding(program, **opts)
             elif name in ("dead_code_elimination", "dce"):
                 if not fetch_vars:
-                    raise ValueError(
+                    raise E.InvalidArgumentError(
                         "dead_code_elimination needs fetch_vars — with an "
                         "empty fetch set EVERY op is dead and the whole "
                         "program would be deleted")
                 stats[name] = dead_code_elimination(program, fetch_vars,
                                                     **opts)
             else:
-                raise ValueError(f"unknown pass {name!r}")
+                raise E.InvalidArgumentError(f"unknown pass {name!r}")
         return stats

@@ -12,6 +12,7 @@ from . import cpp_extension  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
 from . import unique_name  # noqa: F401
+from ..core import enforce as E
 
 __all__ = ["deprecated", "require_version", "run_check", "try_import",
            "unique_name", "dlpack", "download", "cpp_extension"]
@@ -33,7 +34,7 @@ def deprecated(update_to="", since="", reason="", level=0):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             if level >= 2:
-                raise RuntimeError(msg)
+                raise E.PreconditionNotMetError(msg)
             warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*args, **kwargs)
 
@@ -127,7 +128,7 @@ def assert_same_structure(nest1, nest2, check_types=True):
     s1 = jax.tree.structure(nest1, is_leaf=leaf)
     s2 = jax.tree.structure(nest2, is_leaf=leaf)
     if s1 != s2:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"The two structures don't match: {s1} vs {s2}")
 
 
@@ -169,10 +170,10 @@ def convert_to_list(value, n, name, dtype=int):
     try:
         value_list = list(value)
     except TypeError:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"{name} must be a {dtype.__name__} or iterable, got {value!r}")
     if len(value_list) != n:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"{name} must have {n} elements, got {len(value_list)}")
     return value_list
 

@@ -17,6 +17,7 @@ from .datasets import (Cifar10, Cifar100, DatasetFolder, FashionMNIST,  # noqa
 # read back the shadowed attribute) so paddle.vision.transforms stays
 # the package
 import sys as _sys
+from ..core import enforce as E
 
 transforms = _sys.modules[__name__ + ".transforms"]
 models = _sys.modules[__name__ + ".models"]
@@ -32,7 +33,7 @@ def set_image_backend(backend):
     'tensor' selects what image_load / dataset loaders return."""
     global _image_backend
     if backend not in ("pil", "cv2", "tensor"):
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"image backend must be pil/cv2/tensor, got {backend!r}")
     _image_backend = backend
 
@@ -51,7 +52,7 @@ def image_load(path, backend=None):
 
             return cv2.imread(path)
         except ImportError as e:
-            raise RuntimeError("cv2 backend requested but OpenCV is not "
+            raise E.PreconditionNotMetError("cv2 backend requested but OpenCV is not "
                                "installed") from e
     try:
         from PIL import Image
@@ -67,7 +68,7 @@ def image_load(path, backend=None):
             arr = arr[:, :, None]
         return Tensor(arr.transpose(2, 0, 1))
     except ImportError as e:
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             "image_load needs Pillow for the pil/tensor backends") from e
 
 

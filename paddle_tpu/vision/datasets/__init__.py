@@ -13,6 +13,7 @@ import struct
 import numpy as np
 
 from ...io import Dataset
+from ...core import enforce as E
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
 
@@ -166,7 +167,7 @@ class DatasetFolder(Dataset):
                     if is_valid_file(p):
                         self.samples.append((p, self.class_to_idx[c]))
         if not self.samples:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 f"Found 0 files in subfolders of {root} "
                 f"(looked for extensions {exts})")
 
@@ -202,7 +203,7 @@ class ImageFolder(Dataset):
                 if is_valid_file(p):
                     self.samples.append(p)
         if not self.samples:
-            raise RuntimeError(f"Found 0 files in {root}")
+            raise E.PreconditionNotMetError(f"Found 0 files in {root}")
 
     def __getitem__(self, idx):
         img = self.loader(self.samples[idx])
@@ -225,7 +226,7 @@ class Flowers(Dataset):
                  mode="train", transform=None, download=False,
                  backend=None):
         if data_file is None or label_file is None or setid_file is None:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "Flowers requires local data_file/label_file/setid_file "
                 "(102flowers.tgz, imagelabels.mat, setid.mat) — automatic "
                 "download is unavailable in this build")
@@ -261,7 +262,7 @@ class VOC2012(Dataset):
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
         if data_file is None:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "VOC2012 requires a local data_file (VOCtrainval tar) — "
                 "automatic download is unavailable in this build")
         import tarfile
@@ -272,7 +273,7 @@ class VOC2012(Dataset):
         seg = [n for n in names if "/ImageSets/Segmentation/" in n
                and n.endswith(f"{'train' if mode == 'train' else 'val'}.txt")]
         if not seg:
-            raise RuntimeError("segmentation index not found in archive")
+            raise E.PreconditionNotMetError("segmentation index not found in archive")
         with tarfile.open(data_file) as tf:
             ids = tf.extractfile(seg[0]).read().decode().split()
         self.ids = ids

@@ -21,6 +21,7 @@ from ..core.tensor import Tensor
 from ..nn.layer.base import Layer
 from ..ops._op import op_fn, unwrap, wrap
 from ..nn import Sequential as _nn_Sequential
+from ..core import enforce as E
 
 __all__ = [
     "nms", "roi_align", "roi_pool", "psroi_pool", "box_coder", "prior_box",
@@ -226,7 +227,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     xa = unwrap(x)
     c = xa.shape[1]
     if c % (ph * pw) != 0:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"psroi_pool needs channels divisible by {ph * pw}, got {c}")
     out_c = c // (ph * pw)
     # average-align each position-sensitive group then pick its own bin
@@ -625,7 +626,7 @@ def decode_jpeg(x, mode="unchanged", name=None):
             if arr.dtype != np.uint8:
                 arr = (arr * 255).astype(np.uint8)
         except ImportError as e:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "decode_jpeg needs Pillow or matplotlib for host-side "
                 "decode; neither is importable") from e
     if arr.ndim == 2:

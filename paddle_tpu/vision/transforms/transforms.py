@@ -9,6 +9,7 @@ from typing import Sequence
 import numpy as np
 
 from . import functional as F
+from ...core import enforce as E
 
 __all__ = ["BaseTransform", "Compose", "ToTensor", "Normalize", "Resize",
            "RandomCrop", "CenterCrop", "RandomHorizontalFlip",
@@ -210,7 +211,7 @@ class ContrastTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
         if value < 0:
-            raise ValueError("contrast value must be non-negative")
+            raise E.InvalidArgumentError("contrast value must be non-negative")
         self.value = value
 
     def _apply_image(self, img):
@@ -236,7 +237,7 @@ class HueTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
         if not 0 <= value <= 0.5:
-            raise ValueError("hue value must be in [0, 0.5]")
+            raise E.InvalidArgumentError("hue value must be in [0, 0.5]")
         self.value = value
 
     def _apply_image(self, img):

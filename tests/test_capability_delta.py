@@ -1,0 +1,62 @@
+"""CAPABILITY_DELTA.md stale-claim self-check (VERDICT-r4 weak #4).
+
+The delta doc is the SURVEY §2.9 official record of deliberate drops.
+Round 4 showed it can rot: the elastic row still said heartbeats were
+"not built" two commits after distributed/heartbeat.py landed. This
+gives the doc the same discipline docs/attr_delta.json already has
+(the attr sweep fails on stale entries):
+
+- Any row asserting a feature is NOT built must carry a machine-
+  checkable token ``absent:<dotted.path>``. The moment that path starts
+  resolving, the test fails, forcing the doc row to be updated in the
+  same round the delta closes.
+- The bare phrase "not built" (and variants) without a token is itself
+  a failure — untagged claims cannot be checked.
+"""
+import importlib
+import re
+from pathlib import Path
+
+DOC = Path(__file__).resolve().parents[1] / "docs" / "CAPABILITY_DELTA.md"
+
+
+def _resolve(dotted):
+    """Import the longest importable module prefix, then walk attrs.
+    Returns the object or None if any step is missing."""
+    parts = dotted.split(".")
+    for i in range(len(parts), 0, -1):
+        name = ".".join(parts[:i])
+        try:
+            obj = importlib.import_module(name)
+        except ImportError:
+            continue
+        for attr in parts[i:]:
+            if not hasattr(obj, attr):
+                return None
+            obj = getattr(obj, attr)
+        return obj
+    return None
+
+
+def test_absent_tokens_still_absent():
+    text = DOC.read_text()
+    tokens = re.findall(r"`absent:([A-Za-z_][\w.]*)`", text)
+    assert tokens, "delta doc must carry at least one absent: token"
+    stale = [t for t in tokens if _resolve(t) is not None]
+    assert not stale, (
+        f"CAPABILITY_DELTA.md claims these are absent but they resolve: "
+        f"{stale}. The feature landed — update the doc row in the same "
+        f"round (VERDICT-r4 weak #4 discipline).")
+
+
+def test_not_built_claims_are_tagged():
+    text = DOC.read_text()
+    untagged = []
+    for n, line in enumerate(text.splitlines(), 1):
+        if re.search(r"\bnot built\b|\bnot yet built\b|\bno converter\b",
+                     line, re.I) and "absent:" not in line:
+            untagged.append(n)
+    assert not untagged, (
+        f"CAPABILITY_DELTA.md lines {untagged} claim something is not "
+        f"built without an `absent:<dotted.path>` token, so the claim "
+        f"cannot be machine-checked for staleness. Tag it.")

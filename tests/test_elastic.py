@@ -44,19 +44,45 @@ class TestElasticScaleOut:
             AdaptiveElasticManager, ElasticStatus)
 
         log_root = tmp_path / "logs"
-        # readmit window sized so the shrunken world finishes its gloo
-        # re-rendezvous AND logs real training steps before the
-        # re-grown world takes over
+        members = tmp_path / "members"
+        members.mkdir()
+        # Event-driven re-admission: a watcher tails the workerlogs and
+        # announces the recovered worker (worker0.up) only after the
+        # SHRUNKEN world has demonstrably trained >=2 steps. A wall-clock
+        # readmit_after raced under suite load (world-2 launch+compile
+        # time varies), readmitting before world 2 logged a step or
+        # after it had already finished.
+        import threading
+
+        def _announce_when_world2_trains():
+            deadline = __import__("time").monotonic() + 420
+            while __import__("time").monotonic() < deadline:
+                n = 0
+                for p in sorted(log_root.glob("run*/workerlog.*")):
+                    try:
+                        n += len(re.findall(r"STEP run=\d+ world=2 "
+                                            r"rank=0", p.read_text()))
+                    except OSError:
+                        pass
+                if n >= 2:
+                    (members / "worker0.up").touch()
+                    return
+                __import__("time").sleep(0.3)
+
+        announcer = threading.Thread(target=_announce_when_world2_trains,
+                                     daemon=True)
+        announcer.start()
         mgr = AdaptiveElasticManager(max_restarts=6, min_nproc=2,
-                                     readmit_after=10.0,
                                      restart_delay=0.1)
         rc = mgr.run_adaptive(
             WORKER, nproc_per_node=3,
+            membership_dir=str(members),
             ckpt_dir=str(tmp_path / "ckpt"),
             log_dir=str(log_root),
             extra_env={"KILL_AT_STEP": "2", "STEP_SLEEP": "0.8",
                        "ELASTIC_TOTAL_STEPS": "24",
                        "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+        announcer.join(timeout=5)
         logs = ""
         for p in sorted(log_root.glob("run*/workerlog.*")):
             logs += p.read_text()
