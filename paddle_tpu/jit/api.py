@@ -47,10 +47,23 @@ def enable_to_static(flag: bool):
 
 class InputSpec:
     """paddle.static.InputSpec parity (shape may contain None: resolved at
-    first trace; each distinct concrete signature compiles once)."""
+    first trace; each distinct concrete signature compiles once).
+
+    DimExpr-lite (reference: paddle/pir/include/dialect/shape/): a dim
+    may be a NAME string instead of None — the same name appearing on
+    two axes (of one or several inputs) asserts they are equal at every
+    call, and ``to_static(constraints=[...])`` can relate names
+    arithmetically ("S % 8 == 0"). Named dims also export as SHARED
+    symbolic dims in jit.save."""
 
     def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
         self.shape = list(shape)
+        for d in self.shape:
+            if not (d is None or isinstance(d, (int, str))):
+                from ..core import enforce as E
+                raise E.InvalidArgumentError(
+                    f"InputSpec dim must be int, None, or a symbolic "
+                    f"name string; got {d!r}")
         self.dtype = convert_dtype(dtype)
         self.name = name
         self.stop_gradient = stop_gradient
@@ -107,10 +120,23 @@ class StaticFunction:
                  input_spec=None, build_strategy=None, full_graph=True,
                  bucket_batch=False, bucket_sizes=None,
                  bucket_seq=False, seq_axis=1, seq_bucket_sizes=None,
-                 seq_pad_value=0):
+                 seq_pad_value=0, constraints=None):
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
+        # DimExpr-lite: named dims in input_spec + relational constraints
+        from .constraints import DimConstraints
+        self._constraints = DimConstraints(constraints) \
+            if (constraints or self._spec_dim_names(input_spec)) else None
+        if constraints and not self._spec_dim_names(input_spec):
+            missing = self._constraints.names
+            if missing:
+                # constraints can only bind through named spec dims
+                from ..core import enforce as E
+                raise E.InvalidArgumentError(
+                    f"to_static(constraints=...) names dims {sorted(missing)} "
+                    "but input_spec declares no named dims",
+                    hint="use InputSpec([None, 'S'], ...) style names")
         self._programs: Dict[tuple, _Program] = {}
         self._bucket_batch = bool(bucket_batch)
         self._bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
@@ -128,19 +154,89 @@ class StaticFunction:
         functools.update_wrapper(self, fn)
 
     @staticmethod
-    def _pick_bucket(n: int, sizes) -> int:
+    def _spec_dim_names(input_spec):
+        """All symbolic dim names declared across the input specs."""
+        names = set()
+        for s in (input_spec or []):
+            if isinstance(s, InputSpec):
+                names.update(d for d in s.shape if isinstance(d, str))
+        return names
+
+    def _axis_name(self, axis: int):
+        """The symbolic name bound to ``axis`` (first spec declaring
+        one), or None — used to aim constraint pruning at the bucketed
+        axis."""
+        for s in (self._input_spec or []):
+            if isinstance(s, InputSpec) and len(s.shape) > axis \
+                    and isinstance(s.shape[axis], str):
+                return s.shape[axis]
+        return None
+
+    def _check_dims(self, args):
+        """Bind named spec dims against the call's concrete shapes;
+        raise typed errors on name conflicts (the dim_a == dim_b
+        relation) and on violated constraints."""
+        if self._constraints is None:
+            return
+        from ..core import enforce as E
+        bindings: dict = {}
+        for spec, a in zip(self._input_spec or [], args):
+            if not (isinstance(spec, InputSpec) and isinstance(a, Tensor)):
+                continue
+            shape = a._data.shape
+            if len(spec.shape) != len(shape):
+                raise E.InvalidArgumentError(
+                    f"input rank {len(shape)} does not match "
+                    f"InputSpec {spec.shape}")
+            for axis, d in enumerate(spec.shape):
+                if isinstance(d, int) and d >= 0 and d != shape[axis]:
+                    raise E.InvalidArgumentError(
+                        f"input dim {axis} is {shape[axis]}, InputSpec "
+                        f"fixes it to {d}")
+                if isinstance(d, str):
+                    seen = bindings.setdefault(d, int(shape[axis]))
+                    if seen != int(shape[axis]):
+                        raise E.InvalidArgumentError(
+                            f"symbolic dim {d!r} bound to both {seen} "
+                            f"and {shape[axis]} in one call",
+                            hint="the same name on two axes asserts "
+                                 "they are equal (DimExpr relation)")
+        self._constraints.check(bindings)
+
+    def _admit_fn(self, axis: int):
+        """Bucket-size predicate from the unary constraints on the
+        name bound to ``axis``, or None when unconstrained."""
+        if self._constraints is None:
+            return None
+        name = self._axis_name(axis)
+        if name is None or name not in self._constraints.names:
+            return None
+        return lambda b: self._constraints.admits(name, b)
+
+    @staticmethod
+    def _pick_bucket(n: int, sizes, admit=None) -> int:
         if sizes:
             for b in sizes:
-                if n <= b:
+                if n <= b and (admit is None or admit(b)):
                     return b
             return n          # beyond the largest bucket: run unbucketed
         b = 1
         while b < n:
             b <<= 1
+        if admit is not None and not admit(b):
+            # the power-of-two ladder violates a unary constraint on
+            # this dim (e.g. "S % 96 == 0"): take the smallest admitted
+            # size >= n within a bounded scan, else run unbucketed (the
+            # real size already passed _check_dims)
+            for c in range(n, 4 * b + 1):
+                if admit(c):
+                    return c
+            return n
         return b
 
     def _bucket_of(self, n: int) -> int:
-        return self._pick_bucket(n, self._bucket_sizes)
+        return self._pick_bucket(n, self._bucket_sizes,
+                                 admit=self._admit_fn(0))
 
     def _apply_bucketing(self, args):
         """Pad every Tensor arg's leading dim from the common batch size
@@ -175,7 +271,8 @@ class StaticFunction:
         return tuple(pad(a) for a in args), int(n), int(b)
 
     def _seq_bucket_of(self, n: int) -> int:
-        return self._pick_bucket(n, self._seq_bucket_sizes)
+        return self._pick_bucket(n, self._seq_bucket_sizes,
+                                 admit=self._admit_fn(self._seq_axis))
 
     def _apply_seq_bucketing(self, args):
         """Pad the sequence axis to its bucket (the reference's dynamic
@@ -283,6 +380,7 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._fn(*args, **kwargs)
+        self._check_dims(args)
         real_batch = None
         seq_pad = None
         if self._bucket_batch and not kwargs:
@@ -474,7 +572,8 @@ class StaticFunction:
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, bucket_batch=False,
               bucket_sizes=None, bucket_seq=False, seq_axis=1,
-              seq_bucket_sizes=None, seq_pad_value=0, **kwargs):
+              seq_bucket_sizes=None, seq_pad_value=0, constraints=None,
+              **kwargs):
     """paddle.jit.to_static parity (reference: jit/api.py:136).
     ``bucket_batch``/``bucket_sizes``: see StaticFunction — pad variable
     leading dims to buckets so XLA recompiles O(log max_batch) times.
@@ -489,7 +588,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                  bucket_seq=bucket_seq, seq_axis=seq_axis,
                  seq_bucket_sizes=seq_bucket_sizes,
                  seq_pad_value=seq_pad_value,
-                 full_graph=full_graph)
+                 full_graph=full_graph, constraints=constraints)
 
     def decorate(obj):
         if isinstance(obj, Layer):
@@ -527,6 +626,13 @@ def _resolve_specs(layer, input_spec):
     syms = {}
 
     def _dim(d, axis):
+        if isinstance(d, str):
+            # named symbolic dim (DimExpr-lite): shared across inputs
+            # by NAME, so ids/mask pairs declared with the same name
+            # export as one program-level symbol
+            if d not in syms:
+                syms[d] = jax.export.symbolic_shape(d, scope=scope)[0]
+            return syms[d]
         if d is None or (isinstance(d, int) and d < 0):
             # One shared symbol per axis position: None batch dims of
             # different inputs must unify (ids/mask pairs broadcast
