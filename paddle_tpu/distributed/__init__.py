@@ -45,6 +45,8 @@ from .compat import (CountFilterEntry, DistAttr, DistModel,  # noqa
                      QueueDataset, ShowClickEntry, Strategy, gloo_barrier,
                      gloo_init_parallel_env, gloo_release, split, to_static)
 
+from . import engine  # noqa: F401,E402
+from .engine import Engine, ParallelPlan, plan_parallel  # noqa: F401,E402
 from . import sharding  # noqa: F401,E402
 from .sharding import (group_sharded_parallel,  # noqa: F401,E402
                        save_group_sharded_model)

@@ -1,0 +1,98 @@
+"""Auto-parallel Engine: cost-model-driven plans (VERDICT-r4 item 8).
+
+Reference: auto_parallel/static/engine.py:63 + static/cost/ — the Engine
+plans the distributed layout instead of making the user pick. Here the
+planner reuses the auto-tuner's candidate/prune/cost machinery and the
+plan materialises as a ('dp','fsdp','tp') Mesh.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core import enforce as E
+from paddle_tpu.distributed.engine import (Engine, ParallelPlan,
+                                           plan_parallel)
+
+NORTH_STAR = dict(num_params=8e9, num_layers=32, hidden_size=4096,
+                  seq_length=2048, dtype="bfloat16")
+
+
+class TestPlanner:
+    def test_hybrid_plan_when_naive_dp_cannot_fit(self):
+        # 8B params on 8 x 17.5 GB chips: pure dp needs 128 GB/chip of
+        # param+grad+optimizer state — the planner must find a hybrid
+        # (fsdp shards state, tp shards compute) and say why
+        plan = plan_parallel(8, NORTH_STAR, global_batch_size=8,
+                             hbm_bytes=17.5e9, chips_per_host=2,
+                             sharding_stage=3, use_recompute=True)
+        dp, sh, mp = plan.mesh_shape
+        assert dp * sh * mp == 8
+        assert sh > 1 and mp > 1, plan.describe()          # non-trivial
+        assert math.isinf(plan.naive_cost)                 # dp-only OOMs
+        assert plan.cost < plan.naive_cost
+        assert plan.config["estimated_memory_bytes"] <= 17.5e9
+        assert plan.candidates_feasible < plan.candidates_considered
+        assert "fsdp" in plan.describe()
+
+    def test_naive_dp_chosen_when_it_fits(self):
+        # tiny model, huge HBM: nothing beats pure data parallelism in
+        # the cost model (mp pays comm, pp pays bubble)
+        plan = plan_parallel(8, dict(num_params=1e6, num_layers=4,
+                                     hidden_size=64, seq_length=128),
+                             global_batch_size=64, hbm_bytes=95e9)
+        assert not math.isinf(plan.naive_cost)
+        assert plan.cost <= plan.naive_cost
+        assert plan.mesh_shape[2] == 1                     # no tp needed
+
+    def test_infeasible_raises_typed(self):
+        with pytest.raises(E.ResourceExhaustedError, match="no parallel"):
+            plan_parallel(2, NORTH_STAR, hbm_bytes=1e9)
+
+    def test_build_mesh(self):
+        plan = plan_parallel(8, NORTH_STAR, global_batch_size=8,
+                             hbm_bytes=17.5e9, chips_per_host=2)
+        mesh = plan.build_mesh()
+        assert mesh.axis_names == ("dp", "fsdp", "tp")
+        assert int(np.prod(mesh.devices.shape)) == 8
+
+    def test_dryrun_mesh_comes_from_planner(self):
+        import __graft_entry__ as g
+        assert g._mesh_shape(8) == (1, 4, 2)
+
+
+class TestEngine:
+    def test_prepare_plans_and_builds_mesh(self):
+        eng = Engine()
+        plan = eng.prepare(model_cfg=NORTH_STAR, n_devices=8,
+                           global_batch_size=8, hbm_bytes=17.5e9,
+                           chips_per_host=2)
+        assert isinstance(plan, ParallelPlan)
+        assert eng.mesh is not None and eng.plan is plan
+
+    def test_fit_evaluate_predict(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 4)).astype("float32")
+        Y = (X @ rng.normal(size=(4, 1)).astype("float32"))
+        model = nn.Linear(4, 1)
+        eng = Engine(model=model, loss=nn.MSELoss(),
+                     optimizer=optimizer.AdamW(
+                         learning_rate=0.05,
+                         parameters=model.parameters()))
+        data = [(paddle.to_tensor(X[i:i + 4]), paddle.to_tensor(Y[i:i + 4]))
+                for i in range(0, 16, 4)]
+        losses = eng.fit(data, epochs=30)
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+        assert eng.evaluate(data) < losses[0]
+        preds = eng.predict([(paddle.to_tensor(X[:4]),)])
+        assert tuple(preds[0].shape) == (4, 1)
+
+    def test_fit_requires_optimizer(self):
+        model = nn.Linear(2, 1)
+        eng = Engine(model=model, loss=nn.MSELoss())
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        y = paddle.to_tensor(np.ones((2, 1), "float32"))
+        with pytest.raises(E.NotFoundError):
+            eng.fit([(x, y)], epochs=1)
