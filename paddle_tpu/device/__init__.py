@@ -25,10 +25,57 @@ __all__ = [
     "is_compiled_with_distribute", "is_compiled_with_ipu",
     "is_compiled_with_rocm", "is_compiled_with_xpu", "IPUPlace", "XPUPlace",
     "Stream", "Event", "current_stream", "set_stream", "stream_guard",
-    "synchronize", "cuda",
+    "synchronize", "cuda", "register_pjrt_plugin",
 ]
 
 _current_device = None
+
+# -- plugin devices (reference: phi/backends/custom/custom_device.cc +
+# -- phi/capi/ — third-party hardware registers kernels/runtime hooks at
+# -- load time). TPU-native seam: a PJRT plugin .so IS the registration
+# -- unit — once registered as a jax platform, every op in this
+# -- framework reaches it through jnp/lax lowering, so no per-op C hook
+# -- table is needed (the PJRT C API plays the role of phi/capi).
+_custom_plugins: dict = {}
+
+
+def register_pjrt_plugin(device_type: str, library_path: str,
+                         options=None, priority: int = 400):
+    """Register a third-party PJRT plugin as a selectable device type.
+
+    ``library_path`` points at the vendor's PJRT C-API shared library
+    (the artifact every modern accelerator vendor ships). After
+    registration the platform participates in jax backend discovery:
+    ``set_device("<device_type>")``, sharding meshes, and every op in
+    this framework work unchanged on it. Registration is idempotent per
+    device_type; the library loads lazily at first backend use.
+    """
+    import os
+
+    from ..core import enforce as E
+
+    E.enforce(device_type and device_type.isidentifier(),
+              f"plugin device_type must be an identifier, got "
+              f"{device_type!r}", E.InvalidArgumentError)
+    if device_type in _custom_plugins:
+        return _custom_plugins[device_type]
+    if not os.path.exists(library_path):
+        raise E.NotFoundError(
+            f"PJRT plugin library not found: {library_path!r}",
+            hint="pass the vendor's PJRT C-API .so (see jax_plugins "
+                 "packaging for the entry-point alternative)")
+    from jax._src import xla_bridge as _xb
+
+    try:
+        _xb.register_plugin(device_type, library_path=str(library_path),
+                            options=options, priority=priority)
+    except Exception as e:
+        raise E.ExternalError(
+            f"PJRT plugin {library_path!r} failed to load: {e}",
+            hint="the library must export GetPjrtApi (PJRT C API)") \
+            from e
+    _custom_plugins[device_type] = str(library_path)
+    return str(library_path)
 
 
 def get_all_device_type():
@@ -40,7 +87,7 @@ def get_all_device_type():
 
 
 def get_all_custom_device_type():
-    return []
+    return sorted(_custom_plugins)
 
 
 def get_available_device():
@@ -56,7 +103,14 @@ def get_available_device():
 
 
 def get_available_custom_device():
-    return []
+    out = []
+    for t in sorted(_custom_plugins):
+        try:
+            for d in jax.devices(t):
+                out.append(f"{t}:{d.id}")
+        except RuntimeError:
+            pass        # registered but not initializable on this host
+    return out
 
 
 def get_cudnn_version():
@@ -101,7 +155,7 @@ def is_compiled_with_ipu():
 
 
 def is_compiled_with_custom_device(device_type):
-    return False
+    return device_type in _custom_plugins
 
 
 def is_compiled_with_distribute():

@@ -1,0 +1,60 @@
+// Minimal PJRT C-API plugin for the plugin-device seam test
+// (tests/test_device_plugin.py). Shaped exactly like a vendor plugin —
+// exports GetPjrtApi returning a versioned PJRT_Api — but owns no
+// hardware: PJRT_Client_Create reports UNIMPLEMENTED through the real
+// error protocol, so registration succeeds and backend initialization
+// fails CLEANLY (the registered-but-unavailable state the framework
+// must handle).
+#include <cstring>
+#include <string>
+
+#include "tensorflow/compiler/xla/pjrt/c/pjrt_c_api.h"
+
+struct PJRT_Error {
+  std::string message;
+};
+
+static void ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete args->error;
+}
+
+static void ErrorMessage(PJRT_Error_Message_Args* args) {
+  args->message = args->error->message.c_str();
+  args->message_size = args->error->message.size();
+}
+
+static PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = PJRT_Error_Code_UNIMPLEMENTED;
+  return nullptr;
+}
+
+static PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+
+static PJRT_Error* PluginAttributes(PJRT_Plugin_Attributes_Args* args) {
+  args->num_attributes = 0;
+  args->attributes = nullptr;
+  return nullptr;
+}
+
+static PJRT_Error* ClientCreate(PJRT_Client_Create_Args*) {
+  return new PJRT_Error{
+      "fake_pjrt test plugin: no hardware behind this plugin"};
+}
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api api;
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = ErrorDestroy;
+  api.PJRT_Error_Message = ErrorMessage;
+  api.PJRT_Error_GetCode = ErrorGetCode;
+  api.PJRT_Plugin_Initialize = PluginInitialize;
+  api.PJRT_Plugin_Attributes = PluginAttributes;
+  api.PJRT_Client_Create = ClientCreate;
+  return &api;
+}

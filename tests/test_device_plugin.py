@@ -1,0 +1,86 @@
+"""Plugin-device registration seam (VERDICT-r4 item 10).
+
+Reference: paddle/phi/backends/custom/custom_device.cc + phi/capi/ —
+runtime registration of third-party devices. TPU-native seam: a PJRT
+C-API plugin registers as a jax platform; ops reach it through the
+jnp/lax lowering with no per-op hook table. The test builds a REAL
+plugin .so (tests/_fake_pjrt_plugin.cc, the vendor-artifact shape) that
+owns no hardware, so registration succeeds and initialization fails
+through the PJRT error protocol instead of crashing.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import device
+from paddle_tpu.core import enforce as E
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "_fake_pjrt_plugin.cc")
+_TF_INC = None
+for p in sys.path + [os.path.join(sys.prefix, "lib",
+                                  f"python{sys.version_info.major}."
+                                  f"{sys.version_info.minor}",
+                                  "site-packages")]:
+    cand = os.path.join(p, "tensorflow", "include")
+    if os.path.isdir(os.path.join(cand, "tensorflow", "compiler", "xla",
+                                  "pjrt", "c")):
+        _TF_INC = cand
+        break
+
+
+@pytest.fixture(scope="module")
+def plugin_so(tmp_path_factory):
+    if shutil.which("g++") is None or _TF_INC is None:
+        pytest.skip("g++ or pjrt_c_api.h unavailable")
+    out = tmp_path_factory.mktemp("pjrt") / "libfake_pjrt.so"
+    r = subprocess.run(
+        ["g++", "-shared", "-fPIC", "-O1", f"-I{_TF_INC}",
+         _SRC, "-o", str(out)],
+        capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        pytest.skip(f"stub plugin did not compile: {r.stderr[-800:]}")
+    return str(out)
+
+
+class TestPluginSeam:
+    def test_missing_library_raises_typed(self):
+        with pytest.raises(E.NotFoundError, match="not found"):
+            device.register_pjrt_plugin("my_npu", "/nonexistent/libfoo.so")
+        assert "my_npu" not in device.get_all_custom_device_type()
+
+    def test_bad_name_raises_typed(self):
+        with pytest.raises(E.InvalidArgumentError, match="identifier"):
+            device.register_pjrt_plugin("my npu!", "/tmp/x.so")
+
+    def test_non_plugin_library_rejected(self, tmp_path):
+        bogus = tmp_path / "libnotaplugin.so"
+        bogus.write_bytes(b"\x7fELF not a real library")
+        with pytest.raises(E.ExternalError, match="failed to load"):
+            device.register_pjrt_plugin("bogusdev", str(bogus))
+        assert "bogusdev" not in device.get_all_custom_device_type()
+
+    def test_register_and_query(self, plugin_so):
+        got = device.register_pjrt_plugin("fakedev", plugin_so)
+        assert got == plugin_so
+        assert "fakedev" in device.get_all_custom_device_type()
+        assert device.is_compiled_with_custom_device("fakedev")
+        # idempotent: re-registering the same type returns the recorded
+        # path without reloading
+        assert device.register_pjrt_plugin("fakedev", "/other.so") \
+            == plugin_so
+        # the stub owns no hardware: Client_Create reports UNIMPLEMENTED
+        # through the PJRT error protocol, so the type is registered-
+        # but-unavailable and the query must not raise
+        assert not any(d.startswith("fakedev:")
+                       for d in device.get_available_custom_device())
+
+    def test_compute_unaffected_by_registration(self, plugin_so):
+        device.register_pjrt_plugin("fakedev", plugin_so)
+        x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        assert float((x * 2).sum()) == 30.0
