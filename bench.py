@@ -349,11 +349,21 @@ def _main():
                     f"WARNING: pallas flash kernel did not engage: {stats}\n")
 
             _stage("timed-loop", 240)
+            # two independent timed windows: the r3 stability ask —
+            # a single sample can't show run-to-run variance, two
+            # back-to-back windows bound it in one bench invocation
             t0 = time.perf_counter()
             for _ in range(iters):
                 params, opt_state, loss = step(params, opt_state, ids)
+            float(loss)               # drain before closing window 1
+            t1 = time.perf_counter()
+            for _ in range(iters):
+                params, opt_state, loss = step(params, opt_state, ids)
             final_loss = float(loss)  # device->host fetch = pipeline drain
-            dt = time.perf_counter() - t0
+            t2 = time.perf_counter()
+            window_dts = [t1 - t0, t2 - t1]
+            iters *= 2
+            dt = t2 - t0
             break
         except Exception as e:
             last_err = f"{type(e).__name__}: {e}"
@@ -398,6 +408,11 @@ def _main():
                   "layers": cfg.num_hidden_layers,
                   "vocab": cfg.vocab_size,
                   "moment_dtype": moments,
+                  "tps_windows": [round(batch * seq * (iters // 2) / w, 2)
+                                  for w in window_dts],
+                  "window_spread_pct": round(
+                      abs(window_dts[0] - window_dts[1])
+                      / (dt / 2) * 100, 2),
                   "flash_dispatch": stats,
                   "autotune": _autotune_summary(),
                   # NaN/inf would make the line unparseable as strict JSON

@@ -16,6 +16,15 @@ Two beat sources, two failure classes:
   WEDGED-BUT-ALIVE workers (hung collective, stuck IO), which auto
   beats cannot see. The watcher uses the progress threshold only for
   workers that have opted in by emitting at least one progress beat.
+
+Multi-host transport (no shared filesystem needed): beats ALSO publish
+to the jax.distributed coordination-service KV store when a client is
+live (the same store TCPStore maps to). ``KVHeartbeatWatcher`` measures
+staleness clock-skew-free — it tracks when each rank's beat VALUE last
+CHANGED on the watcher's own clock, never comparing cross-host
+timestamps — and ``start_kv_relay`` (rank-0 worker) mirrors every
+rank's KV beats into the local controller's heartbeat dir, so the
+file-based launch watcher covers remote hosts unchanged.
 """
 from __future__ import annotations
 
@@ -27,7 +36,9 @@ from typing import Dict, Optional
 
 _AUTO_SUFFIX = ".alive"
 _PROGRESS_SUFFIX = ".progress"
-_state = {"thread": None, "stop": None, "dir": None, "rank": None}
+_KV_PREFIX = "paddle_hb"
+_state = {"thread": None, "stop": None, "dir": None, "rank": None,
+          "seq": 0}
 
 
 def _touch(path, payload=None):
@@ -35,6 +46,31 @@ def _touch(path, payload=None):
     with open(tmp, "w") as f:
         f.write(json.dumps(payload or {"t": time.time()}))
     os.replace(tmp, path)
+
+
+def _kv_client():
+    """The live coordination-service client, or None (single-process /
+    pre-init)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def _kv_publish(kind: str, rank: int, payload: dict):
+    client = _kv_client()
+    if client is None:
+        return False
+    _state["seq"] += 1
+    payload = dict(payload, seq=_state["seq"])
+    try:
+        client.key_value_set(f"{_KV_PREFIX}/{kind}/rank{rank}",
+                             json.dumps(payload), allow_overwrite=True)
+        return True
+    except Exception:
+        return False
 
 
 def start(dir_path: Optional[str] = None, rank: Optional[int] = None,
@@ -58,6 +94,7 @@ def start(dir_path: Optional[str] = None, rank: Optional[int] = None,
                 _touch(path)
             except OSError:
                 pass
+            _kv_publish("auto", rank, {"t": time.time()})
             stop.wait(interval)
 
     th = threading.Thread(target=loop, daemon=True)
@@ -75,15 +112,16 @@ def stop():
 def beat(step: Optional[int] = None):
     """Emit a PROGRESS beat from the training loop. A worker that emits
     one opts into wedge detection: the watcher kills the job if its
-    progress beat goes stale."""
-    dir_path = _state["dir"] or os.environ.get("PADDLE_HEARTBEAT_DIR")
-    if not dir_path:
-        return
+    progress beat goes stale. Publishes to the file dir (when set) AND
+    the KV store (when a coordination client is live)."""
     rank = _state["rank"] if _state["rank"] is not None else int(
         os.environ.get("PADDLE_TRAINER_ID", "0"))
-    os.makedirs(dir_path, exist_ok=True)
-    _touch(os.path.join(dir_path, f"rank{rank}{_PROGRESS_SUFFIX}"),
-           {"t": time.time(), "step": step})
+    dir_path = _state["dir"] or os.environ.get("PADDLE_HEARTBEAT_DIR")
+    if dir_path:
+        os.makedirs(dir_path, exist_ok=True)
+        _touch(os.path.join(dir_path, f"rank{rank}{_PROGRESS_SUFFIX}"),
+               {"t": time.time(), "step": step})
+    _kv_publish("progress", rank, {"t": time.time(), "step": step})
 
 
 def check_stale(dir_path: str, ranks, auto_timeout: float,
@@ -121,3 +159,98 @@ def check_stale(dir_path: str, ranks, auto_timeout: float,
         except OSError:
             pass   # never opted in
     return stale
+
+
+# -- KV-store transport (multi-host, no shared filesystem) -------------------
+
+class KVHeartbeatWatcher:
+    """Staleness over KV beats, clock-skew-free: a rank's age is the
+    time since its beat VALUE last changed, measured on THIS process's
+    clock (cross-host timestamps are never compared — the etcd-lease
+    property the reference relies on)."""
+
+    def __init__(self, client=None):
+        self._client = client if client is not None else _kv_client()
+        # key -> (last value, local time the value last changed)
+        self._last: Dict[str, tuple] = {}
+
+    def _age(self, key: str, now: float) -> Optional[float]:
+        try:
+            val = self._client.key_value_try_get(key)
+        except Exception:
+            return None                 # never published
+        prev = self._last.get(key)
+        if prev is None or prev[0] != val:
+            self._last[key] = (val, now)
+            return 0.0
+        return now - prev[1]
+
+    def check(self, ranks, auto_timeout: float, progress_timeout: float,
+              started_at: Optional[float] = None) -> Dict[int, str]:
+        """Same contract as ``check_stale``, over the KV transport."""
+        now = time.time()
+        stale: Dict[int, str] = {}
+        for rank in ranks:
+            age = self._age(f"{_KV_PREFIX}/auto/rank{rank}", now)
+            if age is None:
+                if (auto_timeout > 0 and started_at is not None
+                        and now - started_at > auto_timeout):
+                    stale[rank] = ("never published a liveness beat "
+                                   f"({now - started_at:.1f}s since "
+                                   "launch)")
+                continue
+            if auto_timeout > 0 and age > auto_timeout:
+                stale[rank] = f"no liveness beat for {age:.1f}s"
+                continue
+            page = self._age(f"{_KV_PREFIX}/progress/rank{rank}", now)
+            if page is not None and progress_timeout > 0 \
+                    and page > progress_timeout:
+                stale[rank] = f"no training progress for {page:.1f}s"
+        return stale
+
+    def latest(self, kind: str, rank: int) -> Optional[dict]:
+        try:
+            return json.loads(self._client.key_value_try_get(
+                f"{_KV_PREFIX}/{kind}/rank{rank}"))
+        except Exception:
+            return None
+
+
+def start_kv_relay(dir_path: str, world_ranks, interval: float = 1.0,
+                   client=None) -> Optional[threading.Event]:
+    """Rank-0 side: mirror every rank's KV beats into ``dir_path`` as
+    the files the launch controller already watches, so a controller
+    with no shared filesystem (and no coordination client of its own)
+    sees remote hosts' liveness through its local disk. A rank's file
+    is touched only when its KV beat VALUE changes, preserving the
+    staleness signal. Returns the stop event (None if no client)."""
+    watcher = KVHeartbeatWatcher(client)
+    if watcher._client is None:
+        return None
+    os.makedirs(dir_path, exist_ok=True)
+    stop = threading.Event()
+    seen: Dict[str, str] = {}
+
+    def loop():
+        while not stop.is_set():
+            for rank in world_ranks:
+                for kind, suffix in (("auto", _AUTO_SUFFIX),
+                                     ("progress", _PROGRESS_SUFFIX)):
+                    key = f"{_KV_PREFIX}/{kind}/rank{rank}"
+                    try:
+                        val = watcher._client.key_value_try_get(key)
+                    except Exception:
+                        continue
+                    if seen.get(key) == val:
+                        continue
+                    seen[key] = val
+                    try:
+                        _touch(os.path.join(
+                            dir_path, f"rank{rank}{suffix}"),
+                            json.loads(val))
+                    except (OSError, ValueError):
+                        pass
+            stop.wait(interval)
+
+    threading.Thread(target=loop, daemon=True).start()
+    return stop
