@@ -1,27 +1,52 @@
 """paddle.onnx parity surface (reference: python/paddle/onnx/__init__.py
 -> paddle2onnx).
 
-The reference delegates to the external paddle2onnx converter. This
-runtime's portable deployment artifact is the StableHLO bundle
-(`paddle.jit.save`), which serves through `paddle.inference` and any
-StableHLO consumer. ``export`` converts through onnx only when an onnx
-exporter for StableHLO is importable; otherwise it saves the StableHLO
-artifact next to the requested path and raises with the pointer, so the
-capability delta is explicit (docs/CAPABILITY_DELTA.md).
+The reference delegates to the external paddle2onnx converter; here
+``export`` converts the traced model DIRECTLY to ONNX (opset 17)
+through the in-tree jaxpr -> ONNX pass (converter.py) — closed-over
+parameters become initializers, supported primitives map to ONNX ops,
+and the bytes are written through a protoc-compiled subset of the
+public ONNX schema. Models using primitives outside the supported set
+(control flow, TPU-kernel paths) still save a StableHLO artifact
+(``paddle.jit.save`` format, the full-fidelity deploy path) and raise a
+typed error naming the unsupported primitive.
 """
 from __future__ import annotations
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    from .. import jit
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Export ``layer`` to ``path`` (``.onnx`` appended if absent).
+    ``input_spec``: example inputs or InputSpec list (concrete dims)."""
+    import numpy as np
 
-    artifact = path[:-5] if path.endswith(".onnx") else path
-    jit.save(layer, artifact, input_spec=input_spec)
-    raise NotImplementedError(
-        "ONNX conversion requires the external paddle2onnx/odml "
-        "toolchain, unavailable in this environment. The model was saved "
-        f"as a StableHLO artifact at {artifact!r} (paddle.jit.save "
-        "format) — the portable interchange this runtime supports; load "
-        "it with paddle.jit.load or paddle.inference.Predictor.")
+    from ..core import enforce as E
+    from ..jit.api import InputSpec
+    from .converter import export_layer
+
+    E.enforce_not_none(input_spec, "input_spec",
+                       hint="onnx.export needs example inputs or "
+                            "InputSpec(shape, dtype) entries")
+    examples = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            E.enforce(all(isinstance(d, int) and d > 0 for d in s.shape),
+                      f"onnx.export InputSpec dims must be concrete, "
+                      f"got {s.shape}", E.InvalidArgumentError)
+            examples.append(np.zeros(s.shape, dtype=s.dtype))
+        else:
+            examples.append(s)
+
+    onnx_path = path if path.endswith(".onnx") else path + ".onnx"
+    try:
+        model = export_layer(layer, examples)
+    except E.UnimplementedError:
+        from .. import jit
+
+        artifact = path[:-5] if path.endswith(".onnx") else path
+        jit.save(layer, artifact, input_spec=input_spec)
+        raise
+    with open(onnx_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_path

@@ -1,0 +1,216 @@
+"""Minimal numpy ONNX interpreter for validating paddle_tpu.onnx
+exports end-to-end (no onnx/onnxruntime exists in this environment).
+Executes exactly the op subset the converter emits; an unknown op is a
+test failure, not a skip."""
+import numpy as np
+
+from paddle_tpu.onnx import onnx_pb2 as P
+
+_NP_DTYPE = {
+    P.TensorProto.FLOAT: np.float32, P.TensorProto.DOUBLE: np.float64,
+    P.TensorProto.FLOAT16: np.float16, P.TensorProto.INT32: np.int32,
+    P.TensorProto.INT64: np.int64, P.TensorProto.INT16: np.int16,
+    P.TensorProto.INT8: np.int8, P.TensorProto.UINT8: np.uint8,
+    P.TensorProto.BOOL: np.bool_,
+}
+
+
+def tensor_to_np(t):
+    if t.data_type == P.TensorProto.BFLOAT16:
+        import jax.numpy as jnp
+        raw = np.frombuffer(t.raw_data, np.uint16).reshape(tuple(t.dims))
+        return np.asarray(raw.view(jnp.bfloat16), np.float32)
+    dt = _NP_DTYPE[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims)).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, dt).reshape(tuple(t.dims))
+    if t.int64_data:
+        return np.asarray(t.int64_data, dt).reshape(tuple(t.dims))
+    return np.zeros(tuple(t.dims), dt)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == P.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+    return out
+
+
+def _conv(x, w, attrs):
+    group = attrs.get("group", 1)
+    strides = attrs.get("strides", [1, 1])
+    dil = attrs.get("dilations", [1, 1])
+    pads = attrs.get("pads", [0] * 4)
+    nsp = x.ndim - 2
+    pad_width = [(0, 0), (0, 0)] + [
+        (pads[i], pads[nsp + i]) for i in range(nsp)]
+    x = np.pad(x, pad_width)
+    N, C = x.shape[:2]
+    O, I = w.shape[:2]
+    ksp = w.shape[2:]
+    out_sp = [
+        (x.shape[2 + i] - (dil[i] * (ksp[i] - 1) + 1)) // strides[i] + 1
+        for i in range(nsp)]
+    out = np.zeros((N, O, *out_sp), np.float32)
+    cg, og = C // group, O // group
+    for g in range(group):
+        for o in range(og):
+            for idx in np.ndindex(*out_sp):
+                patch = x[:, g * cg:(g + 1) * cg]
+                sl = tuple(
+                    slice(idx[i] * strides[i],
+                          idx[i] * strides[i] + dil[i] * (ksp[i] - 1) + 1,
+                          dil[i])
+                    for i in range(nsp))
+                val = (patch[(slice(None), slice(None)) + sl]
+                       * w[g * og + o]).sum(axis=tuple(range(1, 2 + nsp)))
+                out[(slice(None), g * og + o) + idx] = val
+    return out
+
+
+def run(model, inputs):
+    """Execute the graph; returns list of output arrays."""
+    g = model.graph
+    env = {}
+    for t in g.initializer:
+        env[t.name] = tensor_to_np(t)
+    names = [vi.name for vi in g.input]
+    assert len(names) == len(inputs), (names, len(inputs))
+    for n, x in zip(names, inputs):
+        env[n] = np.asarray(x)
+
+    for node in g.node:
+        i = [env[n] for n in node.input]
+        a = _attrs(node)
+        op = node.op_type
+        if op == "Identity":
+            r = i[0]
+        elif op == "Add":
+            r = i[0] + i[1]
+        elif op == "Sub":
+            r = i[0] - i[1]
+        elif op == "Mul":
+            r = i[0] * i[1]
+        elif op == "Div":
+            r = i[0] / i[1]
+        elif op == "Max":
+            r = np.maximum(i[0], i[1])
+        elif op == "Min":
+            r = np.minimum(i[0], i[1])
+        elif op == "Neg":
+            r = -i[0]
+        elif op == "Exp":
+            r = np.exp(i[0])
+        elif op == "Log":
+            r = np.log(i[0])
+        elif op == "Tanh":
+            r = np.tanh(i[0])
+        elif op == "Sigmoid":
+            r = 1.0 / (1.0 + np.exp(-i[0]))
+        elif op == "Sqrt":
+            r = np.sqrt(i[0])
+        elif op == "Reciprocal":
+            r = 1.0 / i[0]
+        elif op == "Abs":
+            r = np.abs(i[0])
+        elif op == "Sign":
+            r = np.sign(i[0])
+        elif op == "Floor":
+            r = np.floor(i[0])
+        elif op == "Ceil":
+            r = np.ceil(i[0])
+        elif op == "Round":
+            r = np.round(i[0])
+        elif op == "Erf":
+            from scipy.special import erf as _erf  # noqa
+            r = _erf(i[0]).astype(i[0].dtype)
+        elif op == "Pow":
+            r = np.power(i[0], i[1]).astype(i[0].dtype)
+        elif op == "Not":
+            r = ~i[0]
+        elif op == "And":
+            r = i[0] & i[1]
+        elif op == "Or":
+            r = i[0] | i[1]
+        elif op == "Mod":
+            r = np.fmod(i[0], i[1])
+        elif op == "Sin":
+            r = np.sin(i[0])
+        elif op == "Cos":
+            r = np.cos(i[0])
+        elif op == "Cast":
+            r = i[0].astype(_NP_DTYPE[a["to"]] if a["to"] !=
+                            P.TensorProto.BFLOAT16 else np.float32)
+        elif op == "Reshape":
+            r = i[0].reshape(tuple(int(d) for d in i[1]))
+        elif op == "Transpose":
+            r = np.transpose(i[0], a["perm"])
+        elif op == "Expand":
+            r = np.broadcast_to(i[0], tuple(int(d) for d in i[1]))
+        elif op == "ReduceSum":
+            axes = tuple(int(d) for d in i[1])
+            r = i[0].sum(axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+            f = {"ReduceMax": np.max, "ReduceMin": np.min,
+                 "ReduceProd": np.prod}[op]
+            r = f(i[0], axis=tuple(a["axes"]),
+                  keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ArgMax", "ArgMin"):
+            f = np.argmax if op == "ArgMax" else np.argmin
+            r = f(i[0], axis=a["axis"])
+            if a.get("keepdims", 1):
+                r = np.expand_dims(r, a["axis"])
+        elif op == "Concat":
+            r = np.concatenate(i, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (i[1], i[2], i[3], i[4])
+            idx = [slice(None)] * i[0].ndim
+            imax = np.iinfo(np.int64).max
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                s, e = int(s), int(e)
+                e = None if e >= imax else (None if e <= -imax else e)
+                idx[int(ax)] = slice(s, e, int(st))
+            r = i[0][tuple(idx)]
+        elif op == "Pad":
+            pads = [int(d) for d in i[1]]
+            n = len(pads) // 2
+            r = np.pad(i[0], [(pads[k], pads[n + k]) for k in range(n)],
+                       constant_values=i[2] if len(i) > 2 else 0)
+        elif op == "Where":
+            r = np.where(i[0], i[1], i[2])
+        elif op == "Equal":
+            r = i[0] == i[1]
+        elif op == "Less":
+            r = i[0] < i[1]
+        elif op == "LessOrEqual":
+            r = i[0] <= i[1]
+        elif op == "Greater":
+            r = i[0] > i[1]
+        elif op == "GreaterOrEqual":
+            r = i[0] >= i[1]
+        elif op == "Einsum":
+            r = np.einsum(a["equation"], *i)
+        elif op == "Gather":
+            r = np.take(i[0], i[1].astype(np.int64), axis=a["axis"])
+        elif op == "Conv":
+            r = _conv(i[0].astype(np.float32), i[1].astype(np.float32),
+                      a)
+        elif op == "Clip":
+            r = np.clip(i[0], i[1], i[2])
+        elif op == "CumSum":
+            r = np.cumsum(i[0], axis=int(i[1]))
+        else:
+            raise AssertionError(f"interpreter has no op {op}")
+        env[node.output[0]] = np.asarray(r)
+
+    return [env[vi.name] for vi in g.output]
