@@ -444,6 +444,45 @@ def _conv(ctx, eqn):
              group=int(p["feature_group_count"]))
 
 
+@_handler("reduce_window_max", "reduce_window_sum")
+def _reduce_window(ctx, eqn):
+    p = eqn.params
+    wd = tuple(p["window_dimensions"])
+    ws = tuple(p["window_strides"])
+    pad = tuple(p["padding"])
+    E.enforce(len(wd) >= 3 and wd[0] == wd[1] == 1
+              and ws[0] == ws[1] == 1 and pad[0] == (0, 0)
+              and pad[1] == (0, 0),
+              "reduce_window must be NC-leading spatial pooling",
+              E.UnimplementedError)
+    E.enforce(all(d == 1 for d in p["base_dilation"]),
+              "base-dilated reduce_window unsupported",
+              E.UnimplementedError)
+    E.enforce(all(d == 1 for d in p["window_dilation"]),
+              "window-dilated reduce_window unsupported",
+              E.UnimplementedError)
+    kernel = list(wd[2:])
+    strides = list(ws[2:])
+    pads = ([lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]])
+    x = _in(ctx, eqn, 0)
+    out = _out(ctx, eqn)
+    if eqn.primitive.name == "reduce_window_max":
+        # ONNX MaxPool ignores pad cells — identical to lax's -inf pad
+        ctx.emit("MaxPool", [x], [out], kernel_shape=kernel,
+                 strides=strides, pads=pads)
+    else:
+        # sum-pool = AveragePool(count_include_pad) * window_size; the
+        # caller's following Div turns it back into the mean
+        mid = ctx.fresh("avg")
+        ctx.emit("AveragePool", [x], [mid], kernel_shape=kernel,
+                 strides=strides, pads=pads, count_include_pad=1)
+        n = float(np.prod(kernel))
+        ctx.emit("Mul",
+                 [mid, ctx.add_const(np.asarray(
+                     n, np.dtype(eqn.outvars[0].aval.dtype)))],
+                 [out])
+
+
 @_handler("cumsum")
 def _cumsum(ctx, eqn):
     ctx.emit("CumSum",
@@ -451,6 +490,84 @@ def _cumsum(ctx, eqn):
               ctx.add_const(np.asarray(eqn.params["axis"], np.int64))],
              [_out(ctx, eqn)],
              reverse=int(bool(eqn.params.get("reverse", False))))
+
+
+_MAX_SCAN_UNROLL = 128
+
+
+@_handler("scan")
+def _scan(ctx, eqn):
+    """Static-length scan UNROLLS into the graph (ONNX's Loop op exists
+    but unrolling serves the dominant inference case — scan-over-layers
+    decoders — with plain dataflow every consumer optimizes well)."""
+    p = eqn.params
+    length = int(p["length"])
+    E.enforce_le(length, _MAX_SCAN_UNROLL,
+                 f"scan length {length} exceeds the ONNX unroll cap",
+                 error=E.UnimplementedError)
+    E.enforce(not p.get("reverse", False), "reverse scan unsupported",
+              E.UnimplementedError)
+    closed = p["jaxpr"]
+    inner, consts = closed.jaxpr, closed.consts
+    n_consts = int(p["num_consts"])
+    n_carry = int(p["num_carry"])
+
+    const_names = [ctx.name_of(v) for v in eqn.invars[:n_consts]]
+    carry = [ctx.name_of(v) for v in eqn.invars[n_consts:n_consts
+                                                + n_carry]]
+    xs_vars = eqn.invars[n_consts + n_carry:]
+    xs_names = [ctx.name_of(v) for v in xs_vars]
+    ys_avals = [ov.aval for ov in eqn.outvars[n_carry:]]
+    ys_parts: List[List[str]] = [[] for _ in ys_avals]
+
+    for cv, cval in zip(inner.constvars, consts):
+        ctx.names[cv] = ctx.add_const(np.asarray(cval))
+
+    for it in range(length):
+        # slice iteration it from each scanned input and drop axis 0
+        x_slice_names = []
+        for xv, xn in zip(xs_vars, xs_names):
+            shp = xv.aval.shape
+            sl = ctx.fresh("scan_x")
+            ctx.emit("Slice",
+                     [xn,
+                      ctx.add_const(np.asarray([it], np.int64)),
+                      ctx.add_const(np.asarray([it + 1], np.int64)),
+                      ctx.add_const(np.asarray([0], np.int64)),
+                      ctx.add_const(np.asarray([1], np.int64))],
+                     [sl])
+            sq = ctx.fresh("scan_xs")
+            ctx.emit("Reshape",
+                     [sl, ctx.add_const(np.asarray(shp[1:], np.int64))],
+                     [sq])
+            x_slice_names.append(sq)
+        # bind body inputs: consts, carry, x-slices — fresh names per
+        # iteration so emitted nodes don't collide
+        local: Dict[Any, str] = dict(ctx.names)
+        for iv, nm in zip(inner.invars,
+                          const_names + carry + x_slice_names):
+            local[iv] = nm
+        saved, ctx.names = ctx.names, local
+        _walk(ctx, inner)
+        new_carry = [ctx.name_of(ov) for ov in inner.outvars[:n_carry]]
+        ys_now = [ctx.name_of(ov) for ov in inner.outvars[n_carry:]]
+        ctx.names = saved
+        carry = new_carry
+        for k, (y, aval) in enumerate(zip(ys_now, ys_avals)):
+            ex = ctx.fresh("scan_y")
+            ctx.emit("Reshape",
+                     [y, ctx.add_const(np.asarray(
+                         (1,) + tuple(aval.shape[1:]), np.int64))],
+                     [ex])
+            ys_parts[k].append(ex)
+
+    for c_out, nm in zip(eqn.outvars[:n_carry], carry):
+        ctx.emit("Identity", [nm], [ctx.name_of(c_out)])
+    for y_out, parts in zip(eqn.outvars[n_carry:], ys_parts):
+        if len(parts) == 1:
+            ctx.emit("Identity", [parts[0]], [ctx.name_of(y_out)])
+        else:
+            ctx.emit("Concat", parts, [ctx.name_of(y_out)], axis=0)
 
 
 @_handler("pjit", "jit", "closed_call", "custom_jvp_call",

@@ -205,6 +205,29 @@ def run(model, inputs):
         elif op == "Conv":
             r = _conv(i[0].astype(np.float32), i[1].astype(np.float32),
                       a)
+        elif op in ("MaxPool", "AveragePool"):
+            kernel = a["kernel_shape"]
+            strides = a.get("strides", [1] * len(kernel))
+            pads = a.get("pads", [0] * (2 * len(kernel)))
+            nsp = len(kernel)
+            fill = (-np.inf if op == "MaxPool" else 0.0)
+            x = np.pad(i[0].astype(np.float64),
+                       [(0, 0), (0, 0)] + [(pads[k], pads[nsp + k])
+                                           for k in range(nsp)],
+                       constant_values=fill)
+            out_sp = [(x.shape[2 + k] - kernel[k]) // strides[k] + 1
+                      for k in range(nsp)]
+            r = np.zeros(i[0].shape[:2] + tuple(out_sp))
+            for idx in np.ndindex(*out_sp):
+                sl = tuple(slice(idx[k] * strides[k],
+                                 idx[k] * strides[k] + kernel[k])
+                           for k in range(nsp))
+                win = x[(slice(None), slice(None)) + sl]
+                red = (win.max(axis=tuple(range(2, 2 + nsp)))
+                       if op == "MaxPool"
+                       else win.mean(axis=tuple(range(2, 2 + nsp))))
+                r[(slice(None), slice(None)) + idx] = red
+            r = r.astype(i[0].dtype)
         elif op == "Clip":
             r = np.clip(i[0], i[1], i[2])
         elif op == "CumSum":
