@@ -59,6 +59,12 @@ def init_parallel_env():
     try:
         from . import heartbeat as _hb
         _hb.start()
+        # multi-host relay: rank 0 mirrors every rank's KV beats into
+        # the primary controller's heartbeat dir so its file watcher
+        # covers hosts with no shared filesystem
+        relay_dir = os.environ.get("PADDLE_HEARTBEAT_KV_RELAY")
+        if relay_dir and get_rank() == 0:
+            _hb.start_kv_relay(relay_dir, range(get_world_size()))
     except Exception:
         pass
     _initialized = True
