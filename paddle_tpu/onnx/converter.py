@@ -417,9 +417,29 @@ def _gather(ctx, eqn):
             ctx.emit("Gather", [_in(ctx, eqn, 0), idx],
                      [_out(ctx, eqn)], axis=axis)
             return
+    # take_along_axis pattern: batched single-axis element gather ->
+    # ONNX GatherElements
+    batching = tuple(getattr(d, "operand_batching_dims", ()))
+    if (len(d.start_index_map) == 1 and d.offset_dims == ()
+            and d.collapsed_slice_dims == d.start_index_map
+            and all(s == 1 for s in slice_sizes)
+            and batching == tuple(i for i in range(len(operand.shape))
+                                  if i != d.start_index_map[0])):
+        axis = d.start_index_map[0]
+        out_shape = eqn.outvars[0].aval.shape
+        idx = _in(ctx, eqn, 1)
+        mid = ctx.fresh("idxsq")
+        ctx.emit("Reshape",
+                 [idx, ctx.add_const(np.asarray(out_shape, np.int64))],
+                 [mid])
+        cast = ctx.fresh("idx64")
+        ctx.emit("Cast", [mid], [cast], to=P.TensorProto.INT64)
+        ctx.emit("GatherElements", [_in(ctx, eqn, 0), cast],
+                 [_out(ctx, eqn)], axis=axis)
+        return
     raise E.UnimplementedError(
         f"ONNX export: general gather {d} unsupported (only "
-        "jnp.take-style axis gathers)")
+        "jnp.take-style axis gathers and take_along_axis)")
 
 
 @_handler("conv_general_dilated")
@@ -490,6 +510,32 @@ def _cumsum(ctx, eqn):
               ctx.add_const(np.asarray(eqn.params["axis"], np.int64))],
              [_out(ctx, eqn)],
              reverse=int(bool(eqn.params.get("reverse", False))))
+
+
+@_handler("top_k")
+def _top_k(ctx, eqn):
+    k = ctx.add_const(np.asarray([eqn.params["k"]], np.int64))
+    vals, idx = ctx.name_of(eqn.outvars[0]), ctx.fresh("topk_i")
+    ctx.emit("TopK", [_in(ctx, eqn, 0), k], [vals, idx],
+             axis=-1, largest=1, sorted=1)
+    ctx.emit("Cast", [idx], [ctx.name_of(eqn.outvars[1])],
+             to=_onnx_dtype(eqn.outvars[1].aval.dtype))
+
+
+@_handler("sort")
+def _sort(ctx, eqn):
+    E.enforce_eq(len(eqn.invars), 1,
+                 "multi-operand sort (argsort) unsupported",
+                 error=E.UnimplementedError)
+    dim = int(eqn.params["dimension"])
+    aval = eqn.invars[0].aval
+    E.enforce_eq(dim, len(aval.shape) - 1, "sort on a non-last axis",
+                 error=E.UnimplementedError)
+    # jax sort is ascending: TopK(largest=0, sorted=1, k=dim size)
+    k = ctx.add_const(np.asarray([aval.shape[dim]], np.int64))
+    idx = ctx.fresh("sort_i")
+    ctx.emit("TopK", [_in(ctx, eqn, 0), k],
+             [_out(ctx, eqn), idx], axis=-1, largest=0, sorted=1)
 
 
 _MAX_SCAN_UNROLL = 128

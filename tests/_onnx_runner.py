@@ -202,6 +202,9 @@ def run(model, inputs):
             r = np.einsum(a["equation"], *i)
         elif op == "Gather":
             r = np.take(i[0], i[1].astype(np.int64), axis=a["axis"])
+        elif op == "GatherElements":
+            r = np.take_along_axis(i[0], i[1].astype(np.int64),
+                                   axis=a["axis"])
         elif op == "Conv":
             r = _conv(i[0].astype(np.float32), i[1].astype(np.float32),
                       a)
@@ -232,8 +235,20 @@ def run(model, inputs):
             r = np.clip(i[0], i[1], i[2])
         elif op == "CumSum":
             r = np.cumsum(i[0], axis=int(i[1]))
+        elif op == "TopK":
+            k = int(i[1][0])
+            axis = a.get("axis", -1)
+            order = np.argsort(i[0], axis=axis, kind="stable")
+            if a.get("largest", 1):
+                order = np.flip(order, axis=axis)
+            idx = np.take(order, range(k), axis=axis)
+            r = (np.take_along_axis(i[0], idx, axis=axis),
+                 idx.astype(np.int64))
         else:
             raise AssertionError(f"interpreter has no op {op}")
-        env[node.output[0]] = np.asarray(r)
+        if not isinstance(r, tuple):
+            r = (r,)
+        for nm, val in zip(node.output, r):
+            env[nm] = np.asarray(val)
 
     return [env[vi.name] for vi in g.output]

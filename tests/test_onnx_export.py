@@ -149,13 +149,22 @@ class TestOnnxExport:
         with pytest.raises(E.UnimplementedError, match="unroll cap"):
             to_onnx_model(fn, [np.ones((500, 2), "float32")])
 
+    def test_sort_topk_numerics(self):
+        class F(nn.Layer):
+            def forward(self, x):
+                v, i = paddle.topk(x, 3)
+                return paddle.sort(x, axis=-1), v
+
+        x = np.random.default_rng(7).normal(size=(4, 8)).astype("float32")
+        _check(F(), [x])
+
     def test_unsupported_primitive_typed_error(self, tmp_path):
         import jax.numpy as jnp
 
         def fn(x):
-            return jnp.sort(x, axis=-1)
+            return jnp.argsort(x, axis=-1)
 
-        with pytest.raises(E.UnimplementedError, match="sort"):
+        with pytest.raises(E.UnimplementedError, match="argsort"):
             to_onnx_model(fn, [np.ones((3, 2), "float32")])
 
     def test_export_api_writes_file(self, tmp_path):
@@ -171,7 +180,7 @@ class TestOnnxExport:
     def test_export_api_fallback_saves_stablehlo(self, tmp_path):
         class Sorter(nn.Layer):
             def forward(self, x):
-                return paddle.sort(x, axis=-1)     # 'sort' primitive
+                return paddle.argsort(x, axis=-1)  # multi-operand sort
 
         with pytest.raises(E.UnimplementedError, match="sort"):
             export(Sorter(), str(tmp_path / "s"),
