@@ -290,9 +290,12 @@ def adamw_init(params):
 
 
 def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
-                    lr: float = 1e-4):
+                    lr: float = 1e-4, donate: bool = True):
     """Jitted AdamW train step; with a mesh, params/opt-state placements
-    come from param_specs and the batch shards over ('dp','fsdp')."""
+    come from param_specs and the batch shards over ('dp','fsdp').
+    Buffer donation updates params/opt-state in place — without it the
+    step holds BOTH generations of the expert weights, which at MoE
+    sizes is the difference between fitting and OOM."""
     from .llama import _adamw_update
 
     def step(params, opt_state, batch):
@@ -301,8 +304,9 @@ def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
         params, opt_state = _adamw_update(params, grads, opt_state, lr)
         return params, opt_state, loss
 
+    dn = (0, 1) if donate else ()
     if mesh is None:
-        return jax.jit(step)
+        return jax.jit(step, donate_argnums=dn)
 
     specs = param_specs(config)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
@@ -314,4 +318,4 @@ def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
             batch, NamedSharding(mesh, P(("dp", "fsdp"), None)))
         return step(params, opt_state, batch)
 
-    return jax.jit(placed)
+    return jax.jit(placed, donate_argnums=dn)
