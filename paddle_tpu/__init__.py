@@ -76,6 +76,7 @@ import numpy as _np
 dtype = _np.dtype
 
 import jax as _jax
+from .core import enforce as E
 
 
 def check_shape(shape):
@@ -87,7 +88,7 @@ def check_shape(shape):
         neg = builtins.sum(1 for s in shape
                            if isinstance(s, int) and s < 0)
         if neg > 1:
-            raise ValueError(f"shape can carry at most one -1, got {shape}")
+            raise E.InvalidArgumentError(f"shape can carry at most one -1, got {shape}")
 
 
 def is_compiled_with_cuda() -> bool:

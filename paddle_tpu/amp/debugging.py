@@ -20,6 +20,7 @@ from collections import Counter
 import jax.numpy as jnp
 
 from ..ops import _op as _op_mod
+from ..core import enforce as E
 
 __all__ = ["DebugMode", "TensorCheckerConfig", "check_numerics",
            "check_layer_numerics", "collect_operator_stats",
@@ -131,7 +132,7 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
         n_nan = int(jnp.sum(jnp.isnan(arr)))
         n_inf = int(jnp.sum(jnp.isinf(arr)))
         if bool(jnp.any(bad)):
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 f"check_numerics: {op_type or 'tensor'} {var_name} has "
                 f"{n_nan} nan / {n_inf} inf values")
     return tensor

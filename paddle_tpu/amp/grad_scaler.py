@@ -12,6 +12,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..core import enforce as E
 
 __all__ = ["GradScaler", "AmpScaler", "OptimizerState"]
 
@@ -71,7 +72,7 @@ class GradScaler:
         if st == OptimizerState.UNSCALED:
             return
         if st == OptimizerState.STEPPED:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "unscale_() is being called after step() for this optimizer; "
                 "call update() first (reference: grad_scaler.py)")
         inv = 1.0 / self._scale
@@ -95,7 +96,7 @@ class GradScaler:
         st, _ = self._opt_states.get(id(optimizer),
                                      (OptimizerState.INIT, False))
         if st == OptimizerState.STEPPED:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "step() has already been called for this optimizer since the "
                 "last update()")
         if st != OptimizerState.UNSCALED:

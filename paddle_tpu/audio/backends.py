@@ -9,6 +9,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..ops._op import unwrap, wrap
+from ..core import enforce as E
 
 __all__ = ["load", "save", "info", "list_available_backends",
            "get_current_backend", "set_backend"]
@@ -27,7 +28,7 @@ def get_current_backend():
 def set_backend(name: str):
     global _backend
     if name not in list_available_backends():
-        raise ValueError(f"unknown audio backend {name!r}")
+        raise E.InvalidArgumentError(f"unknown audio backend {name!r}")
     _backend = name
 
 

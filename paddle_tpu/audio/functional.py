@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops._op import unwrap, wrap
+from ..core import enforce as E
 
 __all__ = [
     "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
@@ -143,5 +144,5 @@ def get_window(window, win_length: int, fftbins: bool = True,
     elif name == "triang":
         w = 1.0 - np.abs((i - n / 2.0) / ((win_length + 1) / 2.0))
     else:
-        raise ValueError(f"unsupported window {name!r}")
+        raise E.InvalidArgumentError(f"unsupported window {name!r}")
     return wrap(jnp.asarray(w.astype(dtype)))

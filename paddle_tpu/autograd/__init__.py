@@ -6,6 +6,7 @@ from __future__ import annotations
 from ..core.state import enable_grad, no_grad, set_grad_enabled  # noqa
 from .py_layer import PyLayer, PyLayerContext  # noqa
 from .tape import GradNode, record_node, run_backward  # noqa
+from ..core import enforce as E
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -45,7 +46,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     for t in inputs:
         g = sink.get(id(t))
         if g is None and not allow_unused:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "One of the differentiated tensors appears to not have "
                 "been used in the graph (set allow_unused=True to allow).")
         if g is None:

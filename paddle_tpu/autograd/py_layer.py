@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..core import state
 from ..core.tensor import Tensor
 from . import tape
+from ..core import enforce as E
 
 
 class PyLayerContext:
@@ -101,7 +102,7 @@ class PyLayer:
                 grads = cls.backward(ctx, *cot_tensors)
             grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
             if len(grads) != len(tensor_inputs):
-                raise ValueError(
+                raise E.InvalidArgumentError(
                     f"{cls.__name__}.backward returned {len(grads)} grads "
                     f"but forward received {len(tensor_inputs)} Tensor "
                     "inputs — they must match one-to-one")

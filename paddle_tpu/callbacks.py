@@ -5,6 +5,7 @@ carry; the trackers degrade to gated no-ops when their client libraries
 are absent (no egress here)."""
 from __future__ import annotations
 
+from .core import enforce as E
 from .hapi.callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa
                              ModelCheckpoint, ProgBarLogger)
 
@@ -85,7 +86,7 @@ class VisualDL(Callback):
 
                 self._writer = LogWriter(self.log_dir)
             except ImportError as e:
-                raise RuntimeError(
+                raise E.PreconditionNotMetError(
                     "VisualDL callback needs the visualdl package, which "
                     "is not installed in this environment") from e
 
@@ -117,7 +118,7 @@ class WandbCallback(Callback):
 
                 self._run = wandb.init(project=self.project, **self.kwargs)
             except ImportError as e:
-                raise RuntimeError(
+                raise E.PreconditionNotMetError(
                     "WandbCallback needs the wandb package, which is not "
                     "installed in this environment") from e
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+from ..core import enforce as E
 
 # Canonical dtype objects are the jnp dtypes themselves: keeping them native
 # means zero conversion cost at dispatch time and full XLA compatibility.
@@ -63,7 +64,7 @@ def convert_dtype(dtype) -> np.dtype:
     if isinstance(dtype, str):
         key = dtype.lower()
         if key not in _NAME_TO_DTYPE:
-            raise ValueError(f"Unknown dtype name: {dtype!r}")
+            raise E.InvalidArgumentError(f"Unknown dtype name: {dtype!r}")
         d = np.dtype(_NAME_TO_DTYPE[key])
     else:
         d = np.dtype(dtype)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 from typing import Any, Callable, Dict
+from ..core import enforce as E
 
 
 @dataclass
@@ -55,7 +56,7 @@ def get_flags(flags):
     for f in flags:
         key = f[len("FLAGS_"):] if f.startswith("FLAGS_") else f
         if key not in _REGISTRY:
-            raise ValueError(f"Flag {f} is not registered")
+            raise E.InvalidArgumentError(f"Flag {f} is not registered")
         out[f] = _REGISTRY[key].value
     return out
 
@@ -65,7 +66,7 @@ def set_flags(flags: dict):
     for k, v in flags.items():
         key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
         if key not in _REGISTRY:
-            raise ValueError(f"Flag {k} is not registered")
+            raise E.InvalidArgumentError(f"Flag {k} is not registered")
         info = _REGISTRY[key]
         info.value = info.parser(v) if isinstance(v, str) else v
 

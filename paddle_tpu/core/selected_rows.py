@@ -38,6 +38,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .tensor import Tensor
+from ..core import enforce as E
 
 __all__ = ["SelectedRows", "SelectedRowsGrad"]
 
@@ -103,7 +104,7 @@ class SelectedRows:
     def __add__(self, other):
         if isinstance(other, SelectedRows):
             if other.dense_shape != self.dense_shape:
-                raise ValueError(
+                raise E.InvalidArgumentError(
                     f"SelectedRows shape mismatch: {self.dense_shape} vs "
                     f"{other.dense_shape}")
             return SelectedRows(
@@ -168,7 +169,7 @@ class SelectedRowsGrad(Tensor):
     @property
     def sr(self) -> SelectedRows:
         if self._sr is None:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "this grad was densified (a dense-style access degraded "
                 "it); the sparse payload is gone")
         return self._sr

@@ -30,6 +30,7 @@ import numpy as np
 from ..core.tensor import Parameter, Tensor
 from .placement import Partial, Placement, Replicate, Shard
 from .process_mesh import ProcessMesh
+from ..core import enforce as E
 
 __all__ = [
     "shard_tensor", "reshard", "dtensor_from_fn", "unshard_dtensor",
@@ -159,7 +160,7 @@ class _ShardingStage:
         from .process_mesh import get_mesh
         mesh = self.mesh or get_mesh()
         if mesh is None:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "ShardingStage needs a mesh: pass one or dist.set_mesh(...)")
         return mesh
 

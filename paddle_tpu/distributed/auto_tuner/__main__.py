@@ -14,6 +14,7 @@ import os
 import sys
 
 from . import AutoTuner, run_trial_subprocess, write_history_csv
+from ...core import enforce as E
 
 
 def main(argv=None):
@@ -52,7 +53,7 @@ def main(argv=None):
               f"mp={cfg['mp_degree']} mbs={cfg['micro_batch_size']} "
               f"rc={cfg.get('use_recompute')} -> {rec}", file=sys.stderr)
         if not rec.get("ok"):
-            raise RuntimeError(rec.get("error") or "trial failed")
+            raise E.PreconditionNotMetError(rec.get("error") or "trial failed")
         return rec["time"]
 
     best = tuner.tune(run_fn, max_trials=args.max_trials)

@@ -11,11 +11,12 @@ Prints ONE json line: {"ok": bool, "time": sec_per_step|null,
 import json
 import os
 import sys
+from ...core import enforce as E
 
 
 def _configure_env(cfg):
     if cfg.get("pp_degree", 1) != 1:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             "trial runner measures dp x sharding x mp meshes only; "
             "prune pp_degree>1 from the search space (pipeline trials "
             "need the pipeline runtime, not a flat mesh)")

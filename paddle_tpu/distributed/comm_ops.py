@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.tensor import Tensor
+from ..core import enforce as E
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
@@ -75,7 +76,7 @@ def all_reduce(x, axis: AxisName, op: str = "sum"):
         any_zero = lax.pmax(zero.astype(raw.dtype), axis)
         out = jnp.where(any_zero > 0, 0.0, sign * mag).astype(raw.dtype)
     else:
-        raise ValueError(f"unknown reduce op {op}")
+        raise E.InvalidArgumentError(f"unknown reduce op {op}")
     return _rewrap(x, out)
 
 

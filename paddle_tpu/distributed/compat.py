@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ..core import enforce as E
 
 __all__ = [
     "ParallelMode", "split", "Strategy", "DistAttr", "DistModel",
@@ -56,7 +57,7 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
         layer = fleet.meta_parallel.VocabParallelEmbedding(
             vocab, emb, weight_attr=weight_attr)
         return layer(x)
-    raise ValueError(f"split: unknown operation {operation!r}")
+    raise E.InvalidArgumentError(f"split: unknown operation {operation!r}")
 
 
 class Strategy:

@@ -22,6 +22,7 @@ from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa
 # meta_parallel namespace parity (reference: fleet/meta_parallel/__init__.py
 # exports the mpu layers too).
 from . import mp_layers as meta_parallel  # noqa
+from ...core import enforce as E
 
 
 # -- PS-era role makers / data generators (reference: fleet/base/
@@ -58,7 +59,7 @@ class UtilBase:
             return max(gathered)
         if mode == "min":
             return min(gathered)
-        raise ValueError(f"all_reduce: unknown mode {mode!r}")
+        raise E.InvalidArgumentError(f"all_reduce: unknown mode {mode!r}")
 
     def barrier(self, comm_world="worker"):
         from ..collective import barrier as _barrier

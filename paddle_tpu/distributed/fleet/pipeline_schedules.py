@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 from typing import Dict, List, Tuple
+from ...core import enforce as E
 
 __all__ = ["Action", "build_schedule", "fthenb", "one_f_one_b",
            "interleaved_1f1b", "zero_bubble_h1", "validate_schedule",
@@ -103,7 +104,7 @@ def interleaved_1f1b(num_stages: int, num_micro: int,
     if v < 2:
         return one_f_one_b(num_stages, num_micro)
     if num_micro % S != 0:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"interleaved schedule requires num_micro ({num_micro}) to be "
             f"a multiple of num_stages ({S})")
     total = num_micro * v
@@ -176,7 +177,7 @@ _BUILDERS = {
 def build_schedule(name: str, num_stages: int, num_micro: int,
                    num_chunks: int = 1) -> Schedule:
     if name not in _BUILDERS:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"unknown schedule {name!r}; one of {sorted(_BUILDERS)}")
     return _BUILDERS[name](num_stages, num_micro, num_chunks)
 

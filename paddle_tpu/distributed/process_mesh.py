@@ -18,6 +18,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .placement import Partial, Placement, Replicate, Shard
+from ..core import enforce as E
 
 __all__ = ["ProcessMesh", "get_mesh", "set_mesh", "auto_mesh"]
 
@@ -43,7 +44,7 @@ class ProcessMesh:
         if dim_names is None:
             dim_names = [f"d{i}" for i in range(arr.ndim)]
         if len(dim_names) != arr.ndim:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
         self._mesh = arr
         self._dim_names = list(dim_names)
@@ -94,7 +95,7 @@ class ProcessMesh:
         if self._jax_mesh is None:
             devices = jax.devices()
             if self.size > len(devices):
-                raise RuntimeError(
+                raise E.PreconditionNotMetError(
                     f"ProcessMesh needs {self.size} devices, only "
                     f"{len(devices)} visible. For tests use "
                     f"--xla_force_host_platform_device_count.")

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 
+from ..core import enforce as E
 from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
                   shard_optimizer)
 
@@ -37,7 +38,6 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
     accepted for parity — XLA owns comm bucketing (recorded in
     docs/CAPABILITY_DELTA.md).
     """
-    from ..core import enforce as E
     E.enforce(level in _LEVELS,
               f"level must be one of {sorted(_LEVELS)} (ZeRO 1/2/3), "
               f"got {level!r}", E.InvalidArgumentError)
@@ -58,7 +58,7 @@ def save_group_sharded_model(model, output, optimizer=None):
     from . import get_rank
 
     if os.path.splitext(output)[1]:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"save_group_sharded_model expects a directory, got {output!r}")
     os.makedirs(output, exist_ok=True)
     if get_rank() == 0:

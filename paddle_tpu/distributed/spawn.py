@@ -10,6 +10,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 from typing import Sequence
+from ..core import enforce as E
 
 __all__ = ["spawn"]
 
@@ -50,7 +51,7 @@ def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
         if p.exitcode != 0:
             failed.append(p.exitcode)
     if failed:
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             f"spawn: {len(failed)} worker(s) failed with exit codes "
             f"{failed}")
     return procs

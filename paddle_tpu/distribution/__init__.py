@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..framework import random as frandom
+from ..core import enforce as E
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace",
@@ -181,7 +182,7 @@ class Categorical(Distribution):
         elif probs is not None:
             self.logits = jnp.log(_raw(probs).astype(jnp.float32))
         else:
-            raise ValueError("provide logits or probs")
+            raise E.InvalidArgumentError("provide logits or probs")
         super().__init__(self.logits.shape[:-1])
 
     @property
@@ -215,7 +216,7 @@ class Bernoulli(Distribution):
         elif logits is not None:
             self.probs_ = jax.nn.sigmoid(_raw(logits).astype(jnp.float32))
         else:
-            raise ValueError("provide probs or logits")
+            raise E.InvalidArgumentError("provide probs or logits")
         super().__init__(self.probs_.shape)
 
     @property

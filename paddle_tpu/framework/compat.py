@@ -14,6 +14,7 @@ import contextlib
 
 import jax
 import numpy as np
+from ..core import enforce as E
 
 
 # -- places (reference: phi::CPUPlace / GPUPlace pybind) --------------------
@@ -106,7 +107,7 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
 def batch(reader, batch_size, drop_last=False):
     """Wrap a sample reader into a batch reader."""
     if batch_size <= 0:
-        raise ValueError(f"batch_size must be positive, got {batch_size}")
+        raise E.InvalidArgumentError(f"batch_size must be positive, got {batch_size}")
 
     def batch_reader():
         buf = []

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops._op import op_fn, unwrap, wrap
+from ..core import enforce as E
 
 __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
@@ -36,7 +37,7 @@ def _seg(vals, ids, num, pool):
     if pool == "min":
         return jax.ops.segment_min(vals, ids, num,
                                    indices_are_sorted=False)
-    raise ValueError(f"unknown pool_type {pool!r}")
+    raise E.InvalidArgumentError(f"unknown pool_type {pool!r}")
 
 
 def _finite(x):

@@ -10,6 +10,7 @@ from __future__ import annotations
 import importlib.util
 import os
 import sys
+from .core import enforce as E
 
 __all__ = ["help", "list", "load"]
 
@@ -45,7 +46,7 @@ def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
     mod = _load_hubconf(repo_dir, source)
     fn = getattr(mod, model, None)
     if fn is None:
-        raise ValueError(f"model {model!r} not found in {repo_dir}")
+        raise E.InvalidArgumentError(f"model {model!r} not found in {repo_dir}")
     return fn.__doc__
 
 
@@ -53,5 +54,5 @@ def load(repo_dir, model, source="local", force_reload=False, **kwargs):
     mod = _load_hubconf(repo_dir, source)
     fn = getattr(mod, model, None)
     if fn is None:
-        raise ValueError(f"model {model!r} not found in {repo_dir}")
+        raise E.InvalidArgumentError(f"model {model!r} not found in {repo_dir}")
     return fn(**kwargs)

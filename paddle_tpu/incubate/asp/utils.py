@@ -24,6 +24,7 @@ from enum import Enum
 
 import jax.numpy as jnp
 import numpy as np
+from ...core import enforce as E
 
 __all__ = ["MaskAlgo", "CheckMethod", "calculate_density",
            "get_mask_1d", "check_mask_1d", "get_mask_2d_greedy",
@@ -190,7 +191,7 @@ def _to_2d(a: np.ndarray):
         return t.reshape(-1, shape[2]), \
             lambda mk: mk.reshape(shape[0], shape[1], shape[3],
                                   shape[2]).transpose(0, 1, 3, 2)
-    raise ValueError(
+    raise E.InvalidArgumentError(
         f"n:m sparsity masks support tensors of dim 1-4, got {a.ndim}D")
 
 

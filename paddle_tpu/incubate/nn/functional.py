@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from ...nn import functional as F
 from ...nn.functional.attention import fused_rotary_position_embedding  # noqa: F401
 from ...ops._op import op_fn
+from ...core import enforce as E
 
 __all__ = ["fused_rms_norm", "fused_layer_norm", "swiglu",
            "fused_rotary_position_embedding", "fused_bias_act"]
@@ -300,7 +301,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     n_layers = len(qkv_weights)
     if cache_kvs is not None:
         if unwrap(x).shape[1] != 1:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 "fused_multi_transformer: cache_kvs decode expects one "
                 "token per step (x [B, 1, D]); run prefill without "
                 "caches first")

@@ -15,6 +15,7 @@ from ... import nn
 from ...nn.initializer import Constant, XavierUniform
 from ...nn.layer.base import Layer
 from . import functional as IF
+from ...core import enforce as E
 
 __all__ = ["FusedLinear", "FusedDropoutAdd", "FusedEcMoe",
            "FusedBiasDropoutResidualLayerNorm", "FusedFeedForward",
@@ -56,7 +57,7 @@ class FusedEcMoe(Layer):
                  act_type="gelu", weight_attr=None, bias_attr=None):
         super().__init__()
         if act_type not in ("gelu", "relu"):
-            raise ValueError(f"unsupported act_type {act_type!r}")
+            raise E.InvalidArgumentError(f"unsupported act_type {act_type!r}")
         self.act_type = act_type
         init = XavierUniform()
         self.gate_weight = self.create_parameter(

@@ -7,6 +7,7 @@ copies of the parameters host-side between jitted inner steps.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from ..core import enforce as E
 
 __all__ = ["LookAhead", "ModelAverage"]
 
@@ -17,9 +18,9 @@ class LookAhead:
 
     def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
         if not 0.0 <= alpha <= 1.0:
-            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+            raise E.InvalidArgumentError(f"alpha must be in [0, 1], got {alpha}")
         if k < 1:
-            raise ValueError(f"k must be >= 1, got {k}")
+            raise E.InvalidArgumentError(f"k must be >= 1, got {k}")
         self.inner_optimizer = inner_optimizer
         self.alpha = float(alpha)
         self.k = int(k)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import enforce as E
 from ..core import state
 from ..core.dtype import convert_dtype
 from ..core.tensor import Parameter, Tensor
@@ -60,7 +61,6 @@ class InputSpec:
         self.shape = list(shape)
         for d in self.shape:
             if not (d is None or isinstance(d, (int, str))):
-                from ..core import enforce as E
                 raise E.InvalidArgumentError(
                     f"InputSpec dim must be int, None, or a symbolic "
                     f"name string; got {d!r}")
@@ -132,7 +132,6 @@ class StaticFunction:
             missing = self._constraints.names
             if missing:
                 # constraints can only bind through named spec dims
-                from ..core import enforce as E
                 raise E.InvalidArgumentError(
                     f"to_static(constraints=...) names dims {sorted(missing)} "
                     "but input_spec declares no named dims",
@@ -178,7 +177,6 @@ class StaticFunction:
         relation) and on violated constraints."""
         if self._constraints is None:
             return
-        from ..core import enforce as E
         bindings: dict = {}
         for spec, a in zip(self._input_spec or [], args):
             if not (isinstance(spec, InputSpec) and isinstance(a, Tensor)):
@@ -451,7 +449,7 @@ class StaticFunction:
             return self.__compiled_call(key, args, kwargs)
         except _GraphBreak as e:
             if self._full_graph:
-                raise RuntimeError(str(e)) from e
+                raise E.PreconditionNotMetError(str(e)) from e
             import warnings
 
             # mixed capture (reference SOT, jit/sot/translate.py:30):
@@ -676,7 +674,7 @@ def save(layer, path, input_spec=None, **configs):
         fn, owner = layer, None
 
     if input_spec is None:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             "jit.save requires input_spec (pass it here or to to_static)")
     specs = _resolve_specs(owner, input_spec)
 

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
+from ..core import enforce as E
 from ..nn.functional.attention import (rope_raw, rope_tables as _rope_tables,
                                        sdpa_raw)
 
@@ -200,7 +201,7 @@ def forward_hidden(params, ids, config: LlamaConfig, *, sp: bool = False,
 
     if c.remat:
         if c.remat_policy not in ("dots", "full"):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"remat_policy must be 'dots' or 'full', got {c.remat_policy!r}")
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if c.remat_policy == "dots" else None)

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...ops._op import op_fn
+from ...core import enforce as E
 
 __all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
            "conv2d_transpose", "conv3d_transpose"]
@@ -138,7 +139,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
                           - pad_cfg[i][1] + k[i])
             for i in range(nsp))
         if any(o < 0 or o >= stride[i] for i, o in enumerate(opad)):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"output_size {out_req} unreachable with stride {stride}")
     tpad = [(k[i] - 1 - pad_cfg[i][0],
              k[i] - 1 - pad_cfg[i][1] + opad[i]) for i in range(nsp)]

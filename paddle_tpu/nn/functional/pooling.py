@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...ops._op import op_fn
+from ...core import enforce as E
 
 __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d",
@@ -127,7 +128,7 @@ def _max_pool_mask(x, nsp, kernel, stride, padding, ceil_mode, data_format):
     reference return_mask semantics that max_unpool consumes). Patch
     extraction keeps everything static-shaped for XLA."""
     if not data_format.startswith("NC"):
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"return_mask requires channel-first layout, got {data_format}")
     k = _tuplize(kernel, nsp)
     s = _tuplize(stride if stride is not None else kernel, nsp)

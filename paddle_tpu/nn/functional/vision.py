@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...ops._op import op_fn
+from ...core import enforce as E
 
 __all__ = ["grid_sample", "affine_grid"]
 
@@ -82,9 +83,9 @@ def _grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros",
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
     if mode not in ("bilinear", "nearest"):
-        raise ValueError(f"mode must be bilinear/nearest, got {mode!r}")
+        raise E.InvalidArgumentError(f"mode must be bilinear/nearest, got {mode!r}")
     if padding_mode not in ("zeros", "border", "reflection"):
-        raise ValueError(f"bad padding_mode {padding_mode!r}")
+        raise E.InvalidArgumentError(f"bad padding_mode {padding_mode!r}")
     return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
                         align_corners=align_corners)
 

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import random as frandom
+from ..core import enforce as E
 
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
@@ -181,7 +182,7 @@ class Orthogonal(Initializer):
 
     def __call__(self, shape, dtype=jnp.float32):
         if len(shape) < 2:
-            raise ValueError("Orthogonal init needs >=2 dims")
+            raise E.InvalidArgumentError("Orthogonal init needs >=2 dims")
         rows = shape[0]
         cols = int(np.prod(shape[1:]))
         k = frandom.next_key()
@@ -217,7 +218,7 @@ class Bilinear(Initializer):
 
     def __call__(self, shape, dtype=jnp.float32):
         if len(shape) != 4:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"Bilinear expects a 4-D conv weight shape, got {shape}")
         kh, kw = shape[2], shape[3]
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 from .. import functional as F
 from ..initializer import Uniform
 from .base import Layer
+from ...core import enforce as E
 
 __all__ = [
     "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
@@ -121,7 +122,7 @@ class HSigmoidLoss(Layer):
                  name=None):
         super().__init__()
         if num_classes < 2:
-            raise ValueError("num_classes must be >= 2")
+            raise E.InvalidArgumentError("num_classes must be >= 2")
         self.num_classes = num_classes
         self.is_custom = is_custom
         std = 1.0 / (feature_size ** 0.5)
@@ -169,6 +170,6 @@ class Softmax2D(Layer):
 
     def forward(self, x):
         if x.ndim not in (3, 4):
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 f"Softmax2D expects 3D/4D input, got {x.ndim}D")
         return F.softmax(x, axis=-3)

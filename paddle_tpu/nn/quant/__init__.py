@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ..layer.base import Layer
 from ...ops._op import op_fn, unwrap, wrap
+from ...core import enforce as E
 
 __all__ = ["Stub", "weight_quantize", "weight_dequantize",
            "weight_only_linear", "llm_int8_linear"]
@@ -39,11 +40,11 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     (quantized_weight, scale)."""
     w = unwrap(x).astype(jnp.float32)
     if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
-        raise ValueError(f"unsupported algo {algo!r}")
+        raise E.InvalidArgumentError(f"unsupported algo {algo!r}")
     absmax = jnp.max(jnp.abs(w), axis=0)            # per out-channel
     if algo == "weight_only_int4":
         if w.shape[0] % 2:
-            raise ValueError(
+            raise E.InvalidArgumentError(
                 "weight_only_int4 packs two rows per byte; in_features "
                 f"must be even, got {w.shape[0]} — pad the weight first")
         scale = absmax / 7.0

@@ -19,6 +19,7 @@ from .misc_ext import *  # noqa
 from . import fft_ops  # noqa  (namespaced under paddle_tpu.fft)
 
 from ..core.tensor import Tensor
+from ..core import enforce as E
 
 
 def _m(name, f, positional_kw=None):
@@ -269,7 +270,7 @@ def _adopt(x: Tensor, out: Tensor) -> Tensor:
         from ..autograd.tape import GradNode
 
         def _poison(*_):
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "Tensor was modified by an in-place operation while grad "
                 "recording was off; its autograd graph is invalid. "
                 "Recompute it or call .detach() before the in-place op.")

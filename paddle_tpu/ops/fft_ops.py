@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ._op import op_fn, unwrap, wrap
+from ..core import enforce as E
 
 __all__ = [
     "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
@@ -22,7 +23,7 @@ __all__ = [
 
 def _norm(normalization):
     if normalization not in ("backward", "ortho", "forward"):
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"Unexpected norm: {normalization!r} (use backward/ortho/forward)")
     return normalization
 

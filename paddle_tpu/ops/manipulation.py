@@ -13,6 +13,7 @@ import numpy as np
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
 from ._op import op_fn, unwrap, wrap, _unwrap_index
+from ..core import enforce as E
 
 
 @op_fn
@@ -234,7 +235,7 @@ def put_along_axis(x, indices, values, *, axis, reduce="assign"):
         return x.at[tuple(idx)].add(values)
     if reduce in ("mul", "multiply"):
         return x.at[tuple(idx)].multiply(values)
-    raise ValueError(f"unsupported reduce: {reduce}")
+    raise E.InvalidArgumentError(f"unsupported reduce: {reduce}")
 
 
 @op_fn

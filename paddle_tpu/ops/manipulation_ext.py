@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ._op import op_fn, unwrap, wrap
+from ..core import enforce as E
 
 # this module defines a public `slice` op (paddle API name) — keep a
 # handle on the builtin for internal indexing
@@ -326,7 +327,7 @@ def _shard_index(input, *, index_num, nshards, shard_id, ignore_value):
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     if not 0 <= shard_id < nshards:
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"shard_id ({shard_id}) must be in [0, {nshards})")
     return _shard_index(input, index_num=index_num, nshards=nshards,
                         shard_id=shard_id, ignore_value=ignore_value)

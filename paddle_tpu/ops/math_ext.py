@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from jax.scipy import special as jsp
 
 from ._op import op_fn, unwrap, wrap
+from ..core import enforce as E
 
 __all__ = [
     "copysign", "nextafter", "i0", "i0e", "i1", "i1e", "sinc", "gammaln",
@@ -312,7 +313,7 @@ def _take(x, index, *, mode="raise"):
 
 def take(x, index, mode="raise", name=None):
     if mode not in ("raise", "wrap", "clip"):
-        raise ValueError(f"'mode' must be raise/wrap/clip, got {mode}")
+        raise E.InvalidArgumentError(f"'mode' must be raise/wrap/clip, got {mode}")
     return _take(x, index, mode=mode)
 
 

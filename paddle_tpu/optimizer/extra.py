@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from .optimizer import Optimizer
+from ..core import enforce as E
 
 __all__ = ["ASGD", "Adadelta", "NAdam", "RAdam", "Rprop", "LBFGS"]
 
@@ -28,7 +29,7 @@ class ASGD(Optimizer):
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         if batch_num <= 0:
-            raise ValueError(f"batch_num must be positive, got {batch_num}")
+            raise E.InvalidArgumentError(f"batch_num must be positive, got {batch_num}")
         self._batch_num = int(batch_num)
 
     def _init_state(self, p):

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
+from ..core import enforce as E
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam",
            "AdamW", "Adamax", "Lamb", "ClipGradByValue", "ClipGradByNorm",
@@ -108,7 +109,7 @@ class Optimizer:
 
     def set_lr(self, value: float):
         if isinstance(self._lr, LRScheduler):
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 "set_lr is not allowed when the lr is an LRScheduler")
         self._lr = value
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ...core import enforce as E
 
 __all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d",
            "subm_conv2d_igemm", "subm_conv3d_igemm", "max_pool3d",
@@ -46,7 +47,7 @@ def softmax(x, axis=-1, name=None):
     stored entries of each row renormalize among themselves. Only the
     last axis is supported, like the reference."""
     if axis not in (-1, len(x.shape) - 1):
-        raise ValueError(
+        raise E.InvalidArgumentError(
             f"sparse softmax only supports the last axis, got {axis}")
     S = _parent()
     from jax.experimental import sparse as jsparse

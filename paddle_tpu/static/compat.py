@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.dtype import convert_dtype
 from ..core.tensor import Parameter, Tensor
+from ..core import enforce as E
 
 __all__ = [
     "create_parameter", "create_global_var", "gradients", "py_func",
@@ -284,7 +285,7 @@ def deserialize_program(data):
 
     payload = pickle.loads(data)
     if payload.get("kind") != "paddle_tpu_program":
-        raise ValueError("not a serialized paddle_tpu program")
+        raise E.InvalidArgumentError("not a serialized paddle_tpu program")
     return _program_from_serializable(payload["program"])
 
 

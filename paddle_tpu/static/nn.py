@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from .. import nn as _nn
 from .compat import py_func  # noqa: F401  (re-export, reference parity)
+from ..core import enforce as E
 
 __all__ = [
     "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
@@ -335,7 +336,7 @@ def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
         @staticmethod
         def backward(ctx, *grads):
             if backward_fn is None:
-                raise RuntimeError("static_pylayer: no backward_fn")
+                raise E.PreconditionNotMetError("static_pylayer: no backward_fn")
             return backward_fn(*grads)
 
     return _Static.apply(*inputs)

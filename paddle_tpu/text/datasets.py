@@ -18,6 +18,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..io.dataset import Dataset
+from ..core import enforce as E
 
 __all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
            "WMT14", "WMT16"]
@@ -25,7 +26,7 @@ __all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
 
 def _need_file(name, data_file):
     if data_file is None or not os.path.exists(data_file):
-        raise RuntimeError(
+        raise E.PreconditionNotMetError(
             f"{name}: automatic download is unavailable in this "
             "environment; pass data_file= pointing at a local copy "
             "(same archive format as the reference dataset)")

@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 from typing import List, Optional, Sequence
+from ..core import enforce as E
 
 __all__ = ["load", "get_build_directory", "CppExtension", "setup"]
 
@@ -58,7 +59,7 @@ def load(name: str, sources: Sequence[str],
         try:
             subprocess.run(cmd, check=True, capture_output=not verbose)
         except (subprocess.CalledProcessError, FileNotFoundError) as e:
-            raise RuntimeError(
+            raise E.PreconditionNotMetError(
                 f"compiling extension '{name}' failed: {e}") from e
     return ctypes.CDLL(so_path)
 
@@ -121,7 +122,7 @@ def parse_op_info(op_name):
     """Metadata of a custom op registered via load() (reference:
     extension_utils.parse_op_info)."""
     if op_name not in _REGISTERED_OPS:
-        raise ValueError(f"custom op {op_name!r} is not registered")
+        raise E.InvalidArgumentError(f"custom op {op_name!r} is not registered")
     return dict(_REGISTERED_OPS[op_name])
 
 

@@ -5,6 +5,7 @@ local weights cache and raises with instructions otherwise."""
 from __future__ import annotations
 
 import os
+from ..core import enforce as E
 
 __all__ = ["get_weights_path_from_url"]
 
@@ -16,6 +17,6 @@ def get_weights_path_from_url(url, md5sum=None):
     path = os.path.join(WEIGHTS_HOME, fname)
     if os.path.exists(path):
         return path
-    raise RuntimeError(
+    raise E.PreconditionNotMetError(
         f"downloading {url} requires network access, unavailable in this "
         f"environment; place the file at {path} manually")

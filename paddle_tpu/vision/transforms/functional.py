@@ -7,6 +7,7 @@ import numbers
 from typing import Sequence
 
 import numpy as np
+from ...core import enforce as E
 
 __all__ = ["to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
            "hflip", "vflip", "rotate", "adjust_brightness",
@@ -169,7 +170,7 @@ def adjust_saturation(img, factor):
 def adjust_hue(img, hue_factor):
     """Shift hue by ``hue_factor`` (in [-0.5, 0.5]) via HSV conversion."""
     if not -0.5 <= hue_factor <= 0.5:
-        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+        raise E.InvalidArgumentError("hue_factor must be in [-0.5, 0.5]")
     arr = _as_np(img).astype(np.float32)
     high = arr.max() > 1
     x = arr / 255.0 if high else arr
