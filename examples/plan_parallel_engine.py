@@ -1,0 +1,29 @@
+"""Let the auto-parallel Engine's cost model pick the mesh split.
+
+8B parameters on 8 memory-tight chips: naive data parallelism needs
+~128 GB/chip of param+grad+optimizer state, so the planner must find a
+hybrid (fsdp shards state, tp shards compute) — and show its work.
+
+Run:  python examples/plan_parallel_engine.py
+"""
+from paddle_tpu.distributed.engine import plan_parallel
+
+plan = plan_parallel(
+    8,
+    dict(num_params=8e9, num_layers=32, hidden_size=4096,
+         seq_length=2048, dtype="bfloat16"),
+    global_batch_size=8, hbm_bytes=17.5e9, chips_per_host=2,
+    sharding_stage=3, use_recompute=True)
+
+print(plan.describe())
+print("considered:", plan.candidates_considered,
+      "feasible:", plan.candidates_feasible)
+for alt in plan.alternatives:
+    print("  runner-up:", {k: alt[k] for k in
+                           ("dp_degree", "sharding_degree", "mp_degree",
+                            "pp_degree", "micro_batch_size")})
+dp, fsdp, tp = plan.mesh_shape
+assert fsdp > 1, "planner should shard state for this scenario"
+print(f"plan: dp={dp} fsdp={fsdp} tp={tp} -> "
+      "build_mesh() yields the ('dp','fsdp','tp') Mesh for GSPMD")
+print("engine planning: OK")

@@ -39,3 +39,15 @@ def test_ring_attention_example_runs():
              {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert r.returncode == 0, r.stderr[-800:]
     assert "exact parity OK" in r.stdout
+
+
+def test_onnx_export_example_runs():
+    r = _run("export_onnx.py")
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "onnx export: OK" in r.stdout
+
+
+def test_engine_planning_example_runs():
+    r = _run("plan_parallel_engine.py")
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "engine planning: OK" in r.stdout
