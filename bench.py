@@ -94,16 +94,37 @@ def _stage(name, budget_s):
     _STAGE["name"] = name
 
 
+# Once the DENSE rung has a measured result, it is staged here; a
+# watchdog firing in a later optional stage (the MoE rung) must emit
+# the measured headline number, not zero it.
+_PARTIAL = {"payload": None}
+
+
+def _watchdog_fire():
+    """Emit on deadline expiry: the staged headline snapshot if the
+    dense rung already measured (a late optional stage must not zero
+    the run), else the failure record. Unit-tested directly; the loop
+    below only adds the timer and the os._exit."""
+    partial = _PARTIAL["payload"]
+    if partial is not None:
+        partial.setdefault("extra", {})["late_stage_timeout"] = (
+            f"stage '{_STAGE['name']}' exceeded its deadline "
+            "after the headline measurement completed")
+        _emit(partial)
+    else:
+        _fail(f"deadline exceeded in stage '{_STAGE['name']}' "
+              f"(global budget {_GLOBAL_DEADLINE_S:.0f}s); the "
+              f"bench process was killed by its own watchdog "
+              f"instead of hanging into the driver's timeout",
+              stage=_STAGE["name"])
+
+
 def _watchdog():
     while True:
         time.sleep(1.0)
         now = time.monotonic()
         if now > _STAGE["deadline"]:
-            _fail(f"deadline exceeded in stage '{_STAGE['name']}' "
-                  f"(global budget {_GLOBAL_DEADLINE_S:.0f}s); the bench "
-                  f"process was killed by its own watchdog instead of "
-                  f"hanging into the driver's timeout",
-                  stage=_STAGE["name"])
+            _watchdog_fire()
             sys.stdout.flush()
             sys.stderr.flush()
             os._exit(2)
@@ -377,20 +398,6 @@ def _main():
         _fail(f"all bench rungs failed; last: {last_err}")
         return
 
-    # Second flagship family: a DeepSeekMoE-shaped expert-parallel rung
-    # (BASELINE.json config matrix; VERDICT-r4 item 9). Measured after the
-    # dense rung releases its HBM; failure degrades to an error entry in
-    # the JSON instead of zeroing the headline metric.
-    moe_result = None
-    try:
-        _stage("moe-rung", 300)
-        params = opt_state = step = init = ids = None
-        jax.clear_caches()
-        moe_result = _moe_rung(on_tpu, dev)
-    except Exception as e:                      # noqa: BLE001
-        moe_result = {"error": f"{type(e).__name__}: {e}"[:500]}
-
-    _stage("report", 30)
     tokens = batch * seq * iters
     tps = tokens / dt
     # 6ND (fwd+bwd) -> standard MFU (remat recompute not credited)
@@ -420,12 +427,32 @@ def _main():
                   else repr(final_loss),
                   "elapsed_s": round(time.monotonic() - _T0, 1)},
     }
-    if moe_result is not None:
-        payload["extra"]["moe"] = moe_result
     if preflight:
         payload["extra"]["kernel_preflight_failures"] = preflight
     if flash_missed:
         payload["warning"] = "pallas flash kernel did not engage (XLA fallback)"
+
+    # The headline number is now measured: stage a SNAPSHOT (not the
+    # live dict — the MoE stage keeps mutating it, and the watchdog
+    # thread must never serialize a dict mid-mutation) so a watchdog
+    # firing in the optional MoE stage emits it instead of zeroing the
+    # run.
+    _PARTIAL["payload"] = dict(payload, extra=dict(payload["extra"]))
+
+    # Second flagship family: a DeepSeekMoE-shaped expert-parallel rung
+    # (BASELINE.json config matrix). Measured after the dense rung
+    # releases its HBM; failure degrades to an error entry in the JSON.
+    try:
+        _stage("moe-rung", 300)
+        params = opt_state = step = init = ids = None
+        jax.clear_caches()
+        payload["extra"]["moe"] = _moe_rung(on_tpu, dev)
+    except Exception as e:                      # noqa: BLE001
+        payload["extra"]["moe"] = {
+            "error": f"{type(e).__name__}: {e}"[:500]}
+
+    _stage("report", 30)
+    payload["extra"]["elapsed_s"] = round(time.monotonic() - _T0, 1)
     _emit(payload)
 
 

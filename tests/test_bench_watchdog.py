@@ -1,0 +1,49 @@
+"""bench.py watchdog semantics: a deadline expiring in a LATE optional
+stage (the MoE rung) must emit the already-measured headline number,
+not zero the run; before any measurement it emits the failure record.
+Importing bench must not arm the watchdog or print anything."""
+import importlib
+import json
+import sys
+
+
+def _fresh_bench(capsys):
+    sys.modules.pop("bench", None)
+    import bench
+    importlib.reload(bench)
+    assert capsys.readouterr().out == ""     # import is silent
+    return bench
+
+
+class TestWatchdogFire:
+    def test_pre_measurement_fires_failure(self, capsys):
+        b = _fresh_bench(capsys)
+        b._STAGE["name"] = "init+compile"
+        b._watchdog_fire()
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
+        p = json.loads(out[0])
+        assert p["value"] == 0.0
+        assert "init+compile" in p["error"]
+
+    def test_post_measurement_emits_partial(self, capsys):
+        b = _fresh_bench(capsys)
+        b._STAGE["name"] = "moe-rung"
+        b._PARTIAL["payload"] = {
+            "metric": b._METRIC, "value": 123.4, "unit": "tokens/s",
+            "vs_baseline": 0.5, "extra": {"mfu": 0.2}}
+        b._watchdog_fire()
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
+        p = json.loads(out[0])
+        assert p["value"] == 123.4                      # not zeroed
+        assert "moe-rung" in p["extra"]["late_stage_timeout"]
+
+    def test_emit_is_once_only(self, capsys):
+        b = _fresh_bench(capsys)
+        b._PARTIAL["payload"] = {"metric": b._METRIC, "value": 1.0,
+                                 "unit": "tokens/s", "vs_baseline": 0.0}
+        b._watchdog_fire()
+        b._watchdog_fire()                              # second is a no-op
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
