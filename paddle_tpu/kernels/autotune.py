@@ -113,6 +113,15 @@ class AutotuneCache:
 
 _CACHE = AutotuneCache()
 
+# Shape keys whose sweep failed IN THIS PROCESS: don't re-sweep on every
+# retrace (each sweep is minutes of compiles). Persisted error entries
+# are honoured as hits only after MAX_SWEEP_FAILURES processes have each
+# re-paid the sweep — one transient tunnel death must not pin a healthy
+# shape to the defaults forever (self-heal), but a shape that genuinely
+# cannot compile must not cost every later process minutes either.
+_FAILED_KEYS: set = set()
+MAX_SWEEP_FAILURES = 2
+
 # What flash_blocks actually RETURNED in this process, per shape key —
 # the benchmark's evidence of which blocks the traced program used
 # (distinct from the persisted cache, which holds every shape any prior
@@ -142,7 +151,6 @@ def _vmem_bytes(bq: int, bk: int, d: int) -> int:
 def flash_candidates(bh, sq, sk, d, dtype):
     """Legal (block_q, block_k) candidates for a flash shape, default
     first."""
-    from . import flash_attention as _fa
     from .tiling import flash_specs_legal
 
     out = []
@@ -162,9 +170,13 @@ def flash_candidates(bh, sq, sk, d, dtype):
     return out
 
 
-def _measure_flash(b, sq, sk, h, kvh, d, dtype, causal, bq, bk) -> float:
+def _measure_flash(b, sq, sk, h, kvh, d, dtype, causal, bq, bk,
+                   interpret=False) -> float:
     """Seconds per fwd+bwd of the real kernel at the real shape."""
-    from . import flash_attention as _fa
+    # Import from the submodule directly: the package __init__ rebinds
+    # the ``flash_attention`` attribute to the function, so a lazy
+    # ``from . import flash_attention`` here would get the function.
+    from .flash_attention import flash_attention as _flash
 
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((b, sq, h, d)), dtype)
@@ -172,9 +184,9 @@ def _measure_flash(b, sq, sk, h, kvh, d, dtype, causal, bq, bk) -> float:
     v = jnp.asarray(rng.standard_normal((b, sk, kvh, d)), dtype)
 
     def loss(q, k, v):
-        return jnp.sum(_fa.flash_attention(
+        return jnp.sum(_flash(
             q, k, v, causal=causal, block_q=bq, block_k=bk,
-            interpret=False).astype(jnp.float32))
+            interpret=interpret).astype(jnp.float32))
 
     f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     out = f(q, k, v)                    # compile + warmup
@@ -204,18 +216,26 @@ def flash_blocks(q_shape, k_shape, dtype, causal,
     b, sq, h, d = q_shape
     sk, kvh = k_shape[1], k_shape[2]
     defaults = (min(DEFAULT_BLOCKS[0], sq), min(DEFAULT_BLOCKS[1], sk))
-    mode = _mode()
-    if not _flags.flag_value("use_autotune") or mode == "0":
-        return defaults
-    if measure is None and mode != "cached" and not _tuning_backend():
-        return defaults
-    cache = cache or _CACHE
     key = (f"flash:{jax.default_backend()}:{jnp.dtype(dtype).name}:"
            f"b{b}h{h}kv{kvh}:q{sq}k{sk}d{d}:c{int(bool(causal))}")
+    mode = _mode()
+    if not _flags.flag_value("use_autotune") or mode == "0":
+        _USED[key] = {"blocks": list(defaults), "source": "off"}
+        return defaults
+    if measure is None and mode != "cached" and not _tuning_backend():
+        _USED[key] = {"blocks": list(defaults), "source": "default-not-tpu"}
+        return defaults
+    cache = cache or _CACHE
     hit = cache.get(key)
-    if hit:
+    if hit and not hit.get("error"):
         _USED[key] = {"blocks": list(hit["blocks"]), "source": "cache"}
         return tuple(hit["blocks"])
+    if key in _FAILED_KEYS or (
+            hit and hit.get("failures", 1) >= MAX_SWEEP_FAILURES):
+        # swept-and-failed this process, or enough OTHER processes paid
+        # the failed sweep already — stop re-paying minutes of compiles
+        _USED[key] = {"blocks": list(defaults), "source": "default"}
+        return defaults
     if mode == "cached":   # never measure in this mode — cache miss ->
         _USED[key] = {"blocks": list(defaults), "source": "default"}
         return defaults    # known-good defaults
@@ -228,16 +248,24 @@ def flash_blocks(q_shape, k_shape, dtype, causal,
     measure = measure or (lambda bq, bk: _measure_flash(
         b, sq, sk, h, kvh, d, dtype, causal, bq, bk))
     timings = {}
+    last_err = None
     for bq, bk in cands:
         try:
             timings[(bq, bk)] = measure(bq, bk)
-        except Exception:   # a failing candidate just drops out
+        except Exception as e:   # a failing candidate just drops out
+            last_err = f"{type(e).__name__}: {e}"[:200]
             continue
     if not timings:
-        # cache the default so the failed sweep isn't repeated by every
-        # retrace / later process at this shape
+        # record the failure for diagnosis (honoured as a hit only after
+        # MAX_SWEEP_FAILURES distinct processes re-paid the sweep — a
+        # transient tunnel death must not pin defaults forever, a real
+        # lowering limit must not cost every process minutes) and pin
+        # this process to the defaults so retraces don't re-sweep
+        _FAILED_KEYS.add(key)
+        prior = hit.get("failures", 1) if hit and hit.get("error") else 0
         cache.put(key, {"blocks": list(defaults), "us": None,
-                        "candidates": 0, "error": "all candidates failed"})
+                        "candidates": 0, "failures": prior + 1,
+                        "error": f"all candidates failed ({last_err})"})
         _USED[key] = {"blocks": list(defaults), "source": "default"}
         return defaults
     best = min(timings, key=timings.get)

@@ -26,7 +26,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "TPU_SMOKE.json")
 
-_RELAY_PORTS = (8082, 8083, 8087, 8102, 8103, 8107, 8112, 8113, 8117)
+from bench import _RELAY_PORTS  # noqa: E402  single source for the port set
 DEADLINE_S = float(os.environ.get("SMOKE_DEADLINE_S", "1500"))
 _T0 = time.monotonic()
 
@@ -213,6 +213,15 @@ def main():
             blocks = at.flash_blocks((b, s, h, d), (b, s, kvh, d),
                                      jnp.bfloat16, True)
             print(f"tuned blocks for s={s}: {blocks}", file=sys.stderr)
+            # a silent all-candidates-failed sweep falls back to the
+            # defaults — that is a smoke FAILURE, not a timing tie. The
+            # dispatch decision record carries the exact key + source.
+            (key, used), = [(k, u) for k, u in at.used_blocks().items()
+                            if f"q{s}k{s}" in k]
+            if on_tpu and used["source"] not in ("measured", "cache"):
+                raise RuntimeError(
+                    f"autotune sweep did not measure: {used} "
+                    f"(cache entry: {at._CACHE.get(key)})")
 
     fails = [k for k, v in results.items() if v != "ok"]
     _emit({"skipped": None, "results": results,

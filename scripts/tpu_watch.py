@@ -1,0 +1,140 @@
+"""Tunnel watcher: capture the driver bench the moment the TPU lives.
+
+The axon tunnel relay comes and goes (round 4: dead all round; round 5:
+one ~12-minute window that fit the smoke sweep but not the bench). This
+watcher loops forever:
+
+1. Probe the relay's loopback ports with a 2s TCP connect (cheap, no
+   chip claim).
+2. On an open port, verify PJRT init actually completes in a bounded
+   subprocess (the round-5 pathology was TCP-accept + init-hang).
+3. Run ``python bench.py`` with a generous self-measure deadline
+   (BENCH_DEADLINE_S, default 3000s) — this also warms the persistent
+   XLA compile cache, so any later run (including the driver's
+   end-of-round one) deserializes instead of recompiling.
+4. On a measured result (value > 0), immediately re-run with the
+   default driver budget for the run-to-run stability record, then
+   exit 0.
+
+Artifacts: BENCH_SELF_r05.json (run 1) and BENCH_SELF_r05_run2.json
+(run 2), each the bench's own JSON line plus provenance fields.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from bench import _RELAY_PORTS as RELAY_PORTS  # noqa: E402  single source
+
+def _env_float(name, default):
+    """A bad override must not crash the watcher at the moment the
+    scarce TPU window finally opens (mirrors bench.py's guard)."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+PROBE_EVERY_S = _env_float("TPU_WATCH_PROBE_S", "60")
+RUN1_DEADLINE_S = _env_float("TPU_WATCH_RUN1_DEADLINE_S", "3000")
+
+
+def log(msg):
+    print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr)
+    sys.stderr.flush()
+
+
+def relay_alive():
+    for port in RELAY_PORTS:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=2).close()
+            return True
+        except OSError:
+            continue
+    return False
+
+
+def pjrt_alive(timeout_s=150):
+    """TCP-accept is not enough: init must complete (round-5 pathology)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
+        return r.returncode == 0 and ("tpu" in r.stdout or "axon" in r.stdout)
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench(out_path, deadline_env, budget_s):
+    env = dict(os.environ)
+    if deadline_env:
+        env["BENCH_DEADLINE_S"] = deadline_env
+    else:   # "driver budget" must mean the bench's own default, even if
+        env.pop("BENCH_DEADLINE_S", None)   # the watcher's shell set one
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "bench.py"], cwd=REPO,
+                           capture_output=True, text=True, timeout=budget_s,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        log(f"bench exceeded its outer {budget_s}s timeout")
+        return None
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if line is None:
+        log(f"bench emitted no JSON (rc {r.returncode}); "
+            f"stderr tail: {(r.stderr or '')[-300:]}")
+        return None
+    try:
+        payload = json.loads(line)
+    except ValueError:
+        log(f"unparseable bench line: {line[:200]}")
+        return None
+    payload["provenance"] = {
+        "self_measured": True,
+        "script": "scripts/tpu_watch.py",
+        "wall_clock_s": round(time.time() - t0, 1),
+        "bench_deadline_env": deadline_env or "(default)",
+    }
+    with open(os.path.join(REPO, out_path), "w") as f:
+        json.dump(payload, f, indent=1)
+    log(f"{out_path}: value={payload.get('value')} "
+        f"mfu={payload.get('extra', {}).get('mfu')}")
+    return payload
+
+
+def main():
+    log(f"watching relay ports {RELAY_PORTS[0]}..{RELAY_PORTS[-1]}")
+    while True:
+        if not relay_alive():
+            time.sleep(PROBE_EVERY_S)
+            continue
+        log("relay port open; verifying PJRT init")
+        if not pjrt_alive():
+            log("PJRT init hung/failed; relay is up but chipless")
+            time.sleep(PROBE_EVERY_S * 2)
+            continue
+        log("TPU live — bench run 1 (generous deadline, warms "
+            "compile cache)")
+        p1 = run_bench("BENCH_SELF_r05.json", str(RUN1_DEADLINE_S),
+                       RUN1_DEADLINE_S + 300)
+        if not p1 or not p1.get("value"):
+            log("run 1 did not measure; re-probing")
+            time.sleep(PROBE_EVERY_S)
+            continue
+        log("bench run 2 (default driver budget, cache-warm)")
+        run_bench("BENCH_SELF_r05_run2.json", None, 1200)
+        log("done")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,3 +162,56 @@ class TestBf16Moments:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
         assert jax.tree.leaves(opt["m"])[0].dtype == jnp.bfloat16
+
+
+class TestRealMeasurePath:
+    def test_measure_flash_runs_end_to_end(self):
+        # Regression: the package __init__ rebinds ``flash_attention`` to
+        # the function, so a lazy ``from . import flash_attention`` inside
+        # _measure_flash bound the function and EVERY candidate died on
+        # AttributeError — the on-chip sweep silently fell back to the
+        # defaults. Run the real measurement body (interpret mode, tiny
+        # shape) so an import regression fails loudly on CPU.
+        t = at._measure_flash(1, 16, 16, 2, 1, 64, jnp.float32, True,
+                              16, 16, interpret=True)
+        assert t > 0
+
+
+class TestErrorEntrySelfHeal:
+    def _call(self, cache, measure):
+        return at.flash_blocks((2, 2048, 4, 128), (2, 2048, 2, 128),
+                               jnp.bfloat16, True,
+                               measure=measure, cache=cache)
+
+    def test_error_entry_is_retried_then_pinned(self, tmp_path):
+        # process A: all candidates fail (e.g. tunnel died mid-sweep)
+        path = str(tmp_path / "c.json")
+        at._FAILED_KEYS.clear()
+        cache = at.AutotuneCache(path)
+        assert self._call(cache, lambda bq, bk: 1 / 0) == (128, 128)
+        (entry,) = cache._mem.values()
+        assert entry["error"] and entry["failures"] == 1
+
+        # process B (fresh _FAILED_KEYS): the persisted error entry is a
+        # MISS — healthy hardware re-sweeps and self-heals the cache
+        at._FAILED_KEYS.clear()
+        calls = []
+        cache_b = at.AutotuneCache(path)
+        got = self._call(cache_b, lambda bq, bk: calls.append(1) or
+                         (0.5 if (bq, bk) == (256, 128) else 1.0))
+        assert calls and got == (256, 128)
+        assert not cache_b.get(next(iter(cache_b._mem))).get("error")
+
+    def test_error_entry_pins_after_budget(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        for _ in range(at.MAX_SWEEP_FAILURES):
+            at._FAILED_KEYS.clear()          # simulate a fresh process
+            cache = at.AutotuneCache(path)
+            assert self._call(cache, lambda bq, bk: 1 / 0) == (128, 128)
+        # budget exhausted: later processes use defaults WITHOUT sweeping
+        at._FAILED_KEYS.clear()
+        calls = []
+        cache = at.AutotuneCache(path)
+        got = self._call(cache, lambda bq, bk: calls.append(1) or 1.0)
+        assert got == (128, 128) and not calls
+        at._FAILED_KEYS.clear()
