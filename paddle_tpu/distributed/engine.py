@@ -26,7 +26,21 @@ from ..core import enforce as E
 from .auto_tuner import (AutoTuner, default_cost, estimate_memory_bytes,
                          generate_candidates)
 
-__all__ = ["ParallelPlan", "plan_parallel", "Engine"]
+__all__ = ["ParallelPlan", "PipelineConfig", "plan_parallel", "Engine"]
+
+
+@dataclass
+class PipelineConfig:
+    """A pp>1 plan materialised for the pipeline runtime: the knobs
+    `pipeline_spmd`/`make_pipeline_train_step` need, derived from the
+    planner's candidate (reference: the Engine's planner feeds
+    PipelineParallel's chunk/micro-batch settings the same way)."""
+
+    num_stages: int
+    num_micro: int                 # micro-batches per dp replica
+    micro_batch_size: int
+    axis: str = "pp"
+    schedule: str = "gpipe-spmd"   # compiled collective-permute pipeline
 
 
 @dataclass
@@ -40,12 +54,13 @@ class ParallelPlan:
     candidates_considered: int = 0
     candidates_feasible: int = 0
     alternatives: List[Dict] = field(default_factory=list)
+    global_batch_size: int = 8
 
     @property
     def mesh_shape(self):
         """(dp, fsdp, tp) — sharding_degree rides the 'fsdp' axis, mp the
-        'tp' axis. pp (if chosen) is returned separately because the
-        pipeline runtime uses its own ('pp',) mesh."""
+        'tp' axis. pp (if chosen) is not part of this triple; build_mesh
+        appends it as the trailing mesh axis for pp>1 plans."""
         c = self.config
         return (c["dp_degree"], c["sharding_degree"], c["mp_degree"])
 
@@ -54,6 +69,9 @@ class ParallelPlan:
         return self.config["pp_degree"]
 
     def build_mesh(self, devices=None):
+        """The plan's Mesh. pp==1: ('dp','fsdp','tp'). pp>1: the pp axis
+        joins the mesh as the trailing axis so the pipeline's
+        collective-permutes ride neighbouring devices (ICI-adjacent)."""
         import jax
         from jax.sharding import Mesh
 
@@ -62,8 +80,57 @@ class ParallelPlan:
         E.enforce_le(need, len(devs),
                      "plan needs more devices than available")
         dp, sh, mp = self.mesh_shape
+        if self.pp_degree > 1:
+            return Mesh(
+                np.array(devs[:need]).reshape(dp, sh, mp, self.pp_degree),
+                ("dp", "fsdp", "tp", "pp"))
         return Mesh(np.array(devs[:dp * sh * mp]).reshape(dp, sh, mp),
                     ("dp", "fsdp", "tp"))
+
+    def pipeline_config(self) -> Optional["PipelineConfig"]:
+        """Materialise a pp>1 pick for the pipeline runtime; None when
+        the plan has no pipeline dimension. num_micro follows the cost
+        model's own convention (acc_steps = gbs / (dp*sh) / mbs — the
+        batch splits over BOTH data-parallel-like axes before
+        micro-batching), so the built step does exactly the work the
+        plan was costed for."""
+        if self.pp_degree == 1:
+            return None
+        c = self.config
+        mbs = c["micro_batch_size"]
+        if "acc_steps" in c:
+            num_micro = int(c["acc_steps"])
+        else:
+            ways = c["dp_degree"] * c["sharding_degree"] * mbs
+            E.enforce_gt(self.global_batch_size, 0, "global_batch_size")
+            E.enforce(self.global_batch_size % ways == 0,
+                      f"global batch {self.global_batch_size} not "
+                      f"divisible by dp*sharding*micro_batch = {ways}")
+            num_micro = self.global_batch_size // ways
+        return PipelineConfig(num_stages=self.pp_degree,
+                              num_micro=num_micro,
+                              micro_batch_size=mbs)
+
+    def build_pipeline_step(self, stage_fn, loss_fn, *, lr: float = 1e-3,
+                            remat: bool = True, devices=None):
+        """Wire a pp>1 plan into the compiled collective-permute
+        pipeline: returns (jitted step, mesh, PipelineConfig). The step
+        takes stage-stacked params (leading axis = num_stages, placed
+        with `shard_stage_params`), a [num_micro*mbs, ...] batch, and
+        labels."""
+        from .pipeline import make_pipeline_train_step
+
+        pc = self.pipeline_config()
+        if pc is None:
+            raise E.InvalidArgumentError(
+                "plan chose pp=1 — no pipeline schedule to build",
+                hint="a pp=1 plan runs as a plain GSPMD step; "
+                     "build_pipeline_step is for pp>1 plans")
+        mesh = self.build_mesh(devices)
+        step = make_pipeline_train_step(stage_fn, loss_fn, mesh,
+                                        num_micro=pc.num_micro,
+                                        axis=pc.axis, lr=lr, remat=remat)
+        return step, mesh, pc
 
     def describe(self) -> str:
         dp, sh, mp = self.mesh_shape
@@ -129,7 +196,8 @@ def plan_parallel(n_devices: int, model_cfg: Dict, *,
         cost=default_cost(best, mcfg), naive_cost=naive_cost,
         candidates_considered=considered,
         candidates_feasible=len(feasible),
-        alternatives=[dict(c) for c in feasible[1:4]])
+        alternatives=[dict(c) for c in feasible[1:4]],
+        global_batch_size=int(global_batch_size))
 
 
 def _model_stats(layer) -> Dict:
@@ -166,6 +234,7 @@ class Engine:
         self.strategy = strategy
         self.plan: Optional[ParallelPlan] = None
         self.mesh = None
+        self.pipeline: Optional[PipelineConfig] = None
 
     # -- planning ------------------------------------------------------------
     def prepare(self, model_cfg: Optional[Dict] = None,
@@ -186,6 +255,7 @@ class Engine:
         self.plan = plan_parallel(int(n_devices), model_cfg,
                                   **plan_kwargs)
         self.mesh = self.plan.build_mesh()
+        self.pipeline = self.plan.pipeline_config()
         return self.plan
 
     # -- execution (single-controller step surface) --------------------------

@@ -102,6 +102,9 @@ class _Ctx:
                     isinstance(x, (int, np.integer)) for x in v):
                 a.type = P.AttributeProto.INTS
                 a.ints.extend(int(x) for x in v)
+            elif isinstance(v, P.GraphProto):
+                a.type = P.AttributeProto.GRAPH
+                a.g.CopyFrom(v)
             elif isinstance(v, (list, tuple)):
                 a.type = P.AttributeProto.FLOATS
                 a.floats.extend(float(x) for x in v)
@@ -543,16 +546,16 @@ _MAX_SCAN_UNROLL = 128
 
 @_handler("scan")
 def _scan(ctx, eqn):
-    """Static-length scan UNROLLS into the graph (ONNX's Loop op exists
-    but unrolling serves the dominant inference case — scan-over-layers
-    decoders — with plain dataflow every consumer optimizes well)."""
+    """Static-length scan: short scans UNROLL into the graph (plain
+    dataflow every consumer optimizes well); scans beyond the unroll cap
+    emit an ONNX ``Loop`` with the body as a subgraph, so arbitrary-depth
+    scan-over-layers decoders convert without graph blow-up."""
     p = eqn.params
-    length = int(p["length"])
-    E.enforce_le(length, _MAX_SCAN_UNROLL,
-                 f"scan length {length} exceeds the ONNX unroll cap",
-                 error=E.UnimplementedError)
     E.enforce(not p.get("reverse", False), "reverse scan unsupported",
               E.UnimplementedError)
+    if int(p["length"]) > _MAX_SCAN_UNROLL:
+        return _scan_loop(ctx, eqn)
+    length = int(p["length"])
     closed = p["jaxpr"]
     inner, consts = closed.jaxpr, closed.consts
     n_consts = int(p["num_consts"])
@@ -614,6 +617,86 @@ def _scan(ctx, eqn):
             ctx.emit("Identity", [parts[0]], [ctx.name_of(y_out)])
         else:
             ctx.emit("Concat", parts, [ctx.name_of(y_out)], axis=0)
+
+
+def _scan_loop(ctx, eqn):
+    """Emit scan as an ONNX ``Loop``: the body jaxpr becomes a subgraph
+    that gathers iteration ``i`` of each scanned input (subgraphs read
+    outer-scope tensors by name, so consts/xs stay in the main graph),
+    threads the carry through the Loop's loop-carried deps, and returns
+    per-iteration ys through the Loop's scan-output mechanism (stacked
+    on a new leading axis — exactly scan's ys layout)."""
+    p = eqn.params
+    length = int(p["length"])
+    closed = p["jaxpr"]
+    inner, consts = closed.jaxpr, closed.consts
+    n_consts = int(p["num_consts"])
+    n_carry = int(p["num_carry"])
+
+    const_names = [ctx.name_of(v) for v in eqn.invars[:n_consts]]
+    carry_vars = eqn.invars[n_consts:n_consts + n_carry]
+    carry_init = [ctx.name_of(v) for v in carry_vars]
+    xs_vars = eqn.invars[n_consts + n_carry:]
+    xs_names = [ctx.name_of(v) for v in xs_vars]
+    for cv, cval in zip(inner.constvars, consts):
+        ctx.names[cv] = ctx.add_const(np.asarray(cval))
+
+    body = P.GraphProto(name=ctx.fresh("scan_body"))
+    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond_in")
+    vi = body.input.add(name=iter_nm)
+    vi.type.tensor_type.elem_type = P.TensorProto.INT64
+    vi = body.input.add(name=cond_nm)
+    vi.type.tensor_type.elem_type = P.TensorProto.BOOL
+    body_carry = []
+    for cv in carry_vars:
+        nm = ctx.fresh("loop_c")
+        body_carry.append(nm)
+        vi = body.input.add(name=nm)
+        tt = vi.type.tensor_type
+        tt.elem_type = _onnx_dtype(cv.aval.dtype)
+        for d in cv.aval.shape:
+            tt.shape.dim.add(dim_value=int(d))
+
+    # body nodes collect into a swapped-in list; names stay shared (the
+    # fresh-name counter must keep advancing so body/outer never collide)
+    saved_nodes, ctx.nodes = ctx.nodes, []
+    local = dict(ctx.names)
+    x_slices = []
+    for xv, xn in zip(xs_vars, xs_names):
+        sl = ctx.fresh("loop_x")
+        ctx.emit("Gather", [xn, iter_nm], [sl], axis=0)
+        x_slices.append(sl)
+    saved_names, ctx.names = ctx.names, local
+    for iv, nm in zip(inner.invars, const_names + body_carry + x_slices):
+        ctx.names[iv] = nm
+    _walk(ctx, inner)
+    cond_out = ctx.fresh("cond_out")
+    ctx.emit("Identity", [cond_nm], [cond_out])
+    carry_out = [ctx.name_of(ov) for ov in inner.outvars[:n_carry]]
+    ys_out = [ctx.name_of(ov) for ov in inner.outvars[n_carry:]]
+    body_nodes, ctx.nodes = ctx.nodes, saved_nodes
+    ctx.names = saved_names
+    body.node.extend(body_nodes)
+
+    vi = body.output.add(name=cond_out)
+    vi.type.tensor_type.elem_type = P.TensorProto.BOOL
+    for nm, ov in zip(carry_out, inner.outvars[:n_carry]):
+        vi = body.output.add(name=nm)
+        tt = vi.type.tensor_type
+        tt.elem_type = _onnx_dtype(ov.aval.dtype)
+        for d in ov.aval.shape:
+            tt.shape.dim.add(dim_value=int(d))
+    for nm, ov in zip(ys_out, inner.outvars[n_carry:]):
+        vi = body.output.add(name=nm)
+        tt = vi.type.tensor_type
+        tt.elem_type = _onnx_dtype(ov.aval.dtype)
+        for d in ov.aval.shape:   # PER-ITERATION shape; Loop stacks
+            tt.shape.dim.add(dim_value=int(d))
+
+    trip = ctx.add_const(np.asarray(length, np.int64), "trip")
+    cond0 = ctx.add_const(np.asarray(True), "cond")
+    outs = [ctx.name_of(ov) for ov in eqn.outvars]
+    ctx.emit("Loop", [trip, cond0] + carry_init, outs, body=body)
 
 
 @_handler("pjit", "jit", "closed_call", "custom_jvp_call",

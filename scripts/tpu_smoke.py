@@ -209,7 +209,11 @@ def main():
         os.environ["PADDLE_TPU_AUTOTUNE"] = "1"
         from paddle_tpu.kernels import autotune as at
         at._CACHE = at.AutotuneCache()   # re-read path env
-        for b, h, kvh, s, d in ((4, 32, 8, 2048, 128),):
+        # rung-1 dense shape + the MoE rung's shape (DeepSeekMoE-16B
+        # slice at b2/s1024: 16 heads, d128) so both bench rungs run
+        # tuned blocks
+        for b, h, kvh, s, d in ((4, 32, 8, 2048, 128),
+                                (2, 16, 16, 1024, 128)):
             blocks = at.flash_blocks((b, s, h, d), (b, s, kvh, d),
                                      jnp.bfloat16, True)
             print(f"tuned blocks for s={s}: {blocks}", file=sys.stderr)

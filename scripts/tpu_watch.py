@@ -21,7 +21,6 @@ Artifacts: BENCH_SELF_r05.json (run 1) and BENCH_SELF_r05_run2.json
 """
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -29,6 +28,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 from bench import _RELAY_PORTS as RELAY_PORTS  # noqa: E402  single source
+from bench import _relay_alive as relay_alive  # noqa: E402
 
 def _env_float(name, default):
     """A bad override must not crash the watcher at the moment the
@@ -46,16 +46,6 @@ RUN1_DEADLINE_S = _env_float("TPU_WATCH_RUN1_DEADLINE_S", "3000")
 def log(msg):
     print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr)
     sys.stderr.flush()
-
-
-def relay_alive():
-    for port in RELAY_PORTS:
-        try:
-            socket.create_connection(("127.0.0.1", port), timeout=2).close()
-            return True
-        except OSError:
-            continue
-    return False
 
 
 def pjrt_alive(timeout_s=150):
@@ -130,6 +120,12 @@ def main():
             log("run 1 did not measure; re-probing")
             time.sleep(PROBE_EVERY_S)
             continue
+        log("re-running the smoke sweep (tunes the MoE rung shape)")
+        try:
+            subprocess.run([sys.executable, "scripts/tpu_smoke.py"],
+                           cwd=REPO, timeout=1800)
+        except subprocess.TimeoutExpired:
+            log("smoke re-run timed out; continuing to bench run 2")
         log("bench run 2 (default driver budget, cache-warm)")
         run_bench("BENCH_SELF_r05_run2.json", None, 1200)
         log("done")

@@ -43,6 +43,8 @@ def _attrs(node):
             out[a.name] = list(a.ints)
         elif a.type == P.AttributeProto.FLOATS:
             out[a.name] = list(a.floats)
+        elif a.type == P.AttributeProto.GRAPH:
+            out[a.name] = a.g
     return out
 
 
@@ -88,7 +90,13 @@ def run(model, inputs):
     assert len(names) == len(inputs), (names, len(inputs))
     for n, x in zip(names, inputs):
         env[n] = np.asarray(x)
+    _exec_nodes(g, env)
+    return [env[vi.name] for vi in g.output]
 
+
+def _exec_nodes(g, env):
+    """Execute g.node into env (which may hold outer-scope tensors —
+    ONNX subgraphs read enclosing-graph names)."""
     for node in g.node:
         i = [env[n] for n in node.input]
         a = _attrs(node)
@@ -244,11 +252,34 @@ def run(model, inputs):
             idx = np.take(order, range(k), axis=axis)
             r = (np.take_along_axis(i[0], idx, axis=axis),
                  idx.astype(np.int64))
+        elif op == "Loop":
+            body = a["body"]
+            trip, cond = int(i[0]), bool(i[1])
+            carries = list(i[2:])
+            n_carry = len(carries)
+            n_scan = len(node.output) - n_carry
+            scans = [[] for _ in range(n_scan)]
+            t = 0
+            while t < trip and cond:
+                benv = dict(env)   # outer-scope capture
+                bi = body.input
+                benv[bi[0].name] = np.asarray(t, np.int64)
+                benv[bi[1].name] = np.asarray(cond)
+                for vi, c in zip(bi[2:], carries):
+                    benv[vi.name] = c
+                for bt in body.initializer:
+                    benv[bt.name] = tensor_to_np(bt)
+                _exec_nodes(body, benv)
+                outs = [benv[vi.name] for vi in body.output]
+                cond = bool(outs[0])
+                carries = outs[1:1 + n_carry]
+                for k, v in enumerate(outs[1 + n_carry:]):
+                    scans[k].append(v)
+                t += 1
+            r = tuple(carries + [np.stack(s, axis=0) for s in scans])
         else:
             raise AssertionError(f"interpreter has no op {op}")
         if not isinstance(r, tuple):
             r = (r,)
         for nm, val in zip(node.output, r):
             env[nm] = np.asarray(val)
-
-    return [env[vi.name] for vi in g.output]

@@ -96,3 +96,99 @@ class TestEngine:
         y = paddle.to_tensor(np.ones((2, 1), "float32"))
         with pytest.raises(E.NotFoundError):
             eng.fit([(x, y)], epochs=1)
+
+
+class TestPipelineMaterialization:
+    """pp>1 plans materialise as pipeline runtime configs (ROUND5 gap:
+    the planner could CHOOSE pp but nothing turned the choice into a
+    runnable schedule)."""
+
+    def _pp_plan(self, pp=4, dp=1, mbs=2, gbs=16):
+        cfg = dict(dp_degree=dp, sharding_degree=1, mp_degree=2,
+                   pp_degree=pp, micro_batch_size=mbs)
+        return ParallelPlan(config=cfg, world=dp * 2 * pp, cost=1.0,
+                            naive_cost=math.inf, global_batch_size=gbs)
+
+    def test_pipeline_config_derivation(self):
+        pc = self._pp_plan().pipeline_config()
+        assert pc.num_stages == 4
+        assert pc.num_micro == 8            # 16 / (dp=1 * sh=1 * mbs=2)
+        assert pc.micro_batch_size == 2
+
+    def test_pipeline_config_uses_planner_acc_steps(self):
+        # a real planner candidate carries acc_steps = gbs/(dp*sh)/mbs;
+        # the materialised num_micro must match the costed work exactly
+        # (the batch splits over BOTH dp-like axes before micro-batching)
+        plan = self._pp_plan()
+        plan.config.update(sharding_degree=2, acc_steps=4)
+        assert plan.pipeline_config().num_micro == 4
+
+    def test_pipeline_config_sharding_fallback(self):
+        plan = self._pp_plan(gbs=16, mbs=2)
+        plan.config["sharding_degree"] = 2   # no acc_steps in config
+        assert plan.pipeline_config().num_micro == 4   # 16/(1*2*2)
+
+    def test_pp1_has_no_pipeline_config(self):
+        plan = self._pp_plan(pp=1)
+        plan.config["pp_degree"] = 1
+        assert plan.pipeline_config() is None
+        with pytest.raises(E.InvalidArgumentError, match="pp=1"):
+            plan.build_pipeline_step(lambda p, x: x, lambda y, l: 0.0)
+
+    def test_indivisible_batch_raises(self):
+        plan = self._pp_plan(mbs=3, gbs=16)
+        with pytest.raises(E.PreconditionNotMetError):
+            plan.pipeline_config()
+
+    def test_mesh_gains_pp_axis(self):
+        plan = self._pp_plan()
+        mesh = plan.build_mesh()
+        assert mesh.axis_names == ("dp", "fsdp", "tp", "pp")
+        assert mesh.shape["pp"] == 4 and mesh.shape["tp"] == 2
+
+    def test_pp_step_trains_and_matches_sequential_oracle(self):
+        # 1x1x2x4 mesh: a 4-stage pipeline (tp axis unused by the stage
+        # fn) vs running the same stages sequentially — GPipe semantics
+        # must be exact, not approximate
+        import jax
+        import jax.numpy as jnp
+
+        plan = self._pp_plan(pp=4, dp=1, mbs=2, gbs=16)
+        d = 8
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.normal(size=(4, d, d)) * 0.3,
+                                   jnp.float32)}
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        def loss_fn(y, l):
+            return jnp.mean((y - l) ** 2)
+
+        step, mesh, pc = plan.build_pipeline_step(
+            stage_fn, loss_fn, lr=0.05, remat=False)
+        x = jnp.asarray(rng.normal(size=(16, d)), jnp.float32)
+        lbl = jnp.asarray(rng.normal(size=(16, d)), jnp.float32)
+
+        from paddle_tpu.distributed.pipeline import shard_stage_params
+        pparams = shard_stage_params(params, mesh, axis=pc.axis)
+        new_params, loss = step(pparams, x, lbl)
+
+        # oracle: sequential stage application per micro-batch
+        def oracle_loss(params, x, lbl):
+            xs = x.reshape(pc.num_micro, pc.micro_batch_size, d)
+            ls = lbl.reshape(pc.num_micro, pc.micro_batch_size, d)
+            def per_micro(xm, lm):
+                y = xm
+                for s in range(4):
+                    y = stage_fn({"w": params["w"][s]}, y)
+                return loss_fn(y, lm)
+            return jnp.mean(jax.vmap(per_micro)(xs, ls))
+
+        want_loss, want_g = jax.value_and_grad(oracle_loss)(params, x, lbl)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        want_w = params["w"] - 0.05 * want_g["w"]
+        np.testing.assert_allclose(np.asarray(new_params["w"]),
+                                   np.asarray(want_w), rtol=1e-4,
+                                   atol=1e-5)

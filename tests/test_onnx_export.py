@@ -140,14 +140,53 @@ class TestOnnxExport:
         want = np.asarray(fn(ids), np.float32)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
-    def test_scan_beyond_unroll_cap_raises(self):
+    def test_scan_beyond_unroll_cap_becomes_loop(self):
+        # 500 > _MAX_SCAN_UNROLL: converts as one ONNX Loop node whose
+        # body subgraph gathers x[i], not 500 unrolled copies
         import jax
 
         def fn(x):
-            return jax.lax.scan(lambda c, v: (c + v, c), x[0], x)[0]
+            c, ys = jax.lax.scan(lambda c, v: (c * 0.99 + v, c.sum()),
+                                 x[0], x)
+            return c, ys
 
-        with pytest.raises(E.UnimplementedError, match="unroll cap"):
-            to_onnx_model(fn, [np.ones((500, 2), "float32")])
+        x = np.random.default_rng(3).normal(size=(500, 2)).astype(
+            "float32")
+        m = to_onnx_model(fn, [x])
+        assert sum(1 for n in m.graph.node if n.op_type == "Loop") == 1
+        assert len(m.graph.node) < 30
+        m = P.ModelProto.FromString(m.SerializeToString())
+        got = run(m, [x])
+        want = fn(x)
+        np.testing.assert_allclose(got[0], np.asarray(want[0]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(got[1], np.asarray(want[1]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_llama_loop_path_numerics(self, monkeypatch):
+        # force the flagship scan-over-layers decoder down the Loop path
+        # (cap 0) and check parity vs eager — proves real models convert
+        # at arbitrary depth, not just toy scans
+        import jax
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.onnx import converter as C
+
+        monkeypatch.setattr(C, "_MAX_SCAN_UNROLL", 0)
+        cfg = L.llama_tiny(num_hidden_layers=3, hidden_size=32,
+                           num_attention_heads=2, num_key_value_heads=2,
+                           vocab_size=64, remat=False)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        ids = np.asarray([[1, 5, 9, 3]], "int32")
+
+        def fn(i):
+            return L.forward(params, i, cfg)
+
+        m = to_onnx_model(fn, [ids])
+        assert any(n.op_type == "Loop" for n in m.graph.node)
+        m = P.ModelProto.FromString(m.SerializeToString())
+        got = run(m, [ids])[0]
+        want = np.asarray(fn(ids), np.float32)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
     def test_sort_topk_numerics(self):
         class F(nn.Layer):
