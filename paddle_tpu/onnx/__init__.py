@@ -18,7 +18,11 @@ __all__ = ["export"]
 
 def export(layer, path, input_spec=None, opset_version=17, **configs):
     """Export ``layer`` to ``path`` (``.onnx`` appended if absent).
-    ``input_spec``: example inputs or InputSpec list (concrete dims)."""
+    ``input_spec``: example inputs or InputSpec list. InputSpec dims of
+    ``None`` (or a string name) become DYNAMIC onnx dims (dim_param):
+    the converter traces at two sizes and rewrites shape constants as
+    runtime Shape() computations, so the export runs at sizes never
+    traced."""
     import numpy as np
 
     from ..core import enforce as E
@@ -29,18 +33,30 @@ def export(layer, path, input_spec=None, opset_version=17, **configs):
                        hint="onnx.export needs example inputs or "
                             "InputSpec(shape, dtype) entries")
     examples = []
-    for s in input_spec:
+    dynamic_axes = {}
+    for idx, s in enumerate(input_spec):
         if isinstance(s, InputSpec):
-            E.enforce(all(isinstance(d, int) and d > 0 for d in s.shape),
-                      f"onnx.export InputSpec dims must be concrete, "
-                      f"got {s.shape}", E.InvalidArgumentError)
-            examples.append(np.zeros(s.shape, dtype=s.dtype))
+            shape, axes = [], {}
+            for ax, d in enumerate(s.shape):
+                if isinstance(d, int) and d > 0:
+                    shape.append(d)
+                    continue
+                E.enforce(d is None or isinstance(d, str),
+                          f"onnx.export InputSpec dim must be a positive "
+                          f"int, None, or a name, got {d!r}",
+                          E.InvalidArgumentError)
+                axes[ax] = d if isinstance(d, str) else f"dyn_{idx}_{ax}"
+                shape.append(2)    # example size for the traced graph
+            examples.append(np.zeros(shape, dtype=s.dtype))
+            if axes:
+                dynamic_axes[idx] = axes
         else:
             examples.append(s)
 
     onnx_path = path if path.endswith(".onnx") else path + ".onnx"
     try:
-        model = export_layer(layer, examples)
+        model = export_layer(layer, examples,
+                             dynamic_axes=dynamic_axes or None)
     except E.UnimplementedError:
         from .. import jit
 

@@ -672,8 +672,19 @@ def _scan_loop(ctx, eqn):
     _walk(ctx, inner)
     cond_out = ctx.fresh("cond_out")
     ctx.emit("Identity", [cond_nm], [cond_out])
-    carry_out = [ctx.name_of(ov) for ov in inner.outvars[:n_carry]]
-    ys_out = [ctx.name_of(ov) for ov in inner.outvars[n_carry:]]
+    # every body output goes through an Identity into a FRESH name:
+    # repeated outvars, passthrough carries (output name == input name),
+    # and Literal outvars (outer-scope initializers) would otherwise
+    # violate ONNX's unique/produced-in-graph output rules
+    carry_out, ys_out = [], []
+    for ov in inner.outvars[:n_carry]:
+        nm = ctx.fresh("carry_out")
+        ctx.emit("Identity", [ctx.name_of(ov)], [nm])
+        carry_out.append(nm)
+    for ov in inner.outvars[n_carry:]:
+        nm = ctx.fresh("y_out")
+        ctx.emit("Identity", [ctx.name_of(ov)], [nm])
+        ys_out.append(nm)
     body_nodes, ctx.nodes = ctx.nodes, saved_nodes
     ctx.names = saved_names
     body.node.extend(body_nodes)
@@ -745,9 +756,21 @@ def _walk(ctx: _Ctx, jaxpr):
 # public entry
 # ---------------------------------------------------------------------------
 
-def to_onnx_model(fn, example_inputs, *, name="paddle_tpu_model"):
+def to_onnx_model(fn, example_inputs, *, name="paddle_tpu_model",
+                  dynamic_axes=None):
     """Trace ``fn`` (arrays in -> arrays/pytree out) and convert the
-    jaxpr to a ModelProto. Closed-over parameters become initializers."""
+    jaxpr to a ModelProto. Closed-over parameters become initializers.
+
+    ``dynamic_axes``: {flat_input_index: {axis: "symbol"}} marks dims as
+    runtime-dynamic (exported as dim_param). Conversion then traces at
+    TWO sizes per symbol and rewrites every initializer entry that
+    changed as an affine function k*dim+c of the runtime ``Shape`` of
+    the marked input — so Reshape/Expand/Slice targets that bake the
+    traced size become shape-polymorphic. Values that are not affine in
+    a single symbol raise a typed error (honest failure, not a silently
+    wrong graph)."""
+    if dynamic_axes:
+        return _to_onnx_dynamic(fn, example_inputs, name, dynamic_axes)
     flat_in, in_tree = jax.tree_util.tree_flatten(tuple(example_inputs))
     closed = jax.make_jaxpr(
         lambda *xs: fn(*jax.tree_util.tree_unflatten(in_tree, xs)))(
@@ -789,7 +812,232 @@ def to_onnx_model(fn, example_inputs, *, name="paddle_tpu_model"):
     return model
 
 
-def export_layer(layer, example_inputs, *, name="paddle_tpu_model"):
+def _flat_graph_ops(g):
+    """op_type sequence of a graph including attribute subgraphs."""
+    out = []
+    for n in g.node:
+        out.append(n.op_type)
+        for a in n.attribute:
+            if a.type == P.AttributeProto.GRAPH:
+                out.extend(_flat_graph_ops(a.g))
+    return out
+
+
+def _subgraph_valueinfos(g):
+    """All ValueInfos of attribute subgraphs (recursively)."""
+    out = []
+    for n in g.node:
+        for a in n.attribute:
+            if a.type == P.AttributeProto.GRAPH:
+                out.extend(list(a.g.input) + list(a.g.output))
+                out.extend(_subgraph_valueinfos(a.g))
+    return out
+
+
+def _affine_fit3(v0, v1, v2, s0):
+    """(k, c) with v == k*s + c through the three measured points
+    (s0, s0+1, s0+2), or None when the dependence is not affine —
+    the third point is what catches k*s^2-style values that two points
+    would silently mis-fit."""
+    k = int(v1) - int(v0)
+    c = int(v0) - k * s0
+    if int(v2) == k * (s0 + 2) + c:
+        return k, c
+    return None
+
+
+def _to_onnx_dynamic(fn, example_inputs, name, dynamic_axes):
+    flat = [np.asarray(x) for x in example_inputs]
+    syms: Dict[str, list] = {}
+    for i, axes in dynamic_axes.items():
+        E.enforce(0 <= int(i) < len(flat),
+                  f"dynamic_axes input index {i} out of range",
+                  E.InvalidArgumentError)
+        for ax, sym in axes.items():
+            E.enforce(0 <= int(ax) < flat[int(i)].ndim,
+                      f"dynamic_axes axis {ax} out of range for input "
+                      f"{i}", E.InvalidArgumentError)
+            syms.setdefault(str(sym), []).append((int(i), int(ax)))
+    size1 = {}
+    for sym, locs in syms.items():
+        sizes = {flat[i].shape[ax] for i, ax in locs}
+        E.enforce(len(sizes) == 1,
+                  f"axes sharing dynamic dim '{sym}' have different "
+                  f"example sizes {sorted(sizes)}", E.InvalidArgumentError)
+        size1[sym] = sizes.pop()
+
+    # Isolation traces: bump ONE symbol at a time (+1 and +2), leaving
+    # the others at their example size, so an entry's dependence is
+    # attributed by which symbol's traces changed it — never by
+    # divisibility luck — and the +2 point rejects non-affine values.
+    def traced(sym_bumps):
+        fl = list(flat)
+        for sym, b in sym_bumps.items():
+            for i, ax in syms[sym]:
+                x = fl[i]
+                idx = np.arange(x.shape[ax] + b) % x.shape[ax]
+                fl[i] = np.take(x, idx, axis=ax)
+        return to_onnx_model(fn, fl, name=name)
+
+    m1 = to_onnx_model(fn, flat, name=name)
+    probes = {sym: (traced({sym: 1}), traced({sym: 2}))
+              for sym in sorted(syms)}
+    for sym, (ma, mb) in probes.items():
+        E.enforce(_flat_graph_ops(m1.graph) == _flat_graph_ops(ma.graph)
+                  == _flat_graph_ops(mb.graph),
+                  f"traced graph structure depends on dynamic dim "
+                  f"'{sym}'", E.UnimplementedError,
+                  hint="a data-dependent python branch on the marked "
+                       "axis size cannot export shape-polymorphically")
+        E.enforce(len(m1.graph.initializer)
+                  == len(ma.graph.initializer)
+                  == len(mb.graph.initializer),
+                  "initializer sets diverged between traces",
+                  E.UnimplementedError)
+
+    g = m1.graph
+    dctx = _Ctx()   # builds the shape-computation chains + their consts
+
+    def const1d(vals, hint="dyn_c"):
+        return dctx.add_const(np.asarray(vals, np.int64), hint)
+
+    dim_scalars: Dict[str, str] = {}   # sym -> [1]-tensor of runtime dim
+
+    def dim_of(sym):
+        if sym not in dim_scalars:
+            i, ax = syms[sym][0]
+            shp = dctx.fresh("dyn_shape")
+            dctx.emit("Shape", [f"input_{i}"], [shp])
+            out = dctx.fresh(f"dyn_dim_{sym}")
+            dctx.emit("Gather", [shp, const1d([ax], "dyn_ax")], [out],
+                      axis=0)
+            dim_scalars[sym] = out
+        return dim_scalars[sym]
+
+    def affine_entry(k, c, sym):
+        """[1] int64 tensor holding k*dim(sym)+c at runtime."""
+        v = dim_of(sym)
+        if k != 1:
+            out = dctx.fresh("dyn_mul")
+            dctx.emit("Mul", [v, const1d([k], "dyn_k")], [out])
+            v = out
+        if c != 0:
+            out = dctx.fresh("dyn_add")
+            dctx.emit("Add", [v, const1d([c], "dyn_add_c")], [out])
+            v = out
+        if k == 1 and c == 0:
+            out = dctx.fresh("dyn_dimcopy")
+            dctx.emit("Identity", [v], [out])
+            v = out
+        return v
+
+    def fit_value(v0, per_sym, what):
+        """(k, c, sym) for a value with per-symbol probe pairs, or a
+        const when nothing moved; typed errors otherwise."""
+        moved = [sym for sym, (va, vb) in per_sym.items()
+                 if va != v0 or vb != v0]
+        if not moved:
+            return None
+        E.enforce(len(moved) == 1,
+                  f"{what}: value {v0} depends on several dynamic dims "
+                  f"({moved})", E.UnimplementedError,
+                  hint="products of two dynamic dims cannot export; "
+                       "mark only one of them dynamic")
+        sym = moved[0]
+        va, vb = per_sym[sym]
+        fit = _affine_fit3(v0, va, vb, size1[sym])
+        E.enforce_not_none(
+            fit, f"{what}: value {v0}->{va}->{vb}",
+            error=E.UnimplementedError,
+            hint=f"the value is not affine in dynamic dim '{sym}'")
+        return fit[0], fit[1], sym
+
+    keep_inits: List = []
+    for j, t1 in enumerate(g.initializer):
+        probe_ts = {sym: (probes[sym][0].graph.initializer[j],
+                          probes[sym][1].graph.initializer[j])
+                    for sym in syms}
+        if all(t1.raw_data == ta.raw_data == tb.raw_data
+               and list(t1.dims) == list(ta.dims) == list(tb.dims)
+               for ta, tb in probe_ts.values()):
+            keep_inits.append(t1)
+            continue
+        ok = (t1.data_type == P.TensorProto.INT64 and len(t1.dims) <= 1
+              and all(list(t1.dims) == list(ta.dims) == list(tb.dims)
+                      for ta, tb in probe_ts.values()))
+        E.enforce(ok, f"initializer '{t1.name}' depends on the dynamic "
+                      f"dim in a non-shape way (dtype/shape changed)",
+                  E.UnimplementedError,
+                  hint="only int64 shape-vector constants can be made "
+                       "runtime-dynamic")
+        a1 = np.frombuffer(t1.raw_data, np.int64).ravel()
+        arrs = {sym: (np.frombuffer(ta.raw_data, np.int64).ravel(),
+                      np.frombuffer(tb.raw_data, np.int64).ravel())
+                for sym, (ta, tb) in probe_ts.items()}
+        parts = []
+        for e, v0 in enumerate(a1):
+            fit = fit_value(
+                int(v0),
+                {sym: (int(aa[e]), int(ab[e]))
+                 for sym, (aa, ab) in arrs.items()},
+                f"initializer '{t1.name}' entry {e}")
+            parts.append(const1d([v0]) if fit is None
+                         else affine_entry(*fit))
+        if len(t1.dims) == 0:   # scalar consumer: reshape [1] -> []
+            dctx.emit("Reshape",
+                      [parts[0], const1d(np.empty((0,), np.int64),
+                                         "dyn_scalar")], [t1.name])
+        elif len(parts) == 1:
+            dctx.emit("Identity", [parts[0]], [t1.name])
+        else:
+            dctx.emit("Concat", parts, [t1.name], axis=0)
+
+    del g.initializer[:]
+    g.initializer.extend(keep_inits + dctx.inits)
+    old_nodes = list(g.node)
+    del g.node[:]
+    g.node.extend(dctx.nodes + old_nodes)
+
+    # --- symbolic dims on graph inputs ---------------------------------
+    for i, axes in dynamic_axes.items():
+        dims = g.input[int(i)].type.tensor_type.shape.dim
+        for ax, sym in axes.items():
+            dims[int(ax)].ClearField("dim_value")
+            dims[int(ax)].dim_param = str(sym)
+
+    # --- outputs + subgraph ValueInfos: label dims that moved ----------
+    def relabel(vi1, vi_probes):
+        d1 = vi1.type.tensor_type.shape.dim
+        probe_dims = {sym: (va.type.tensor_type.shape.dim,
+                            vb.type.tensor_type.shape.dim)
+                      for sym, (va, vb) in vi_probes.items()}
+        for idx, a in enumerate(d1):
+            per_sym = {sym: (da[idx].dim_value, db[idx].dim_value)
+                       for sym, (da, db) in probe_dims.items()}
+            if all(a.dim_value == va == vb
+                   for va, vb in per_sym.values()):
+                continue
+            fit = fit_value(a.dim_value, per_sym,
+                            f"output dim of '{vi1.name}'")
+            label = (fit[2] if fit[:2] == (1, 0)
+                     else f"{fit[0]}*{fit[2]}+{fit[1]}")
+            a.ClearField("dim_value")
+            a.dim_param = label
+
+    out_lists = {sym: (list(ma.graph.output)
+                       + _subgraph_valueinfos(ma.graph),
+                       list(mb.graph.output)
+                       + _subgraph_valueinfos(mb.graph))
+                 for sym, (ma, mb) in probes.items()}
+    base_vis = list(g.output) + _subgraph_valueinfos(g)
+    for idx, vi1 in enumerate(base_vis):
+        relabel(vi1, {sym: (la[idx], lb[idx])
+                      for sym, (la, lb) in out_lists.items()})
+    return m1
+
+
+def export_layer(layer, example_inputs, *, name="paddle_tpu_model",
+                 dynamic_axes=None):
     """Convert an eval-mode Layer to a ModelProto (its parameters are
     captured as initializers)."""
     from ..core import state
@@ -804,4 +1052,5 @@ def export_layer(layer, example_inputs, *, name="paddle_tpu_model"):
 
     arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
               for x in example_inputs]
-    return to_onnx_model(fn, arrays, name=name)
+    return to_onnx_model(fn, arrays, name=name,
+                         dynamic_axes=dynamic_axes)

@@ -122,8 +122,11 @@ def main():
             continue
         log("re-running the smoke sweep (tunes the MoE rung shape)")
         try:
-            subprocess.run([sys.executable, "scripts/tpu_smoke.py"],
-                           cwd=REPO, timeout=1800)
+            r = subprocess.run([sys.executable, "scripts/tpu_smoke.py"],
+                               cwd=REPO, timeout=1800)
+            if r.returncode != 0:
+                log(f"smoke re-run FAILED rc={r.returncode} — run 2 "
+                    "proceeds with whatever the cache already holds")
         except subprocess.TimeoutExpired:
             log("smoke re-run timed out; continuing to bench run 2")
         log("bench run 2 (default driver budget, cache-warm)")

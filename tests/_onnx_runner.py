@@ -161,6 +161,8 @@ def _exec_nodes(g, env):
                             P.TensorProto.BFLOAT16 else np.float32)
         elif op == "Reshape":
             r = i[0].reshape(tuple(int(d) for d in i[1]))
+        elif op == "Shape":
+            r = np.asarray(i[0].shape, np.int64)
         elif op == "Transpose":
             r = np.transpose(i[0], a["perm"])
         elif op == "Expand":
@@ -260,15 +262,16 @@ def _exec_nodes(g, env):
             n_scan = len(node.output) - n_carry
             scans = [[] for _ in range(n_scan)]
             t = 0
+            outer = dict(env)   # loop-invariant: outer scope + body inits
+            for bt in body.initializer:
+                outer[bt.name] = tensor_to_np(bt)
             while t < trip and cond:
-                benv = dict(env)   # outer-scope capture
+                benv = dict(outer)
                 bi = body.input
                 benv[bi[0].name] = np.asarray(t, np.int64)
                 benv[bi[1].name] = np.asarray(cond)
                 for vi, c in zip(bi[2:], carries):
                     benv[vi.name] = c
-                for bt in body.initializer:
-                    benv[bt.name] = tensor_to_np(bt)
                 _exec_nodes(body, benv)
                 outs = [benv[vi.name] for vi in body.output]
                 cond = bool(outs[0])

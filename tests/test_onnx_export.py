@@ -225,3 +225,173 @@ class TestOnnxExport:
             export(Sorter(), str(tmp_path / "s"),
                    input_spec=[np.ones((3, 2), "float32")])
         assert (tmp_path / "s.pdmodel").exists()   # StableHLO fallback
+
+
+class TestDynamicDims:
+    """Trace-twice shape polymorphism: initializer entries affine in a
+    marked dim are rewritten as runtime Shape() computations, so the
+    export runs at sizes never traced."""
+
+    def test_flatten_mlp_dynamic_batch(self):
+        # Flatten bakes [B, F] into a Reshape target — the classic
+        # dynamic-batch breaker. Export at B=2, execute at B=5.
+        class F(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(12, 4)
+
+            def forward(self, x):
+                return self.fc(paddle.flatten(x, start_axis=1))
+
+        layer = F(); layer.eval()
+        x2 = np.random.default_rng(0).normal(size=(2, 3, 4)).astype(
+            "float32")
+        m = export_layer(layer, [x2], dynamic_axes={0: {0: "batch"}})
+        # input dim 0 is symbolic
+        d0 = m.graph.input[0].type.tensor_type.shape.dim[0]
+        assert d0.dim_param == "batch"
+        d0out = m.graph.output[0].type.tensor_type.shape.dim[0]
+        assert d0out.dim_param == "batch"
+        m = P.ModelProto.FromString(m.SerializeToString())
+        x5 = np.random.default_rng(1).normal(size=(5, 3, 4)).astype(
+            "float32")
+        got = run(m, [x5])[0]
+        want = layer(paddle.to_tensor(x5)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_attention_softmax_dynamic_batch(self):
+        # broadcast/reduce/reshape-heavy graph at a never-traced size
+        class F(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.q = nn.Linear(8, 8)
+                self.k = nn.Linear(8, 8)
+
+            def forward(self, x):
+                q, k = self.q(x), self.k(x)
+                a = paddle.matmul(q, k, transpose_y=True) / 8 ** 0.5
+                a = paddle.nn.functional.softmax(a, axis=-1)
+                return paddle.matmul(a, x)
+
+        layer = F(); layer.eval()
+        x = np.random.default_rng(2).normal(size=(2, 6, 8)).astype(
+            "float32")
+        m = export_layer(layer, [x], dynamic_axes={0: {0: "b"}})
+        m = P.ModelProto.FromString(m.SerializeToString())
+        x7 = np.random.default_rng(3).normal(size=(7, 6, 8)).astype(
+            "float32")
+        got = run(m, [x7])[0]
+        want = layer(paddle.to_tensor(x7)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_structure_dependent_on_dim_raises(self):
+        import jax.numpy as jnp
+
+        def fn(x):
+            # iota of length B: the baked arange CHANGES SHAPE with the
+            # marked dim -> honest typed failure, not a wrong graph
+            return jnp.arange(x.shape[0]) + x[:, 0].astype(jnp.int32)
+
+        x = np.zeros((3, 2), "float32")
+        with pytest.raises(E.UnimplementedError):
+            to_onnx_model(fn, [x], dynamic_axes={0: {0: "batch"}})
+
+    def test_export_api_inputspec_none_dim(self, tmp_path):
+        from paddle_tpu.jit.api import InputSpec
+        from paddle_tpu.onnx import export
+
+        class F(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(6, 3)
+
+            def forward(self, x):
+                return self.fc(paddle.flatten(x, start_axis=1))
+
+        layer = F(); layer.eval()
+        p = export(layer, str(tmp_path / "m"),
+                   input_spec=[InputSpec([None, 2, 3], "float32")])
+        with open(p, "rb") as f:
+            m = P.ModelProto.FromString(f.read())
+        d0 = m.graph.input[0].type.tensor_type.shape.dim[0]
+        assert d0.dim_param
+        x = np.random.default_rng(4).normal(size=(9, 2, 3)).astype(
+            "float32")
+        got = run(m, [x])[0]
+        want = layer(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_two_symbols_attributed_independently(self):
+        # the two-point-fit trap: with batch AND seq both dynamic, a
+        # seq-derived Reshape entry must NOT be attributed to batch.
+        # Exported at (B=2, S=6), executed at (B=4, S=3).
+        class F(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):       # [B, S, 8]
+                y = self.fc(x)
+                # bakes a [B, S*8] Reshape target: entry 0 is affine in
+                # batch, entry 1 affine in seq (k=8) — a two-point fit
+                # with shared traces would attribute BOTH to batch
+                return paddle.flatten(y, start_axis=1)
+
+        layer = F(); layer.eval()
+        x = np.random.default_rng(5).normal(size=(2, 6, 8)).astype(
+            "float32")
+        m = export_layer(layer, [x],
+                         dynamic_axes={0: {0: "batch", 1: "seq"}})
+        m = P.ModelProto.FromString(m.SerializeToString())
+        x2 = np.random.default_rng(6).normal(size=(4, 3, 8)).astype(
+            "float32")
+        got = run(m, [x2])[0]
+        want = layer(paddle.to_tensor(x2)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_product_of_two_dynamic_dims_raises(self):
+        import jax.numpy as jnp
+
+        def fn(x):
+            return jnp.reshape(x, (x.shape[0] * x.shape[1],))
+
+        x = np.zeros((2, 6), "float32")
+        with pytest.raises(E.UnimplementedError, match="several"):
+            to_onnx_model(fn, [x],
+                          dynamic_axes={0: {0: "b", 1: "s"}})
+
+
+class TestLoopBodyNaming:
+    def test_repeated_and_passthrough_outvars(self, monkeypatch):
+        # body outputs that repeat one var / pass a carry through
+        # unchanged must still yield unique, body-produced output names
+        import jax
+        from paddle_tpu.onnx import converter as C
+
+        monkeypatch.setattr(C, "_MAX_SCAN_UNROLL", 0)
+
+        def fn(x):
+            def cell(c, v):
+                y = c + v
+                return y, y          # carry AND ys are the SAME var
+            c, ys = jax.lax.scan(cell, x[0], x)
+            return c, ys
+
+        x = np.random.default_rng(8).normal(size=(5, 3)).astype(
+            "float32")
+        m = to_onnx_model(fn, [x])
+        (loop,) = [n for n in m.graph.node if n.op_type == "Loop"]
+        (body,) = [a.g for a in loop.attribute if a.name == "body"]
+        out_names = [vi.name for vi in body.output]
+        assert len(out_names) == len(set(out_names))
+        produced = {o for n in body.node for o in n.output}
+        assert set(out_names) <= produced
+        in_names = {vi.name for vi in body.input}
+        assert not (set(out_names) & in_names)
+        m = P.ModelProto.FromString(m.SerializeToString())
+        got = run(m, [x])
+        want = fn(x)
+        np.testing.assert_allclose(got[0], np.asarray(want[0]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got[1], np.asarray(want[1]),
+                                   rtol=1e-5, atol=1e-6)
