@@ -94,16 +94,28 @@ def _make_rms_dispatch(tpu_only: bool):
     return dispatch
 
 
-def dispatched_fused_ce(x, head, labels, *, vocab_chunk=4096,
+def dispatched_fused_ce(x, head, labels, *, vocab_chunk=None,
                         reduction="mean", ignore_index=-100):
     """Blockwise CE with the same counter discipline as flash/rms: the
     trace records whether the memory-efficient path engaged, and an
     unsupported shape falls back to the materialising xent (identical
     math, including ignore_index masking and valid-count mean) instead
     of erroring. Works on every backend (it is pure jnp/lax, not
-    pallas), so there is no tpu_only gate."""
+    pallas), so there is no tpu_only gate.
+
+    ``vocab_chunk=None`` (default) resolves through the autotune cache;
+    an explicit int is ALWAYS respected verbatim — a user capping
+    loss-path HBM must not be overridden by a throughput-tuned winner."""
     if _fce.supported(x, head, labels):
         _DISPATCH_STATS["fused_ce"] += 1
+        if vocab_chunk is None:
+            from . import autotune as _at
+
+            n_tokens = 1
+            for s in x.shape[:-1]:
+                n_tokens *= int(s)
+            vocab_chunk = _at.ce_chunk(n_tokens, int(x.shape[-1]),
+                                       int(head.shape[0]), x.dtype)
         return _fce.fused_cross_entropy(
             x, head, labels, vocab_chunk=vocab_chunk, reduction=reduction,
             ignore_index=ignore_index)

@@ -170,40 +170,174 @@ def flash_candidates(bh, sq, sk, d, dtype):
     return out
 
 
-def _measure_flash(b, sq, sk, h, kvh, d, dtype, causal, bq, bk,
-                   interpret=False) -> float:
-    """Seconds per fwd+bwd of the real kernel at the real shape."""
+def _rand(rng, shape, dtype, scale=1.0):
+    # float32 host generation: float64 standard_normal doubles the host
+    # bytes for multi-GB sweep operands for no measurement benefit
+    return jnp.asarray(
+        rng.standard_normal(shape, dtype=np.float32) * scale, dtype)
+
+
+def _flash_measurer(b, sq, sk, h, kvh, d, dtype, causal):
+    """Per-sweep measurement closure: operands materialise ONCE, every
+    candidate reuses them (per-candidate regeneration cost minutes of
+    host RNG + transfer on large shapes)."""
     # Import from the submodule directly: the package __init__ rebinds
     # the ``flash_attention`` attribute to the function, so a lazy
     # ``from . import flash_attention`` here would get the function.
     from .flash_attention import flash_attention as _flash
 
     rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.standard_normal((b, sq, h, d)), dtype)
-    k = jnp.asarray(rng.standard_normal((b, sk, kvh, d)), dtype)
-    v = jnp.asarray(rng.standard_normal((b, sk, kvh, d)), dtype)
+    q = _rand(rng, (b, sq, h, d), dtype)
+    k = _rand(rng, (b, sk, kvh, d), dtype)
+    v = _rand(rng, (b, sk, kvh, d), dtype)
 
-    def loss(q, k, v):
-        return jnp.sum(_flash(
-            q, k, v, causal=causal, block_q=bq, block_k=bk,
-            interpret=interpret).astype(jnp.float32))
+    def measure(bq, bk, interpret=False):
+        def loss(q, k, v):
+            return jnp.sum(_flash(
+                q, k, v, causal=causal, block_q=bq, block_k=bk,
+                interpret=interpret).astype(jnp.float32))
 
-    f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-    out = f(q, k, v)                    # compile + warmup
-    jax.block_until_ready(out)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = f(q, k, v)
-        # float() hard-syncs even through the axon tunnel (where
-        # block_until_ready can return early)
-        float(out[0][0, 0, 0, 0].astype(jnp.float32))
-        best = min(best, time.perf_counter() - t0)
-    return best
+        f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        out = f(q, k, v)                # compile + warmup
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = f(q, k, v)
+            # float() hard-syncs even through the axon tunnel (where
+            # block_until_ready can return early)
+            float(out[0][0, 0, 0, 0].astype(jnp.float32))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return measure
+
+
+def _measure_flash(b, sq, sk, h, kvh, d, dtype, causal, bq, bk,
+                   interpret=False) -> float:
+    """One-shot measurement (tests); sweeps use _flash_measurer."""
+    return _flash_measurer(b, sq, sk, h, kvh, d, dtype, causal)(
+        bq, bk, interpret=interpret)
 
 
 def _tuning_backend() -> bool:
     return jax.default_backend() in ("tpu", "axon")
+
+
+# --------------------------------------------------------------------------
+# fused cross-entropy vocab-chunk tuning (same cache/policy machinery).
+# The chunk trades scan length against per-chunk logits HBM: too small
+# pays scan overhead, too large re-materialises what the kernel exists
+# to avoid. Like cuDNN algo choice, the right point is measured, not
+# guessed.
+# --------------------------------------------------------------------------
+
+CE_DEFAULT_CHUNK = 4096
+CE_CANDIDATES = (1024, 2048, 4096, 8192, 16384)
+
+
+def ce_candidates(vocab: int):
+    """Legal vocab-chunk candidates, default first, clamped to V."""
+    out = []
+    for c in (CE_DEFAULT_CHUNK,) + CE_CANDIDATES:
+        c = min(c, vocab)
+        if c % 128 and c != vocab:   # keep lane-aligned tiles
+            continue
+        if c not in out:
+            out.append(c)
+    return out
+
+
+def _ce_measurer(n, d, v, dtype):
+    """Per-sweep closure: the [V, D] head (multi-GB at 100k vocab)
+    materialises once, every candidate reuses it."""
+    from .fused_ce import fused_cross_entropy
+
+    rng = np.random.default_rng(0)
+    x = _rand(rng, (n, d), dtype)
+    head = _rand(rng, (v, d), dtype, scale=0.05)
+    labels = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+
+    def measure(chunk):
+        f = jax.jit(jax.grad(
+            lambda x, h: fused_cross_entropy(x, h, labels,
+                                             vocab_chunk=chunk),
+            argnums=(0, 1)))
+        out = f(x, head)                 # compile + warmup
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = f(x, head)
+            float(out[0][0, 0].astype(jnp.float32))  # axon-safe sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return measure
+
+
+def _measure_ce(n, d, v, dtype, chunk) -> float:
+    """One-shot measurement (tests); sweeps use _ce_measurer."""
+    return _ce_measurer(n, d, v, dtype)(chunk)
+
+
+def ce_chunk(n_tokens, hidden, vocab, dtype,
+             default: int = CE_DEFAULT_CHUNK,
+             measure: Optional[Callable] = None,
+             cache: Optional[AutotuneCache] = None) -> int:
+    """Tuned vocab_chunk for a fused-CE call; measures once per shape
+    key and caches (memory + disk), same policy gates as flash_blocks."""
+    default = min(default, vocab)
+    key = (f"ce:{jax.default_backend()}:{jnp.dtype(dtype).name}:"
+           f"n{n_tokens}v{vocab}d{hidden}")
+    mode = _mode()
+    if not _flags.flag_value("use_autotune") or mode == "0":
+        _USED[key] = {"chunk": default, "source": "off"}
+        return default
+    if measure is None and mode != "cached" and not _tuning_backend():
+        _USED[key] = {"chunk": default, "source": "default-not-tpu"}
+        return default
+    cache = cache or _CACHE
+    hit = cache.get(key)
+    if hit and not hit.get("error"):
+        _USED[key] = {"chunk": hit["chunk"], "source": "cache"}
+        return int(hit["chunk"])
+    if key in _FAILED_KEYS or (
+            hit and hit.get("failures", 1) >= MAX_SWEEP_FAILURES):
+        _USED[key] = {"chunk": default, "source": "default"}
+        return default
+    if mode == "cached":
+        _USED[key] = {"chunk": default, "source": "default"}
+        return default
+    cands = ce_candidates(vocab)
+    if len(cands) == 1:
+        cache.put(key, {"chunk": cands[0], "us": None, "candidates": 1})
+        _USED[key] = {"chunk": cands[0], "source": "measured"}
+        return cands[0]
+    measure = measure or _ce_measurer(n_tokens, hidden, vocab, dtype)
+    timings = {}
+    last_err = None
+    for c in cands:
+        try:
+            timings[c] = measure(c)
+        except Exception as e:
+            last_err = f"{type(e).__name__}: {e}"[:200]
+            continue
+    if not timings:
+        _FAILED_KEYS.add(key)
+        prior = hit.get("failures", 1) if hit and hit.get("error") else 0
+        cache.put(key, {"chunk": default, "us": None, "candidates": 0,
+                        "failures": prior + 1,
+                        "error": f"all candidates failed ({last_err})"})
+        _USED[key] = {"chunk": default, "source": "default"}
+        return default
+    best = min(timings, key=timings.get)
+    cache.put(key, {"chunk": best, "us": round(timings[best] * 1e6, 1),
+                    "candidates": len(timings),
+                    "timings_us": {str(c): round(t * 1e6, 1)
+                                   for c, t in timings.items()}})
+    _USED[key] = {"chunk": best, "source": "measured"}
+    return best
 
 
 def flash_blocks(q_shape, k_shape, dtype, causal,
@@ -245,8 +379,8 @@ def flash_blocks(q_shape, k_shape, dtype, causal,
                         "candidates": 1})
         _USED[key] = {"blocks": list(cands[0]), "source": "measured"}
         return cands[0]
-    measure = measure or (lambda bq, bk: _measure_flash(
-        b, sq, sk, h, kvh, d, dtype, causal, bq, bk))
+    measure = measure or _flash_measurer(b, sq, sk, h, kvh, d, dtype,
+                                         causal)
     timings = {}
     last_err = None
     for bq, bk in cands:

@@ -72,7 +72,10 @@ class LlamaConfig:
     # multi-device loss keeps the einsum head (vocab-parallel sharding of
     # the scan-chunked head is not yet wired).
     fused_ce: bool = True
-    fused_ce_chunk: int = 4096
+    # None: the vocab-chunk comes from the autotune cache (measured per
+    # shape on TPU). An explicit int is respected verbatim — set it to
+    # cap loss-path HBM regardless of what tuning found fastest.
+    fused_ce_chunk: Optional[int] = None
 
     @property
     def head_dim(self) -> int:

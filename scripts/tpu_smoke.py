@@ -226,6 +226,19 @@ def main():
                 raise RuntimeError(
                     f"autotune sweep did not measure: {used} "
                     f"(cache entry: {at._CACHE.get(key)})")
+        # fused-CE vocab-chunk sweeps at the bench rungs' loss shapes:
+        # dense rung (b4*s2048 tokens, 32k vocab, d4096) and the MoE
+        # rung (b2*s1024, 102k vocab, d2048)
+        for n, d, v in ((8192, 4096, 32000), (2048, 2048, 102400)):
+            chunk = at.ce_chunk(n, d, v, jnp.bfloat16)
+            print(f"tuned ce chunk for n={n} v={v}: {chunk}",
+                  file=sys.stderr)
+            (key, used), = [(k, u) for k, u in at.used_blocks().items()
+                            if f"n{n}v{v}" in k]
+            if on_tpu and used["source"] not in ("measured", "cache"):
+                raise RuntimeError(
+                    f"ce autotune sweep did not measure: {used} "
+                    f"(cache entry: {at._CACHE.get(key)})")
 
     fails = [k for k, v in results.items() if v != "ok"]
     _emit({"skipped": None, "results": results,

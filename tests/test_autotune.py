@@ -215,3 +215,79 @@ class TestErrorEntrySelfHeal:
         got = self._call(cache, lambda bq, bk: calls.append(1) or 1.0)
         assert got == (128, 128) and not calls
         at._FAILED_KEYS.clear()
+
+
+class TestCeChunk:
+    def _call(self, cache, measure, n=8192, v=32000):
+        return at.ce_chunk(n, 4096, v, jnp.bfloat16,
+                           measure=measure, cache=cache)
+
+    def test_candidates_default_first_clamped(self):
+        cands = at.ce_candidates(32000)
+        assert cands[0] == at.CE_DEFAULT_CHUNK
+        assert all(c <= 32000 for c in cands)
+        tiny = at.ce_candidates(1000)
+        assert tiny == [1000]          # every candidate clamps to V
+
+    def test_measures_best_and_caches(self, tmp_path):
+        at._FAILED_KEYS.clear()
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        calls = []
+
+        def measure(c):
+            calls.append(c)
+            return 1.0 / c             # bigger chunk = faster here
+
+        got = self._call(cache, measure)
+        assert got == 16384 and calls
+        n = len(calls)
+        assert self._call(cache, measure) == 16384
+        assert len(calls) == n         # second call: cache hit
+        disk = json.loads((tmp_path / "c.json").read_text())
+        (entry,) = disk.values()
+        assert entry["chunk"] == 16384 and entry["candidates"] >= 4
+
+    def test_all_fail_pins_default_and_records_error(self, tmp_path):
+        at._FAILED_KEYS.clear()
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        assert self._call(cache, lambda c: 1 / 0) == at.CE_DEFAULT_CHUNK
+        (entry,) = cache._mem.values()
+        assert entry["error"] and entry["failures"] == 1
+        at._FAILED_KEYS.clear()
+
+    def test_cached_mode_never_measures(self, tmp_path, monkeypatch):
+        at._FAILED_KEYS.clear()
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "cached")
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        calls = []
+        got = self._call(cache, lambda c: calls.append(c) or 1.0)
+        assert got == at.CE_DEFAULT_CHUNK and not calls
+
+    def test_real_measure_body_runs(self):
+        # the flash sweep died on a shadowed import nobody executed on
+        # CPU; keep the CE measurement body exercised the same way
+        t = at._measure_ce(8, 16, 64, jnp.float32, 32)
+        assert t > 0
+
+    def test_dispatcher_resolves_chunk(self, tmp_path, monkeypatch):
+        # the llama loss path goes through dispatched_fused_ce: a cache
+        # hit must reach the kernel as its vocab_chunk
+        import numpy as np
+        from paddle_tpu import kernels
+
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "cached")
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "c.json"))
+        at._FAILED_KEYS.clear()
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        import jax
+        key = f"ce:{jax.default_backend()}:float32:n8v64d16"
+        cache.put(key, {"chunk": 32, "us": 1.0, "candidates": 2})
+        monkeypatch.setattr(at, "_CACHE", at.AutotuneCache(
+            str(tmp_path / "c.json")))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+        head = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 64, (8,)), jnp.int32)
+        kernels.dispatched_fused_ce(x, head, labels)
+        assert at.used_blocks()[key] == {"chunk": 32, "source": "cache"}
