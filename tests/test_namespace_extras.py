@@ -112,16 +112,22 @@ class TestHubAndUtils:
         with pytest.raises(NotImplementedError):
             CUDAExtension(sources=["x.cu"])
 
-    def test_onnx_gate_saves_stablehlo(self, tmp_path):
+    def test_onnx_export_dynamic_batch_inputspec(self, tmp_path):
+        # None dims used to gate to the StableHLO fallback; they now
+        # export as symbolic onnx dims (converter dynamic_axes support)
         import paddle_tpu.jit as jit
 
         lin = nn.Linear(3, 2)
-        sf = jit.to_static(lin, input_spec=[
-            jit.InputSpec([None, 3], "float32")])
-        with pytest.raises(NotImplementedError, match="StableHLO"):
-            paddle.onnx.export(lin, str(tmp_path / "m.onnx"),
+        lin.eval()
+        p = paddle.onnx.export(lin, str(tmp_path / "m.onnx"),
                                input_spec=[jit.InputSpec([None, 3],
                                                          "float32")])
+        from paddle_tpu.onnx import onnx_pb2 as P
+
+        with open(p, "rb") as f:
+            m = P.ModelProto.FromString(f.read())
+        d0 = m.graph.input[0].type.tensor_type.shape.dim[0]
+        assert d0.dim_param
 
     def test_reader_composition(self):
         r = paddle.reader.firstn(
