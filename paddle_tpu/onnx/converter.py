@@ -394,32 +394,52 @@ def _dot_general(ctx, eqn):
 @_handler("gather")
 def _gather(ctx, eqn):
     # recognize the jnp.take(..., axis=k) pattern: one collapsed slice
-    # dim == the single start_index_map entry, full slices elsewhere
+    # dim == the single start_index_map entry, full slices elsewhere,
+    # index dims landing as a block at position k in the output
     p = eqn.params
     d = p["dimension_numbers"]
     operand = eqn.invars[0].aval
     out_rank = len(eqn.outvars[0].aval.shape)
     slice_sizes = tuple(p["slice_sizes"])
-    trailing = tuple(range(out_rank - len(d.offset_dims), out_rank))
+    idx_aval = eqn.invars[1].aval
+    idx_shape = tuple(idx_aval.shape)
+    has_ivd = bool(idx_shape) and idx_shape[-1] == len(d.start_index_map)
+    idx_rank = len(idx_shape) - (1 if has_ivd else 0)
     if (len(d.start_index_map) == 1
-            and d.collapsed_slice_dims == d.start_index_map
-            and d.offset_dims == trailing):
+            and d.collapsed_slice_dims == d.start_index_map):
         axis = d.start_index_map[0]
+        expected_offsets = tuple(range(axis)) + tuple(
+            range(axis + idx_rank, out_rank))
         full = all(s == operand.shape[i] for i, s in
                    enumerate(slice_sizes) if i != axis)
-        if full and slice_sizes[axis] == 1 and axis == 0:
+        if (full and slice_sizes[axis] == 1
+                and d.offset_dims == expected_offsets):
             idx = _in(ctx, eqn, 1)
-            # jax appends a trailing index-vector dim of size 1
-            idx_aval = eqn.invars[1].aval
-            if idx_aval.shape and idx_aval.shape[-1] == 1:
+            if has_ivd:   # drop jax's trailing index-vector dim
                 mid = ctx.fresh("idxsq")
                 ctx.emit("Reshape",
                          [idx, ctx.add_const(np.asarray(
-                             idx_aval.shape[:-1], np.int64))], [mid])
+                             idx_shape[:-1], np.int64))], [mid])
                 idx = mid
             ctx.emit("Gather", [_in(ctx, eqn, 0), idx],
                      [_out(ctx, eqn)], axis=axis)
             return
+    # multi-coordinate pattern (x[i_arr, j_arr] advanced indexing):
+    # the leading M operand dims are indexed jointly -> ONNX GatherND
+    m = len(d.start_index_map)
+    if (m > 1 and d.start_index_map == tuple(range(m))
+            and d.collapsed_slice_dims == tuple(range(m))
+            and d.offset_dims == tuple(range(out_rank - (len(operand.shape)
+                                                         - m), out_rank))
+            and all(s == 1 for s in slice_sizes[:m])
+            and all(s == operand.shape[i]
+                    for i, s in enumerate(slice_sizes) if i >= m)
+            and has_ivd):
+        cast = ctx.fresh("ndidx64")
+        ctx.emit("Cast", [_in(ctx, eqn, 1)], [cast],
+                 to=P.TensorProto.INT64)
+        ctx.emit("GatherND", [_in(ctx, eqn, 0), cast], [_out(ctx, eqn)])
+        return
     # take_along_axis pattern: batched single-axis element gather ->
     # ONNX GatherElements
     batching = tuple(getattr(d, "operand_batching_dims", ()))

@@ -215,6 +215,9 @@ def _exec_nodes(g, env):
         elif op == "GatherElements":
             r = np.take_along_axis(i[0], i[1].astype(np.int64),
                                    axis=a["axis"])
+        elif op == "GatherND":
+            idx = i[1].astype(np.int64)
+            r = i[0][tuple(np.moveaxis(idx, -1, 0))]
         elif op == "Conv":
             r = _conv(i[0].astype(np.float32), i[1].astype(np.float32),
                       a)

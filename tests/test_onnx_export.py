@@ -395,3 +395,49 @@ class TestLoopBodyNaming:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(got[1], np.asarray(want[1]),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestGeneralGathers:
+    def test_take_along_nonzero_axis(self):
+        import jax.numpy as jnp
+
+        def fn(x, idx):
+            return jnp.take(x, idx, axis=1)
+
+        x = np.random.default_rng(9).normal(size=(3, 7, 4)).astype(
+            "float32")
+        idx = np.asarray([[2, 0], [5, 1]], "int32")
+        m = to_onnx_model(fn, [x, idx])
+        assert any(n.op_type == "Gather" for n in m.graph.node)
+        m = P.ModelProto.FromString(m.SerializeToString())
+        got = run(m, [x, idx])[0]
+        np.testing.assert_allclose(got, np.take(x, idx, axis=1))
+
+    def test_take_last_axis(self):
+        import jax.numpy as jnp
+
+        def fn(x, idx):
+            return jnp.take(x, idx, axis=2)
+
+        x = np.random.default_rng(10).normal(size=(2, 3, 9)).astype(
+            "float32")
+        idx = np.asarray([4, 8, 0], "int32")
+        m = P.ModelProto.FromString(
+            to_onnx_model(fn, [x, idx]).SerializeToString())
+        got = run(m, [x, idx])[0]
+        np.testing.assert_allclose(got, np.take(x, idx, axis=2))
+
+    def test_multi_coordinate_advanced_indexing(self):
+        import jax.numpy as jnp
+
+        def fn(x, ij):
+            return x[ij[:, 0], ij[:, 1]]
+
+        x = np.random.default_rng(11).normal(size=(5, 6, 3)).astype(
+            "float32")
+        ij = np.asarray([[0, 2], [4, 5], [3, 0]], "int32")
+        m = to_onnx_model(fn, [x, ij])
+        assert any(n.op_type == "GatherND" for n in m.graph.node)
+        m = P.ModelProto.FromString(m.SerializeToString())
+        got = run(m, [x, ij])[0]
+        np.testing.assert_allclose(got, x[ij[:, 0], ij[:, 1]])
