@@ -301,6 +301,10 @@ def _main():
     smoke = "--smoke" in sys.argv
     _arm_watchdog()
     _enable_compile_cache()
+    # Before ANY paddle_tpu import: the autotune cache path env var must
+    # be in place when modules first load (the cache also resolves its
+    # path lazily now, but ordering here keeps the policy obvious).
+    _autotune_setup()
 
     _stage("relay-probe", 30)
     # Probe even under --smoke: when the axon sitecustomize has registered
@@ -354,7 +358,6 @@ def _main():
     else:
         ladder = [(None, 4, 128, 5, "float32")]
 
-    _autotune_setup()
     _stage("kernel-preflight", 150)
     preflight = _preflight_kernels(on_tpu)
 

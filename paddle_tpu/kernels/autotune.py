@@ -61,9 +61,21 @@ class AutotuneCache:
     """shape-key -> chosen config, in-memory with JSON persistence."""
 
     def __init__(self, path: Optional[str] = None):
-        self._path = path if path is not None else _cache_path()
+        self._explicit_path = path
         self._mem: dict = {}
         self._loaded = False
+
+    @property
+    def _path(self) -> str:
+        # Resolved lazily, NOT in __init__: the module-level _CACHE is
+        # constructed at import time, which may precede the harness
+        # setting PADDLE_TPU_AUTOTUNE_CACHE (bench.py imports paddle_tpu
+        # before it applies its autotune policy). Freezing the path at
+        # construction silently redirected the bench to the empty
+        # home-dir cache and cost the tuned blocks.
+        if self._explicit_path is not None:
+            return self._explicit_path
+        return _cache_path()
 
     def _load(self):
         if self._loaded:

@@ -107,6 +107,23 @@ class TestFlashBlocks:
         key = ("flash:cpu:bfloat16:b2h4kv2:q2048k2048d128:c1")
         cache.put(key, {"blocks": list(blocks), "us": 1.0, "candidates": 2})
 
+    def test_env_path_resolves_after_construction(self, tmp_path,
+                                                  monkeypatch):
+        # The module-level cache is built at import time, BEFORE the
+        # harness (bench.py) exports PADDLE_TPU_AUTOTUNE_CACHE. The path
+        # must resolve lazily or the tuned repo cache is silently
+        # ignored (the round-5 on-chip bench ran default blocks this
+        # way).
+        cache = at.AutotuneCache()          # constructed with no env var
+        path = tmp_path / "repo_cache.json"
+        path.write_text(json.dumps({
+            "flash:cpu:bfloat16:b2h4kv2:q2048k2048d128:c1":
+                {"blocks": [512, 256], "us": 1.0, "candidates": 6}}))
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(path))
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "cached")
+        got = self._call(cache, None)
+        assert got == (512, 256)
+
     def test_concurrent_put_merges_disk(self, tmp_path):
         path = str(tmp_path / "c.json")
         a = at.AutotuneCache(path)
