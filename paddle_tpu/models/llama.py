@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import nn
@@ -65,7 +66,12 @@ class LlamaConfig:
     remat: bool = True              # per-layer rematerialisation
     # remat policy: "full" recomputes everything (min HBM); "dots" saves
     # non-batch matmul outputs (reference recompute's selective checkpointing
-    # — fewer recomputed FLOPs, higher MFU, modest extra HBM).
+    # — fewer recomputed FLOPs, higher MFU, modest extra HBM); "attn"
+    # saves only the named attention outputs (2*B*S*D bytes/layer) so the
+    # backward never re-runs the flash kernel but everything else still
+    # rematerialises — the sweet spot when HBM is tight or the XLA
+    # program size under "dots" is a problem (the axon tunnel's remote
+    # compile helper rejects the "dots" program at bench shapes).
     remat_policy: str = "dots"
     # Blockwise lm-head cross entropy (kernels/fused_ce.py): the [B,S,V]
     # logits never hit HBM. Engaged on the single-device path; the GSPMD
@@ -182,6 +188,10 @@ def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     a = sdpa_raw(q, k, v, is_causal=True)
+    # Named so remat_policy="attn" can pin exactly this value: the one
+    # tensor whose recompute (a full flash-attention forward) dominates
+    # the backward pass under full remat, at 2*B*S*D bytes per layer.
+    a = checkpoint_name(a, "attn_out")
     a = a.reshape(B, S, nh * hd)
     x = x + constrain(a @ lp["wo"], _act_spec(sp))
 
@@ -203,11 +213,16 @@ def forward_hidden(params, ids, config: LlamaConfig, *, sp: bool = False,
         return _block(carry, lp, cos, sin, c, sp, mesh), None
 
     if c.remat:
-        if c.remat_policy not in ("dots", "full"):
+        if c.remat_policy not in ("dots", "full", "attn"):
             raise E.InvalidArgumentError(
-                f"remat_policy must be 'dots' or 'full', got {c.remat_policy!r}")
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if c.remat_policy == "dots" else None)
+                f"remat_policy must be 'dots', 'full' or 'attn', "
+                f"got {c.remat_policy!r}")
+        policy = {
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "attn": jax.checkpoint_policies.save_only_these_names(
+                "attn_out"),
+            "full": None,
+        }[c.remat_policy]
         step = jax.checkpoint(step, prevent_cse=False, policy=policy)
     x, _ = lax.scan(step, x, params["layers"])
     return _rms(x, params["ln_f"], c.rms_norm_eps)

@@ -124,6 +124,35 @@ class TestFlashBlocks:
         got = self._call(cache, None)
         assert got == (512, 256)
 
+    def test_in_trace_dispatch_never_measures(self, tmp_path, monkeypatch):
+        # A dispatch reached while an outer jit trace is active must not
+        # attempt measurement (jitted candidates would stage into the
+        # trace and the float() sync raises ConcretizationTypeError,
+        # which then poisons the persisted cache as a failed sweep).
+        import jax
+
+        monkeypatch.setattr(at, "_tuning_backend", lambda: True)
+        cache = at.AutotuneCache(str(tmp_path / "c.json"))
+        seen = {}
+
+        def probe(x):
+            seen["blocks"] = at.flash_blocks(
+                (2, 2048, 4, 128), (2, 2048, 2, 128), jnp.bfloat16, True,
+                cache=cache)
+            seen["chunk"] = at.ce_chunk(512, 64, 1000, jnp.bfloat16,
+                                        cache=cache)
+            return x
+
+        jax.jit(probe)(jnp.zeros(()))
+        assert seen["blocks"] == (128, 128)
+        assert seen["chunk"] == 1000   # default clamped to vocab
+        used = at.used_blocks()
+        assert any(v.get("source") == "default-in-trace"
+                   for v in used.values())
+        # and nothing was persisted as a failure
+        import os
+        assert not os.path.exists(str(tmp_path / "c.json"))
+
     def test_concurrent_put_merges_disk(self, tmp_path):
         path = str(tmp_path / "c.json")
         a = at.AutotuneCache(path)
