@@ -145,6 +145,22 @@ def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
     return params
 
 
+def remat_policy(name: str):
+    """Resolve a config remat-policy name to a jax.checkpoint policy
+    (one definition shared by every model family — llama, moe, ...):
+    "full" recomputes everything, "dots" saves non-batch matmul outputs,
+    "attn" saves only values tagged checkpoint_name("attn_out")."""
+    policies = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+        "full": None,
+    }
+    if name not in policies:
+        raise E.InvalidArgumentError(
+            f"remat_policy must be one of {sorted(policies)}, got {name!r}")
+    return policies[name]
+
+
 def rope_tables(config: LlamaConfig, seq_len: int, dtype=jnp.float32):
     """cos/sin tables [S, head_dim//2] (shared helper, config theta)."""
     return _rope_tables(seq_len, config.head_dim, theta=config.rope_theta,
@@ -213,17 +229,8 @@ def forward_hidden(params, ids, config: LlamaConfig, *, sp: bool = False,
         return _block(carry, lp, cos, sin, c, sp, mesh), None
 
     if c.remat:
-        if c.remat_policy not in ("dots", "full", "attn"):
-            raise E.InvalidArgumentError(
-                f"remat_policy must be 'dots', 'full' or 'attn', "
-                f"got {c.remat_policy!r}")
-        policy = {
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "attn": jax.checkpoint_policies.save_only_these_names(
-                "attn_out"),
-            "full": None,
-        }[c.remat_policy]
-        step = jax.checkpoint(step, prevent_cse=False, policy=policy)
+        step = jax.checkpoint(step, prevent_cse=False,
+                              policy=remat_policy(c.remat_policy))
     x, _ = lax.scan(step, x, params["layers"])
     return _rms(x, params["ln_f"], c.rms_norm_eps)
 

@@ -3,14 +3,28 @@ ERNIE-4.5-style, the BASELINE.json EP configs).
 
 Reference capability: the PaddleNLP llm/ MoE recipes trained through the
 reference's expert-parallel stack (incubate/distributed/models/moe/
-moe_layer.py dispatch/combine + gate, fleet expert-parallel groups).
-TPU-native design: GShard DENSE dispatch/combine — routing becomes two
-einsums against a one-hot combine tensor, so shapes stay static under jit
-and the expert axis shards over the mesh's 'ep' dimension (expert weights
-are [E, ...] arrays with E on 'ep'; XLA turns the dispatch einsum into an
-all-to-all over ICI). Fine-grained experts + a shared expert follow the
-DeepSeekMoE shape; top-k routing carries the switch-style load-balancing
-auxiliary loss.
+moe_layer.py dispatch/combine + gate, fleet expert-parallel groups; the
+gate's capacity_factor token dropping lives in
+incubate/distributed/models/moe/gate/base_gate.py descendants).
+TPU-native design, two dispatch modes:
+
+- "capacity" (single-chip default): GShard capacity-based gather
+  dispatch. Token slots scatter into a static [E, C] index grid
+  (C = ceil(T*k/E * capacity_factor), lane-aligned), experts run
+  batched [E, C, D] matmuls, outputs gather back per (token, k) slot.
+  Compute scales with ACTIVE tokens (E*C ~ T*k*factor), not E*T — at
+  DeepSeekMoE shapes (E=64, k=6) the dense form burns ~10x the active
+  FLOPs. Over-capacity slots drop (token keeps its shared-expert path),
+  the reference's capacity_factor semantics.
+- "dense" (mesh/EP default): routing becomes two einsums against a
+  one-hot combine tensor, so shapes stay static under jit and the expert
+  axis shards over the mesh's 'ep' dimension (expert weights are
+  [E, ...] arrays with E on 'ep'; XLA turns the dispatch einsum into an
+  all-to-all over ICI). Exact (no drops); right when E is small or the
+  expert axis is sharded and the einsum IS the a2a.
+
+Fine-grained experts + a shared expert follow the DeepSeekMoE shape;
+top-k routing carries the switch-style load-balancing auxiliary loss.
 """
 from __future__ import annotations
 
@@ -22,13 +36,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .llama import _rms, apply_rope
+from .llama import _rms, apply_rope, remat_policy
+from ..core import enforce as E
 from ..nn.functional.attention import rope_tables as _rope_tables, sdpa_raw
 
 __all__ = [
     "MoEConfig", "moe_tiny", "deepseek_moe_16b", "qwen2_moe_a14b",
-    "init_params", "forward", "loss_fn", "param_specs", "make_train_step",
-    "count_params", "adamw_init",
+    "ernie_4_5_a3b", "init_params", "forward", "forward_hidden", "loss_fn",
+    "param_specs", "make_train_step", "count_params", "adamw_init",
+    "moe_capacity",
 ]
 
 
@@ -49,6 +65,18 @@ class MoEConfig:
     router_aux_loss_coef: float = 0.001
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full" recomputes everything; "dots" saves matmul outputs (viable
+    # with capacity dispatch, where the saved expert activations are
+    # C-sized, not T-sized).
+    remat_policy: str = "full"
+    # None = auto: "capacity" on a single device, "dense" under a mesh
+    # (the dense dispatch einsum is what GSPMD lowers to the EP a2a).
+    dispatch_mode: Optional[str] = None
+    capacity_factor: float = 1.25
+    # Blockwise fused CE for the single-device loss (the 102k-vocab
+    # logits of the DeepSeekMoE family are ~840M materialized); mesh
+    # losses keep the einsum head for vocab-parallel GSPMD sharding.
+    fused_ce: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -61,7 +89,7 @@ def moe_tiny(**kw) -> MoEConfig:
                 num_attention_heads=4, num_key_value_heads=4,
                 num_experts=4, num_experts_per_tok=2,
                 max_position_embeddings=128, dtype=jnp.float32,
-                remat=False)
+                remat=False, dispatch_mode="dense")
     base.update(kw)
     return MoEConfig(**base)
 
@@ -150,26 +178,82 @@ def init_params(config: MoEConfig, key) -> Dict[str, Any]:
 # MoE block
 # ---------------------------------------------------------------------------
 
-def _moe_mlp(h, lp, config: MoEConfig, mesh):
-    """GShard dense dispatch: combine[t, e] carries top-k router weights;
-    expert compute is an einsum over the (sharded) expert axis. Returns
-    (out, aux_loss)."""
+def moe_capacity(config: MoEConfig, n_tokens: int) -> int:
+    """Per-expert slot count: ceil(T*k/E * factor), lane-aligned (128)."""
     c = config
-    B, S, D = h.shape
-    T = B * S
-    x = h.reshape(T, D)
+    even = n_tokens * c.num_experts_per_tok / c.num_experts
+    cap = int(even * c.capacity_factor + 0.9999)
+    return max(8, min(n_tokens, (cap + 127) // 128 * 128 if cap >= 128
+                      else cap))
 
+
+def _route(x, lp, config: MoEConfig):
+    """Shared router head: (topv [T,k] normalized f32, topi [T,k], aux)."""
+    c = config
     logits = (x.astype(jnp.float32) @ lp["router"])         # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = lax.top_k(probs, c.num_experts_per_tok)    # [T, k]
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)     # renormalize
+    # switch-style load-balance aux loss (reference: moe gate aux):
+    # fraction of ROUTED token-slots per expert x mean router prob
+    sel = jnp.sum(jax.nn.one_hot(topi, c.num_experts, dtype=jnp.float32),
+                  axis=1)                                   # [T, E] 0/1
+    me = jnp.mean(probs, axis=0)                            # [E]
+    ce = jnp.mean(sel, axis=0)
+    aux = c.num_experts * jnp.sum(me * ce)
+    return topv, topi, aux
+
+
+def _expert_ffn(xe, lp):
+    """Batched per-expert SwiGLU on [E, C|T, D] slot grids."""
+    g = jnp.einsum("ecd,edf->ecf", xe, lp["e_gate"])
+    u = jnp.einsum("ecd,edf->ecf", xe, lp["e_up"])
+    return jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, lp["e_down"])
+
+
+def _moe_mlp_capacity(x, lp, config: MoEConfig, T):
+    """Capacity gather dispatch (single-chip default): compute scales
+    with E*C ~ T*k*capacity_factor instead of E*T."""
+    c = config
+    E, k = c.num_experts, c.num_experts_per_tok
+    C = moe_capacity(c, T)
+    topv, topi, aux = _route(x, lp, c)
+
+    # Slot bookkeeping in token-major priority order (GShard): pos[t,k] =
+    # how many earlier slots chose the same expert == position in that
+    # expert's buffer. Over-capacity slots drop.
+    oh = jax.nn.one_hot(topi.reshape(-1), E, dtype=jnp.int32)  # [T*k, E]
+    pos = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=-1)  # [T*k]
+    expert = topi.reshape(-1)                                   # [T*k]
+    keep = pos < C
+    dest = expert * C + pos                                     # [T*k]
+
+    # Scatter each kept slot's TOKEN INDEX into the [E*C] grid; empty
+    # slots point at the appended zero row of xp (index T).
+    idx = jnp.full((E * C,), T, jnp.int32)
+    idx = idx.at[jnp.where(keep, dest, E * C)].set(
+        jnp.repeat(jnp.arange(T, dtype=jnp.int32), k), mode="drop")
+    xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)])
+    xe = jnp.take(xp, idx, axis=0).reshape(E, C, -1)            # [E, C, D]
+
+    y = _expert_ffn(xe, lp)                                     # [E, C, D]
+
+    # Combine: each (t, k) slot gathers its expert output row, scaled by
+    # its (still-normalized) router weight; dropped slots contribute 0.
+    yk = jnp.take(y.reshape(E * C, -1), jnp.where(keep, dest, 0), axis=0)
+    w = (topv.reshape(-1) * keep).astype(jnp.float32)[:, None]
+    routed = jnp.sum((yk.astype(jnp.float32) * w).reshape(T, k, -1),
+                     axis=1)
+    return routed.astype(x.dtype), aux
+
+
+def _moe_mlp_dense(x, lp, config: MoEConfig, T, mesh):
+    """GShard dense dispatch: combine[t, e] carries top-k router weights;
+    expert compute is an einsum over the (sharded) expert axis."""
+    c = config
+    topv, topi, aux = _route(x, lp, c)
     combine = jnp.zeros((T, c.num_experts), jnp.float32).at[
         jnp.arange(T)[:, None], topi].set(topv)             # [T, E]
-
-    # switch-style load-balance aux loss (reference: moe gate aux)
-    me = jnp.mean(probs, axis=0)                            # [E]
-    ce = jnp.mean((combine > 0).astype(jnp.float32), axis=0)
-    aux = c.num_experts * jnp.sum(me * ce)
 
     constrain = (lambda a, spec: lax.with_sharding_constraint(
         a, NamedSharding(mesh, spec))) if mesh is not None \
@@ -182,12 +266,27 @@ def _moe_mlp(h, lp, config: MoEConfig, mesh):
     dispatch = (combine > 0).astype(c.dtype)                # [T, E]
     xe = jnp.einsum("td,te->etd", x.astype(c.dtype), dispatch)
     xe = constrain(xe, P("ep", None, None))
-    g = jnp.einsum("etd,edf->etf", xe, lp["e_gate"])
-    u = jnp.einsum("etd,edf->etf", xe, lp["e_up"])
-    y = jnp.einsum("etf,efd->etd", jax.nn.silu(g) * u, lp["e_down"])
-    y = constrain(y, P("ep", None, None))
+    y = constrain(_expert_ffn(xe, lp), P("ep", None, None))
     routed = jnp.einsum("etd,te->td", y.astype(jnp.float32),
-                        combine).astype(c.dtype)            # weighted combine
+                        combine)                            # weighted combine
+    return routed.astype(x.dtype), aux
+
+
+def _moe_mlp(h, lp, config: MoEConfig, mesh):
+    """Top-k routed experts + shared expert. Returns (out, aux_loss)."""
+    c = config
+    B, S, D = h.shape
+    T = B * S
+    x = h.reshape(T, D)
+
+    mode = c.dispatch_mode or ("dense" if mesh is not None else "capacity")
+    if mode not in ("dense", "capacity"):
+        raise E.InvalidArgumentError(
+            f"dispatch_mode must be 'dense' or 'capacity', got {mode!r}")
+    if mode == "capacity":
+        routed, aux = _moe_mlp_capacity(x, lp, c, T)
+    else:
+        routed, aux = _moe_mlp_dense(x, lp, c, T, mesh)
 
     sg = x @ lp["s_gate"]
     su = x @ lp["s_up"]
@@ -215,9 +314,9 @@ def _block(x, lp, cos, sin, config: MoEConfig, mesh):
     return x + moe_out, aux
 
 
-def forward(params, ids, config: MoEConfig, *,
-            mesh: Optional[Mesh] = None):
-    """Returns (logits [B,S,V], aux_loss scalar)."""
+def forward_hidden(params, ids, config: MoEConfig, *,
+                   mesh: Optional[Mesh] = None):
+    """(final hidden [B,S,D] post ln_f, summed aux loss)."""
     c = config
     x = jnp.take(params["embed"], ids, axis=0)
     cos, sin = _rope_tables(ids.shape[1], c.head_dim, theta=c.rope_theta)
@@ -227,12 +326,19 @@ def forward(params, ids, config: MoEConfig, *,
         return y, aux
 
     if c.remat:
-        step = jax.checkpoint(step, prevent_cse=False)
+        step = jax.checkpoint(step, prevent_cse=False,
+                              policy=remat_policy(c.remat_policy))
     x, auxes = lax.scan(step, x, params["layers"])
-    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    return _rms(x, params["ln_f"], c.rms_norm_eps), jnp.sum(auxes)
+
+
+def forward(params, ids, config: MoEConfig, *,
+            mesh: Optional[Mesh] = None):
+    """Returns (logits [B,S,V], aux_loss scalar)."""
+    x, aux = forward_hidden(params, ids, config, mesh=mesh)
     logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
-    return logits, jnp.sum(auxes)
+    return logits, aux
 
 
 def loss_fn(params, batch, config: MoEConfig, *,
@@ -241,10 +347,20 @@ def loss_fn(params, batch, config: MoEConfig, *,
         inp, labels = batch
     else:
         inp, labels = batch[:, :-1], batch[:, 1:]
-    logits, aux = forward(params, inp, config, mesh=mesh)
+    c = config
+    if c.fused_ce and mesh is None:
+        # Blockwise fused CE: the [B,S,V] logits (~840M f32 at the
+        # DeepSeekMoE 102k vocab) never materialize in HBM. Same
+        # dispatcher as the llama family (autotuned vocab chunk).
+        from ..kernels import dispatched_fused_ce
+
+        x, aux = forward_hidden(params, inp, c, mesh=mesh)
+        ce = dispatched_fused_ce(x, params["lm_head"], labels)
+        return ce + c.router_aux_loss_coef * aux
+    logits, aux = forward(params, inp, c, mesh=mesh)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold) + config.router_aux_loss_coef * aux
+    return jnp.mean(logz - gold) + c.router_aux_loss_coef * aux
 
 
 # ---------------------------------------------------------------------------
