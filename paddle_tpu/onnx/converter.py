@@ -126,7 +126,8 @@ _SIMPLE = {
     "round": "Round", "erf": "Erf", "pow": "Pow",
     "not": "Not", "and": "And", "or": "Or", "xor": "Xor",
     "rem": "Mod", "stop_gradient": "Identity",
-    "copy": "Identity", "sin": "Sin", "cos": "Cos",
+    "copy": "Identity", "name": "Identity",   # checkpoint_name tags
+    "sin": "Sin", "cos": "Cos",
 }
 
 _HANDLERS = {}
@@ -391,6 +392,72 @@ def _dot_general(ctx, eqn):
     ctx.emit("Einsum", [a, b], [_out(ctx, eqn)], equation=eqn_str)
 
 
+def _gather_fill_value(p, dtype):
+    """The fill jax uses for FILL_OR_DROP out-of-bounds gathers."""
+    fv = p.get("fill_value")
+    if fv is not None:
+        return np.asarray(fv, dtype)
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return np.asarray(np.nan, dt)
+    if dt.kind == "b":
+        return np.asarray(False, dt)
+    info = np.iinfo(dt)
+    return np.asarray(info.min if dt.kind == "i" else info.max, dt)
+
+
+def _guard_oob(ctx, idx, mode, bounds):
+    """Emulate the jax gather OOB modes on an ONNX index tensor.
+
+    jax semantics at the gather eqn (lax.GatherScatterMode): CLIP clamps
+    into bounds; FILL_OR_DROP yields fill_value for any out-of-bounds
+    coordinate. ONNX Gather* instead wraps negatives python-style and
+    rejects true OOB at runtime — exporting the raw index silently
+    changes behavior exactly where jax guarantees it (advisor finding).
+
+    Returns (safe_idx int64, oob_mask|None). ``bounds``: per-last-dim
+    coordinate bounds (list) for GatherND-style indices, else a scalar.
+    """
+    mode_s = str(mode) if mode is not None else ""
+    cast = ctx.fresh("idx64")
+    ctx.emit("Cast", [idx], [cast], to=P.TensorProto.INT64)
+    if "CLIP" not in mode_s and "FILL_OR_DROP" not in mode_s:
+        return cast, None   # PROMISE_IN_BOUNDS: jax makes no guarantee
+    bnd = np.asarray(bounds, np.int64)
+    zero = ctx.add_const(np.zeros_like(bnd) if bnd.ndim else
+                         np.asarray(0, np.int64))
+    hi = ctx.add_const(bnd - 1)
+    clipped = ctx.fresh("idxclip")
+    if bnd.ndim:   # per-coordinate bounds: Clip is scalar-only
+        lo_n = ctx.fresh("idxlo")
+        ctx.emit("Max", [cast, zero], [lo_n])
+        ctx.emit("Min", [lo_n, hi], [clipped])
+    else:
+        ctx.emit("Clip", [cast, zero, hi], [clipped])
+    if "CLIP" in mode_s:
+        return clipped, None
+    neg = ctx.fresh("oobneg")
+    ctx.emit("Less", [cast, zero], [neg])
+    over = ctx.fresh("oobover")
+    ctx.emit("Greater", [cast, hi], [over])
+    mask = ctx.fresh("oob")
+    ctx.emit("Or", [neg, over], [mask])
+    return clipped, mask
+
+
+def _emit_fill(ctx, eqn, gathered, mask, mask_shape):
+    """Where(oob, fill, gathered) with the mask reshaped to broadcast
+    against the gather output."""
+    out_dt = eqn.outvars[0].aval.dtype
+    mid = ctx.fresh("oobshaped")
+    ctx.emit("Reshape",
+             [mask, ctx.add_const(np.asarray(mask_shape, np.int64))],
+             [mid])
+    ctx.emit("Where",
+             [mid, ctx.add_const(_gather_fill_value(eqn.params, out_dt)),
+              gathered], [_out(ctx, eqn)])
+
+
 @_handler("gather")
 def _gather(ctx, eqn):
     # recognize the jnp.take(..., axis=k) pattern: one collapsed slice
@@ -415,14 +482,25 @@ def _gather(ctx, eqn):
         if (full and slice_sizes[axis] == 1
                 and d.offset_dims == expected_offsets):
             idx = _in(ctx, eqn, 1)
+            sq_shape = idx_shape[:-1] if has_ivd else idx_shape
             if has_ivd:   # drop jax's trailing index-vector dim
                 mid = ctx.fresh("idxsq")
                 ctx.emit("Reshape",
                          [idx, ctx.add_const(np.asarray(
-                             idx_shape[:-1], np.int64))], [mid])
+                             sq_shape, np.int64))], [mid])
                 idx = mid
-            ctx.emit("Gather", [_in(ctx, eqn, 0), idx],
-                     [_out(ctx, eqn)], axis=axis)
+            safe, mask = _guard_oob(ctx, idx, p.get("mode"),
+                                    operand.shape[axis])
+            if mask is None:
+                ctx.emit("Gather", [_in(ctx, eqn, 0), safe],
+                         [_out(ctx, eqn)], axis=axis)
+            else:
+                g = ctx.fresh("gathered")
+                ctx.emit("Gather", [_in(ctx, eqn, 0), safe], [g],
+                         axis=axis)
+                _emit_fill(ctx, eqn, g, mask,
+                           (1,) * axis + tuple(sq_shape)
+                           + (1,) * (len(operand.shape) - axis - 1))
             return
     # multi-coordinate pattern (x[i_arr, j_arr] advanced indexing):
     # the leading M operand dims are indexed jointly -> ONNX GatherND
@@ -435,10 +513,25 @@ def _gather(ctx, eqn):
             and all(s == operand.shape[i]
                     for i, s in enumerate(slice_sizes) if i >= m)
             and has_ivd):
-        cast = ctx.fresh("ndidx64")
-        ctx.emit("Cast", [_in(ctx, eqn, 1)], [cast],
-                 to=P.TensorProto.INT64)
-        ctx.emit("GatherND", [_in(ctx, eqn, 0), cast], [_out(ctx, eqn)])
+        safe, mask = _guard_oob(ctx, _in(ctx, eqn, 1), p.get("mode"),
+                                [operand.shape[i] for i in range(m)])
+        if mask is None:
+            ctx.emit("GatherND", [_in(ctx, eqn, 0), safe],
+                     [_out(ctx, eqn)])
+        else:
+            # any coordinate OOB poisons the whole slice: Or-reduce the
+            # elementwise mask over the index-vector dim
+            mi = ctx.fresh("oobint")
+            ctx.emit("Cast", [mask], [mi], to=P.TensorProto.INT32)
+            mr = ctx.fresh("oobany")
+            ctx.emit("ReduceMax", [mi], [mr], axes=[-1], keepdims=0)
+            mb = ctx.fresh("oobanyb")
+            ctx.emit("Cast", [mr], [mb], to=P.TensorProto.BOOL)
+            g = ctx.fresh("gathered")
+            ctx.emit("GatherND", [_in(ctx, eqn, 0), safe], [g])
+            _emit_fill(ctx, eqn, g, mb,
+                       tuple(idx_shape[:-1])
+                       + (1,) * (len(operand.shape) - m))
         return
     # take_along_axis pattern: batched single-axis element gather ->
     # ONNX GatherElements
@@ -455,10 +548,16 @@ def _gather(ctx, eqn):
         ctx.emit("Reshape",
                  [idx, ctx.add_const(np.asarray(out_shape, np.int64))],
                  [mid])
-        cast = ctx.fresh("idx64")
-        ctx.emit("Cast", [mid], [cast], to=P.TensorProto.INT64)
-        ctx.emit("GatherElements", [_in(ctx, eqn, 0), cast],
-                 [_out(ctx, eqn)], axis=axis)
+        safe, mask = _guard_oob(ctx, mid, p.get("mode"),
+                                operand.shape[axis])
+        if mask is None:
+            ctx.emit("GatherElements", [_in(ctx, eqn, 0), safe],
+                     [_out(ctx, eqn)], axis=axis)
+        else:
+            g = ctx.fresh("gathered")
+            ctx.emit("GatherElements", [_in(ctx, eqn, 0), safe], [g],
+                     axis=axis)
+            _emit_fill(ctx, eqn, g, mask, out_shape)
         return
     raise E.UnimplementedError(
         f"ONNX export: general gather {d} unsupported (only "
