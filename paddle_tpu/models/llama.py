@@ -47,6 +47,7 @@ __all__ = [
     "init_params", "forward", "loss_fn", "param_specs",
     "make_train_step", "make_forward", "adamw_init", "count_params",
     "LlamaForCausalLM",
+    "init_cache", "prefill", "decode_step", "generate",
 ]
 
 
@@ -184,23 +185,46 @@ def _act_spec(sp: bool):
     return P(("dp", "fsdp"), "tp" if sp else None, None)
 
 
+def _noc(a, spec):
+    """No-op sharding constraint (single-device paths)."""
+    return a
+
+
+def _qkv_proj(h, lp, config: LlamaConfig, constrain=_noc):
+    """Attention input projections [B,S,D] -> q/k/v head grids (no rope;
+    callers position-encode: training uses the full table, decode the
+    gathered row at the cache position). Heads shard over tp inside the
+    attention region."""
+    c = config
+    B, S, _ = h.shape
+    nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    q = constrain((h @ lp["wq"]).reshape(B, S, nh, hd),
+                  P(("dp", "fsdp"), None, "tp", None))
+    k = constrain((h @ lp["wk"]).reshape(B, S, nkv, hd),
+                  P(("dp", "fsdp"), None, "tp", None))
+    v = constrain((h @ lp["wv"]).reshape(B, S, nkv, hd),
+                  P(("dp", "fsdp"), None, "tp", None))
+    return q, k, v
+
+
+def _ffn(x, lp, config: LlamaConfig, sp: bool = False, constrain=_noc):
+    """Post-attention half of a decoder layer (ln2 + SwiGLU + residual)."""
+    c = config
+    h = _rms(x, lp["ln2"], c.rms_norm_eps)
+    g = constrain(h @ lp["gate"], P(("dp", "fsdp"), None, "tp"))
+    u = constrain(h @ lp["up"], P(("dp", "fsdp"), None, "tp"))
+    return x + constrain((jax.nn.silu(g) * u) @ lp["down"], _act_spec(sp))
+
+
 def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     """One decoder layer. x: [B, S, D]; lp: this layer's param slice."""
     c = config
     B, S, D = x.shape
-    nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     constrain = (lambda a, spec: lax.with_sharding_constraint(
-        a, NamedSharding(mesh, spec))) if mesh is not None \
-        else (lambda a, spec: a)
+        a, NamedSharding(mesh, spec))) if mesh is not None else _noc
 
     h = _rms(x, lp["ln1"], c.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
-    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
-    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
-    # heads sharded over tp inside the attention region
-    q = constrain(q, P(("dp", "fsdp"), None, "tp", None))
-    k = constrain(k, P(("dp", "fsdp"), None, "tp", None))
-    v = constrain(v, P(("dp", "fsdp"), None, "tp", None))
+    q, k, v = _qkv_proj(h, lp, c, constrain)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     a = sdpa_raw(q, k, v, is_causal=True)
@@ -208,14 +232,9 @@ def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     # tensor whose recompute (a full flash-attention forward) dominates
     # the backward pass under full remat, at 2*B*S*D bytes per layer.
     a = checkpoint_name(a, "attn_out")
-    a = a.reshape(B, S, nh * hd)
+    a = a.reshape(B, S, -1)
     x = x + constrain(a @ lp["wo"], _act_spec(sp))
-
-    h = _rms(x, lp["ln2"], c.rms_norm_eps)
-    g = constrain(h @ lp["gate"], P(("dp", "fsdp"), None, "tp"))
-    u = constrain(h @ lp["up"], P(("dp", "fsdp"), None, "tp"))
-    x = x + constrain((jax.nn.silu(g) * u) @ lp["down"], _act_spec(sp))
-    return x
+    return _ffn(x, lp, c, sp, constrain)
 
 
 def forward_hidden(params, ids, config: LlamaConfig, *, sp: bool = False,
@@ -247,6 +266,144 @@ def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
     # logits in float32 for a stable softmax-xent
     return jnp.einsum("bsd,vd->bsv", x, _head(params, config),
                       preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding (serving path)
+#
+# Reference capability: incremental decoding via per-layer K/V caches —
+# python/paddle/nn/layer/transformer.py MultiHeadAttention.gen_cache /
+# Cache (concat-grown) and the PaddleNLP llm generation loops built on
+# it. TPU-native design: a STATIC [L, B, max_len, kv, hd] ring buffer
+# written with lax.dynamic_update_slice and masked attention — shapes
+# never change across steps, so the whole generate loop jits as one
+# program (concat-grown caches would retrace/recompile every token).
+# ---------------------------------------------------------------------------
+
+def init_cache(config: LlamaConfig, batch: int, max_len: int, dtype=None):
+    """Fresh decode cache for ``batch`` sequences of up to ``max_len``."""
+    c = config
+    dt = dtype if dtype is not None else c.dtype
+    shape = (c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
+             c.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _attn_over_cache(q, kc, vc, pos):
+    """Single-position attention against the cache. q: [B, 1, nh, hd];
+    kc/vc: [B, M, nkv, hd]; positions > pos are masked out."""
+    B, M, nkv, hd = kc.shape
+    nh = q.shape[2]
+    g = nh // nkv
+    qf = q.astype(jnp.float32).reshape(B, nkv, g, hd)
+    scores = jnp.einsum("bkgd,bmkd->bkgm", qf,
+                        kc.astype(jnp.float32)) / math.sqrt(hd)
+    mask = (jnp.arange(M) <= pos)[None, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgm,bmkd->bkgd", p, vc.astype(jnp.float32))
+    return out.reshape(B, 1, nh * hd)
+
+
+def prefill(params, ids, config: LlamaConfig, cache):
+    """Consume the prompt [B, S]: fills cache[:, :, :S] and returns
+    (cache', last-position logits [B, V])."""
+    c = config
+    B, S = ids.shape
+    E.enforce(S <= cache["k"].shape[2],
+              f"prompt length {S} exceeds cache max_len "
+              f"{cache['k'].shape[2]}")
+    x = jnp.take(params["embed"], ids, axis=0)
+    cos, sin = rope_tables(c, S)
+
+    def step(carry, lp):
+        x = carry
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, -1)
+        x = x + a @ lp["wo"]
+        return _ffn(x, lp, c), (k, v)   # cache post-rope k, raw v
+
+    x, (ks, vs) = lax.scan(step, x, params["layers"])
+    kc = lax.dynamic_update_slice(
+        cache["k"], ks.astype(cache["k"].dtype), (0,) * 5)
+    vc = lax.dynamic_update_slice(
+        cache["v"], vs.astype(cache["v"].dtype), (0,) * 5)
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, -1, :], _head(params, c),
+                        preferred_element_type=jnp.float32)
+    return {"k": kc, "v": vc, "pos": jnp.asarray(S, jnp.int32)}, logits
+
+
+def decode_step(params, cache, token, config: LlamaConfig):
+    """One incremental step: ``token`` [B] sits at position cache['pos'].
+    Returns (cache', logits [B, V]) for the next position."""
+    c = config
+    pos = cache["pos"]
+    M = cache["k"].shape[2]
+    x = jnp.take(params["embed"], token, axis=0)[:, None, :]   # [B, 1, D]
+    cos_t, sin_t = rope_tables(c, M)
+    cos = lax.dynamic_slice_in_dim(cos_t, pos, 1, 0)           # [1, hd/2]
+    sin = lax.dynamic_slice_in_dim(sin_t, pos, 1, 0)
+
+    def step(carry, xs):
+        x = carry
+        lp, kc, vc = xs
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = rope_raw(q, cos, sin)
+        k = rope_raw(k, cos, sin)
+        kc = lax.dynamic_update_slice_in_dim(
+            kc, k.astype(kc.dtype), pos, 1)
+        vc = lax.dynamic_update_slice_in_dim(
+            vc, v.astype(vc.dtype), pos, 1)
+        a = _attn_over_cache(q, kc, vc, pos)
+        x = x + a.astype(x.dtype) @ lp["wo"]
+        return _ffn(x, lp, c), (kc, vc)
+
+    x, (kc, vc) = lax.scan(step, x,
+                           (params["layers"], cache["k"], cache["v"]))
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, 0, :], _head(params, c),
+                        preferred_element_type=jnp.float32)
+    return {"k": kc, "v": vc, "pos": pos + 1}, logits
+
+
+def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             key=None):
+    """Autoregressive generation: greedy (temperature 0) or temperature
+    sampling. ids: [B, S] prompt; returns [B, max_new_tokens]. The whole
+    loop is static-shape (ring cache + lax.scan) — jit once, reuse for
+    any same-shape prompt."""
+    c = config
+    B, S = ids.shape
+    M = max_len if max_len is not None else S + max_new_tokens
+    E.enforce(M >= S + max_new_tokens,
+              f"max_len {M} < prompt {S} + max_new_tokens "
+              f"{max_new_tokens}")
+    cache = init_cache(c, B, M)
+    cache, logits = prefill(params, ids, c, cache)
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def body(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        cache, logits = decode_step(params, cache, tok, c)
+        return (cache, logits), tok
+
+    keys = jax.random.split(
+        key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
+    _, toks = lax.scan(body, (cache, logits), keys)
+    return toks.T                                   # [B, max_new_tokens]
 
 
 def loss_fn(params, batch, config: LlamaConfig, *, sp: bool = False,
