@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -648,3 +649,38 @@ class LlamaForCausalLM(nn.Layer):
             return ops.matmul(x, ops.transpose(self.embed_tokens.weight,
                                                perm=[1, 0]))
         return self.lm_head(x)
+
+    _LAYER_MAP = (("ln1", "input_layernorm"), ("wq", "q_proj"),
+                  ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj"),
+                  ("ln2", "post_attention_layernorm"),
+                  ("gate", "gate_proj"), ("up", "up_proj"),
+                  ("down", "down_proj"))
+
+    def functional_params(self):
+        """This Layer's weights as the functional-core pytree
+        (init_params layout) — the bridge onto the jitted train/decode
+        paths. Values are snapshots: mutate the Layer, re-export."""
+        c = self.config
+        layers = {
+            fk: jnp.stack([jnp.asarray(getattr(l, attr).weight.numpy())
+                           for l in self.layers])
+            for fk, attr in self._LAYER_MAP}
+        params = {"embed": jnp.asarray(self.embed_tokens.weight.numpy()),
+                  "layers": layers,
+                  "ln_f": jnp.asarray(self.norm.weight.numpy())}
+        if not c.tie_word_embeddings:
+            # functional head is [V, D]; nn.Linear stores [D, V]
+            params["lm_head"] = jnp.asarray(self.lm_head.weight.numpy()).T
+        return params
+
+    def generate(self, ids, max_new_tokens: int, **kw):
+        """Autoregressive generation through the static-cache functional
+        path (see module-level ``generate``). Accepts array or Tensor
+        ids; returns a Tensor [B, max_new_tokens]."""
+        from ..core.tensor import to_tensor
+
+        arr = ids.numpy() if hasattr(ids, "numpy") else np.asarray(ids)
+        toks = generate(self.functional_params(),
+                        jnp.asarray(arr, jnp.int32), self.config,
+                        max_new_tokens=max_new_tokens, **kw)
+        return to_tensor(np.asarray(toks))

@@ -227,6 +227,22 @@ class TestEagerLlama:
         np.testing.assert_allclose(out.numpy(), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_functional_params_roundtrip_and_generate(self):
+        """Layer -> functional export computes the identical function,
+        and the eager .generate delegates onto the static-cache path."""
+        cfg = tiny(num_hidden_layers=2)
+        m = L.LlamaForCausalLM(cfg)
+        params = m.functional_params()
+        ids = np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 9))
+        ref = L.forward(params, jnp.asarray(ids), cfg)
+        out = m(paddle.to_tensor(ids))
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        toks = m.generate(paddle.to_tensor(ids), max_new_tokens=3)
+        want = L.generate(params, jnp.asarray(ids, jnp.int32), cfg,
+                          max_new_tokens=3)
+        np.testing.assert_array_equal(toks.numpy(), np.asarray(want))
+
     def test_eager_training_memorizes(self):
         cfg = tiny(num_hidden_layers=1)
         m = L.LlamaForCausalLM(cfg)
