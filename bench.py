@@ -482,9 +482,59 @@ def _main():
         payload["extra"]["moe"] = {
             "error": f"{type(e).__name__}: {e}"[:500]}
 
+    # Serving rung: KV-cache greedy decode throughput on the 8B-shaped
+    # slice (static ring cache, jit-once loop). Optional like the MoE
+    # rung — failure degrades to an error entry.
+    try:
+        _stage("decode-rung", 240)
+        jax.clear_caches()
+        payload["extra"]["decode"] = _decode_rung(on_tpu)
+    except Exception as e:                      # noqa: BLE001
+        payload["extra"]["decode"] = {
+            "error": f"{type(e).__name__}: {e}"[:500]}
+
     _stage("report", 30)
     payload["extra"]["elapsed_s"] = round(time.monotonic() - _T0, 1)
     _emit(payload)
+
+
+def _decode_rung(on_tpu):
+    """Greedy KV-cache decode throughput (models.llama generate path):
+    batch x new-token throughput after a prompt prefill. Inference-mode
+    config (no remat — there is no backward to rematerialise for)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama as L
+
+    if on_tpu:
+        cfg = L.llama_3_8b(num_hidden_layers=4, vocab_size=32000,
+                           remat=False)
+        batch, prompt, new = 8, 128, 64
+    else:
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        batch, prompt, new = 2, 8, 4
+
+    params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
+    jax.block_until_ready(params["embed"])
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, prompt)), jnp.int32)
+    gen = jax.jit(lambda p, i: L.generate(p, i, cfg, max_new_tokens=new))
+    toks = gen(params, ids)                       # compile + warmup
+    float(toks[0, -1])   # hard sync — block_until_ready returns early
+    t0 = _time.perf_counter()                     # through the tunnel
+    toks = gen(params, ids)
+    float(toks[0, -1])                            # axon-safe hard sync
+    dt = _time.perf_counter() - t0
+    return {
+        "config": f"llama_3_8b[{cfg.num_hidden_layers}L]" if on_tpu
+        else "llama_tiny[2L]",
+        "batch": batch, "prompt": prompt, "new_tokens": new,
+        "decode_tokens_per_sec": round(batch * new / dt, 2),
+        "ms_per_token": round(dt / new * 1000, 3),
+    }
 
 
 def _moe_rung(on_tpu, dev):
