@@ -200,6 +200,36 @@ def main():
             argnums=(0, 1))(x, head)
         float(loss)
 
+    @case("moe_capacity_dispatch_train")
+    def _():
+        # the bench MoE rung's dispatch mode, at toy shapes: capacity
+        # gather + expert matmuls + drop path must compile AND grad
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.models import moe as M
+        cfg = M.moe_tiny(dispatch_mode="capacity", dtype=jnp.bfloat16,
+                         capacity_factor=1.0)
+        params = M.init_params(cfg, jax.random.PRNGKey(0))
+        opt = L.adamw_init(params)
+        step = M.make_train_step(cfg, lr=1e-3)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 17)),
+                          jnp.int32)
+        _, _, loss = step(params, opt, ids)
+        assert np.isfinite(float(loss))
+
+    @case("kv_cache_decode")
+    def _():
+        # the bench decode rung's path at toy shapes: prefill + jitted
+        # generate scan over decode steps
+        from paddle_tpu.models import llama as L
+        cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)),
+                          jnp.int32)
+        toks = jax.jit(lambda p, i: L.generate(
+            p, i, cfg, max_new_tokens=4))(params, ids)
+        t = np.asarray(toks)
+        assert t.shape == (2, 4) and (t >= 0).all()
+
     @case("flash_block_autotune_bench_shape")
     def _():
         # pre-tune the bench shapes; winners land in the REPO cache that
