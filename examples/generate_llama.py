@@ -1,0 +1,46 @@
+"""Autoregressive generation with the static KV cache — jit once,
+decode at HBM-bandwidth speed.
+
+Run:  python examples/generate_llama.py  (TPU or CPU)
+
+Shows the serving path: prefill fills a static [L, B, max_len, kv, hd]
+ring cache, then the whole greedy loop runs as ONE compiled program
+(lax.scan over decode steps) — no per-token retrace, no concat-grown
+cache. The eager Layer model reaches the same path via
+``LlamaForCausalLM.generate``.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models import llama as L
+
+on_tpu = jax.default_backend() in ("tpu", "axon")
+if on_tpu:
+    cfg = L.llama_3_8b(num_hidden_layers=4, vocab_size=32000, remat=False)
+    batch, prompt_len, new = 8, 128, 64
+else:
+    cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+    batch, prompt_len, new = 2, 16, 8
+
+print(f"params: {L.count_params(cfg) / 1e6:.1f}M  device: "
+      f"{jax.devices()[0].device_kind}")
+
+params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
+ids = jnp.asarray(np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+
+# greedy — temperature=0.7 + key=PRNGKey(..) would sample instead
+gen = jax.jit(lambda p, i: L.generate(p, i, cfg, max_new_tokens=new))
+toks = gen(params, ids)                       # compile + warmup
+float(toks[0, -1])                            # hard sync
+
+t0 = time.perf_counter()
+toks = gen(params, ids)
+float(toks[0, -1])
+dt = time.perf_counter() - t0
+print(f"decoded {batch}x{new} tokens in {dt * 1e3:.0f} ms "
+      f"({batch * new / dt:.0f} tok/s, {dt / new * 1e3:.2f} ms/token)")
+print("first sequence:", np.asarray(toks[0]))
