@@ -375,25 +375,48 @@ def decode_step(params, cache, token, config: LlamaConfig):
 
 def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              key=None):
     """Autoregressive generation: greedy (temperature 0) or temperature
-    sampling. ids: [B, S] prompt; returns [B, max_new_tokens]. The whole
-    loop is static-shape (ring cache + lax.scan) — jit once, reuse for
-    any same-shape prompt."""
+    sampling with optional top-k / nucleus (top-p) filtering — the
+    reference generation-loop controls (PaddleNLP GenerationMixin).
+    ids: [B, S] prompt; returns [B, max_new_tokens]. The whole loop is
+    static-shape (ring cache + lax.scan) — jit once, reuse for any
+    same-shape prompt."""
     c = config
     B, S = ids.shape
     M = max_len if max_len is not None else S + max_new_tokens
     E.enforce(M >= S + max_new_tokens,
               f"max_len {M} < prompt {S} + max_new_tokens "
               f"{max_new_tokens}")
+    if top_p is not None:
+        E.enforce(0.0 < top_p <= 1.0, f"top_p must be in (0, 1], got "
+                                      f"{top_p}")
     cache = init_cache(c, B, M)
     cache, logits = prefill(params, ids, c, cache)
+
+    def _filter(logits):
+        if top_k is not None:
+            kth = lax.top_k(logits, min(top_k, logits.shape[-1]))[0][
+                ..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            # drop the tail whose cumulative prob (over descending
+            # probs) already exceeded top_p BEFORE this token; the
+            # first token always survives
+            srt = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs
+            cut = jnp.min(jnp.where(cum < top_p, srt, jnp.inf), axis=-1,
+                          keepdims=True)
+            logits = jnp.where(logits < cut, -jnp.inf, logits)
+        return logits
 
     def sample(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return jax.random.categorical(
-            k, logits / temperature, axis=-1).astype(jnp.int32)
+            k, _filter(logits) / temperature, axis=-1).astype(jnp.int32)
 
     def body(carry, k):
         cache, logits = carry

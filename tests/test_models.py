@@ -97,6 +97,44 @@ class TestKVCacheDecode:
         assert t.shape == (2, 5)
         assert (t >= 0).all() and (t < cfg.vocab_size).all()
 
+    def test_gqa_decode_matches_full_forward(self):
+        # grouped-query attention through the cache: kv heads < q heads
+        cfg = tiny(num_attention_heads=4, num_key_value_heads=2)
+        params = L.init_params(cfg, jax.random.PRNGKey(6))
+        ids = jnp.asarray(np.random.default_rng(6).integers(
+            0, cfg.vocab_size, (2, 6)), jnp.int32)
+        cache = L.init_cache(cfg, 2, 9)
+        cache, logits = L.prefill(params, ids, cfg, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        seq = jnp.concatenate([ids, tok[:, None]], axis=1)
+        cache, logits = L.decode_step(params, cache, tok, cfg)
+        full = L.forward(params, seq, cfg)[:, -1, :]
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_top_k_restricts_support(self):
+        # with top_k=1, temperature sampling must equal greedy
+        cfg, params, ids = self._setup(seed=7)
+        greedy = L.generate(params, ids, cfg, max_new_tokens=4)
+        topk1 = L.generate(params, ids, cfg, max_new_tokens=4,
+                           temperature=1.3, top_k=1,
+                           key=jax.random.PRNGKey(11))
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(topk1))
+
+    def test_top_p_tiny_equals_greedy_and_validates(self):
+        cfg, params, ids = self._setup(seed=8)
+        # a tiny nucleus keeps only the argmax token
+        nucleus = L.generate(params, ids, cfg, max_new_tokens=4,
+                             temperature=1.0, top_p=1e-6,
+                             key=jax.random.PRNGKey(13))
+        greedy = L.generate(params, ids, cfg, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(nucleus),
+                                      np.asarray(greedy))
+        from paddle_tpu.core import enforce as E
+        with pytest.raises(E.EnforceError):
+            L.generate(params, ids, cfg, max_new_tokens=2, top_p=0.0)
+
 
 class TestFunctionalLlama:
     def test_forward_shapes_gqa(self):
