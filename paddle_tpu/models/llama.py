@@ -48,7 +48,7 @@ __all__ = [
     "init_params", "forward", "loss_fn", "param_specs",
     "make_train_step", "make_forward", "adamw_init", "count_params",
     "LlamaForCausalLM",
-    "init_cache", "prefill", "decode_step", "generate",
+    "init_cache", "prefill", "decode_step", "generate", "make_sampler",
 ]
 
 
@@ -389,11 +389,31 @@ def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
     E.enforce(M >= S + max_new_tokens,
               f"max_len {M} < prompt {S} + max_new_tokens "
               f"{max_new_tokens}")
-    if top_p is not None:
-        E.enforce(0.0 < top_p <= 1.0, f"top_p must be in (0, 1], got "
-                                      f"{top_p}")
     cache = init_cache(c, B, M)
     cache, logits = prefill(params, ids, c, cache)
+    sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
+
+    def body(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        cache, logits = decode_step(params, cache, tok, c)
+        return (cache, logits), tok
+
+    keys = jax.random.split(
+        key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
+    _, toks = lax.scan(body, (cache, logits), keys)
+    return toks.T                                   # [B, max_new_tokens]
+
+
+def make_sampler(temperature: float = 0.0, *, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
+    """sample(logits [B, V], key) -> [B] int32: greedy at temperature 0,
+    else categorical with optional top-k cut and top-p nucleus filtering
+    (the reference generation-loop controls). Static-shape — safe inside
+    a jitted decode scan. Shared by every model family's generate."""
+    if top_p is not None:
+        E.enforce(0.0 < top_p <= 1.0,
+                  f"top_p must be in (0, 1], got {top_p}")
 
     def _filter(logits):
         if top_k is not None:
@@ -418,16 +438,7 @@ def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
         return jax.random.categorical(
             k, _filter(logits) / temperature, axis=-1).astype(jnp.int32)
 
-    def body(carry, k):
-        cache, logits = carry
-        tok = sample(logits, k)
-        cache, logits = decode_step(params, cache, tok, c)
-        return (cache, logits), tok
-
-    keys = jax.random.split(
-        key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
-    _, toks = lax.scan(body, (cache, logits), keys)
-    return toks.T                                   # [B, max_new_tokens]
+    return sample
 
 
 def loss_fn(params, batch, config: LlamaConfig, *, sp: bool = False,

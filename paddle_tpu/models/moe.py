@@ -44,7 +44,7 @@ __all__ = [
     "MoEConfig", "moe_tiny", "deepseek_moe_16b", "qwen2_moe_a14b",
     "ernie_4_5_a3b", "init_params", "forward", "forward_hidden", "loss_fn",
     "param_specs", "make_train_step", "count_params", "adamw_init",
-    "moe_capacity",
+    "moe_capacity", "init_cache", "prefill", "decode_step", "generate",
 ]
 
 
@@ -339,6 +339,124 @@ def forward(params, ids, config: MoEConfig, *,
     logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
     return logits, aux
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding (serving path for the MoE families; same static
+# ring-buffer design as models.llama — see the design note there)
+# ---------------------------------------------------------------------------
+
+def init_cache(config: MoEConfig, batch: int, max_len: int, dtype=None):
+    """Fresh decode cache (same layout as the llama family's)."""
+    from .llama import init_cache as _ic
+    return _ic(config, batch, max_len, dtype)   # shared field contract
+
+
+def prefill(params, ids, config: MoEConfig, cache):
+    """Consume the prompt [B, S]: fills cache[:, :, :S] and returns
+    (cache', last-position logits [B, V])."""
+    from .llama import _qkv_proj
+    c = config
+    B, S = ids.shape
+    E.enforce(S <= cache["k"].shape[2],
+              f"prompt length {S} exceeds cache max_len "
+              f"{cache['k'].shape[2]}")
+    x = jnp.take(params["embed"], ids, axis=0)
+    cos, sin = _rope_tables(S, c.head_dim, theta=c.rope_theta)
+
+    def step(carry, lp):
+        x = carry
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, -1)
+        x = x + a @ lp["wo"]
+        h2 = _rms(x, lp["ln2"], c.rms_norm_eps)
+        out, _ = _moe_mlp(h2, lp, c, None)
+        return x + out, (k, v)
+
+    x, (ks, vs) = lax.scan(step, x, params["layers"])
+    kc = lax.dynamic_update_slice(
+        cache["k"], ks.astype(cache["k"].dtype), (0,) * 5)
+    vc = lax.dynamic_update_slice(
+        cache["v"], vs.astype(cache["v"].dtype), (0,) * 5)
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, -1, :], params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return {"k": kc, "v": vc, "pos": jnp.asarray(S, jnp.int32)}, logits
+
+
+def decode_step(params, cache, token, config: MoEConfig):
+    """One incremental step: ``token`` [B] sits at position cache['pos'].
+    Routing runs per decoded token (T = B), so the capacity grid is tiny
+    and no slot can overflow — decode is effectively DROPLESS even under
+    dispatch_mode="capacity" (the usual capacity-factor train/infer
+    asymmetry: training drops over-capacity slots at T = B*S, inference
+    routes every token). Returns (cache', logits [B, V])."""
+    from .llama import _attn_over_cache, _qkv_proj
+    from ..nn.functional.attention import rope_raw
+    c = config
+    pos = cache["pos"]
+    M = cache["k"].shape[2]
+    x = jnp.take(params["embed"], token, axis=0)[:, None, :]   # [B, 1, D]
+    cos_t, sin_t = _rope_tables(M, c.head_dim, theta=c.rope_theta)
+    cos = lax.dynamic_slice_in_dim(cos_t, pos, 1, 0)
+    sin = lax.dynamic_slice_in_dim(sin_t, pos, 1, 0)
+
+    def step(carry, xs):
+        x = carry
+        lp, kc, vc = xs
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = rope_raw(q, cos, sin)
+        k = rope_raw(k, cos, sin)
+        kc = lax.dynamic_update_slice_in_dim(
+            kc, k.astype(kc.dtype), pos, 1)
+        vc = lax.dynamic_update_slice_in_dim(
+            vc, v.astype(vc.dtype), pos, 1)
+        a = _attn_over_cache(q, kc, vc, pos)
+        x = x + a.astype(x.dtype) @ lp["wo"]
+        h2 = _rms(x, lp["ln2"], c.rms_norm_eps)
+        out, _ = _moe_mlp(h2, lp, c, None)
+        return x + out, (kc, vc)
+
+    x, (kc, vc) = lax.scan(step, x,
+                           (params["layers"], cache["k"], cache["v"]))
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = jnp.einsum("bd,vd->bv", x[:, 0, :], params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return {"k": kc, "v": vc, "pos": pos + 1}, logits
+
+
+def generate(params, ids, config: MoEConfig, *, max_new_tokens: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             key=None):
+    """Autoregressive generation for the MoE families (greedy /
+    temperature / top-k / top-p); same jit-once static loop as
+    llama.generate."""
+    from .llama import make_sampler
+    c = config
+    B, S = ids.shape
+    M = max_len if max_len is not None else S + max_new_tokens
+    E.enforce(M >= S + max_new_tokens,
+              f"max_len {M} < prompt {S} + max_new_tokens "
+              f"{max_new_tokens}")
+    cache = init_cache(c, B, M)
+    cache, logits = prefill(params, ids, c, cache)
+    sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
+
+    def body(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        cache, logits = decode_step(params, cache, tok, c)
+        return (cache, logits), tok
+
+    keys = jax.random.split(
+        key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
+    _, toks = lax.scan(body, (cache, logits), keys)
+    return toks.T
 
 
 def loss_fn(params, batch, config: MoEConfig, *,

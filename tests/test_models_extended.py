@@ -133,6 +133,46 @@ class TestMoECapacityDispatch:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    def test_kv_cache_decode_matches_forward(self):
+        # MoE incremental decode: prefill + steps pin to the full
+        # forward's last logits (routing runs per decoded token)
+        cfg = moe.moe_tiny()
+        params = moe.init_params(cfg, jax.random.key(5))
+        ids = jnp.asarray(np.random.default_rng(5).integers(
+            0, cfg.vocab_size, (2, 6)), jnp.int32)
+        cache = moe.init_cache(cfg, 2, 9)
+        cache, logits = moe.prefill(params, ids, cfg, cache)
+        full, _ = moe.forward(params, ids, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1, :]),
+                                   rtol=2e-4, atol=2e-4)
+        seq = ids
+        for _ in range(2):
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+            cache, logits = moe.decode_step(params, cache, tok, cfg)
+            full, _ = moe.forward(params, seq, cfg)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, -1, :]),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_generate_greedy_matches_naive(self):
+        cfg = moe.moe_tiny()
+        params = moe.init_params(cfg, jax.random.key(6))
+        ids = jnp.asarray(np.random.default_rng(6).integers(
+            0, cfg.vocab_size, (2, 5)), jnp.int32)
+        got = jax.jit(lambda p, i: moe.generate(
+            p, i, cfg, max_new_tokens=3))(params, ids)
+        seq = ids
+        want = []
+        for _ in range(3):
+            logits, _ = moe.forward(params, seq, cfg)
+            nxt = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+            want.append(nxt)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.stack(want, axis=1))
+
     def test_dots_remat_policy_compiles(self):
         cfg = moe.moe_tiny(dispatch_mode="capacity", remat=True,
                            remat_policy="dots")
