@@ -162,6 +162,30 @@ class TestSaveLoad:
         loaded = jit.load(path)
         assert loaded(t(np.random.randn(2, 4))).shape == [2, 2]
 
+    def test_generate_loop_exports_and_serves(self):
+        """The whole KV-cache generate loop (prefill + scan of decode
+        steps) saves as ONE StableHLO artifact and serves greedily —
+        the deployment story for the decode path."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models import llama as L
+
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+
+        def serve(ids):
+            return L.generate(params, ids, cfg, max_new_tokens=4)
+
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "decoder")
+        jit.save(serve, path, input_spec=[jit.InputSpec([2, 5], "int32")])
+        loaded = jit.load(path)
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 5)).astype("int32")
+        got = loaded(paddle.to_tensor(ids)).numpy()
+        want = np.asarray(serve(jnp.asarray(ids)))
+        np.testing.assert_array_equal(got, want)
+
     def test_loaded_artifact_is_hermetic(self):
         """Load must not need the original class (serving parity)."""
         net = SmallNet()
