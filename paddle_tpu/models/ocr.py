@@ -81,6 +81,30 @@ class OCRRecognizer(nn.Layer):
         return self.head(out)                        # [N, T, classes]
 
 
+def ctc_greedy_decode(logits, blank: int = 0):
+    """Best-path CTC decoding (reference capability: the CTCLabelDecode
+    postprocess behind the PP-OCR rec pipelines): argmax per frame,
+    collapse repeats, drop blanks. logits: [N, T, C] (Tensor or array).
+    Returns (list of per-sample id lists, [N] mean top-prob confidences
+    over the kept frames)."""
+    import numpy as np
+
+    arr = logits.numpy() if hasattr(logits, "numpy") else np.asarray(logits)
+    # softmax over classes for confidences (stable)
+    z = arr - arr.max(axis=-1, keepdims=True)
+    probs = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
+    ids = arr.argmax(axis=-1)                        # [N, T]
+    top = probs.max(axis=-1)                         # [N, T]
+    texts, confs = [], []
+    for n in range(ids.shape[0]):
+        keep = np.ones(ids.shape[1], bool)
+        keep[1:] = ids[n, 1:] != ids[n, :-1]         # collapse repeats
+        keep &= ids[n] != blank                      # drop blanks
+        texts.append(ids[n, keep].tolist())
+        confs.append(float(top[n, keep].mean()) if keep.any() else 0.0)
+    return texts, np.asarray(confs, np.float32)
+
+
 def ctc_train_step(model: OCRRecognizer, optimizer):
     """Build an eager train-step closure: (images, labels, label_lens) ->
     loss. The CTC loss rides the taped log-semiring scan

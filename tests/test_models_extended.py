@@ -420,6 +420,27 @@ class TestOCRRecognizer:
             last = float(step(imgs, labels, lens).numpy())
         assert np.isfinite(last) and last < l0
 
+    def test_ctc_greedy_decode(self):
+        import numpy as np
+
+        from paddle_tpu.models import ocr
+
+        # hand-built logits: frames argmax to [blank, 5, 5, blank, 3, 3]
+        # -> collapse repeats, drop blanks -> [5, 3]
+        C = 8
+        frames = [0, 5, 5, 0, 3, 3]
+        logits = np.full((1, len(frames), C), -5.0, np.float32)
+        for t, k in enumerate(frames):
+            logits[0, t, k] = 5.0
+        texts, confs = ocr.ctc_greedy_decode(logits)
+        assert texts == [[5, 3]]
+        assert 0.9 < confs[0] <= 1.0
+        # all-blank row decodes empty with zero confidence
+        blank = np.zeros((1, 4, C), np.float32)
+        blank[..., 0] = 9.0
+        texts, confs = ocr.ctc_greedy_decode(blank)
+        assert texts == [[]] and confs[0] == 0.0
+
     def test_ernie_config(self):
         from paddle_tpu.models import moe
 
