@@ -376,12 +376,15 @@ def decode_step(params, cache, token, config: LlamaConfig):
 def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0,
              key=None):
     """Autoregressive generation: greedy (temperature 0) or temperature
-    sampling with optional top-k / nucleus (top-p) filtering — the
-    reference generation-loop controls (PaddleNLP GenerationMixin).
-    ids: [B, S] prompt; returns [B, max_new_tokens]. The whole loop is
-    static-shape (ring cache + lax.scan) — jit once, reuse for any
+    sampling with optional top-k / nucleus (top-p) filtering and EOS
+    stopping — the reference generation-loop controls (PaddleNLP
+    GenerationMixin). ids: [B, S] prompt; returns [B, max_new_tokens];
+    with ``eos_token_id`` set, positions after a sequence's EOS hold
+    ``pad_token_id`` (the loop itself stays static-shape: finished rows
+    keep decoding, their outputs are masked). Jit once, reuse for any
     same-shape prompt."""
     c = config
     B, S = ids.shape
@@ -394,14 +397,20 @@ def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
     sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
 
     def body(carry, k):
-        cache, logits = carry
+        cache, logits, done = carry
         tok = sample(logits, k)
+        if eos_token_id is not None:
+            out = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32),
+                            tok)
+            done = done | (tok == eos_token_id)
+        else:
+            out = tok
         cache, logits = decode_step(params, cache, tok, c)
-        return (cache, logits), tok
+        return (cache, logits, done), out
 
     keys = jax.random.split(
         key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
-    _, toks = lax.scan(body, (cache, logits), keys)
+    _, toks = lax.scan(body, (cache, logits, jnp.zeros((B,), bool)), keys)
     return toks.T                                   # [B, max_new_tokens]
 
 

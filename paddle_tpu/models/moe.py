@@ -432,10 +432,11 @@ def decode_step(params, cache, token, config: MoEConfig):
 def generate(params, ids, config: MoEConfig, *, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0,
              key=None):
     """Autoregressive generation for the MoE families (greedy /
-    temperature / top-k / top-p); same jit-once static loop as
-    llama.generate."""
+    temperature / top-k / top-p / EOS stopping); same jit-once static
+    loop as llama.generate."""
     from .llama import make_sampler
     c = config
     B, S = ids.shape
@@ -448,14 +449,20 @@ def generate(params, ids, config: MoEConfig, *, max_new_tokens: int,
     sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
 
     def body(carry, k):
-        cache, logits = carry
+        cache, logits, done = carry
         tok = sample(logits, k)
+        if eos_token_id is not None:
+            out = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32),
+                            tok)
+            done = done | (tok == eos_token_id)
+        else:
+            out = tok
         cache, logits = decode_step(params, cache, tok, c)
-        return (cache, logits), tok
+        return (cache, logits, done), out
 
     keys = jax.random.split(
         key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
-    _, toks = lax.scan(body, (cache, logits), keys)
+    _, toks = lax.scan(body, (cache, logits, jnp.zeros((B,), bool)), keys)
     return toks.T
 
 

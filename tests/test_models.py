@@ -122,6 +122,21 @@ class TestKVCacheDecode:
         np.testing.assert_array_equal(np.asarray(greedy),
                                       np.asarray(topk1))
 
+    def test_eos_stops_and_pads(self):
+        cfg, params, ids = self._setup(seed=9)
+        # find what greedy emits, then declare its SECOND token the EOS:
+        # position 0..1 must be emitted as-is, everything after padded
+        base = np.asarray(L.generate(params, ids, cfg, max_new_tokens=5))
+        eos = int(base[0, 1])
+        got = np.asarray(L.generate(params, ids, cfg, max_new_tokens=5,
+                                    eos_token_id=eos, pad_token_id=-1))
+        assert got[0, 1] == eos            # the EOS itself is emitted
+        assert (got[0, 2:] == -1).all()    # then padding
+        # a row that never hits EOS is untouched
+        for b in range(base.shape[0]):
+            if eos not in base[b]:
+                np.testing.assert_array_equal(got[b], base[b])
+
     def test_top_p_tiny_equals_greedy_and_validates(self):
         cfg, params, ids = self._setup(seed=8)
         # a tiny nucleus keeps only the argmax token
