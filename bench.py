@@ -494,6 +494,10 @@ def _main():
             "error": f"{type(e).__name__}: {e}"[:500]}
 
     _stage("report", 30)
+    # Re-capture the dispatch record now that every rung has traced:
+    # the earlier snapshot (taken for the partial-payload safety copy)
+    # misses the MoE and decode stages' block/chunk decisions.
+    payload["extra"]["autotune"] = _autotune_summary()
     payload["extra"]["elapsed_s"] = round(time.monotonic() - _T0, 1)
     _emit(payload)
 

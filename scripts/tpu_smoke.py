@@ -244,10 +244,10 @@ def main():
         # re-reads the env var just set above.
         at._CACHE = at.AutotuneCache()
         # rung-1 dense shape + the MoE rung's shape (DeepSeekMoE-16B
-        # slice at b2/s1024: 16 heads, d128) so both bench rungs run
+        # slice at b8/s1024: 16 heads, d128) so both bench rungs run
         # tuned blocks
         for b, h, kvh, s, d in ((4, 32, 8, 2048, 128),
-                                (2, 16, 16, 1024, 128)):
+                                (8, 16, 16, 1024, 128)):
             blocks = at.flash_blocks((b, s, h, d), (b, s, kvh, d),
                                      jnp.bfloat16, True)
             print(f"tuned blocks for s={s}: {blocks}", file=sys.stderr)
