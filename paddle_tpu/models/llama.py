@@ -49,6 +49,7 @@ __all__ = [
     "make_train_step", "make_forward", "adamw_init", "count_params",
     "LlamaForCausalLM",
     "init_cache", "prefill", "decode_step", "generate", "make_sampler",
+    "beam_search",
 ]
 
 
@@ -412,6 +413,98 @@ def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
         key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
     _, toks = lax.scan(body, (cache, logits, jnp.zeros((B,), bool)), keys)
     return toks.T                                   # [B, max_new_tokens]
+
+
+def beam_search(params, ids, config: LlamaConfig, *, max_new_tokens: int,
+                num_beams: int, max_len: Optional[int] = None,
+                length_penalty: float = 0.0,
+                eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+    """Static-shape beam search (reference capability: PaddleNLP
+    GenerationMixin beam decoding). One prefill, then every step runs
+    ONE batched decode over [B*K] beam rows, selects the global top-K of
+    ``running score + log-softmax`` over [K, V], and reorders the KV
+    cache along the beam axis with a gather — shapes never change, so
+    the whole search jits once.
+
+    Finished beams (EOS emitted) are frozen: their only continuation is
+    ``pad_token_id`` at zero additional score. Final ranking divides
+    scores by ``generated_length ** length_penalty`` (0 = pure
+    log-prob). Returns (tokens [B, max_new_tokens] of the best beam,
+    best scores [B])."""
+    c = config
+    B, S = ids.shape
+    K = num_beams
+    E.enforce(K >= 1, f"num_beams must be >= 1, got {K}")
+    M = max_len if max_len is not None else S + max_new_tokens
+    E.enforce(M >= S + max_new_tokens,
+              f"max_len {M} < prompt {S} + max_new_tokens "
+              f"{max_new_tokens}")
+
+    cache = init_cache(c, B, M)
+    cache, logits = prefill(params, ids, c, cache)      # logits [B, V]
+    # replicate the prompt cache across beams: [L, B, ...] -> [L, B*K, ...]
+    tile = lambda a: jnp.repeat(a, K, axis=1)
+    cache = {"k": tile(cache["k"]), "v": tile(cache["v"]),
+             "pos": cache["pos"]}
+    V = logits.shape[-1]
+    logits = jnp.repeat(logits, K, axis=0)              # [B*K, V]
+    # beam 0 starts live, the rest at -inf so step 1 picks K distinct
+    # tokens from the prompt distribution
+    scores = jnp.tile(jnp.asarray([0.0] + [-jnp.inf] * (K - 1)), (B, 1))
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+
+    def step(carry, _):
+        cache, logits, scores, done, lengths = carry
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, V)
+        # frozen beams: only pad continues, at zero additional score
+        pad_only = jnp.full((V,), -jnp.inf).at[pad_token_id].set(0.0)
+        logp = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
+        total = scores[:, :, None] + logp               # [B, K, V]
+        top, flat = lax.top_k(total.reshape(B, K * V), K)
+        beam_idx, tok = flat // V, (flat % V).astype(jnp.int32)  # [B, K]
+        gather_rows = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        cache = {"k": jnp.take(cache["k"], gather_rows, axis=1),
+                 "v": jnp.take(cache["v"], gather_rows, axis=1),
+                 "pos": cache["pos"]}
+        done = jnp.take_along_axis(done, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        lengths = lengths + (~done).astype(jnp.int32)
+        # frozen beams continue through their (possibly wrapped) pad
+        # score slot internally, but the RECORDED token is the literal
+        # pad id (pad_token_id may be negative, e.g. -1)
+        tok = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32), tok)
+        if eos_token_id is not None:
+            done = done | ((tok == eos_token_id) & ~done)
+        cache, logits = decode_step(params, cache, tok.reshape(-1), c)
+        return (cache, logits, top, done, lengths), (tok, beam_idx)
+
+    done0 = jnp.zeros((B, K), bool)
+    len0 = jnp.zeros((B, K), jnp.int32)
+    (cache, logits, scores, done, lengths), (toks, bidx) = lax.scan(
+        step, (cache, logits, scores, done0, len0), None,
+        length=max_new_tokens)
+
+    # Reconstruct each surviving beam's token path by walking the
+    # recorded (token, parent-beam) choices backwards.
+    def back(carry, xs):
+        beam = carry                                    # [B, K]
+        tok, bi = xs
+        t = jnp.take_along_axis(tok, beam, axis=1)
+        beam = jnp.take_along_axis(bi, beam, axis=1)
+        return beam, t
+
+    init = jnp.tile(jnp.arange(K), (B, 1))
+    _, path = lax.scan(back, init, (toks, bidx), reverse=True)
+    path = jnp.moveaxis(path, 0, -1)                    # [B, K, T]
+
+    norm = jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+    best = jnp.argmax(scores / norm, axis=1)            # [B]
+    best_toks = jnp.take_along_axis(
+        path, best[:, None, None], axis=1)[:, 0, :]
+    best_scores = jnp.take_along_axis(scores / norm, best[:, None],
+                                      axis=1)[:, 0]
+    return best_toks, best_scores
 
 
 def make_sampler(temperature: float = 0.0, *, top_k: Optional[int] = None,

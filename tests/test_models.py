@@ -140,6 +140,64 @@ class TestKVCacheDecode:
         np.testing.assert_array_equal(np.asarray(greedy),
                                       np.asarray(topk1))
 
+    def test_beam_search_k1_equals_greedy(self):
+        cfg, params, ids = self._setup(seed=10)
+        greedy = np.asarray(L.generate(params, ids, cfg,
+                                       max_new_tokens=4))
+        toks, scores = L.beam_search(params, ids, cfg, max_new_tokens=4,
+                                     num_beams=1)
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+        assert np.isfinite(np.asarray(scores)).all()
+
+    def test_beam_search_matches_naive_reference(self):
+        """Differential test: the jitted static beam search must agree
+        with a naive python beam search that re-runs the full forward
+        for every candidate prefix."""
+        cfg, params, ids = self._setup(seed=11, B=1, S=4)
+        K, T = 2, 3
+        toks, scores = L.beam_search(params, ids, cfg, max_new_tokens=T,
+                                     num_beams=K)
+
+        def logp_next(prefix):
+            lg = L.forward(params, jnp.asarray(prefix[None]), cfg)
+            return np.asarray(
+                jax.nn.log_softmax(lg[0, -1].astype(jnp.float32)))
+
+        prompt = np.asarray(ids[0])
+        beams = [(0.0, prompt, [])]
+        for _ in range(T):
+            cands = []
+            for sc, pref, out in beams:
+                lp = logp_next(pref)
+                top = np.argsort(lp)[::-1][:K]
+                for t in top:
+                    cands.append((sc + lp[t],
+                                  np.concatenate([pref, [t]]),
+                                  out + [int(t)]))
+            cands.sort(key=lambda x: -x[0])
+            beams = cands[:K]
+        want_toks = beams[0][2]
+        want_score = beams[0][0]
+        np.testing.assert_array_equal(np.asarray(toks)[0], want_toks)
+        np.testing.assert_allclose(float(scores[0]), want_score,
+                                   rtol=1e-4)
+
+    def test_beam_search_eos_freezes_beam(self):
+        cfg, params, ids = self._setup(seed=12)
+        base, _ = L.beam_search(params, ids, cfg, max_new_tokens=5,
+                                num_beams=2)
+        base = np.asarray(base)
+        eos = int(base[0, 1])
+        toks, _ = L.beam_search(params, ids, cfg, max_new_tokens=5,
+                                num_beams=2, eos_token_id=eos,
+                                pad_token_id=-1)
+        toks = np.asarray(toks)
+        for b in range(toks.shape[0]):
+            row = toks[b].tolist()
+            if eos in row:
+                i = row.index(eos)
+                assert all(t == -1 for t in row[i + 1:]), row
+
     def test_eos_stops_and_pads(self):
         cfg, params, ids = self._setup(seed=9)
         # find what greedy emits, then declare its SECOND token the EOS:
