@@ -431,6 +431,22 @@ def beam_search(params, ids, config: LlamaConfig, *, max_new_tokens: int,
     scores by ``generated_length ** length_penalty`` (0 = pure
     log-prob). Returns (tokens [B, max_new_tokens] of the best beam,
     best scores [B])."""
+    return _beam_search_over(
+        init_cache, prefill, decode_step, params, ids, config,
+        max_new_tokens=max_new_tokens, num_beams=num_beams,
+        max_len=max_len, length_penalty=length_penalty,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id)
+
+
+def _beam_search_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
+                      config, *, max_new_tokens: int, num_beams: int,
+                      max_len: Optional[int] = None,
+                      length_penalty: float = 0.0,
+                      eos_token_id: Optional[int] = None,
+                      pad_token_id: int = 0):
+    """Family-agnostic beam loop: any model exposing the
+    (init_cache, prefill, decode_step) cache contract plugs in (the MoE
+    family reuses this verbatim)."""
     c = config
     B, S = ids.shape
     K = num_beams
@@ -440,8 +456,8 @@ def beam_search(params, ids, config: LlamaConfig, *, max_new_tokens: int,
               f"max_len {M} < prompt {S} + max_new_tokens "
               f"{max_new_tokens}")
 
-    cache = init_cache(c, B, M)
-    cache, logits = prefill(params, ids, c, cache)      # logits [B, V]
+    cache = init_cache_fn(c, B, M)
+    cache, logits = prefill_fn(params, ids, c, cache)   # logits [B, V]
     # replicate the prompt cache across beams: [L, B, ...] -> [L, B*K, ...]
     tile = lambda a: jnp.repeat(a, K, axis=1)
     cache = {"k": tile(cache["k"]), "v": tile(cache["v"]),
@@ -476,7 +492,7 @@ def beam_search(params, ids, config: LlamaConfig, *, max_new_tokens: int,
         tok = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32), tok)
         if eos_token_id is not None:
             done = done | ((tok == eos_token_id) & ~done)
-        cache, logits = decode_step(params, cache, tok.reshape(-1), c)
+        cache, logits = decode_fn(params, cache, tok.reshape(-1), c)
         return (cache, logits, top, done, lengths), (tok, beam_idx)
 
     done0 = jnp.zeros((B, K), bool)

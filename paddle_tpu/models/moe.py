@@ -45,6 +45,7 @@ __all__ = [
     "ernie_4_5_a3b", "init_params", "forward", "forward_hidden", "loss_fn",
     "param_specs", "make_train_step", "count_params", "adamw_init",
     "moe_capacity", "init_cache", "prefill", "decode_step", "generate",
+    "beam_search",
 ]
 
 
@@ -464,6 +465,20 @@ def generate(params, ids, config: MoEConfig, *, max_new_tokens: int,
         key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
     _, toks = lax.scan(body, (cache, logits, jnp.zeros((B,), bool)), keys)
     return toks.T
+
+
+def beam_search(params, ids, config: MoEConfig, *, max_new_tokens: int,
+                num_beams: int, max_len: Optional[int] = None,
+                length_penalty: float = 0.0,
+                eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+    """Static-shape beam search for the MoE families (shared loop —
+    see llama.beam_search)."""
+    from .llama import _beam_search_over
+    return _beam_search_over(
+        init_cache, prefill, decode_step, params, ids, config,
+        max_new_tokens=max_new_tokens, num_beams=num_beams,
+        max_len=max_len, length_penalty=length_penalty,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id)
 
 
 def loss_fn(params, batch, config: MoEConfig, *,

@@ -156,6 +156,21 @@ class TestMoECapacityDispatch:
                                        np.asarray(full[:, -1, :]),
                                        rtol=2e-4, atol=2e-4)
 
+    def test_beam_search_k1_equals_greedy(self):
+        cfg = moe.moe_tiny()
+        params = moe.init_params(cfg, jax.random.key(7))
+        ids = jnp.asarray(np.random.default_rng(7).integers(
+            0, cfg.vocab_size, (2, 5)), jnp.int32)
+        greedy = np.asarray(moe.generate(params, ids, cfg,
+                                         max_new_tokens=3))
+        toks, scores = moe.beam_search(params, ids, cfg,
+                                       max_new_tokens=3, num_beams=1)
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+        # and K=3 scores are at least as good as the greedy path's
+        _, s3 = moe.beam_search(params, ids, cfg, max_new_tokens=3,
+                                num_beams=3)
+        assert (np.asarray(s3) >= np.asarray(scores) - 1e-5).all()
+
     def test_generate_greedy_matches_naive(self):
         cfg = moe.moe_tiny()
         params = moe.init_params(cfg, jax.random.key(6))
