@@ -43,4 +43,13 @@ float(toks[0, -1])
 dt = time.perf_counter() - t0
 print(f"decoded {batch}x{new} tokens in {dt * 1e3:.0f} ms "
       f"({batch * new / dt:.0f} tok/s, {dt / new * 1e3:.2f} ms/token)")
-print("first sequence:", np.asarray(toks[0]))
+print("greedy:", np.asarray(toks[0])[:16])
+
+# nucleus sampling and beam search ride the same compiled-loop design
+sampled = L.generate(params, ids[:2], cfg, max_new_tokens=16,
+                     temperature=0.8, top_p=0.95,
+                     key=jax.random.PRNGKey(42))
+print("top-p 0.95:", np.asarray(sampled[0]))
+beams, scores = L.beam_search(params, ids[:2], cfg, max_new_tokens=16,
+                              num_beams=4, length_penalty=0.6)
+print(f"beam-4 (score {float(scores[0]):.2f}):", np.asarray(beams[0]))
