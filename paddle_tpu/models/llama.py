@@ -825,14 +825,20 @@ class LlamaForCausalLM(nn.Layer):
             params["lm_head"] = jnp.asarray(self.lm_head.weight.numpy()).T
         return params
 
-    def generate(self, ids, max_new_tokens: int, **kw):
+    def generate(self, ids, max_new_tokens: int, num_beams: int = 1,
+                 **kw):
         """Autoregressive generation through the static-cache functional
-        path (see module-level ``generate``). Accepts array or Tensor
-        ids; returns a Tensor [B, max_new_tokens]."""
+        path (see module-level ``generate``; ``num_beams > 1`` selects
+        beam search, the reference's one-generate-API shape). Accepts
+        array or Tensor ids; returns a Tensor [B, max_new_tokens]."""
         from ..core.tensor import to_tensor
 
         arr = ids.numpy() if hasattr(ids, "numpy") else np.asarray(ids)
-        toks = generate(self.functional_params(),
-                        jnp.asarray(arr, jnp.int32), self.config,
-                        max_new_tokens=max_new_tokens, **kw)
+        args = (self.functional_params(), jnp.asarray(arr, jnp.int32),
+                self.config)
+        if num_beams > 1:
+            toks, _ = beam_search(*args, max_new_tokens=max_new_tokens,
+                                  num_beams=num_beams, **kw)
+        else:
+            toks = generate(*args, max_new_tokens=max_new_tokens, **kw)
         return to_tensor(np.asarray(toks))

@@ -371,6 +371,12 @@ class TestEagerLlama:
         want = L.generate(params, jnp.asarray(ids, jnp.int32), cfg,
                           max_new_tokens=3)
         np.testing.assert_array_equal(toks.numpy(), np.asarray(want))
+        # num_beams routes to beam search through the same entry point
+        bt = m.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                        num_beams=2)
+        bw, _ = L.beam_search(params, jnp.asarray(ids, jnp.int32), cfg,
+                              max_new_tokens=3, num_beams=2)
+        np.testing.assert_array_equal(bt.numpy(), np.asarray(bw))
 
     def test_eager_training_memorizes(self):
         cfg = tiny(num_hidden_layers=1)
