@@ -521,16 +521,47 @@ def _decode_rung(on_tpu):
         cfg = L.llama_tiny(num_hidden_layers=2)
         batch, prompt, new = 2, 8, 4
 
+    from jax import lax
+
     params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
     jax.block_until_ready(params["embed"])
     ids = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, prompt)), jnp.int32)
-    gen = jax.jit(lambda p, i: L.generate(p, i, cfg, max_new_tokens=new))
-    toks = gen(params, ids)                       # compile + warmup
-    float(toks[0, -1])   # hard sync — block_until_ready returns early
-    t0 = _time.perf_counter()                     # through the tunnel
-    toks = gen(params, ids)
-    float(toks[0, -1])                            # axon-safe hard sync
+    M = prompt + new
+
+    # Prefill and the decode scan are timed SEPARATELY: folding the
+    # prompt forward into the per-token quotient overstated decode
+    # latency ~2x at these shapes (prefill is 1024 prompt-token
+    # forwards vs 512 decode-step token-forwards).
+    pf = jax.jit(lambda p, i: L.prefill(p, i, cfg, L.init_cache(
+        cfg, batch, M)))
+
+    def _decode_scan(p, cache, logits):
+        def body(carry, _):
+            cache, logits = carry
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            cache, logits = L.decode_step(p, cache, tok, cfg)
+            return (cache, logits), tok
+        (cache, logits), toks = lax.scan(body, (cache, logits), None,
+                                         length=new)
+        return toks.T
+
+    dec = jax.jit(_decode_scan)
+
+    cache, logits = pf(params, ids)               # compile + warmup
+    float(logits[0, 0])
+    t0 = _time.perf_counter()
+    cache, logits = pf(params, ids)
+    float(logits[0, 0])                           # axon-safe hard sync
+    prefill_dt = _time.perf_counter() - t0
+
+    toks = dec(params, cache, logits)             # compile + warmup
+    float(toks[0, -1])
+    cache2, logits2 = pf(params, ids)             # fresh same-shape cache
+    float(logits2[0, 0])
+    t0 = _time.perf_counter()
+    toks = dec(params, cache2, logits2)
+    float(toks[0, -1])
     dt = _time.perf_counter() - t0
     return {
         "config": f"llama_3_8b[{cfg.num_hidden_layers}L]" if on_tpu
@@ -538,6 +569,8 @@ def _decode_rung(on_tpu):
         "batch": batch, "prompt": prompt, "new_tokens": new,
         "decode_tokens_per_sec": round(batch * new / dt, 2),
         "ms_per_token": round(dt / new * 1000, 3),
+        "prefill_ms": round(prefill_dt * 1000, 1),
+        "prefill_tokens_per_sec": round(batch * prompt / prefill_dt, 2),
     }
 
 

@@ -387,14 +387,30 @@ def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
     ``pad_token_id`` (the loop itself stays static-shape: finished rows
     keep decoding, their outputs are masked). Jit once, reuse for any
     same-shape prompt."""
+    return _generate_over(
+        init_cache, prefill, decode_step, params, ids, config,
+        max_new_tokens=max_new_tokens, max_len=max_len,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, key=key)
+
+
+def _generate_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
+                   config, *, max_new_tokens: int,
+                   max_len: Optional[int] = None, temperature: float = 0.0,
+                   top_k: Optional[int] = None, top_p: Optional[float] = None,
+                   eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+                   key=None):
+    """Family-agnostic sampling loop: any model exposing the
+    (init_cache, prefill, decode_step) cache contract plugs in (same
+    precedent as _beam_search_over — one copy of the EOS/done logic)."""
     c = config
     B, S = ids.shape
     M = max_len if max_len is not None else S + max_new_tokens
     E.enforce(M >= S + max_new_tokens,
               f"max_len {M} < prompt {S} + max_new_tokens "
               f"{max_new_tokens}")
-    cache = init_cache(c, B, M)
-    cache, logits = prefill(params, ids, c, cache)
+    cache = init_cache_fn(c, B, M)
+    cache, logits = prefill_fn(params, ids, c, cache)
     sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
 
     def body(carry, k):
@@ -406,7 +422,7 @@ def generate(params, ids, config: LlamaConfig, *, max_new_tokens: int,
             done = done | (tok == eos_token_id)
         else:
             out = tok
-        cache, logits = decode_step(params, cache, tok, c)
+        cache, logits = decode_fn(params, cache, tok, c)
         return (cache, logits, done), out
 
     keys = jax.random.split(
@@ -553,8 +569,11 @@ def make_sampler(temperature: float = 0.0, *, top_k: Optional[int] = None,
     def sample(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # temperature FIRST, then filter: top-p membership is decided on
+        # the tempered distribution (the reference semantics; top-k is
+        # invariant to the order, nucleus is not)
         return jax.random.categorical(
-            k, _filter(logits) / temperature, axis=-1).astype(jnp.int32)
+            k, _filter(logits / temperature), axis=-1).astype(jnp.int32)
 
     return sample
 
@@ -837,8 +856,14 @@ class LlamaForCausalLM(nn.Layer):
         args = (self.functional_params(), jnp.asarray(arr, jnp.int32),
                 self.config)
         if num_beams > 1:
+            # the GenerationMixin-style surface accepts both kwarg sets;
+            # beam search is deterministic, so sampling knobs are
+            # silently inapplicable (reference behavior) — drop them
+            for k in ("temperature", "top_k", "top_p", "key"):
+                kw.pop(k, None)
             toks, _ = beam_search(*args, max_new_tokens=max_new_tokens,
                                   num_beams=num_beams, **kw)
         else:
+            kw.pop("length_penalty", None)   # beam-only knob
             toks = generate(*args, max_new_tokens=max_new_tokens, **kw)
         return to_tensor(np.asarray(toks))

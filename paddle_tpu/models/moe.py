@@ -436,35 +436,14 @@ def generate(params, ids, config: MoEConfig, *, max_new_tokens: int,
              eos_token_id: Optional[int] = None, pad_token_id: int = 0,
              key=None):
     """Autoregressive generation for the MoE families (greedy /
-    temperature / top-k / top-p / EOS stopping); same jit-once static
-    loop as llama.generate."""
-    from .llama import make_sampler
-    c = config
-    B, S = ids.shape
-    M = max_len if max_len is not None else S + max_new_tokens
-    E.enforce(M >= S + max_new_tokens,
-              f"max_len {M} < prompt {S} + max_new_tokens "
-              f"{max_new_tokens}")
-    cache = init_cache(c, B, M)
-    cache, logits = prefill(params, ids, c, cache)
-    sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
-
-    def body(carry, k):
-        cache, logits, done = carry
-        tok = sample(logits, k)
-        if eos_token_id is not None:
-            out = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32),
-                            tok)
-            done = done | (tok == eos_token_id)
-        else:
-            out = tok
-        cache, logits = decode_step(params, cache, tok, c)
-        return (cache, logits, done), out
-
-    keys = jax.random.split(
-        key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
-    _, toks = lax.scan(body, (cache, logits, jnp.zeros((B,), bool)), keys)
-    return toks.T
+    temperature / top-k / top-p / EOS stopping); the shared jit-once
+    static loop (llama._generate_over)."""
+    from .llama import _generate_over
+    return _generate_over(
+        init_cache, prefill, decode_step, params, ids, config,
+        max_new_tokens=max_new_tokens, max_len=max_len,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, key=key)
 
 
 def beam_search(params, ids, config: MoEConfig, *, max_new_tokens: int,

@@ -401,7 +401,8 @@ def _gather_fill_value(p, dtype):
     if dt.kind == "f":
         return np.asarray(np.nan, dt)
     if dt.kind == "b":
-        return np.asarray(False, dt)
+        # jax fills OOB bool gathers with True (lax/slicing.py)
+        return np.asarray(True, dt)
     info = np.iinfo(dt)
     return np.asarray(info.min if dt.kind == "i" else info.max, dt)
 

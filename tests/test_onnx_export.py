@@ -482,6 +482,17 @@ class TestGatherOutOfBounds:
         idx = np.asarray([1, 9], "int32")
         self._np_run(fn, [x, idx])
 
+    def test_bool_take_oob_fills_true(self):
+        import jax.numpy as jnp
+
+        # jax fills OOB bool gathers with True (lax/slicing.py)
+        def fn(x, idx):
+            return jnp.take(x, idx, axis=0)
+
+        x = np.asarray([False, False, False])
+        idx = np.asarray([0, 7], "int32")
+        self._np_run(fn, [x, idx])
+
     def test_take_clip_mode(self):
         import jax.numpy as jnp
 
