@@ -49,7 +49,7 @@ __all__ = [
     "make_train_step", "make_forward", "adamw_init", "count_params",
     "LlamaForCausalLM",
     "init_cache", "prefill", "decode_step", "generate", "make_sampler",
-    "beam_search",
+    "beam_search", "quantize_weights",
 ]
 
 
@@ -192,6 +192,63 @@ def _noc(a, spec):
     return a
 
 
+def _mm(x, w):
+    """Matmul against a weight that is either a plain array or a
+    weight-only-quantized {"q": int8 [in, out], "s": f32 [out]} dict
+    (reference: nn/quant weight_only_linear). The dequant fuses into
+    the dot under XLA, so HBM reads stay int8 — on the HBM-bound decode
+    path that halves the weight traffic."""
+    if isinstance(w, dict):
+        return x @ (w["q"].astype(x.dtype) * w["s"][None, :].astype(x.dtype))
+    return x @ w
+
+
+def _head_logits(x2d, head):
+    """lm-head logits [.., V] from hidden [.., D]; head is [V, D] (or
+    its weight-only form {"q": int8 [V, D], "s": f32 [V]})."""
+    if isinstance(head, dict):
+        w = head["q"].astype(x2d.dtype) * head["s"][:, None].astype(x2d.dtype)
+    else:
+        w = head
+    return jnp.einsum("...d,vd->...v", x2d, w,
+                      preferred_element_type=jnp.float32)
+
+
+def quantize_weights(params, weight_dtype: str = "int8"):
+    """Weight-only int8 quantization of a llama params pytree for
+    serving (reference: paddle.nn.quant.weight_quantize applied by the
+    inference pipelines). Every matmul weight — per-layer attention and
+    MLP matrices and the lm head — becomes {"q": int8, "s": f32
+    per-out-channel scale}; the embedding stays full precision (it is
+    gathered, not matmul'd; with tied embeddings it therefore also
+    serves the head in full precision). The quantized tree drops into
+    forward / prefill / decode_step / generate / beam_search unchanged."""
+    E.enforce_eq(weight_dtype, "int8",
+                 "only weight-only int8 is supported for the functional "
+                 "decode path", error=E.UnimplementedError)
+
+    def quant(w, axes):
+        wf = w.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+        s = absmax / 127.0
+        q = jnp.clip(jnp.round(wf / jnp.maximum(s, 1e-10)),
+                     -127, 127).astype(jnp.int8)
+        return q, s
+
+    out = {"embed": params["embed"], "layers": {},
+           "ln_f": params["ln_f"]}
+    for name, w in params["layers"].items():
+        if name.startswith("ln"):
+            out["layers"][name] = w
+            continue
+        q, s = quant(w, axes=1)          # [L, in, out] -> scale [L,1,out]
+        out["layers"][name] = {"q": q, "s": s[:, 0, :]}
+    if "lm_head" in params:
+        q, s = quant(params["lm_head"], axes=1)   # [V, D] -> scale [V,1]
+        out["lm_head"] = {"q": q, "s": s[:, 0]}
+    return out
+
+
 def _qkv_proj(h, lp, config: LlamaConfig, constrain=_noc):
     """Attention input projections [B,S,D] -> q/k/v head grids (no rope;
     callers position-encode: training uses the full table, decode the
@@ -200,11 +257,11 @@ def _qkv_proj(h, lp, config: LlamaConfig, constrain=_noc):
     c = config
     B, S, _ = h.shape
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
-    q = constrain((h @ lp["wq"]).reshape(B, S, nh, hd),
+    q = constrain(_mm(h, lp["wq"]).reshape(B, S, nh, hd),
                   P(("dp", "fsdp"), None, "tp", None))
-    k = constrain((h @ lp["wk"]).reshape(B, S, nkv, hd),
+    k = constrain(_mm(h, lp["wk"]).reshape(B, S, nkv, hd),
                   P(("dp", "fsdp"), None, "tp", None))
-    v = constrain((h @ lp["wv"]).reshape(B, S, nkv, hd),
+    v = constrain(_mm(h, lp["wv"]).reshape(B, S, nkv, hd),
                   P(("dp", "fsdp"), None, "tp", None))
     return q, k, v
 
@@ -213,9 +270,10 @@ def _ffn(x, lp, config: LlamaConfig, sp: bool = False, constrain=_noc):
     """Post-attention half of a decoder layer (ln2 + SwiGLU + residual)."""
     c = config
     h = _rms(x, lp["ln2"], c.rms_norm_eps)
-    g = constrain(h @ lp["gate"], P(("dp", "fsdp"), None, "tp"))
-    u = constrain(h @ lp["up"], P(("dp", "fsdp"), None, "tp"))
-    return x + constrain((jax.nn.silu(g) * u) @ lp["down"], _act_spec(sp))
+    g = constrain(_mm(h, lp["gate"]), P(("dp", "fsdp"), None, "tp"))
+    u = constrain(_mm(h, lp["up"]), P(("dp", "fsdp"), None, "tp"))
+    return x + constrain(_mm(jax.nn.silu(g) * u, lp["down"]),
+                         _act_spec(sp))
 
 
 def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
@@ -235,7 +293,7 @@ def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     # the backward pass under full remat, at 2*B*S*D bytes per layer.
     a = checkpoint_name(a, "attn_out")
     a = a.reshape(B, S, -1)
-    x = x + constrain(a @ lp["wo"], _act_spec(sp))
+    x = x + constrain(_mm(a, lp["wo"]), _act_spec(sp))
     return _ffn(x, lp, c, sp, constrain)
 
 
@@ -266,8 +324,7 @@ def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
     """Logits [B, S, V] from token ids [B, S]. Pure; jit/shard-ready."""
     x = forward_hidden(params, ids, config, sp=sp, mesh=mesh)
     # logits in float32 for a stable softmax-xent
-    return jnp.einsum("bsd,vd->bsv", x, _head(params, config),
-                      preferred_element_type=jnp.float32)
+    return _head_logits(x, _head(params, config))
 
 
 # ---------------------------------------------------------------------------
@@ -326,7 +383,7 @@ def prefill(params, ids, config: LlamaConfig, cache):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, -1)
-        x = x + a @ lp["wo"]
+        x = x + _mm(a, lp["wo"])
         return _ffn(x, lp, c), (k, v)   # cache post-rope k, raw v
 
     x, (ks, vs) = lax.scan(step, x, params["layers"])
@@ -335,8 +392,7 @@ def prefill(params, ids, config: LlamaConfig, cache):
     vc = lax.dynamic_update_slice(
         cache["v"], vs.astype(cache["v"].dtype), (0,) * 5)
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
-    logits = jnp.einsum("bd,vd->bv", x[:, -1, :], _head(params, c),
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x[:, -1, :], _head(params, c))
     return {"k": kc, "v": vc, "pos": jnp.asarray(S, jnp.int32)}, logits
 
 
@@ -363,14 +419,13 @@ def decode_step(params, cache, token, config: LlamaConfig):
         vc = lax.dynamic_update_slice_in_dim(
             vc, v.astype(vc.dtype), pos, 1)
         a = _attn_over_cache(q, kc, vc, pos)
-        x = x + a.astype(x.dtype) @ lp["wo"]
+        x = x + _mm(a.astype(x.dtype), lp["wo"])
         return _ffn(x, lp, c), (kc, vc)
 
     x, (kc, vc) = lax.scan(step, x,
                            (params["layers"], cache["k"], cache["v"]))
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
-    logits = jnp.einsum("bd,vd->bv", x[:, 0, :], _head(params, c),
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x[:, 0, :], _head(params, c))
     return {"k": kc, "v": vc, "pos": pos + 1}, logits
 
 

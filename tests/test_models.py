@@ -227,6 +227,62 @@ class TestKVCacheDecode:
             L.generate(params, ids, cfg, max_new_tokens=2, top_p=0.0)
 
 
+class TestWeightOnlyDecode:
+    """Serving with weight-only int8 weights (reference:
+    nn.quant.weight_quantize in the inference pipelines): the quantized
+    pytree drops into every functional entry point."""
+
+    def _quant_and_deq(self, seed=0):
+        cfg = tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(seed))
+        qp = L.quantize_weights(params)
+        # fp tree with the DEQUANTIZED weights: running it through the
+        # plain path must match the quantized path bit-for-bit (proves
+        # the _mm routing computes exactly dequant-then-matmul)
+        deq = {"embed": params["embed"], "ln_f": params["ln_f"],
+               "layers": {}}
+        for k, w in qp["layers"].items():
+            if isinstance(w, dict):
+                deq["layers"][k] = (w["q"].astype(jnp.float32)
+                                    * w["s"][:, None, :])
+            else:
+                deq["layers"][k] = w
+        deq["lm_head"] = (qp["lm_head"]["q"].astype(jnp.float32)
+                          * qp["lm_head"]["s"][:, None])
+        return cfg, params, qp, deq
+
+    def test_quantized_forward_equals_dequantized(self):
+        cfg, _, qp, deq = self._quant_and_deq()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 9)), jnp.int32)
+        a = L.forward(qp, ids, cfg)
+        b = L.forward(deq, ids, cfg)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_quantized_logits_close_to_fp(self):
+        cfg, params, qp, _ = self._quant_and_deq(seed=1)
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (2, 9)), jnp.int32)
+        fp = np.asarray(L.forward(params, ids, cfg))
+        q = np.asarray(L.forward(qp, ids, cfg))
+        # per-channel int8 keeps logits close on a tiny random model
+        denom = np.maximum(np.abs(fp).max(), 1e-6)
+        assert np.abs(q - fp).max() / denom < 0.05
+
+    def test_quantized_generate_and_beam(self):
+        cfg, _, qp, deq = self._quant_and_deq(seed=2)
+        ids = jnp.asarray(np.random.default_rng(2).integers(
+            0, cfg.vocab_size, (2, 6)), jnp.int32)
+        gq = np.asarray(L.generate(qp, ids, cfg, max_new_tokens=4))
+        gd = np.asarray(L.generate(deq, ids, cfg, max_new_tokens=4))
+        np.testing.assert_array_equal(gq, gd)
+        bq, _ = L.beam_search(qp, ids, cfg, max_new_tokens=3, num_beams=2)
+        bd, _ = L.beam_search(deq, ids, cfg, max_new_tokens=3,
+                              num_beams=2)
+        np.testing.assert_array_equal(np.asarray(bq), np.asarray(bd))
+
+
 class TestFunctionalLlama:
     def test_forward_shapes_gqa(self):
         cfg = tiny()
