@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .llama import _rms, apply_rope, remat_policy
+from .llama import (_head_logits, _mm, _rms, apply_rope,
+                    remat_policy)
 from ..core import enforce as E
 from ..nn.functional.attention import rope_tables as _rope_tables, sdpa_raw
 
@@ -45,7 +46,7 @@ __all__ = [
     "ernie_4_5_a3b", "init_params", "forward", "forward_hidden", "loss_fn",
     "param_specs", "make_train_step", "count_params", "adamw_init",
     "moe_capacity", "init_cache", "prefill", "decode_step", "generate",
-    "beam_search",
+    "beam_search", "quantize_weights",
 ]
 
 
@@ -179,6 +180,50 @@ def init_params(config: MoEConfig, key) -> Dict[str, Any]:
 # MoE block
 # ---------------------------------------------------------------------------
 
+def _edeq(w, dtype):
+    """Expert-grid weight for the batched einsums: plain array, or the
+    weight-only form {"q": int8 [E, in, out], "s": f32 [E, out]}
+    dequantized into the einsum (the convert fuses under XLA, so HBM
+    reads stay int8 — same seam as llama's _mm)."""
+    if isinstance(w, dict):
+        return w["q"].astype(dtype) * w["s"][:, None, :].astype(dtype)
+    return w
+
+
+def quantize_weights(params, weight_dtype: str = "int8"):
+    """Weight-only int8 quantization of a MoE params pytree for serving
+    (see llama.quantize_weights). Attention, shared-expert, per-expert
+    grids, and the lm head quantize per out-channel; the router stays
+    float32 (routing logits are precision-sensitive) and the embedding
+    stays full precision (gathered, not matmul'd)."""
+    E.enforce_eq(weight_dtype, "int8",
+                 "only weight-only int8 is supported for the functional "
+                 "decode path", error=E.UnimplementedError)
+
+    def quant(w, axis):
+        wf = w.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+        s = absmax / 127.0
+        q = jnp.clip(jnp.round(wf / jnp.maximum(s, 1e-10)),
+                     -127, 127).astype(jnp.int8)
+        return q, jnp.squeeze(s, axis)
+
+    out = {"embed": params["embed"], "ln_f": params["ln_f"],
+           "lm_head": None, "layers": {}}
+    for name, w in params["layers"].items():
+        if name.startswith("ln") or name == "router":
+            out["layers"][name] = w
+        elif name.startswith("e_"):            # [L, E, in, out]
+            q, s = quant(w, axis=2)
+            out["layers"][name] = {"q": q, "s": s}     # s: [L, E, out]
+        else:                                  # [L, in, out]
+            q, s = quant(w, axis=1)
+            out["layers"][name] = {"q": q, "s": s}     # s: [L, out]
+    q, s = quant(params["lm_head"], axis=1)            # [V, D] -> [V]
+    out["lm_head"] = {"q": q, "s": s}
+    return out
+
+
 def moe_capacity(config: MoEConfig, n_tokens: int) -> int:
     """Per-expert slot count: ceil(T*k/E * factor), lane-aligned (128)."""
     c = config
@@ -207,9 +252,10 @@ def _route(x, lp, config: MoEConfig):
 
 def _expert_ffn(xe, lp):
     """Batched per-expert SwiGLU on [E, C|T, D] slot grids."""
-    g = jnp.einsum("ecd,edf->ecf", xe, lp["e_gate"])
-    u = jnp.einsum("ecd,edf->ecf", xe, lp["e_up"])
-    return jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, lp["e_down"])
+    g = jnp.einsum("ecd,edf->ecf", xe, _edeq(lp["e_gate"], xe.dtype))
+    u = jnp.einsum("ecd,edf->ecf", xe, _edeq(lp["e_up"], xe.dtype))
+    return jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u,
+                      _edeq(lp["e_down"], xe.dtype))
 
 
 def _moe_mlp_capacity(x, lp, config: MoEConfig, T):
@@ -289,9 +335,9 @@ def _moe_mlp(h, lp, config: MoEConfig, mesh):
     else:
         routed, aux = _moe_mlp_dense(x, lp, c, T, mesh)
 
-    sg = x @ lp["s_gate"]
-    su = x @ lp["s_up"]
-    shared = (jax.nn.silu(sg) * su) @ lp["s_down"]
+    sg = _mm(x, lp["s_gate"])
+    su = _mm(x, lp["s_up"])
+    shared = _mm(jax.nn.silu(sg) * su, lp["s_down"])
 
     return (routed + shared).reshape(B, S, D).astype(h.dtype), aux
 
@@ -302,13 +348,13 @@ def _block(x, lp, cos, sin, config: MoEConfig, mesh):
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
 
     h = _rms(x, lp["ln1"], c.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
-    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
-    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    q = _mm(h, lp["wq"]).reshape(B, S, nh, hd)
+    k = _mm(h, lp["wk"]).reshape(B, S, nkv, hd)
+    v = _mm(h, lp["wv"]).reshape(B, S, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, nh * hd)
-    x = x + a @ lp["wo"]
+    x = x + _mm(a, lp["wo"])
 
     h = _rms(x, lp["ln2"], c.rms_norm_eps)
     moe_out, aux = _moe_mlp(h, lp, c, mesh)
@@ -337,8 +383,7 @@ def forward(params, ids, config: MoEConfig, *,
             mesh: Optional[Mesh] = None):
     """Returns (logits [B,S,V], aux_loss scalar)."""
     x, aux = forward_hidden(params, ids, config, mesh=mesh)
-    logits = jnp.einsum("bsd,vd->bsv", x, params["lm_head"],
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x, params["lm_head"])
     return logits, aux
 
 
@@ -372,7 +417,7 @@ def prefill(params, ids, config: MoEConfig, cache):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, -1)
-        x = x + a @ lp["wo"]
+        x = x + _mm(a, lp["wo"])
         h2 = _rms(x, lp["ln2"], c.rms_norm_eps)
         out, _ = _moe_mlp(h2, lp, c, None)
         return x + out, (k, v)
@@ -383,8 +428,7 @@ def prefill(params, ids, config: MoEConfig, cache):
     vc = lax.dynamic_update_slice(
         cache["v"], vs.astype(cache["v"].dtype), (0,) * 5)
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
-    logits = jnp.einsum("bd,vd->bv", x[:, -1, :], params["lm_head"],
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x[:, -1, :], params["lm_head"])
     return {"k": kc, "v": vc, "pos": jnp.asarray(S, jnp.int32)}, logits
 
 
@@ -417,7 +461,7 @@ def decode_step(params, cache, token, config: MoEConfig):
         vc = lax.dynamic_update_slice_in_dim(
             vc, v.astype(vc.dtype), pos, 1)
         a = _attn_over_cache(q, kc, vc, pos)
-        x = x + a.astype(x.dtype) @ lp["wo"]
+        x = x + _mm(a.astype(x.dtype), lp["wo"])
         h2 = _rms(x, lp["ln2"], c.rms_norm_eps)
         out, _ = _moe_mlp(h2, lp, c, None)
         return x + out, (kc, vc)
@@ -425,8 +469,7 @@ def decode_step(params, cache, token, config: MoEConfig):
     x, (kc, vc) = lax.scan(step, x,
                            (params["layers"], cache["k"], cache["v"]))
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
-    logits = jnp.einsum("bd,vd->bv", x[:, 0, :], params["lm_head"],
-                        preferred_element_type=jnp.float32)
+    logits = _head_logits(x[:, 0, :], params["lm_head"])
     return {"k": kc, "v": vc, "pos": pos + 1}, logits
 
 

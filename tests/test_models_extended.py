@@ -188,6 +188,33 @@ class TestMoECapacityDispatch:
         np.testing.assert_array_equal(np.asarray(got),
                                       np.stack(want, axis=1))
 
+    def test_weight_only_int8_decode(self):
+        # quantized tree == dequantized-fp tree through forward AND the
+        # decode loop (same bit-exact contract as the llama family)
+        cfg = moe.moe_tiny()
+        params = moe.init_params(cfg, jax.random.key(8))
+        qp = moe.quantize_weights(params)
+        deq = {"embed": params["embed"], "ln_f": params["ln_f"],
+               "layers": {}}
+        for k, w in qp["layers"].items():
+            if isinstance(w, dict):
+                s = w["s"]
+                br = s[:, :, None, :] if w["q"].ndim == 4 else s[:, None, :]
+                deq["layers"][k] = w["q"].astype(jnp.float32) * br
+            else:
+                deq["layers"][k] = w
+        deq["lm_head"] = (qp["lm_head"]["q"].astype(jnp.float32)
+                          * qp["lm_head"]["s"][:, None])
+        ids = jnp.asarray(np.random.default_rng(8).integers(
+            0, cfg.vocab_size, (2, 7)), jnp.int32)
+        la, _ = moe.forward(qp, ids, cfg)
+        lb, _ = moe.forward(deq, ids, cfg)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-6, atol=1e-6)
+        ga = np.asarray(moe.generate(qp, ids, cfg, max_new_tokens=3))
+        gb = np.asarray(moe.generate(deq, ids, cfg, max_new_tokens=3))
+        np.testing.assert_array_equal(ga, gb)
+
     def test_dots_remat_policy_compiles(self):
         cfg = moe.moe_tiny(dispatch_mode="capacity", remat=True,
                            remat_policy="dots")
