@@ -227,26 +227,31 @@ def quantize_weights(params, weight_dtype: str = "int8"):
                  "only weight-only int8 is supported for the functional "
                  "decode path", error=E.UnimplementedError)
 
-    def quant(w, axes):
-        wf = w.astype(jnp.float32)
-        absmax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
-        s = absmax / 127.0
-        q = jnp.clip(jnp.round(wf / jnp.maximum(s, 1e-10)),
-                     -127, 127).astype(jnp.int8)
-        return q, s
-
     out = {"embed": params["embed"], "layers": {},
            "ln_f": params["ln_f"]}
     for name, w in params["layers"].items():
         if name.startswith("ln"):
             out["layers"][name] = w
             continue
-        q, s = quant(w, axes=1)          # [L, in, out] -> scale [L,1,out]
-        out["layers"][name] = {"q": q, "s": s[:, 0, :]}
+        out["layers"][name] = quant_int8(w, in_axis=1)  # [L, in, out]
     if "lm_head" in params:
-        q, s = quant(params["lm_head"], axes=1)   # [V, D] -> scale [V,1]
-        out["lm_head"] = {"q": q, "s": s[:, 0]}
+        out["lm_head"] = quant_int8(params["lm_head"], in_axis=1)
     return out
+
+
+def quant_int8(w, in_axis: int):
+    """Per-out-channel absmax int8 quantization of a stacked weight:
+    the ONE scheme definition every family's quantize_weights and every
+    dequant seam (_mm / _edeq / _head_logits) must agree on for the
+    quantized-vs-dequantized bit-exact contract. Reduces |w| over
+    ``in_axis`` (the contraction dim); returns {"q": int8, "s": f32
+    with the reduced axis dropped}."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=in_axis, keepdims=True)
+    s = absmax / 127.0
+    q = jnp.clip(jnp.round(wf / jnp.maximum(s, 1e-10)),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "s": jnp.squeeze(s, in_axis)}
 
 
 def _qkv_proj(h, lp, config: LlamaConfig, constrain=_noc):

@@ -199,28 +199,18 @@ def quantize_weights(params, weight_dtype: str = "int8"):
     E.enforce_eq(weight_dtype, "int8",
                  "only weight-only int8 is supported for the functional "
                  "decode path", error=E.UnimplementedError)
-
-    def quant(w, axis):
-        wf = w.astype(jnp.float32)
-        absmax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
-        s = absmax / 127.0
-        q = jnp.clip(jnp.round(wf / jnp.maximum(s, 1e-10)),
-                     -127, 127).astype(jnp.int8)
-        return q, jnp.squeeze(s, axis)
+    from .llama import quant_int8   # the one scheme definition
 
     out = {"embed": params["embed"], "ln_f": params["ln_f"],
-           "lm_head": None, "layers": {}}
+           "layers": {}}
     for name, w in params["layers"].items():
         if name.startswith("ln") or name == "router":
             out["layers"][name] = w
         elif name.startswith("e_"):            # [L, E, in, out]
-            q, s = quant(w, axis=2)
-            out["layers"][name] = {"q": q, "s": s}     # s: [L, E, out]
+            out["layers"][name] = quant_int8(w, in_axis=2)
         else:                                  # [L, in, out]
-            q, s = quant(w, axis=1)
-            out["layers"][name] = {"q": q, "s": s}     # s: [L, out]
-    q, s = quant(params["lm_head"], axis=1)            # [V, D] -> [V]
-    out["lm_head"] = {"q": q, "s": s}
+            out["layers"][name] = quant_int8(w, in_axis=1)
+    out["lm_head"] = quant_int8(params["lm_head"], in_axis=1)
     return out
 
 

@@ -190,8 +190,9 @@ class TestMoECapacityDispatch:
 
     def test_weight_only_int8_decode(self):
         # quantized tree == dequantized-fp tree through forward AND the
-        # decode loop (same bit-exact contract as the llama family)
-        cfg = moe.moe_tiny()
+        # decode loop (same bit-exact contract as the llama family) —
+        # under capacity dispatch, the measured on-chip configuration
+        cfg = moe.moe_tiny(dispatch_mode="capacity")
         params = moe.init_params(cfg, jax.random.key(8))
         qp = moe.quantize_weights(params)
         deq = {"embed": params["embed"], "ln_f": params["ln_f"],
