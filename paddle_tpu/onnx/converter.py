@@ -11,9 +11,10 @@ the public ONNX schema (onnx.proto here); tests validate exports by
 parsing them back and EXECUTING the graph with a numpy interpreter
 against the eager model (no onnx package exists in this environment).
 
-Scope: inference graphs (eval-mode layers). Control-flow primitives
-(scan/while/cond) and TPU-kernel paths (pallas flash attention) are out
-of scope — export with the XLA fallback dispatchers active.
+Scope: inference graphs (eval-mode layers). `scan` converts (unrolled
+or as an ONNX Loop), `cond`/`switch` as (nested) ONNX If subgraphs;
+`while_loop` and TPU-kernel paths (pallas flash attention) are out of
+scope — export with the XLA fallback dispatchers active.
 """
 from __future__ import annotations
 
@@ -830,6 +831,78 @@ def _scan_loop(ctx, eqn):
     ctx.emit("Loop", [trip, cond0] + carry_init, outs, body=body)
 
 
+@_handler("cond")
+def _cond(ctx, eqn):
+    """lax.cond / lax.switch -> ONNX ``If`` (nested for >2 branches).
+
+    Each branch jaxpr becomes a subgraph reading the shared operands
+    from the enclosing scope by name (the same outer-scope convention
+    the Loop body uses); jax guarantees the branch index is clamped to
+    [0, n), so an equality chain with branches[-1] as the final else is
+    exhaustive."""
+    branches = eqn.params["branches"]
+    operands = [ctx.name_of(v) for v in eqn.invars[1:]]
+    n = len(branches)
+    idx64 = ctx.fresh("cond_idx")
+    ctx.emit("Cast", [_in(ctx, eqn, 0)], [idx64], to=P.TensorProto.INT64)
+
+    def branch_graph(closed):
+        """Subgraph computing one branch from outer-scope operands."""
+        inner, consts = closed.jaxpr, closed.consts
+        g = P.GraphProto(name=ctx.fresh("branch"))
+        saved_nodes, ctx.nodes = ctx.nodes, []
+        saved_names, ctx.names = ctx.names, dict(ctx.names)
+        for cv, cval in zip(inner.constvars, consts):
+            ctx.names[cv] = ctx.add_const(np.asarray(cval))
+        for iv, nm in zip(inner.invars, operands):
+            ctx.names[iv] = nm
+        _walk(ctx, inner)
+        outs = []
+        for ov in inner.outvars:
+            nm = ctx.fresh("branch_out")   # fresh: Literal/passthrough
+            ctx.emit("Identity", [ctx.name_of(ov)], [nm])
+            outs.append(nm)
+        nodes, ctx.nodes = ctx.nodes, saved_nodes
+        ctx.names = saved_names
+        g.node.extend(nodes)
+        for nm, ov in zip(outs, inner.outvars):
+            vi = g.output.add(name=nm)
+            tt = vi.type.tensor_type
+            tt.elem_type = _onnx_dtype(ov.aval.dtype)
+            for d in ov.aval.shape:
+                tt.shape.dim.add(dim_value=int(d))
+        return g
+
+    def chain_graph(k):
+        """Subgraph selecting among branches[k:] (k >= 1)."""
+        if k == n - 1:
+            return branch_graph(branches[k])
+        g = P.GraphProto(name=ctx.fresh("sel"))
+        saved_nodes, ctx.nodes = ctx.nodes, []
+        cmp = ctx.fresh("is_k")
+        ctx.emit("Equal", [idx64, ctx.add_const(np.asarray(k, np.int64))],
+                 [cmp])
+        outs = [ctx.fresh("sel_out") for _ in eqn.outvars]
+        ctx.emit("If", [cmp], outs, then_branch=branch_graph(branches[k]),
+                 else_branch=chain_graph(k + 1))
+        nodes, ctx.nodes = ctx.nodes, saved_nodes
+        g.node.extend(nodes)
+        for nm, ov in zip(outs, eqn.outvars):
+            vi = g.output.add(name=nm)
+            tt = vi.type.tensor_type
+            tt.elem_type = _onnx_dtype(ov.aval.dtype)
+            for d in ov.aval.shape:
+                tt.shape.dim.add(dim_value=int(d))
+        return g
+
+    is0 = ctx.fresh("is_0")
+    ctx.emit("Equal", [idx64, ctx.add_const(np.asarray(0, np.int64))],
+             [is0])
+    ctx.emit("If", [is0], [ctx.name_of(ov) for ov in eqn.outvars],
+             then_branch=branch_graph(branches[0]),
+             else_branch=chain_graph(1))
+
+
 @_handler("pjit", "jit", "closed_call", "custom_jvp_call",
           "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
           "checkpoint", "custom_gradient")
@@ -867,7 +940,7 @@ def _walk(ctx: _Ctx, jaxpr):
         raise E.UnimplementedError(
             f"ONNX export: primitive '{prim}' has no converter "
             f"(supported: {sorted(set(_SIMPLE) | set(_HANDLERS))})",
-            hint="control flow (scan/cond) and TPU-kernel paths are "
+            hint="while_loop and TPU-kernel (pallas) paths are "
                  "out of ONNX-export scope; use jit.save (StableHLO) "
                  "for full-fidelity deployment")
 

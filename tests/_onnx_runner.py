@@ -257,6 +257,13 @@ def _exec_nodes(g, env):
             idx = np.take(order, range(k), axis=axis)
             r = (np.take_along_axis(i[0], idx, axis=axis),
                  idx.astype(np.int64))
+        elif op == "If":
+            branch = a["then_branch"] if bool(i[0]) else a["else_branch"]
+            benv = dict(env)   # subgraphs read enclosing-graph names
+            for bt in branch.initializer:
+                benv[bt.name] = tensor_to_np(bt)
+            _exec_nodes(branch, benv)
+            r = tuple(benv[vi.name] for vi in branch.output)
         elif op == "Loop":
             body = a["body"]
             trip, cond = int(i[0]), bool(i[1])

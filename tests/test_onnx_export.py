@@ -443,6 +443,64 @@ class TestGeneralGathers:
         np.testing.assert_allclose(got, x[ij[:, 0], ij[:, 1]])
 
 
+class TestCondExport:
+    """lax.cond / lax.switch -> ONNX If: one exported model serves both
+    branch outcomes (previously a documented fallback-to-StableHLO)."""
+
+    def _np_run(self, fn, args):
+        m = P.ModelProto.FromString(
+            to_onnx_model(fn, args).SerializeToString())
+        got = run(m, args)
+        want = fn(*args)
+        want = [np.asarray(w) for w in
+                (want if isinstance(want, (list, tuple)) else [want])]
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+        return m
+
+    def test_cond_both_outcomes_one_model(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x, flag):
+            return lax.cond(flag[0] > 0,
+                            lambda x: x * 2.0 + 1.0,
+                            lambda x: x - 3.0, x)
+
+        x = np.random.default_rng(0).normal(size=(2, 3)).astype("float32")
+        m = self._np_run(fn, [x, np.asarray([1], "int32")])
+        assert any(n.op_type == "If" for n in m.graph.node)
+        self._np_run(fn, [x, np.asarray([-1], "int32")])
+
+    def test_switch_three_branches(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x, idx):
+            return lax.switch(jnp.clip(idx[0], 0, 2),
+                              [lambda x: x + 1.0,
+                               lambda x: x * 10.0,
+                               lambda x: -x], x)
+
+        x = np.random.default_rng(1).normal(size=(4,)).astype("float32")
+        for k in (0, 1, 2):
+            self._np_run(fn, [x, np.asarray([k], "int32")])
+
+    def test_cond_multi_operand_multi_output(self):
+        from jax import lax
+
+        def fn(x, y, flag):
+            return lax.cond(flag[0] > 0,
+                            lambda x, y: (x + y, x @ y.T),
+                            lambda x, y: (x - y, y @ x.T), x, y)
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 3)).astype("float32")
+        y = rng.normal(size=(2, 3)).astype("float32")
+        for f in (1, 0):
+            self._np_run(fn, [x, y, np.asarray([f], "int32")])
+
+
 class TestGatherOutOfBounds:
     """jax's FILL_OR_DROP/CLIP gather modes must survive export: ONNX
     Gather* wraps negatives python-style and rejects true OOB, so the
