@@ -11,10 +11,11 @@ the public ONNX schema (onnx.proto here); tests validate exports by
 parsing them back and EXECUTING the graph with a numpy interpreter
 against the eager model (no onnx package exists in this environment).
 
-Scope: inference graphs (eval-mode layers). `scan` converts (unrolled
-or as an ONNX Loop), `cond`/`switch` as (nested) ONNX If subgraphs;
-`while_loop` and TPU-kernel paths (pallas flash attention) are out of
-scope — export with the XLA fallback dispatchers active.
+Scope: inference graphs (eval-mode layers). Control flow converts —
+`scan` (unrolled or ONNX Loop), `cond`/`switch` (nested ONNX If),
+`while_loop` (condition-driven Loop); TPU-kernel paths (pallas flash
+attention) are out of scope — export with the XLA fallback dispatchers
+active.
 """
 from __future__ import annotations
 
@@ -903,6 +904,89 @@ def _cond(ctx, eqn):
              else_branch=chain_graph(1))
 
 
+def _walk_closed(ctx, closed, in_names):
+    """Walk a ClosedJaxpr's eqns into the CURRENT node list with its
+    invars bound to ``in_names``; returns the outvar names."""
+    inner, consts = closed.jaxpr, closed.consts
+    for cv, cval in zip(inner.constvars, consts):
+        ctx.names[cv] = ctx.add_const(np.asarray(cval))
+    for iv, nm in zip(inner.invars, in_names):
+        ctx.names[iv] = nm
+    _walk(ctx, inner)
+    return [ctx.name_of(ov) for ov in inner.outvars]
+
+
+@_handler("while")
+def _while(ctx, eqn):
+    """lax.while_loop -> ONNX ``Loop`` (condition-driven; no trip count).
+
+    ONNX Loop gates each iteration on the incoming condition and the
+    body emits the NEXT condition, while jax checks the condition
+    before the first iteration too — so the initial condition is
+    computed in the OUTER graph from the init carry, and the body
+    re-evaluates the cond jaxpr on its updated carry. Semantics match
+    exactly (zero-iteration loops return the init carry)."""
+    p = eqn.params
+    ncc, nbc = int(p["cond_nconsts"]), int(p["body_nconsts"])
+    cond_consts = [ctx.name_of(v) for v in eqn.invars[:ncc]]
+    body_consts = [ctx.name_of(v) for v in eqn.invars[ncc:ncc + nbc]]
+    carry_vars = eqn.invars[ncc + nbc:]
+    carry_init = [ctx.name_of(v) for v in carry_vars]
+
+    # initial condition from the init carry, in the outer graph
+    saved_names, ctx.names = ctx.names, dict(ctx.names)
+    (cond0,) = _walk_closed(ctx, p["cond_jaxpr"],
+                            cond_consts + carry_init)
+    ctx.names = saved_names
+
+    body = P.GraphProto(name=ctx.fresh("while_body"))
+    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond_in")
+    vi = body.input.add(name=iter_nm)
+    vi.type.tensor_type.elem_type = P.TensorProto.INT64
+    vi = body.input.add(name=cond_nm)
+    vi.type.tensor_type.elem_type = P.TensorProto.BOOL
+    body_carry = []
+    for cv in carry_vars:
+        nm = ctx.fresh("loop_c")
+        body_carry.append(nm)
+        vi = body.input.add(name=nm)
+        tt = vi.type.tensor_type
+        tt.elem_type = _onnx_dtype(cv.aval.dtype)
+        for d in cv.aval.shape:
+            tt.shape.dim.add(dim_value=int(d))
+
+    saved_nodes, ctx.nodes = ctx.nodes, []
+    saved_names, ctx.names = ctx.names, dict(ctx.names)
+    new_carry = _walk_closed(ctx, p["body_jaxpr"],
+                             body_consts + body_carry)
+    carry_out = []
+    for nm in new_carry:   # fresh names: passthrough/Literal outvars
+        out = ctx.fresh("carry_out")
+        ctx.emit("Identity", [nm], [out])
+        carry_out.append(out)
+    (cond_next,) = _walk_closed(ctx, p["cond_jaxpr"],
+                                cond_consts + carry_out)
+    cond_out = ctx.fresh("cond_out")
+    ctx.emit("Identity", [cond_next], [cond_out])
+    body_nodes, ctx.nodes = ctx.nodes, saved_nodes
+    ctx.names = saved_names
+    body.node.extend(body_nodes)
+
+    vi = body.output.add(name=cond_out)
+    vi.type.tensor_type.elem_type = P.TensorProto.BOOL
+    for nm, cv in zip(carry_out, carry_vars):
+        vi = body.output.add(name=nm)
+        tt = vi.type.tensor_type
+        tt.elem_type = _onnx_dtype(cv.aval.dtype)
+        for d in cv.aval.shape:
+            tt.shape.dim.add(dim_value=int(d))
+
+    trip = ctx.add_const(np.asarray(np.iinfo(np.int64).max, np.int64),
+                         "trip")
+    ctx.emit("Loop", [trip, cond0] + carry_init,
+             [ctx.name_of(ov) for ov in eqn.outvars], body=body)
+
+
 @_handler("pjit", "jit", "closed_call", "custom_jvp_call",
           "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
           "checkpoint", "custom_gradient")
@@ -940,7 +1024,7 @@ def _walk(ctx: _Ctx, jaxpr):
         raise E.UnimplementedError(
             f"ONNX export: primitive '{prim}' has no converter "
             f"(supported: {sorted(set(_SIMPLE) | set(_HANDLERS))})",
-            hint="while_loop and TPU-kernel (pallas) paths are "
+            hint="TPU-kernel (pallas) paths are "
                  "out of ONNX-export scope; use jit.save (StableHLO) "
                  "for full-fidelity deployment")
 

@@ -501,6 +501,60 @@ class TestCondExport:
             self._np_run(fn, [x, y, np.asarray([f], "int32")])
 
 
+class TestWhileExport:
+    """lax.while_loop -> condition-driven ONNX Loop (the last
+    control-flow primitive; jax's check-before-first-iteration maps by
+    evaluating the condition on the init carry in the outer graph)."""
+
+    def _np_run(self, fn, args):
+        m = P.ModelProto.FromString(
+            to_onnx_model(fn, args).SerializeToString())
+        got = run(m, args)
+        want = fn(*args)
+        want = [np.asarray(w) for w in
+                (want if isinstance(want, (list, tuple)) else [want])]
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-6)
+        return m
+
+    def test_data_dependent_trip_count(self):
+        from jax import lax
+
+        def fn(x):
+            # double until >= 100: trip count depends on the input value
+            return lax.while_loop(lambda c: c < 100.0,
+                                  lambda c: c * 2.0, x[0])
+
+        m = self._np_run(fn, [np.asarray([3.0], "float32")])
+        assert any(n.op_type == "Loop" for n in m.graph.node)
+        self._np_run(fn, [np.asarray([1.5], "float32")])
+
+    def test_zero_iterations_returns_init(self):
+        from jax import lax
+
+        def fn(x):
+            return lax.while_loop(lambda c: c < 0.0,
+                                  lambda c: c - 1.0, x[0])
+
+        self._np_run(fn, [np.asarray([7.0], "float32")])
+
+    def test_tuple_carry_and_consts(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x, step):
+            def body(c):
+                i, acc = c
+                return i + 1, acc + step[0] * i.astype(jnp.float32)
+
+            i, acc = lax.while_loop(lambda c: c[0] < 5,
+                                    body, (jnp.int32(0), x[0]))
+            return acc
+
+        self._np_run(fn, [np.asarray([0.5], "float32"),
+                          np.asarray([2.0], "float32")])
+
+
 class TestGatherOutOfBounds:
     """jax's FILL_OR_DROP/CLIP gather modes must survive export: ONNX
     Gather* wraps negatives python-style and rejects true OOB, so the
