@@ -198,11 +198,26 @@ def _compare(ctx, eqn):
 
 @_handler("select_n")
 def _select_n(ctx, eqn):
-    E.enforce_eq(len(eqn.invars), 3, "select_n with >2 cases",
-                 error=E.UnimplementedError)
-    pred, a, b = _in(ctx, eqn)
-    # select_n(pred, a, b): pred==True picks b -> Where(pred, b, a)
-    ctx.emit("Where", [pred, b, a], [_out(ctx, eqn)])
+    names = _in(ctx, eqn)
+    if len(eqn.invars) == 3 and eqn.invars[0].aval.dtype == np.bool_:
+        pred, a, b = names
+        # select_n(pred, a, b): pred==True picks b -> Where(pred, b, a)
+        ctx.emit("Where", [pred, b, a], [_out(ctx, eqn)])
+        return
+    # integer selector with n cases: fold a Where chain over
+    # Equal(idx, k) masks (jax clamps the selector into range, so the
+    # last case is the exhaustive default)
+    idx, cases = names[0], names[1:]
+    idx64 = ctx.fresh("sel_idx")
+    ctx.emit("Cast", [idx], [idx64], to=P.TensorProto.INT64)
+    acc = cases[-1]
+    for k in range(len(cases) - 2, -1, -1):
+        m = ctx.fresh("sel_eq")
+        ctx.emit("Equal", [idx64, ctx.add_const(np.asarray(k, np.int64))],
+                 [m])
+        nxt = ctx.fresh("sel_acc") if k else _out(ctx, eqn)
+        ctx.emit("Where", [m, cases[k], acc], [nxt])
+        acc = nxt
 
 
 @_handler("convert_element_type")

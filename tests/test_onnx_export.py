@@ -538,6 +538,18 @@ class TestWhileExport:
 
         self._np_run(fn, [np.asarray([7.0], "float32")])
 
+    def test_select_n_integer_cases(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x, i):
+            return lax.select_n(jnp.clip(i[0], 0, 2),
+                                x + 1.0, x * 2.0, -x)
+
+        x = np.random.default_rng(3).normal(size=(3,)).astype("float32")
+        for k in (0, 1, 2):
+            self._np_run(fn, [x, np.asarray([k], "int32")])
+
     def test_tuple_carry_and_consts(self):
         import jax.numpy as jnp
         from jax import lax
