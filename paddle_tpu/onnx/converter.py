@@ -208,6 +208,9 @@ def _select_n(ctx, eqn):
     # Equal(idx, k) masks (jax clamps the selector into range, so the
     # last case is the exhaustive default)
     idx, cases = names[0], names[1:]
+    if len(cases) == 1:   # degenerate: the clamp leaves one choice
+        ctx.emit("Identity", [cases[0]], [_out(ctx, eqn)])
+        return
     idx64 = ctx.fresh("sel_idx")
     ctx.emit("Cast", [idx], [idx64], to=P.TensorProto.INT64)
     acc = cases[-1]
@@ -756,6 +759,16 @@ def _scan(ctx, eqn):
             ctx.emit("Concat", parts, [ctx.name_of(y_out)], axis=0)
 
 
+def _add_vi(field, name, dtype, shape):
+    """Append a typed ValueInfo (subgraph input/output declaration)."""
+    vi = field.add(name=name)
+    tt = vi.type.tensor_type
+    tt.elem_type = _onnx_dtype(dtype)
+    for d in shape:
+        tt.shape.dim.add(dim_value=int(d))
+    return vi
+
+
 def _scan_loop(ctx, eqn):
     """Emit scan as an ONNX ``Loop``: the body jaxpr becomes a subgraph
     that gathers iteration ``i`` of each scanned input (subgraphs read
@@ -788,11 +801,8 @@ def _scan_loop(ctx, eqn):
     for cv in carry_vars:
         nm = ctx.fresh("loop_c")
         body_carry.append(nm)
-        vi = body.input.add(name=nm)
-        tt = vi.type.tensor_type
-        tt.elem_type = _onnx_dtype(cv.aval.dtype)
-        for d in cv.aval.shape:
-            tt.shape.dim.add(dim_value=int(d))
+        _add_vi(body.input, nm, cv.aval.dtype,
+                cv.aval.shape)
 
     # body nodes collect into a swapped-in list; names stay shared (the
     # fresh-name counter must keep advancing so body/outer never collide)
@@ -829,17 +839,11 @@ def _scan_loop(ctx, eqn):
     vi = body.output.add(name=cond_out)
     vi.type.tensor_type.elem_type = P.TensorProto.BOOL
     for nm, ov in zip(carry_out, inner.outvars[:n_carry]):
-        vi = body.output.add(name=nm)
-        tt = vi.type.tensor_type
-        tt.elem_type = _onnx_dtype(ov.aval.dtype)
-        for d in ov.aval.shape:
-            tt.shape.dim.add(dim_value=int(d))
+        _add_vi(body.output, nm, ov.aval.dtype,
+                ov.aval.shape)
     for nm, ov in zip(ys_out, inner.outvars[n_carry:]):
-        vi = body.output.add(name=nm)
-        tt = vi.type.tensor_type
-        tt.elem_type = _onnx_dtype(ov.aval.dtype)
-        for d in ov.aval.shape:   # PER-ITERATION shape; Loop stacks
-            tt.shape.dim.add(dim_value=int(d))
+        _add_vi(body.output, nm, ov.aval.dtype,
+                ov.aval.shape)
 
     trip = ctx.add_const(np.asarray(length, np.int64), "trip")
     cond0 = ctx.add_const(np.asarray(True), "cond")
@@ -864,29 +868,21 @@ def _cond(ctx, eqn):
 
     def branch_graph(closed):
         """Subgraph computing one branch from outer-scope operands."""
-        inner, consts = closed.jaxpr, closed.consts
+        inner = closed.jaxpr
         g = P.GraphProto(name=ctx.fresh("branch"))
         saved_nodes, ctx.nodes = ctx.nodes, []
         saved_names, ctx.names = ctx.names, dict(ctx.names)
-        for cv, cval in zip(inner.constvars, consts):
-            ctx.names[cv] = ctx.add_const(np.asarray(cval))
-        for iv, nm in zip(inner.invars, operands):
-            ctx.names[iv] = nm
-        _walk(ctx, inner)
+        raw = _walk_closed(ctx, closed, operands)
         outs = []
-        for ov in inner.outvars:
-            nm = ctx.fresh("branch_out")   # fresh: Literal/passthrough
-            ctx.emit("Identity", [ctx.name_of(ov)], [nm])
-            outs.append(nm)
+        for nm in raw:
+            out = ctx.fresh("branch_out")  # fresh: Literal/passthrough
+            ctx.emit("Identity", [nm], [out])
+            outs.append(out)
         nodes, ctx.nodes = ctx.nodes, saved_nodes
         ctx.names = saved_names
         g.node.extend(nodes)
         for nm, ov in zip(outs, inner.outvars):
-            vi = g.output.add(name=nm)
-            tt = vi.type.tensor_type
-            tt.elem_type = _onnx_dtype(ov.aval.dtype)
-            for d in ov.aval.shape:
-                tt.shape.dim.add(dim_value=int(d))
+            _add_vi(g.output, nm, ov.aval.dtype, ov.aval.shape)
         return g
 
     def chain_graph(k):
@@ -904,11 +900,8 @@ def _cond(ctx, eqn):
         nodes, ctx.nodes = ctx.nodes, saved_nodes
         g.node.extend(nodes)
         for nm, ov in zip(outs, eqn.outvars):
-            vi = g.output.add(name=nm)
-            tt = vi.type.tensor_type
-            tt.elem_type = _onnx_dtype(ov.aval.dtype)
-            for d in ov.aval.shape:
-                tt.shape.dim.add(dim_value=int(d))
+            _add_vi(g.output, nm, ov.aval.dtype,
+                    ov.aval.shape)
         return g
 
     is0 = ctx.fresh("is_0")
@@ -964,11 +957,8 @@ def _while(ctx, eqn):
     for cv in carry_vars:
         nm = ctx.fresh("loop_c")
         body_carry.append(nm)
-        vi = body.input.add(name=nm)
-        tt = vi.type.tensor_type
-        tt.elem_type = _onnx_dtype(cv.aval.dtype)
-        for d in cv.aval.shape:
-            tt.shape.dim.add(dim_value=int(d))
+        _add_vi(body.input, nm, cv.aval.dtype,
+                cv.aval.shape)
 
     saved_nodes, ctx.nodes = ctx.nodes, []
     saved_names, ctx.names = ctx.names, dict(ctx.names)
@@ -990,11 +980,8 @@ def _while(ctx, eqn):
     vi = body.output.add(name=cond_out)
     vi.type.tensor_type.elem_type = P.TensorProto.BOOL
     for nm, cv in zip(carry_out, carry_vars):
-        vi = body.output.add(name=nm)
-        tt = vi.type.tensor_type
-        tt.elem_type = _onnx_dtype(cv.aval.dtype)
-        for d in cv.aval.shape:
-            tt.shape.dim.add(dim_value=int(d))
+        _add_vi(body.output, nm, cv.aval.dtype,
+                cv.aval.shape)
 
     trip = ctx.add_const(np.asarray(np.iinfo(np.int64).max, np.int64),
                          "trip")
@@ -1093,11 +1080,8 @@ def to_onnx_model(fn, example_inputs, *, name="paddle_tpu_model",
         nm = ctx.name_of(ov)
         out_nm = f"output_{i}"
         ctx.emit("Identity", [nm], [out_nm])
-        vi = g.output.add(name=out_nm)
-        tt = vi.type.tensor_type
-        tt.elem_type = _onnx_dtype(ov.aval.dtype)
-        for d in ov.aval.shape:
-            tt.shape.dim.add(dim_value=int(d))
+        _add_vi(g.output, out_nm, ov.aval.dtype,
+                ov.aval.shape)
 
     g.node.extend(ctx.nodes)
     g.initializer.extend(ctx.inits)

@@ -443,20 +443,27 @@ class TestGeneralGathers:
         np.testing.assert_allclose(got, x[ij[:, 0], ij[:, 1]])
 
 
+def _export_and_run(fn, args, rtol=1e-6, **np_kw):
+    """Serialize-roundtrip the export, execute it in the numpy
+    interpreter, and pin it to eager jax (shared by the control-flow
+    and OOB-gather test classes)."""
+    m = P.ModelProto.FromString(
+        to_onnx_model(fn, args).SerializeToString())
+    got = run(m, args)
+    want = fn(*args)
+    want = [np.asarray(w) for w in
+            (want if isinstance(want, (list, tuple)) else [want])]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=rtol, **np_kw)
+    return m
+
+
 class TestCondExport:
     """lax.cond / lax.switch -> ONNX If: one exported model serves both
     branch outcomes (previously a documented fallback-to-StableHLO)."""
 
     def _np_run(self, fn, args):
-        m = P.ModelProto.FromString(
-            to_onnx_model(fn, args).SerializeToString())
-        got = run(m, args)
-        want = fn(*args)
-        want = [np.asarray(w) for w in
-                (want if isinstance(want, (list, tuple)) else [want])]
-        for g, w in zip(got, want):
-            np.testing.assert_allclose(g, w, rtol=1e-6)
-        return m
+        return _export_and_run(fn, args)
 
     def test_cond_both_outcomes_one_model(self):
         import jax.numpy as jnp
@@ -486,6 +493,18 @@ class TestCondExport:
         for k in (0, 1, 2):
             self._np_run(fn, [x, np.asarray([k], "int32")])
 
+    def test_select_n_integer_cases(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x, i):
+            return lax.select_n(jnp.clip(i[0], 0, 2),
+                                x + 1.0, x * 2.0, -x)
+
+        x = np.random.default_rng(3).normal(size=(3,)).astype("float32")
+        for k in (0, 1, 2):
+            self._np_run(fn, [x, np.asarray([k], "int32")])
+
     def test_cond_multi_operand_multi_output(self):
         from jax import lax
 
@@ -507,15 +526,7 @@ class TestWhileExport:
     evaluating the condition on the init carry in the outer graph)."""
 
     def _np_run(self, fn, args):
-        m = P.ModelProto.FromString(
-            to_onnx_model(fn, args).SerializeToString())
-        got = run(m, args)
-        want = fn(*args)
-        want = [np.asarray(w) for w in
-                (want if isinstance(want, (list, tuple)) else [want])]
-        for g, w in zip(got, want):
-            np.testing.assert_allclose(g, w, rtol=1e-6)
-        return m
+        return _export_and_run(fn, args)
 
     def test_data_dependent_trip_count(self):
         from jax import lax
@@ -537,18 +548,6 @@ class TestWhileExport:
                                   lambda c: c - 1.0, x[0])
 
         self._np_run(fn, [np.asarray([7.0], "float32")])
-
-    def test_select_n_integer_cases(self):
-        import jax.numpy as jnp
-        from jax import lax
-
-        def fn(x, i):
-            return lax.select_n(jnp.clip(i[0], 0, 2),
-                                x + 1.0, x * 2.0, -x)
-
-        x = np.random.default_rng(3).normal(size=(3,)).astype("float32")
-        for k in (0, 1, 2):
-            self._np_run(fn, [x, np.asarray([k], "int32")])
 
     def test_tuple_carry_and_consts(self):
         import jax.numpy as jnp
@@ -575,15 +574,7 @@ class TestGatherOutOfBounds:
     [0, N) silently diverged or crashed)."""
 
     def _np_run(self, fn, args):
-        import jax
-        m = P.ModelProto.FromString(
-            to_onnx_model(fn, args).SerializeToString())
-        got = run(m, args)
-        want = fn(*args)
-        want = [np.asarray(w) for w in
-                (want if isinstance(want, (list, tuple)) else [want])]
-        for g, w in zip(got, want):
-            np.testing.assert_allclose(g, w, rtol=1e-6, equal_nan=True)
+        return _export_and_run(fn, args, equal_nan=True)
 
     def test_take_fill_mode_oob_nan(self):
         import jax.numpy as jnp
