@@ -505,6 +505,18 @@ class TestCondExport:
         for k in (0, 1, 2):
             self._np_run(fn, [x, np.asarray([k], "int32")])
 
+    def test_select_n_single_case_degenerate(self):
+        # one case: previously emitted NO nodes, leaving the output
+        # name dangling (invalid graph)
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x, i):
+            return lax.select_n(jnp.clip(i[0], 0, 0), x * 2.0)
+
+        x = np.random.default_rng(4).normal(size=(3,)).astype("float32")
+        self._np_run(fn, [x, np.asarray([5], "int32")])
+
     def test_cond_multi_operand_multi_output(self):
         from jax import lax
 
