@@ -218,6 +218,47 @@ def _autotune_summary():
         return {}
 
 
+def _enable_monitor():
+    """Turn on the runtime metrics registry for this bench process
+    (PADDLE_TPU_BENCH_MONITOR=0 opts out). Failure is never fatal —
+    metrics are a reporting extra, not a bench dependency."""
+    if os.environ.get("PADDLE_TPU_BENCH_MONITOR", "1") == "0":
+        return
+    try:
+        from paddle_tpu.core import flags as _pt_flags
+        _pt_flags.set_flags({"enable_monitor": True})
+    except Exception as e:                      # noqa: BLE001
+        sys.stderr.write(f"monitor unavailable: {e}\n")
+
+
+def _metrics_summary():
+    """Monitor snapshot distilled for the JSON line — compile counts,
+    cache hit rates, peak tensor bytes — plus the full run-id-keyed
+    snapshot (paddle_tpu.monitor.dump_json) for offline digging."""
+    try:
+        from paddle_tpu import monitor
+        if not monitor.enabled():
+            return {"disabled": True}
+        snap = monitor.snapshot()
+        c = snap.get("counters", {})
+        g = snap.get("gauges", {})
+        hits, misses = c.get("jit.cache.hit", 0), c.get("jit.cache.miss", 0)
+        at_h = c.get("autotune.cache.hit", 0)
+        at_m = c.get("autotune.cache.miss", 0)
+        return {
+            "compile_count": misses,
+            "jit_cache_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "autotune_cache_hit_rate": round(at_h / (at_h + at_m), 4)
+            if at_h + at_m else None,
+            "peak_tensor_bytes": g.get("tensor.bytes.peak"),
+            "snapshot": monitor.dump_json(
+                run_id=f"bench-{os.getpid()}-{int(time.time())}"),
+        }
+    except Exception as e:                      # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _preflight_kernels(on_tpu):
     """Lower + run each Pallas kernel standalone (fwd AND bwd) at tiny
     shapes before the timed loop. A kernel that fails de-registers itself
@@ -330,6 +371,7 @@ def _main():
         dev = _probe_backend()
         from paddle_tpu import kernels
         from paddle_tpu.models import llama as L
+        _enable_monitor()
     except Exception as e:
         _fail(f"{type(e).__name__}: {e}")
         return
@@ -498,6 +540,7 @@ def _main():
     # the earlier snapshot (taken for the partial-payload safety copy)
     # misses the MoE and decode stages' block/chunk decisions.
     payload["extra"]["autotune"] = _autotune_summary()
+    payload["extra"]["metrics"] = _metrics_summary()
     payload["extra"]["elapsed_s"] = round(time.monotonic() - _T0, 1)
     _emit(payload)
 

@@ -45,6 +45,7 @@ from . import kernels  # noqa
 from . import models  # noqa
 from . import incubate  # noqa
 from . import metric  # noqa
+from . import monitor  # noqa
 from . import profiler  # noqa
 from . import static  # noqa
 from . import inference  # noqa

@@ -75,8 +75,18 @@ def flag_value(name: str):
     return _REGISTRY[name].value
 
 
+def flag_info(name: str) -> _FlagInfo:
+    """The live flag record. set_flags mutates it in place, so hot paths
+    cache the record once and read ``.value`` — one attribute load per
+    check instead of a registry lookup."""
+    return _REGISTRY[name]
+
+
 # Core flags (subset of the reference's ~150, the ones with TPU meaning).
 define_flag("check_nan_inf", False, "Check outputs for NaN/Inf after each op (debug).")
 define_flag("use_pallas_kernels", True, "Use hand-written Pallas kernels where available.")
 define_flag("eager_jit_ops", True, "jit-compile each eager op (cached) instead of op-by-op dispatch.")
 define_flag("default_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+define_flag("enable_monitor", False,
+            "Collect runtime metrics (paddle_tpu.monitor counters/gauges/"
+            "histograms) on the instrumented hot paths; off = one branch.")

@@ -1,0 +1,38 @@
+"""Version shims for the jax surface this codebase tracks.
+
+The code targets current jax (``jax.shard_map`` with ``check_vma``,
+eagerly-imported ``jax.export``); older runtimes (jax < 0.6, e.g.
+0.4.x) ship ``shard_map`` under ``jax.experimental`` with the kwarg
+spelled ``check_rep``, and ``jax.export`` as a submodule that ``import
+jax`` does not load. Importing THIS module gives every caller the
+current-jax spelling on either runtime.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    # jax < 0.5 does not auto-import the submodule; after this,
+    # ``jax.export.*`` works everywhere in the process.
+    import jax.export  # noqa: F401
+except ImportError:                                 # pragma: no cover
+    pass
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:       # jax < 0.6 ships it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma=None, **kw):
+        """Old-jax adapter: ``check_vma`` was spelled ``check_rep``."""
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(*args, **kw)
+
+
+__all__ = ["shard_map"]

@@ -19,6 +19,7 @@ is mutable, the array is not. This is exactly the discipline XLA wants
 """
 from __future__ import annotations
 
+import weakref
 from typing import Optional
 
 import jax
@@ -28,6 +29,40 @@ import numpy as np
 from . import dtype as dtypes
 from . import state
 from . import enforce as E
+from .flags import flag_info
+
+# Monitor gate for the live/peak tensor-bytes gauges (reference:
+# phi/core/memory/stats.h HostMemoryStatUpdate): cached flag record so
+# the off path is one attribute load per Tensor construction and ZERO
+# cost at destruction (the finalizer registers only on counted
+# tensors). The recording helper imports lazily — this module loads
+# before the monitor package exists on the parent.
+_MON_FLAG = flag_info("enable_monitor")
+_MON_TENSOR_BYTES = None
+_MON_TENSOR_FREE = None
+
+
+def _monitor_tensor_bytes(nbytes):
+    """Count an allocation; returns the gauge generation for the paired
+    finalizer (monitor.tensor_free)."""
+    global _MON_TENSOR_BYTES, _MON_TENSOR_FREE
+    if _MON_TENSOR_BYTES is None:
+        # free BEFORE bytes: a second thread that sees _MON_TENSOR_BYTES
+        # non-None must be guaranteed _MON_TENSOR_FREE is bound (it
+        # registers it as a finalizer callback without re-checking)
+        from ..monitor import tensor_free as _MON_TENSOR_FREE  # noqa: PLW0603
+        from ..monitor import tensor_bytes as _MON_TENSOR_BYTES  # noqa: PLW0603
+    return _MON_TENSOR_BYTES(nbytes)
+
+
+def _nbytes_of(data) -> int:
+    """Byte estimate from shape x itemsize (0 when the shape is
+    symbolic or the value carries no shape/dtype). Shared by the
+    tensor gauges and the collective byte counters."""
+    try:
+        return int(np.prod(data.shape)) * np.dtype(data.dtype).itemsize
+    except Exception:
+        return 0
 
 # Set by jit/segment.py while a segmented capture is recording: called
 # with a symbolic Tensor whose concrete value Python needs (bool/float/
@@ -79,6 +114,18 @@ class Tensor:
         self._placements = None  # distributed placement annotation
         self._process_mesh = None
         self._symbolic = None    # static-graph Var (static/ir.py) or None
+        # live/peak byte gauges count the handle's construction-time
+        # bytes (rebinds are not re-counted — the handle, not the
+        # buffer, is the unit). The finalizer returns exactly what was
+        # added and registers ONLY on counted tensors, so flag-off
+        # tensors pay nothing at destruction and a later flag flip
+        # cannot skew the balance.
+        if _MON_FLAG.value:
+            nb = _nbytes_of(data)
+            if nb:
+                epoch = _monitor_tensor_bytes(nb)
+                if epoch is not None:
+                    weakref.finalize(self, _MON_TENSOR_FREE, nb, epoch)
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -362,6 +409,17 @@ class Tensor:
     def __array__(self, dtype=None):
         a = np.asarray(self._concrete())
         return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        # jnp.asarray(tensor) unwraps to the backing array: older jax
+        # cannot flatten a custom pytree node inside jnp.array (raises
+        # "Unexpected input type"), and newer jax honors this protocol
+        # on the same path. Symbolic tensors concretize like __array__
+        # does — the capture-manager hook, or the guided static-mode
+        # error instead of an opaque ShapeDtypeStruct failure.
+        if self._symbolic is not None:
+            return self._concrete()
+        return self._data
 
     def __repr__(self):
         sg = self.stop_gradient

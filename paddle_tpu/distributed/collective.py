@@ -24,9 +24,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tensor import Tensor
+from .. import monitor as _monitor
+from ..core.tensor import Tensor, _nbytes_of
 from . import env
 from ..core import enforce as E
+
+
+def _note_eager(op: str, tensor=None):
+    """Monitor-gated accounting for the eager (host-side) collectives —
+    unlike comm_ops these count per CALL, not per trace."""
+    if not _monitor.enabled():
+        return
+    _monitor.inc(f"dist.eager.{op}.calls",
+                 doc="eager host-collective calls")
+    if isinstance(tensor, Tensor):
+        nbytes = _nbytes_of(tensor._data)
+        if nbytes:
+            _monitor.inc(f"dist.eager.{op}.bytes", nbytes,
+                         doc="eager host-collective operand bytes")
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
@@ -180,6 +195,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     The hot-path allreduce (DP gradient sync) is NOT this function — it's
     lax.psum inside the compiled train step (comm_ops.all_reduce), or
     implicit from GSPMD when grads carry a dp-sharded batch dim."""
+    _note_eager("all_reduce", tensor)
     n = _group_size(group)
     if n > 1 and op == ReduceOp.AVG:
         # Single-controller: array value is already the global sum-of-parts
@@ -191,6 +207,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list: List[Tensor], tensor: Tensor, group=None,
                sync_op=True):
+    _note_eager("all_gather", tensor)
     n = _group_size(group)
     tensor_list.clear()
     tensor_list.extend(Tensor(tensor._data) for _ in range(n))
@@ -234,6 +251,7 @@ def _coord_client():
 
 
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    _note_eager("broadcast", tensor)
     return _Task(tensor) if not sync_op else tensor
 
 
@@ -326,6 +344,7 @@ def irecv(tensor: Tensor, src: int = 0, group=None):
 def barrier(group=None):
     """Host barrier over the coordination service (reference: TCPStore
     barrier / ProcessGroup barrier)."""
+    _note_eager("barrier")
     client = _coord_client()
     if client is not None and env.get_world_size() > 1:
         client.wait_at_barrier("pt_barrier", 60_000)

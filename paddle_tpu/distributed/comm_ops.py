@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.tensor import Tensor
+from .. import monitor as _monitor
+from ..core.tensor import Tensor, _nbytes_of
 from ..core import enforce as E
 
 __all__ = [
@@ -46,6 +47,21 @@ def _rewrap(x, raw):
         if isinstance(x, Tensor) else raw
 
 
+def _note(op: str, raw):
+    """Monitor-gated collective accounting. These wrappers run at TRACE
+    time (inside jit/shard_map), so counts are per-compile, not
+    per-execution — the honest observable without a host callback in
+    the compiled program. ``bytes`` is the per-device operand size."""
+    if not _monitor.enabled():
+        return
+    _monitor.inc(f"dist.{op}.calls",
+                 doc="traced compiled-collective call sites")
+    nbytes = _nbytes_of(raw)
+    if nbytes:
+        _monitor.inc(f"dist.{op}.bytes", nbytes,
+                     doc="per-device operand bytes at trace time")
+
+
 def axis_index(axis: AxisName):
     return lax.axis_index(axis)
 
@@ -57,6 +73,7 @@ def axis_size(axis: AxisName) -> int:
 def all_reduce(x, axis: AxisName, op: str = "sum"):
     """c_allreduce_{sum,max,min,prod,avg} equivalent → lax.psum/pmax/pmin."""
     raw = _unwrap(x)
+    _note("all_reduce", raw)
     if op == "sum":
         out = lax.psum(raw, axis)
     elif op == "max":
@@ -99,20 +116,26 @@ def pmin(x, axis: AxisName):
 def all_gather(x, axis: AxisName, *, gather_dim: int = 0, tiled: bool = True):
     """c_allgather equivalent. ``tiled=True`` concatenates along
     ``gather_dim`` (the common Megatron-SP use); False stacks a new dim."""
-    out = lax.all_gather(_unwrap(x), axis, axis=gather_dim, tiled=tiled)
+    raw = _unwrap(x)
+    _note("all_gather", raw)
+    out = lax.all_gather(raw, axis, axis=gather_dim, tiled=tiled)
     return _rewrap(x, out)
 
 
 def reduce_scatter(x, axis: AxisName, *, scatter_dim: int = 0):
     """c_reducescatter equivalent → lax.psum_scatter (ICI-ring lowered)."""
-    out = lax.psum_scatter(_unwrap(x), axis, scatter_dimension=scatter_dim,
+    raw = _unwrap(x)
+    _note("reduce_scatter", raw)
+    out = lax.psum_scatter(raw, axis, scatter_dimension=scatter_dim,
                            tiled=True)
     return _rewrap(x, out)
 
 
 def all_to_all(x, axis: AxisName, *, split_dim: int, concat_dim: int):
     """alltoall equivalent (MoE dispatch / s→s reshard) → lax.all_to_all."""
-    out = lax.all_to_all(_unwrap(x), axis, split_axis=split_dim,
+    raw = _unwrap(x)
+    _note("all_to_all", raw)
+    out = lax.all_to_all(raw, axis, split_axis=split_dim,
                          concat_axis=concat_dim, tiled=True)
     return _rewrap(x, out)
 
@@ -124,13 +147,16 @@ def p2p_permute(x, axis: AxisName, perm: Sequence[tuple]):
     pp_utils/p2p_communication.py. TPU-native: lax.ppermute compiles to ICI
     collective-permute; ``perm`` is [(src, dst), ...] in axis coordinates.
     """
-    out = lax.ppermute(_unwrap(x), axis, perm=perm)
+    raw = _unwrap(x)
+    _note("p2p_permute", raw)
+    out = lax.ppermute(raw, axis, perm=perm)
     return _rewrap(x, out)
 
 
 def broadcast(x, axis: AxisName, src: int = 0):
     """c_broadcast equivalent: keep src's value on all ranks of the axis."""
     raw = _unwrap(x)
+    _note("broadcast", raw)
     idx = lax.axis_index(axis)
     masked = jnp.where(idx == src, raw, jnp.zeros_like(raw))
     return _rewrap(x, lax.psum(masked, axis))

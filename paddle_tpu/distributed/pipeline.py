@@ -27,7 +27,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..core.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["pipeline_spmd", "make_pipeline_train_step",

@@ -23,10 +23,12 @@ import multiprocessing as mp
 import os
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core import enforce as E
 from ..core.tensor import Tensor, to_tensor
 from .dataset import Dataset, IterableDataset
@@ -356,6 +358,47 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        it = self._make_iter()
+        if _monitor.enabled():
+            return self._monitored(it)
+        return it
+
+    def _monitored(self, it):
+        """Per-batch throughput instrumentation (entered only when the
+        monitor is enabled): batch counter + inter-batch interval
+        histogram while iterating, and an epoch-level batches/sec gauge
+        when the epoch ends. Metric handles hoist out of the loop (the
+        record_op pattern) so the per-batch cost is two lock-free-ish
+        updates, not registry lookups; an epoch started under the flag
+        keeps recording to its handles until it ends. batches/sec over
+        the whole run = dataloader.batches /
+        (dataloader.batch_interval_ms.sum / 1000)."""
+        batches = _monitor.counter(
+            "dataloader.batches", "batches yielded across all loaders")
+        intervals = _monitor.histogram(
+            "dataloader.batch_interval_ms",
+            "gap between consecutive batches (includes consumer step "
+            "time)")
+        t_start = time.perf_counter()
+        last = t_start
+        n = 0
+        try:
+            for batch in it:
+                now = time.perf_counter()
+                batches.incr()
+                intervals.observe((now - last) * 1e3)
+                last = now
+                n += 1
+                yield batch
+        finally:
+            elapsed = time.perf_counter() - t_start
+            if n and elapsed > 0:
+                _monitor.set_gauge(
+                    "dataloader.last_epoch_batches_per_sec",
+                    round(n / elapsed, 3),
+                    doc="throughput of the most recently finished epoch")
+
+    def _make_iter(self):
         if self.worker_mode == "native":
             if self._user_batch_sampler:
                 raise E.InvalidArgumentError(

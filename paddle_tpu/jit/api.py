@@ -23,12 +23,15 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import monitor as _monitor
+from ..core import jax_compat as _jax_compat  # noqa: F401  (jax.export shim)
 from ..core import enforce as E
 from ..core import state
 from ..core.dtype import convert_dtype
@@ -489,9 +492,25 @@ class StaticFunction:
 
     def __compiled_call(self, key, args, kwargs):
         prog = self._programs.get(key)
+        t_compile = None
         if prog is None:
+            if _monitor.enabled():
+                # program-cache miss == a fresh trace+compile; a miss on
+                # a StaticFunction that ALREADY holds programs is a
+                # recompile (new input signature / training flip) — the
+                # reference's _ExecutorCache growth events.
+                _monitor.inc("jit.cache.miss",
+                             doc="to_static program-cache misses")
+                if self._programs:
+                    _monitor.inc("jit.recompile",
+                                 doc="cache misses after the first "
+                                     "program (signature churn)")
+                t_compile = time.perf_counter()
             prog = self._build_program(args, kwargs)
             self._programs[key] = prog
+        elif _monitor.enabled():
+            _monitor.inc("jit.cache.hit",
+                         doc="to_static program-cache hits")
 
         named_params = self._named_params()
         named_buffers = self._named_buffers()
@@ -514,6 +533,7 @@ class StaticFunction:
         if not need_grad:
             flat_out, new_buffers = prog.jitted(
                 param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
+            self._note_compile(t_compile)
         else:
             train_names = [n for n, _ in trainable]
             diff_idx = [i for i, _ in diff_args]
@@ -531,6 +551,7 @@ class StaticFunction:
             diff_arg_arrays = tuple(a._data for _, a in diff_args)
             (flat_out, new_buffers), vjp_fn = jax.vjp(
                 closed, train_arrays, diff_arg_arrays)
+            self._note_compile(t_compile)
 
             input_tensors = [p for _, p in trainable] + \
                 [a for _, a in diff_args]
@@ -558,6 +579,16 @@ class StaticFunction:
         tree = prog.out_tree_store["tree"]
         return jax.tree_util.tree_unflatten(
             tree, [Tensor(o) for o in flat_out])
+
+    @staticmethod
+    def _note_compile(t_compile):
+        """Observe trace+compile latency for a cache-miss call (timed
+        through the first execution, where jax.jit actually compiles)."""
+        if t_compile is not None:
+            _monitor.observe(
+                "jit.compile_ms", (time.perf_counter() - t_compile) * 1e3,
+                doc="to_static trace+compile wall time per cache miss",
+                buckets=tuple(float(10 ** i) / 10 for i in range(9)))
 
     @property
     def concrete_programs(self):

@@ -1,0 +1,10 @@
+"""Pallas-TPU naming shims (single home for the kernels' version
+compat, like core/jax_compat.py for the core jax surface)."""
+from jax.experimental.pallas import tpu as pltpu
+
+try:
+    CompilerParams = pltpu.CompilerParams
+except AttributeError:      # jax < 0.6 names it TPUCompilerParams
+    CompilerParams = pltpu.TPUCompilerParams
+
+__all__ = ["CompilerParams"]

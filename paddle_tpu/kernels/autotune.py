@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core import flags as _flags
 
 _flags.define_flag("use_autotune", True,
@@ -64,6 +65,7 @@ class AutotuneCache:
         self._explicit_path = path
         self._mem: dict = {}
         self._loaded = False
+        self._resolved_path: Optional[str] = None
 
     @property
     def _path(self) -> str:
@@ -78,6 +80,19 @@ class AutotuneCache:
         return _cache_path()
 
     def _load(self):
+        # PADDLE_TPU_AUTOTUNE_CACHE may change AFTER the first load
+        # (tpu_smoke retargets the repo cache mid-process): a stale
+        # sticky _loaded would keep serving old-path entries and put()
+        # would write their union into the new file (cross-cache
+        # contamination, ADVICE r5). Track the last-resolved path and
+        # evict when it moves.
+        path = self._path
+        if self._resolved_path is not None and path != self._resolved_path:
+            _monitor.inc("autotune.cache.evictions", len(self._mem),
+                         doc="entries dropped on cache-path change")
+            self._mem.clear()
+            self._loaded = False
+        self._resolved_path = path
         if self._loaded:
             return
         self._loaded = True
@@ -341,6 +356,8 @@ def ce_chunk(n_tokens, hidden, vocab, dtype,
         return default
     cache = cache or _CACHE
     hit = cache.get(key)
+    _monitor.inc("autotune.cache.hit" if hit and not hit.get("error")
+                 else "autotune.cache.miss")
     if hit and not hit.get("error"):
         _USED[key] = {"chunk": hit["chunk"], "source": "cache"}
         return int(hit["chunk"])
@@ -360,6 +377,7 @@ def ce_chunk(n_tokens, hidden, vocab, dtype,
         _USED[key] = {"chunk": cands[0], "source": "measured"}
         return cands[0]
     measure = measure or _ce_measurer(n_tokens, hidden, vocab, dtype)
+    _monitor.inc("autotune.sweeps", doc="candidate measurement sweeps run")
     timings = {}
     last_err = None
     for c in cands:
@@ -406,6 +424,8 @@ def flash_blocks(q_shape, k_shape, dtype, causal,
         return defaults
     cache = cache or _CACHE
     hit = cache.get(key)
+    _monitor.inc("autotune.cache.hit" if hit and not hit.get("error")
+                 else "autotune.cache.miss")
     if hit and not hit.get("error"):
         _USED[key] = {"blocks": list(hit["blocks"]), "source": "cache"}
         return tuple(hit["blocks"])
@@ -429,6 +449,7 @@ def flash_blocks(q_shape, k_shape, dtype, causal,
         return cands[0]
     measure = measure or _flash_measurer(b, sq, sk, h, kvh, d, dtype,
                                          causal)
+    _monitor.inc("autotune.sweeps", doc="candidate measurement sweeps run")
     timings = {}
     last_err = None
     for bq, bk in cands:

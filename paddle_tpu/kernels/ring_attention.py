@@ -22,7 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..core.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["ring_attention"]

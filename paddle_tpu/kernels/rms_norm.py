@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import CompilerParams as _CompilerParams
+
 DEFAULT_BLOCK_ROWS = 256
 # rows*cols budget per block: ~6 live (br, d) f32 buffers double-buffered
 # must fit the ~16MB scoped-vmem limit (v5e OOMs at br=256, d=4096)
@@ -146,7 +148,7 @@ def _rms_bwd(eps, block_rows, _interp_unused, res, dy):
             jax.ShapeDtypeStruct((n, d), x.dtype),
             jax.ShapeDtypeStruct((1, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x2, w.reshape(1, d), rstd, dy2)

@@ -424,11 +424,17 @@ def prefill(params, ids, config: MoEConfig, cache):
 
 def decode_step(params, cache, token, config: MoEConfig):
     """One incremental step: ``token`` [B] sits at position cache['pos'].
-    Routing runs per decoded token (T = B), so the capacity grid is tiny
-    and no slot can overflow — decode is effectively DROPLESS even under
-    dispatch_mode="capacity" (the usual capacity-factor train/infer
-    asymmetry: training drops over-capacity slots at T = B*S, inference
-    routes every token). Returns (cache', logits [B, V])."""
+    Routing runs per decoded token (T = B), so under
+    dispatch_mode="capacity" the grid is [E, C] with C =
+    moe_capacity(config, B) — typically DROPLESS at small batch, but not
+    guaranteed: a slot overflows whenever more than C of the B tokens
+    route one of their top-k picks to the same expert (C ~
+    ceil(B*k/E * capacity_factor), so a routing hot spot at large B can
+    exceed it; only C >= B makes dropping impossible). An over-capacity
+    pick silently falls back to the token's shared-expert path, which
+    shifts decode logits relative to training. Use dispatch_mode="dense"
+    (exact) when serving large batches with skewed routing. Returns
+    (cache', logits [B, V])."""
     from .llama import _attn_over_cache, _qkv_proj
     from ..nn.functional.attention import rope_raw
     c = config

@@ -1,0 +1,217 @@
+"""paddle_tpu.monitor — process-global runtime metrics.
+
+Reference capability: paddle/fluid/platform/monitor.h (StatRegistry of
+named process-global stats, DEFINE_INT_STATUS / STAT_ADD macros baked
+into hot paths) + paddle/phi/core/memory/stats.h (live/peak byte
+accounting). TPU-native redesign: a typed registry (counters, gauges,
+histograms) with two exposition surfaces — Prometheus text for scrapes,
+a run-id-keyed JSON snapshot for the bench harness — instead of the
+reference's pybind getters.
+
+Gating: everything is behind ``FLAGS_enable_monitor`` (core/flags.py).
+With the flag off (the default) the instrumented hot paths pay ONE
+branch on a cached flag record and never touch this package, so
+``snapshot()`` stays ``{}`` — nothing is registered until something is
+recorded. Flip it on with ``FLAGS_enable_monitor=1`` in the environment
+or ``paddle.set_flags({"FLAGS_enable_monitor": True})`` at runtime.
+
+Instrumented seams (each self-documents its unit in the metric name):
+- ``op.<name>.calls`` / ``op.dispatch.wall_ns`` — eager op dispatch
+  (ops/_op.py; under jit these count trace-time dispatches).
+- ``jit.cache.hit|miss`` / ``jit.recompile`` / ``jit.compile_ms`` —
+  to_static program cache (jit/api.py).
+- ``autotune.cache.hit|miss|evictions`` / ``autotune.sweeps`` —
+  kernel autotuner (kernels/autotune.py).
+- ``dataloader.batches`` / ``dataloader.batch_interval_ms`` /
+  ``dataloader.last_epoch_batches_per_sec`` — io/dataloader.py.
+- ``dist.<collective>.calls|bytes`` — compiled collectives count at
+  TRACE time (once per compile, comm_ops.py); eager host collectives
+  (collective.py) count per call.
+- ``tensor.bytes.live`` / ``tensor.bytes.peak`` — Tensor handle
+  construction/destruction (core/tensor.py; construction-time bytes,
+  handle rebinds are not re-counted).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..core import flags as _flags
+from . import exposition as _exposition
+from .registry import Counter, Gauge, Histogram, StatRegistry
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "StatRegistry",
+    "enabled", "counter", "gauge", "histogram",
+    "inc", "observe", "set_gauge",
+    "snapshot", "expose_text", "dump_json", "reset",
+    "record_op", "tensor_bytes", "tensor_free",
+]
+
+# The one process-global registry (monitor.h StatRegistry::Instance()).
+_REGISTRY = StatRegistry()
+
+# Cached flag record: set_flags mutates the _FlagInfo in place, so one
+# attribute load reads the current value — the hot-path gate.
+_FLAG = _flags.flag_info("enable_monitor")
+
+
+def enabled() -> bool:
+    """True when FLAGS_enable_monitor is set (env or set_flags)."""
+    return _FLAG.value
+
+
+def registry() -> StatRegistry:
+    return _REGISTRY
+
+
+# -- typed access (creates the metric; callers gate on enabled()) -----------
+
+def counter(name: str, doc: str = "") -> Counter:
+    return _REGISTRY.counter(name, doc)
+
+
+def gauge(name: str, doc: str = "") -> Gauge:
+    return _REGISTRY.gauge(name, doc)
+
+
+def histogram(name: str, doc: str = "", buckets=None) -> Histogram:
+    return _REGISTRY.histogram(name, doc, buckets=buckets)
+
+
+# -- gated convenience (no-ops when the flag is off) ------------------------
+
+def inc(name: str, n=1, doc: str = ""):
+    if _FLAG.value:
+        _REGISTRY.counter(name, doc).incr(n)
+
+
+def observe(name: str, value, doc: str = "", buckets=None):
+    if _FLAG.value:
+        _REGISTRY.histogram(name, doc, buckets=buckets).observe(value)
+
+
+def set_gauge(name: str, value, doc: str = ""):
+    if _FLAG.value:
+        _REGISTRY.gauge(name, doc).set(value)
+
+
+# -- hot-path helpers (self-gated, handle-cached) ---------------------------
+
+# Per-op metric handles: the dispatcher calls record_op on EVERY eager
+# op, so the registry lock must not sit on that path — plain dict reads
+# are GIL-atomic and the rare first-seen miss takes the registry lock.
+_OP_HANDLES: dict = {}
+_DISPATCH_HIST: list = []       # one-element cache of the shared histogram
+
+
+def record_op(opname: str, wall_ns: int):
+    """Per-op call counter + shared dispatch wall-time histogram."""
+    if not _FLAG.value:
+        return
+    h = _OP_HANDLES.get(opname)
+    if h is None:
+        h = _REGISTRY.counter(f"op.{opname}.calls",
+                              "eager dispatches of this op")
+        _OP_HANDLES[opname] = h
+    if not _DISPATCH_HIST:
+        _DISPATCH_HIST.append(_REGISTRY.histogram(
+            "op.dispatch.wall_ns",
+            "wall time of one eager op dispatch (ns), all ops",
+            buckets=tuple(float(10 ** i) for i in range(2, 11))))
+    h.incr()
+    _DISPATCH_HIST[0].observe(wall_ns)
+
+
+_TENSOR_GAUGES: list = []       # [(live, peak)] one-element cache
+# Generation counter bumped by reset(): frees of tensors counted in an
+# earlier generation are dropped instead of landing on (and driving
+# negative) gauges recreated after the reset.
+_TENSOR_EPOCH = [0]
+
+
+def tensor_bytes(nbytes: int):
+    """Count a Tensor allocation into the live/peak byte gauges
+    (stats.h HostMemoryStatUpdate shape). Returns the generation to
+    pass back to ``tensor_free``, or None when the flag is off.
+
+    The asymmetric pair keeps the balance honest: allocations register
+    only while the flag is ON, but ``tensor_free`` lands regardless of
+    the flag (so disabling it mid-run doesn't pin counted bytes in
+    ``live``) yet only within the same generation (so a ``reset()``
+    orphans stragglers instead of going negative)."""
+    if not _FLAG.value:
+        return None
+    if not _TENSOR_GAUGES:
+        _TENSOR_GAUGES.append((
+            _REGISTRY.gauge("tensor.bytes.live",
+                            "bytes held by live Tensor handles"),
+            _REGISTRY.gauge("tensor.bytes.peak",
+                            "high-water mark of tensor.bytes.live"),
+        ))
+    live, peak = _TENSOR_GAUGES[0]
+    live.add_and_max_into(nbytes, peak)
+    return _TENSOR_EPOCH[0]
+
+
+def tensor_free(nbytes: int, epoch):
+    """Return a counted allocation's bytes (finalizer side of
+    ``tensor_bytes``); dropped when the registry was reset since."""
+    if epoch == _TENSOR_EPOCH[0] and _TENSOR_GAUGES:
+        _TENSOR_GAUGES[0][0].add(-nbytes)
+
+
+# -- reporting --------------------------------------------------------------
+
+def snapshot() -> dict:
+    """Nested {kind: {name: value}} dict; {} when nothing registered."""
+    return _REGISTRY.snapshot()
+
+
+def expose_text() -> str:
+    """Prometheus text exposition of every registered metric."""
+    return _exposition.expose_text(_REGISTRY)
+
+
+def dump_json(run_id: Optional[str] = None,
+              path: Optional[str] = None) -> dict:
+    """Run-id-keyed JSON snapshot; optional atomic file write."""
+    return _exposition.dump_json(_REGISTRY, run_id=run_id, path=path)
+
+
+def reset():
+    """Drop all metrics and cached handles (tests; between bench runs).
+    Live counted tensors become orphans: their eventual frees are
+    dropped (generation mismatch), never negative gauges."""
+    _REGISTRY.reset()
+    _OP_HANDLES.clear()
+    _DISPATCH_HIST.clear()
+    _TENSOR_GAUGES.clear()
+    _TENSOR_EPOCH[0] += 1
+
+
+class timed:
+    """Context manager observing its wall time (ms) into a histogram
+    when the monitor is enabled — zero-cost pass-through otherwise."""
+
+    __slots__ = ("name", "doc", "_t0")
+
+    def __init__(self, name: str, doc: str = ""):
+        self.name = name
+        self.doc = doc
+        self._t0 = None
+
+    def __enter__(self):
+        # always (re)assign: a reused instance must not observe a stale
+        # _t0 from an earlier flag-on entry
+        self._t0 = time.perf_counter() if _FLAG.value else None
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            observe(self.name, (time.perf_counter() - self._t0) * 1e3,
+                    self.doc)
+        return False
+
+
+__all__.append("timed")

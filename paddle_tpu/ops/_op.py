@@ -17,6 +17,7 @@ FORWARD_FUNCTION_TEMPLATE) and kernel dispatch
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable
 
 import jax
@@ -25,8 +26,22 @@ import jax.numpy as jnp
 from ..amp.auto_cast import _amp as _amp_state
 from ..amp.auto_cast import current_cast_dtype_for as _current_cast_dtype_for
 from ..core import state
-from ..core.flags import flag_value
+from ..core.flags import flag_info, flag_value
 from ..core.tensor import Tensor
+
+# Monitor gate: cached flag record (set_flags mutates it in place) so
+# the uninstrumented hot path pays one attribute load + branch. The
+# recording helper imports lazily — paddle_tpu.monitor is cheap but
+# this module loads very early in package init.
+_MON_FLAG = flag_info("enable_monitor")
+_MON_RECORD = None
+
+
+def _monitor_record_op(opname, wall_ns):
+    global _MON_RECORD
+    if _MON_RECORD is None:
+        from ..monitor import record_op as _MON_RECORD  # noqa: PLW0603
+    _MON_RECORD(opname, wall_ns)
 
 _OP_REGISTRY = {}
 
@@ -189,13 +204,18 @@ def op_fn(fn: Callable = None, *, name: str = None, differentiable: bool = True,
     @functools.wraps(fn)
     def dispatch(*args, **kwargs):
         ph = _PROFILE_HOOK
+        if ph is None and not _MON_FLAG.value:
+            return _dispatch_inner(*args, **kwargs)
         if ph is not None:
             ph[0](opname)
-            try:
-                return _dispatch_inner(*args, **kwargs)
-            finally:
+        t0 = time.perf_counter_ns() if _MON_FLAG.value else 0
+        try:
+            return _dispatch_inner(*args, **kwargs)
+        finally:
+            if t0:
+                _monitor_record_op(opname, time.perf_counter_ns() - t0)
+            if ph is not None:
                 ph[1]()
-        return _dispatch_inner(*args, **kwargs)
 
     def _dispatch_inner(*args, **kwargs):
         # static-build interception (reference: under program_guard ops

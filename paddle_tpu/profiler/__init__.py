@@ -394,36 +394,20 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        """Aggregate span stats per name (reference: profiler.py summary →
-        statistic_helper). Returns the formatted table string and prints it."""
-        evs = self.events()
-        agg = {}
-        for e in evs:
-            tot, cnt = agg.get(e["name"], (0, 0))
-            agg[e["name"]] = (tot + (e["end_ns"] - e["begin_ns"]), cnt + 1)
-        unit = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
-        lines = [f"{'Name':<40} {'Calls':>8} {'Total(' + time_unit + ')':>14} "
-                 f"{'Avg(' + time_unit + ')':>12}"]
-        for name, (tot, cnt) in rows:
-            lines.append(f"{name[:40]:<40} {cnt:>8} {tot / unit:>14.3f} "
-                         f"{tot / cnt / unit:>12.3f}")
-        table = "\n".join(lines)
+        """Aggregate span stats per name and render the reference-shaped
+        table — calls/total/avg/max/min/ratio columns, sortable by
+        ``SortedKeys`` (reference: profiler.py summary ->
+        profiler_statistic._build_table). Prints and returns the table
+        string; ``statistics.op_breakdown(self.events())`` gives the
+        machine-readable form."""
+        from .statistics import summary_string
+        table = summary_string(self.events(), sorted_by=sorted_by,
+                               time_unit=time_unit, thread_sep=thread_sep)
         print(table)
         return table
 
 
-class SortedKeys(Enum):
-    """Summary-table sort keys (reference: profiler/profiler.py
-    SortedKeys)."""
-    CPUTotal = 0
-    CPUAvg = 1
-    CPUMax = 2
-    CPUMin = 3
-    GPUTotal = 4
-    GPUAvg = 5
-    GPUMax = 6
-    GPUMin = 7
+from .statistics import SortedKeys  # noqa: E402  (single definition home)
 
 
 class SummaryView(Enum):

@@ -238,11 +238,10 @@ def main():
             REPO, "autotune_cache.json")
         os.environ["PADDLE_TPU_AUTOTUNE"] = "1"
         from paddle_tpu.kernels import autotune as at
-        # Rebind, don't rely on the lazy path property: earlier smoke
-        # cases already dispatched flash kernels, so the module cache
-        # has _loaded=True against the home-dir path — a fresh instance
-        # re-reads the env var just set above.
-        at._CACHE = at.AutotuneCache()
+        # The module cache tracks its resolved path and evicts when the
+        # env var just set above moves it — no _CACHE rebinding needed
+        # even though earlier smoke cases already loaded the home-dir
+        # cache.
         # rung-1 dense shape + the MoE rung's shape (DeepSeekMoE-16B
         # slice at b8/s1024: 16 heads, d128) so both bench rungs run
         # tuned blocks

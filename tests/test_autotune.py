@@ -124,6 +124,32 @@ class TestFlashBlocks:
         got = self._call(cache, None)
         assert got == (512, 256)
 
+    def test_env_path_change_after_load_evicts(self, tmp_path,
+                                               monkeypatch):
+        # ADVICE r5: the sticky _loaded/_mem kept serving the OLD path's
+        # entries after PADDLE_TPU_AUTOTUNE_CACHE moved (and put() wrote
+        # their union into the new file). The cache now tracks its
+        # resolved path and evicts on change — no _CACHE rebinding
+        # workaround needed (tpu_smoke.py relied on one).
+        key = "flash:cpu:bfloat16:b2h4kv2:q2048k2048d128:c1"
+        p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+        p1.write_text(json.dumps(
+            {key: {"blocks": [256, 128], "us": 1.0, "candidates": 2}}))
+        p2.write_text(json.dumps(
+            {key: {"blocks": [512, 256], "us": 1.0, "candidates": 2}}))
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "cached")
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(p1))
+        cache = at.AutotuneCache()
+        assert self._call(cache, None) == (256, 128)   # loads p1
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(p2))
+        assert self._call(cache, None) == (512, 256)   # evict + reload
+        # a put after the switch must not leak p1's entries into p2
+        cache.put("k_extra", {"blocks": [128, 128]})
+        disk = json.load(open(p2))
+        assert disk[key]["blocks"] == [512, 256]
+        assert "k_extra" in disk
+        assert json.load(open(p1))[key]["blocks"] == [256, 128]
+
     def test_in_trace_dispatch_never_measures(self, tmp_path, monkeypatch):
         # A dispatch reached while an outer jit trace is active must not
         # attempt measurement (jitted candidates would stage into the

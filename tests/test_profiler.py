@@ -165,3 +165,126 @@ class TestProfilerRegressions:
             p.step()
         p.stop()
         assert len(exports) == 1
+
+
+class TestStatistics:
+    """profiler/statistics.py: the profiler_statistic.py parity layer."""
+
+    EVENTS = [
+        dict(name="a", begin_ns=0, end_ns=100, tid=1),
+        dict(name="a", begin_ns=100, end_ns=400, tid=1),
+        dict(name="b", begin_ns=0, end_ns=400, tid=2),
+    ]
+
+    def test_aggregate_math(self):
+        from paddle_tpu.profiler import statistics as S
+        stats = S.aggregate(self.EVENTS)
+        a = stats["a"]
+        assert (a.calls, a.total_ns, a.min_ns, a.max_ns) == (2, 400, 100,
+                                                             300)
+        assert a.avg_ns == 200
+        # observed window = 400ns; both names fill it entirely
+        assert a.ratio == 100.0 and stats["b"].ratio == 100.0
+
+    def test_explicit_span_ratio(self):
+        from paddle_tpu.profiler import statistics as S
+        stats = S.aggregate(self.EVENTS, span_ns=800)
+        assert stats["a"].ratio == 50.0
+
+    def test_sort_keys(self):
+        from paddle_tpu.profiler import statistics as S
+        from paddle_tpu.profiler.statistics import SortedKeys
+        evs = self.EVENTS + [dict(name="c", begin_ns=0, end_ns=50, tid=1),
+                             dict(name="c", begin_ns=0, end_ns=50, tid=1)]
+        stats = S.aggregate(evs)
+        by_max = S._sort(list(stats.values()), SortedKeys.CPUMax)
+        assert by_max[0].name == "b"            # max 400
+        by_min = S._sort(list(stats.values()), SortedKeys.CPUMin)
+        assert by_min[0].name == "b"            # min 400, descending
+        # GPU aliases sort the same host columns
+        assert [s.name for s in S._sort(list(stats.values()),
+                                        SortedKeys.GPUTotal)] == \
+            [s.name for s in S._sort(list(stats.values()),
+                                     SortedKeys.CPUTotal)]
+
+    def test_table_golden_shape(self):
+        from paddle_tpu.profiler import statistics as S
+        table = S.build_table(S.aggregate(self.EVENTS), time_unit="ns")
+        lines = table.splitlines()
+        header = lines[1]
+        for col in ("Name", "Calls", "Total(ns)", "Avg(ns)", "Max(ns)",
+                    "Min(ns)", "Ratio(%)"):
+            assert col in header, header
+        row_a = next(ln for ln in lines if ln.startswith("a "))
+        cells = row_a.split()
+        assert cells[1] == "2" and float(cells[2]) == 400.0
+        assert float(cells[3]) == 200.0
+
+    def test_thread_sep(self):
+        from paddle_tpu.profiler import statistics as S
+        out = S.summary_string(self.EVENTS, thread_sep=True)
+        assert "Thread 1" in out and "Thread 2" in out
+
+    def test_op_breakdown_machine_readable(self):
+        from paddle_tpu.profiler import statistics as S
+        bd = S.op_breakdown(self.EVENTS)
+        assert bd["a"]["calls"] == 2 and bd["a"]["total_ns"] == 400
+        assert bd["b"]["avg_ns"] == 400
+
+    def test_bad_time_unit_raises(self):
+        from paddle_tpu.profiler import statistics as S
+        with pytest.raises(ValueError):
+            S.build_table({}, time_unit="h")
+
+
+class TestSummaryParity:
+    def test_summary_golden_columns(self):
+        """Profiler.summary() renders the reference-shaped per-op table:
+        calls/total/avg (+max/min/ratio) columns for each span name."""
+        p = Profiler()
+        with p:
+            lin_x = pt.to_tensor(np.random.randn(4, 8).astype("float32"))
+            import paddle_tpu.nn as nn
+            lin = nn.Linear(8, 8)
+            for _ in range(2):
+                _ = (lin(lin_x) ** 2).mean()
+        table = p.summary(time_unit="us")
+        for col in ("Calls", "Total(us)", "Avg(us)", "Max(us)", "Min(us)",
+                    "Ratio(%)"):
+            assert col in table
+        assert "matmul" in table or "linear" in table
+        assert "mean" in table
+
+    def test_summary_sorted_by(self):
+        from paddle_tpu.profiler import SortedKeys
+        p = Profiler()
+        with p:
+            with prof_mod.RecordEvent("zz_long"):
+                import time as _t
+                _t.sleep(0.002)
+            with prof_mod.RecordEvent("aa_short"):
+                pass
+        table = p.summary(sorted_by=SortedKeys.CPUTotal)
+        assert table.index("zz_long") < table.index("aa_short")
+
+
+class TestOpCounterUnderProfiler:
+    def test_dispatch_under_profiler_increments_counter(self):
+        """ISSUE satellite: op dispatch while a profiler is recording
+        must ALSO increment the monitor's per-op counter when the flag
+        is on (the two seams compose, not shadow)."""
+        from paddle_tpu import monitor
+        monitor.reset()
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        try:
+            p = Profiler()
+            with p:
+                x = pt.to_tensor(np.ones((4, 4), "float32"))
+                _ = x + x
+            snap = monitor.snapshot()
+            assert snap["counters"]["op.add.calls"] >= 1
+            # and the profiler saw the same span
+            assert any(e["name"] == "add" for e in p.events())
+        finally:
+            pt.set_flags({"FLAGS_enable_monitor": False})
+            monitor.reset()
