@@ -245,6 +245,7 @@ def _metrics_summary():
         hits, misses = c.get("jit.cache.hit", 0), c.get("jit.cache.miss", 0)
         at_h = c.get("autotune.cache.hit", 0)
         at_m = c.get("autotune.cache.miss", 0)
+        h = snap.get("histograms", {})
         return {
             "compile_count": misses,
             "jit_cache_hit_rate": round(hits / (hits + misses), 4)
@@ -252,6 +253,17 @@ def _metrics_summary():
             "autotune_cache_hit_rate": round(at_h / (at_h + at_m), 4)
             if at_h + at_m else None,
             "peak_tensor_bytes": g.get("tensor.bytes.peak"),
+            # fault-tolerant checkpoint layer (distributed/checkpoint):
+            # zeros when the bench run never checkpointed
+            "checkpoint": {
+                "saves": c.get("ckpt.saves", 0),
+                "save_bytes": c.get("ckpt.save.bytes", 0),
+                "commit_failures": c.get("ckpt.commit.failures", 0),
+                "restore_fallbacks": c.get("ckpt.restore.fallbacks", 0),
+                "gc_deleted": c.get("ckpt.gc.deleted", 0),
+                "gc_debris": c.get("ckpt.gc.debris", 0),
+                "save_duration_ms": h.get("ckpt.save.duration_ms"),
+            },
             "snapshot": monitor.dump_json(
                 run_id=f"bench-{os.getpid()}-{int(time.time())}"),
         }

@@ -6,12 +6,13 @@ are absent (no egress here)."""
 from __future__ import annotations
 
 from .core import enforce as E
-from .hapi.callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa
+from .hapi.callbacks import (Callback, EarlyStopping,  # noqa
+                             FaultTolerantCheckpoint, LRScheduler,
                              ModelCheckpoint, ProgBarLogger)
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
-           "WandbCallback"]
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint",
+           "FaultTolerantCheckpoint", "LRScheduler", "EarlyStopping",
+           "ReduceLROnPlateau", "VisualDL", "WandbCallback"]
 
 
 class ReduceLROnPlateau(Callback):

@@ -90,3 +90,7 @@ define_flag("default_matmul_precision", "default", "jax matmul precision: defaul
 define_flag("enable_monitor", False,
             "Collect runtime metrics (paddle_tpu.monitor counters/gauges/"
             "histograms) on the instrumented hot paths; off = one branch.")
+define_flag("fault_injection", "",
+            "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
+            " armed at import by paddle_tpu.testing.faults (actions: "
+            "raise|delay|kill; e.g. 'checkpoint.rename:kill:2').")

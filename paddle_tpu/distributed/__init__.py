@@ -38,7 +38,8 @@ from . import pipeline  # noqa  (collective-permute PP schedules)
 from .spawn import spawn  # noqa
 from .parallel import DataParallel  # noqa
 from . import checkpoint  # noqa
-from .checkpoint import load_state_dict, save_state_dict  # noqa
+from .checkpoint import (CheckpointManager, load_state_dict,  # noqa
+                         save_state_dict)
 from . import io  # noqa
 from .compat import (CountFilterEntry, DistAttr, DistModel,  # noqa
                      InMemoryDataset, ParallelMode, ProbabilityEntry,

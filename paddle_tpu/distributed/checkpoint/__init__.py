@@ -1,4 +1,5 @@
-"""Distributed checkpoint: save/load with reshard-on-load.
+"""Distributed checkpoint: save/load with reshard-on-load and an
+atomic commit protocol.
 
 Reference: python/paddle/distributed/checkpoint/{save_state_dict.py:104,
 load_state_dict.py:377, metadata.py} — per-rank shard files + a global
@@ -10,24 +11,112 @@ path walks addressable shards (each host writes only what it owns — the
 per-rank shard files of the reference) and the metadata records the global
 shape plus each shard's index window. Load assembles requested windows and
 ``device_put``s onto the *target* tensor's sharding — reshard-on-load for
-free, including across different meshes. Orbax is the production-grade
-equivalent; this implementation keeps the reference's on-disk model
-(metadata + shard files) explicit and dependency-light.
+free, including across different meshes.
+
+Crash consistency (Orbax-style commit protocol): every save stages into
+``<path>.tmp.<uid>`` — shard files, then the metadata, then a
+``checkpoint.manifest`` recording every file's size + CRC32 — and only
+after every host has finished writing does the coordinator rename the
+staging dir to ``<path>`` and drop a ``COMMIT`` marker. A ``kill -9`` at
+any instant therefore leaves either (a) a stale staging dir and the
+previous checkpoint untouched, (b) a fully-renamed dir missing only
+its COMMIT marker, or — only when overwriting an existing non-empty
+``path`` in place, which the manager's one-dir-per-step layout never
+does — (c) the previous checkpoint moved aside to ``<path>.old.<uid>``
+(raised failures move it back; CheckpointManager recovers graveyards
+left by kills). :func:`load_state_dict` refuses anything uncommitted
+or checksum-corrupt with an error that names the file.
+:mod:`paddle_tpu.testing.faults` points (``checkpoint.write`` /
+``checkpoint.metadata`` / ``checkpoint.rename`` / ``checkpoint.commit``)
+let tests kill the process at each stage; the crash-consistency suite
+holds the protocol to that contract.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import threading
+import time
+import zlib
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...testing import faults as _faults
+from ... import monitor as _monitor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "CheckpointError",
+           "is_committed", "verify_checkpoint"]
 
 _META_NAME = "0.metadata"
+_MANIFEST_NAME = "checkpoint.manifest"
+_COMMIT_NAME = "COMMIT"
+_FORMAT = "paddle_tpu_dckpt_v2"
+
+# process-local staging-uid sequence (multi-save-per-process uniqueness;
+# cross-process uniqueness comes from the pid component)
+_UID_SEQ = [0]
+# per-path save-attempt counts: every host saves the same paths in the
+# same order (failures propagate to all hosts through the status
+# gathers), so this yields host-identical collective tags even from the
+# async writer thread — see all_gather_object's tag contract
+_SAVE_ATTEMPTS: Dict[str, int] = {}
+
+# Tagged-gather KV reclamation. The coordination-service KV store never
+# frees keys on its own, and checkpointing makes tagged exchanges the
+# dominant producer (3 per save, one carrying full metadata), so each
+# process deletes ITS OWN keys once they are provably read: within one
+# STREAM (one checkpoint root) multi-host ops run in lockstep program
+# order on every host, so when this process starts the stream's op G,
+# every peer has finished reading op G-1's keys (it had to, to produce
+# the op-(G-1) keys this process already consumed) — the stream's keys
+# from ops <= G-2 are therefore dead. Generations are tracked per
+# stream and mutated under a lock: two live managers (two roots) save
+# from their own async writer threads concurrently.
+_TAG_MU = threading.Lock()
+_TAG_GENS: Dict[str, int] = {}
+_SPENT_KEYS: list = []      # (stream, generation, kv key this process wrote)
+
+
+def _begin_tagged_op_and_reclaim(stream: str) -> int:
+    """Open a new tagged-exchange generation for ``stream``; delete this
+    process's KV keys from that stream's generations at least two back.
+    Returns the generation."""
+    with _TAG_MU:
+        gen = _TAG_GENS.get(stream, 0) + 1
+        _TAG_GENS[stream] = gen
+        doomed = [k for s, g, k in _SPENT_KEYS
+                  if s == stream and g <= gen - 2]
+        _SPENT_KEYS[:] = [e for e in _SPENT_KEYS
+                          if not (e[0] == stream and e[1] <= gen - 2)]
+    if doomed:
+        from ..collective import _coord_client
+        client = _coord_client()
+        if client is not None:
+            for key in doomed:
+                try:
+                    client.key_value_delete(key)
+                except Exception:
+                    pass
+    return gen
+
+
+def _note_tagged_key(stream: str, tag: str):
+    """Record the KV key this process wrote for a tagged gather, for
+    later reclamation."""
+    from .. import env as _env
+    with _TAG_MU:
+        _SPENT_KEYS.append((stream, _TAG_GENS.get(stream, 0),
+                            f"ag_{tag}_{_env.get_rank()}"))
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory is unusable: uncommitted (interrupted
+    save) or corrupt (manifest checksum/size mismatch). The message
+    names the directory and the offending file."""
 
 
 def _flat_items(state_dict, prefix=""):
@@ -39,14 +128,196 @@ def _flat_items(state_dict, prefix=""):
             yield key, v
 
 
+def _local_uid() -> str:
+    _UID_SEQ[0] += 1
+    return f"{os.getpid()}.{_UID_SEQ[0]}"
+
+
+def _crc32_of(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+def _atomic_write_json(payload: dict, dest: str):
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dest)
+
+
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id: Optional[int] = None):
     """Reference: save_state_dict.py:104. Each host writes its addressable
-    shards; coordinator writes the metadata."""
-    os.makedirs(path, exist_ok=True)
+    shards; the coordinator writes the metadata + manifest, renames the
+    staging dir into place, and drops the COMMIT marker (atomic commit —
+    see the module docstring). Returns only after the commit is visible
+    on every host."""
+    t0 = time.perf_counter()
+    try:
+        _save_committed(state_dict, path, process_group,
+                        coordinator_rank, unique_id)
+    except BaseException:
+        _monitor.inc("ckpt.commit.failures",
+                     doc="checkpoint saves that failed before COMMIT")
+        raise
+    _monitor.inc("ckpt.saves", doc="committed checkpoint saves")
+    _monitor.observe("ckpt.save.duration_ms",
+                     (time.perf_counter() - t0) * 1e3,
+                     doc="wall time of one committed save (ms)")
+
+
+def _save_committed(state_dict, path, process_group, coordinator_rank,
+                    unique_id):
+    path = os.path.normpath(path)
+    multi = jax.process_count() > 1
     pid = jax.process_index()
-    meta = {"tensors": {}, "format": "paddle_tpu_dckpt_v1"}
-    shard_file = os.path.join(path, f"{pid}_0.distcp")
+    uid = str(unique_id) if unique_id is not None else _local_uid()
+    tag_base = None
+    if multi:
+        # every host must stage into the SAME directory: adopt the
+        # coordinator's uid proposal
+        from .. import collective as _coll
+        stream = os.path.dirname(path) or path
+        with _TAG_MU:
+            _SAVE_ATTEMPTS[path] = _SAVE_ATTEMPTS.get(path, 0) + 1
+            attempt = _SAVE_ATTEMPTS[path]
+        tag_base = f"dckpt{zlib.crc32(path.encode()):08x}a{attempt}"
+        _begin_tagged_op_and_reclaim(stream)
+        proposals: list = []
+        _coll.all_gather_object(proposals, uid, tag=f"{tag_base}.uid")
+        _note_tagged_key(stream, f"{tag_base}.uid")
+        uid = proposals[coordinator_rank]
+    staging = f"{path}.tmp.{uid}"
+    os.makedirs(staging, exist_ok=True)
+
+    # -- phase 1: every host writes its own shards into the staging dir.
+    # A raised local failure must still reach the metadata gather below
+    # (or the peers would block a full KV timeout on a missing
+    # contribution and then mis-pair later gathers), so it is carried as
+    # a status payload instead of propagating immediately.
+    local_err: Optional[BaseException] = None
+    meta: dict = {}
+    files: dict = {}
+    try:
+        meta, files = _write_local_shards(state_dict, staging, pid)
+    except BaseException as e:
+        if not multi:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        local_err = e
+    if multi:
+        # Multi-host: every host contributes its shard windows + file
+        # stats (or its write error); the coordinator merges before
+        # writing (the reference's global Metadata of tensor->shard
+        # mapping, metadata.py). Exchange rides the coordination-service
+        # KV store; the gather doubles as the write barrier — once it
+        # returns, every host's shard file is fully on disk.
+        from .. import collective as _coll
+        all_metas: list = []
+        _coll.all_gather_object(all_metas, {
+            "meta": meta, "files": files,
+            "error": repr(local_err) if local_err is not None else None},
+            tag=f"{tag_base}.meta")
+        _note_tagged_key(stream, f"{tag_base}.meta")
+        peer_errs = [p["error"] for p in all_metas if p["error"]]
+        if local_err is not None or peer_errs:
+            if pid == coordinator_rank:
+                shutil.rmtree(staging, ignore_errors=True)
+            if local_err is not None:
+                raise local_err
+            raise CheckpointError(
+                f"checkpoint save to {path!r} aborted: a peer host "
+                f"failed writing its shards ({peer_errs[0]})")
+        if pid == coordinator_rank:
+            merged = {"tensors": {}, "format": meta["format"]}
+            for payload in all_metas:
+                files.update(payload["files"])
+                for key, entry in payload["meta"]["tensors"].items():
+                    if entry.get("kind") == "object":
+                        merged["tensors"].setdefault(key, entry)
+                        continue
+                    tgt = merged["tensors"].setdefault(
+                        key, {**entry, "shards": []})
+                    windows = {tuple(map(tuple, s["window"]))
+                               for s in tgt["shards"]}
+                    for s in entry["shards"]:
+                        if tuple(map(tuple, s["window"])) not in windows:
+                            tgt["shards"].append(s)
+            meta = merged
+
+    # -- phase 2: the coordinator writes metadata + manifest, renames
+    # the staging dir into place, and drops the COMMIT marker. Its
+    # outcome is broadcast in phase 3, so a commit failure surfaces on
+    # every host instead of as a bare barrier timeout.
+    commit_err: Optional[BaseException] = None
+    if pid == coordinator_rank:
+        graveyard = None
+        try:
+            _faults.hit("checkpoint.metadata")
+            meta_path = os.path.join(staging, _META_NAME)
+            _atomic_write_json(meta, meta_path)
+            files[_META_NAME] = {"size": os.path.getsize(meta_path),
+                                 "crc32": _crc32_of(meta_path)}
+            _atomic_write_json(
+                {"format": _FORMAT, "uid": uid, "files": files},
+                os.path.join(staging, _MANIFEST_NAME))
+            _faults.hit("checkpoint.rename")
+            if os.path.exists(path):
+                if os.listdir(path):
+                    # overwrite of a live directory: move it aside first
+                    # (rename(2) cannot replace a non-empty dir). A kill
+                    # inside this window strands the old checkpoint at
+                    # <path>.old.<uid>; CheckpointManager recovers such
+                    # graveyards, and the manager's normal layout (a
+                    # fresh dir per step) never takes this branch.
+                    graveyard = f"{path}.old.{uid}"
+                    os.rename(path, graveyard)
+                else:
+                    os.rmdir(path)
+            os.rename(staging, path)
+            _faults.hit("checkpoint.commit")
+            _atomic_write_json({"uid": uid, "ts": time.time()},
+                               os.path.join(path, _COMMIT_NAME))
+            if graveyard is not None:
+                shutil.rmtree(graveyard, ignore_errors=True)
+        except BaseException as e:
+            commit_err = e
+            _restore_graveyard(path, graveyard)
+            shutil.rmtree(staging, ignore_errors=True)
+    if multi:
+        # phase 3: commit-status exchange — doubles as the return
+        # barrier (no host returns — or exits — before the commit
+        # landed; each gather uses a fresh KV key, so a failed round
+        # can't pair with a later save's)
+        from .. import collective as _coll
+        statuses: list = []
+        _coll.all_gather_object(
+            statuses, repr(commit_err) if commit_err is not None else None,
+            tag=f"{tag_base}.status")
+        _note_tagged_key(stream, f"{tag_base}.status")
+        if commit_err is not None:
+            raise commit_err
+        bad = [s for s in statuses if s]
+        if bad:
+            raise CheckpointError(
+                f"checkpoint save to {path!r} aborted: the coordinator "
+                f"failed to commit ({bad[0]})")
+    elif commit_err is not None:
+        raise commit_err
+
+
+def _write_local_shards(state_dict, staging: str, pid: int):
+    """Phase 1 of the commit protocol: write this host's shard file into
+    the staging dir; returns (local metadata, {fname: {size, crc32}})."""
+    meta = {"tensors": {}, "format": _FORMAT}
+    shard_file = os.path.join(staging, f"{pid}_0.distcp")
     blobs = {}
     for key, v in _flat_items(state_dict):
         if isinstance(v, Tensor):
@@ -74,44 +345,104 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                 {"window": [list(w) for w in window],
                  "file": os.path.basename(shard_file), "key": blob_key})
         meta["tensors"][key] = entry
+    _faults.hit("checkpoint.write")
     np.savez(shard_file, **blobs)
     # np.savez appends .npz — normalize name.
     if os.path.exists(shard_file + ".npz"):
         os.replace(shard_file + ".npz", shard_file)
-    if jax.process_count() > 1:
-        # Multi-host: every host contributes its shard windows; the
-        # coordinator merges before writing (the reference's global Metadata
-        # of tensor->shard mapping, metadata.py). Exchange rides the
-        # coordination-service KV store (collective.all_gather_object).
-        from .. import collective as _coll
-        all_metas: list = []
-        _coll.all_gather_object(all_metas, meta)
-        if jax.process_index() == coordinator_rank:
-            merged = {"tensors": {}, "format": meta["format"]}
-            for m in all_metas:
-                for key, entry in m["tensors"].items():
-                    if entry.get("kind") == "object":
-                        merged["tensors"].setdefault(key, entry)
-                        continue
-                    tgt = merged["tensors"].setdefault(
-                        key, {**entry, "shards": []})
-                    windows = {tuple(map(tuple, s["window"]))
-                               for s in tgt["shards"]}
-                    for s in entry["shards"]:
-                        if tuple(map(tuple, s["window"])) not in windows:
-                            tgt["shards"].append(s)
-            meta = merged
-    if jax.process_index() == coordinator_rank:
-        with open(os.path.join(path, _META_NAME), "w") as f:
-            json.dump(meta, f)
+    files = {os.path.basename(shard_file): {
+        "size": os.path.getsize(shard_file),
+        "crc32": _crc32_of(shard_file)}}
+    _monitor.inc("ckpt.save.bytes",
+                 files[os.path.basename(shard_file)]["size"],
+                 doc="shard bytes written by committed+failed saves")
+    return meta, files
+
+
+def _restore_graveyard(path: str, graveyard: Optional[str]):
+    """Undo a move-aside after a raised commit failure: put the
+    previously-committed checkpoint back at ``path`` (dropping an
+    uncommitted half-renamed staging dir if one landed there)."""
+    if graveyard is None or not os.path.exists(graveyard):
+        return
+    try:
+        if os.path.exists(path):
+            if os.path.isfile(os.path.join(path, _COMMIT_NAME)):
+                return          # a committed checkpoint won; keep it
+            shutil.rmtree(path, ignore_errors=True)
+        os.rename(graveyard, path)
+    except OSError:
+        pass
+
+
+def is_committed(path: str) -> bool:
+    """True when ``path`` holds a fully-committed checkpoint (COMMIT
+    marker + manifest + metadata present)."""
+    return (os.path.isfile(os.path.join(path, _COMMIT_NAME))
+            and os.path.isfile(os.path.join(path, _MANIFEST_NAME))
+            and os.path.isfile(os.path.join(path, _META_NAME)))
+
+
+def verify_checkpoint(path: str):
+    """Raise :class:`CheckpointError` unless ``path`` is committed and
+    every manifest file matches its recorded size and CRC32."""
+    if not os.path.isdir(path):
+        raise CheckpointError(f"checkpoint dir {path!r} does not exist")
+    if not os.path.isfile(os.path.join(path, _COMMIT_NAME)):
+        raise CheckpointError(
+            f"checkpoint {path!r} has no COMMIT marker — the save was "
+            "interrupted before commit; refusing to load a partial "
+            "checkpoint (restore from the previous committed one)")
+    manifest_path = os.path.join(path, _MANIFEST_NAME)
+    if not os.path.isfile(manifest_path):
+        raise CheckpointError(
+            f"checkpoint {path!r} is committed but has no manifest — "
+            "cannot verify integrity")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"checkpoint {path!r}: unreadable manifest: {e}") from e
+    for fname, rec in manifest.get("files", {}).items():
+        fpath = os.path.join(path, fname)
+        if not os.path.isfile(fpath):
+            raise CheckpointError(
+                f"checkpoint {path!r}: file {fname!r} listed in the "
+                "manifest is missing")
+        size = os.path.getsize(fpath)
+        if size != rec["size"]:
+            raise CheckpointError(
+                f"checkpoint {path!r}: file {fname!r} is {size} bytes, "
+                f"manifest says {rec['size']} — truncated or overwritten")
+        crc = _crc32_of(fpath)
+        if crc != rec["crc32"]:
+            raise CheckpointError(
+                f"checkpoint {path!r}: file {fname!r} fails its CRC32 "
+                f"check ({crc:#010x} != manifest {rec['crc32']:#010x}) "
+                "— corrupt")
 
 
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id: Optional[int] = None,
-                    offload: bool = False):
+                    offload: bool = False, verify: bool = True):
     """Reference: load_state_dict.py:377 — fills ``state_dict`` in place,
-    resharding saved shards onto each target tensor's current sharding."""
-    with open(os.path.join(path, _META_NAME)) as f:
+    resharding saved shards onto each target tensor's current sharding.
+
+    ``verify=True`` (default) enforces the commit protocol: an
+    uncommitted or checksum-failing directory raises
+    :class:`CheckpointError` instead of half-loading. The CRC pass costs
+    one extra sequential read of the checkpoint before the load — paid
+    only on restores, which are rare and correctness-critical. Pass
+    ``verify=False`` to skip it (and to read pre-protocol v1 dirs)."""
+    if verify:
+        verify_checkpoint(path)
+    meta_path = os.path.join(path, _META_NAME)
+    if not os.path.isfile(meta_path):
+        raise CheckpointError(
+            f"checkpoint {path!r} has no {_META_NAME} — not a "
+            "checkpoint directory")
+    with open(meta_path) as f:
         meta = json.load(f)
     files = {}
 
@@ -183,7 +514,8 @@ def async_save_state_dict(state_dict: Dict, path: str, process_group=None,
                           unique_id: Optional[int] = None) -> AsyncSaveHandle:
     """Checkpoint without blocking training: the device->host snapshot
     happens now (so the caller may mutate parameters immediately after
-    return); file IO and the metadata merge run on a background thread.
+    return); file IO, the metadata merge, and the atomic commit run on a
+    background thread.
 
     TPU-native note: the snapshot is the unavoidable synchronous cost
     (HBM->host copy); overlapping the *disk* write is where the win is —
@@ -227,3 +559,7 @@ def async_save_state_dict(state_dict: Dict, path: str, process_group=None,
 
 
 __all__ += ["async_save_state_dict", "AsyncSaveHandle"]
+
+from .manager import CheckpointManager  # noqa: E402
+
+__all__ += ["CheckpointManager"]

@@ -26,6 +26,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..core.tensor import Tensor, _nbytes_of
+from ..testing import faults as _faults
 from . import env
 from ..core import enforce as E
 
@@ -221,20 +222,29 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor, group=None,
 _AG_SEQ = [0]
 
 
-def all_gather_object(object_list: List, obj, group=None):
+def all_gather_object(object_list: List, obj, group=None, tag=None):
     """Host object exchange. Multi-host: via the coordination-service KV
-    store (jax.distributed client), mirroring TCPStore exchange."""
+    store (jax.distributed client), mirroring TCPStore exchange.
+
+    Untagged calls pair across hosts by a per-process sequence counter,
+    which is only sound when every host issues its collectives in the
+    same order from ONE thread. Callers running off the main thread
+    (e.g. the async checkpoint writer) must pass an explicit ``tag``
+    that is identical across hosts and unique per exchange — tagged
+    rounds use their own KV keys and cannot mis-pair with the counter."""
+    _faults.hit("collective.gather")
     n = _group_size(group)
     client = _coord_client()
     if client is not None and n > 1:
-        seq = _AG_SEQ[0]
-        _AG_SEQ[0] += 1
+        if tag is None:
+            tag = _AG_SEQ[0]
+            _AG_SEQ[0] += 1
         me = env.get_rank()
         blob = pickle.dumps(obj).hex()
-        client.key_value_set(f"ag_{seq}_{me}", blob)
+        client.key_value_set(f"ag_{tag}_{me}", blob)
         object_list.clear()
         for r in range(n):
-            data = client.blocking_key_value_get(f"ag_{seq}_{r}", 60_000)
+            data = client.blocking_key_value_get(f"ag_{tag}_{r}", 60_000)
             object_list.append(pickle.loads(bytes.fromhex(data)))
     else:
         object_list.clear()

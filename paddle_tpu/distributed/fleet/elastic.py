@@ -267,74 +267,109 @@ def elastic_run_index() -> int:
     return int(os.environ.get("PADDLE_ELASTIC_RUN", "0"))
 
 
-def save_state(step: int, state_dict, blocking: bool = False,
-               prev_handle=None):
-    """Checkpoint one training step for elastic resume. Uses the
-    distributed async checkpoint (distributed/checkpoint: snapshot now,
-    write in background, shard-aware, reshards on load at a different
-    world size). The ``latest`` pointer advances only after a save
-    completes, so a kill mid-write can never be resumed from.
+# One CheckpointManager per checkpoint root (workers call save_state /
+# load_state with only the env var set; the manager carries the commit
+# protocol, retention, discovery, and the SIGTERM finalize hook).
+_MANAGERS: dict = {}
 
-    Returns a handle; pass it back as ``prev_handle`` on the next call
-    (a 1-deep pipeline: step N's save overlaps step N+1's compute), and
-    call ``finish_saves(handle)`` once after the loop."""
+
+def _manager_for(root: str):
     import os
 
-    from .. import checkpoint as dckpt
+    mgr = _MANAGERS.get(root)
+    if mgr is None:
+        from ..checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(
+            root,
+            keep_last_n=int(os.environ.get("PADDLE_ELASTIC_KEEP_CKPTS",
+                                           "2")),
+            async_save=True)
+        # preemption (launcher fail-fast SIGTERM): finalize the
+        # in-flight save — or take an emergency sync save — before
+        # dying, so the restarted world resumes from the step the
+        # worker was actually on
+        mgr.install_preemption_hook()
+        _MANAGERS[root] = mgr
+    return mgr
+
+
+def save_state(step: int, state_dict, blocking: bool = False,
+               prev_handle=None):
+    """Checkpoint one training step for elastic resume via the
+    CheckpointManager: atomic commit (a kill mid-write can never be
+    resumed from), async by default (snapshot now, write in background,
+    shard-aware, reshards on load at a different world size), keep-last-N
+    retention. The manager itself finalizes the previous save before
+    staging the next (a 1-deep pipeline: step N's save overlaps step
+    N+1's compute), so ``prev_handle`` is accepted only for backward
+    compatibility and ignored.
+
+    Returns the per-root manager (or None when blocking or no
+    PADDLE_ELASTIC_CKPT_DIR is set); pass whatever was returned to
+    ``finish_saves`` once after the loop to join the final save."""
+    import os
 
     root = os.environ.get("PADDLE_ELASTIC_CKPT_DIR")
     if not root:
         return None
-    if prev_handle is not None:
-        finish_saves(prev_handle)
-    path = os.path.join(root, f"step{step}")
-    handle = _CompletedSave(dckpt.async_save_state_dict(
-        dict(state_dict), path), step, root)
-    if blocking:
-        finish_saves(handle)
-        return None
-    return handle
-
-
-class _CompletedSave:
-    __slots__ = ("handle", "step", "root")
-
-    def __init__(self, handle, step, root):
-        self.handle, self.step, self.root = handle, step, root
+    mgr = _manager_for(root)
+    mgr.save(step, dict(state_dict), blocking=blocking)
+    return None if blocking else mgr
 
 
 def finish_saves(pending) -> bool:
-    """Wait for an in-flight elastic save; rank 0 then advances the
-    ``latest`` pointer atomically."""
-    import os
-
-    from ..env import get_rank
-
+    """Finalize an in-flight elastic save (join + retention GC)."""
     if pending is None:
         return False
-    pending.handle.result()
-    if get_rank() == 0:
-        tmp = os.path.join(pending.root, f".latest.tmp.{os.getpid()}")
-        with open(tmp, "w") as f:
-            f.write(str(pending.step))
-        os.replace(tmp, os.path.join(pending.root, "latest"))
+    pending.wait()
     return True
 
 
 def load_state(template_state_dict):
     """Resume point for an elastic worker: (start_step, state). Loads the
-    newest completed checkpoint into ``template_state_dict`` (sharded
-    values reshard to the CURRENT world's placements), or returns
-    (0, template) on a fresh start."""
+    newest COMMITTED checkpoint into ``template_state_dict`` (sharded
+    values reshard to the CURRENT world's placements), skipping
+    incomplete or corrupt directories, or returns (0, template) on a
+    fresh start. Falls back to a pre-commit-protocol layout (``latest``
+    pointer + ``step<N>`` dirs) so jobs upgraded mid-flight keep their
+    resume point."""
     import os
 
+    root = os.environ.get("PADDLE_ELASTIC_CKPT_DIR")
+    if not root or not os.path.isdir(root):
+        return 0, template_state_dict
+    mgr = _manager_for(root)
+    full = dict(template_state_dict)
+    step = mgr.restore_latest(full)
+    if step is None:
+        legacy = _load_legacy_state(root, template_state_dict)
+        if legacy is not None:
+            return legacy
+        return 0, template_state_dict
+    return step, full
+
+
+def _load_legacy_state(root, template_state_dict):
+    """Resume from a checkpoint dir written before the commit protocol:
+    the old rank-0 ``latest`` pointer named the ``step<N>`` dir (no
+    underscore, no COMMIT/manifest). Best-effort — any failure means a
+    fresh start, as before."""
+    import os
+
+    latest = os.path.join(root, "latest")
+    if not os.path.isfile(latest):
+        return None
     from .. import checkpoint as dckpt
 
-    root = os.environ.get("PADDLE_ELASTIC_CKPT_DIR")
-    latest = os.path.join(root, "latest") if root else None
-    if not latest or not os.path.exists(latest):
-        return 0, template_state_dict
-    step = int(open(latest).read().strip())
-    full = dict(template_state_dict)
-    dckpt.load_state_dict(full, os.path.join(root, f"step{step}"))
-    return step, full
+    try:
+        step = int(open(latest).read().strip())
+        full = dict(template_state_dict)
+        dckpt.load_state_dict(full, os.path.join(root, f"step{step}"),
+                              verify=False)
+        return step, full
+    except Exception as e:
+        import sys
+        print(f"[elastic] legacy checkpoint at {root!r} unusable "
+              f"({type(e).__name__}: {e}); starting fresh", file=sys.stderr)
+        return None

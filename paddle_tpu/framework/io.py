@@ -2,13 +2,18 @@
 (reference: python/paddle/framework/io.py).
 
 State dicts of Tensors are stored as pickled numpy arrays; nested containers
-are preserved. Distributed (sharded) checkpointing lives in
-distributed/checkpoint/."""
+are preserved. Writes are atomic (staged next to the destination, then
+``os.replace``d) so a crash mid-save can never truncate an existing
+checkpoint. Distributed (sharded) checkpointing — including the commit
+protocol and CheckpointManager — lives in distributed/checkpoint/."""
 from __future__ import annotations
 
+import os
 import pickle
+import threading
 from pathlib import Path
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -31,8 +36,9 @@ def _from_storable(obj, return_numpy=False):
         if obj.get("__pt_tensor__"):
             if return_numpy:
                 return obj["data"]
-            t = Tensor(__import__("jax.numpy", fromlist=["asarray"]).asarray(obj["data"]),
-                       stop_gradient=obj["stop_gradient"], name=obj.get("name"))
+            t = Tensor(jnp.asarray(obj["data"]),
+                       stop_gradient=obj["stop_gradient"],
+                       name=obj.get("name"))
             return t
         return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -42,17 +48,39 @@ def _from_storable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4):
-    """paddle.save parity."""
+    """paddle.save parity. Atomic: pickles into a same-directory temp
+    file and ``os.replace``s it over ``path``, so a crash (or a raising
+    ``__reduce__``) mid-write never truncates an existing checkpoint."""
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
-    with open(p, "wb") as f:
-        pickle.dump(_to_storable(obj), f, protocol=protocol)
+    # pid + thread id: a concurrent save of the same path from another
+    # process or thread must not share the staging file
+    tmp = p.parent / f"{p.name}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_storable(obj), f, protocol=protocol)
+        os.replace(tmp, p)
+    finally:
+        if tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
 
 
 def load(path, return_numpy=False):
     """paddle.load parity."""
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    except FileNotFoundError:
+        raise
+    except (pickle.UnpicklingError, EOFError, AttributeError,
+            ImportError, IndexError, UnicodeDecodeError) as e:
+        raise RuntimeError(
+            f"paddle.load: failed to unpickle checkpoint at {str(path)!r} "
+            f"({type(e).__name__}: {e}) — the file is truncated, corrupt, "
+            "or not a paddle checkpoint") from e
     return _from_storable(obj, return_numpy=return_numpy)
 
 
@@ -62,20 +90,11 @@ def load(path, return_numpy=False):
 # training continuing to mutate params), the pickle+write runs in the
 # background. ---------------------------------------------------------------
 _ASYNC_TASKS: list = []
-_ASYNC_LOCK = None   # created lazily (threading import stays local)
-
-
-def _async_worker(snap, path, protocol):
-    # atomic write: a crash/exit mid-pickle can never corrupt an
-    # existing checkpoint at `path`
-    import os
-    tmp = f"{path}.tmp.{os.getpid()}"
-    save(snap, tmp, protocol)
-    os.replace(tmp, path)
+_ASYNC_MU = threading.Lock()        # guards the task list
+_ASYNC_WRITE_MU = threading.Lock()  # serializes the actual writes
 
 
 def _snapshot(obj):
-    import numpy as np
     import jax
 
     def leaf(x):
@@ -91,10 +110,6 @@ def async_save(obj, path, protocol=4, sync_other_task=False):
     """save() that returns immediately; the write happens on a
     background thread (device->host snapshot is taken synchronously so
     later param mutation can't corrupt the checkpoint)."""
-    import threading
-    global _ASYNC_LOCK
-    if _ASYNC_LOCK is None:
-        _ASYNC_LOCK = threading.Lock()
     if sync_other_task:
         clear_async_save_task_queue()
     snap = _snapshot(obj)
@@ -102,18 +117,30 @@ def async_save(obj, path, protocol=4, sync_other_task=False):
     def run():
         # one write at a time: concurrent saves (same or different
         # paths) serialize instead of interleaving on a shared file
-        with _ASYNC_LOCK:
-            _async_worker(snap, path, protocol)
+        with _ASYNC_WRITE_MU:
+            save(snap, path, protocol)
 
     th = threading.Thread(target=run, daemon=True)
-    th.start()
-    _ASYNC_TASKS.append(th)
+    with _ASYNC_MU:
+        # prune finished writers here, not only in the drain call —
+        # otherwise a long-lived trainer that never drains leaks one
+        # dead Thread object per save. Start under the lock: an
+        # unstarted thread reads as not-alive, so a concurrent prune
+        # would silently drop it from the queue.
+        _ASYNC_TASKS[:] = [t for t in _ASYNC_TASKS if t.is_alive()]
+        th.start()
+        _ASYNC_TASKS.append(th)
     return th
 
 
 def clear_async_save_task_queue():
     """Block until every queued async_save has finished writing."""
-    while _ASYNC_TASKS:
-        th = _ASYNC_TASKS.pop()
+    while True:
+        with _ASYNC_MU:
+            if not _ASYNC_TASKS:
+                return
+            th = _ASYNC_TASKS.pop()
+        # join outside the lock: a writer appending concurrently (via
+        # async_save) must not deadlock against a long join
         if th.is_alive():
             th.join()

@@ -1,0 +1,11 @@
+"""paddle_tpu.testing — test-support utilities that ship with the
+package (reference capability: paddle.incubate's test helpers +
+the fault-injection discipline of production checkpoint stacks).
+
+Currently: :mod:`paddle_tpu.testing.faults`, a deterministic
+fault-injection harness used by the crash-consistency test suite and
+available for chaos runs via ``FLAGS_fault_injection``.
+"""
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -1,0 +1,186 @@
+"""Deterministic fault injection for crash-consistency testing.
+
+Production checkpoint stacks (Orbax, the reference's fleet elastic
+layer) earn their atomicity claims by killing themselves mid-save in CI.
+This module provides the knife: code under test declares **named
+injection points** (``faults.hit("checkpoint.rename")``), and a test —
+or a chaos run via ``FLAGS_fault_injection`` — arms an action at a
+point:
+
+- ``raise``  raise :class:`FaultInjected` (clean in-process failure)
+- ``delay``  sleep ``delay_s`` (widen race windows, keep going)
+- ``kill``   ``os._exit(137)`` — the ``kill -9`` equivalent: no
+  ``finally`` blocks, no ``atexit``, nothing flushed.
+
+Arming is per-point with an ``nth`` trigger (fire on the Nth hit,
+1-based), so a test can let the first save succeed and murder the
+second. Disarmed, ``hit()`` is one list-indexing branch.
+
+In-process use::
+
+    from paddle_tpu.testing import faults
+    with faults.injected("checkpoint.rename", action="raise"):
+        mgr.save(2, state)          # raises FaultInjected mid-commit
+
+Cross-process use (chaos runs, subprocess crash tests)::
+
+    FLAGS_fault_injection=checkpoint.write:kill:1 python train.py
+
+The flag is parsed once at import; the spec is a comma-separated list
+of ``point:action[:nth[:delay_s]]``.
+
+Known injection points (grep ``faults.hit`` for the live list):
+
+- ``checkpoint.write``     before a shard file is written
+- ``checkpoint.metadata``  before the coordinator writes metadata+manifest
+- ``checkpoint.rename``    before the tmp-dir -> final-dir rename
+- ``checkpoint.commit``    before the COMMIT marker lands
+- ``collective.gather``    inside ``all_gather_object``
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["FaultInjected", "inject", "clear", "injected", "hit",
+           "hit_count", "armed", "KILL_EXIT_CODE"]
+
+# 128 + SIGKILL(9): what a shell reports for a kill -9'd process.
+KILL_EXIT_CODE = 137
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``raise`` injection point."""
+
+
+class _Injection:
+    __slots__ = ("point", "action", "nth", "delay_s", "hits", "fired")
+
+    def __init__(self, point: str, action: str, nth: int, delay_s: float):
+        if action not in ("raise", "delay", "kill"):
+            raise ValueError(f"unknown fault action {action!r} "
+                             "(want raise|delay|kill)")
+        if nth < 1:
+            raise ValueError(f"nth must be >= 1, got {nth}")
+        self.point = point
+        self.action = action
+        self.nth = nth
+        self.delay_s = delay_s
+        self.hits = 0
+        self.fired = False
+
+
+_MU = threading.Lock()
+_POINTS: Dict[str, _Injection] = {}
+_HITS: Dict[str, int] = {}       # lifetime hit counts, armed or not
+# One-element armed gate: the disarmed hot path reads it without the
+# lock (list indexing is GIL-atomic) and returns immediately.
+_ARMED = [False]
+
+
+def inject(point: str, action: str = "raise", nth: int = 1,
+           delay_s: float = 0.05):
+    """Arm ``point`` to fire ``action`` on its ``nth`` hit (counted from
+    now). Re-arming a point resets its hit count."""
+    inj = _Injection(point, action, nth, delay_s)
+    with _MU:
+        _POINTS[point] = inj
+        _ARMED[0] = True
+    return inj
+
+
+def clear(point: Optional[str] = None):
+    """Disarm one point (or all of them); lifetime hit counts survive."""
+    with _MU:
+        if point is None:
+            _POINTS.clear()
+        else:
+            _POINTS.pop(point, None)
+        _ARMED[0] = bool(_POINTS)
+
+
+class injected:
+    """Context manager: arm on enter, disarm (that point) on exit."""
+
+    def __init__(self, point: str, action: str = "raise", nth: int = 1,
+                 delay_s: float = 0.05):
+        self._args = (point, action, nth, delay_s)
+
+    def __enter__(self):
+        return inject(*self._args)
+
+    def __exit__(self, *exc):
+        clear(self._args[0])
+        return False
+
+
+def hit(point: str):
+    """Declare an injection point. No-op (one branch) unless a test or
+    ``FLAGS_fault_injection`` armed this point."""
+    if not _ARMED[0]:
+        return
+    with _MU:
+        _HITS[point] = _HITS.get(point, 0) + 1
+        inj = _POINTS.get(point)
+        if inj is None or inj.fired:
+            return
+        inj.hits += 1
+        if inj.hits < inj.nth:
+            return
+        inj.fired = True
+        action, delay_s = inj.action, inj.delay_s
+    # fire outside the lock: delay must not serialize unrelated points,
+    # and a raise must not leave the lock held
+    if action == "delay":
+        time.sleep(delay_s)
+        return
+    if action == "kill":
+        os._exit(KILL_EXIT_CODE)
+    raise FaultInjected(f"fault injected at {point!r}")
+
+
+def hit_count(point: str) -> int:
+    """Lifetime hits at ``point`` while *any* point was armed (the
+    harness only counts when the gate is up, keeping hit() free in
+    production)."""
+    with _MU:
+        return _HITS.get(point, 0)
+
+
+def armed() -> bool:
+    return _ARMED[0]
+
+
+def _arm_from_spec(spec: str):
+    """Parse a ``point:action[:nth[:delay_s]]`` comma list (the
+    ``FLAGS_fault_injection`` format) and arm every entry."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2:
+            raise ValueError(
+                f"bad FLAGS_fault_injection entry {part!r}: want "
+                "point:action[:nth[:delay_s]]")
+        point, action = bits[0], bits[1]
+        nth = int(bits[2]) if len(bits) > 2 else 1
+        delay_s = float(bits[3]) if len(bits) > 3 else 0.05
+        inject(point, action=action, nth=nth, delay_s=delay_s)
+
+
+def _init_from_flag():
+    # core.flags reads the FLAGS_fault_injection env var at registration;
+    # going through the registry keeps set_flags introspection working.
+    try:
+        from ..core import flags as _flags
+        spec = _flags.flag_value("fault_injection")
+    except Exception:
+        spec = os.environ.get("FLAGS_fault_injection", "")
+    if spec:
+        _arm_from_spec(spec)
+
+
+_init_from_flag()

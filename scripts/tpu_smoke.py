@@ -230,6 +230,50 @@ def main():
         t = np.asarray(toks)
         assert t.shape == (2, 4) and (t >= 0).all()
 
+    @case("checkpoint_save_kill_resume")
+    def _():
+        # crash-consistency on the real machine: a child process commits
+        # step 1, is kill -9'd (via the fault harness) mid-step-2 save,
+        # and THIS process must restore step 1 bit-for-bit
+        import subprocess
+        import tempfile
+
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+        from paddle_tpu.testing import faults as _faults
+
+        root = os.path.join(tempfile.mkdtemp(prefix="smoke_ckpt_"), "root")
+        child = (
+            "import os, sys\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as _np\n"
+            "import paddle_tpu as _pt\n"
+            "from paddle_tpu.distributed.checkpoint import "
+            "CheckpointManager\n"
+            "m = CheckpointManager(sys.argv[1], keep_last_n=3)\n"
+            "w = _np.arange(12, dtype='float32').reshape(3, 4)\n"
+            "m.save(1, {'w': _pt.to_tensor(w + 1), 'step': 1})\n"
+            "m.save(2, {'w': _pt.to_tensor(w + 2), 'step': 2})\n"
+            "print('SAVED2')\n")
+        r = subprocess.run(
+            [sys.executable, "-c", child, root],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                     FLAGS_fault_injection="checkpoint.rename:kill:2"))
+        if r.returncode != _faults.KILL_EXIT_CODE or "SAVED2" in r.stdout:
+            raise RuntimeError(
+                f"child survived the injected kill: rc={r.returncode} "
+                f"{r.stderr[-500:]}")
+        mgr = CheckpointManager(root)
+        target = {"w": paddle.to_tensor(np.zeros((3, 4), "float32")),
+                  "step": 0}
+        step = mgr.restore_latest(target)
+        got = np.asarray(target["w"].numpy())
+        want = np.arange(12, dtype="float32").reshape(3, 4) + 1
+        if step != 1 or not np.array_equal(got, want):
+            raise RuntimeError(
+                f"resume after kill wrong: step={step} w={got.tolist()}")
+
     @case("flash_block_autotune_bench_shape")
     def _():
         # pre-tune the bench shapes; winners land in the REPO cache that

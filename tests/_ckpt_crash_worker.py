@@ -1,0 +1,64 @@
+"""Worker for the multi-process crash-consistency test (run via the
+launch CLI, not collected by pytest).
+
+Both ranks save a committed step-1 checkpoint, then start a step-2 save
+during which the coordinator is killed (kill -9 equivalent) by the
+fault-injection harness, armed from the FLAGS_fault_injection env var
+set by the test (e.g. ``checkpoint.rename:kill:2`` — the coordinator's
+second rename hit is step 2's commit). The launcher's fail-fast watcher
+then tears down the surviving rank. The parent test asserts that the
+step-1 checkpoint is still committed, manifest-clean, and bit-for-bit
+restorable while step 2 never became visible.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+
+def _state(step: int):
+    return {
+        "w": pt.Tensor(jax.numpy.asarray(
+            np.arange(12, dtype=np.float32).reshape(3, 4) + step)),
+        "step": step,
+    }
+
+
+def main():
+    root = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "crash"
+    dist.init_parallel_env()
+    assert dist.get_world_size() == 2, dist.get_world_size()
+    mgr = CheckpointManager(root, keep_last_n=3)
+    if mode == "restore":
+        # the multi-host restore path: candidate agreement + per-step
+        # verification gathers, then every rank loads the same step
+        target = {"w": pt.Tensor(jax.numpy.zeros((3, 4), "float32")),
+                  "step": 0}
+        step = mgr.restore_latest(target)
+        got = np.asarray(target["w"]._data)
+        want = np.arange(12, dtype=np.float32).reshape(3, 4) + step
+        assert np.array_equal(got, want), (step, got)
+        assert target["step"] == step
+        print(f"RESTORED{step} rank={dist.get_rank()}", flush=True)
+        return
+    mgr.save(1, _state(1))
+    print(f"SAVED1 rank={dist.get_rank()}", flush=True)
+    # the armed kill fires inside this save on the coordinator; the
+    # other rank blocks in the commit barrier until fail-fast reaps it
+    mgr.save(2, _state(2))
+    print(f"SAVED2 rank={dist.get_rank()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
