@@ -22,7 +22,24 @@ from ..core import enforce as E
 from ..core import jax_compat as _jax_compat  # noqa: F401  (jax.export shim)
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "PrecisionType", "PlaceType", "get_version",
+           "PageAllocator", "PagedKVCache", "Request", "RequestOutput",
+           "ServingEngine"]
+
+_SERVING = {"PageAllocator": "paged", "PagedKVCache": "paged",
+            "Request": "engine", "RequestOutput": "engine",
+            "ServingEngine": "engine"}
+
+
+def __getattr__(name):
+    # Lazy: the serving stack pulls in the model families; the static
+    # Predictor surface must stay importable without them (and without
+    # a circular import during package init).
+    if name in _SERVING:
+        import importlib
+        mod = importlib.import_module(f".{_SERVING[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def get_version() -> str:

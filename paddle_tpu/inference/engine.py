@@ -1,0 +1,577 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Reference capability: the vLLM/TGI scheduler loop (and the reference's
+fastdeploy serving stack) — a request queue feeding a fixed grid of
+decode slots, admission gated on free KV pages, prefill-then-join so a
+new request enters the running batch without draining it, retirement
+freeing pages the moment a sequence finishes — rebuilt TPU-native:
+
+- The decode data plane is ONE jitted program over the static
+  ``[num_slots]`` grid (paged_decode_step + vectorised sampling inside
+  a ``lax.scan`` of ``decode_chunk`` steps), so continuous batching
+  never retraces: joins/retires only permute host-side block tables
+  between chunks. One device round-trip per chunk, not per token.
+- Admission policy: a request is admitted when a slot is free AND the
+  pool keeps >= ``watermark`` free pages after its prompt allocation —
+  the page headroom that lets RUNNING requests keep appending without
+  immediate preemption.
+- Preemption: when a running request cannot get its next page, the
+  youngest running request is evicted (pages freed, request requeued
+  for full recomputation — the vLLM "recompute" policy, the right
+  choice when sequences are short relative to prefill cost).
+- Per-step slot compaction: retirements compact the active slots to the
+  low indices before each admission pass, so occupancy accounting and
+  the admission scan touch a dense prefix.
+
+Instrumentation (paddle_tpu.monitor, FLAGS_enable_monitor-gated):
+``serving.pages.in_use|total``, ``serving.batch.occupancy``,
+``serving.queue.depth`` gauges; ``serving.requests.admitted|completed|
+preempted``, ``serving.tokens.generated|prefilled`` counters. The same
+numbers are always available unconditionally on ``engine.stats``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import monitor as _monitor
+from ..core import enforce as E
+from .paged import PagedKVCache, paged_decode_step, paged_prefill
+
+__all__ = ["Request", "RequestOutput", "ServingEngine"]
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                   # [S] int32 token ids
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    key: Optional[jax.Array] = None      # PRNG key when temperature > 0
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    rid: int
+    tokens: np.ndarray                   # generated ids (<= max_new_tokens)
+    prompt_len: int
+    preemptions: int = 0                 # times this request was evicted
+
+
+class _Slot:
+    __slots__ = ("req", "kv_len", "gen", "tokens", "pending", "done",
+                 "keys", "preemptions")
+
+    def __init__(self, req: Request, keys: np.ndarray):
+        self.req = req
+        self.kv_len = 0          # KV positions written (prompt + decoded)
+        self.gen = 0             # tokens sampled so far
+        self.tokens: List[int] = []
+        self.pending = 0         # last sampled token (KV not yet written)
+        self.done = False
+        self.keys = keys         # [max_new, 2] uint32 sampling keys
+        self.preemptions = 0
+
+
+class EngineStats:
+    def __init__(self):
+        self.admitted = 0
+        self.completed = 0
+        self.preempted = 0
+        self.decode_steps = 0
+        self.tokens_generated = 0    # incl. the token sampled at prefill
+        self.tokens_decoded = 0      # emitted by decode steps only
+        self.tokens_prefilled = 0
+        self.peak_pages_in_use = 0
+        self._occ_steps = 0      # decode steps weighted by slot count
+
+    def occupancy(self) -> float:
+        """Useful-token fraction of the decode grid: decode-emitted
+        tokens / (decode steps x slots). Empty slots, done-masked chunk
+        tails and drain phases all count against it — the honest
+        number."""
+        return (self.tokens_decoded / self._occ_steps
+                if self._occ_steps else 0.0)
+
+    def as_dict(self) -> dict:
+        return {"admitted": self.admitted, "completed": self.completed,
+                "preempted": self.preempted,
+                "decode_steps": self.decode_steps,
+                "tokens_generated": self.tokens_generated,
+                "tokens_prefilled": self.tokens_prefilled,
+                "peak_pages_in_use": self.peak_pages_in_use,
+                "batch_occupancy": round(self.occupancy(), 4)}
+
+
+def _sample_rows(logits, temps, keys, sampled=True):
+    """Vectorised per-slot sampling: greedy rows where temperature is 0,
+    else categorical on the tempered logits with that slot's own key —
+    row-for-row the same draw the ring-buffer ``generate`` makes, so
+    fixed-seed parity holds. ``sampled=False`` (every live slot greedy)
+    skips the threefry/gumbel draw entirely — per-token RNG is real
+    money at small model sizes."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not sampled:
+        return greedy
+    drawn = jax.vmap(lambda row, t, k: jax.random.categorical(
+        k, row / jnp.maximum(t, 1e-6)))(logits, temps, keys)
+    return jnp.where(temps > 0, drawn.astype(jnp.int32), greedy)
+
+
+def _decode_chunk(family, config, chunk, sampled, params, pool_k, pool_v,
+                  block_tables, tokens, kv_len, done, gen, keys, temps,
+                  max_new, eos):
+    """``chunk`` decode steps as one program: write the pending token's
+    KV, attend, sample the next. Done slots coast (writes dropped via
+    length 0, outputs masked to -1)."""
+
+    def body(carry, key_t):
+        pool_k, pool_v, tok, kvl, done, gen = carry
+        n = jnp.where(done, 0, kvl + 1)
+        pool_k, pool_v, logits = paged_decode_step(
+            family, params, pool_k, pool_v, block_tables, n, tok, config)
+        kvl = jnp.where(done, kvl, kvl + 1)
+        nxt = _sample_rows(logits, temps, key_t, sampled)
+        emitted = jnp.where(done, -1, nxt)
+        gen = gen + jnp.where(done, 0, 1)
+        hit_eos = (~done) & (nxt == eos)
+        done = done | hit_eos | (gen >= max_new)
+        tok = jnp.where(emitted >= 0, nxt, tok)
+        return (pool_k, pool_v, tok, kvl, done, gen), emitted
+
+    (pool_k, pool_v, tok, kvl, done, gen), emitted = jax.lax.scan(
+        body, (pool_k, pool_v, tokens, kv_len, done, gen), keys,
+        length=chunk)
+    return pool_k, pool_v, tok, kvl, done, gen, emitted
+
+
+class ServingEngine:
+    """Continuous-batching decode over a paged KV cache.
+
+    ``family`` is a model module exposing the decoder seam
+    (models.llama / models.moe); ``params`` may be the bf16 tree or the
+    weight-only int8 tree from ``family.quantize_weights``."""
+
+    def __init__(self, family, params, config, *, num_slots: int = 8,
+                 max_len: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 decode_chunk: int = 4, watermark: float = 0.0,
+                 kv_dtype=None):
+        self.family = family
+        self.params = params
+        self.config = config
+        self.num_slots = int(num_slots)
+        self.decode_chunk = int(decode_chunk)
+        E.enforce(self.decode_chunk >= 1, "decode_chunk must be >= 1")
+        max_len = int(max_len if max_len is not None
+                      else config.max_position_embeddings)
+        kv_dtype = kv_dtype if kv_dtype is not None else config.dtype
+        if page_size is None:
+            from ..kernels import autotune as _at
+            page_size = _at.paged_page_size(
+                num_slots, config.num_attention_heads,
+                config.num_key_value_heads, config.head_dim,
+                -(-max_len // 16) * 16, kv_dtype)
+        self.page_size = int(page_size)
+        self.max_len = -(-max_len // self.page_size) * self.page_size
+        self.max_pages_per_seq = self.max_len // self.page_size
+        if num_pages is None:
+            num_pages = self.num_slots * self.max_pages_per_seq
+        E.enforce(num_pages >= self.max_pages_per_seq,
+                  f"pool of {num_pages} pages cannot hold even one "
+                  f"max-length sequence ({self.max_pages_per_seq} pages)")
+        self.watermark_pages = int(watermark * num_pages)
+        self.cache = PagedKVCache(config, num_pages, self.page_size,
+                                  self.max_pages_per_seq, kv_dtype)
+        self.queue: deque = deque()
+        self.slots: List[Optional[_Slot]] = [None] * self.num_slots
+        self.outputs: Dict[int, RequestOutput] = {}
+        self.stats = EngineStats()
+        self._rng_fallback = 0
+
+        self._prefill_fns: dict = {}     # (S_pad, sampled) -> jitted
+        # chunk programs keyed by (length, sampled): greedy-only skips
+        # per-token RNG; the 4x "turbo" length engages when every live
+        # slot is guaranteed to run it end-to-end (no retire/join could
+        # happen mid-chunk), quartering per-chunk host+dispatch overhead
+        # through the long middle of large generations
+        self.turbo_chunk = self.decode_chunk * 4
+        self._chunk_fns = {
+            (c, s): jax.jit(partial(_decode_chunk, family, config, c, s),
+                            donate_argnums=(1, 2))
+            for c in (self.decode_chunk, self.turbo_chunk)
+            for s in (False, True)}
+        # device-side slot state, reused across chunks until a
+        # join/retire/preempt (state) or page-table change (bt) dirties it
+        self._dev: dict = {}
+        self._state_dirty = True
+        self._bt_dirty = True
+        self._sampled = False
+        self._zero_keys = {
+            c: jnp.zeros((c, self.num_slots, 2), jnp.uint32)
+            for c in (self.decode_chunk, self.turbo_chunk)}
+        _monitor.set_gauge("serving.pages.total",
+                           self.cache.num_pages,
+                           doc="KV page pool capacity")
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, req: Request):
+        E.enforce(req.max_new_tokens >= 1,
+                  "max_new_tokens must be >= 1")
+        plen = int(np.asarray(req.prompt).shape[0])
+        E.enforce(plen >= 1, "empty prompt")
+        E.enforce(plen + req.max_new_tokens <= self.max_len,
+                  f"request {req.rid}: prompt {plen} + max_new "
+                  f"{req.max_new_tokens} exceeds max_len {self.max_len}")
+        self.queue.append(req)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _bucket(self, plen: int) -> int:
+        """Padded prompt length: next power-of-two page count (bounds the
+        number of distinct prefill compiles at log2(max_pages))."""
+        pages = self.cache.alloc.pages_for(plen)
+        b = 1
+        while b < pages:
+            b *= 2
+        return min(b, self.max_pages_per_seq) * self.page_size
+
+    def _prefill_fn(self, g: int, s_pad: int, sampled: bool):
+        fn = self._prefill_fns.get((g, s_pad, sampled))
+        if fn is None:
+            family, config = self.family, self.config
+
+            def _pf(params, ids, pool_k, pool_v, page_rows, slen, temp,
+                    key):
+                pk, pv, logits = paged_prefill(family, params, ids,
+                                               config, pool_k, pool_v,
+                                               page_rows, slen)
+                # the first tokens sample INSIDE the prefill program —
+                # one dispatch per admission GROUP, not two per request
+                tok = _sample_rows(logits, temp, key, sampled)
+                return pk, pv, tok
+
+            fn = jax.jit(_pf, donate_argnums=(2, 3))
+            self._prefill_fns[(g, s_pad, sampled)] = fn
+        return fn
+
+    def _keys_for(self, req: Request) -> np.ndarray:
+        if req.temperature <= 0.0:
+            return np.zeros((req.max_new_tokens, 2), np.uint32)
+        key = req.key
+        if key is None:
+            self._rng_fallback += 1
+            key = jax.random.PRNGKey(self._rng_fallback)
+        return np.asarray(jax.random.split(key, req.max_new_tokens),
+                          np.uint32)
+
+    def _compact(self):
+        """Slot compaction: pack live slots into the low indices (block
+        tables and device slot state are rebuilt on the next chunk, so
+        this is a pure host permutation)."""
+        live = [s for s in self.slots if s is not None]
+        packed = live + [None] * (self.num_slots - len(live))
+        if packed != self.slots:
+            self.slots = packed
+            self._state_dirty = self._bt_dirty = True
+
+    def _retire(self, idx: int):
+        slot = self.slots[idx]
+        self.slots[idx] = None
+        self._state_dirty = self._bt_dirty = True
+        self.cache.alloc.free(slot.req.rid)
+        self.outputs[slot.req.rid] = RequestOutput(
+            rid=slot.req.rid,
+            tokens=np.asarray(slot.tokens, np.int32),
+            prompt_len=int(np.asarray(slot.req.prompt).shape[0]),
+            preemptions=slot.preemptions)
+        self.stats.completed += 1
+        _monitor.inc("serving.requests.completed")
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted live request (recompute
+        policy: pages freed, request requeued at the FRONT so it re-runs
+        before newcomers). False when nothing can be evicted."""
+        for idx in range(self.num_slots - 1, -1, -1):
+            slot = self.slots[idx]
+            if slot is not None and not slot.done:
+                self.slots[idx] = None
+                self._state_dirty = self._bt_dirty = True
+                self.cache.alloc.free(slot.req.rid)
+                slot.req._preempt_count = getattr(
+                    slot.req, "_preempt_count", 0) + 1
+                self.queue.appendleft(slot.req)
+                self.stats.preempted += 1
+                _monitor.inc("serving.requests.preempted")
+                return True
+        return False
+
+    def _admit(self):
+        while self.queue:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                break
+            req = self.queue[0]
+            plen = int(np.asarray(req.prompt).shape[0])
+            s_pad = max(self._bucket(plen), self.page_size)
+            need = s_pad // self.page_size
+            idle = not any(s is not None and not s.done
+                           for s in self.slots)
+            if (self.cache.alloc.free_pages - need < self.watermark_pages
+                    and not idle):        # head-of-line admission control
+                break
+            self.queue.popleft()
+            if self.cache.alloc.alloc(req.rid, s_pad) is None:
+                self.queue.appendleft(req)
+                # an idle engine that cannot place its head request will
+                # never make progress — that is a sizing error, not a
+                # transient
+                E.enforce(not idle,
+                          f"request {req.rid} needs {need} pages but only "
+                          f"{self.cache.alloc.free_pages} exist free on an "
+                          f"idle engine", error=E.ResourceExhaustedError)
+                break
+            # group same-bucket waiters into this prefill dispatch (a
+            # bounded look-through keeps overall FIFO fairness while
+            # letting one program admit several requests)
+            group = [req]
+            scanned = 0
+            while (len(group) < len(free)
+                   and scanned < len(self.queue)
+                   and self.cache.alloc.free_pages - need
+                   >= self.watermark_pages):
+                cand = self.queue[scanned]
+                cp = int(np.asarray(cand.prompt).shape[0])
+                if max(self._bucket(cp), self.page_size) != s_pad:
+                    scanned += 1
+                    continue
+                if self.cache.alloc.alloc(cand.rid, s_pad) is None:
+                    break
+                del self.queue[scanned]
+                group.append(cand)
+            self._prefill_group(free, group, s_pad)
+
+    def _prefill_group(self, free: List[int], group: List["Request"],
+                       s_pad: int):
+        """One batched prefill for same-bucket requests, padded to a
+        power-of-two group size (bounds compiles at log2(slots) per
+        bucket); dummy rows carry all-sentinel page tables and never
+        touch the pool."""
+        need = s_pad // self.page_size
+        g = 1
+        while g < len(group):
+            g *= 2
+        ids = np.zeros((g, s_pad), np.int32)
+        rows = np.full((g, need), self.cache.num_pages, np.int32)
+        slen = np.ones(g, np.int32)
+        temps = np.zeros(g, np.float32)
+        keys = np.zeros((g, 2), np.uint32)
+        slots = []
+        for j, r in enumerate(group):
+            plen = int(np.asarray(r.prompt).shape[0])
+            ids[j, :plen] = np.asarray(r.prompt, np.int32)
+            rows[j] = self.cache.alloc.block_row(r.rid, need)
+            slen[j] = plen
+            temps[j] = r.temperature
+            slot = _Slot(r, self._keys_for(r))
+            slot.kv_len = plen
+            slot.preemptions = getattr(r, "_preempt_count", 0)
+            keys[j] = slot.keys[0]
+            slots.append(slot)
+        sampled = any(r.temperature > 0 for r in group)
+        pk, pv, tok_a = self._prefill_fn(g, s_pad, sampled)(
+            self.params, jnp.asarray(ids), self.cache.pool["k"],
+            self.cache.pool["v"], page_rows=jnp.asarray(rows),
+            slen=jnp.asarray(slen), temp=jnp.asarray(temps),
+            key=jnp.asarray(keys))
+        self.cache.pool = {"k": pk, "v": pv}
+        toks = np.asarray(tok_a)
+        for j, (r, slot) in enumerate(zip(group, slots)):
+            self.cache.alloc.advance(r.rid, int(slen[j]))
+            tok = int(toks[j])
+            slot.tokens.append(tok)
+            slot.pending = tok
+            slot.gen = 1
+            slot.done = (tok == r.eos_token_id
+                         if r.eos_token_id is not None else False) \
+                or slot.gen >= r.max_new_tokens
+            self.slots[free[j]] = slot
+            self.stats.admitted += 1
+            self.stats.tokens_generated += 1
+            self.stats.tokens_prefilled += int(slen[j])
+            _monitor.inc("serving.requests.admitted")
+            # the prefill-sampled first token counts here so the counter
+            # agrees with stats.tokens_generated
+            _monitor.inc("serving.tokens.generated")
+            _monitor.inc("serving.tokens.prefilled", int(slen[j]))
+        self._state_dirty = self._bt_dirty = True
+
+    def _pick_chunk(self, live_idx: List[int]) -> int:
+        """Turbo chunk when no retire/join/EOS could land mid-chunk:
+        the slot grid is full, everyone's remaining run covers it, and
+        nobody can stop early on EOS. Occupancy is then provably
+        unaffected, and per-chunk overhead amortises 4x further."""
+        if len(live_idx) < self.num_slots:
+            return self.decode_chunk
+        for i in live_idx:
+            s = self.slots[i]
+            if (s.req.eos_token_id is not None
+                    or s.req.max_new_tokens - s.gen < self.turbo_chunk):
+                return self.decode_chunk
+        return self.turbo_chunk
+
+    def _ensure_chunk_capacity(self, live_idx: List[int],
+                               chunk: int) -> List[int]:
+        """Reserve pages for up to ``chunk`` appends per live slot,
+        preempting the youngest requests on OOM. Returns the (possibly
+        shrunk) live index list."""
+        i = 0
+        while i < len(live_idx):
+            idx = live_idx[i]
+            slot = self.slots[idx]
+            if slot is None:              # preempted by an earlier pass
+                live_idx.pop(i)
+                continue
+            appends = min(chunk,
+                          slot.req.max_new_tokens - slot.gen + 1)
+            got = self.cache.alloc.ensure(slot.req.rid,
+                                          slot.kv_len + appends)
+            if got is None:
+                E.enforce(self._preempt_youngest(),
+                          "page pool exhausted with nothing left to "
+                          "preempt", error=E.ResourceExhaustedError)
+                continue                  # retry this slot
+            if got[0] or got[1]:
+                self._bt_dirty = True
+            self.cache.apply_cow(got[1])
+            i += 1
+        return [idx for idx in live_idx if self.slots[idx] is not None]
+
+    def step(self) -> bool:
+        """One scheduling iteration: retire -> compact -> admit -> one
+        decode chunk. Returns False when the engine is fully idle."""
+        for idx in range(self.num_slots):
+            if self.slots[idx] is not None and self.slots[idx].done:
+                self._retire(idx)
+        self._compact()
+        self._admit()
+        _monitor.set_gauge("serving.queue.depth", len(self.queue),
+                           doc="requests waiting for admission")
+        in_use = self.cache.alloc.used_pages
+        self.stats.peak_pages_in_use = max(self.stats.peak_pages_in_use,
+                                           in_use)
+        _monitor.set_gauge("serving.pages.in_use", in_use,
+                           doc="KV pages currently allocated")
+
+        live_idx = [i for i, s in enumerate(self.slots)
+                    if s is not None and not s.done]
+        if not live_idx:
+            return bool(self.queue) or any(
+                s is not None for s in self.slots)
+        C = self._pick_chunk(live_idx)
+        live_idx = self._ensure_chunk_capacity(live_idx, C)
+        if not live_idx:
+            return True
+
+        B = self.num_slots
+        if self._state_dirty:
+            # (re)build the device-side slot state. The steady state —
+            # chunk after chunk with no join/retire/new-page — reuses the
+            # PREVIOUS chunk's returned device arrays untouched: the
+            # scheduler's host work then stays off the per-token path.
+            tokens = np.zeros(B, np.int32)
+            kv_len = np.zeros(B, np.int32)
+            done = np.ones(B, bool)
+            gen = np.zeros(B, np.int32)
+            temps = np.zeros(B, np.float32)
+            max_new = np.zeros(B, np.int32)
+            eos = np.full(B, -1, np.int32)
+            for i in live_idx:
+                s = self.slots[i]
+                tokens[i], kv_len[i], done[i] = s.pending, s.kv_len, False
+                gen[i], temps[i] = s.gen, s.req.temperature
+                max_new[i] = s.req.max_new_tokens
+                if s.req.eos_token_id is not None:
+                    eos[i] = s.req.eos_token_id
+            self._dev.update(
+                tokens=jnp.asarray(tokens), kv_len=jnp.asarray(kv_len),
+                done=jnp.asarray(done), gen=jnp.asarray(gen),
+                temps=jnp.asarray(temps), max_new=jnp.asarray(max_new),
+                eos=jnp.asarray(eos))
+            self._sampled = any(self.slots[i].req.temperature > 0
+                                for i in live_idx)
+            self._state_dirty = False
+        if self._bt_dirty:
+            seq_ids = [self.slots[i].req.rid
+                       if i in set(live_idx) else None for i in range(B)]
+            self._dev["bt"] = jnp.asarray(self.cache.block_tables(seq_ids))
+            self._bt_dirty = False
+        if self._sampled:
+            keys = np.zeros((C, B, 2), np.uint32)
+            for i in live_idx:
+                s = self.slots[i]
+                for t in range(C):
+                    keys[t, i] = s.keys[min(s.gen + t, len(s.keys) - 1)]
+            keys = jnp.asarray(keys)
+        else:
+            keys = self._zero_keys[C]  # greedy: keys are never read
+
+        d = self._dev
+        pk, pv, tok, kvl, done_a, gen_a, emitted = self._chunk_fns[
+            (C, self._sampled)](
+            self.params, self.cache.pool["k"], self.cache.pool["v"],
+            d["bt"], d["tokens"], d["kv_len"], d["done"], d["gen"],
+            keys, d["temps"], d["max_new"], d["eos"])
+        self.cache.pool = {"k": pk, "v": pv}
+        self._dev.update(tokens=tok, kv_len=kvl, done=done_a, gen=gen_a)
+        # ONE device->host transfer per chunk: every host-side fact is
+        # derivable from the emitted grid (-1 = slot was done at that
+        # step; a write and a sample happen exactly on non -1 steps)
+        emitted = np.asarray(emitted)                    # [C, B]
+        new_tokens = 0
+        for i in live_idx:
+            s = self.slots[i]
+            toks = emitted[:, i]
+            toks = toks[toks >= 0].tolist()
+            if toks:
+                s.tokens.extend(toks)
+                new_tokens += len(toks)
+                self.cache.alloc.advance(s.req.rid, len(toks))
+                s.kv_len += len(toks)
+                s.gen += len(toks)
+                s.pending = toks[-1]
+            s.done = s.gen >= s.req.max_new_tokens or (
+                s.req.eos_token_id is not None and bool(toks)
+                and toks[-1] == s.req.eos_token_id)
+        self.stats.decode_steps += C
+        self.stats.tokens_generated += new_tokens
+        self.stats.tokens_decoded += new_tokens
+        self.stats._occ_steps += C * self.num_slots
+        occ = self.stats.occupancy()
+        _monitor.set_gauge("serving.batch.occupancy", round(occ, 4),
+                           doc="generated tokens / (decode steps x slots)")
+        _monitor.inc("serving.tokens.generated", new_tokens)
+        return True
+
+    def run(self, requests=None, max_steps: int = 1_000_000
+            ) -> Dict[int, RequestOutput]:
+        """Drive the scheduler until every submitted request completes;
+        returns {rid: RequestOutput}."""
+        if requests:
+            for r in requests:
+                self.submit(r)
+        steps = 0
+        while self.step():
+            steps += 1
+            E.enforce(steps < max_steps,
+                      f"engine did not drain within {max_steps} steps")
+        return self.outputs

@@ -1,0 +1,359 @@
+"""Paged KV cache: page-pool tensors + block-table allocator + the
+paged prefill/decode data plane.
+
+Reference capability: vLLM's PagedAttention block manager (the
+dominant serving-stack design: KV lives in fixed-size pages named by
+per-sequence block tables, so HBM is allocated at page granularity
+instead of max-length ring buffers) realised TPU-native per Ragged
+Paged Attention (arxiv 2604.15464, PAPERS.md).
+
+Three layers:
+
+- ``PageAllocator`` — the host-side control plane: a free list plus
+  ref-counted pages per sequence (alloc / ensure(+copy-on-write) /
+  advance / fork / free). Pure Python+numpy; never touches the device.
+- ``PagedKVCache`` — the pool tensors (one page grid per layer) married
+  to an allocator; owns layout and the block-table/length device views.
+- ``paged_prefill`` / ``paged_decode_step`` — pure-jax data plane with
+  the same (params, ..., config) shape as the ring-buffer
+  ``(init_cache, prefill, decode_step)`` contract in models/llama.py,
+  but generic over the model family: any module exposing the decoder
+  seam (``_qkv_proj``-compatible layers, ``decode_mlp``, ``_head``)
+  plugs in — llama and the MoE families both do.
+
+Pool layout: ``[L, num_pages, kv_heads, page_size, head_dim]``. The
+ISSUE/vLLM order puts page_size before kv_heads; the kv-head axis is
+hoisted OUTSIDE the page axis here so the decode kernel's per-page
+block ``(1, 1, page_size, head_dim)`` satisfies Mosaic's last-two-dims
+tiling rule for every page size (see kernels/paged_attention.py).
+
+Writes into pages use scatter-with-drop: block-table entries equal to
+``num_pages`` are an explicit "no page" sentinel, so a padded prompt
+page or an inactive decode slot drops its write instead of corrupting
+page 0 — the allocator owns the sentinel discipline.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import enforce as E
+from ..models.llama import _head_logits, _mm, _qkv_proj, _rms
+from ..nn.functional.attention import rope_raw, rope_tables
+
+__all__ = ["PageAllocator", "PagedKVCache", "init_pool",
+           "paged_prefill", "paged_decode_step"]
+
+
+# ---------------------------------------------------------------------------
+# host-side control plane
+# ---------------------------------------------------------------------------
+
+class PageAllocator:
+    """Free-list page allocator with per-sequence block tables and
+    ref-counted pages (copy-on-fork for beam/top-k style sequence
+    sharing). All methods are host-side and O(pages touched); OOM is a
+    ``None`` return with state unchanged — admission control, not an
+    exception."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 max_pages_per_seq: int):
+        E.enforce(num_pages >= 1, f"num_pages must be >= 1, got {num_pages}")
+        E.enforce(page_size >= 1, f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._ref = np.zeros(num_pages, np.int32)
+        # seq_id -> {"pages": [page ids], "len": tokens written}
+        self._seqs: Dict[int, dict] = {}
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.page_size)
+
+    def seq_len(self, seq_id: int) -> int:
+        return self._seqs[seq_id]["len"]
+
+    def seq_pages(self, seq_id: int) -> List[int]:
+        return list(self._seqs[seq_id]["pages"])
+
+    def block_row(self, seq_id: int, width: Optional[int] = None
+                  ) -> np.ndarray:
+        """This sequence's block-table row, padded with the ``num_pages``
+        sentinel (the no-page value the scatter path drops)."""
+        width = self.max_pages_per_seq if width is None else width
+        row = np.full(width, self.num_pages, np.int32)
+        pages = self._seqs[seq_id]["pages"]
+        row[:len(pages)] = pages
+        return row
+
+    def check_invariants(self):
+        """Refcount bookkeeping audit (tests): every page is either free
+        (ref 0) or referenced exactly as many times as sequences hold
+        it, and the free list is duplicate-free."""
+        counts = np.zeros(self.num_pages, np.int32)
+        for s in self._seqs.values():
+            for p in s["pages"]:
+                counts[p] += 1
+        if not np.array_equal(counts, self._ref):
+            raise AssertionError(
+                f"refcount drift: held={counts.tolist()} "
+                f"ref={self._ref.tolist()}")
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate pages on the free list")
+        if any(self._ref[p] != 0 for p in free):
+            raise AssertionError("referenced page on the free list")
+        if len(free) + int((self._ref > 0).sum()) != self.num_pages:
+            raise AssertionError("leaked page: neither free nor referenced")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _take(self, n: int) -> Optional[List[int]]:
+        if len(self._free) < n:
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        for p in taken:
+            self._ref[p] += 1
+        return taken
+
+    def alloc(self, seq_id: int, n_tokens: int) -> Optional[List[int]]:
+        """Create a sequence with capacity for ``n_tokens`` (its written
+        length starts at 0 — ``advance`` after the KV lands). None = OOM."""
+        E.enforce(seq_id not in self._seqs,
+                  f"sequence {seq_id} already allocated")
+        need = self.pages_for(n_tokens)
+        E.enforce(need <= self.max_pages_per_seq,
+                  f"{n_tokens} tokens need {need} pages > "
+                  f"max_pages_per_seq {self.max_pages_per_seq}")
+        pages = self._take(need)
+        if pages is None:
+            return None
+        self._seqs[seq_id] = {"pages": pages, "len": 0}
+        return pages
+
+    def ensure(self, seq_id: int, total_tokens: int
+               ) -> Optional[Tuple[List[int], List[Tuple[int, int]]]]:
+        """Grow capacity to ``total_tokens`` and copy-on-write any SHARED
+        page the upcoming writes (positions >= current len) would touch.
+        Returns (new_pages, cow_pairs[(src, dst)]) — the caller must
+        mirror cow_pairs onto the device pool — or None on OOM (state
+        unchanged)."""
+        s = self._seqs[seq_id]
+        need_total = self.pages_for(total_tokens)
+        E.enforce(need_total <= self.max_pages_per_seq,
+                  f"{total_tokens} tokens need {need_total} pages > "
+                  f"max_pages_per_seq {self.max_pages_per_seq}")
+        grow = max(0, need_total - len(s["pages"]))
+        first_written = s["len"] // self.page_size
+        cow_idx = [i for i in range(first_written,
+                                    min(len(s["pages"]), need_total))
+                   if self._ref[s["pages"][i]] > 1]
+        fresh = self._take(grow + len(cow_idx))
+        if fresh is None:
+            return None
+        new_pages, cow_dst = fresh[:grow], fresh[grow:]
+        cow_pairs = []
+        for i, dst in zip(cow_idx, cow_dst):
+            src = s["pages"][i]
+            cow_pairs.append((src, dst))
+            self._ref[src] -= 1          # shared: never hits 0 here
+            s["pages"][i] = dst
+        s["pages"].extend(new_pages)
+        return new_pages, cow_pairs
+
+    def advance(self, seq_id: int, n_tokens: int = 1):
+        """Record ``n_tokens`` written; capacity must already exist."""
+        s = self._seqs[seq_id]
+        new_len = s["len"] + int(n_tokens)
+        E.enforce(new_len <= len(s["pages"]) * self.page_size,
+                  f"advance past capacity: {new_len} tokens > "
+                  f"{len(s['pages'])} pages")
+        s["len"] = new_len
+
+    def fork(self, src_id: int, dst_id: int) -> List[int]:
+        """Share src's pages with a new sequence (beam/top-k fork): pure
+        refcount bumps, zero copies now; a later ``ensure`` on either
+        side copy-on-writes the tail page."""
+        E.enforce(dst_id not in self._seqs,
+                  f"sequence {dst_id} already allocated")
+        s = self._seqs[src_id]
+        for p in s["pages"]:
+            self._ref[p] += 1
+        self._seqs[dst_id] = {"pages": list(s["pages"]), "len": s["len"]}
+        return list(s["pages"])
+
+    def free(self, seq_id: int):
+        s = self._seqs.pop(seq_id)
+        for p in s["pages"]:
+            self._ref[p] -= 1
+            E.enforce(self._ref[p] >= 0, f"double free of page {p}")
+            if self._ref[p] == 0:
+                self._free.append(p)
+
+
+# ---------------------------------------------------------------------------
+# pool tensors
+# ---------------------------------------------------------------------------
+
+def init_pool(config, num_pages: int, page_size: int, dtype=None) -> dict:
+    """Fresh page pools, one [P, kv, ps, hd] grid per layer (stacked on
+    a leading layer axis to ride the decode lax.scan, like the ring
+    cache)."""
+    dt = dtype if dtype is not None else config.dtype
+    shape = (config.num_hidden_layers, num_pages,
+             config.num_key_value_heads, page_size, config.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+class PagedKVCache:
+    """Pool tensors + allocator under one roof — the serving engine's
+    cache object. Device state lives in ``.pool`` (replaced wholesale by
+    the jitted prefill/decode calls); control state in ``.alloc``."""
+
+    def __init__(self, config, num_pages: int, page_size: int,
+                 max_pages_per_seq: int, dtype=None):
+        self.config = config
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.pool = init_pool(config, num_pages, page_size, dtype)
+        self.alloc = PageAllocator(num_pages, page_size, max_pages_per_seq)
+        self._copy1 = jax.jit(
+            lambda pool, src, dst: {
+                "k": pool["k"].at[:, dst].set(pool["k"][:, src]),
+                "v": pool["v"].at[:, dst].set(pool["v"][:, src]),
+            }, donate_argnums=(0,))
+
+    def apply_cow(self, pairs):
+        """Mirror allocator copy-on-write decisions onto the device pool."""
+        for src, dst in pairs:
+            self.pool = self._copy1(self.pool,
+                                    jnp.asarray(src), jnp.asarray(dst))
+
+    def block_tables(self, seq_ids, width: Optional[int] = None
+                     ) -> np.ndarray:
+        """[len(seq_ids), width] block table; None entries (empty slots)
+        become all-sentinel rows."""
+        width = self.max_pages_per_seq if width is None else width
+        rows = np.full((len(seq_ids), width), self.num_pages, np.int32)
+        for i, sid in enumerate(seq_ids):
+            if sid is not None:
+                rows[i] = self.alloc.block_row(sid, width)
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# data plane (pure jax; family/config static under jit)
+# ---------------------------------------------------------------------------
+
+def paged_prefill(family, params, ids, config, pool_k, pool_v, page_rows,
+                  slen):
+    """Consume a batch of padded prompts [G, S_pad] (S_pad a page
+    multiple; rows are INDEPENDENT requests): writes every covered page
+    of K/V into ``page_rows`` [G, S_pad/ps] (sentinel rows drop —
+    padding beyond a request's owned pages never lands; an all-sentinel
+    row is a group-padding dummy) and returns (pool_k', pool_v', logits
+    [G, V] at each row's position ``slen[g]``-1). Identical layer math
+    to the family's ring-buffer prefill, so greedy decode parity holds
+    token-for-token."""
+    c = config
+    G, S = ids.shape
+    L, P, kv, ps, hd = pool_k.shape
+    E.enforce(S % ps == 0, f"padded prompt {S} not a multiple of "
+              f"page_size {ps}")
+    x = jnp.take(params["embed"], ids, axis=0)
+    cos, sin = rope_tables(S, c.head_dim, theta=c.rope_theta)
+
+    from ..nn.functional.attention import sdpa_raw
+
+    def step(carry, lp):
+        x = carry
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = rope_raw(q, cos, sin)
+        k = rope_raw(k, cos, sin)
+        a = sdpa_raw(q, k, v, is_causal=True).reshape(G, S, -1)
+        x = x + _mm(a.astype(x.dtype), lp["wo"])
+        return family.decode_mlp(x, lp, c), (k, v)
+
+    x, (ks, vs) = lax.scan(step, x, params["layers"])
+    npad = S // ps
+    # [L, G, S, kv, hd] -> [L, G, npad, kv, ps, hd] page grids
+    ks = jnp.moveaxis(ks.reshape(L, G, npad, ps, kv, hd), 4, 3)
+    vs = jnp.moveaxis(vs.reshape(L, G, npad, ps, kv, hd), 4, 3)
+    pool_k = pool_k.at[:, page_rows].set(ks.astype(pool_k.dtype),
+                                         mode="drop")
+    pool_v = pool_v.at[:, page_rows].set(vs.astype(pool_v.dtype),
+                                         mode="drop")
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(slen - 1, 0)[:, None, None], axis=1)[:, 0]
+    logits = _head_logits(last, family._head(params, c))
+    return pool_k, pool_v, logits
+
+
+def paged_decode_step(family, params, pool_k, pool_v, block_tables,
+                      lengths, tokens, config):
+    """One incremental step over the fixed slot grid. ``tokens`` [B]
+    sit at position ``lengths``-1 of their sequences (``lengths`` is the
+    valid KV count INCLUDING each new token; 0 marks an inactive slot —
+    its write is dropped and its logits row is garbage the caller
+    masks). Returns (pool_k', pool_v', logits [B, V])."""
+    c = config
+    B = tokens.shape[0]
+    L, P, kv, ps, hd = pool_k.shape
+    maxp = block_tables.shape[1]
+    n = lengths
+    posw = jnp.maximum(n - 1, 0)                       # [B] write position
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]
+    # rope angles computed directly at the ragged positions (identical
+    # floats to a rope_tables row: same product, same cos — but a fused
+    # elementwise chain instead of two table gathers per step)
+    inv = 1.0 / (c.rope_theta ** (
+        jnp.arange(0, c.head_dim, 2, jnp.float32) / c.head_dim))
+    freqs = posw.astype(jnp.float32)[:, None, None] * inv  # [B, 1, hd/2]
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+
+    page_idx = posw // ps
+    off = posw % ps
+    rows = jnp.take_along_axis(block_tables, page_idx[:, None],
+                               axis=1)[:, 0]
+    rows = jnp.where(n > 0, rows, P)                   # inactive: drop
+    kvi = jnp.arange(kv)
+
+    from ..kernels import dispatched_paged_attention
+
+    def step(carry, xs):
+        x = carry
+        lp, kpl, vpl = xs                              # [P, kv, ps, hd]
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = rope_raw(q, cos, sin)
+        k = rope_raw(k, cos, sin)
+        kpl = kpl.at[rows[:, None], kvi[None, :], off[:, None]].set(
+            k[:, 0].astype(kpl.dtype), mode="drop", unique_indices=True)
+        vpl = vpl.at[rows[:, None], kvi[None, :], off[:, None]].set(
+            v[:, 0].astype(vpl.dtype), mode="drop", unique_indices=True)
+        a = dispatched_paged_attention(q[:, 0], kpl, vpl, block_tables, n)
+        x = x + _mm(a.reshape(B, 1, -1).astype(x.dtype), lp["wo"])
+        return family.decode_mlp(x, lp, c), (kpl, vpl)
+
+    x, (kc, vc) = lax.scan(step, x, (params["layers"], pool_k, pool_v))
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = _head_logits(x[:, 0, :], family._head(params, c))
+    return kc, vc, logits

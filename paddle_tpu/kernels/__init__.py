@@ -20,15 +20,20 @@ import jax.numpy as jnp
 
 from . import flash_attention as _fa
 from . import fused_ce as _fce
+from . import paged_attention as _pa
 from . import rms_norm as _rn
 from .ring_attention import ring_attention  # noqa
 
 flash_attention = _fa.flash_attention
 fused_rms_norm = _rn.rms_norm
 fused_cross_entropy = _fce.fused_cross_entropy
+ragged_paged_attention = _pa.ragged_paged_attention
+paged_attention_ref = _pa.paged_attention_ref
 
 __all__ = ["flash_attention", "fused_rms_norm", "fused_cross_entropy",
            "dispatched_fused_ce", "ring_attention",
+           "ragged_paged_attention", "paged_attention_ref",
+           "dispatched_paged_attention",
            "register", "unregister", "dispatch_stats", "reset_dispatch_stats"]
 
 # Trace-time dispatch counters (reference capability: the KernelFactory's
@@ -39,7 +44,8 @@ __all__ = ["flash_attention", "fused_rms_norm", "fused_cross_entropy",
 # back (a silent `supported()` miss would quietly cost MFU).
 _DISPATCH_STATS = {"flash": 0, "flash_fallback": 0,
                    "rms": 0, "rms_fallback": 0,
-                   "fused_ce": 0, "fused_ce_fallback": 0}
+                   "fused_ce": 0, "fused_ce_fallback": 0,
+                   "paged": 0, "paged_fallback": 0}
 
 
 def dispatch_stats() -> dict:
@@ -124,6 +130,23 @@ def dispatched_fused_ce(x, head, labels, *, vocab_chunk=None,
                         preferred_element_type=jnp.float32)
     return _fce.masked_xent_from_logits(
         logits, labels, ignore_index=ignore_index, reduction=reduction)
+
+
+def dispatched_paged_attention(q, k_pages, v_pages, block_tables, lengths,
+                               *, scale=None):
+    """Ragged paged decode attention with the same counter discipline as
+    flash/rms: the pallas kernel on TPU when the shapes are supported,
+    the pure-jnp gather reference elsewhere (tier-1's CPU path). Both
+    share one masking/softmax definition — the serving engine's
+    paged-vs-ring parity holds on either path."""
+    if _on_tpu() and _pa.supported(q, k_pages, block_tables):
+        _DISPATCH_STATS["paged"] += 1
+        return _pa.ragged_paged_attention(
+            q, k_pages, v_pages, block_tables, lengths, scale=scale,
+            interpret=False)
+    _DISPATCH_STATS["paged_fallback"] += 1
+    return _pa.paged_attention_ref(
+        q, k_pages, v_pages, block_tables, lengths, scale=scale)
 
 
 def register(flash: bool = True, rms: bool = True, tpu_only: bool = False):

@@ -403,6 +403,136 @@ def ce_chunk(n_tokens, hidden, vocab, dtype,
     return best
 
 
+# --------------------------------------------------------------------------
+# paged-attention page-size tuning (same cache/policy machinery). The page
+# is the KV block the ragged decode kernel processes per grid step: small
+# pages waste less pool memory on ragged tails but pay more grid steps
+# and DMA descriptors per token; large pages amortise the DMA but strand
+# capacity. Like the flash blocks, the right point is measured on the
+# real chip, not guessed.
+# --------------------------------------------------------------------------
+
+PAGED_DEFAULT_PAGE = 16
+PAGED_CANDIDATES = (8, 16, 32, 64)
+
+
+def paged_candidates(dtype, max_len: int):
+    """Legal page-size candidates for a pool dtype, default first; the
+    packed-dtype sublane tile (16) floors bf16 pages."""
+    sub = 16 if jnp.dtype(dtype).itemsize == 2 else 8
+    out = []
+    for ps in (PAGED_DEFAULT_PAGE,) + PAGED_CANDIDATES:
+        if ps < sub or ps > max(max_len, sub):
+            continue
+        if ps not in out:
+            out.append(ps)
+    return out or [max(sub, PAGED_DEFAULT_PAGE)]
+
+
+def _paged_measurer(batch, nh, kvh, d, max_len, dtype):
+    """Per-sweep closure: one random KV working set, re-paged per
+    candidate (pool bytes are identical across candidates; ``max_len``
+    rounds up to the largest candidate so every page size divides it)."""
+    from .paged_attention import ragged_paged_attention
+
+    cap = max(PAGED_CANDIDATES)
+    max_len = -(-max_len // cap) * cap
+    rng = np.random.default_rng(0)
+    q = _rand(rng, (batch, nh, d), dtype)
+    flat_k = _rand(rng, (batch * max_len, kvh, d), dtype)
+    flat_v = _rand(rng, (batch * max_len, kvh, d), dtype)
+    lengths = jnp.asarray(
+        rng.integers(max_len // 4, max_len + 1, (batch,)), jnp.int32)
+
+    def measure(ps):
+        maxp = max_len // ps
+        pages = batch * maxp
+        kp = jnp.moveaxis(flat_k.reshape(pages, ps, kvh, d), 2, 1)
+        vp = jnp.moveaxis(flat_v.reshape(pages, ps, kvh, d), 2, 1)
+        bt = jnp.asarray(np.arange(pages).reshape(batch, maxp), jnp.int32)
+        f = jax.jit(lambda q_, k_, v_: ragged_paged_attention(
+            q_, k_, v_, bt, lengths, interpret=False))
+        out = f(q, kp, vp)              # compile + warmup
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = f(q, kp, vp)
+            float(out[0, 0, 0].astype(jnp.float32))  # axon-safe sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return measure
+
+
+def paged_page_size(batch, num_heads, kv_heads, head_dim, max_len, dtype,
+                    default: int = PAGED_DEFAULT_PAGE,
+                    measure: Optional[Callable] = None,
+                    cache: Optional[AutotuneCache] = None) -> int:
+    """Tuned KV page size for a paged serving shape; measures the decode
+    kernel once per shape key and caches (memory + disk), same policy
+    gates as flash_blocks/ce_chunk. Used by the serving engine when
+    constructed with ``page_size=None``."""
+    cands = paged_candidates(dtype, max_len)
+    default = default if default in cands else cands[0]
+    key = (f"paged:{jax.default_backend()}:{jnp.dtype(dtype).name}:"
+           f"b{batch}h{num_heads}kv{kv_heads}d{head_dim}:m{max_len}")
+    mode = _mode()
+    if not _flags.flag_value("use_autotune") or mode == "0":
+        _USED[key] = {"page_size": default, "source": "off"}
+        return default
+    if measure is None and mode != "cached" and not _tuning_backend():
+        _USED[key] = {"page_size": default, "source": "default-not-tpu"}
+        return default
+    cache = cache or _CACHE
+    hit = cache.get(key)
+    _monitor.inc("autotune.cache.hit" if hit and not hit.get("error")
+                 else "autotune.cache.miss")
+    if hit and not hit.get("error"):
+        _USED[key] = {"page_size": hit["page_size"], "source": "cache"}
+        return int(hit["page_size"])
+    if key in _FAILED_KEYS or (
+            hit and hit.get("failures", 1) >= MAX_SWEEP_FAILURES):
+        _USED[key] = {"page_size": default, "source": "default"}
+        return default
+    if mode == "cached":
+        _USED[key] = {"page_size": default, "source": "default"}
+        return default
+    if measure is None and _in_trace():
+        _USED[key] = {"page_size": default, "source": "default-in-trace"}
+        return default
+    if len(cands) == 1:
+        cache.put(key, {"page_size": cands[0], "us": None, "candidates": 1})
+        _USED[key] = {"page_size": cands[0], "source": "measured"}
+        return cands[0]
+    measure = measure or _paged_measurer(batch, num_heads, kv_heads,
+                                         head_dim, max_len, dtype)
+    _monitor.inc("autotune.sweeps", doc="candidate measurement sweeps run")
+    timings = {}
+    last_err = None
+    for ps in cands:
+        try:
+            timings[ps] = measure(ps)
+        except Exception as e:
+            last_err = f"{type(e).__name__}: {e}"[:200]
+            continue
+    if not timings:
+        _FAILED_KEYS.add(key)
+        prior = hit.get("failures", 1) if hit and hit.get("error") else 0
+        cache.put(key, {"page_size": default, "us": None, "candidates": 0,
+                        "failures": prior + 1,
+                        "error": f"all candidates failed ({last_err})"})
+        _USED[key] = {"page_size": default, "source": "default"}
+        return default
+    best = min(timings, key=timings.get)
+    cache.put(key, {"page_size": best, "us": round(timings[best] * 1e6, 1),
+                    "candidates": len(timings),
+                    "timings_us": {str(ps): round(t * 1e6, 1)
+                                   for ps, t in timings.items()}})
+    _USED[key] = {"page_size": best, "source": "measured"}
+    return best
+
+
 def flash_blocks(q_shape, k_shape, dtype, causal,
                  measure: Optional[Callable] = None,
                  cache: Optional[AutotuneCache] = None):

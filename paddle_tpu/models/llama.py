@@ -281,6 +281,15 @@ def _ffn(x, lp, config: LlamaConfig, sp: bool = False, constrain=_noc):
                          _act_spec(sp))
 
 
+def decode_mlp(x, lp, config: LlamaConfig):
+    """Post-attention half of a decode-path layer (ln2 + SwiGLU +
+    residual). The family seam the paged serving path
+    (inference/paged.py) composes with: llama and the MoE family expose
+    the same signature, so one paged prefill/decode implementation
+    serves every decoder family."""
+    return _ffn(x, lp, config)
+
+
 def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     """One decoder layer. x: [B, S, D]; lp: this layer's param slice."""
     c = config

@@ -332,6 +332,22 @@ def _moe_mlp(h, lp, config: MoEConfig, mesh):
     return (routed + shared).reshape(B, S, D).astype(h.dtype), aux
 
 
+def decode_mlp(x, lp, config: MoEConfig):
+    """Post-attention half of a decode-path layer (ln2 + routed/shared
+    MoE MLP + residual) — the family seam inference/paged.py composes
+    with (see llama.decode_mlp). Router aux loss is dropped: serving
+    never backprops."""
+    h2 = _rms(x, lp["ln2"], config.rms_norm_eps)
+    out, _ = _moe_mlp(h2, lp, config, None)
+    return x + out
+
+
+def _head(params, config: MoEConfig):
+    """lm-head weight (uniform accessor with llama._head — the MoE
+    families never tie embeddings)."""
+    return params["lm_head"]
+
+
 def _block(x, lp, cos, sin, config: MoEConfig, mesh):
     c = config
     B, S, D = x.shape

@@ -208,6 +208,7 @@ class TestFlashBlocks:
 
 
 class TestBf16Moments:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_bf16_moments_halve_bytes_and_still_train(self):
         import jax
 

@@ -274,6 +274,7 @@ class TestPreemption:
 
 @pytest.mark.faults
 class TestMultiProcessCrash:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_coordinator_killed_mid_commit(self, tmp_path):
         worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "_ckpt_crash_worker.py")

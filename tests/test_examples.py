@@ -34,6 +34,7 @@ def test_serve_predictor_example_runs():
     assert "parity with eager: OK" in r.stdout
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
 def test_ring_attention_example_runs():
     r = _run("long_context_ring_attention.py",
              {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
@@ -41,6 +42,7 @@ def test_ring_attention_example_runs():
     assert "exact parity OK" in r.stdout
 
 
+@pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
 def test_onnx_export_example_runs():
     r = _run("export_onnx.py")
     assert r.returncode == 0, r.stderr[-800:]

@@ -78,6 +78,7 @@ class TestFusedCE:
         assert kernels.dispatch_stats()["fused_ce_fallback"] == 1
         np.testing.assert_allclose(out, _naive(x1, head, l1), rtol=1e-6)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_llama_loss_fused_matches_einsum(self):
         from paddle_tpu.models import llama as L
 

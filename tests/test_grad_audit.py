@@ -165,6 +165,7 @@ class TestComplexOpGrads:
     """Finite-difference checks for the structurally complex ops added in
     round 3 (scan-based losses, window gathers, samplers)."""
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_ctc_loss_grad(self):
         import paddle_tpu.nn.functional as F
 
@@ -178,6 +179,7 @@ class TestComplexOpGrads:
 
         _fd_check(fn, rng.normal(size=(5, 2, 4)), rtol=5e-2, atol=5e-3)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_rnnt_loss_grad(self):
         import paddle_tpu.nn.functional as F
 

@@ -39,6 +39,7 @@ class _RefNet(nn.Layer):
 
 
 class TestFullStackHybrid:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_mp_sharding_amp_scaler_parity(self):
         """fleet.init(dp=2, sharding=2, mp=2) + Column/RowParallel + AMP
         auto_cast + GradScaler + fleet.distributed_optimizer (ZeRO-1 over

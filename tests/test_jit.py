@@ -162,6 +162,7 @@ class TestSaveLoad:
         loaded = jit.load(path)
         assert loaded(t(np.random.randn(2, 4))).shape == [2, 2]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_generate_loop_exports_and_serves(self):
         """The whole KV-cache generate loop (prefill + scan of decode
         steps) saves as ONE StableHLO artifact and serves greedily —

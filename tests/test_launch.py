@@ -9,6 +9,7 @@ import os
 import subprocess
 import sys
 
+import jax
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -52,6 +53,13 @@ class TestLaunchCLI:
         assert "MP_OK rank=0" in logs and "MP_OK rank=1" in logs, \
             logs[-6000:]
 
+    @pytest.mark.skipif(
+        jax.__version__.startswith("0.4."),
+        reason="environment limit: jax 0.4.x CPU backend has no "
+               "multi-process compiled collectives (broadcast_one_to_all "
+               "in device_put raises 'Multiprocess computations aren't "
+               "implemented on the CPU backend'); needs jax >= 0.5 or a "
+               "real accelerator")
     @pytest.mark.parametrize("nprocs", [2, 4])
     def test_cross_process_compiled_collective_training(self, tmp_path,
                                                         nprocs):
@@ -94,6 +102,7 @@ class TestLaunchCLI:
 
 
 class TestSpawn:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_spawn_runs_workers(self, tmp_path):
         """paddle.distributed.spawn parity — 2 fresh processes, each
         writes a rank file."""
@@ -126,6 +135,7 @@ if __name__ == "__main__":
 
 
 class TestAutoTunerTrials:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_end_to_end_real_trials(self, tmp_path):
         """VERDICT-r4 item 7: the tuner launches REAL trial subprocesses
         (sharded train steps on a virtual mesh), records CSV history,
@@ -174,6 +184,7 @@ class TestHeartbeatLiveness:
                                     PYTHONPATH=REPO))
         return r, time.time() - t0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_wedged_worker_detected_via_progress_beats(self, tmp_path):
         # rank 1 emits progress beats then wedges (sleeps forever while
         # its auto-beat thread keeps the process looking alive) — only
@@ -197,6 +208,7 @@ class TestHeartbeatLiveness:
         assert "wedged" in r.stderr
         assert dt < 60, dt
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_healthy_workers_unaffected(self, tmp_path):
         body = (
             "import os, sys, time\n"

@@ -81,6 +81,7 @@ class TestMoECapacityDispatch:
         capped = moe.moe_tiny(dispatch_mode="capacity", **cap_kw)
         return dense, capped
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_matches_dense_when_nothing_drops(self):
         # capacity_factor = E/k makes C = T: no expert can overflow, so
         # capacity dispatch computes exactly the dense function
@@ -156,6 +157,7 @@ class TestMoECapacityDispatch:
                                        np.asarray(full[:, -1, :]),
                                        rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_beam_search_k1_equals_greedy(self):
         cfg = moe.moe_tiny()
         params = moe.init_params(cfg, jax.random.key(7))
@@ -441,6 +443,7 @@ class TestDomainReviewRegressions:
 
 
 class TestOCRRecognizer:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_ocr_rec_trains_with_ctc(self):
         import numpy as np
 

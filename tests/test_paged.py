@@ -1,0 +1,397 @@
+"""Paged KV cache + ragged paged attention + continuous-batching engine
+(inference/paged.py, inference/engine.py, kernels/paged_attention.py).
+
+The load-bearing contract: the paged decode path must produce EXACTLY
+the ring-buffer path's tokens (greedy and fixed-seed sampling, bf16 and
+weight-only int8, llama and MoE) while allocating KV at page
+granularity — plus allocator refcount invariants (nothing leaks, OOM is
+admission refusal, fork is copy-on-write) and scheduler behavior under
+a randomized arrival/length trace.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (backend/platform init)
+from paddle_tpu.core import enforce as E
+from paddle_tpu.inference import PagedKVCache, Request, ServingEngine
+from paddle_tpu.inference.paged import PageAllocator
+from paddle_tpu.kernels import paged_attention as PA
+from paddle_tpu.models import llama as L
+from paddle_tpu.models import moe as M
+
+pytestmark = pytest.mark.serving
+
+
+def _prompts(rng, vocab, lens):
+    return [rng.integers(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+def _ring_generate(family, params, cfg, prompt, n, **kw):
+    return np.asarray(family.generate(
+        params, jnp.asarray(prompt)[None, :], cfg, max_new_tokens=n,
+        **kw))[0]
+
+
+class TestKernel:
+    """ragged_paged_attention (interpret mode) vs the jnp gather ref."""
+
+    def _case(self, dtype, B=3, nh=4, kv=2, hd=64, ps=8, P=16, maxp=4,
+              lengths=(13, 0, 25), seed=0):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(B, nh, hd)), dtype)
+        kp = jnp.asarray(rng.normal(size=(P, kv, ps, hd)), dtype)
+        vp = jnp.asarray(rng.normal(size=(P, kv, ps, hd)), dtype)
+        bt = jnp.asarray(rng.permutation(P)[:B * maxp].reshape(B, maxp),
+                         jnp.int32)
+        ln = jnp.asarray(lengths, jnp.int32)
+        return q, kp, vp, bt, ln
+
+    def test_kernel_matches_ref_f32(self):
+        q, kp, vp, bt, ln = self._case(jnp.float32)
+        got = PA.ragged_paged_attention(q, kp, vp, bt, ln, interpret=True)
+        want = PA.paged_attention_ref(q, kp, vp, bt, ln)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_kernel_matches_ref_bf16_gqa(self):
+        q, kp, vp, bt, ln = self._case(jnp.bfloat16, nh=8, kv=2, ps=16,
+                                       lengths=(31, 7, 64))
+        got = PA.ragged_paged_attention(q, kp, vp, bt, ln, interpret=True)
+        want = PA.paged_attention_ref(q, kp, vp, bt, ln)
+        np.testing.assert_allclose(
+            np.asarray(got).astype(np.float32),
+            np.asarray(want).astype(np.float32), rtol=2e-2, atol=2e-2)
+
+    def test_empty_sequence_yields_zero_row_not_nan(self):
+        q, kp, vp, bt, _ = self._case(jnp.float32)
+        ln = jnp.zeros((3,), jnp.int32)
+        for fn in (lambda: PA.ragged_paged_attention(
+                q, kp, vp, bt, ln, interpret=True),
+                lambda: PA.paged_attention_ref(q, kp, vp, bt, ln)):
+            out = np.asarray(fn())
+            assert np.isfinite(out).all()
+            np.testing.assert_array_equal(out, np.zeros_like(out))
+
+    def test_ref_matches_ring_attention_math(self):
+        """Paged gather attention == the ring _attn_over_cache on the
+        same KV laid out contiguously (pages = consecutive chunks)."""
+        rng = np.random.default_rng(3)
+        B, nh, kv, hd, ps, maxp = 2, 4, 2, 32, 4, 3
+        Mlen = maxp * ps
+        q = jnp.asarray(rng.normal(size=(B, 1, nh, hd)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(B, Mlen, kv, hd)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, Mlen, kv, hd)), jnp.float32)
+        pos = 9                                   # ring: 0..pos valid
+        ring = L._attn_over_cache(q, kc, vc, jnp.asarray(pos))
+        # re-page the same cache: page p of seq b = rows [p*ps, (p+1)*ps)
+        kp = jnp.moveaxis(kc.reshape(B * maxp, ps, kv, hd), 2, 1)
+        vp = jnp.moveaxis(vc.reshape(B * maxp, ps, kv, hd), 2, 1)
+        bt = jnp.arange(B * maxp, dtype=jnp.int32).reshape(B, maxp)
+        ln = jnp.full((B,), pos + 1, jnp.int32)
+        paged = PA.paged_attention_ref(q[:, 0], kp, vp, bt, ln)
+        np.testing.assert_allclose(np.asarray(ring)[:, 0],
+                                   np.asarray(paged).reshape(B, -1),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_supported_guard(self):
+        q, kp, _, bt, _ = self._case(jnp.float32)
+        assert PA.supported(q, kp, bt)
+        assert not PA.supported(q.astype(jnp.int8), kp, bt)
+        assert not PA.supported(q[:, :3], kp, bt)      # nh % kv != 0
+
+
+class TestAllocator:
+    def test_alloc_advance_free_roundtrip(self):
+        a = PageAllocator(num_pages=8, page_size=4, max_pages_per_seq=4)
+        pages = a.alloc(0, 10)                         # 3 pages
+        assert len(pages) == 3 and a.used_pages == 3
+        a.advance(0, 10)
+        a.check_invariants()
+        a.free(0)
+        assert a.used_pages == 0 and a.free_pages == 8
+        a.check_invariants()
+
+    def test_oom_returns_none_state_unchanged(self):
+        a = PageAllocator(num_pages=2, page_size=4, max_pages_per_seq=4)
+        assert a.alloc(0, 8) is not None
+        assert a.alloc(1, 4) is None                   # OOM: no pages
+        assert 1 not in a._seqs and a.used_pages == 2
+        a.advance(0, 8)
+        assert a.ensure(0, 12) is None                 # grow OOM
+        assert len(a.seq_pages(0)) == 2                # unchanged
+        a.check_invariants()
+
+    def test_ensure_grows_only_when_needed(self):
+        a = PageAllocator(num_pages=8, page_size=4, max_pages_per_seq=8)
+        a.alloc(0, 4)
+        a.advance(0, 4)
+        new, cow = a.ensure(0, 4)
+        assert new == [] and cow == []
+        new, cow = a.ensure(0, 5)
+        assert len(new) == 1 and cow == []
+        a.check_invariants()
+
+    def test_fork_shares_then_copies_on_write(self):
+        a = PageAllocator(num_pages=8, page_size=4, max_pages_per_seq=4)
+        pages = a.alloc(0, 6)
+        a.advance(0, 6)
+        assert a.fork(0, 1) == pages
+        assert a.used_pages == 2                       # shared, no copies
+        a.check_invariants()
+        new, cow = a.ensure(1, 7)     # writes into the shared tail page
+        assert new == [] and len(cow) == 1
+        assert cow[0][0] == pages[1]
+        assert a.seq_pages(1)[1] != pages[1]
+        assert a.seq_pages(0) == pages                 # src untouched
+        a.check_invariants()
+        a.free(0)
+        a.free(1)
+        assert a.used_pages == 0
+        a.check_invariants()
+
+    def test_double_alloc_and_overadvance_raise(self):
+        a = PageAllocator(num_pages=4, page_size=4, max_pages_per_seq=4)
+        a.alloc(0, 4)
+        with pytest.raises(E.EnforceError):
+            a.alloc(0, 4)
+        with pytest.raises(E.EnforceError):
+            a.advance(0, 5)                            # past capacity
+
+    def test_pool_cow_copies_device_pages(self):
+        cfg = L.llama_tiny()
+        c = PagedKVCache(cfg, num_pages=6, page_size=4,
+                         max_pages_per_seq=3, dtype=jnp.float32)
+        pages = c.alloc.alloc(0, 6)
+        c.pool["k"] = c.pool["k"].at[:, pages[1]].set(7.0)
+        c.alloc.advance(0, 6)
+        c.alloc.fork(0, 1)
+        _, cow = c.alloc.ensure(1, 7)
+        c.apply_cow(cow)
+        dst = c.alloc.seq_pages(1)[1]
+        np.testing.assert_array_equal(
+            np.asarray(c.pool["k"][:, dst]),
+            np.full_like(np.asarray(c.pool["k"][:, dst]), 7.0))
+
+
+class TestPagedDecodeParity:
+    """Identical tokens vs the ring-buffer path (the acceptance bar)."""
+
+    def _run(self, family, cfg, params, lens, new, **req_kw):
+        rng = np.random.default_rng(7)
+        prompts = _prompts(rng, cfg.vocab_size, lens)
+        want = [_ring_generate(family, params, cfg, p, new,
+                               **{k: v for k, v in req_kw.items()
+                                  if k in ("temperature", "key")})
+                for p in prompts]
+        eng = ServingEngine(family, params, cfg, num_slots=2,
+                            max_len=32, page_size=4, decode_chunk=3)
+        outs = eng.run([Request(rid=i, prompt=p, max_new_tokens=new,
+                                **req_kw)
+                        for i, p in enumerate(prompts)])
+        for i, w in enumerate(want):
+            np.testing.assert_array_equal(outs[i].tokens, w)
+        eng.cache.alloc.check_invariants()
+        assert eng.cache.alloc.used_pages == 0         # all retired
+        return eng
+
+    def test_llama_greedy_f32(self):
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        self._run(L, cfg, params, (5, 8, 11), 6)
+
+    def test_llama_greedy_bf16(self):
+        cfg = L.llama_tiny(dtype=jnp.bfloat16)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        self._run(L, cfg, params, (5, 9), 5)
+
+    def test_llama_temperature_fixed_seed(self):
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(1))
+        self._run(L, cfg, params, (8,), 6, temperature=0.8,
+                  key=jax.random.PRNGKey(42))
+
+    def test_llama_int8(self):
+        cfg = L.llama_tiny()
+        qp = L.quantize_weights(L.init_params(cfg, jax.random.PRNGKey(2)))
+        self._run(L, cfg, qp, (6, 10), 5)
+
+    def test_moe_greedy(self):
+        cfg = M.moe_tiny()
+        params = M.init_params(cfg, jax.random.PRNGKey(3))
+        self._run(M, cfg, params, (4, 9), 5)
+
+    def test_moe_int8(self):
+        cfg = M.moe_tiny()
+        qp = M.quantize_weights(M.init_params(cfg, jax.random.PRNGKey(4)))
+        self._run(M, cfg, qp, (7,), 4)
+
+    def test_eos_stops_and_frees(self):
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(5))
+        rng = np.random.default_rng(9)
+        prompt = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+        full = _ring_generate(L, params, cfg, prompt, 8)
+        eos = int(full[3])                  # force a stop mid-stream
+        eng = ServingEngine(L, params, cfg, num_slots=1, max_len=32,
+                            page_size=4, decode_chunk=3)
+        outs = eng.run([Request(rid=0, prompt=prompt, max_new_tokens=8,
+                                eos_token_id=eos)])
+        got = outs[0].tokens
+        assert got[-1] == eos and len(got) <= 8
+        np.testing.assert_array_equal(got, full[:len(got)])
+        assert eng.cache.alloc.used_pages == 0
+
+    def test_decode_through_interpret_kernel_matches_ref(self):
+        """The pallas kernel (interpret) slotted into the decode seam
+        produces the same tokens as the jnp fallback."""
+        from paddle_tpu import kernels as K
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(6))
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        want = _ring_generate(L, params, cfg, prompt, 4)
+        orig = K.dispatched_paged_attention
+        import paddle_tpu.inference.paged as paged_mod  # noqa: F401
+
+        def interp(q, kp, vp, bt, ln, *, scale=None):
+            return PA.ragged_paged_attention(q, kp, vp, bt, ln,
+                                             scale=scale, interpret=True)
+
+        K.dispatched_paged_attention = interp
+        try:
+            eng = ServingEngine(L, params, cfg, num_slots=1, max_len=16,
+                                page_size=8, decode_chunk=2)
+            outs = eng.run([Request(rid=0, prompt=prompt,
+                                    max_new_tokens=4)])
+        finally:
+            K.dispatched_paged_attention = orig
+        np.testing.assert_array_equal(outs[0].tokens, want)
+
+
+class TestEngineScheduling:
+    def test_randomized_arrival_length_trace(self):
+        """Poisson-ish arrivals x random prompt/gen lengths through a
+        small slot grid: every request completes with exactly its token
+        budget, no page leaks, occupancy accounted."""
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(8))
+        rng = np.random.default_rng(123)
+        eng = ServingEngine(L, params, cfg, num_slots=3, max_len=48,
+                            page_size=4, decode_chunk=2)
+        reqs = [Request(rid=i,
+                        prompt=rng.integers(
+                            0, cfg.vocab_size,
+                            (int(rng.integers(1, 14)),)).astype(np.int32),
+                        max_new_tokens=int(rng.integers(1, 9)))
+                for i in range(9)]
+        pending = list(reqs)
+        # staggered arrivals: a couple of requests join per scheduler step
+        eng.submit(pending.pop(0))
+        busy = True
+        while busy or pending:
+            for _ in range(int(rng.integers(0, 3))):
+                if pending:
+                    eng.submit(pending.pop(0))
+            busy = eng.step()
+        outs = eng.outputs
+        assert sorted(outs) == [r.rid for r in reqs]
+        for r in reqs:
+            assert len(outs[r.rid].tokens) == r.max_new_tokens
+            # spot-check correctness on a couple of requests
+        for r in reqs[:2]:
+            want = _ring_generate(L, params, cfg, r.prompt,
+                                  r.max_new_tokens)
+            np.testing.assert_array_equal(outs[r.rid].tokens, want)
+        eng.cache.alloc.check_invariants()
+        assert eng.cache.alloc.used_pages == 0
+        s = eng.stats
+        assert s.completed == len(reqs)
+        assert s.tokens_generated == sum(r.max_new_tokens for r in reqs)
+        assert 0.0 < s.occupancy() <= 1.0
+
+    def test_preemption_under_tiny_pool(self):
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(9))
+        rng = np.random.default_rng(5)
+        eng = ServingEngine(L, params, cfg, num_slots=2, max_len=16,
+                            page_size=4, num_pages=5, decode_chunk=2)
+        reqs = [Request(rid=i, prompt=rng.integers(
+                    0, cfg.vocab_size, (4,)).astype(np.int32),
+                        max_new_tokens=8) for i in range(3)]
+        outs = eng.run(reqs)
+        assert eng.stats.preempted >= 1            # pool forces eviction
+        for r in reqs:                             # recompute = exact
+            want = _ring_generate(L, params, cfg, r.prompt, 8)
+            np.testing.assert_array_equal(outs[r.rid].tokens, want)
+        assert eng.cache.alloc.used_pages == 0
+
+    def test_admission_refused_on_oom_idle_engine(self):
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(10))
+        eng = ServingEngine(L, params, cfg, num_slots=1, max_len=16,
+                            page_size=4, num_pages=4)
+        # pool holds 4 pages; a 17-token request exceeds max_len
+        with pytest.raises(E.EnforceError):
+            eng.submit(Request(rid=0,
+                               prompt=np.zeros(12, np.int32),
+                               max_new_tokens=8))
+
+    def test_watermark_defers_admission(self):
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(11))
+        rng = np.random.default_rng(6)
+        eng = ServingEngine(L, params, cfg, num_slots=2, max_len=16,
+                            page_size=4, num_pages=8, watermark=0.5,
+                            decode_chunk=2)
+        reqs = [Request(rid=i, prompt=rng.integers(
+                    0, cfg.vocab_size, (12,)).astype(np.int32),
+                        max_new_tokens=4) for i in range(2)]
+        for r in reqs:
+            eng.submit(r)
+        eng.step()
+        # each prompt buckets to 4 pages; admitting the second would
+        # leave 0 < 4 (= watermark) free pages: deferred
+        assert eng.stats.admitted == 1 and len(eng.queue) == 1
+        outs = eng.run()
+        assert sorted(outs) == [0, 1]
+        assert eng.cache.alloc.used_pages == 0
+
+    def test_max_len_auto_page_size(self):
+        """page_size=None resolves through the autotune knob (defaults
+        off-TPU) and the engine still round-trips."""
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(12))
+        eng = ServingEngine(L, params, cfg, num_slots=1, max_len=32)
+        assert eng.page_size >= 1
+        outs = eng.run([Request(rid=0,
+                                prompt=np.arange(4, dtype=np.int32),
+                                max_new_tokens=3)])
+        assert len(outs[0].tokens) == 3
+
+
+class TestPagedAutotune:
+    def test_page_size_sweep_with_injected_measure(self):
+        from paddle_tpu.kernels import autotune as at
+        cache = at.AutotuneCache(path="/dev/null/never")  # memory-only
+        calls = []
+
+        def measure(ps):
+            calls.append(ps)
+            return {8: 5.0, 16: 1.0, 32: 2.0, 64: 3.0}[ps]
+
+        got = at.paged_page_size(4, 8, 2, 64, 128, jnp.float32,
+                                 measure=measure, cache=cache)
+        assert got == 16 and len(calls) >= 2
+        # second call is a cache hit: no remeasure
+        calls.clear()
+        got = at.paged_page_size(4, 8, 2, 64, 128, jnp.float32,
+                                 measure=measure, cache=cache)
+        assert got == 16 and calls == []
+
+    def test_bf16_candidates_respect_sublane(self):
+        from paddle_tpu.kernels import autotune as at
+        assert all(ps >= 16 for ps in at.paged_candidates(jnp.bfloat16,
+                                                          128))
+        assert 8 in at.paged_candidates(jnp.float32, 128)

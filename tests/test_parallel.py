@@ -68,6 +68,7 @@ class TestPipelineSchedule:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5, losses[::10]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_pipeline_grad_matches_sequential(self):
         """d(loss)/d(params) through the pipelined program equals the
         sequential gradient."""

@@ -176,6 +176,7 @@ class TestPredictor:
         (out,) = pred.run([xin])
         np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_fresh_process_serving(self, tmp_path):
         """Save here; serve through the Predictor API in a NEW python
         process (the reference deploy contract: no model class, no saver

@@ -238,6 +238,7 @@ class TestVisionSurface:
         out = T.RandomErasing(prob=1.0)(img)
         assert out.shape == img.shape
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_new_model_families_forward(self):
         import paddle_tpu.vision.models as M
 
@@ -250,6 +251,7 @@ class TestVisionSurface:
         y = M.densenet121(num_classes=10)(x)
         assert y.shape == [1, 10]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_alexnet_googlenet_inception(self):
         import paddle_tpu.vision.models as M
 
@@ -262,6 +264,7 @@ class TestVisionSurface:
                .astype("float32"))
         assert M.inception_v3(num_classes=7)(x2).shape == [1, 7]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_resnext_wide(self):
         import paddle_tpu.vision.models as M
 

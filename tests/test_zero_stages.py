@@ -45,6 +45,7 @@ def build_mlp(seed=3):
 
 
 class TestZeroMemoryEvidence:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 3): heavy; run in the slow lane
     def test_stage3_param_bytes_one_over_n(self):
         mesh = make_mesh(8, names=["dp"])
         model = build_mlp()
