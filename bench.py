@@ -277,11 +277,31 @@ def _metrics_summary():
                 "tokens_generated": c.get("serving.tokens.generated", 0),
                 "tokens_prefilled": c.get("serving.tokens.prefilled", 0),
             },
+            # sequence-packed training (io/packing.py + the segment
+            # flash kernel): pack efficiency, block skipping, and the
+            # varlen dispatch counters of the training_packed rung
+            "packing": {
+                "efficiency": g.get("packing.efficiency"),
+                "blocks_skipped": g.get("packing.blocks.skipped"),
+                "blocks_total": g.get("packing.blocks.total"),
+                "tokens_real": c.get("packing.tokens.real", 0),
+                "tokens_padding": c.get("packing.tokens.padding", 0),
+                "varlen_dispatch": _varlen_dispatch_counters(),
+            },
             "snapshot": monitor.dump_json(
                 run_id=f"bench-{os.getpid()}-{int(time.time())}"),
         }
     except Exception as e:                      # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _varlen_dispatch_counters():
+    try:
+        from paddle_tpu import kernels
+        stats = kernels.dispatch_stats()
+        return {k: stats[k] for k in ("varlen", "varlen_fallback")}
+    except Exception:
+        return {}
 
 
 def _preflight_kernels(on_tpu):
@@ -571,6 +591,19 @@ def _main():
         payload["extra"]["serving_paged"] = {
             "error": f"{type(e).__name__}: {e}"[:500]}
 
+    # Packed-training rung: a heavy-tailed document-length trace trained
+    # sequence-PACKED (segment-masked flash attention, io/packing.py)
+    # vs the SAME trace trained one-document-per-row padded. Equal
+    # useful tokens on both sides — padding rows are exactly the waste
+    # packing exists to reclaim. Optional like the rungs above.
+    try:
+        _stage("training-packed-rung", 240)
+        jax.clear_caches()
+        payload["extra"]["training_packed"] = _training_packed_rung(on_tpu)
+    except Exception as e:                      # noqa: BLE001
+        payload["extra"]["training_packed"] = {
+            "error": f"{type(e).__name__}: {e}"[:500]}
+
     _stage("report", 30)
     # Re-capture the dispatch record now that every rung has traced:
     # the earlier snapshot (taken for the partial-payload safety copy)
@@ -783,6 +816,135 @@ def _serving_paged_rung(on_tpu):
         "page_pool_utilization": round(s.peak_pages_in_use / pool, 4),
         "preempted": s.preempted,
         "engine": s.as_dict(),
+    }
+
+
+def _training_packed_rung(on_tpu):
+    """Sequence-packed training throughput: a heavy-tailed
+    document-length trace (io.packing.heavy_tailed_lengths — the same
+    deterministic trace scripts/tpu_smoke.py pre-tunes the varlen
+    kernel blocks for) is trained twice with equal useful tokens:
+
+    - packed: greedy first-fit rows + per-token segment ids through the
+      segment-masked flash kernel (inter-document block skipping);
+    - padded: one document per row, padded to the row length — the
+      static-shape baseline every fixed-[B, S] pipeline pays.
+
+    Reports useful tokens/s both ways, the padding fraction reclaimed,
+    and the block-skip fraction of the packed attention grid."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import kernels, monitor
+    from paddle_tpu.io import packing as PK
+    from paddle_tpu.models import llama as L
+
+    if on_tpu:
+        cfg = L.llama_3_8b(num_hidden_layers=4, vocab_size=32000,
+                           remat_policy="dots", fused_ce=False)
+        S, n_docs, iters = 2048, 24, 6
+    else:
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        S, n_docs, iters = 128, 24, 3
+
+    lens = PK.heavy_tailed_lengths(S, n_docs, seed=7)
+    rng = np.random.default_rng(7)
+    docs = [rng.integers(0, cfg.vocab_size, (ln,)).astype(np.int32)
+            for ln in lens]
+    packed = PK.pack_documents(docs, S)
+    pbatch = tuple(jnp.asarray(a) for a in
+                   (packed["ids"], packed["labels"],
+                    packed["segment_ids"], packed["positions"]))
+    b_packed = packed["ids"].shape[0]
+    useful = int((packed["labels"] >= 0).sum())
+
+    # padded baseline: one doc per row, chunked into waves of b_packed
+    # rows so both sides run the same [b_packed, S] step shape
+    ids_pad = np.zeros((n_docs, S), np.int32)
+    lab_pad = np.full((n_docs, S), -100, np.int32)
+    for i, d in enumerate(docs):
+        ids_pad[i, :len(d)] = d
+        lab_pad[i, :len(d) - 1] = d[1:]
+    waves = -(-n_docs // b_packed)
+    pad_rows = waves * b_packed
+    ids_pad = np.pad(ids_pad, ((0, pad_rows - n_docs), (0, 0)))
+    lab_pad = np.pad(lab_pad, ((0, pad_rows - n_docs), (0, 0)),
+                     constant_values=-100)
+    pad_batches = [(jnp.asarray(ids_pad[w * b_packed:(w + 1) * b_packed]),
+                    jnp.asarray(lab_pad[w * b_packed:(w + 1) * b_packed]))
+                   for w in range(waves)]
+
+    # buffer donation like the headline rung — always rebind the
+    # returned params/opt so the donated buffers are never reused
+    step = L.make_train_step(cfg, lr=1e-4)
+
+    @jax.jit
+    def init():
+        p = L.init_params(cfg, jax.random.PRNGKey(0))
+        return p, L.adamw_init(p, moment_dtype=jnp.bfloat16)
+
+    params, opt = init()
+    jax.block_until_ready(params["embed"])
+
+    kernels.reset_dispatch_stats()
+    params, opt, loss = step(params, opt, pbatch)   # compile + warmup
+    float(loss)
+    varlen_stats = {k: v for k, v in kernels.dispatch_stats().items()
+                    if k.startswith("varlen")}
+    params, opt, loss = step(params, opt, pad_batches[0])
+    float(loss)
+
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        params, opt, loss = step(params, opt, pbatch)
+    packed_loss = float(loss)
+    packed_dt = _time.perf_counter() - t0
+
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        for wb in pad_batches:
+            params, opt, loss = step(params, opt, wb)
+    float(loss)
+    padded_dt = _time.perf_counter() - t0
+
+    # block-skip fraction at the blocks the dispatch would use (the
+    # cached/tuned varlen blocks, else the 128/128 defaults)
+    from paddle_tpu.kernels import autotune as _at
+    bq, bk = _at.varlen_blocks(
+        (b_packed, S, cfg.num_attention_heads, cfg.head_dim),
+        (b_packed, S, cfg.num_key_value_heads, cfg.head_dim),
+        cfg.dtype, True)
+    bq, bk = min(bq, S), min(bk, S)
+    skipped, total = kernels.count_skipped_blocks(
+        packed["segment_ids"], packed["segment_ids"],
+        packed["positions"], packed["positions"], bq, bk, True)
+    monitor.set_gauge("packing.blocks.skipped", skipped,
+                      doc="attention block pairs skipped, packed rung")
+    monitor.set_gauge("packing.blocks.total", total,
+                      doc="attention block pairs in the packed grid")
+
+    slots_padded = pad_rows * S
+    slots_packed = b_packed * S
+    return {
+        "config": f"llama_3_8b[{cfg.num_hidden_layers}L]" if on_tpu
+        else "llama_tiny[2L]",
+        "seq_len": S, "documents": n_docs,
+        "packed_rows": b_packed, "padded_rows": pad_rows,
+        "useful_tokens_per_step": useful,
+        "packing_efficiency": round(PK.packing_efficiency(packed), 4),
+        "packed_tokens_per_sec": round(useful * iters / packed_dt, 2),
+        "padded_tokens_per_sec": round(useful * iters / padded_dt, 2),
+        "speedup_vs_padded": round(padded_dt / packed_dt, 3),
+        "padding_fraction_reclaimed": round(
+            (slots_padded - slots_packed) / slots_padded, 4),
+        "blocks_skipped": skipped, "blocks_total": total,
+        "block_skip_fraction": round(skipped / total, 4) if total else 0.0,
+        "varlen_blocks": [bq, bk],
+        "varlen_dispatch": varlen_stats,
+        "loss": packed_loss if np.isfinite(packed_loss)
+        else repr(packed_loss),
     }
 
 

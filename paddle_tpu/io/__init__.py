@@ -3,6 +3,8 @@ from .dataloader import (DataLoader, WorkerInfo, default_collate_fn,  # noqa
                          get_worker_info)
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa
                       IterableDataset, Subset, TensorDataset, random_split)
+from .packing import (PackingCollator, pack_documents,  # noqa
+                      packed_train_batch, packing_efficiency)
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)

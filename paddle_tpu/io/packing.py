@@ -1,0 +1,169 @@
+"""Sequence packing for training (documents -> dense [B, S] rows).
+
+Reference capability: the PaddleNLP llm/ data pipelines' in-batch packing
+(intokens/greedy packing of variable-length documents into fixed
+max_length rows). TPU-native motivation: jit/GSPMD need static shapes, so
+variable-length documents either pay per-row padding (a [B, S] batch of
+mixed-length docs is mostly pad at realistic length distributions) or
+pack back-to-back into full rows tagged with per-token segment ids. The
+segment-aware flash attention kernel (kernels/flash_attention.py) masks
+cross-document attention inside its online-softmax tiles and SKIPS fully
+off-diagonal blocks, so packing is a FLOPs win on top of the padding win.
+
+The packer is greedy FIRST-FIT over arrival order: deterministic (same
+documents -> bit-identical batch), no sorting (arrival order preserved
+within a row, so data order stays reproducible), O(docs * rows). Rows are
+closed only by capacity. Documents longer than ``seq_len`` split into
+consecutive chunks, each chunk its own segment (positions restart — the
+standard LM chunking convention).
+
+Output contract (the model families' ``unpack_batch`` dict form):
+- ``ids``          [B, S] int32 — packed token ids, ``pad_id`` padding.
+- ``segment_ids``  [B, S] int32 — per-row document index, -1 = padding.
+- ``positions``    [B, S] int32 — segment-LOCAL offsets (rope positions).
+- ``labels``       [B, S] int32 — next-token targets; the LAST token of
+  every document and all padding hold ``ignore_index`` so no token ever
+  predicts across a document boundary (fused-CE masks them out).
+
+Monitor gauges/counters (FLAGS_enable_monitor): ``packing.efficiency``
+(real tokens / row slots of the most recent pack), ``packing.documents``,
+``packing.rows``, ``packing.tokens.real``, ``packing.tokens.padding``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import monitor as _monitor
+from ..core import enforce as E
+
+__all__ = ["pack_documents", "PackingCollator", "packed_train_batch",
+           "packing_efficiency", "heavy_tailed_lengths", "IGNORE_INDEX"]
+
+IGNORE_INDEX = -100
+
+
+def _as_1d_ids(doc) -> np.ndarray:
+    if hasattr(doc, "numpy"):          # paddle Tensor
+        doc = doc.numpy()
+    a = np.asarray(doc)
+    return a.reshape(-1).astype(np.int32)
+
+
+def pack_documents(docs: Sequence, seq_len: int, *, pad_id: int = 0,
+                   ignore_index: int = IGNORE_INDEX,
+                   max_rows: Optional[int] = None) -> dict:
+    """Greedily first-fit ``docs`` (1-D token-id arrays) into packed
+    [B, S] rows. Deterministic in arrival order. ``max_rows`` caps the
+    batch: a document whose chunk fits no open row once the cap is
+    reached raises (callers size their traces to their row budget).
+
+    Returns the dict described in the module docstring."""
+    E.enforce(seq_len >= 2, f"seq_len must be >= 2, got {seq_len}",
+              E.InvalidArgumentError)
+    chunks = []
+    n_docs = 0
+    for doc in docs:
+        a = _as_1d_ids(doc)
+        if a.size == 0:
+            continue
+        n_docs += 1
+        for off in range(0, len(a), seq_len):
+            chunks.append(a[off:off + seq_len])
+
+    rows: list = []          # list of list-of-chunks
+    space: list = []         # remaining capacity per row
+    for ch in chunks:
+        for r, free in enumerate(space):
+            if free >= len(ch):
+                rows[r].append(ch)
+                space[r] -= len(ch)
+                break
+        else:
+            if max_rows is not None and len(rows) >= max_rows:
+                raise E.ResourceExhaustedError(
+                    f"pack_documents: a {len(ch)}-token chunk fits none "
+                    f"of the {len(rows)} open rows and max_rows="
+                    f"{max_rows} is reached; raise max_rows or feed "
+                    "fewer documents per pack")
+            rows.append([ch])
+            space.append(seq_len - len(ch))
+
+    b = max(len(rows), 1)
+    ids = np.full((b, seq_len), pad_id, np.int32)
+    seg = np.full((b, seq_len), -1, np.int32)
+    pos = np.zeros((b, seq_len), np.int32)
+    labels = np.full((b, seq_len), ignore_index, np.int32)
+    for r, row in enumerate(rows):
+        o = 0
+        for si, ch in enumerate(row):
+            n = len(ch)
+            ids[r, o:o + n] = ch
+            seg[r, o:o + n] = si
+            pos[r, o:o + n] = np.arange(n, dtype=np.int32)
+            # next-token targets stay INSIDE the document: the last
+            # token's target is the next doc's first token -> masked
+            labels[r, o:o + n - 1] = ch[1:]
+            o += n
+
+    real = int(sum(len(ch) for ch in chunks))
+    slots = b * seq_len
+    if _monitor.enabled():
+        _monitor.set_gauge("packing.efficiency",
+                           round(real / slots, 4) if slots else 0.0,
+                           doc="real tokens / row slots, most recent pack")
+        _monitor.inc("packing.documents", n_docs)
+        _monitor.inc("packing.rows", b)
+        _monitor.inc("packing.tokens.real", real)
+        _monitor.inc("packing.tokens.padding", slots - real)
+    return {"ids": ids, "segment_ids": seg, "positions": pos,
+            "labels": labels}
+
+
+def packing_efficiency(packed: dict) -> float:
+    """real tokens / row slots of a packed batch (from segment_ids)."""
+    seg = np.asarray(packed["segment_ids"])
+    return float((seg >= 0).sum() / seg.size)
+
+
+def packed_train_batch(packed: dict):
+    """Packed dict -> the (inp, labels, segment_ids, positions) jnp
+    tuple the model families' loss_fn/make_train_step consume."""
+    import jax.numpy as jnp
+    return (jnp.asarray(packed["ids"]), jnp.asarray(packed["labels"]),
+            jnp.asarray(packed["segment_ids"]),
+            jnp.asarray(packed["positions"]))
+
+
+class PackingCollator:
+    """DataLoader ``collate_fn``: a list of variable-length token-id
+    samples (numpy arrays / lists / Tensors) packs into one dense
+    [B, S] batch per the module contract. Deterministic — the same
+    sample list always yields the same batch. Returns numpy arrays
+    (convert with ``packed_train_batch`` for the jitted train step)."""
+
+    def __init__(self, seq_len: int, *, pad_id: int = 0,
+                 ignore_index: int = IGNORE_INDEX,
+                 max_rows: Optional[int] = None):
+        self.seq_len = seq_len
+        self.pad_id = pad_id
+        self.ignore_index = ignore_index
+        self.max_rows = max_rows
+
+    def __call__(self, batch) -> dict:
+        return pack_documents(batch, self.seq_len, pad_id=self.pad_id,
+                              ignore_index=self.ignore_index,
+                              max_rows=self.max_rows)
+
+
+def heavy_tailed_lengths(seq_len: int, n_docs: int, seed: int = 7):
+    """Deterministic heavy-tailed document-length trace (most documents
+    short, a few near ``seq_len``) — the distribution the packed
+    training bench rung and the smoke pre-tuning share so both resolve
+    the same autotune shape key."""
+    rng = np.random.default_rng(seed)
+    buckets = np.array([seq_len // 16, seq_len // 8, seq_len // 4,
+                        seq_len // 2, seq_len])
+    probs = np.array([0.35, 0.25, 0.2, 0.15, 0.05])
+    return [int(x) for x in rng.choice(buckets, size=n_docs, p=probs)]

@@ -25,6 +25,9 @@ from . import rms_norm as _rn
 from .ring_attention import ring_attention  # noqa
 
 flash_attention = _fa.flash_attention
+flash_attention_segments = _fa.flash_attention_segments
+segment_attention_ref = _fa.segment_attention_ref
+count_skipped_blocks = _fa.count_skipped_blocks
 fused_rms_norm = _rn.rms_norm
 fused_cross_entropy = _fce.fused_cross_entropy
 ragged_paged_attention = _pa.ragged_paged_attention
@@ -34,6 +37,8 @@ __all__ = ["flash_attention", "fused_rms_norm", "fused_cross_entropy",
            "dispatched_fused_ce", "ring_attention",
            "ragged_paged_attention", "paged_attention_ref",
            "dispatched_paged_attention",
+           "flash_attention_segments", "segment_attention_ref",
+           "count_skipped_blocks", "dispatched_segment_attention",
            "register", "unregister", "dispatch_stats", "reset_dispatch_stats"]
 
 # Trace-time dispatch counters (reference capability: the KernelFactory's
@@ -45,7 +50,8 @@ __all__ = ["flash_attention", "fused_rms_norm", "fused_cross_entropy",
 _DISPATCH_STATS = {"flash": 0, "flash_fallback": 0,
                    "rms": 0, "rms_fallback": 0,
                    "fused_ce": 0, "fused_ce_fallback": 0,
-                   "paged": 0, "paged_fallback": 0}
+                   "paged": 0, "paged_fallback": 0,
+                   "varlen": 0, "varlen_fallback": 0}
 
 
 def dispatch_stats() -> dict:
@@ -132,6 +138,30 @@ def dispatched_fused_ce(x, head, labels, *, vocab_chunk=None,
         logits, labels, ignore_index=ignore_index, reduction=reduction)
 
 
+def dispatched_segment_attention(q, k, v, seg_q, seg_k, pos_q, pos_k, *,
+                                 causal=False, scale=None):
+    """Segment-masked (sequence-packed) attention with the same counter
+    discipline as flash/paged: the Pallas segment kernel on TPU when the
+    shapes are supported (block sizes resolved through the autotune
+    cache's ``varlen`` knob), the pure-jnp grouped-GQA reference
+    elsewhere (tier-1's CPU path). Both share one masking definition —
+    packed-vs-unpacked training parity holds on either path."""
+    # default-block support check BEFORE tuning (the dense dispatcher's
+    # order): a shape the kernel can never run must not pay a
+    # varlen_blocks measurement sweep just to fall back
+    if _on_tpu() and _fa.segments_supported(q, k):
+        from . import autotune as _at
+        bq, bk = _at.varlen_blocks(q.shape, k.shape, q.dtype, causal)
+        if _fa.segments_supported(q, k, block_q=bq, block_k=bk):
+            _DISPATCH_STATS["varlen"] += 1
+            return _fa.flash_attention_segments(
+                q, k, v, seg_q, seg_k, pos_q, pos_k, causal=causal,
+                scale=scale, block_q=bq, block_k=bk)
+    _DISPATCH_STATS["varlen_fallback"] += 1
+    return _fa.segment_attention_ref(q, k, v, seg_q, seg_k, pos_q, pos_k,
+                                     causal=causal, scale=scale)
+
+
 def dispatched_paged_attention(q, k_pages, v_pages, block_tables, lengths,
                                *, scale=None):
     """Ragged paged decode attention with the same counter discipline as
@@ -160,6 +190,9 @@ def register(flash: bool = True, rms: bool = True, tpu_only: bool = False):
     from ..nn.functional import norm as _norm
     if flash:
         _att.register_flash_impl(_make_flash_dispatch(tpu_only))
+        # the segment (sequence-packed) dispatcher self-gates on the
+        # backend + shape support, so one registration serves both modes
+        _att.register_segment_impl(dispatched_segment_attention)
     if rms:
         _norm.register_rms_impl(_make_rms_dispatch(tpu_only))
 
@@ -168,6 +201,7 @@ def unregister():
     from ..nn.functional import attention as _att
     from ..nn.functional import norm as _norm
     _att.register_flash_impl(None)
+    _att.register_segment_impl(None)
     _norm.register_rms_impl(None)
 
 

@@ -533,6 +533,147 @@ def paged_page_size(batch, num_heads, kv_heads, head_dim, max_len, dtype,
     return best
 
 
+# --------------------------------------------------------------------------
+# segment-masked (sequence-packed) flash block tuning: same cache/policy
+# machinery as flash_blocks under its own "varlen" key space — the
+# segment kernel's block trade-off differs from the dense kernel's (the
+# skip predicate's hit rate depends on block size vs document length),
+# so the two knobs tune independently.
+# --------------------------------------------------------------------------
+
+def varlen_candidates(b, bh, sq, sk, d, dtype):
+    """Legal (block_q, block_k) candidates for the segment kernels:
+    flash legality plus the segment-array specs (k-side lane rule)."""
+    from .tiling import segment_specs_legal
+
+    out = []
+    for bq, bk in flash_candidates(bh, sq, sk, d, dtype):
+        if segment_specs_legal(b, sq, sk, bq, bk):
+            out.append((bq, bk))
+    if not out:
+        out.append((min(DEFAULT_BLOCKS[0], sq), min(DEFAULT_BLOCKS[1], sk)))
+    return out
+
+
+def _varlen_measurer(b, sq, sk, h, kvh, d, dtype, causal):
+    """Per-sweep closure for the segment kernel: operands (including a
+    deterministic mixed-length packed segment layout — roughly
+    doc ~ S/4, the regime the packed bench runs) materialise once."""
+    from .flash_attention import flash_attention_segments
+
+    rng = np.random.default_rng(0)
+    q = _rand(rng, (b, sq, h, d), dtype)
+    k = _rand(rng, (b, sk, kvh, d), dtype)
+    v = _rand(rng, (b, sk, kvh, d), dtype)
+
+    def layout(s):
+        seg = np.full((b, s), -1, np.int32)
+        pos = np.zeros((b, s), np.int32)
+        for r in range(b):
+            o = i = 0
+            while o < s:
+                ln = min(int(rng.integers(s // 8, s // 2)), s - o)
+                seg[r, o:o + ln] = i
+                pos[r, o:o + ln] = np.arange(ln)
+                o += ln
+                i += 1
+        return jnp.asarray(seg), jnp.asarray(pos)
+
+    seg_q, pos_q = layout(sq)
+    seg_k, pos_k = (seg_q, pos_q) if sk == sq else layout(sk)
+
+    def measure(bq, bk, interpret=False):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention_segments(
+                q, k, v, seg_q, seg_k, pos_q, pos_k, causal=causal,
+                block_q=bq, block_k=bk,
+                interpret=interpret).astype(jnp.float32))
+
+        f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        out = f(q, k, v)                # compile + warmup
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = f(q, k, v)
+            float(out[0][0, 0, 0, 0].astype(jnp.float32))  # axon-safe sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return measure
+
+
+def varlen_blocks(q_shape, k_shape, dtype, causal,
+                  measure: Optional[Callable] = None,
+                  cache: Optional[AutotuneCache] = None):
+    """Tuned (block_q, block_k) for a segment-masked flash call;
+    measures once per shape key and caches (memory + disk), same policy
+    gates as flash_blocks. The key rides its own ``varlen:`` prefix so
+    dense and packed tunings never collide."""
+    b, sq, h, d = q_shape
+    sk, kvh = k_shape[1], k_shape[2]
+    defaults = (min(DEFAULT_BLOCKS[0], sq), min(DEFAULT_BLOCKS[1], sk))
+    key = (f"varlen:{jax.default_backend()}:{jnp.dtype(dtype).name}:"
+           f"b{b}h{h}kv{kvh}:q{sq}k{sk}d{d}:c{int(bool(causal))}")
+    mode = _mode()
+    if not _flags.flag_value("use_autotune") or mode == "0":
+        _USED[key] = {"blocks": list(defaults), "source": "off"}
+        return defaults
+    if measure is None and mode != "cached" and not _tuning_backend():
+        _USED[key] = {"blocks": list(defaults), "source": "default-not-tpu"}
+        return defaults
+    cache = cache or _CACHE
+    hit = cache.get(key)
+    _monitor.inc("autotune.cache.hit" if hit and not hit.get("error")
+                 else "autotune.cache.miss")
+    if hit and not hit.get("error"):
+        _USED[key] = {"blocks": list(hit["blocks"]), "source": "cache"}
+        return tuple(hit["blocks"])
+    if key in _FAILED_KEYS or (
+            hit and hit.get("failures", 1) >= MAX_SWEEP_FAILURES):
+        _USED[key] = {"blocks": list(defaults), "source": "default"}
+        return defaults
+    if mode == "cached":
+        _USED[key] = {"blocks": list(defaults), "source": "default"}
+        return defaults
+    if measure is None and _in_trace():
+        _USED[key] = {"blocks": list(defaults), "source": "default-in-trace"}
+        return defaults
+    cands = varlen_candidates(b, b * h, sq, sk, d, dtype)
+    if len(cands) == 1:
+        cache.put(key, {"blocks": list(cands[0]), "us": None,
+                        "candidates": 1})
+        _USED[key] = {"blocks": list(cands[0]), "source": "measured"}
+        return cands[0]
+    measure = measure or _varlen_measurer(b, sq, sk, h, kvh, d, dtype,
+                                          causal)
+    _monitor.inc("autotune.sweeps", doc="candidate measurement sweeps run")
+    timings = {}
+    last_err = None
+    for bq, bk in cands:
+        try:
+            timings[(bq, bk)] = measure(bq, bk)
+        except Exception as e:
+            last_err = f"{type(e).__name__}: {e}"[:200]
+            continue
+    if not timings:
+        _FAILED_KEYS.add(key)
+        prior = hit.get("failures", 1) if hit and hit.get("error") else 0
+        cache.put(key, {"blocks": list(defaults), "us": None,
+                        "candidates": 0, "failures": prior + 1,
+                        "error": f"all candidates failed ({last_err})"})
+        _USED[key] = {"blocks": list(defaults), "source": "default"}
+        return defaults
+    best = min(timings, key=timings.get)
+    cache.put(key, {"blocks": list(best),
+                    "us": round(timings[best] * 1e6, 1),
+                    "candidates": len(timings),
+                    "timings_us": {f"{a}x{c}": round(t * 1e6, 1)
+                                   for (a, c), t in timings.items()}})
+    _USED[key] = {"blocks": list(best), "source": "measured"}
+    return best
+
+
 def flash_blocks(q_shape, k_shape, dtype, causal,
                  measure: Optional[Callable] = None,
                  cache: Optional[AutotuneCache] = None):

@@ -390,3 +390,502 @@ def supported(q, k, v, *, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
             bq % 8 == 0 and bk % 8 == 0 and
             h % k.shape[2] == 0 and d <= 256 and
             flash_specs_legal(b * h, sq, sk, d, bq, bk, q.dtype))
+
+
+# ---------------------------------------------------------------------------
+# segment-aware (sequence-packed) flash attention
+#
+# Packed training rows hold several documents back to back, tagged by a
+# per-token segment id (-1 = padding). The kernels below fuse the
+# same-segment mask (and the segment-LOCAL causal mask) into the
+# online-softmax tiles, and prefetch per-block min/max segment ids /
+# positions (splash-attention style, PrefetchScalarGridSpec) so a block
+# pair that cannot contain any same-segment (and, when causal, any
+# non-future) token pair skips its matmuls entirely — packing becomes a
+# FLOPs win on top of the padding win.
+# ---------------------------------------------------------------------------
+
+# rows of the prefetched per-block stats array (int32, [6, B * stride]):
+_ST_QSMIN, _ST_QSMAX, _ST_KSMIN, _ST_KSMAX, _ST_QPMAX, _ST_KPMIN = range(6)
+
+
+def _seg_block_stats(seg_q, seg_k, pos_q, pos_k, block_q, block_k):
+    """Per-block segment/position extrema for the skip predicate.
+    seg/pos: [B, S] int32 (already block-divisible). Returns
+    (stats [6, B*stride] int32, stride) with q blocks at
+    ``b*stride + qi`` and k blocks at ``b*stride + ki``."""
+    b, sq = seg_q.shape
+    sk = seg_k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    stride = max(nq, nk)
+
+    def pad(a):
+        return jnp.pad(a, ((0, 0), (0, stride - a.shape[1])))
+
+    qs = seg_q.reshape(b, nq, block_q)
+    ks = seg_k.reshape(b, nk, block_k)
+    qp = pos_q.reshape(b, nq, block_q)
+    kp = pos_k.reshape(b, nk, block_k)
+    stats = jnp.stack([
+        pad(qs.min(-1)), pad(qs.max(-1)),
+        pad(ks.min(-1)), pad(ks.max(-1)),
+        pad(qp.max(-1)), pad(kp.min(-1)),
+    ]).astype(jnp.int32).reshape(6, b * stride)
+    return stats, stride
+
+
+def _seg_run_predicate(stats_ref, qb, kb, causal):
+    """Scalar block-skip predicate (reads prefetched SMEM stats).
+
+    A (q-block, k-block) pair can contribute iff some pair of tokens
+    shares a (non-padding) segment id — interval overlap of
+    [max(min,0), max] is conservative for any layout and exact for
+    contiguous packing — and, when causal, some k token's segment-local
+    position does not exceed every q token's (min pos_k <= max pos_q:
+    otherwise every same-segment pair is strictly future and masked)."""
+    qsmax = stats_ref[_ST_QSMAX, qb]
+    ksmax = stats_ref[_ST_KSMAX, kb]
+    run = jnp.logical_and(
+        jnp.logical_and(qsmax >= 0, ksmax >= 0),
+        jnp.logical_and(
+            jnp.maximum(stats_ref[_ST_QSMIN, qb], 0) <= ksmax,
+            jnp.maximum(stats_ref[_ST_KSMIN, kb], 0) <= qsmax))
+    if causal:
+        run = jnp.logical_and(
+            run, stats_ref[_ST_KPMIN, kb] <= stats_ref[_ST_QPMAX, qb])
+    return run
+
+
+def count_skipped_blocks(seg_q, seg_k, pos_q, pos_k, block_q, block_k,
+                         causal):
+    """(skipped, total) block pairs for one head's grid — the exact
+    predicate the kernels run, computed eagerly for metrics/bench (every
+    head sees the same segment layout, so the fraction is per-head
+    invariant). Inputs [B, S]; block sizes must divide S."""
+    seg_q = jnp.asarray(seg_q, jnp.int32)
+    seg_k = jnp.asarray(seg_k, jnp.int32)
+    pos_q = jnp.asarray(pos_q, jnp.int32)
+    pos_k = jnp.asarray(pos_k, jnp.int32)
+    b, sq = seg_q.shape
+    nq, nk = sq // block_q, seg_k.shape[1] // block_k
+    stats, stride = _seg_block_stats(seg_q, seg_k, pos_q, pos_k,
+                                     block_q, block_k)
+    st = stats.reshape(6, b, stride)
+    qsmin, qsmax = st[_ST_QSMIN, :, :nq], st[_ST_QSMAX, :, :nq]
+    ksmin, ksmax = st[_ST_KSMIN, :, :nk], st[_ST_KSMAX, :, :nk]
+    run = ((qsmax[:, :, None] >= 0) & (ksmax[:, None, :] >= 0)
+           & (jnp.maximum(qsmin, 0)[:, :, None] <= ksmax[:, None, :])
+           & (jnp.maximum(ksmin, 0)[:, None, :] <= qsmax[:, :, None]))
+    if causal:
+        run = run & (st[_ST_KPMIN, :, None, :nk]
+                     <= st[_ST_QPMAX, :, :nq, None])
+    total = b * nq * nk
+    return total - int(jnp.sum(run)), total
+
+
+def _seg_mask(qseg_ref, kseg_ref, qpos_ref, kpos_ref, causal):
+    """[bq, bk] same-segment (and causal) mask from the per-token refs:
+    q side rides [bq, 1] blocks, k side [1, bk] — the compare broadcasts
+    straight to the score tile shape."""
+    same = jnp.logical_and(qseg_ref[0] == kseg_ref[0], qseg_ref[0] >= 0)
+    if causal:
+        same = jnp.logical_and(same, qpos_ref[0] >= kpos_ref[0])
+    return same
+
+
+def _seg_fwd_kernel(stats_ref, q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+                    qpos_ref, kpos_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                    scale, causal, nh, stride, num_k_blocks):
+    b = pl.program_id(0) // nh
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    run = _seg_run_predicate(stats_ref, b * stride + qi, b * stride + ki,
+                             causal)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                                    # [bq, d]
+        k = k_ref[0]                                    # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        same = _seg_mask(qseg_ref, kseg_ref, qpos_ref, kpos_ref, causal)
+        s = jnp.where(same, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]                             # [bq, 1]
+        l_prev = l_s[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # p masked (not just s): a fully-masked ROW has m_new = -1e30,
+        # where exp(s - m_new) would be 1 per lane and corrupt l — the
+        # mask keeps padding rows at l == 0 so finalize emits exact 0s
+        p = jnp.where(same, jnp.exp(s - m_new), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_s[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)                 # padding rows -> 0
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_s[:, :1] + jnp.log(l)
+
+
+def _seg_bwd_dq_kernel(stats_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, qseg_ref, kseg_ref, qpos_ref, kpos_ref,
+                       dq_ref, dq_acc, *, scale, causal, nh, stride,
+                       num_k_blocks):
+    b = pl.program_id(0) // nh
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _seg_run_predicate(stats_ref, b * stride + qi, b * stride + ki,
+                             causal)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        kk = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        same = _seg_mask(qseg_ref, kseg_ref, qpos_ref, kpos_ref, causal)
+        # padding rows carry lse = -1e30; exp(s - lse) there would be 1,
+        # so the mask (not the -1e30 trick) must zero p
+        p = jnp.where(same, jnp.exp(s - lse_ref[0]), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _seg_bwd_dkv_kernel(stats_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, qseg_ref, kseg_ref, qpos_ref, kpos_ref,
+                        dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                        nh, stride, num_q_blocks):
+    b = pl.program_id(0) // nh
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _seg_run_predicate(stats_ref, b * stride + qi, b * stride + ki,
+                             causal)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        kk = k_ref[0]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        same = _seg_mask(qseg_ref, kseg_ref, qpos_ref, kpos_ref, causal)
+        p = jnp.where(same, jnp.exp(s - lse_ref[0]), 0.0)
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _seg_views(seg_q, seg_k, pos_q, pos_k):
+    """[B, S] int arrays -> the kernel-side layouts: q side [B, Sq, 1]
+    (sublane-major, the LSE-block trick), k side [B, 1, Sk]
+    (lane-major)."""
+    return (jnp.asarray(seg_q, jnp.int32)[:, :, None],
+            jnp.asarray(seg_k, jnp.int32)[:, None, :],
+            jnp.asarray(pos_q, jnp.int32)[:, :, None],
+            jnp.asarray(pos_k, jnp.int32)[:, None, :])
+
+
+def _seg_specs(nh, group, block_q, block_k, d):
+    """The in_specs shared by all three segment kernels, in
+    (q, k, v, qseg, kseg, qpos, kpos) order for the given grid layout
+    where axis 1 = q blocks, axis 2 = k blocks (the dkv kernel swaps the
+    index-map arguments instead)."""
+    qtok = pl.BlockSpec((1, block_q, 1),
+                        lambda b, i, j, s_, h=nh: (b // h, i, 0))
+    ktok = pl.BlockSpec((1, 1, block_k),
+                        lambda b, i, j, s_, h=nh: (b // h, 0, j))
+    return [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, s_: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j, s_, g=group: (b // g, j, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j, s_, g=group: (b // g, j, 0)),
+        qtok, ktok, qtok, ktok,
+    ]
+
+
+def _seg_fwd(q, k, v, segq, segk, posq, posk, stats, stride, nh, *, scale,
+             causal, block_q, block_k, interpret):
+    """q: [BH, Sq, D]; k/v: [BKV, Sk, D]; seg/pos in kernel layouts."""
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    group = bh // bkv
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, nq, nk),
+        in_specs=_seg_specs(nh, group, block_q, block_k, d),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j, s_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j, s_: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(_seg_fwd_kernel, scale=scale, causal=causal,
+                          nh=nh, stride=stride, num_k_blocks=nk),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(stats, q, k, v, segq, segk, posq, posk)
+    return out, lse
+
+
+def _seg_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+    (q, k, v, out, lse, segq, segk, posq, posk, stats, stride, nh) = res
+    bh, sq, d = q.shape
+    bkv, sk, _ = k.shape
+    group = bh // bkv
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    do = g.astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [BH, Sq, 1]
+
+    def qrow(b, i, j, s_):
+        return (b, i, 0)
+
+    row_specs = [pl.BlockSpec((1, block_q, d), qrow),
+                 pl.BlockSpec((1, block_q, 1), qrow),
+                 pl.BlockSpec((1, block_q, 1), qrow)]
+
+    dq = pl.pallas_call(
+        functools.partial(_seg_bwd_dq_kernel, scale=scale, causal=causal,
+                          nh=nh, stride=stride, num_k_blocks=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nq, nk),
+            in_specs=(_seg_specs(nh, group, block_q, block_k, d)[:3]
+                      + row_specs
+                      + _seg_specs(nh, group, block_q, block_k, d)[3:]),
+            out_specs=pl.BlockSpec((1, block_q, d), qrow),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(stats, q, k, v, do, lse, delta, segq, segk, posq, posk)
+
+    # dk/dv per query head then group-summed (GQA, same as the dense bwd);
+    # grid minor axis iterates q blocks, so every index map swaps (i, j)
+    def swap(spec):
+        im = spec.index_map
+        return pl.BlockSpec(spec.block_shape,
+                            lambda b, j, i, s_, f=im: f(b, i, j, s_))
+
+    base = [swap(s) for s in _seg_specs(nh, group, block_q, block_k, d)]
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_seg_bwd_dkv_kernel, scale=scale, causal=causal,
+                          nh=nh, stride=stride, num_q_blocks=nq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nk, nq),
+            in_specs=(base[:3] + [swap(s) for s in row_specs] + base[3:]),
+            out_specs=[
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, j, i, s_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda b, j, i, s_: (b, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(stats, q, k, v, do, lse, delta, segq, segk, posq, posk)
+
+    if group > 1:
+        dk = dk_full.reshape(bkv, group, sk, d).sum(axis=1)
+        dv = dv_full.reshape(bkv, group, sk, d).sum(axis=1)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash_seg(q, k, v, seg_q, seg_k, pos_q, pos_k, scale, causal,
+               block_q, block_k, interpret):
+    out, _ = _flash_seg_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, scale,
+                            causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_seg_fwd(q, k, v, seg_q, seg_k, pos_q, pos_k, scale, causal,
+                   block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    qr = _reshape_in(q)
+    kr = _reshape_in(k)
+    vr = _reshape_in(v)
+    segq, segk, posq, posk = _seg_views(seg_q, seg_k, pos_q, pos_k)
+    stats, stride = _seg_block_stats(
+        jnp.asarray(seg_q, jnp.int32), jnp.asarray(seg_k, jnp.int32),
+        jnp.asarray(pos_q, jnp.int32), jnp.asarray(pos_k, jnp.int32),
+        block_q, block_k)
+    out, lse = _seg_fwd(qr, kr, vr, segq, segk, posq, posk, stats, stride,
+                        h, scale=scale, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    res = (qr, kr, vr, out, lse, segq, segk, posq, posk, stats, stride, h)
+    return _reshape_out(out, b, h), (res, b, h)
+
+
+def _flash_seg_bwd(scale, causal, block_q, block_k, interpret, resbh, g):
+    res, b, h = resbh
+    kvh = res[1].shape[0] // b
+    gr = _reshape_in(g)
+    dq, dk, dv = _seg_bwd(res, gr, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return (_reshape_out(dq, b, h), _reshape_out(dk, b, kvh),
+            _reshape_out(dv, b, kvh), None, None, None, None)
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
+def flash_attention_segments(q, k, v, seg_q, seg_k, pos_q, pos_k, *,
+                             causal=False, scale=None,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Segment-masked flash attention on [B, S, H, D] packed rows.
+
+    ``seg_q``/``seg_k`` [B, S] int32 tag each token with its document
+    (-1 = padding: such rows produce exact zeros and zero gradients);
+    tokens attend only within their own segment, and ``causal`` masks on
+    the segment-LOCAL positions ``pos_q``/``pos_k`` [B, S] (for
+    self-attention packing, pos = offset within the document). GQA and
+    the blockwise custom-VJP backward work exactly as in the dense
+    ``flash_attention``; additionally, block pairs that can contain no
+    visible token pair are skipped via prefetched per-block segment /
+    position extrema (see ``count_skipped_blocks`` for the predicate)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    return _flash_seg(q, k, v, jnp.asarray(seg_q, jnp.int32),
+                      jnp.asarray(seg_k, jnp.int32),
+                      jnp.asarray(pos_q, jnp.int32),
+                      jnp.asarray(pos_k, jnp.int32),
+                      float(scale), bool(causal), bq, bk, interpret)
+
+
+def segment_attention_ref(q, k, v, seg_q, seg_k, pos_q, pos_k, *,
+                          causal=False, scale=None):
+    """Pure-jnp reference with IDENTICAL masking semantics to the
+    segment kernels (tier-1's CPU path and the dispatcher fallback):
+    same-segment block-diagonal mask, segment-local causal, padding
+    (seg < 0) rows exactly zero. GQA contracts grouped heads directly —
+    no jnp.repeat of k/v, so KV HBM traffic stays at the kv-head count."""
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    seg_q = jnp.asarray(seg_q, jnp.int32)
+    seg_k = jnp.asarray(seg_k, jnp.int32)
+    q5 = q.astype(jnp.float32).reshape(b, sq, kvh, g, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", q5,
+                   k.astype(jnp.float32)) * scale
+    same = ((seg_q[:, :, None] == seg_k[:, None, :])
+            & (seg_q[:, :, None] >= 0))                  # [B, Sq, Sk]
+    if causal:
+        same = same & (jnp.asarray(pos_q)[:, :, None]
+                       >= jnp.asarray(pos_k)[:, None, :])
+    mask = same[:, None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)                      # padding rows -> 0
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", e / l,
+                     v.astype(jnp.float32))
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def segments_supported(q, k, *, block_q=DEFAULT_BLOCK_Q,
+                       block_k=DEFAULT_BLOCK_K):
+    """Whether the segment kernels handle these shapes (else the
+    dispatcher uses segment_attention_ref). Adds the segment-array
+    BlockSpec legality (tiling.segment_specs_legal) on top of the dense
+    kernel's rules — notably the k-side lane rule: block_k % 128 == 0 or
+    block_k == Sk."""
+    from .tiling import flash_specs_legal, segment_specs_legal
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    return (sq % bq == 0 and sk % bk == 0 and
+            bq % 8 == 0 and bk % 8 == 0 and
+            h % k.shape[2] == 0 and d <= 256 and
+            flash_specs_legal(b * h, sq, sk, d, bq, bk, q.dtype) and
+            segment_specs_legal(b, sq, sk, bq, bk))

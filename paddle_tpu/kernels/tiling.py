@@ -60,3 +60,15 @@ def flash_specs_legal(bh, sq, sk, d, block_q, block_k, dtype) -> bool:
         # lse/delta blocks: (1, block_q, 1) over [bh, sq, 1] (always f32)
         and block_legal((1, block_q, 1), (bh, sq, 1), lse)
     )
+
+
+def segment_specs_legal(b, sq, sk, block_q, block_k) -> bool:
+    """Legality of the EXTRA BlockSpecs the segment-aware flash kernels
+    add on top of flash_specs_legal: per-token segment-id / position
+    arrays in the trailing-singleton layout (q side ``[B, Sq, 1]`` with
+    (1, block_q, 1) blocks — the LSE trick) and the lane-major k side
+    (``[B, 1, Sk]`` with (1, 1, block_k) blocks, whose last dim must hit
+    the 128-lane rule or equal Sk). All int32."""
+    i32 = np.int32
+    return (block_legal((1, block_q, 1), (b, sq, 1), i32)
+            and block_legal((1, 1, block_k), (b, 1, sk), i32))

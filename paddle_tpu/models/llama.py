@@ -40,12 +40,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import nn
 from ..nn import functional as F
 from ..core import enforce as E
-from ..nn.functional.attention import (rope_raw, rope_tables as _rope_tables,
+from ..nn.functional.attention import (gather_rope_rows as _gather_rope_rows,
+                                       rope_raw, rope_tables as _rope_tables,
                                        sdpa_raw)
 
 __all__ = [
     "LlamaConfig", "llama_tiny", "llama_3_8b",
-    "init_params", "forward", "loss_fn", "param_specs",
+    "init_params", "forward", "loss_fn", "param_specs", "unpack_batch",
     "make_train_step", "make_forward", "adamw_init", "count_params",
     "LlamaForCausalLM",
     "init_cache", "prefill", "decode_step", "generate", "make_sampler",
@@ -290,7 +291,8 @@ def decode_mlp(x, lp, config: LlamaConfig):
     return _ffn(x, lp, config)
 
 
-def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
+def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh,
+           segment_ids=None, positions=None):
     """One decoder layer. x: [B, S, D]; lp: this layer's param slice."""
     c = config
     B, S, D = x.shape
@@ -301,7 +303,8 @@ def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
     q, k, v = _qkv_proj(h, lp, c, constrain)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    a = sdpa_raw(q, k, v, is_causal=True)
+    a = sdpa_raw(q, k, v, is_causal=True, segment_ids=segment_ids,
+                 positions=positions)
     # Named so remat_policy="attn" can pin exactly this value: the one
     # tensor whose recompute (a full flash-attention forward) dominates
     # the backward pass under full remat, at 2*B*S*D bytes per layer.
@@ -312,14 +315,24 @@ def _block(x, lp, cos, sin, config: LlamaConfig, sp: bool, mesh):
 
 
 def forward_hidden(params, ids, config: LlamaConfig, *, sp: bool = False,
-                   mesh: Optional[Mesh] = None):
-    """Final hidden states [B, S, D] (post ln_f) from token ids [B, S]."""
+                   mesh: Optional[Mesh] = None, segment_ids=None,
+                   positions=None):
+    """Final hidden states [B, S, D] (post ln_f) from token ids [B, S].
+
+    ``segment_ids``/``positions`` [B, S] select sequence-packed
+    semantics: rope positions restart per document and attention is
+    segment-masked (see nn.functional.attention.sdpa_raw)."""
     c = config
     x = jnp.take(params["embed"], ids, axis=0)
     cos, sin = rope_tables(c, ids.shape[1])
+    if positions is not None:
+        # segment-local rope rows (sequence packing) via the shared
+        # position_ids gather seam
+        cos, sin = _gather_rope_rows(cos, sin, positions)
 
     def step(carry, lp):
-        return _block(carry, lp, cos, sin, c, sp, mesh), None
+        return _block(carry, lp, cos, sin, c, sp, mesh,
+                      segment_ids, positions), None
 
     if c.remat:
         step = jax.checkpoint(step, prevent_cse=False,
@@ -334,9 +347,10 @@ def _head(params, config: LlamaConfig):
 
 
 def forward(params, ids, config: LlamaConfig, *, sp: bool = False,
-            mesh: Optional[Mesh] = None):
+            mesh: Optional[Mesh] = None, segment_ids=None, positions=None):
     """Logits [B, S, V] from token ids [B, S]. Pure; jit/shard-ready."""
-    x = forward_hidden(params, ids, config, sp=sp, mesh=mesh)
+    x = forward_hidden(params, ids, config, sp=sp, mesh=mesh,
+                       segment_ids=segment_ids, positions=positions)
     # logits in float32 for a stable softmax-xent
     return _head_logits(x, _head(params, config))
 
@@ -647,9 +661,36 @@ def make_sampler(temperature: float = 0.0, *, top_k: Optional[int] = None,
     return sample
 
 
+def unpack_batch(batch):
+    """Normalize a train-step batch to (inp, labels, segment_ids,
+    positions) — the ONE accepted-forms definition shared by every model
+    family's loss_fn:
+
+    - ids [B, S+1] (labels = shifted ids),
+    - (inp, labels),
+    - (inp, labels, segment_ids, positions)  — sequence-packed rows,
+    - {"ids", "labels", "segment_ids", "positions"} — the packing
+      collator's output (io/packing.py): labels are already next-token
+      targets with cross-document / padding positions at ignore_index.
+    """
+    if isinstance(batch, dict):
+        return (batch["ids"], batch["labels"],
+                batch.get("segment_ids"), batch.get("positions"))
+    if isinstance(batch, (tuple, list)):
+        if len(batch) == 4:
+            return batch[0], batch[1], batch[2], batch[3]
+        inp, labels = batch
+        return inp, labels, None, None
+    return batch[:, :-1], batch[:, 1:], None, None
+
+
 def loss_fn(params, batch, config: LlamaConfig, *, sp: bool = False,
             mesh: Optional[Mesh] = None):
-    """Causal-LM cross entropy. batch = (ids [B,S+1]) or (inp, labels).
+    """Causal-LM cross entropy. batch = (ids [B,S+1]) or (inp, labels)
+    or a sequence-packed form (see ``unpack_batch``): packed rows carry
+    per-token segment ids / segment-local positions, and the labels set
+    cross-document next-token targets to the fused-CE ignore_index so a
+    document never predicts the first token of the next one.
 
     Single-device: blockwise fused CE (kernels/fused_ce.py) — the [B,S,V]
     logits never materialise in HBM (the reference's
@@ -657,18 +698,17 @@ def loss_fn(params, batch, config: LlamaConfig, *, sp: bool = False,
     over vocab chunks). Multi-device (mesh): einsum logits + stable xent,
     which GSPMD shards vocab-parallel.
     """
-    if isinstance(batch, (tuple, list)):
-        inp, labels = batch
-    else:
-        inp, labels = batch[:, :-1], batch[:, 1:]
+    inp, labels, seg, pos = unpack_batch(batch)
     c = config
     if c.fused_ce and mesh is None:
         from ..kernels import dispatched_fused_ce
 
-        x = forward_hidden(params, inp, c, sp=sp, mesh=mesh)
+        x = forward_hidden(params, inp, c, sp=sp, mesh=mesh,
+                           segment_ids=seg, positions=pos)
         return dispatched_fused_ce(x, _head(params, c), labels,
                                    vocab_chunk=c.fused_ce_chunk)
-    logits = forward(params, inp, c, sp=sp, mesh=mesh)
+    logits = forward(params, inp, c, sp=sp, mesh=mesh, segment_ids=seg,
+                     positions=pos)
     # identical ignore_index masking to the fused path (one shared
     # definition — padded labels zero out, mean over valid tokens)
     from ..kernels.fused_ce import masked_xent_from_logits
@@ -778,7 +818,10 @@ def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
     With a mesh (axes 'dp','fsdp','tp'): full GSPMD hybrid parallelism —
     dp/fsdp batch sharding, ZeRO-3 param+opt-state sharding on fsdp,
     Megatron TP on tp, optional sequence parallel. Buffer donation keeps
-    params/opt-state in place (no 2x HBM)."""
+    params/opt-state in place (no 2x HBM). The batch may be any
+    ``unpack_batch`` form — the single batch sharding below is a pytree
+    PREFIX, so a packed (inp, labels, segment_ids, positions) tuple (all
+    [B, S]) shards each leaf over ('dp','fsdp') without new plumbing."""
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(

@@ -348,7 +348,8 @@ def _head(params, config: MoEConfig):
     return params["lm_head"]
 
 
-def _block(x, lp, cos, sin, config: MoEConfig, mesh):
+def _block(x, lp, cos, sin, config: MoEConfig, mesh,
+           segment_ids=None, positions=None):
     c = config
     B, S, D = x.shape
     nh, nkv, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
@@ -359,7 +360,8 @@ def _block(x, lp, cos, sin, config: MoEConfig, mesh):
     v = _mm(h, lp["wv"]).reshape(B, S, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    a = sdpa_raw(q, k, v, is_causal=True).reshape(B, S, nh * hd)
+    a = sdpa_raw(q, k, v, is_causal=True, segment_ids=segment_ids,
+                 positions=positions).reshape(B, S, nh * hd)
     x = x + _mm(a, lp["wo"])
 
     h = _rms(x, lp["ln2"], c.rms_norm_eps)
@@ -368,14 +370,22 @@ def _block(x, lp, cos, sin, config: MoEConfig, mesh):
 
 
 def forward_hidden(params, ids, config: MoEConfig, *,
-                   mesh: Optional[Mesh] = None):
-    """(final hidden [B,S,D] post ln_f, summed aux loss)."""
+                   mesh: Optional[Mesh] = None, segment_ids=None,
+                   positions=None):
+    """(final hidden [B,S,D] post ln_f, summed aux loss).
+    ``segment_ids``/``positions`` [B, S] select sequence-packed
+    semantics — segment-masked attention and per-document rope
+    positions, exactly as in the llama family."""
     c = config
     x = jnp.take(params["embed"], ids, axis=0)
     cos, sin = _rope_tables(ids.shape[1], c.head_dim, theta=c.rope_theta)
+    if positions is not None:
+        from ..nn.functional.attention import gather_rope_rows
+        cos, sin = gather_rope_rows(cos, sin, positions)
 
     def step(carry, lp):
-        y, aux = _block(carry, lp, cos, sin, c, mesh)
+        y, aux = _block(carry, lp, cos, sin, c, mesh,
+                        segment_ids, positions)
         return y, aux
 
     if c.remat:
@@ -386,9 +396,10 @@ def forward_hidden(params, ids, config: MoEConfig, *,
 
 
 def forward(params, ids, config: MoEConfig, *,
-            mesh: Optional[Mesh] = None):
+            mesh: Optional[Mesh] = None, segment_ids=None, positions=None):
     """Returns (logits [B,S,V], aux_loss scalar)."""
-    x, aux = forward_hidden(params, ids, config, mesh=mesh)
+    x, aux = forward_hidden(params, ids, config, mesh=mesh,
+                            segment_ids=segment_ids, positions=positions)
     logits = _head_logits(x, params["lm_head"])
     return logits, aux
 
@@ -517,10 +528,12 @@ def beam_search(params, ids, config: MoEConfig, *, max_new_tokens: int,
 
 def loss_fn(params, batch, config: MoEConfig, *,
             mesh: Optional[Mesh] = None):
-    if isinstance(batch, (tuple, list)):
-        inp, labels = batch
-    else:
-        inp, labels = batch[:, :-1], batch[:, 1:]
+    """Causal-LM CE + router aux loss. Accepts every llama
+    ``unpack_batch`` form, including sequence-packed
+    (inp, labels, segment_ids, positions) rows whose labels carry the
+    ignore_index at cross-document / padding positions."""
+    from .llama import unpack_batch
+    inp, labels, seg, pos = unpack_batch(batch)
     c = config
     if c.fused_ce and mesh is None:
         # Blockwise fused CE: the [B,S,V] logits (~840M f32 at the
@@ -528,13 +541,17 @@ def loss_fn(params, batch, config: MoEConfig, *,
         # dispatcher as the llama family (autotuned vocab chunk).
         from ..kernels import dispatched_fused_ce
 
-        x, aux = forward_hidden(params, inp, c, mesh=mesh)
+        x, aux = forward_hidden(params, inp, c, mesh=mesh,
+                                segment_ids=seg, positions=pos)
         ce = dispatched_fused_ce(x, params["lm_head"], labels)
         return ce + c.router_aux_loss_coef * aux
-    logits, aux = forward(params, inp, c, mesh=mesh)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold) + c.router_aux_loss_coef * aux
+    logits, aux = forward(params, inp, c, mesh=mesh, segment_ids=seg,
+                          positions=pos)
+    # the same ignore_index masking as the fused path (packed batches
+    # mark cross-document targets and padding with -100)
+    from ..kernels.fused_ce import masked_xent_from_logits
+    ce = masked_xent_from_logits(logits, labels)
+    return ce + c.router_aux_loss_coef * aux
 
 
 # ---------------------------------------------------------------------------

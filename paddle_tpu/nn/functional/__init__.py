@@ -68,12 +68,15 @@ def sdp_kernel(enable_math=True, enable_flash=True,
     it unregisters the flash dispatcher within the scope."""
     from . import attention as _att
     prev = _att._FLASH_IMPL
+    prev_seg = _att._SEGMENT_IMPL
     try:
         if not enable_flash:
             # actually remove the flash dispatcher so the scope runs the
             # XLA/math path (register(flash=False) would merely skip
-            # re-installing it)
+            # re-installing it); the segment kernel is the same Pallas
+            # family, so it toggles with it
             _att.register_flash_impl(None)
+            _att.register_segment_impl(None)
         yield
     finally:
         # restore whatever was installed on entry verbatim — a
@@ -81,3 +84,4 @@ def sdp_kernel(enable_math=True, enable_flash=True,
         # deliberately-unregistered state must survive the scope
         if not enable_flash:
             _att.register_flash_impl(prev)
+            _att.register_segment_impl(prev_seg)

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from ...ops._op import op_fn
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "sdpa_reference", "sdpa_raw", "apply_rotary_emb",
+           "sdpa_reference", "sdpa_raw", "segment_attention_raw",
+           "apply_rotary_emb",
            "fused_rotary_position_embedding", "flash_attn_unpadded",
            "segment_ids_from_cu_seqlens", "flash_attn_qkvpacked",
            "flash_attn_varlen_qkvpacked", "flash_attention_with_sparse_mask"]
@@ -27,10 +28,36 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
 # signature (q, k, v, bias, causal, scale) -> out. None = use XLA path.
 _FLASH_IMPL = None
 
+# Segment-masked (sequence-packed) attention dispatcher, installed by
+# paddle_tpu.kernels.register alongside the flash impl; signature
+# (q, k, v, seg_q, seg_k, pos_q, pos_k, *, causal, scale) -> out.
+# None = the pure-jnp reference (identical masking semantics).
+_SEGMENT_IMPL = None
+
 
 def register_flash_impl(fn):
     global _FLASH_IMPL
     _FLASH_IMPL = fn
+
+
+def register_segment_impl(fn):
+    global _SEGMENT_IMPL
+    _SEGMENT_IMPL = fn
+
+
+def segment_attention_raw(q, k, v, seg_q, seg_k, pos_q, pos_k, *,
+                          causal=False, scale=None):
+    """Raw-array segment-masked attention (kernel seam): the registered
+    dispatcher (paddle_tpu.kernels.dispatched_segment_attention — Pallas
+    segment kernel on TPU, grouped-GQA jnp reference elsewhere) when
+    installed, else the reference directly. Used by sdpa_raw's packed
+    path and the varlen functional surface below."""
+    if _SEGMENT_IMPL is not None:
+        return _SEGMENT_IMPL(q, k, v, seg_q, seg_k, pos_q, pos_k,
+                             causal=causal, scale=scale)
+    from ...kernels.flash_attention import segment_attention_ref
+    return segment_attention_ref(q, k, v, seg_q, seg_k, pos_q, pos_k,
+                                 causal=causal, scale=scale)
 
 
 def sdpa_reference(q, k, v, attn_mask=None, *, causal=False, scale=None,
@@ -67,10 +94,29 @@ def sdpa_reference(q, k, v, attn_mask=None, *, causal=False, scale=None,
 
 
 def sdpa_raw(query, key, value, attn_mask=None, *, dropout_p: float = 0.0,
-             is_causal: bool = False, rng_key=None, scale=None):
+             is_causal: bool = False, rng_key=None, scale=None,
+             segment_ids=None, positions=None):
     """Raw-array attention dispatcher (kernel seam): flash kernel when
     registered and applicable, else the XLA math path. Used by both the
-    eager op below and the functional model cores (models/llama.py)."""
+    eager op below and the functional model cores (models/llama.py).
+
+    ``segment_ids`` [B, S] selects the sequence-packed path: tokens
+    attend only within their own document (-1 = padding -> zero rows),
+    with ``is_causal`` evaluated on the segment-local ``positions``
+    [B, S] (defaults to the global arange, which equals the
+    segment-local order for contiguously packed rows)."""
+    if segment_ids is not None:
+        if attn_mask is not None or dropout_p != 0.0:
+            raise NotImplementedError(
+                "sdpa_raw: attn_mask/dropout are not supported together "
+                "with segment_ids (the packed mask IS the mask)")
+        pos = positions
+        if pos is None:
+            pos = jnp.broadcast_to(jnp.arange(query.shape[1]),
+                                   segment_ids.shape)
+        return segment_attention_raw(query, key, value, segment_ids,
+                                     segment_ids, pos, pos,
+                                     causal=is_causal, scale=scale)
     use_flash = (_FLASH_IMPL is not None and attn_mask is None
                  and dropout_p == 0.0)
     if use_flash:
@@ -125,6 +171,14 @@ def rope_tables(seq_len: int, head_dim: int, *, theta: float = 10000.0,
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
     freqs = jnp.outer(jnp.arange(seq_len, dtype=jnp.float32), inv)
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def gather_rope_rows(cos, sin, positions):
+    """Gather per-token rope table rows at explicit positions [B, S] —
+    the position_ids seam: incremental decoding gathers cache offsets,
+    sequence packing gathers segment-LOCAL offsets (every document
+    restarts at 0). Returns [B, S, D/2] tables rope_raw consumes."""
+    return jnp.take(cos, positions, axis=0), jnp.take(sin, positions, axis=0)
 
 
 def rope_raw(x, cos, sin, *, neox: bool = True):
@@ -214,37 +268,23 @@ def _local_positions(cu_seqlens, seg, total):
 def _flash_varlen(q, k, v, seg_q, seg_k, pos_q, pos_k, *, causal, scale):
     """Packed ragged attention: q/k/v [T, H, D] with per-token segment
     ids; tokens attend only within their segment (block-diagonal mask),
-    optionally causal inside each segment.
+    optionally causal inside each segment (on the segment-LOCAL
+    positions — q and k of the same sequence can sit at different global
+    offsets when cu_seqlens_q != cu_seqlens_k).
 
     Reference capability: nn/functional/flash_attention.py
     flash_attn_unpadded (cu_seqlens varlen kernel). TPU-native: the
-    packed layout IS the TPU-friendly form (one dense [T, T] score tile
-    set, no padding waste); the segment mask keeps shapes static so jit
-    never recompiles across batches of different ragged lengths — the
-    same masking strategy as jax's splash-attention segment ids."""
-    import jax
-    import jax.numpy as jnp
-    t, h, d = q.shape
-    hk = k.shape[1]
-    if hk != h:                              # GQA
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-    if scale is None:
-        scale = 1.0 / (d ** 0.5)
-    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    same = (seg_q[:, None] == seg_k[None, :]) & (seg_q[:, None] >= 0)
-    if causal:
-        # SEGMENT-LOCAL positions: q and k of the same sequence can sit at
-        # different global offsets when cu_seqlens_q != cu_seqlens_k
-        same = same & (pos_q[:, None] >= pos_k[None, :])
-    s = jnp.where(same[None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    # fully-masked rows (padding) produce uniform probs; zero them out
-    p = jnp.where(same[None], p, 0.0)
-    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    packed layout IS the TPU-friendly form (shapes stay static so jit
+    never recompiles across batches of different ragged lengths), and
+    the body routes through the segment-attention dispatcher — the
+    Pallas segment-masked flash kernel with inter-document block
+    skipping on TPU (kernels/flash_attention.py), the grouped-GQA jnp
+    reference elsewhere. No [H, T, T] score matrix materialises on the
+    kernel path, and GQA no longer repeats k/v."""
+    out = segment_attention_raw(
+        q[None], k[None], v[None], seg_q[None], seg_k[None],
+        pos_q[None], pos_k[None], causal=causal, scale=scale)
+    return out[0]
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
@@ -265,6 +305,23 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     cq, ck = unwrap(cu_seqlens_q), unwrap(cu_seqlens_k)
     tq = unwrap(query).shape[0]
     tk = unwrap(key).shape[0]
+    # A prefix sum reaching PAST the packed tensor would silently
+    # mis-segment every sequence after the overflow point (tokens it
+    # claims don't exist); cu[-1] < T is the documented trailing-padding
+    # convention and stays legal. Checked eagerly only — under a trace
+    # the values are abstract and the mask math is still well-defined.
+    for name, cu, t in (("cu_seqlens_q", cq, tq), ("cu_seqlens_k", ck, tk)):
+        try:
+            last = int(cu[-1])
+        except (TypeError, jax.errors.ConcretizationTypeError):
+            continue   # traced values: mask math stays well-defined
+        if last > t:
+            from ...core import enforce as E
+            raise E.InvalidArgumentError(
+                f"flash_attn_unpadded: {name}[-1] == {last} exceeds the "
+                f"packed tensor length T == {t}; the prefix sums must "
+                f"end at or before the token count (trailing tokens "
+                f"past {name}[-1] are treated as padding)")
     seg_q = segment_ids_from_cu_seqlens(cq, tq)
     seg_k = segment_ids_from_cu_seqlens(ck, tk)
     out = _flash_varlen(query, key, value, wrap(seg_q), wrap(seg_k),

@@ -278,6 +278,45 @@ def main():
             np.asarray(got).astype(np.float32),
             np.asarray(want).astype(np.float32), rtol=3e-2, atol=3e-2)
 
+    @case("packed_train_step")
+    def _():
+        # sequence-packed training on the real chip: the NATIVE segment
+        # flash kernel must engage (dispatch counter, not a silent
+        # fallback), the loss must be finite, and an aligned trace
+        # (documents exactly one row long) must match the equivalent
+        # unpacked batch
+        from paddle_tpu import kernels
+        from paddle_tpu.io.packing import pack_documents, packed_train_batch
+        from paddle_tpu.models import llama as L
+        cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+        S = 128
+        docs = [rng.integers(0, cfg.vocab_size, (ln,)).astype(np.int32)
+                for ln in (96, 32, 64, 48, 128, 16)]
+        batch = packed_train_batch(pack_documents(docs, S))
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        opt = L.adamw_init(params)
+        step = L.make_train_step(cfg, lr=1e-3, donate=False)
+        kernels.reset_dispatch_stats()
+        _, _, loss = step(params, opt, batch)
+        assert np.isfinite(float(loss)), f"packed loss {float(loss)}"
+        st = kernels.dispatch_stats()
+        if on_tpu:
+            assert st["varlen"] > 0, \
+                f"segment kernel did not engage: {st}"
+        # parity on an aligned trace: one doc per row -> packing is the
+        # identity layout, so packed loss == unpacked loss
+        docs2 = [rng.integers(0, cfg.vocab_size, (S,)).astype(np.int32)
+                 for _ in range(2)]
+        b2 = packed_train_batch(pack_documents(docs2, S))
+        _, _, lp = step(params, opt, b2)
+        ids = np.stack(docs2)
+        labels = np.full((2, S), -100, np.int32)
+        labels[:, :-1] = ids[:, 1:]
+        _, _, lu = step(params, opt, (jnp.asarray(ids),
+                                      jnp.asarray(labels)))
+        np.testing.assert_allclose(float(lp), float(lu),
+                                   rtol=2e-2, atol=2e-2)
+
     @case("checkpoint_save_kill_resume")
     def _():
         # crash-consistency on the real machine: a child process commits
@@ -351,6 +390,24 @@ def main():
                 raise RuntimeError(
                     f"autotune sweep did not measure: {used} "
                     f"(cache entry: {at._CACHE.get(key)})")
+        # varlen (segment-kernel) blocks at the packed-training rung's
+        # shape: the rung's packed row count is a deterministic function
+        # of the shared heavy-tailed trace (io.packing), so the sweep
+        # here lands on exactly the key bench.py will look up
+        from paddle_tpu.io import packing as pk
+        lens = pk.heavy_tailed_lengths(2048, 24, seed=7)
+        pb = pk.pack_documents(
+            [np.zeros(ln, np.int32) for ln in lens], 2048)["ids"].shape[0]
+        vblocks = at.varlen_blocks((pb, 2048, 32, 128),
+                                   (pb, 2048, 8, 128), jnp.bfloat16, True)
+        print(f"tuned varlen blocks for b={pb}: {vblocks}",
+              file=sys.stderr)
+        (key, used), = [(k, u) for k, u in at.used_blocks().items()
+                        if k.startswith("varlen:") and "q2048" in k]
+        if on_tpu and used["source"] not in ("measured", "cache"):
+            raise RuntimeError(
+                f"varlen autotune sweep did not measure: {used} "
+                f"(cache entry: {at._CACHE.get(key)})")
         # fused-CE vocab-chunk sweeps at the bench rungs' loss shapes:
         # dense rung (b4*s2048 tokens, 32k vocab, d4096) and the MoE
         # rung (b2*s1024, 102k vocab, d2048)

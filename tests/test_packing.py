@@ -1,0 +1,525 @@
+"""Sequence-packed training: segment-aware flash attention kernel
+(interpret mode — the hardware-free kernel path), the varlen dispatch
+surface, the greedy first-fit packing collator, and end-to-end
+packed-vs-unpacked training parity for both model families."""
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (framework init)
+from paddle_tpu.io import packing as PK
+from paddle_tpu.models import llama as L
+from paddle_tpu.models import moe as M
+
+FA = importlib.import_module("paddle_tpu.kernels.flash_attention")
+AT = importlib.import_module("paddle_tpu.kernels.autotune")
+
+RNG = np.random.default_rng(3)
+
+
+def rand(shape, dtype=jnp.float32):
+    return jnp.asarray(RNG.normal(size=shape), dtype)
+
+
+def make_row(lens, s):
+    """One packed row's (segment_ids, positions) from doc lengths
+    (rest = padding)."""
+    seg = np.full(s, -1, np.int32)
+    pos = np.zeros(s, np.int32)
+    o = 0
+    for i, ln in enumerate(lens):
+        seg[o:o + ln] = i
+        pos[o:o + ln] = np.arange(ln)
+        o += ln
+    return seg, pos
+
+
+def make_batch(rows, s):
+    segs, poss = zip(*(make_row(r, s) for r in rows))
+    return jnp.asarray(np.stack(segs)), jnp.asarray(np.stack(poss))
+
+
+# ---------------------------------------------------------------------------
+# kernel numerics (interpret mode vs the jnp reference)
+# ---------------------------------------------------------------------------
+
+class TestSegmentKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, causal):
+        B, S, H, KV, D = 2, 128, 4, 2, 32
+        q, k, v = rand((B, S, H, D)), rand((B, S, KV, D)), rand((B, S, KV, D))
+        seg, pos = make_batch([[50, 40, 30], [128]], S)
+        ref = FA.segment_attention_ref(q, k, v, seg, seg, pos, pos,
+                                       causal=causal)
+        out = FA.flash_attention_segments(q, k, v, seg, seg, pos, pos,
+                                          causal=causal, interpret=True,
+                                          block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.slow
+    def test_forward_bf16(self):
+        B, S, H, KV, D = 1, 128, 4, 2, 32
+        q = rand((B, S, H, D), jnp.bfloat16)
+        k = rand((B, S, KV, D), jnp.bfloat16)
+        v = rand((B, S, KV, D), jnp.bfloat16)
+        seg, pos = make_batch([[70, 58]], S)
+        ref = FA.segment_attention_ref(q, k, v, seg, seg, pos, pos,
+                                       causal=True)
+        out = FA.flash_attention_segments(q, k, v, seg, seg, pos, pos,
+                                          causal=True, interpret=True,
+                                          block_q=64, block_k=64)
+        np.testing.assert_allclose(
+            np.asarray(out).astype(np.float32),
+            np.asarray(ref).astype(np.float32), rtol=3e-2, atol=3e-2)
+
+    def _grad_check(self, causal):
+        """Both backward kernels (dq and dkv, GQA group-sum) through the
+        custom_vjp in interpret mode."""
+        B, S, H, KV, D = 2, 64, 4, 2, 16
+        q, k, v = rand((B, S, H, D)), rand((B, S, KV, D)), rand((B, S, KV, D))
+        seg, pos = make_batch([[30, 20, 10], [40, 24]], S)
+
+        def lf(q, k, v):
+            return (FA.flash_attention_segments(
+                q, k, v, seg, seg, pos, pos, causal=causal,
+                interpret=True, block_q=32, block_k=32) ** 2).sum()
+
+        def lr(q, k, v):
+            return (FA.segment_attention_ref(
+                q, k, v, seg, seg, pos, pos, causal=causal) ** 2).sum()
+
+        g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=5e-4)
+
+    def test_grad_matches_reference_causal(self):
+        self._grad_check(True)
+
+    @pytest.mark.slow
+    def test_grad_matches_reference_noncausal(self):
+        self._grad_check(False)
+
+    def test_single_segment_matches_dense_flash(self):
+        """One full-row document == the dense flash kernel (the packed
+        kernel is a strict generalisation)."""
+        B, S, H, D = 2, 128, 2, 32
+        q, k, v = rand((B, S, H, D)), rand((B, S, H, D)), rand((B, S, H, D))
+        seg = jnp.zeros((B, S), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        dense = FA.flash_attention(q, k, v, causal=True, interpret=True)
+        out = FA.flash_attention_segments(q, k, v, seg, seg, pos, pos,
+                                          causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_padding_rows_exactly_zero_with_zero_grad(self):
+        B, S, H, D = 1, 32, 2, 16
+        q, k, v = rand((B, S, H, D)), rand((B, S, H, D)), rand((B, S, H, D))
+        seg, pos = make_batch([[20]], S)     # 12 padding tokens
+
+        out = FA.flash_attention_segments(q, k, v, seg, seg, pos, pos,
+                                          causal=True, interpret=True,
+                                          block_q=16, block_k=16)
+        np.testing.assert_array_equal(np.asarray(out[:, 20:]), 0.0)
+        # gradients w.r.t. padding-position k/v are exactly zero (no
+        # real token attends across a segment boundary)
+        g = jax.grad(lambda k: (FA.flash_attention_segments(
+            q, k, v, seg, seg, pos, pos, causal=True, interpret=True,
+            block_q=16, block_k=16)[:, :20] ** 2).sum())(k)
+        np.testing.assert_array_equal(np.asarray(g[:, 20:]), 0.0)
+
+    def test_block_skipping_preserves_output(self):
+        """Block-aligned documents produce skippable off-diagonal blocks;
+        skipping must not change the numerics."""
+        B, S = 1, 128
+        q, k, v = rand((B, S, 2, 16)), rand((B, S, 2, 16)), rand((B, S, 2, 16))
+        seg, pos = make_batch([[32, 32, 32, 32]], S)
+        skipped, total = FA.count_skipped_blocks(seg, seg, pos, pos,
+                                                 32, 32, True)
+        assert total == 16
+        # block-diagonal layout: only the 4 diagonal blocks can run
+        assert skipped == 12
+        ref = FA.segment_attention_ref(q, k, v, seg, seg, pos, pos,
+                                       causal=True)
+        out = FA.flash_attention_segments(q, k, v, seg, seg, pos, pos,
+                                          causal=True, interpret=True,
+                                          block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_count_skipped_blocks_causal_diagonal(self):
+        """A single full-row document under causal recovers the dense
+        above-the-diagonal skip count."""
+        S, bq = 128, 32
+        seg = jnp.zeros((1, S), jnp.int32)
+        pos = jnp.arange(S)[None, :]
+        skipped, total = FA.count_skipped_blocks(seg, seg, pos, pos,
+                                                 bq, bq, True)
+        n = S // bq
+        assert total == n * n
+        assert skipped == n * (n - 1) // 2     # strictly-above-diagonal
+
+    def test_segments_supported_rules(self):
+        q = rand((2, 128, 4, 32))
+        k = rand((2, 128, 2, 32))
+        assert FA.segments_supported(q, k, block_q=128, block_k=128)
+        # k-side lane rule: a 64-wide k block over Sk=128 is neither
+        # 128-divisible nor equal to Sk -> unsupported
+        assert not FA.segments_supported(q, k, block_q=64, block_k=64)
+        # non-divisible lengths fall back
+        assert not FA.segments_supported(rand((2, 100, 4, 32)),
+                                         rand((2, 100, 2, 32)))
+
+
+# ---------------------------------------------------------------------------
+# varlen functional surface (flash_attn_unpadded et al.)
+# ---------------------------------------------------------------------------
+
+class TestVarlenSurface:
+    def test_cu_seqlens_overflow_guard(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.core import enforce as E
+        q = paddle.to_tensor(np.zeros((8, 1, 8), "float32"))
+        cu_bad = paddle.to_tensor(np.array([0, 5, 12], "int32"))
+        cu_ok = paddle.to_tensor(np.array([0, 5, 8], "int32"))
+        with pytest.raises(E.InvalidArgumentError) as ei:
+            F.flash_attn_unpadded(q, q, q, cu_bad, cu_ok)
+        assert "12" in str(ei.value) and "8" in str(ei.value)
+        with pytest.raises(E.InvalidArgumentError):
+            F.flash_attn_unpadded(q, q, q, cu_ok, cu_bad)
+        # cu[-1] < T stays the documented trailing-padding convention
+        out, _ = F.flash_attn_unpadded(
+            q, q, q, paddle.to_tensor(np.array([0, 5], "int32")),
+            paddle.to_tensor(np.array([0, 5], "int32")))
+        np.testing.assert_allclose(np.asarray(out.numpy())[5:], 0.0)
+
+    def test_gqa_matches_per_sequence_reference(self):
+        """GQA varlen path (grouped einsum, no kv repeat) vs dense
+        per-sequence GQA attention."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(5)
+        lens = [6, 10]
+        T, H, KV, D = sum(lens), 4, 2, 16
+        q = rng.normal(size=(T, H, D)).astype("float32")
+        k = rng.normal(size=(T, KV, D)).astype("float32")
+        v = rng.normal(size=(T, KV, D)).astype("float32")
+        cu = np.cumsum([0] + lens).astype("int32")
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True)
+        out = np.asarray(out.numpy())
+        for i, ln in enumerate(lens):
+            lo, hi = cu[i], cu[i + 1]
+            ref = F.sdpa_reference(jnp.asarray(q[None, lo:hi]),
+                                   jnp.asarray(k[None, lo:hi]),
+                                   jnp.asarray(v[None, lo:hi]), causal=True)
+            np.testing.assert_allclose(out[lo:hi], np.asarray(ref)[0],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_varlen_dispatch_counter(self):
+        """flash_attn_unpadded routes through the segment dispatcher —
+        off-TPU that is the varlen_fallback counter. The dispatcher is
+        (re)installed explicitly: an earlier test's kernels.unregister()
+        teardown may have emptied the seam."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import kernels
+        from paddle_tpu.nn.functional import attention as att
+        q = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(8, 2, 16)).astype("float32"))
+        cu = paddle.to_tensor(np.array([0, 8], "int32"))
+        prev = att._SEGMENT_IMPL
+        att.register_segment_impl(kernels.dispatched_segment_attention)
+        try:
+            kernels.reset_dispatch_stats()
+            F.flash_attn_unpadded(q, q, q, cu, cu, causal=True)
+            stats = kernels.dispatch_stats()
+        finally:
+            att.register_segment_impl(prev)
+        assert stats["varlen"] + stats["varlen_fallback"] == 1
+
+    def test_sdpa_raw_segment_path_defaults_positions(self):
+        """sdpa_raw(segment_ids=...) without positions uses the global
+        arange — identical to segment-local for contiguous packing."""
+        from paddle_tpu.nn.functional.attention import sdpa_raw
+        B, S, H, D = 1, 32, 2, 16
+        q, k, v = rand((B, S, H, D)), rand((B, S, H, D)), rand((B, S, H, D))
+        seg, pos = make_batch([[20, 12]], S)
+        a = sdpa_raw(q, k, v, is_causal=True, segment_ids=seg)
+        b = sdpa_raw(q, k, v, is_causal=True, segment_ids=seg,
+                     positions=pos)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# packing collator
+# ---------------------------------------------------------------------------
+
+class TestPackingCollator:
+    def docs(self, lens, vocab=100, seed=0):
+        rng = np.random.default_rng(seed)
+        return [rng.integers(1, vocab, (ln,)).astype(np.int32)
+                for ln in lens]
+
+    def test_deterministic(self):
+        docs = self.docs([17, 40, 9, 33, 64, 5])
+        a = PK.pack_documents(docs, 64)
+        b = PK.pack_documents(docs, 64)
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+
+    def test_first_fit_layout_and_positions(self):
+        docs = self.docs([40, 30, 20])
+        p = PK.pack_documents(docs, 64)
+        # first-fit: [40, 20] in row 0 (20 fits the 24-slot gap), [30]
+        # in row 1
+        assert p["ids"].shape == (2, 64)
+        seg = p["segment_ids"]
+        assert list(seg[0, :40]) == [0] * 40
+        assert list(seg[0, 40:60]) == [1] * 20
+        assert list(seg[0, 60:]) == [-1] * 4
+        assert list(seg[1, :30]) == [0] * 30
+        # positions restart per document
+        np.testing.assert_array_equal(p["positions"][0, 40:60],
+                                      np.arange(20))
+
+    def test_labels_stop_at_boundaries(self):
+        docs = self.docs([4, 3])
+        p = PK.pack_documents(docs, 8)
+        ids, lab = p["ids"][0], p["labels"][0]
+        # inside-doc next-token targets
+        np.testing.assert_array_equal(lab[:3], ids[1:4])
+        assert lab[3] == PK.IGNORE_INDEX         # last token of doc 0
+        np.testing.assert_array_equal(lab[4:6], ids[5:7])
+        assert lab[6] == PK.IGNORE_INDEX         # last token of doc 1
+        assert lab[7] == PK.IGNORE_INDEX         # padding
+
+    def test_long_docs_split_into_chunks(self):
+        docs = self.docs([150])
+        p = PK.pack_documents(docs, 64)
+        assert p["ids"].shape[0] == 3            # 64 + 64 + 22
+        assert PK.packing_efficiency(p) == pytest.approx(150 / 192)
+        # each chunk restarts positions (its own segment)
+        assert p["positions"][1, 0] == 0
+
+    def test_efficiency_beats_padding(self):
+        lens = PK.heavy_tailed_lengths(128, 32, seed=1)
+        p = PK.pack_documents(self.docs(lens), 128)
+        rows = p["ids"].shape[0]
+        assert rows < len(lens)                  # packed tighter than 1/doc
+        assert PK.packing_efficiency(p) > sum(lens) / (len(lens) * 128)
+
+    def test_max_rows_overflow_raises(self):
+        from paddle_tpu.core import enforce as E
+        with pytest.raises(E.ResourceExhaustedError):
+            PK.pack_documents(self.docs([60, 60, 60]), 64, max_rows=2)
+
+    def test_collator_and_monitor_gauge(self):
+        from paddle_tpu import monitor
+        from paddle_tpu.core import flags as _flags
+        coll = PK.PackingCollator(64)
+        _flags.set_flags({"enable_monitor": True})
+        try:
+            monitor.reset()
+            out = coll(self.docs([30, 30, 30]))
+            snap = monitor.snapshot()
+            assert snap["gauges"]["packing.efficiency"] == pytest.approx(
+                PK.packing_efficiency(out), abs=1e-3)
+            assert snap["counters"]["packing.documents"] == 3
+        finally:
+            _flags.set_flags({"enable_monitor": False})
+            monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end packed-vs-unpacked training parity
+# ---------------------------------------------------------------------------
+
+def _unpacked_batch(docs, maxl):
+    ids = np.zeros((len(docs), maxl), np.int32)
+    lab = np.full((len(docs), maxl), -100, np.int32)
+    for i, d in enumerate(docs):
+        ids[i, :len(d)] = d
+        lab[i, :len(d) - 1] = d[1:]
+    return jnp.asarray(ids), jnp.asarray(lab)
+
+
+def _doc_trace(vocab, lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (ln,)).astype(np.int32) for ln in lens]
+
+
+class TestPackedTrainingParity:
+    """Packed rows must emit IDENTICAL loss/grads to the equivalent
+    unpacked (one-doc-per-row, ignore_index-padded) batch: same token
+    contexts, same valid-token mean. MoE parity runs with the router
+    aux loss off — the aux term is a batch statistic over ALL processed
+    tokens, and the padded batch legitimately processes more of them."""
+
+    def test_llama_loss_fp32(self):
+        cfg = L.llama_tiny(vocab_size=64)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        docs = _doc_trace(64, [40, 24])
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 64))
+        ub = _unpacked_batch(docs, 40)
+        lp = L.loss_fn(params, pb, cfg)
+        lu = L.loss_fn(params, ub, cfg)
+        np.testing.assert_allclose(float(lp), float(lu), rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_llama_grads_fp32(self):
+        cfg = L.llama_tiny(vocab_size=64)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        docs = _doc_trace(64, [40, 24])
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 64))
+        ub = _unpacked_batch(docs, 40)
+        gp = jax.grad(lambda p: L.loss_fn(p, pb, cfg))(params)
+        gu = jax.grad(lambda p: L.loss_fn(p, ub, cfg))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), gp, gu)
+
+    def test_moe_loss_fp32(self):
+        # grads parity for the MoE family runs in the slow lane
+        # (test_moe_parity_larger_trace_with_grads_bf16)
+        cfg = M.moe_tiny(vocab_size=64, router_aux_loss_coef=0.0)
+        params = M.init_params(cfg, jax.random.PRNGKey(1))
+        docs = _doc_trace(64, [40, 24], seed=2)
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 64))
+        ub = _unpacked_batch(docs, 40)
+        lp = M.loss_fn(params, pb, cfg)
+        lu = M.loss_fn(params, ub, cfg)
+        np.testing.assert_allclose(float(lp), float(lu), rtol=1e-5)
+
+    def test_llama_packed_train_step_jits(self):
+        cfg = L.llama_tiny(vocab_size=64)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        opt = L.adamw_init(params)
+        step = L.make_train_step(cfg, lr=1e-3)
+        pb = PK.packed_train_batch(
+            PK.pack_documents(_doc_trace(64, [40, 24, 30]), 64))
+        p2, o2, loss = step(params, opt, pb)
+        assert np.isfinite(float(loss))
+        assert int(o2["step"]) == 1
+
+    def test_kernel_interpret_mode_matches_fallback(self):
+        """The same packed llama loss through the interpret-mode segment
+        KERNEL vs the jnp fallback (the two dispatcher arms)."""
+        from paddle_tpu.nn.functional import attention as att
+        cfg = L.llama_tiny(vocab_size=64)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        pb = PK.packed_train_batch(
+            PK.pack_documents(_doc_trace(64, [40, 24]), 64))
+        prev = att._SEGMENT_IMPL
+        try:
+            att.register_segment_impl(None)      # jnp reference
+            l_ref = float(L.loss_fn(params, pb, cfg))
+            att.register_segment_impl(
+                lambda *a, **kw: FA.flash_attention_segments(
+                    *a, **kw, interpret=True))
+            l_kern = float(L.loss_fn(params, pb, cfg))
+        finally:
+            att.register_segment_impl(prev)
+        np.testing.assert_allclose(l_kern, l_ref, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_moe_grads_fp32(self):
+        cfg = M.moe_tiny(vocab_size=64, router_aux_loss_coef=0.0)
+        params = M.init_params(cfg, jax.random.PRNGKey(1))
+        docs = _doc_trace(64, [40, 24], seed=2)
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 64))
+        ub = _unpacked_batch(docs, 40)
+        gp = jax.grad(lambda p: M.loss_fn(p, pb, cfg))(params)
+        gu = jax.grad(lambda p: M.loss_fn(p, ub, cfg))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), gp, gu)
+
+    @pytest.mark.slow
+    def test_llama_parity_bf16(self):
+        cfg = L.llama_tiny(vocab_size=64, dtype=jnp.bfloat16)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        docs = _doc_trace(64, [80, 48])
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 128))
+        ub = _unpacked_batch(docs, 80)
+        np.testing.assert_allclose(float(L.loss_fn(params, pb, cfg)),
+                                   float(L.loss_fn(params, ub, cfg)),
+                                   rtol=2e-2)
+
+    @pytest.mark.slow
+    def test_moe_parity_larger_trace_with_grads_bf16(self):
+        cfg = M.moe_tiny(vocab_size=64, dtype=jnp.bfloat16,
+                         router_aux_loss_coef=0.0)
+        params = M.init_params(cfg, jax.random.PRNGKey(1))
+        docs = _doc_trace(64, [60, 36, 20, 12], seed=3)
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 128))
+        ub = _unpacked_batch(docs, 60)
+        gp = jax.grad(lambda p: M.loss_fn(p, pb, cfg))(params)
+        gu = jax.grad(lambda p: M.loss_fn(p, ub, cfg))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-2, atol=5e-2), gp, gu)
+
+    @pytest.mark.slow
+    def test_llama_grad_parity_through_interpret_kernel(self):
+        """Full packed training grads with the interpret-mode segment
+        kernel engaged (custom_vjp through the model) vs the fallback."""
+        from paddle_tpu.nn.functional import attention as att
+        cfg = L.llama_tiny(vocab_size=64)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        pb = PK.packed_train_batch(
+            PK.pack_documents(_doc_trace(64, [40, 24]), 64))
+        prev = att._SEGMENT_IMPL
+        try:
+            att.register_segment_impl(None)
+            g_ref = jax.grad(lambda p: L.loss_fn(p, pb, cfg))(params)
+            att.register_segment_impl(
+                lambda *a, **kw: FA.flash_attention_segments(
+                    *a, **kw, interpret=True))
+            g_kern = jax.grad(lambda p: L.loss_fn(p, pb, cfg))(params)
+        finally:
+            att.register_segment_impl(prev)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_kern, g_ref)
+
+
+# ---------------------------------------------------------------------------
+# autotune: the varlen block knob
+# ---------------------------------------------------------------------------
+
+class TestVarlenAutotune:
+    def _call(self, cache, measure):
+        return AT.varlen_blocks((2, 256, 4, 32), (2, 256, 2, 32),
+                                jnp.float32, True,
+                                measure=measure, cache=cache)
+
+    def test_measures_once_then_cached(self, tmp_path):
+        cache = AT.AutotuneCache(str(tmp_path / "c.json"))
+        calls = []
+
+        def measure(bq, bk):
+            calls.append((bq, bk))
+            return 0.001 if (bq, bk) == (256, 128) else 0.01
+
+        assert self._call(cache, measure) == (256, 128)
+        n = len(calls)
+        assert n >= 2
+        assert self._call(cache, measure) == (256, 128)
+        assert len(calls) == n                  # cache hit, no re-measure
+
+    def test_key_space_disjoint_from_dense_flash(self, tmp_path):
+        cache = AT.AutotuneCache(str(tmp_path / "c.json"))
+        self._call(cache, lambda bq, bk: 0.001)
+        keys = list(cache._mem)
+        assert keys and all(k.startswith("varlen:") for k in keys)
+
+    def test_candidates_respect_segment_lane_rule(self):
+        # sk = 256: a 64-wide k block is illegal for the segment arrays
+        for bq, bk in AT.varlen_candidates(2, 8, 256, 256, 32,
+                                           jnp.float32):
+            assert bk % 128 == 0 or bk == 256
