@@ -167,16 +167,10 @@ def _relay_alive(timeout=2.0):
 
 
 def _peak_flops(dev) -> float:
-    """bf16 peak FLOP/s per chip by TPU generation (device_kind, or the
-    axon tunnel's PALLAS_AXON_TPU_GEN env)."""
-    table = {"v6e": 918e12, "v5p": 459e12, "v5e": 197e12,
-             "v4": 275e12, "v3": 123e12}
-    kind = (dev.device_kind or "").lower().replace(" ", "")
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for k, v in table.items():
-        if k in kind or k in gen:
-            return v
-    return 459e12   # assume v5p (BASELINE.json north-star hardware)
+    """bf16 peak FLOP/s per chip (monitor/mfu.py owns the table now;
+    PADDLE_TPU_PEAK_FLOPS overrides — the CPU-smoke denominator)."""
+    from paddle_tpu.monitor import mfu as _mfu
+    return _mfu.peak_flops(dev)
 
 
 def _probe_backend(retries=2, delay=5.0):
@@ -276,6 +270,14 @@ def _metrics_summary():
                 "preempted": c.get("serving.requests.preempted", 0),
                 "tokens_generated": c.get("serving.tokens.generated", 0),
                 "tokens_prefilled": c.get("serving.tokens.prefilled", 0),
+                "tokens_discarded": c.get("serving.tokens.discarded", 0),
+                # SLO distributions (count/min/max/avg + interpolated
+                # p50/p90/p95/p99) fed by the serving_paged rung
+                "latency": {
+                    name: h.get(f"serving.latency.{name}")
+                    for name in ("queue_wait_ms", "ttft_ms",
+                                 "tpot_ms", "e2e_ms")
+                },
             },
             # sequence-packed training (io/packing.py + the segment
             # flash kernel): pack efficiency, block skipping, and the
@@ -490,19 +492,31 @@ def _main():
             _stage("timed-loop", 240)
             # two independent timed windows: the r3 stability ask —
             # a single sample can't show run-to-run variance, two
-            # back-to-back windows bound it in one bench invocation
+            # back-to-back windows bound it in one bench invocation.
+            # Each window is one StepTimer compute phase (closed AFTER
+            # the drain so async dispatch isn't mistaken for compute),
+            # so the goodput block in extra.metrics reports the same
+            # tokens/s the headline does, through the production seam.
+            from paddle_tpu import monitor as _pt_monitor
+            stim = _pt_monitor.StepTimer("bench.headline")
             t0 = time.perf_counter()
-            for _ in range(iters):
-                params, opt_state, loss = step(params, opt_state, ids)
-            float(loss)               # drain before closing window 1
+            with stim.compute():
+                for _ in range(iters):
+                    params, opt_state, loss = step(params, opt_state, ids)
+                float(loss)           # drain before closing window 1
+            stim.end_step(useful_tokens=batch * seq * iters)
             t1 = time.perf_counter()
-            for _ in range(iters):
-                params, opt_state, loss = step(params, opt_state, ids)
-            final_loss = float(loss)  # device->host fetch = pipeline drain
+            with stim.compute():
+                for _ in range(iters):
+                    params, opt_state, loss = step(params, opt_state, ids)
+                # device->host fetch = pipeline drain
+                final_loss = float(loss)
+            stim.end_step(useful_tokens=batch * seq * iters)
             t2 = time.perf_counter()
             window_dts = [t1 - t0, t2 - t1]
             iters *= 2
             dt = t2 - t0
+            goodput_report = stim.report()
             break
         except Exception as e:
             last_err = f"{type(e).__name__}: {e}"
@@ -521,8 +535,24 @@ def _main():
     # 6ND (fwd+bwd) -> standard MFU (remat recompute not credited)
     n_params = L.count_params(cfg)
     flops_per_token = 6 * n_params
-    peak = _peak_flops(dev) if on_tpu else 1e12   # CPU nominal
+    peak = _peak_flops(dev)   # CPU: 1e12 nominal or PADDLE_TPU_PEAK_FLOPS
     mfu = tps * flops_per_token / peak
+    # MEASURED MFU: XLA's own cost analysis of the compiled train step
+    # (re-trace + HLO lowering, no second compile) — credits remat
+    # recompute, attention and loss flops the 6ND estimate misses.
+    from paddle_tpu.monitor import mfu as _mfu_mod
+    program_flops = _mfu_mod.lowered_flops(step, params, opt_state, ids)
+    _mfu_mod.record_program_flops(program_flops, source="bench")
+    mfu_block = {
+        "program_flops_per_step": program_flops,
+        "steps_per_sec": round(iters / dt, 4),
+        "achieved_flops_per_sec": round(program_flops * iters / dt, 2),
+        "peak_flops_per_sec": peak,
+        "mfu": round(_mfu_mod.mfu(program_flops, iters / dt, peak=peak),
+                     6),
+        "mfu_6nd": round(mfu, 6),
+        "source": "xla_cost_analysis",
+    }
     payload = {
         "metric": _METRIC,
         "value": round(tps, 2),
@@ -610,6 +640,8 @@ def _main():
     # misses the MoE and decode stages' block/chunk decisions.
     payload["extra"]["autotune"] = _autotune_summary()
     payload["extra"]["metrics"] = _metrics_summary()
+    payload["extra"]["metrics"]["mfu"] = mfu_block
+    payload["extra"]["metrics"]["goodput"] = goodput_report
     payload["extra"]["elapsed_s"] = round(time.monotonic() - _T0, 1)
     _emit(payload)
 
@@ -772,6 +804,14 @@ def _serving_paged_rung(on_tpu):
                         decode_chunk=chunk)
     from paddle_tpu.inference.engine import EngineStats
     eng.run(reqs(0))            # warmup: compiles every prefill bucket
+    # drop warmup observations: a TTFT that includes an XLA compile is
+    # a cold-start story, not the steady-state SLO the rung reports
+    from paddle_tpu import monitor as _mon
+    _latency_names = ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms")
+    for _nm in _latency_names:
+        _m = _mon.registry().get(f"serving.latency.{_nm}")
+        if _m is not None:
+            _m.reset()
 
     # uniform-batch baseline: waves of ``slots`` requests, every wave
     # padded to the global max prompt/gen (the static-shape serving
@@ -801,9 +841,19 @@ def _serving_paged_rung(on_tpu):
 
     s = eng.stats
     pool = eng.cache.num_pages
+    latency = {}
+    for _nm in _latency_names:
+        _m = _mon.registry().get(f"serving.latency.{_nm}")
+        if _m is not None and _m.count:
+            latency[_nm] = {
+                "count": _m.count,
+                **{k: round(v, 3) for k, v in
+                   _m.quantiles((0.5, 0.95, 0.99)).items()},
+            }
     return {
         "config": f"llama_3_8b[{cfg.num_hidden_layers}L]" if on_tpu
         else "llama_tiny[2L]",
+        "latency_ms": latency,
         "requests": n_req, "num_slots": slots,
         "page_size": eng.page_size,
         "trace_prompt_lens": sorted(set(p for p, _ in trace)),

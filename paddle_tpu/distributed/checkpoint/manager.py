@@ -152,20 +152,27 @@ class CheckpointManager:
             if callable(state_dict):
                 state_dict = state_dict()
             # one save in flight: finalize the previous before staging
-            # the next
-            self._finalize_pending_locked()
-            sync = not self.async_save if blocking is None else blocking
-            if sync:
-                from . import save_state_dict
-                save_state_dict(state_dict, self._step_path(step),
-                                coordinator_rank=self.coordinator_rank)
-                self._after_commit_locked(step)
-            else:
-                from . import async_save_state_dict
-                handle = async_save_state_dict(
-                    state_dict, self._step_path(step),
-                    coordinator_rank=self.coordinator_rank)
-                self._pending = (step, handle)
+            # the next. The training thread's time in here — staging,
+            # sync writes, finalizing the previous async save — bills
+            # to the active StepTimer's checkpoint phase (and the
+            # train.step.checkpoint_ms histogram) so step-timeline
+            # accounting sees checkpoint stalls without the loop
+            # threading its timer into this manager.
+            with _monitor.ambient_phase("checkpoint"):
+                self._finalize_pending_locked()
+                sync = not self.async_save if blocking is None \
+                    else blocking
+                if sync:
+                    from . import save_state_dict
+                    save_state_dict(state_dict, self._step_path(step),
+                                    coordinator_rank=self.coordinator_rank)
+                    self._after_commit_locked(step)
+                else:
+                    from . import async_save_state_dict
+                    handle = async_save_state_dict(
+                        state_dict, self._step_path(step),
+                        coordinator_rank=self.coordinator_rank)
+                    self._pending = (step, handle)
             return True
 
     def wait(self):
@@ -388,6 +395,26 @@ class CheckpointManager:
         is newer than anything committed — take an emergency sync save
         of it."""
         import sys
+
+        # Preemption black box FIRST: the flight record must capture
+        # what the process was doing when SIGTERM landed, before the
+        # finalize/emergency-save below rewrites the metrics story (and
+        # before anything here can block into the kill escalation).
+        # The dump runs on a helper thread with a bounded join: this
+        # handler executes ON the interrupted thread, which may hold
+        # the trace-ring or registry locks — dumping inline would
+        # deadlock the whole grace window. Off-thread, a held lock
+        # merely delays the dump until the handler returns and the
+        # interrupted frame releases it.
+        try:
+            from ...monitor import trace as _trace
+            t = threading.Thread(
+                target=_trace.record_fault,
+                args=("preemption.sigterm", "preempt"), daemon=True)
+            t.start()
+            t.join(timeout=2.0)
+        except Exception:
+            pass
         with self._mu:
             if self._pending is not None:
                 step, handle = self._pending

@@ -136,31 +136,44 @@ class Model:
         self.stop_training = False
         cbks.on_begin("train")
         it = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                inputs, labels = self._split_batch(batch)
-                cbks.on_batch_begin("train", step, logs)
-                k = max(int(accumulate_grad_batches), 1)
-                losses = self.train_batch(
-                    inputs, labels, update=(step + 1) % k == 0,
-                    loss_scale=1.0 / k)
-                metric_res = self._update_metrics(self._last_outs, labels) \
-                    if self._metrics else {}
-                logs = {"loss": losses, **metric_res}
-                cbks.on_batch_end("train", step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    self.stop_training = True
+        # Step-timeline accounting (monitor/steptimer.py): data-wait vs
+        # compute vs checkpoint split + goodput. Off-flag, every seam is
+        # one cached-flag branch and registers nothing. The `with stim:`
+        # scope keeps this timer the thread's ambient target for the
+        # whole loop — so checkpoint time spent inside callbacks
+        # (FaultTolerantCheckpoint -> CheckpointManager.save), which run
+        # BETWEEN the timed phases, bills itself here through the
+        # ambient-phase seam — and releases it when fit returns.
+        from .. import monitor as _monitor
+        stim = _monitor.StepTimer("hapi.fit")
+        with stim:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(stim.iter_data(loader)):
+                    inputs, labels = self._split_batch(batch)
+                    cbks.on_batch_begin("train", step, logs)
+                    k = max(int(accumulate_grad_batches), 1)
+                    with stim.compute():
+                        losses = self.train_batch(
+                            inputs, labels, update=(step + 1) % k == 0,
+                            loss_scale=1.0 / k)
+                    metric_res = self._update_metrics(
+                        self._last_outs, labels) if self._metrics else {}
+                    logs = {"loss": losses, **metric_res}
+                    cbks.on_batch_end("train", step, logs)
+                    stim.end_step()
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        self.stop_training = True
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self._run_eval(eval_loader, cbks)
+                if self.stop_training:
                     break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self._run_eval(eval_loader, cbks)
-            if self.stop_training:
-                break
         cbks.on_end("train", logs)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

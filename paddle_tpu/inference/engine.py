@@ -26,12 +26,35 @@ freeing pages the moment a sequence finishes — rebuilt TPU-native:
 Instrumentation (paddle_tpu.monitor, FLAGS_enable_monitor-gated):
 ``serving.pages.in_use|total``, ``serving.batch.occupancy``,
 ``serving.queue.depth`` gauges; ``serving.requests.admitted|completed|
-preempted``, ``serving.tokens.generated|prefilled`` counters. The same
-numbers are always available unconditionally on ``engine.stats``.
+preempted``, ``serving.tokens.generated|prefilled|discarded`` counters.
+The same numbers are always available unconditionally on
+``engine.stats``.
+
+SLO latency (monitor-gated, one cached-flag branch when off): each
+request's lifecycle is stamped enqueue -> admit -> prefill -> first
+token -> retire, feeding the ``serving.latency.*`` histograms —
+``queue_wait_ms`` (latest enqueue to admission; a preempted request
+re-queues and waits again), ``ttft_ms`` (ORIGINAL enqueue to the
+prefill-sampled first token of the run the client KEEPS — observed
+once per request at retirement, so a preempted run's discarded first
+token never biases the histogram),
+``tpot_ms`` (mean inter-token time over the decode phase, chunk-edge
+resolution), ``e2e_ms`` (original enqueue to retire). All carry
+bucket-interpolated p50/p90/p95/p99 in their snapshots. The same
+milestones land in the ``monitor.trace`` ring as lifecycle events, so
+a flight record shows which requests were in flight at a crash.
+
+Token accounting contract (pinned by tests/test_trace.py):
+``serving.tokens.generated`` counts every SAMPLED token (prefill's
+first token + decode emissions — work done, including work later
+thrown away); ``serving.tokens.discarded`` counts tokens a preemption
+discarded for recompute. On a drained engine
+``generated - discarded == sum(len(output.tokens))`` exactly.
 """
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 from typing import Dict, List, Optional
@@ -42,7 +65,12 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..core import enforce as E
+from ..monitor import trace as _trace
+from ..monitor.registry import LATENCY_BUCKETS_MS as _LATENCY_BUCKETS_MS
 from .paged import PagedKVCache, paged_decode_step, paged_prefill
+
+def _observe_latency(name: str, ms: float, doc: str):
+    _monitor.observe(name, ms, doc=doc, buckets=_LATENCY_BUCKETS_MS)
 
 __all__ = ["Request", "RequestOutput", "ServingEngine"]
 
@@ -67,7 +95,7 @@ class RequestOutput:
 
 class _Slot:
     __slots__ = ("req", "kv_len", "gen", "tokens", "pending", "done",
-                 "keys", "preemptions")
+                 "keys", "preemptions", "t_first", "t_last")
 
     def __init__(self, req: Request, keys: np.ndarray):
         self.req = req
@@ -78,6 +106,8 @@ class _Slot:
         self.done = False
         self.keys = keys         # [max_new, 2] uint32 sampling keys
         self.preemptions = 0
+        self.t_first = None      # first-token wall stamp (monitor on)
+        self.t_last = None       # latest-token wall stamp (monitor on)
 
 
 class EngineStats:
@@ -89,6 +119,7 @@ class EngineStats:
         self.tokens_generated = 0    # incl. the token sampled at prefill
         self.tokens_decoded = 0      # emitted by decode steps only
         self.tokens_prefilled = 0
+        self.tokens_discarded = 0    # thrown away by preemption recompute
         self.peak_pages_in_use = 0
         self._occ_steps = 0      # decode steps weighted by slot count
 
@@ -106,6 +137,7 @@ class EngineStats:
                 "decode_steps": self.decode_steps,
                 "tokens_generated": self.tokens_generated,
                 "tokens_prefilled": self.tokens_prefilled,
+                "tokens_discarded": self.tokens_discarded,
                 "peak_pages_in_use": self.peak_pages_in_use,
                 "batch_occupancy": round(self.occupancy(), 4)}
 
@@ -232,6 +264,14 @@ class ServingEngine:
         E.enforce(plen + req.max_new_tokens <= self.max_len,
                   f"request {req.rid}: prompt {plen} + max_new "
                   f"{req.max_new_tokens} exceeds max_len {self.max_len}")
+        if _monitor.enabled():
+            now = time.perf_counter()
+            # t0 anchors TTFT/e2e (first submission wins); t_enqueue is
+            # refreshed by preemption re-queues and anchors queue_wait
+            req._t0 = getattr(req, "_t0", None) or now
+            req._t_enqueue = now
+            _trace.instant("serving.enqueue", rid=req.rid, prompt=plen,
+                           max_new=req.max_new_tokens)
         self.queue.append(req)
 
     # -- scheduling ---------------------------------------------------------
@@ -296,6 +336,35 @@ class ServingEngine:
             preemptions=slot.preemptions)
         self.stats.completed += 1
         _monitor.inc("serving.requests.completed")
+        if _monitor.enabled():
+            now = time.perf_counter()
+            t0 = getattr(slot.req, "_t0", None)
+            if t0 is not None:
+                _observe_latency(
+                    "serving.latency.e2e_ms", (now - t0) * 1e3,
+                    "request lifetime: original enqueue to retirement")
+                if slot.t_first is not None:
+                    # observed at retirement, not at prefill: a
+                    # preempted request re-prefills, and only the
+                    # surviving run's first token — the one the client
+                    # keeps — counts. One sample per completed request.
+                    _observe_latency(
+                        "serving.latency.ttft_ms",
+                        (slot.t_first - t0) * 1e3,
+                        "original enqueue to the prefill-sampled "
+                        "first token the client keeps")
+            if slot.gen > 1 and slot.t_first is not None \
+                    and slot.t_last is not None:
+                # mean inter-token time over the decode phase; t_last
+                # is the arrival of the final emitted token (chunk-edge
+                # resolution), t_first the prefill-sampled token
+                _observe_latency(
+                    "serving.latency.tpot_ms",
+                    (slot.t_last - slot.t_first) / (slot.gen - 1) * 1e3,
+                    "mean time per output token after the first")
+            _trace.instant("serving.retire", rid=slot.req.rid,
+                           tokens=slot.gen,
+                           preemptions=slot.preemptions)
 
     def _preempt_youngest(self) -> bool:
         """Evict the most recently admitted live request (recompute
@@ -311,7 +380,18 @@ class ServingEngine:
                     slot.req, "_preempt_count", 0) + 1
                 self.queue.appendleft(slot.req)
                 self.stats.preempted += 1
+                # the evicted request's sampled-but-unretired tokens are
+                # recomputed from scratch: move them to the discarded
+                # column so generated - discarded stays == emitted
+                self.stats.tokens_discarded += slot.gen
                 _monitor.inc("serving.requests.preempted")
+                _monitor.inc("serving.tokens.discarded", slot.gen,
+                             doc="sampled tokens thrown away by "
+                                 "preemption recompute")
+                if _monitor.enabled():
+                    slot.req._t_enqueue = time.perf_counter()
+                    _trace.instant("serving.preempt", rid=slot.req.rid,
+                                   discarded=slot.gen)
                 return True
         return False
 
@@ -367,6 +447,17 @@ class ServingEngine:
         bucket); dummy rows carry all-sentinel page tables and never
         touch the pool."""
         need = s_pad // self.page_size
+        mon = _monitor.enabled()
+        if mon:
+            t_admit = time.perf_counter()
+            for r in group:
+                t_enq = getattr(r, "_t_enqueue", None)
+                if t_enq is not None:
+                    _observe_latency(
+                        "serving.latency.queue_wait_ms",
+                        (t_admit - t_enq) * 1e3,
+                        "enqueue (or preemption re-queue) to admission")
+                _trace.instant("serving.admit", rid=r.rid)
         g = 1
         while g < len(group):
             g *= 2
@@ -388,19 +479,36 @@ class ServingEngine:
             keys[j] = slot.keys[0]
             slots.append(slot)
         sampled = any(r.temperature > 0 for r in group)
-        pk, pv, tok_a = self._prefill_fn(g, s_pad, sampled)(
-            self.params, jnp.asarray(ids), self.cache.pool["k"],
-            self.cache.pool["v"], page_rows=jnp.asarray(rows),
-            slen=jnp.asarray(slen), temp=jnp.asarray(temps),
-            key=jnp.asarray(keys))
-        self.cache.pool = {"k": pk, "v": pv}
-        toks = np.asarray(tok_a)
+        with _trace.span("serving.prefill", group=len(group),
+                         s_pad=s_pad):
+            pk, pv, tok_a = self._prefill_fn(g, s_pad, sampled)(
+                self.params, jnp.asarray(ids), self.cache.pool["k"],
+                self.cache.pool["v"], page_rows=jnp.asarray(rows),
+                slen=jnp.asarray(slen), temp=jnp.asarray(temps),
+                key=jnp.asarray(keys))
+            self.cache.pool = {"k": pk, "v": pv}
+            # the np.asarray download syncs the device — the span ends
+            # (and TTFT is stamped) when the first token actually EXISTS
+            # on the host, not when the dispatch returned
+            toks = np.asarray(tok_a)
+        t_first = None
+        if mon:
+            # TTFT is NOT observed here: a preemption would discard
+            # this run's tokens and re-prefill, double-sampling the
+            # histogram with a first token the client never saw. The
+            # slot carries t_first to _retire, which observes once per
+            # completed request. The lifecycle instant still marks
+            # every prefill (preempted runs included) in the trace.
+            t_first = time.perf_counter()
+            for r in group:
+                _trace.instant("serving.first_token", rid=r.rid)
         for j, (r, slot) in enumerate(zip(group, slots)):
             self.cache.alloc.advance(r.rid, int(slen[j]))
             tok = int(toks[j])
             slot.tokens.append(tok)
             slot.pending = tok
             slot.gen = 1
+            slot.t_first = slot.t_last = t_first
             slot.done = (tok == r.eos_token_id
                          if r.eos_token_id is not None else False) \
                 or slot.gen >= r.max_new_tokens
@@ -526,17 +634,23 @@ class ServingEngine:
             keys = self._zero_keys[C]  # greedy: keys are never read
 
         d = self._dev
-        pk, pv, tok, kvl, done_a, gen_a, emitted = self._chunk_fns[
-            (C, self._sampled)](
-            self.params, self.cache.pool["k"], self.cache.pool["v"],
-            d["bt"], d["tokens"], d["kv_len"], d["done"], d["gen"],
-            keys, d["temps"], d["max_new"], d["eos"])
-        self.cache.pool = {"k": pk, "v": pv}
-        self._dev.update(tokens=tok, kv_len=kvl, done=done_a, gen=gen_a)
-        # ONE device->host transfer per chunk: every host-side fact is
-        # derivable from the emitted grid (-1 = slot was done at that
-        # step; a write and a sample happen exactly on non -1 steps)
-        emitted = np.asarray(emitted)                    # [C, B]
+        with _trace.span("serving.decode_chunk", chunk=C,
+                         live=len(live_idx)):
+            pk, pv, tok, kvl, done_a, gen_a, emitted = self._chunk_fns[
+                (C, self._sampled)](
+                self.params, self.cache.pool["k"], self.cache.pool["v"],
+                d["bt"], d["tokens"], d["kv_len"], d["done"], d["gen"],
+                keys, d["temps"], d["max_new"], d["eos"])
+            self.cache.pool = {"k": pk, "v": pv}
+            self._dev.update(tokens=tok, kv_len=kvl, done=done_a,
+                             gen=gen_a)
+            # ONE device->host transfer per chunk: every host-side fact
+            # is derivable from the emitted grid (-1 = slot was done at
+            # that step; a write and a sample happen exactly on non -1
+            # steps). The download syncs, so the span's end — and the
+            # t_chunk stamp below — is when the tokens reached the host.
+            emitted = np.asarray(emitted)                # [C, B]
+        t_chunk = time.perf_counter() if _monitor.enabled() else None
         new_tokens = 0
         for i in live_idx:
             s = self.slots[i]
@@ -549,6 +663,7 @@ class ServingEngine:
                 s.kv_len += len(toks)
                 s.gen += len(toks)
                 s.pending = toks[-1]
+                s.t_last = t_chunk if t_chunk is not None else s.t_last
             s.done = s.gen >= s.req.max_new_tokens or (
                 s.req.eos_token_id is not None and bool(toks)
                 and toks[-1] == s.req.eos_token_id)

@@ -534,6 +534,11 @@ class StaticFunction:
             flat_out, new_buffers = prog.jitted(
                 param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
             self._note_compile(t_compile)
+            if t_compile is not None:
+                from ..monitor import mfu as _mfu
+                _mfu.record_program_flops(_mfu.lowered_flops(
+                    prog.jitted, param_arrays, buffer_arrays,
+                    arg_arrays, kwarg_arrays), source="to_static")
         else:
             train_names = [n for n, _ in trainable]
             diff_idx = [i for i, _ in diff_args]
@@ -552,6 +557,32 @@ class StaticFunction:
             (flat_out, new_buffers), vjp_fn = jax.vjp(
                 closed, train_arrays, diff_arg_arrays)
             self._note_compile(t_compile)
+            if t_compile is not None:
+                # MFU accounting must count what a TRAINING call
+                # executes — forward AND backward — so lower the same
+                # vjp composition run above, not just prog.jitted
+                # (forward alone under-counts ~3x). Falls back to the
+                # forward program if the composed lowering can't be
+                # analyzed.
+                from ..monitor import mfu as _mfu
+
+                def _full_step(ta, da):
+                    out, inner_vjp = jax.vjp(closed, ta, da)
+                    cts = jax.tree_util.tree_map(
+                        _mfu.ones_cotangent, out)
+                    # return out too: the real call materializes the
+                    # forward results, so the analyzed program must
+                    # keep them live (grads alone let XLA DCE any
+                    # forward op the backward doesn't reuse)
+                    return out, inner_vjp(cts)
+
+                flops = _mfu.lowered_flops(
+                    jax.jit(_full_step), train_arrays, diff_arg_arrays)
+                if flops <= 0.0:
+                    flops = _mfu.lowered_flops(
+                        prog.jitted, param_arrays, buffer_arrays,
+                        arg_arrays, kwarg_arrays)
+                _mfu.record_program_flops(flops, source="to_static")
 
             input_tensors = [p for _, p in trainable] + \
                 [a for _, a in diff_args]
@@ -583,12 +614,18 @@ class StaticFunction:
     @staticmethod
     def _note_compile(t_compile):
         """Observe trace+compile latency for a cache-miss call (timed
-        through the first execution, where jax.jit actually compiles)."""
-        if t_compile is not None:
-            _monitor.observe(
-                "jit.compile_ms", (time.perf_counter() - t_compile) * 1e3,
-                doc="to_static trace+compile wall time per cache miss",
-                buckets=tuple(float(10 ** i) / 10 for i in range(9)))
+        through the first execution, where jax.jit actually compiles).
+        The caller follows up with the MFU capture — the new program's
+        XLA-cost-analysis FLOPs into ``jit.program.flops`` (one extra
+        re-trace + HLO lowering per compile; no second XLA compile —
+        see monitor/mfu.py) — lowering the grad-path vjp composition
+        where one exists so training programs count fwd+bwd FLOPs."""
+        if t_compile is None:
+            return
+        _monitor.observe(
+            "jit.compile_ms", (time.perf_counter() - t_compile) * 1e3,
+            doc="to_static trace+compile wall time per cache miss",
+            buckets=tuple(float(10 ** i) / 10 for i in range(9)))
 
     @property
     def concrete_programs(self):

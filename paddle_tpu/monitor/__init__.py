@@ -46,6 +46,7 @@ __all__ = [
     "inc", "observe", "set_gauge",
     "snapshot", "expose_text", "dump_json", "reset",
     "record_op", "tensor_bytes", "tensor_free",
+    "trace", "mfu", "StepTimer", "ambient_phase",
 ]
 
 # The one process-global registry (monitor.h StatRegistry::Instance()).
@@ -182,12 +183,15 @@ def dump_json(run_id: Optional[str] = None,
 def reset():
     """Drop all metrics and cached handles (tests; between bench runs).
     Live counted tensors become orphans: their eventual frees are
-    dropped (generation mismatch), never negative gauges."""
+    dropped (generation mismatch), never negative gauges. The trace
+    ring empties with the registry — a flight record dumped after a
+    reset describes the new run, not the old one."""
     _REGISTRY.reset()
     _OP_HANDLES.clear()
     _DISPATCH_HIST.clear()
     _TENSOR_GAUGES.clear()
     _TENSOR_EPOCH[0] += 1
+    trace.clear()
 
 
 class timed:
@@ -215,3 +219,9 @@ class timed:
 
 
 __all__.append("timed")
+
+# Submodules of the observability layer (import AFTER the registry
+# surface above: trace/steptimer/mfu call back into it lazily).
+from . import mfu  # noqa: E402
+from . import trace  # noqa: E402
+from .steptimer import StepTimer, ambient_phase  # noqa: E402

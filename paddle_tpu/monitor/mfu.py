@@ -1,0 +1,152 @@
+"""Model-FLOPs-utilization accounting from XLA cost analysis.
+
+The headline number of GSPMD-style scaling work (PAPERS.md: GSPMD) is
+MFU: the fraction of the chip's peak FLOP/s the model actually
+sustains. Two inputs:
+
+- **Program FLOPs**: XLA's own ``cost_analysis()`` of the compiled
+  program — the MEASURED flop count of one step, not the 6ND
+  estimate (which misses remat recompute, attention, and fused-loss
+  flops; bench.py still reports 6ND-based MFU alongside for
+  comparability with the literature).
+- **Peak FLOP/s**: a per-backend table (bf16 peak per chip by TPU
+  generation), env-overridable with ``PADDLE_TPU_PEAK_FLOPS`` — which
+  is also how the CPU smoke path gets a meaningful denominator.
+
+Capture seams:
+
+- ``jit/api.py`` calls :func:`record_program_flops` on every program-
+  cache miss (monitor-gated), accumulating ``jit.program.flops`` so a
+  snapshot shows the total analyzed FLOPs footprint of the process's
+  compiled programs and ``jit.program.last_flops`` the newest one.
+- ``bench.py`` uses :func:`lowered_flops` on its own jitted train step
+  and reports ``extra.metrics.mfu``.
+
+``lowered_flops`` costs one re-trace + lowering (NO XLA compile:
+``jax.stages.Lowered.cost_analysis`` runs the HLO-level analyzer), so
+the capture is pennies next to the compile it rides behind.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["peak_flops", "lowered_flops", "cost_analysis_flops",
+           "record_program_flops", "mfu", "ones_cotangent"]
+
+# bf16 peak FLOP/s per chip by TPU generation (same table bench.py has
+# always used; v5p is the BASELINE.json north-star part).
+PEAK_FLOPS_TABLE = {
+    "v6e": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+}
+
+# Nominal denominator for CPU runs with no override: keeps MFU finite
+# and comparable across smoke runs without claiming to measure the host.
+_CPU_NOMINAL = 1e12
+
+
+def peak_flops(device=None) -> float:
+    """Peak FLOP/s for ``device`` (default: first jax device).
+    Resolution order: ``PADDLE_TPU_PEAK_FLOPS`` env override (any
+    float, the CPU-smoke escape hatch) -> TPU-generation table matched
+    against ``device_kind`` or the axon tunnel's
+    ``PALLAS_AXON_TPU_GEN`` -> v5p for unknown TPUs -> a 1e12 nominal
+    for CPU hosts."""
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0]
+        except Exception:
+            return _CPU_NOMINAL
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    kind = kind.replace(" ", "")
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for k, v in PEAK_FLOPS_TABLE.items():
+        if k in kind or k in gen:
+            return v
+    platform = getattr(device, "platform", "")
+    if platform in ("tpu", "axon") or "tpu" in kind:
+        return PEAK_FLOPS_TABLE["v5p"]
+    return _CPU_NOMINAL
+
+
+def cost_analysis_flops(cost) -> float:
+    """Pull a flop count out of a jax cost-analysis result, which is a
+    dict on current jax and a list of per-computation dicts on some
+    versions. 0.0 when the analysis has no flops entry."""
+    if cost is None:
+        return 0.0
+    if isinstance(cost, (list, tuple)):
+        return float(sum(cost_analysis_flops(c) for c in cost))
+    try:
+        v = cost.get("flops", 0.0)
+    except AttributeError:
+        return 0.0
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return 0.0
+    # XLA reports -1 for "unknown" on some backends
+    return f if f > 0 else 0.0
+
+
+def lowered_flops(jitted_fn, *args, **kwargs) -> float:
+    """FLOPs of one invocation of ``jitted_fn(*args, **kwargs)`` per
+    XLA's HLO cost analysis. Re-traces and lowers (cheap) but does NOT
+    compile. 0.0 when the backend/analysis can't say."""
+    try:
+        lowered = jitted_fn.lower(*args, **kwargs)
+        return cost_analysis_flops(lowered.cost_analysis())
+    except Exception:
+        return 0.0
+
+
+def ones_cotangent(x):
+    """Cotangent seed for a full fwd+bwd FLOPs lowering (jit/api.py
+    lowers forward-plus-vjp so training programs record the FLOPs they
+    actually execute): ones for inexact outputs, float0 zeros for
+    integer/bool outputs — the only cotangent dtype jax.vjp accepts
+    for non-differentiable leaves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+        return jnp.ones_like(x)
+    return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+
+
+def record_program_flops(flops: float, source: str = "jit"):
+    """Accumulate an analyzed program's FLOPs into the registry
+    (``jit.program.flops`` counter + ``jit.program.last_flops`` gauge).
+    Callers gate on ``monitor.enabled()``."""
+    if flops <= 0:
+        return
+    from . import inc as _inc
+    from . import set_gauge as _set_gauge
+    _inc("jit.program.flops", int(flops),
+         doc="total XLA-cost-analysis FLOPs of compiled programs "
+             "(one invocation each), accumulated per cache miss")
+    _set_gauge("jit.program.last_flops", int(flops),
+               doc="XLA-cost-analysis FLOPs of the most recently "
+                   "compiled program")
+
+
+def mfu(flops_per_step: float, steps_per_sec: float,
+        device=None, peak: Optional[float] = None) -> float:
+    """Model FLOPs utilization: achieved FLOP/s over peak FLOP/s."""
+    p = peak if peak is not None else peak_flops(device)
+    if p <= 0 or flops_per_step <= 0 or steps_per_sec <= 0:
+        return 0.0
+    return flops_per_step * steps_per_sec / p

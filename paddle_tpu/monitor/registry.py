@@ -22,7 +22,17 @@ import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "StatRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "StatRegistry",
+           "LATENCY_BUCKETS_MS"]
+
+# SLO-shaped ms buckets shared by the serving-latency and train-step
+# phase histograms: 0.1ms floor (CPU-smoke chunks), 2min ceiling,
+# dense through the 1ms-10s band where TTFT/TPOT and step-phase
+# targets live. One definition so the two metric families keep the
+# same quantile resolution.
+LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1e3, 2.5e3, 5e3, 1e4, 3e4,
+                      6e4, 1.2e5)
 
 
 class Counter:
@@ -168,13 +178,54 @@ class Histogram:
             if self._count == 0:
                 return {"count": 0, "sum": 0.0, "min": None, "max": None,
                         "avg": None}
-            return {
+            out = {
                 "count": self._count,
                 "sum": self._sum,
                 "min": self._min,
                 "max": self._max,
                 "avg": self._sum / self._count,
             }
+            for q in (0.5, 0.9, 0.95, 0.99):
+                out[f"p{int(q * 100)}"] = self._quantile_locked(q)
+            return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (the
+        histogram_quantile() math of PromQL): find the bucket holding
+        the q-th observation, interpolate linearly inside it. The
+        estimate is always clamped to the OBSERVED [min, max] — a
+        bucket layout entirely below the data piles everything into
+        +Inf, and the honest degraded answer there is the observed max,
+        never inf/NaN. None when the histogram is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._mu:
+            if self._count == 0:
+                return None
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q: float) -> float:
+        rank = q * self._count
+        acc = 0
+        lo = 0.0
+        for i, bound in enumerate(self.buckets):
+            nxt = acc + self._counts[i]
+            if nxt >= rank and self._counts[i] > 0:
+                frac = (rank - acc) / self._counts[i]
+                est = lo + (bound - lo) * frac
+                return min(max(est, self._min), self._max)
+            acc = nxt
+            lo = bound
+        # rank lands in the +Inf bucket: the finite upper edge the data
+        # exceeded says nothing about how far — clamp to observed max
+        return self._max
+
+    def quantiles(self, qs=(0.5, 0.9, 0.99)) -> dict:
+        """{"p50": estimate, ...} for each q; {} when empty."""
+        with self._mu:
+            if self._count == 0:
+                return {}
+            return {f"p{q * 100:g}": self._quantile_locked(q) for q in qs}
 
     def cumulative_buckets(self) -> List[Tuple[float, int]]:
         """[(upper_bound, cumulative_count), ...] ending at (inf, count)

@@ -136,6 +136,16 @@ def hit(point: str):
     if action == "delay":
         time.sleep(delay_s)
         return
+    # Black box: before the process dies (or the failure starts
+    # unwinding), dump the trace ring + metrics snapshot to the armed
+    # flight-record path. Lazy import keeps this module free of monitor
+    # dependencies on the no-fault path; record_fault never raises and
+    # no-ops when no destination is armed.
+    try:
+        from ..monitor import trace as _trace
+        _trace.record_fault(point, action)
+    except Exception:
+        pass
     if action == "kill":
         os._exit(KILL_EXIT_CODE)
     raise FaultInjected(f"fault injected at {point!r}")

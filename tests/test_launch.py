@@ -38,6 +38,8 @@ class TestLaunchCLI:
         assert r.returncode == 0
         assert "nproc_per_node" in r.stdout
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 5): heavy 2-process spawn;
+    # test_checkpoint_ft keeps a 2-process launch-CLI case in its lane
     def test_two_process_cluster(self, tmp_path):
         """launch CLI spawns 2 processes; they rendezvous, exchange
         objects, barrier, and round-trip a distributed checkpoint."""

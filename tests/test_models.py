@@ -40,6 +40,8 @@ class TestKVCacheDecode:
                                    rtol=2e-4, atol=2e-4)
         assert int(cache["pos"]) == ids.shape[1]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 5): heavy; the greedy/beam
+    # naive-loop parities below keep KV-cache decode covered in tier-1
     def test_decode_steps_match_full_forward(self):
         cfg, params, ids = self._setup(seed=1)
         B, S = ids.shape
@@ -332,6 +334,7 @@ class TestFunctionalLlama:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.75, losses
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 5): heavy; run in slow lane
     def test_remat_matches_no_remat(self):
         cfg = tiny(remat=False)
         cfg_r = tiny(remat=True)

@@ -217,11 +217,13 @@ class TestPagedDecodeParity:
         qp = L.quantize_weights(L.init_params(cfg, jax.random.PRNGKey(2)))
         self._run(L, cfg, qp, (6, 10), 5)
 
-    def test_moe_greedy(self):
+    @pytest.mark.slow  # tier-1 budget (ISSUE 5): heavy; llama parity
+    def test_moe_greedy(self):  # cases keep the engine seam in tier-1
         cfg = M.moe_tiny()
         params = M.init_params(cfg, jax.random.PRNGKey(3))
         self._run(M, cfg, params, (4, 9), 5)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 5): heavy; run in slow lane
     def test_moe_int8(self):
         cfg = M.moe_tiny()
         qp = M.quantize_weights(M.init_params(cfg, jax.random.PRNGKey(4)))
