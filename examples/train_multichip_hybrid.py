@@ -24,7 +24,9 @@ cfg = L.llama_tiny(num_hidden_layers=2, hidden_size=64,
                    num_attention_heads=4, num_key_value_heads=2,
                    dtype=jnp.float32)
 with mesh:
-    step = L.make_train_step(cfg, mesh=mesh, lr=1e-3)
+    # guard=False: this example demonstrates GSPMD sharding; see
+    # train_llama_single_chip.py for the sentinel-guarded step
+    step = L.make_train_step(cfg, mesh=mesh, lr=1e-3, guard=False)
     params = L.init_params(cfg, jax.random.PRNGKey(0))
     opt_state = L.adamw_init(params)
     rng = np.random.default_rng(0)

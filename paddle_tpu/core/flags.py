@@ -90,7 +90,17 @@ define_flag("default_matmul_precision", "default", "jax matmul precision: defaul
 define_flag("enable_monitor", False,
             "Collect runtime metrics (paddle_tpu.monitor counters/gauges/"
             "histograms) on the instrumented hot paths; off = one branch.")
+define_flag("enable_sentinel", False,
+            "Train-loop anomaly sentinel: models.llama/models.moe "
+            "make_train_step builds the GUARDED step (in-graph "
+            "NaN/grad-spike gate + health aux scalars) when its "
+            "guard=None default resolves against this flag, and the "
+            "hapi fit loop skips optimizer updates on non-finite "
+            "losses (any model). Other families (dit, ocr) are not yet "
+            "guarded. Off = one cached branch, zero extra device "
+            "outputs.")
 define_flag("fault_injection", "",
             "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
             " armed at import by paddle_tpu.testing.faults (actions: "
-            "raise|delay|kill; e.g. 'checkpoint.rename:kill:2').")
+            "raise|delay|kill|corrupt|corrupt_inf; e.g. "
+            "'checkpoint.rename:kill:2', 'train.batch:corrupt:3').")

@@ -60,7 +60,10 @@ def run(cfg, model_cfg):
 
     params = L.shard_params(
         L.init_params(mcfg, jax.random.PRNGKey(0)), mcfg, mesh)
-    step = L.make_train_step(mcfg, mesh, lr=1e-3, donate=False)
+    # guard=False: trials rank UNGUARDED step throughput; the
+    # sentinel gate is a constant additive cost, not a tuning axis
+    step = L.make_train_step(mcfg, mesh, lr=1e-3, donate=False,
+                             guard=False)
     ids = jax.device_put(
         jnp.asarray(np.random.default_rng(0).integers(
             0, mcfg.vocab_size, (batch, seq + 1)), jnp.int32),

@@ -77,8 +77,17 @@ class Model:
             if loss_scale != 1.0:
                 total = total * loss_scale
             total.backward()
+        # Anomaly guard (training/sentinel.py), fed EVERY micro-batch:
+        # with FLAGS_enable_sentinel set, a non-finite loss anywhere in
+        # the accumulation window SKIPS the window's optimizer step
+        # (its NaN is already summed into the accumulated grads) —
+        # gradients cleared, parameters untouched, train.anomaly.*
+        # metrics fed. One cached-flag branch off.
+        from ..training.sentinel import guard_eager_update
+        skip = guard_eager_update(self, losses, update=update)
         if update and self._optimizer is not None:
-            self._optimizer.step()
+            if not skip:
+                self._optimizer.step()
             self._optimizer.clear_grad()
         return [float(l) for l in losses]
 
@@ -145,6 +154,7 @@ class Model:
         # BETWEEN the timed phases, bills itself here through the
         # ambient-phase seam — and releases it when fit returns.
         from .. import monitor as _monitor
+        from ..testing import faults as _faults
         stim = _monitor.StepTimer("hapi.fit")
         with stim:
             for epoch in range(epochs):
@@ -153,6 +163,10 @@ class Model:
                     m.reset()
                 logs = {}
                 for step, batch in enumerate(stim.iter_data(loader)):
+                    # chaos value point: FLAGS_fault_injection can
+                    # poison a batch here (testing/faults.py `corrupt`)
+                    # to drive the sentinel's skip path end to end
+                    batch = _faults.corrupt("train.batch", batch)
                     inputs, labels = self._split_batch(batch)
                     cbks.on_batch_begin("train", step, logs)
                     k = max(int(accumulate_grad_batches), 1)

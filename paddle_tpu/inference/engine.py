@@ -54,6 +54,7 @@ discarded for recompute. On a drained engine
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from collections import deque
 from functools import partial
@@ -72,7 +73,25 @@ from .paged import PagedKVCache, paged_decode_step, paged_prefill
 def _observe_latency(name: str, ms: float, doc: str):
     _monitor.observe(name, ms, doc=doc, buckets=_LATENCY_BUCKETS_MS)
 
-__all__ = ["Request", "RequestOutput", "ServingEngine"]
+__all__ = ["Request", "RequestOutput", "RequestRejected", "ServingEngine"]
+
+
+class RequestRejected(E.InvalidArgumentError):
+    """A malformed submission, refused at the door.
+
+    Raised by :meth:`ServingEngine.submit` BEFORE the request touches
+    the queue, the page pool, or any device state — so one client's
+    garbage (oversized prompt, empty prompt, non-finite temperature,
+    out-of-vocab token ids) can never detonate mid-chunk and take down
+    the engine loop for every other in-flight request. Counted under
+    ``serving.requests.rejected``. Subclasses the framework's
+    InvalidArgumentError (and therefore ValueError), so existing typed
+    handlers keep working."""
+
+    def __init__(self, rid, reason: str):
+        self.rid = rid
+        self.reason = reason
+        super().__init__(f"request {rid!r} rejected: {reason}")
 
 
 @dataclasses.dataclass
@@ -256,14 +275,75 @@ class ServingEngine:
 
     # -- submission ---------------------------------------------------------
 
+    def _reject_reason(self, req: Request):
+        """``(why this submission must be refused, None)``, or
+        ``(None, (prompt ndarray, max_new int, temperature float))``
+        when it is well-formed — the validated+coerced values ride back
+        and submit writes them ONTO the request, so a coercible-but-
+        wrong-typed field (temperature="0.7", max_new_tokens=2.9) can
+        never pass screening here and still detonate later in the
+        scheduler. Every check runs on the HOST copy before the request
+        touches any engine state — anything that would otherwise raise
+        inside a compiled prefill/decode chunk (and kill the loop for
+        every in-flight request) is turned into a rejection here
+        instead."""
+        def bad(reason):
+            return reason, None
+        try:
+            prompt = np.asarray(req.prompt)
+        except Exception:
+            return bad("prompt is not array-like")
+        if prompt.ndim != 1:
+            return bad(f"prompt must be 1-D token ids, got shape "
+                       f"{prompt.shape}")
+        plen = int(prompt.shape[0])
+        if plen < 1:
+            return bad("empty prompt")
+        if not np.issubdtype(prompt.dtype, np.integer):
+            return bad(f"prompt dtype {prompt.dtype} is not an integer "
+                       "token-id type")
+        vocab = int(self.config.vocab_size)
+        lo, hi = int(prompt.min()), int(prompt.max())
+        if lo < 0 or hi >= vocab:
+            return bad(f"prompt token ids outside [0, {vocab}): min {lo}, "
+                       f"max {hi}")
+        try:
+            max_new = int(req.max_new_tokens)
+            if max_new != req.max_new_tokens:   # 2.9 must not pass as 2
+                return bad(f"max_new_tokens {req.max_new_tokens!r} is "
+                           "not an integral count")
+        except (TypeError, ValueError):
+            return bad(f"max_new_tokens {req.max_new_tokens!r} is not "
+                       "an int")
+        if max_new < 1:
+            return bad(f"max_new_tokens must be >= 1, got {max_new}")
+        if plen + max_new > self.max_len:
+            return bad(f"prompt {plen} + max_new {max_new} exceeds "
+                       f"max_len {self.max_len}")
+        try:
+            temp = float(req.temperature)
+        except (TypeError, ValueError):
+            return bad(f"temperature {req.temperature!r} is not a float")
+        if not math.isfinite(temp) or temp < 0.0:
+            return bad(f"temperature must be finite and >= 0, got {temp}")
+        return None, (prompt, max_new, temp)
+
     def submit(self, req: Request):
-        E.enforce(req.max_new_tokens >= 1,
-                  "max_new_tokens must be >= 1")
-        plen = int(np.asarray(req.prompt).shape[0])
-        E.enforce(plen >= 1, "empty prompt")
-        E.enforce(plen + req.max_new_tokens <= self.max_len,
-                  f"request {req.rid}: prompt {plen} + max_new "
-                  f"{req.max_new_tokens} exceeds max_len {self.max_len}")
+        """Queue a request, or raise :class:`RequestRejected` (typed,
+        counted) when it is malformed — the engine and every in-flight
+        request are untouched either way until admission."""
+        reason, norm = self._reject_reason(req)
+        if reason is not None:
+            _monitor.inc("serving.requests.rejected",
+                         doc="malformed submissions refused at the "
+                             "door (engine state untouched)")
+            _trace.instant("serving.reject", rid=req.rid, reason=reason)
+            raise RequestRejected(req.rid, reason)
+        # the scheduler consumes the NORMALIZED values it was screened
+        # on — the original coercible-but-wrong-typed fields must not
+        # ride into the loop
+        req.prompt, req.max_new_tokens, req.temperature = norm
+        plen = int(req.prompt.shape[0])
         if _monitor.enabled():
             now = time.perf_counter()
             # t0 anchors TTFT/e2e (first submission wins); t_enqueue is

@@ -40,6 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import nn
 from ..nn import functional as F
 from ..core import enforce as E
+from ..training.guards import (gated_update, grad_global_norm,
+                               resolve_guard, step_health)
 from ..nn.functional.attention import (gather_rope_rows as _gather_rope_rows,
                                        rope_raw, rope_tables as _rope_tables,
                                        sdpa_raw)
@@ -48,6 +50,7 @@ __all__ = [
     "LlamaConfig", "llama_tiny", "llama_3_8b",
     "init_params", "forward", "loss_fn", "param_specs", "unpack_batch",
     "make_train_step", "make_forward", "adamw_init", "count_params",
+    "grad_global_norm",
     "LlamaForCausalLM",
     "init_cache", "prefill", "decode_step", "generate", "make_sampler",
     "beam_search", "quantize_weights",
@@ -812,7 +815,8 @@ def make_forward(config: LlamaConfig, mesh: Optional[Mesh] = None):
 
 def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
                     lr: float = 3e-4, weight_decay: float = 0.1,
-                    sp: bool = False, donate: bool = True):
+                    sp: bool = False, donate: bool = True,
+                    guard: Optional[bool] = None):
     """Build `(params, opt_state, batch) -> (params, opt_state, loss)`.
 
     With a mesh (axes 'dp','fsdp','tp'): full GSPMD hybrid parallelism —
@@ -821,27 +825,62 @@ def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
     params/opt-state in place (no 2x HBM). The batch may be any
     ``unpack_batch`` form — the single batch sharding below is a pytree
     PREFIX, so a packed (inp, labels, segment_ids, positions) tuple (all
-    [B, S]) shards each leaf over ('dp','fsdp') without new plumbing."""
+    [B, S]) shards each leaf over ('dp','fsdp') without new plumbing.
+
+    ``guard`` (default: ``FLAGS_enable_sentinel``) selects the GUARDED
+    step `(params, opt_state, batch, gnorm_cap) -> (params, opt_state,
+    loss, health)`: the optimizer update sits behind a ``lax.cond`` on
+    :func:`step_health`'s ok flag, so an anomalous batch (non-finite
+    loss/grads, out-of-range token ids, grad norm over the host-fed
+    ``gnorm_cap`` scalar) leaves params and opt-state byte-identical —
+    all-or-nothing ON DEVICE, donation and shardings intact — and
+    ``health`` = {"finite", "grad_norm"} feeds the host-side
+    ``training.sentinel`` policy engine. Unguarded (the default with
+    the flag off), the step is exactly the 3-in/3-out program above:
+    zero extra device outputs."""
+    guard = resolve_guard(guard)
+
+    def grads_of(params, batch):
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, batch, config, sp=sp, mesh=mesh))(params)
+
+    def update(p, o, g):
+        return _adamw_update(p, g, o, lr, wd=weight_decay)
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, config, sp=sp, mesh=mesh))(params)
-        params, opt_state = _adamw_update(params, grads, opt_state, lr,
-                                          wd=weight_decay)
+        loss, grads = grads_of(params, batch)
+        params, opt_state = update(params, opt_state, grads)
         return params, opt_state, loss
 
+    def guarded_step(params, opt_state, batch, gnorm_cap):
+        loss, grads = grads_of(params, batch)
+        ok, health = step_health(loss, grads, unpack_batch(batch)[0],
+                                 config.vocab_size, gnorm_cap)
+        params, opt_state = gated_update(ok, update, params, opt_state,
+                                         grads)
+        return params, opt_state, loss, health
+
+    dn = (0, 1) if donate else ()
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        return jax.jit(guarded_step if guard else step, donate_argnums=dn)
 
     specs = param_specs(config)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                           is_leaf=lambda x: isinstance(x, P))
     oshard = {"step": NamedSharding(mesh, P()), "m": pshard, "v": pshard}
     dshard = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    scalar = NamedSharding(mesh, P())
+    if guard:
+        return jax.jit(
+            guarded_step,
+            in_shardings=(pshard, oshard, dshard, scalar),
+            out_shardings=(pshard, oshard, scalar,
+                           {"finite": scalar, "grad_norm": scalar}),
+            donate_argnums=dn)
     return jax.jit(step,
                    in_shardings=(pshard, oshard, dshard),
-                   out_shardings=(pshard, oshard, NamedSharding(mesh, P())),
-                   donate_argnums=(0, 1) if donate else ())
+                   out_shardings=(pshard, oshard, scalar),
+                   donate_argnums=dn)
 
 
 def shard_params(params, config: LlamaConfig, mesh: Mesh):

@@ -597,32 +597,65 @@ def adamw_init(params):
 
 
 def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
-                    lr: float = 1e-4, donate: bool = True):
+                    lr: float = 1e-4, donate: bool = True,
+                    guard: Optional[bool] = None):
     """Jitted AdamW train step; with a mesh, params/opt-state placements
     come from param_specs and the batch shards over ('dp','fsdp').
     Buffer donation updates params/opt-state in place — without it the
     step holds BOTH generations of the expert weights, which at MoE
-    sizes is the difference between fitting and OOM."""
-    from .llama import _adamw_update
+    sizes is the difference between fitting and OOM.
+
+    ``guard`` (default: ``FLAGS_enable_sentinel``) builds the GUARDED
+    4-in/4-out step — identical contract to the llama family's (see
+    ``llama.make_train_step``): the update gates on
+    ``llama.step_health``'s ok flag behind a ``lax.cond``, anomalous
+    steps leave params/opt-state byte-identical, and the health aux
+    scalars feed ``training.sentinel``."""
+    from .llama import _adamw_update, unpack_batch
+    from ..training.guards import (gated_update, resolve_guard,
+                                   step_health)
+    guard = resolve_guard(guard)
+
+    def grads_of(params, batch):
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, batch, config, mesh=mesh))(params)
+
+    def update(p, o, g):
+        return _adamw_update(p, g, o, lr)
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, config, mesh=mesh))(params)
-        params, opt_state = _adamw_update(params, grads, opt_state, lr)
+        loss, grads = grads_of(params, batch)
+        params, opt_state = update(params, opt_state, grads)
         return params, opt_state, loss
+
+    def guarded_step(params, opt_state, batch, gnorm_cap):
+        loss, grads = grads_of(params, batch)
+        ok, health = step_health(loss, grads, unpack_batch(batch)[0],
+                                 config.vocab_size, gnorm_cap)
+        params, opt_state = gated_update(ok, update, params, opt_state,
+                                         grads)
+        return params, opt_state, loss, health
 
     dn = (0, 1) if donate else ()
     if mesh is None:
-        return jax.jit(step, donate_argnums=dn)
+        return jax.jit(guarded_step if guard else step, donate_argnums=dn)
 
     specs = param_specs(config)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                           is_leaf=lambda s: isinstance(s, P))
+    bshard = NamedSharding(mesh, P(("dp", "fsdp"), None))
+
+    if guard:
+        def placed_guarded(params, opt_state, batch, gnorm_cap):
+            params = jax.lax.with_sharding_constraint(params, pshard)
+            batch = jax.lax.with_sharding_constraint(batch, bshard)
+            return guarded_step(params, opt_state, batch, gnorm_cap)
+
+        return jax.jit(placed_guarded, donate_argnums=dn)
 
     def placed(params, opt_state, batch):
         params = jax.lax.with_sharding_constraint(params, pshard)
-        batch = jax.lax.with_sharding_constraint(
-            batch, NamedSharding(mesh, P(("dp", "fsdp"), None)))
+        batch = jax.lax.with_sharding_constraint(batch, bshard)
         return step(params, opt_state, batch)
 
     return jax.jit(placed, donate_argnums=dn)

@@ -40,11 +40,34 @@ from ..core import flags as _flags
 from . import trace as _trace
 from .registry import LATENCY_BUCKETS_MS as _PHASE_BUCKETS
 
-__all__ = ["StepTimer", "ambient_phase"]
+__all__ = ["StepTimer", "ambient_phase", "add_step_listener",
+           "remove_step_listener"]
 
 _FLAG = _flags.flag_info("enable_monitor")
 
 _PHASES = ("data_wait", "compute", "checkpoint")
+
+# Step listeners: fn() invoked on EVERY StepTimer.end_step, regardless
+# of FLAGS_enable_monitor — the hang watchdog's heartbeat feed
+# (training/sentinel.py). A stalled step must be detectable even when
+# metrics are off, so this sits above the flag gate; with no listeners
+# the cost is one empty-tuple check.
+_STEP_LISTENERS: list = []
+
+
+def add_step_listener(fn):
+    """Register ``fn()`` to run at every ``end_step`` on any timer
+    (idempotent). Exceptions are swallowed — a broken listener must not
+    take down the training loop."""
+    if fn not in _STEP_LISTENERS:
+        _STEP_LISTENERS.append(fn)
+
+
+def remove_step_listener(fn):
+    try:
+        _STEP_LISTENERS.remove(fn)
+    except ValueError:
+        pass
 
 # Thread-local active timer (the ambient_phase target).
 _ACTIVE = threading.local()
@@ -169,7 +192,13 @@ class StepTimer:
 
     def end_step(self, useful_tokens: int = 0):
         """Close one step: observes the step total, counts useful
-        tokens, refreshes the goodput gauges."""
+        tokens, refreshes the goodput gauges. Step listeners (the hang
+        watchdog's heartbeats) fire first, monitor on or off."""
+        for fn in tuple(_STEP_LISTENERS):
+            try:
+                fn()
+            except Exception:
+                pass
         if not _FLAG.value:
             return
         from . import inc as _inc

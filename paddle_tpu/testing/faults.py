@@ -11,6 +11,10 @@ point:
 - ``delay``  sleep ``delay_s`` (widen race windows, keep going)
 - ``kill``   ``os._exit(137)`` — the ``kill -9`` equivalent: no
   ``finally`` blocks, no ``atexit``, nothing flushed.
+- ``corrupt`` / ``corrupt_inf``  poison a VALUE passing through a
+  :func:`corrupt` point: NaN (or +Inf) planted into the first array
+  leaf — data/activation corruption for anomaly-path testing (the
+  train-loop sentinel's fault model).
 
 Arming is per-point with an ``nth`` trigger (fire on the Nth hit,
 1-based), so a test can let the first save succeed and murder the
@@ -36,6 +40,9 @@ Known injection points (grep ``faults.hit`` for the live list):
 - ``checkpoint.rename``    before the tmp-dir -> final-dir rename
 - ``checkpoint.commit``    before the COMMIT marker lands
 - ``collective.gather``    inside ``all_gather_object``
+- ``train.batch``          value point: each batch entering a sentinel
+  loop / hapi train step (``faults.corrupt`` — grep ``faults.corrupt``
+  for the live list of value points)
 """
 from __future__ import annotations
 
@@ -45,7 +52,7 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["FaultInjected", "inject", "clear", "injected", "hit",
-           "hit_count", "armed", "KILL_EXIT_CODE"]
+           "corrupt", "hit_count", "armed", "KILL_EXIT_CODE"]
 
 # 128 + SIGKILL(9): what a shell reports for a kill -9'd process.
 KILL_EXIT_CODE = 137
@@ -59,9 +66,10 @@ class _Injection:
     __slots__ = ("point", "action", "nth", "delay_s", "hits", "fired")
 
     def __init__(self, point: str, action: str, nth: int, delay_s: float):
-        if action not in ("raise", "delay", "kill"):
+        if action not in ("raise", "delay", "kill", "corrupt",
+                          "corrupt_inf"):
             raise ValueError(f"unknown fault action {action!r} "
-                             "(want raise|delay|kill)")
+                             "(want raise|delay|kill|corrupt|corrupt_inf)")
         if nth < 1:
             raise ValueError(f"nth must be >= 1, got {nth}")
         self.point = point
@@ -116,31 +124,32 @@ class injected:
         return False
 
 
-def hit(point: str):
-    """Declare an injection point. No-op (one branch) unless a test or
-    ``FLAGS_fault_injection`` armed this point."""
-    if not _ARMED[0]:
-        return
+def _fire(point: str, value_point: bool):
+    """Shared arming logic: count the hit and return ``(action,
+    delay_s)`` when the point's Nth hit fires. Corrupt actions only
+    fire (and only count toward ``nth``) at value points — a plain
+    ``hit()`` at a corrupt-armed point neither fires nor consumes."""
     with _MU:
         _HITS[point] = _HITS.get(point, 0) + 1
         inj = _POINTS.get(point)
         if inj is None or inj.fired:
-            return
+            return None
+        if not value_point and inj.action in ("corrupt", "corrupt_inf"):
+            return None
         inj.hits += 1
         if inj.hits < inj.nth:
-            return
+            return None
         inj.fired = True
-        action, delay_s = inj.action, inj.delay_s
-    # fire outside the lock: delay must not serialize unrelated points,
-    # and a raise must not leave the lock held
-    if action == "delay":
-        time.sleep(delay_s)
-        return
-    # Black box: before the process dies (or the failure starts
-    # unwinding), dump the trace ring + metrics snapshot to the armed
-    # flight-record path. Lazy import keeps this module free of monitor
-    # dependencies on the no-fault path; record_fault never raises and
-    # no-ops when no destination is armed.
+        return inj.action, inj.delay_s
+
+
+def _fire_fatal(point: str, action: str):
+    """raise/kill tail shared by ``hit`` and ``corrupt``. Black box
+    first: before the process dies (or the failure starts unwinding),
+    dump the trace ring + metrics snapshot to the armed flight-record
+    path. Lazy import keeps this module free of monitor dependencies on
+    the no-fault path; record_fault never raises and no-ops when no
+    destination is armed."""
     try:
         from ..monitor import trace as _trace
         _trace.record_fault(point, action)
@@ -149,6 +158,107 @@ def hit(point: str):
     if action == "kill":
         os._exit(KILL_EXIT_CODE)
     raise FaultInjected(f"fault injected at {point!r}")
+
+
+def hit(point: str):
+    """Declare an injection point. No-op (one branch) unless a test or
+    ``FLAGS_fault_injection`` armed this point."""
+    if not _ARMED[0]:
+        return
+    fired = _fire(point, value_point=False)
+    if fired is None:
+        return
+    action, delay_s = fired
+    # fire outside the lock: delay must not serialize unrelated points,
+    # and a raise must not leave the lock held
+    if action == "delay":
+        time.sleep(delay_s)
+        return
+    _fire_fatal(point, action)
+
+
+def corrupt(point: str, value):
+    """Declare a VALUE injection point: returns ``value`` untouched
+    unless this is the armed Nth hit — then a poisoned copy. The
+    ``corrupt`` action plants into the first array leaf of the pytree
+    (tuples/dicts/Tensors welcome): floating leaves get NaN (``corrupt``)
+    or +Inf (``corrupt_inf``) at element 0; integer leaves get
+    ``iinfo.min`` at element 0 — the out-of-range-token-id equivalent
+    of bit-rot in an int data pipeline, which the guarded train step's
+    id-range check turns into an anomaly. raise/delay/kill armed at a
+    value point fire exactly as in :func:`hit`. Disarmed: one branch,
+    value passes through by identity."""
+    if not _ARMED[0]:
+        return value
+    fired = _fire(point, value_point=True)
+    if fired is None:
+        return value
+    action, delay_s = fired
+    if action == "delay":
+        time.sleep(delay_s)
+        return value
+    if action in ("corrupt", "corrupt_inf"):
+        try:
+            from ..monitor import trace as _trace
+            _trace.instant("fault.corrupt", point=point, action=action)
+        except Exception:
+            pass
+        return _poison_first_leaf(value, action == "corrupt_inf")
+    _fire_fatal(point, action)
+
+
+def _poison_first_leaf(value, inf: bool):
+    """A copy of ``value`` with the first poisonable array leaf
+    corrupted (non-array leaves — ints, None, strings — pass over)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(value)
+    for i, leaf in enumerate(leaves):
+        poisoned = _poison_leaf(leaf, inf)
+        if poisoned is not None:
+            leaves[i] = poisoned
+            return jax.tree.unflatten(treedef, leaves)
+    return value
+
+
+def _bad_value(dt, inf: bool):
+    import numpy as np
+    dt = np.dtype(dt)
+    name = dt.name
+    if np.issubdtype(dt, np.floating) or "float" in name \
+            or name == "bfloat16":
+        return float("inf") if inf else float("nan")
+    if np.issubdtype(dt, np.unsignedinteger):
+        # unsigned: iinfo.min is 0 — a VALID token id, i.e. a silent
+        # no-op; the out-of-range value is the other end
+        return int(np.iinfo(dt).max)
+    if np.issubdtype(dt, np.integer):
+        return int(np.iinfo(dt).min)
+    return None
+
+
+def _poison_leaf(leaf, inf: bool):
+    import numpy as np
+
+    if hasattr(leaf, "_data") and hasattr(leaf, "numpy"):  # paddle Tensor
+        arr = _poison_leaf(np.array(leaf.numpy()), inf)
+        if arr is None:
+            return None
+        from ..core.tensor import to_tensor
+        return to_tensor(arr)
+    if hasattr(leaf, "at") and hasattr(leaf, "dtype"):     # jax.Array
+        bad = _bad_value(leaf.dtype, inf)
+        if bad is None or leaf.size == 0:
+            return None
+        return leaf.at[(0,) * leaf.ndim].set(bad)
+    if isinstance(leaf, np.ndarray):
+        bad = _bad_value(leaf.dtype, inf)
+        if bad is None or leaf.size == 0:
+            return None
+        out = np.array(leaf)
+        out.flat[0] = bad
+        return out
+    return None
 
 
 def hit_count(point: str) -> int:

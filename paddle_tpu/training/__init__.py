@@ -1,0 +1,12 @@
+"""paddle_tpu.training — train-loop lifecycle subsystems.
+
+The model families (``models/``) define the compiled step; this package
+holds the host-side machinery that keeps a long run ALIVE around it:
+``sentinel`` (anomaly detection, skip/rollback auto-recovery, the hang
+watchdog). Checkpointing lives in ``distributed.checkpoint``; the
+sentinel composes with its CheckpointManager for rollback.
+"""
+from . import guards  # noqa: F401
+from . import sentinel  # noqa: F401
+
+__all__ = ["guards", "sentinel"]

@@ -1,0 +1,69 @@
+"""In-graph anomaly guards — the device half of the train-loop
+sentinel, shared by every model family.
+
+``models/llama.py`` and ``models/moe.py`` compose these into their
+``make_train_step(guard=...)``: :func:`step_health` is the ONE anomaly
+definition (finite loss, finite global grad norm, token ids in range,
+norm under the host-fed cap) and :func:`gated_update` is the
+all-or-nothing ``lax.cond`` gate that leaves params/opt-state
+byte-identical on an anomalous step. The host half (spike detector,
+escalation ladder, watchdog) lives in :mod:`.sentinel`.
+
+Kept free of sentinel/monitor imports on purpose: these trace into the
+compiled step and depend only on jax.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["grad_global_norm", "resolve_guard", "step_health",
+           "gated_update"]
+
+
+def grad_global_norm(grads):
+    """Global L2 norm of a grads pytree, accumulated in float32 — the
+    guarded train step's spike signal (one fused per-leaf reduction +
+    a scalar sum; negligible next to fwd+bwd)."""
+    leaves = jax.tree.leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def resolve_guard(guard: Optional[bool]) -> bool:
+    """make_train_step's guard default: ``None`` reads
+    ``FLAGS_enable_sentinel`` at build time (the one flag definition
+    every model family shares), so flipping the flag and rebuilding the
+    step is all a training script needs."""
+    from ..core import flags as _flags
+    return _flags.flag_value("enable_sentinel") if guard is None else guard
+
+
+def step_health(loss, grads, inp, vocab_size: int, gnorm_cap):
+    """(ok, health) of one guarded train step — the ONE anomaly
+    definition shared by every family's guarded step. ``ok`` is True
+    when the update may apply: finite loss, finite global grad norm,
+    every input token id in [0, vocab) (a corrupt data pipeline would
+    otherwise train on clip-gathered garbage SILENTLY), and grad norm
+    under the host-fed ``gnorm_cap`` (the sentinel's EMA spike
+    threshold; pass +inf to disable). ``health`` rides back to the host
+    as two aux scalars: the applied flag and the grad norm the spike
+    detector feeds on."""
+    gnorm = grad_global_norm(grads)
+    ids_ok = jnp.all((inp >= 0) & (inp < vocab_size))
+    ok = jnp.isfinite(loss) & jnp.isfinite(gnorm) & ids_ok \
+        & (gnorm <= gnorm_cap)
+    return ok, {"finite": ok, "grad_norm": gnorm}
+
+
+def gated_update(ok, update_fn, params, opt_state, grads):
+    """Apply ``update_fn(params, opt_state, grads)`` only when ``ok`` —
+    the all-or-nothing device gate: on an anomalous step the false
+    branch returns params/opt-state byte-identical (same values through
+    the cond; donation and GSPMD shardings are branch-invariant), so
+    the host can keep training as if the batch never happened."""
+    return lax.cond(
+        ok, update_fn, lambda p, o, g: (p, o), params, opt_state, grads)

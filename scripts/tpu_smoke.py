@@ -210,7 +210,10 @@ def main():
                          capacity_factor=1.0)
         params = M.init_params(cfg, jax.random.PRNGKey(0))
         opt = L.adamw_init(params)
-        step = M.make_train_step(cfg, lr=1e-3)
+        # guard=False: this stage measures MoE dispatch, not the
+        # sentinel gate (nan_skip_resume covers the guarded step) —
+        # and must keep its 3-in/3-out shape under chaos-run flags
+        step = M.make_train_step(cfg, lr=1e-3, guard=False)
         ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 17)),
                           jnp.int32)
         _, _, loss = step(params, opt, ids)
@@ -295,7 +298,8 @@ def main():
         batch = packed_train_batch(pack_documents(docs, S))
         params = L.init_params(cfg, jax.random.PRNGKey(0))
         opt = L.adamw_init(params)
-        step = L.make_train_step(cfg, lr=1e-3, donate=False)
+        step = L.make_train_step(cfg, lr=1e-3, donate=False,
+                                 guard=False)
         kernels.reset_dispatch_stats()
         _, _, loss = step(params, opt, batch)
         assert np.isfinite(float(loss)), f"packed loss {float(loss)}"
@@ -360,6 +364,72 @@ def main():
         if step != 1 or not np.array_equal(got, want):
             raise RuntimeError(
                 f"resume after kill wrong: step={step} w={got.tolist()}")
+
+    @case("nan_skip_resume")
+    def _():
+        # the anomaly sentinel end to end on the real chip: a corrupt
+        # batch (fault-injected NaN) must leave the guarded step's
+        # params byte-identical, the loop must SKIP it and keep
+        # training, and the loss must still converge-ish afterwards
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.testing import faults as _faults
+        from paddle_tpu.training.sentinel import AnomalySentinel, \
+            SentinelLoop
+
+        cfg = L.llama_tiny(num_hidden_layers=2, vocab_size=64)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        opt = L.adamw_init(params)
+        step = L.make_train_step(cfg, lr=1e-3, guard=True, donate=False)
+
+        def batch(i):
+            # DISTINCT deterministic batches (identical batches would
+            # alias in the quarantine, which is hash-keyed) with a
+            # LEARNABLE pattern (consecutive ids mod vocab), so the
+            # post-skip loss provably drops
+            r = np.random.default_rng(1000 + i)
+            start = r.integers(0, cfg.vocab_size, (2, 1))
+            ids = ((start + np.arange(33)) % cfg.vocab_size).astype(
+                np.int32)
+            return ids[:, :-1], ids[:, 1:]
+
+        # 1) a NaN-corrupted batch leaves params byte-identical
+        inf_cap = jnp.asarray(np.inf, jnp.float32)
+        try:
+            _faults.inject("smoke.batch", action="corrupt")
+            bad = _faults.corrupt("smoke.batch", (
+                jnp.asarray(batch(0)[0], jnp.float32),))  # float leaf
+        finally:
+            _faults.clear()
+        assert not np.isfinite(np.asarray(bad[0])).all(), \
+            "corrupt action did not plant a non-finite value"
+        bad_ids = np.array(batch(0)[0])
+        bad_ids[0, 0] = np.iinfo(np.int32).min      # int-pipeline rot
+        p2, o2, _, h = step(params, opt,
+                            (bad_ids, batch(0)[1]), inf_cap)
+        assert not bool(h["finite"]), "guard missed the corrupt batch"
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise RuntimeError("anomalous step mutated params")
+
+        # 2) loop: corrupt the 3rd batch mid-run -> exactly one skip,
+        # training continues, loss drops vs the start
+        def make_stream():
+            return (batch(i) for i in range(40))
+
+        loop = SentinelLoop(step, params, opt, make_stream,
+                            sentinel=AnomalySentinel())
+        _, _, first_loss, _ = step(params, opt, batch(0), inf_cap)
+        try:
+            _faults.inject("train.batch", action="corrupt", nth=3)
+            out = loop.run(40)
+        finally:
+            _faults.clear()
+        if out["skipped"] != 1 or out["applied"] != 39:
+            raise RuntimeError(f"skip accounting wrong: {out}")
+        if not (out["last_loss"] < float(first_loss)):
+            raise RuntimeError(
+                f"no convergence after skip: first {float(first_loss)} "
+                f"last {out['last_loss']}")
 
     @case("flash_block_autotune_bench_shape")
     def _():

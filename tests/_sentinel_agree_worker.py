@@ -1,0 +1,50 @@
+"""Worker for the multi-host skip-agreement test (run via the launch
+CLI, not collected by pytest).
+
+Both ranks drive one AnomalySentinel through three observations:
+
+1. rank 0 LOCALLY anomalous, rank 1 healthy — the agreement gather must
+   make EVERY rank skip (any-rank-anomalous -> all-ranks-skip), or the
+   fleet splits into updated and non-updated halves.
+2. both healthy, but with DIFFERENT local grad norms — the gather keeps
+   the EMA state host-identical (max norm wins), so the caps fed to the
+   next device step agree across the fleet.
+3. both healthy again — verdict OK everywhere, identical caps.
+
+Prints one parseable line per observation; the parent test asserts both
+ranks printed the same verdicts and bit-identical cap state.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.training import sentinel as S  # noqa: E402
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    sent = S.AnomalySentinel(S.SentinelConfig(warmup_steps=1,
+                                              name="agreetest"))
+    # 1: rank 0 anomalous locally -> everyone must skip
+    v1 = sent.observe(finite=(rank != 0), grad_norm=float("nan"))
+    # 2: healthy, rank-dependent norms -> gather max keeps EMA identical
+    v2 = sent.observe(finite=True, grad_norm=1.0 + rank)
+    # 3: healthy again
+    v3 = sent.observe(finite=True, grad_norm=2.0)
+    for i, v in enumerate((v1, v2, v3), 1):
+        print(f"VERDICT{i} rank={rank} {v}", flush=True)
+    print(f"STATS rank={rank} n={sent.stats.n} "
+          f"mean={sent.stats.mean!r} cap={sent.gnorm_cap()!r} "
+          f"consecutive={sent.consecutive}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

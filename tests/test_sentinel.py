@@ -1,0 +1,761 @@
+"""Train-loop anomaly sentinel (ISSUE 6): the in-graph NaN/spike guard
+in ``make_train_step(guard=True)`` (params byte-identical on an
+anomalous step — llama and MoE, kernel and fallback attention arms),
+the ``testing/faults.py`` ``corrupt`` value action driving it, the
+host-side skip/rollback escalation ladder with deterministic
+fast-forward replay, the hang watchdog's stall forensics, the hapi
+eager guard, serving-engine request isolation, and the off-flag
+zero-overhead contract."""
+import importlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.models import llama as L
+from paddle_tpu.models import moe as M
+from paddle_tpu.testing import faults
+from paddle_tpu.training import sentinel as S
+
+FA = importlib.import_module("paddle_tpu.kernels.flash_attention")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+B, T, V = 2, 16, 64
+INF_CAP = jnp.asarray(np.inf, jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.clear()
+    pt.set_flags({"FLAGS_enable_sentinel": False,
+                  "FLAGS_enable_monitor": False})
+    monitor.reset()
+
+
+def _batch(i, vocab=V):
+    """Deterministic batch #i of the canonical test stream."""
+    r = np.random.RandomState(1000 + i)
+    ids = r.randint(0, vocab, size=(B, T + 1)).astype(np.int32)
+    return ids[:, :-1], ids[:, 1:]
+
+
+def _stream(n=10_000, poison=()):
+    """Fresh deterministic iterator over the canonical stream; batches
+    whose index is in ``poison`` carry a NaN-equivalent int corruption
+    IN THE DATA (the same batch poisons every replay — persistent
+    bit-rot, not a transient injection)."""
+    def gen():
+        for i in range(n):
+            inp, lab = _batch(i)
+            if i in poison:
+                inp = inp.copy()
+                inp[0, 0] = np.iinfo(np.int32).min
+            yield inp, lab
+    return gen()
+
+
+def _tree_identical(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.asarray(x).dtype == np.asarray(y).dtype
+               and np.array_equal(np.asarray(x), np.asarray(y),
+                                  equal_nan=True)
+               for x, y in zip(la, lb))
+
+
+def _llama():
+    cfg = L.llama_tiny(vocab_size=V)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params, L.adamw_init(params)
+
+
+# ---------------------------------------------------------------------------
+# faults.corrupt — the value-point action
+# ---------------------------------------------------------------------------
+
+class TestCorruptAction:
+    def test_disarmed_is_identity(self):
+        b = _batch(0)
+        assert faults.corrupt("train.batch", b) is b
+
+    def test_nth_hit_semantics(self):
+        faults.inject("train.batch", "corrupt", nth=2)
+        first = faults.corrupt("train.batch", _batch(0))
+        assert int(first[0].min()) >= 0          # 1st hit: untouched
+        second = faults.corrupt("train.batch", _batch(1))
+        assert int(second[0].flat[0]) == np.iinfo(np.int32).min
+        third = faults.corrupt("train.batch", _batch(2))
+        assert int(third[0].min()) >= 0          # fired once, done
+
+    def test_float_leaf_gets_nan_and_inf(self):
+        x = {"a": np.ones((3,), np.float32)}
+        faults.inject("p", "corrupt", nth=1)
+        assert np.isnan(faults.corrupt("p", x)["a"][0])
+        faults.clear()
+        faults.inject("p", "corrupt_inf", nth=1)
+        assert np.isposinf(faults.corrupt("p", x)["a"][0])
+        assert np.all(x["a"] == 1.0)             # original untouched
+
+    def test_jax_array_leaf(self):
+        faults.inject("p", "corrupt", nth=1)
+        out = faults.corrupt("p", jnp.ones((2, 2)))
+        assert np.isnan(np.asarray(out)[0, 0])
+
+    def test_unsigned_int_leaf_goes_out_of_range(self):
+        """uint corruption must plant iinfo.max — iinfo.min is 0, a
+        VALID token id, i.e. a silent no-op."""
+        faults.inject("p", "corrupt", nth=1)
+        out = faults.corrupt("p", np.zeros((4,), np.uint32))
+        assert int(out[0]) == np.iinfo(np.uint32).max
+
+    def test_plain_hit_neither_fires_nor_consumes_corrupt(self):
+        faults.inject("train.batch", "corrupt", nth=1)
+        faults.hit("train.batch")                # value-less declaration
+        out = faults.corrupt("train.batch", _batch(0))
+        assert int(out[0].flat[0]) == np.iinfo(np.int32).min
+
+    def test_raise_fires_at_value_point(self):
+        faults.inject("p", "raise", nth=1)
+        with pytest.raises(faults.FaultInjected):
+            faults.corrupt("p", _batch(0))
+
+    @pytest.mark.chaos
+    @pytest.mark.slow  # tier-1 budget: subprocess; flag-arming also covered by PR 2's chaos tests
+    def test_env_armed_chaos_run(self):
+        """FLAGS_fault_injection arms the corrupt value point in a
+        fresh process — the chaos-run entry to the anomaly paths."""
+        code = (
+            "import numpy as np\n"
+            "import paddle_tpu  # arms faults from the flag\n"
+            "from paddle_tpu.testing import faults\n"
+            "out = faults.corrupt('train.batch',"
+            " np.ones((2,), np.float32))\n"
+            "assert np.isnan(out[0]), out\n"
+            "print('CHAOS_OK')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=300, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     FLAGS_fault_injection="train.batch:corrupt"))
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "CHAOS_OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# in-graph guard: anomalous step is all-or-nothing on device
+# ---------------------------------------------------------------------------
+
+class TestGuardedStep:
+    def test_llama_nan_batch_params_byte_identical_then_continues(self):
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, donate=False)
+        p1, o1, loss1, h1 = step(params, opt, _batch(0), INF_CAP)
+        assert bool(h1["finite"]) and np.isfinite(float(loss1))
+        faults.inject("train.batch", "corrupt", nth=1)
+        bad = faults.corrupt("train.batch", _batch(1))
+        p2, o2, loss2, h2 = step(p1, o1, bad, INF_CAP)
+        assert not bool(h2["finite"])
+        assert _tree_identical(p1, p2)           # params untouched
+        assert _tree_identical(o1, o2)           # opt state untouched
+        # training continues: the next clean batch applies
+        p3, o3, loss3, h3 = step(p2, o2, _batch(2), INF_CAP)
+        assert bool(h3["finite"]) and np.isfinite(float(loss3))
+        assert not _tree_identical(p2, p3)
+
+    def test_moe_nan_batch_params_byte_identical(self):
+        cfg = M.moe_tiny(vocab_size=V)
+        params = M.init_params(cfg, jax.random.PRNGKey(0))
+        opt = M.adamw_init(params)
+        step = M.make_train_step(cfg, guard=True, donate=False)
+        p1, o1, loss1, h1 = step(params, opt, _batch(0), INF_CAP)
+        assert bool(h1["finite"])
+        faults.inject("train.batch", "corrupt_inf", nth=1)
+        bad = faults.corrupt("train.batch", _batch(1))
+        p2, o2, _, h2 = step(p1, o1, bad, INF_CAP)
+        assert not bool(h2["finite"])
+        assert _tree_identical(p1, p2) and _tree_identical(o1, o2)
+
+    def test_guard_holds_on_both_attention_arms(self):
+        """The all-or-nothing contract is attention-impl-independent:
+        the same poisoned PACKED batch through the interpret-mode
+        segment kernel and the jnp fallback both gate the update."""
+        from paddle_tpu.io import packing as PK
+        from paddle_tpu.nn.functional import attention as att
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, donate=False)
+        rng = np.random.default_rng(5)
+        docs = [rng.integers(0, V, (ln,)).astype(np.int32)
+                for ln in (40, 24)]
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 64))
+        bad = (np.where(np.arange(64)[None] == 0,
+                        np.iinfo(np.int32).min, pb[0]).astype(np.int32),
+               ) + tuple(pb[1:])
+        prev = att._SEGMENT_IMPL
+        try:
+            for impl in (None,                    # jnp fallback
+                         lambda *a, **kw: FA.flash_attention_segments(
+                             *a, **kw, interpret=True)):
+                att.register_segment_impl(impl)
+                p2, o2, _, h2 = step(params, opt, bad, INF_CAP)
+                assert not bool(h2["finite"])
+                assert _tree_identical(params, p2)
+                assert _tree_identical(opt, o2)
+        finally:
+            att.register_segment_impl(prev)
+
+    def test_out_of_range_token_id_is_anomalous(self):
+        """One id == vocab_size is flagged by the guard's id-range
+        check. (What the gather itself does is PLATFORM-dependent —
+        XLA:CPU's jnp.take fills NaN, TPU clamps and trains on
+        garbage silently — which is exactly why the explicit ids_ok
+        check exists; the loss value is asserted on neither.)"""
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, donate=False)
+        inp, lab = _batch(0)
+        inp = inp.copy()
+        inp[0, 3] = V                            # one past the edge
+        p2, _, _, h = step(params, opt, (inp, lab), INF_CAP)
+        assert not bool(h["finite"])
+        assert _tree_identical(params, p2)
+
+    def test_spike_cap_gates_finite_step(self):
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, donate=False)
+        tight = jnp.asarray(1e-9, jnp.float32)
+        p2, o2, loss, h = step(params, opt, _batch(0), tight)
+        assert np.isfinite(float(loss))
+        assert np.isfinite(float(h["grad_norm"]))
+        assert not bool(h["finite"])             # gated by the cap
+        assert _tree_identical(params, p2) and _tree_identical(opt, o2)
+
+    def test_guarded_update_math_matches_unguarded(self):
+        """With an infinite cap and clean data the guarded step applies
+        EXACTLY the unguarded update (the cond's true branch is the
+        same program)."""
+        cfg, params, opt = _llama()
+        g = L.make_train_step(cfg, guard=True, donate=False)
+        u = L.make_train_step(cfg, guard=False, donate=False)
+        pg, og, lg, _ = g(params, opt, _batch(0), INF_CAP)
+        pu, ou, lu = u(params, opt, _batch(0))
+        assert float(lg) == float(lu)
+        assert _tree_identical(pg, pu) and _tree_identical(og, ou)
+
+    def test_off_flag_step_is_3_in_3_out(self):
+        """guard=None + flag off -> the historical step program: no cap
+        argument, no health output, zero extra device outputs."""
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, donate=False)
+        out = step(params, opt, _batch(0))
+        assert len(out) == 3                     # params, opt, loss
+        with pytest.raises(TypeError):
+            step(params, opt, _batch(0), INF_CAP)
+
+    def test_flag_selects_guarded_step(self):
+        cfg, params, opt = _llama()
+        pt.set_flags({"FLAGS_enable_sentinel": True})
+        step = L.make_train_step(cfg, donate=False)
+        out = step(params, opt, _batch(0), INF_CAP)
+        assert len(out) == 4 and "finite" in out[3]
+
+
+# ---------------------------------------------------------------------------
+# host policy: spike detector + escalation ladder
+# ---------------------------------------------------------------------------
+
+class TestAnomalySentinel:
+    def test_warmup_cap_is_inf_then_tracks_ema(self):
+        sent = S.AnomalySentinel(S.SentinelConfig(
+            agree=False, warmup_steps=3, spike_sigma=6.0))
+        assert sent.gnorm_cap() == float("inf")
+        for g in (1.0, 1.1, 0.9):
+            assert sent.observe(finite=True, grad_norm=g) == S.OK
+        cap = sent.gnorm_cap()
+        assert np.isfinite(cap) and cap > 1.1
+        assert cap < 10.0                        # sigma-scaled, not wild
+
+    def test_consecutive_resets_on_healthy(self):
+        sent = S.AnomalySentinel(S.SentinelConfig(agree=False))
+        assert sent.observe(finite=False) == S.SKIP
+        assert sent.consecutive == 1
+        assert sent.observe(finite=True, grad_norm=1.0) == S.OK
+        assert sent.consecutive == 0
+
+    def test_rollback_verdict_needs_manager_and_n_consecutive(self):
+        sent = S.AnomalySentinel(S.SentinelConfig(
+            agree=False, max_consecutive=2))
+        assert sent.observe(finite=False) == S.SKIP        # no manager
+        assert sent.observe(finite=False) == S.SKIP
+        sent2 = S.AnomalySentinel(S.SentinelConfig(
+            agree=False, max_consecutive=2), manager=object())
+        assert sent2.observe(finite=False) == S.SKIP
+        assert sent2.observe(finite=False) == S.ROLLBACK
+
+    def test_quarantine_membership_by_content_hash(self):
+        sent = S.AnomalySentinel(S.SentinelConfig(agree=False))
+        b = _batch(0)
+        sent.observe(finite=False, batch=b)
+        assert sent.is_quarantined(_batch(0))    # same content
+        assert not sent.is_quarantined(_batch(1))
+
+    def test_max_rollbacks_refuses_to_thrash(self):
+        sent = S.AnomalySentinel(S.SentinelConfig(
+            agree=False, max_rollbacks=0), manager=object())
+        with pytest.raises(RuntimeError, match="max_rollbacks"):
+            sent.rollback({})
+
+    def test_anomaly_metrics_emitted(self):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        sent = S.AnomalySentinel(S.SentinelConfig(agree=False))
+        sent.observe(finite=False, loss=float("nan"), batch=_batch(0))
+        sent.observe(finite=True, grad_norm=1.0)
+        snap = monitor.snapshot()
+        assert snap["counters"]["train.anomaly.steps"] == 1
+        assert snap["counters"]["train.anomaly.nonfinite"] == 1
+        assert snap["gauges"]["train.anomaly.quarantined"] == 1
+        assert snap["gauges"]["train.anomaly.consecutive"] == 0
+
+
+# ---------------------------------------------------------------------------
+# SentinelLoop: skip / rollback / fast-forward end to end
+# ---------------------------------------------------------------------------
+
+def _loop(make_stream, tmp_path=None, *, interval=2, max_consec=2,
+          warmup=100):
+    cfg, params, opt = _llama()
+    step = L.make_train_step(cfg, guard=True, donate=False)
+    mgr = None
+    if tmp_path is not None:
+        mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                                save_interval_steps=interval,
+                                async_save=False)
+    sent = S.AnomalySentinel(
+        S.SentinelConfig(agree=False, max_consecutive=max_consec,
+                         warmup_steps=warmup), manager=mgr)
+    return S.SentinelLoop(step, params, opt, make_stream,
+                          sentinel=sent, manager=mgr)
+
+
+class TestSentinelLoop:
+    def test_transient_corruption_skipped_training_continues(self):
+        loop = _loop(lambda: _stream())
+        faults.inject("train.batch", "corrupt", nth=3)
+        out = loop.run(6)
+        assert out == {"steps": 6, "applied": 5, "skipped": 1,
+                       "rollbacks": 0, "quarantined": 1,
+                       "last_loss": out["last_loss"]}
+        assert np.isfinite(out["last_loss"])
+
+    def test_rollback_lands_on_latest_step(self, tmp_path):
+        """Two consecutive poisoned DATA batches escalate to a rollback
+        that restores exactly ``latest_step()``; the fast-forwarded
+        replay skips the quarantined batches by hash and completes."""
+        poison = {4, 5}
+        loop = _loop(lambda: _stream(poison=poison), tmp_path)
+        out = loop.run(8)
+        mgr = loop.manager
+        assert out["rollbacks"] == 1
+        assert out["quarantined"] == 2
+        # rollback happened at step 6 (consecutive=2) and restored the
+        # newest committed step at that moment: step 4
+        assert 4 in mgr.all_steps()
+        # replay consumed the stream to step 8 with both poisoned
+        # batches skipped: 8 batches seen, 2 never applied
+        assert out["steps"] == 8
+        assert out["applied"] == 6
+        assert np.isfinite(out["last_loss"])
+
+    @pytest.mark.slow  # tier-1 budget: two full rollback scenarios; the path stays covered by test_rollback_lands_on_latest_step
+    def test_replay_is_deterministic(self, tmp_path):
+        """The whole skip->rollback->fast-forward scenario, run twice
+        from scratch, lands on bit-identical parameters."""
+        a = _loop(lambda: _stream(poison={4, 5}), tmp_path / "a")
+        b = _loop(lambda: _stream(poison={4, 5}), tmp_path / "b")
+        ra, rb = a.run(8), b.run(8)
+        assert ra == rb
+        assert _tree_identical(a.params, b.params)
+        assert _tree_identical(a.opt_state, b.opt_state)
+
+    def test_fast_forward_positions_fresh_stream(self):
+        s = S.fast_forward(_stream(), 3)
+        inp, _ = next(s)
+        want, _ = _batch(3)
+        np.testing.assert_array_equal(inp, want)
+
+    @pytest.mark.slow  # tier-1 budget: third full rollback run, metric-count assertions only
+    def test_quarantined_replay_counts_metrics(self, tmp_path):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        loop = _loop(lambda: _stream(poison={4, 5}), tmp_path)
+        loop.run(8)
+        snap = monitor.snapshot()
+        assert snap["counters"]["train.anomaly.rollbacks"] == 1
+        assert snap["counters"]["train.anomaly.quarantine.skips"] == 2
+        assert snap["counters"]["train.anomaly.steps"] == 2
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+class TestHangWatchdog:
+    def test_stall_dumps_stacks_and_flight_record(self, tmp_path):
+        sp = str(tmp_path / "stall.json")
+        wd = S.HangWatchdog(0.2, poll_s=0.02, stall_path=sp)
+        with wd:
+            deadline = time.monotonic() + 5.0
+            while wd.stalls == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert wd.stalls == 1                    # fires once per stall
+        payload = json.load(open(sp))
+        assert payload["kind"] == "paddle_tpu.watchdog_stall"
+        assert payload["heartbeat_age_s"] > 0.2
+        assert any("MainThread" in k for k in payload["threads"])
+        # every stack is a list of formatted frames
+        assert all(isinstance(v, list) and v
+                   for v in payload["threads"].values())
+        fr = json.load(open(sp + ".flight.json"))
+        assert fr["kind"] == "paddle_tpu.flight_record"
+        assert fr["reason"] == "watchdog.stall"
+
+    def test_heartbeat_rearms_after_stall(self, tmp_path):
+        wd = S.HangWatchdog(0.15, poll_s=0.02,
+                            stall_path=str(tmp_path / "s.json"))
+        with wd:
+            deadline = time.monotonic() + 5.0
+            while wd.stalls == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            wd.heartbeat()                       # loop recovered
+            deadline = time.monotonic() + 5.0
+            while wd.stalls < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert wd.stalls == 2                    # re-armed and re-fired
+
+    def test_steptimer_end_step_feeds_heartbeat(self):
+        """Any StepTimer.end_step anywhere in the process is a
+        heartbeat — the hapi fit loop and bench feed the watchdog for
+        free, monitor on or off."""
+        wd = S.HangWatchdog(60.0, poll_s=0.05)
+        with wd:
+            before = wd._last
+            time.sleep(0.01)
+            stim = monitor.StepTimer("wd.test")
+            with stim:
+                stim.end_step()
+            assert wd._last > before
+        # stopped: listener deregistered
+        from paddle_tpu.monitor import steptimer as st
+        assert wd.heartbeat not in st._STEP_LISTENERS
+
+    def test_exit_on_stall_subprocess_leaves_forensics(self, tmp_path):
+        """A wedged step in a real process: the watchdog dumps the
+        stall JSON + flight record and exits non-zero so process-level
+        supervision (elastic heartbeat) can restart the worker."""
+        sp = str(tmp_path / "stall.json")
+        code = (
+            "import time\n"
+            "import paddle_tpu as pt\n"
+            "from paddle_tpu.training.sentinel import HangWatchdog\n"
+            "pt.set_flags({'FLAGS_enable_monitor': True})\n"
+            "from paddle_tpu.monitor import trace\n"
+            "trace.instant('about.to.wedge', step=7)\n"
+            f"wd = HangWatchdog(0.3, poll_s=0.05, stall_path={sp!r},\n"
+            "                  exit_on_stall=True, exit_code=42)\n"
+            "wd.start()\n"
+            "time.sleep(120)\n"                  # the wedged 'step'
+            "raise SystemExit('watchdog did not fire')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=300, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 42, (r.returncode, r.stderr[-2000:])
+        assert "watchdog stall" in r.stderr      # faulthandler mirror
+        payload = json.load(open(sp))            # parseable JSON
+        assert payload["kind"] == "paddle_tpu.watchdog_stall"
+        assert payload["threads"]
+        fr = json.load(open(sp + ".flight.json"))
+        assert any(e["name"] == "about.to.wedge" for e in fr["events"])
+
+    def test_bad_deadline_rejected(self):
+        with pytest.raises(ValueError):
+            S.HangWatchdog(0.0)
+
+
+# ---------------------------------------------------------------------------
+# hapi eager guard
+# ---------------------------------------------------------------------------
+
+class TestHapiEagerGuard:
+    class _Owner:
+        pass
+
+    def test_off_flag_never_skips(self):
+        assert S.guard_eager_update(self._Owner(), [1.0]) is False
+
+    def test_nonfinite_loss_skips_and_counts(self):
+        pt.set_flags({"FLAGS_enable_sentinel": True,
+                      "FLAGS_enable_monitor": True})
+        monitor.reset()
+        owner = self._Owner()
+        assert S.guard_eager_update(owner, [0.5]) is False
+        assert S.guard_eager_update(owner, [float("nan")]) is True
+        assert S.guard_eager_update(owner, [0.4]) is False
+        snap = monitor.snapshot()
+        assert snap["counters"]["train.anomaly.steps"] == 1
+        assert owner._anomaly_sentinel.consecutive == 0
+
+    def test_accumulation_window_poisoned_by_nonupdate_microbatch(self):
+        """A NaN loss on a NON-update micro-batch taints the whole
+        accumulation window: the NaN is already summed into the
+        accumulated grads, so the window's update must skip even though
+        the final micro-batch's own loss is finite."""
+        pt.set_flags({"FLAGS_enable_sentinel": True})
+        owner = self._Owner()
+        assert S.guard_eager_update(owner, [float("nan")],
+                                    update=False) is True
+        assert S.guard_eager_update(owner, [0.5]) is True   # window skips
+        assert owner._anomaly_sentinel.anomalies == 1
+        # next window is clean again
+        assert S.guard_eager_update(owner, [0.4], update=False) is True
+        assert S.guard_eager_update(owner, [0.3]) is False
+
+    def test_fit_accumulated_nan_microbatch_params_survive(self):
+        """End to end: accumulate_grad_batches=2 with the corrupt batch
+        landing on the NON-update micro-batch — without window
+        poisoning the finite second micro-batch would apply the
+        NaN-accumulated grads."""
+        pt.set_flags({"FLAGS_enable_sentinel": True})
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import Dataset
+
+        class _Reg(Dataset):
+            rng = np.random.RandomState(0)
+            x = rng.randn(32, 4).astype(np.float32)
+            y = np.zeros((32, 2), np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        model.prepare(
+            optimizer=optimizer.SGD(learning_rate=0.1,
+                                    parameters=net.parameters()),
+            loss=nn.MSELoss())
+        # batches 0..3; k=2 -> updates after batches 1 and 3. nth=3
+        # poisons batch #2 (0-based), a NON-update micro-batch.
+        faults.inject("train.batch", "corrupt", nth=3)
+        model.fit(_Reg(), epochs=1, batch_size=8, shuffle=False,
+                  verbose=0, accumulate_grad_batches=2)
+        w = np.asarray(net.weight.numpy())
+        assert np.all(np.isfinite(w))
+        assert model._anomaly_sentinel.anomalies == 1
+
+    def test_fit_skips_poisoned_batch_params_survive(self):
+        """End to end through Model.fit: a corrupt-armed batch yields a
+        non-finite loss; with the sentinel on, the optimizer step is
+        SKIPPED and every parameter stays finite."""
+        pt.set_flags({"FLAGS_enable_sentinel": True})
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import Dataset
+
+        class _Reg(Dataset):
+            rng = np.random.RandomState(0)
+            x = rng.randn(32, 4).astype(np.float32)
+            y = np.zeros((32, 2), np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        model.prepare(
+            optimizer=optimizer.SGD(learning_rate=0.1,
+                                    parameters=net.parameters()),
+            loss=nn.MSELoss())
+        faults.inject("train.batch", "corrupt", nth=2)
+        model.fit(_Reg(), epochs=1, batch_size=8, shuffle=False,
+                  verbose=0)
+        w = np.asarray(net.weight.numpy())
+        assert np.all(np.isfinite(w))
+        assert model._anomaly_sentinel.anomalies == 1
+
+
+# ---------------------------------------------------------------------------
+# serving-engine request isolation (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+class TestEngineIsolation:
+    def _engine(self):
+        from paddle_tpu.inference.engine import ServingEngine
+        cfg, params, _ = _llama()
+        return ServingEngine(L, params, cfg, num_slots=2, max_len=32,
+                             page_size=4, decode_chunk=3), cfg
+
+    def test_malformed_submissions_typed_rejection(self):
+        from paddle_tpu.inference.engine import Request, RequestRejected
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        eng, cfg = self._engine()
+        bad = [
+            (Request(rid=1, prompt=np.array([], np.int32),
+                     max_new_tokens=4), "empty prompt"),
+            (Request(rid=2, prompt=np.arange(40, dtype=np.int32) % V,
+                     max_new_tokens=4), "exceeds max_len"),
+            (Request(rid=3, prompt=np.arange(4, dtype=np.int32),
+                     max_new_tokens=4,
+                     temperature=float("nan")), "temperature"),
+            (Request(rid=4, prompt=np.arange(4, dtype=np.int32),
+                     max_new_tokens=0), "max_new_tokens"),
+            (Request(rid=5, prompt=np.array([0, V], np.int32),
+                     max_new_tokens=4), "token ids outside"),
+            (Request(rid=6, prompt=np.array([0.5, 1.5], np.float32),
+                     max_new_tokens=4), "integer"),
+        ]
+        for req, why in bad:
+            with pytest.raises(RequestRejected, match=why):
+                eng.submit(req)
+        snap = monitor.snapshot()
+        assert snap["counters"]["serving.requests.rejected"] == len(bad)
+        assert len(eng.queue) == 0               # nothing leaked in
+        assert eng.cache.alloc.used_pages == 0
+
+    def test_submit_normalizes_coercible_fields(self):
+        """Coercible-but-wrong-typed fields (temperature='0.7') must be
+        written back normalized so they can't pass screening and still
+        detonate in the scheduler; a non-integral max_new_tokens (2.9
+        would silently budget as 2) is rejected outright."""
+        from paddle_tpu.inference.engine import Request, RequestRejected
+        eng, _ = self._engine()
+        req = Request(rid=1, prompt=list(range(4)), max_new_tokens=3,
+                      temperature="0.0")
+        eng.submit(req)
+        assert isinstance(req.prompt, np.ndarray)
+        assert req.max_new_tokens == 3 and req.temperature == 0.0
+        assert isinstance(req.temperature, float)
+        out = eng.run()
+        assert len(out[1].tokens) == 3           # served normally
+        with pytest.raises(RequestRejected, match="integral"):
+            eng.submit(Request(rid=2, prompt=np.arange(4, dtype=np.int32),
+                               max_new_tokens=2.9))
+
+    def test_engine_keeps_serving_after_poisoned_submit(self):
+        """The isolation pin: a poisoned submission must not perturb
+        the tokens of in-flight or subsequent requests — byte-identical
+        to a run that never saw the poison."""
+        from paddle_tpu.inference.engine import Request, RequestRejected
+
+        def reqs():
+            rng = np.random.default_rng(11)
+            return [Request(rid=i,
+                            prompt=rng.integers(0, V, (5 + i,))
+                            .astype(np.int32), max_new_tokens=6)
+                    for i in range(3)]
+
+        clean, _ = self._engine()
+        want = clean.run(reqs())
+
+        eng, _ = self._engine()
+        good = reqs()
+        eng.submit(good[0])
+        with pytest.raises(RequestRejected):
+            eng.submit(Request(rid=99, prompt=np.array([], np.int32),
+                               max_new_tokens=4))
+        eng.submit(good[1])
+        for _ in range(2):                       # poison mid-flight too
+            eng.step()
+        with pytest.raises(RequestRejected):
+            eng.submit(Request(rid=98,
+                               prompt=np.arange(99, dtype=np.int32) % V,
+                               max_new_tokens=1))
+        eng.submit(good[2])
+        got = eng.run()
+        for i in range(3):
+            np.testing.assert_array_equal(got[i].tokens, want[i].tokens)
+        eng.cache.alloc.check_invariants()
+        assert eng.cache.alloc.used_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# off-flag: zero registrations, zero extra device outputs
+# ---------------------------------------------------------------------------
+
+class TestOffFlagZeroOverhead:
+    def test_no_metric_registrations_off_flag(self):
+        """With FLAGS_enable_sentinel unset, building and running the
+        default train step + a fit-loop batch registers NOTHING under
+        train.anomaly.* / train.watchdog.* (monitor itself on)."""
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, donate=False)
+        step(params, opt, _batch(0))
+        assert S.guard_eager_update(object.__new__(object), []) is False
+        snap = monitor.snapshot()
+        names = (list(snap.get("counters", {}))
+                 + list(snap.get("gauges", {}))
+                 + list(snap.get("histograms", {})))
+        assert not [n for n in names
+                    if n.startswith(("train.anomaly.",
+                                     "train.watchdog."))]
+
+    def test_no_step_listeners_by_default(self):
+        from paddle_tpu.monitor import steptimer as st
+        assert st._STEP_LISTENERS == []
+
+
+# ---------------------------------------------------------------------------
+# multi-host skip agreement (launch CLI, 2 processes)
+# ---------------------------------------------------------------------------
+
+class TestMultiHostAgreement:
+    @pytest.mark.slow  # tier-1 budget: multi-process world, slow lane
+    def test_any_rank_anomalous_all_ranks_skip(self, tmp_path):
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_sentinel_agree_worker.py")
+        log_dir = str(tmp_path / "logs")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", log_dir, worker],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+        logs = {}
+        for rank in range(2):
+            p = os.path.join(log_dir, f"workerlog.{rank}")
+            logs[rank] = open(p).read() if os.path.exists(p) else ""
+        blob = logs[0] + logs[1]
+        assert r.returncode == 0, blob[-4000:]
+        for rank in range(2):
+            # only rank 0 was LOCALLY anomalous; both must skip
+            assert f"VERDICT1 rank={rank} skip" in blob, blob[-4000:]
+            assert f"VERDICT2 rank={rank} ok" in blob
+            assert f"VERDICT3 rank={rank} ok" in blob
+        # gathered-max norms keep the detector state bit-identical
+        stats = sorted(l for l in blob.splitlines()
+                       if l.startswith("STATS"))
+        assert len(stats) == 2
+        s0 = stats[0].split(" ", 2)[2]
+        s1 = stats[1].split(" ", 2)[2]
+        assert s0 == s1, (s0, s1)
