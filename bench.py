@@ -290,6 +290,15 @@ def _metrics_summary():
                 "tokens_padding": c.get("packing.tokens.padding", 0),
                 "varlen_dispatch": _varlen_dispatch_counters(),
             },
+            # operator plane (monitor/memory.py + monitor/programs.py):
+            # HBM occupancy at end of run (empty on backends that
+            # report nothing — never fabricated) and the compiled-
+            # program introspection registry's totals
+            "hbm": monitor.memory.update_hbm_gauges()["totals"],
+            "programs": {
+                "count": len(monitor.programs.programs_snapshot()),
+                "flops_total": c.get("jit.program.flops", 0),
+            },
             "snapshot": monitor.dump_json(
                 run_id=f"bench-{os.getpid()}-{int(time.time())}"),
         }

@@ -99,6 +99,16 @@ define_flag("enable_sentinel", False,
             "losses (any model). Other families (dit, ocr) are not yet "
             "guarded. Off = one cached branch, zero extra device "
             "outputs.")
+define_flag("enable_monitor_server", False,
+            "Serve the operator plane (paddle_tpu.monitor.server): an "
+            "HTTP daemon with /metrics (Prometheus text), /healthz "
+            "(liveness), /flight, /programs and /memory, started by the "
+            "ServingEngine / SentinelLoop / hapi fit entrypoints. Off "
+            "(the default) = one cached branch, no thread, no socket.")
+define_flag("monitor_server_port", 0,
+            "Port for the operator-plane HTTP server (binds 127.0.0.1; "
+            "override host with PADDLE_TPU_MONITOR_HOST). 0 = an "
+            "ephemeral port, exposed on the server object for tests.")
 define_flag("fault_injection", "",
             "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
             " armed at import by paddle_tpu.testing.faults (actions: "

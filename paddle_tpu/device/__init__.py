@@ -257,3 +257,4 @@ def synchronize(device=None):
 
 
 from . import cuda  # noqa: E402,F401
+from . import memory  # noqa: E402,F401

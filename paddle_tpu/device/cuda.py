@@ -58,8 +58,9 @@ class _Props:
 
 
 def _stats(device=None):
+    from .memory import memory_stats
     try:
-        return _dev(device).memory_stats() or {}
+        return memory_stats(_dev(device))
     except Exception:
         return {}
 

@@ -1,0 +1,61 @@
+"""Backend-safe device memory statistics.
+
+Reference capability: paddle/phi/core/memory/stats.h surfaced through
+``paddle.device.cuda.memory_allocated`` & friends. On this runtime the
+allocator belongs to XLA, and what it reports varies by backend: TPU
+PJRT clients return a populated ``memory_stats()`` dict
+(``bytes_in_use``, ``bytes_limit``, ``peak_bytes_in_use``, ...), the
+CPU client returns ``None``, and a plugin backend may return a partial
+dict or raise. Every consumer in this repo — ``device/cuda.py``'s
+paddle-parity queries and ``monitor/memory.py``'s ``device.hbm.*``
+gauges — goes through this one helper so the contract lives in one
+place:
+
+- **never raises** (a telemetry read must not take down a serving
+  loop);
+- **never fabricates**: a backend that reports nothing yields ``{}``,
+  and callers emit *no* gauges for it rather than zeros that would
+  read as "this device has 0 bytes of HBM".
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+__all__ = ["memory_stats", "all_memory_stats"]
+
+
+def memory_stats(device=None) -> dict:
+    """``device.memory_stats()`` as a plain dict; ``{}`` when the
+    backend reports nothing (CPU), the device is missing, or the query
+    raises. ``device`` may be a jax device, an int index into
+    ``jax.local_devices()``, or None (first local device)."""
+    try:
+        if device is None or isinstance(device, int):
+            devs = jax.local_devices()
+            if not devs:
+                return {}
+            idx = 0 if device is None else min(int(device), len(devs) - 1)
+            device = devs[idx]
+        stats = device.memory_stats()
+    except Exception:
+        return {}
+    if not stats:                      # None or {} — backend says nothing
+        return {}
+    try:
+        return dict(stats)
+    except Exception:
+        return {}
+
+
+def all_memory_stats() -> List[dict]:
+    """One ``memory_stats`` dict per *local* device, in device order —
+    devices that report nothing contribute ``{}`` (so indices still
+    line up with ``jax.local_devices()``). ``[]`` when device discovery
+    itself fails."""
+    try:
+        devs = jax.local_devices()
+    except Exception:
+        return []
+    return [memory_stats(d) for d in devs]

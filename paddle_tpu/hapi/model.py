@@ -154,7 +154,12 @@ class Model:
         # BETWEEN the timed phases, bills itself here through the
         # ambient-phase seam — and releases it when fit returns.
         from .. import monitor as _monitor
+        from ..monitor import server as _mserver
         from ..testing import faults as _faults
+        # Operator plane: a fit loop is a long-running entrypoint, so
+        # it starts the telemetry server when FLAGS_enable_monitor_
+        # server is set (one cached branch otherwise)
+        _mserver.maybe_start()
         stim = _monitor.StepTimer("hapi.fit")
         with stim:
             for epoch in range(epochs):

@@ -56,6 +56,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
+import weakref
 from collections import deque
 from functools import partial
 from typing import Dict, List, Optional
@@ -66,9 +67,31 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..core import enforce as E
+from ..monitor import server as _mserver
 from ..monitor import trace as _trace
 from ..monitor.registry import LATENCY_BUCKETS_MS as _LATENCY_BUCKETS_MS
 from .paged import PagedKVCache, paged_decode_step, paged_prefill
+
+
+def _engine_health_provider(ref):
+    """``/healthz`` contributor over a weakly-held engine: queue depth,
+    slot occupancy, page-pool pressure. Returns None once the engine is
+    garbage-collected (the server prunes the entry). Always ``ok`` —
+    a deep queue is backpressure, not a liveness failure."""
+    def provide():
+        eng = ref()
+        if eng is None:
+            return None
+        return {
+            "ok": True,
+            "queue_depth": len(eng.queue),
+            "slots_live": sum(1 for s in eng.slots if s is not None),
+            "num_slots": eng.num_slots,
+            "pages_free": eng.cache.alloc.free_pages,
+            "pages_total": eng.cache.num_pages,
+            "requests_completed": eng.stats.completed,
+        }
+    return provide
 
 def _observe_latency(name: str, ms: float, doc: str):
     _monitor.observe(name, ms, doc=doc, buckets=_LATENCY_BUCKETS_MS)
@@ -272,6 +295,44 @@ class ServingEngine:
         _monitor.set_gauge("serving.pages.total",
                            self.cache.num_pages,
                            doc="KV page pool capacity")
+        # Operator plane: start the telemetry server when its flag is
+        # set (one cached branch otherwise) and contribute this
+        # engine's scheduler state to /healthz. The provider holds the
+        # engine WEAKLY — a retired engine prunes itself, never pins —
+        # and registers only while some plane could read it (monitor on
+        # or server flag/running): a fully-off process must not grow
+        # the provider map one entry per engine, ever.
+        # Process-unique uid (GIL-atomic counter, monitor/programs.py)
+        # keys both the /healthz provider name ("serving:<n>" — two
+        # engines must not evict each other's view) and the
+        # introspection-registry records (which outlive the engine —
+        # id(self) reuse must not alias a successor onto stale ones).
+        _mserver.maybe_start()
+        self._engine_uid = _monitor.programs.next_uid()
+        if _monitor.enabled() or _mserver.plane_active():
+            _mserver.register_health_provider(
+                f"serving:{self._engine_uid}",
+                _engine_health_provider(weakref.ref(self)))
+
+    def _record_serving_program(self, spec_key, name, jitted, args,
+                                kwargs, donated=()):
+        """Register a serving program with the introspection registry
+        (monitor/programs.py) once per specialization — signature,
+        donation map, cost-analysis FLOPs (one re-trace), and a lazy
+        memory analyzer the ``/programs`` endpoint resolves. The
+        registry ITSELF is the dedup (not an engine-local set): after
+        a ``monitor.reset()`` mid-run the next dispatch re-registers,
+        so the scrape endpoints and the headroom estimate's temp
+        reservation recover instead of staying empty forever. The
+        per-dispatch cost after the first is one locked dict lookup,
+        monitor-on only."""
+        from ..monitor import programs as _programs
+        key = ("engine", self._engine_uid) + spec_key
+        if _programs.has_record(key):
+            return
+        _programs.record_jit_call(key, name, jitted, args,
+                                  kwargs=kwargs, source="serving",
+                                  donated=donated)
 
     # -- submission ---------------------------------------------------------
 
@@ -559,13 +620,22 @@ class ServingEngine:
             keys[j] = slot.keys[0]
             slots.append(slot)
         sampled = any(r.temperature > 0 for r in group)
+        pf = self._prefill_fn(g, s_pad, sampled)
+        pf_args = (self.params, jnp.asarray(ids), self.cache.pool["k"],
+                   self.cache.pool["v"])
+        pf_kwargs = dict(page_rows=jnp.asarray(rows),
+                         slen=jnp.asarray(slen), temp=jnp.asarray(temps),
+                         key=jnp.asarray(keys))
+        if mon:
+            # introspection-registry record, BEFORE the dispatch that
+            # donates the pool buffers (once per specialization)
+            self._record_serving_program(
+                ("serving.prefill", g, s_pad, sampled),
+                f"serving.prefill[g{g},s{s_pad}]", pf, pf_args,
+                pf_kwargs, donated=(2, 3))
         with _trace.span("serving.prefill", group=len(group),
                          s_pad=s_pad):
-            pk, pv, tok_a = self._prefill_fn(g, s_pad, sampled)(
-                self.params, jnp.asarray(ids), self.cache.pool["k"],
-                self.cache.pool["v"], page_rows=jnp.asarray(rows),
-                slen=jnp.asarray(slen), temp=jnp.asarray(temps),
-                key=jnp.asarray(keys))
+            pk, pv, tok_a = pf(*pf_args, **pf_kwargs)
             self.cache.pool = {"k": pk, "v": pv}
             # the np.asarray download syncs the device — the span ends
             # (and TTFT is stamped) when the first token actually EXISTS
@@ -714,13 +784,20 @@ class ServingEngine:
             keys = self._zero_keys[C]  # greedy: keys are never read
 
         d = self._dev
+        ck = self._chunk_fns[(C, self._sampled)]
+        ck_args = (self.params, self.cache.pool["k"],
+                   self.cache.pool["v"], d["bt"], d["tokens"],
+                   d["kv_len"], d["done"], d["gen"], keys, d["temps"],
+                   d["max_new"], d["eos"])
+        if _monitor.enabled():
+            self._record_serving_program(
+                ("serving.decode_chunk", C, self._sampled),
+                f"serving.decode_chunk[c{C}"
+                f"{',sampled' if self._sampled else ''}]",
+                ck, ck_args, None, donated=(1, 2))
         with _trace.span("serving.decode_chunk", chunk=C,
                          live=len(live_idx)):
-            pk, pv, tok, kvl, done_a, gen_a, emitted = self._chunk_fns[
-                (C, self._sampled)](
-                self.params, self.cache.pool["k"], self.cache.pool["v"],
-                d["bt"], d["tokens"], d["kv_len"], d["done"], d["gen"],
-                keys, d["temps"], d["max_new"], d["eos"])
+            pk, pv, tok, kvl, done_a, gen_a, emitted = ck(*ck_args)
             self.cache.pool = {"k": pk, "v": pv}
             self._dev.update(tokens=tok, kv_len=kvl, done=done_a,
                              gen=gen_a)

@@ -153,6 +153,11 @@ class StaticFunction:
         self._eager_keys: set = set()
         self._segmented_keys: set = set()
         self._segmented = None
+        # introspection-registry identity, assigned on first use: the
+        # registry's records outlive this object, so they are keyed by
+        # a process-unique uid, never id(self) (address reuse would
+        # alias a successor function onto stale records)
+        self._registry_uid = None
         functools.update_wrapper(self, fn)
 
     @staticmethod
@@ -511,6 +516,8 @@ class StaticFunction:
         elif _monitor.enabled():
             _monitor.inc("jit.cache.hit",
                          doc="to_static program-cache hits")
+            from ..monitor import programs as _programs
+            _programs.note_hit(self._registry_key(key))
 
         named_params = self._named_params()
         named_buffers = self._named_buffers()
@@ -533,12 +540,16 @@ class StaticFunction:
         if not need_grad:
             flat_out, new_buffers = prog.jitted(
                 param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
-            self._note_compile(t_compile)
+            compile_ms = self._note_compile(t_compile)
             if t_compile is not None:
                 from ..monitor import mfu as _mfu
-                _mfu.record_program_flops(_mfu.lowered_flops(
+                flops = _mfu.lowered_flops(
                     prog.jitted, param_arrays, buffer_arrays,
-                    arg_arrays, kwarg_arrays), source="to_static")
+                    arg_arrays, kwarg_arrays)
+                _mfu.record_program_flops(flops, source="to_static")
+                self._register_program(
+                    key, prog, compile_ms, flops, param_arrays,
+                    buffer_arrays, arg_arrays, kwarg_arrays)
         else:
             train_names = [n for n, _ in trainable]
             diff_idx = [i for i, _ in diff_args]
@@ -556,7 +567,7 @@ class StaticFunction:
             diff_arg_arrays = tuple(a._data for _, a in diff_args)
             (flat_out, new_buffers), vjp_fn = jax.vjp(
                 closed, train_arrays, diff_arg_arrays)
-            self._note_compile(t_compile)
+            compile_ms = self._note_compile(t_compile)
             if t_compile is not None:
                 # MFU accounting must count what a TRAINING call
                 # executes — forward AND backward — so lower the same
@@ -583,6 +594,9 @@ class StaticFunction:
                         prog.jitted, param_arrays, buffer_arrays,
                         arg_arrays, kwarg_arrays)
                 _mfu.record_program_flops(flops, source="to_static")
+                self._register_program(
+                    key, prog, compile_ms, flops, param_arrays,
+                    buffer_arrays, arg_arrays, kwarg_arrays)
 
             input_tensors = [p for _, p in trainable] + \
                 [a for _, a in diff_args]
@@ -614,18 +628,51 @@ class StaticFunction:
     @staticmethod
     def _note_compile(t_compile):
         """Observe trace+compile latency for a cache-miss call (timed
-        through the first execution, where jax.jit actually compiles).
-        The caller follows up with the MFU capture — the new program's
-        XLA-cost-analysis FLOPs into ``jit.program.flops`` (one extra
-        re-trace + HLO lowering per compile; no second XLA compile —
-        see monitor/mfu.py) — lowering the grad-path vjp composition
-        where one exists so training programs count fwd+bwd FLOPs."""
+        through the first execution, where jax.jit actually compiles);
+        returns the ms (None on cache hits). The caller follows up with
+        the MFU capture — the new program's XLA-cost-analysis FLOPs
+        into ``jit.program.flops`` (one extra re-trace + HLO lowering
+        per compile; no second XLA compile — see monitor/mfu.py) —
+        lowering the grad-path vjp composition where one exists so
+        training programs count fwd+bwd FLOPs — and the introspection-
+        registry record (``_register_program``)."""
         if t_compile is None:
-            return
+            return None
+        ms = (time.perf_counter() - t_compile) * 1e3
         _monitor.observe(
-            "jit.compile_ms", (time.perf_counter() - t_compile) * 1e3,
+            "jit.compile_ms", ms,
             doc="to_static trace+compile wall time per cache miss",
             buckets=tuple(float(10 ** i) / 10 for i in range(9)))
+        return ms
+
+    def _registry_key(self, key):
+        if self._registry_uid is None:
+            from ..monitor import programs as _programs
+            self._registry_uid = _programs.next_uid()
+        return ("to_static", self._registry_uid, key)
+
+    def _register_program(self, key, prog, compile_ms, flops,
+                          param_arrays, buffer_arrays, arg_arrays,
+                          kwarg_arrays):
+        """Feed the compiled-program introspection registry
+        (monitor/programs.py) at the cache-miss seam: name, input
+        signature, compile wall-ms, analyzed FLOPs, and a LAZY memory
+        analyzer over the forward program's avals (the ``/programs``
+        endpoint pays the one AOT compile, not this call). Grad-path
+        programs record the forward program's memory breakdown — the
+        executable this cache actually holds."""
+        from ..monitor import programs as _programs
+        args = (param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
+        _programs.record_program(
+            self._registry_key(key),
+            getattr(self._fn, "__name__", "to_static"),
+            source="to_static",
+            signature=_programs.signature_of((arg_arrays, kwarg_arrays)),
+            donated=(),
+            compile_ms=round(compile_ms, 3)
+            if compile_ms is not None else None,
+            flops=flops,
+            analyzer=_programs.analyzer_for(prog.jitted, args))
 
     @property
     def concrete_programs(self):

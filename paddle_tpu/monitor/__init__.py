@@ -47,6 +47,8 @@ __all__ = [
     "snapshot", "expose_text", "dump_json", "reset",
     "record_op", "tensor_bytes", "tensor_free",
     "trace", "mfu", "StepTimer", "ambient_phase",
+    "server", "programs", "memory", "fleet",
+    "start_server", "stop_server",
 ]
 
 # The one process-global registry (monitor.h StatRegistry::Instance()).
@@ -192,6 +194,8 @@ def reset():
     _TENSOR_GAUGES.clear()
     _TENSOR_EPOCH[0] += 1
     trace.clear()
+    programs.reset()
+    fleet.reset()
 
 
 class timed:
@@ -225,3 +229,10 @@ __all__.append("timed")
 from . import mfu  # noqa: E402
 from . import trace  # noqa: E402
 from .steptimer import StepTimer, ambient_phase  # noqa: E402
+# Operator plane (PR 7): program/HBM introspection, fleet aggregation,
+# and the flag-gated HTTP server that exposes it all.
+from . import fleet  # noqa: E402
+from . import memory  # noqa: E402
+from . import programs  # noqa: E402
+from . import server  # noqa: E402
+from .server import start_server, stop_server  # noqa: E402

@@ -23,7 +23,8 @@ from typing import Optional
 
 from .registry import StatRegistry
 
-__all__ = ["expose_text", "dump_json", "sanitize_name"]
+__all__ = ["expose_text", "dump_json", "sanitize_name", "escape_help",
+           "escape_label_value", "render_sample"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _FIRST_RE = re.compile(r"^[^a-zA-Z_:]")
@@ -35,6 +36,32 @@ def sanitize_name(name: str) -> str:
     if _FIRST_RE.match(out):
         out = "_" + out
     return out
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the text format 0.0.4: backslash and
+    newline (a doc string with a literal newline would otherwise split
+    into a second, unparseable line)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping: backslash, double-quote, newline — in that
+    order (escaping the escapes first keeps the round-trip exact)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_sample(name: str, labels, value) -> str:
+    """One exposition sample line, labels escaped:
+    ``name{k="v",...} value``. ``labels`` may be None/{}."""
+    n = sanitize_name(name)
+    if labels:
+        body = ",".join(
+            f'{sanitize_name(str(k))}="{escape_label_value(v)}"'
+            for k, v in labels.items())
+        return f"{n}{{{body}}} {_fmt(value)}"
+    return f"{n} {_fmt(value)}"
 
 
 def _fmt(v) -> str:
@@ -53,7 +80,7 @@ def expose_text(registry: StatRegistry) -> str:
     for m in registry.metrics():
         name = sanitize_name(m.name)
         if m.doc:
-            lines.append(f"# HELP {name} {m.doc}")
+            lines.append(f"# HELP {name} {escape_help(m.doc)}")
         lines.append(f"# TYPE {name} {m.kind}")
         if m.kind in ("counter", "gauge"):
             lines.append(f"{name} {_fmt(m.value)}")

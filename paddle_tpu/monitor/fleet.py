@@ -1,0 +1,207 @@
+"""Fleet-aggregated telemetry: cross-host snapshot gather + divergence.
+
+On a GSPMD fleet (PAPERS.md: GSPMD) per-host metrics are meaningless
+in isolation — 64 hosts each reporting a healthy grad-norm EMA can
+still hide one rank drifting, and summed serving throughput is the
+only number an autoscaler can act on. This module gathers every
+host's ``monitor.snapshot()`` through the PR 2 tagged-agreement-gather
+machinery (``distributed/checkpoint``'s own-KV-keys + generation
+reclamation — the same transport the checkpoint commit-status and
+sentinel agreement rides, so a week-long run's KV store stays
+bounded) and reduces them into min/max/sum/mean + per-host views with
+a **host-divergence** report: the metrics whose cross-host relative
+spread is largest, sorted — one rank's drifting EMA becomes the first
+line instead of invisible.
+
+:func:`aggregated_snapshot` is a COLLECTIVE — every host must call it
+at the same point in program order (a training loop step boundary, a
+serving-engine maintenance tick). The freshest result is cached; the
+operator-plane server (``/metrics?scope=fleet``) serves the cache so
+an HTTP scrape never blocks waiting for peers (a scrape-triggered
+gather would hang until every rank happened to call in). Single-host,
+the gather degenerates to the local snapshot and the endpoint computes
+it fresh per scrape.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import List, Optional
+
+__all__ = ["aggregated_snapshot", "last_aggregate", "aggregate_hosts",
+           "divergence", "expose_fleet_text"]
+
+_MU = threading.Lock()
+_LAST: list = [None]
+
+# Relative spread below this is float jitter, not divergence.
+_DIVERGENCE_FLOOR = 1e-9
+
+
+def _scalar_metrics(snap: dict) -> dict:
+    """{name: value} across the counters+gauges of one snapshot."""
+    out = {}
+    for kind in ("counters", "gauges"):
+        out.update(snap.get(kind, {}))
+    return out
+
+
+def aggregate_hosts(host_snaps: List[dict]) -> dict:
+    """Reduce per-host snapshots into
+    ``{"scalars": {name: {min,max,sum,mean,hosts:[...]}},
+    "histograms": {name: {count,sum,min,max}}}``. A metric missing on
+    some hosts aggregates over the hosts that have it (its ``hosts``
+    list carries None for the others — absence is visible, not
+    zero-filled)."""
+    scalars: dict = {}
+    names = []
+    per_host = [_scalar_metrics(s) for s in host_snaps]
+    for h in per_host:
+        for n in h:
+            if n not in scalars:
+                names.append(n)
+                scalars[n] = None
+    for name in names:
+        vals = [h.get(name) for h in per_host]
+        present = [v for v in vals if isinstance(v, (int, float))]
+        if not present:
+            continue
+        scalars[name] = {
+            "min": min(present),
+            "max": max(present),
+            "sum": sum(present),
+            "mean": sum(present) / len(present),
+            "hosts": vals,
+        }
+    scalars = {n: v for n, v in scalars.items() if v is not None}
+
+    hists: dict = {}
+    for snap in host_snaps:
+        for name, h in snap.get("histograms", {}).items():
+            if not isinstance(h, dict) or not h.get("count"):
+                continue
+            agg = hists.setdefault(name, {"count": 0, "sum": 0.0,
+                                          "min": None, "max": None})
+            agg["count"] += h["count"]
+            agg["sum"] += h.get("sum", 0.0)
+            for key, pick in (("min", min), ("max", max)):
+                v = h.get(key)
+                if v is not None:
+                    agg[key] = v if agg[key] is None else pick(agg[key], v)
+    return {"scalars": scalars, "histograms": hists}
+
+
+def divergence(agg: dict, top_n: int = 20) -> List[dict]:
+    """The fleet's most-divergent scalar metrics: relative cross-host
+    spread ``(max - min) / magnitude``, largest first — where the
+    magnitude is the largest |value| observed, not the mean (a gauge
+    legitimately straddling zero has mean ~0; dividing by it would
+    blow up to ~1e9 and bury the real drifting-rank metric this report
+    exists to surface — with |max| the ratio is bounded by 2). Counters
+    that legitimately differ (per-host token counts) show up too — the
+    operator reads the list with the metric semantics in mind; the
+    point is that NOTHING cross-host-skewed stays invisible."""
+    out = []
+    for name, s in agg.get("scalars", {}).items():
+        spread = s["max"] - s["min"]
+        denom = max(abs(s["max"]), abs(s["min"]), abs(s["mean"]),
+                    _DIVERGENCE_FLOOR)
+        rel = spread / denom
+        if rel > _DIVERGENCE_FLOOR:
+            out.append({"metric": name, "min": s["min"], "max": s["max"],
+                        "mean": s["mean"],
+                        "relative_spread": round(rel, 6)})
+    out.sort(key=lambda d: -d["relative_spread"])
+    return out[:top_n]
+
+
+def aggregated_snapshot(name: str = "monitor") -> dict:
+    """COLLECTIVE: gather every host's ``monitor.snapshot()`` (tagged
+    KV gather — own keys per exchange, generation-reclaimed) and
+    reduce. Every rank returns the same payload; the freshest one is
+    cached for :func:`last_aggregate` / the fleet scrape endpoint.
+    Single-process, no gather happens at all."""
+    import jax
+
+    from . import snapshot as _snapshot
+    from . import inc as _inc
+
+    local = _snapshot()
+    nproc = jax.process_count()
+    if nproc > 1:
+        from ..distributed import collective as _coll
+        from ..distributed.checkpoint import (
+            _begin_tagged_op_and_reclaim, _note_tagged_key)
+        stream = f"monitor:{name}"
+        gen = _begin_tagged_op_and_reclaim(stream)
+        tag = f"mon{zlib.crc32(name.encode()):08x}g{gen}"
+        snaps: list = []
+        _coll.all_gather_object(snaps, local, tag=tag)
+        _note_tagged_key(stream, tag)
+    else:
+        snaps = [local]
+    agg = aggregate_hosts(snaps)
+    payload = {
+        "kind": "paddle_tpu.fleet_snapshot",
+        "name": name,
+        "world_size": nproc,
+        "unix_time": round(time.time(), 3),
+        "hosts": snaps,
+        "aggregate": agg,
+        "divergence": divergence(agg),
+    }
+    with _MU:
+        _LAST[0] = payload
+    _inc("monitor.fleet.snapshots",
+         doc="cross-host aggregated snapshots gathered")
+    return payload
+
+
+def last_aggregate() -> Optional[dict]:
+    """The freshest :func:`aggregated_snapshot` payload, or None when
+    no collective has run yet this process."""
+    with _MU:
+        return _LAST[0]
+
+
+def reset():
+    with _MU:
+        _LAST[0] = None
+
+
+def expose_fleet_text(payload: dict) -> str:
+    """Prometheus text rendering of an aggregate payload: one gauge
+    family per scalar metric with ``agg="min|max|sum|mean"`` and
+    ``host="<rank>"`` labeled samples (label values escaped), plus
+    merged histogram count/sum. Aggregated series are exposed as
+    gauges — a cross-host min of a counter is not itself monotonic."""
+    from .exposition import escape_help, render_sample, sanitize_name
+
+    agg = payload.get("aggregate", {})
+    lines = [
+        "# HELP paddle_fleet_world_size hosts contributing to this "
+        "aggregate",
+        "# TYPE paddle_fleet_world_size gauge",
+        render_sample("paddle_fleet_world_size", None,
+                      payload.get("world_size", 1)),
+    ]
+    for name, s in agg.get("scalars", {}).items():
+        pname = sanitize_name(name)
+        lines.append(f"# HELP {pname} "
+                     f"{escape_help('fleet aggregate of ' + name)}")
+        lines.append(f"# TYPE {pname} gauge")
+        for key in ("min", "max", "sum", "mean"):
+            lines.append(render_sample(name, {"agg": key}, s[key]))
+        for rank, v in enumerate(s["hosts"]):
+            if v is not None:
+                lines.append(render_sample(name, {"host": str(rank)}, v))
+    for name, h in agg.get("histograms", {}).items():
+        pname = sanitize_name(name)
+        lines.append(f"# HELP {pname} "
+                     f"{escape_help('fleet-merged histogram of ' + name)}")
+        lines.append(f"# TYPE {pname} gauge")
+        for key in ("count", "sum", "min", "max"):
+            if h.get(key) is not None:
+                lines.append(render_sample(name, {"agg": key}, h[key]))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,155 @@
+"""HBM gauges + serving headroom estimate.
+
+The ROADMAP's SLO-aware-scheduling and elastic-autoscaling items (1/5)
+both need one question answered continuously: *how much accelerator
+memory is left, and how many more sequences could this process admit?*
+Two inputs, both already measured elsewhere, composed here:
+
+- **Device bytes** from the backend-safe ``device/memory.py`` helper
+  (TPU PJRT reports ``bytes_in_use``/``bytes_limit``/``peak_bytes_in_
+  use``; CPU reports nothing). :func:`update_hbm_gauges` folds them —
+  summed across local devices that REPORT — into ``device.hbm.*``
+  gauges. Backends that report nothing emit **no** gauges: a zero here
+  would read as "0 bytes of HBM", which is fabrication, not telemetry.
+- **Page-pool utilization** (``serving.pages.total|in_use`` gauges the
+  engine already maintains) and the **largest analyzed per-program
+  temp footprint** (``monitor/programs.py``): free HBM minus the temp
+  high-water a decode/prefill dispatch will claim is the memory
+  actually available for NEW KV pages — the admission-policy feed.
+
+Everything here is pull-shaped: the ``/metrics`` and ``/memory``
+endpoints (monitor/server.py) call :func:`update_hbm_gauges` /
+:func:`headroom` per scrape, so the gauges are fresh at scrape time
+and cost nothing between scrapes. Callers gate on
+``monitor.enabled()`` for gauge emission; the plain dict readers work
+regardless (engine.stats discipline).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+__all__ = ["hbm_stats", "update_hbm_gauges", "headroom"]
+
+# The PJRT memory_stats keys worth exporting, each summed across the
+# local devices that report it.
+_HBM_KEYS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
+
+
+def hbm_stats(stats_fn: Optional[Callable[[], List[dict]]] = None
+              ) -> dict:
+    """Per-device memory stats + cross-device sums.
+
+    Returns ``{"devices": [per-device dicts], "totals": {key: sum},
+    "devices_reporting": n}`` — ``totals`` only contains keys at least
+    one device reported, and is ``{}`` on backends that report nothing
+    (CPU). ``stats_fn`` injects a fake reading for tests."""
+    if stats_fn is None:
+        from ..device.memory import all_memory_stats as stats_fn
+    per_dev = stats_fn()
+    totals: dict = {}
+    reporting = 0
+    for st in per_dev:
+        if not st:
+            continue
+        reporting += 1
+        for key in _HBM_KEYS:
+            if key in st:
+                try:
+                    totals[key] = totals.get(key, 0) + int(st[key])
+                except (TypeError, ValueError):
+                    pass
+    return {"devices": per_dev, "totals": totals,
+            "devices_reporting": reporting}
+
+
+def update_hbm_gauges(stats_fn=None) -> dict:
+    """Refresh the ``device.hbm.*`` gauges from a fresh backend read
+    and return the :func:`hbm_stats` payload. Gauges are only written
+    for keys the backend actually reported — never fabricated — and
+    only while the monitor flag is on (``set_gauge`` self-gates)."""
+    from . import set_gauge as _set_gauge
+
+    stats = hbm_stats(stats_fn)
+    totals = stats["totals"]
+    if not totals:
+        return stats
+    docs = {
+        "bytes_in_use": "device bytes allocated (summed across local "
+                        "devices that report)",
+        "bytes_limit": "device memory capacity (summed across local "
+                       "devices that report)",
+        "peak_bytes_in_use": "high-water mark of device bytes "
+                             "allocated (summed across local devices)",
+    }
+    for key, v in totals.items():
+        _set_gauge(f"device.hbm.{key}", v, doc=docs.get(key, ""))
+    _set_gauge("device.hbm.devices_reporting",
+               stats["devices_reporting"],
+               doc="local devices whose backend reports memory stats")
+    free = totals.get("bytes_limit", 0) - totals.get("bytes_in_use", 0)
+    if "bytes_limit" in totals and "bytes_in_use" in totals:
+        _set_gauge("device.hbm.headroom_bytes", max(free, 0),
+                   doc="bytes_limit - bytes_in_use across reporting "
+                       "devices (before per-program temp reservation)")
+    return stats
+
+
+def _gauge_value(name: str):
+    from . import _REGISTRY
+    m = _REGISTRY.get(name)
+    return m.value if m is not None else None
+
+
+def headroom(stats_fn=None) -> dict:
+    """The admission-policy composition: page-pool slack x HBM slack x
+    per-program temp reservation.
+
+    Returns a dict with whatever components are measurable right now
+    (absent backends/pools contribute ``None``, never fake zeros):
+
+    - ``pages_total`` / ``pages_in_use`` / ``pages_free_fraction`` —
+      from the serving gauges (None before any engine exists);
+    - ``hbm_free_bytes`` — limit minus in-use, when the backend
+      reports;
+    - ``program_temp_bytes_max`` — the largest analyzed program's temp
+      claim (0 until ``/programs`` or ``/metrics`` triggered analysis);
+    - ``est_admittable_bytes`` — HBM free minus the temp reservation,
+      the bytes genuinely available for new KV pages.
+
+    Also refreshes the ``serving.headroom.pages_free_fraction`` gauge
+    when a pool exists (monitor-gated)."""
+    from . import set_gauge as _set_gauge
+    from . import programs as _programs
+
+    stats = update_hbm_gauges(stats_fn)
+    totals = stats["totals"]
+    # the full per-device payload rides along so a consumer showing
+    # both (the /memory endpoint) reads the backend exactly once and
+    # the two views can never disagree
+    out: dict = {"devices_reporting": stats["devices_reporting"],
+                 "hbm": stats}
+
+    total = _gauge_value("serving.pages.total")
+    in_use = _gauge_value("serving.pages.in_use")
+    out["pages_total"] = total
+    out["pages_in_use"] = in_use
+    if total:
+        frac = max(total - (in_use or 0), 0) / total
+        out["pages_free_fraction"] = round(frac, 4)
+        _set_gauge("serving.headroom.pages_free_fraction",
+                   round(frac, 4),
+                   doc="free fraction of the serving KV page pool")
+    else:
+        out["pages_free_fraction"] = None
+
+    temp_max = _programs.max_temp_bytes()
+    out["program_temp_bytes_max"] = temp_max
+
+    if "bytes_limit" in totals and "bytes_in_use" in totals:
+        free = max(totals["bytes_limit"] - totals["bytes_in_use"], 0)
+        out["hbm_free_bytes"] = free
+        out["est_admittable_bytes"] = max(free - temp_max, 0)
+    else:
+        out["hbm_free_bytes"] = None
+        out["est_admittable_bytes"] = None
+    return out

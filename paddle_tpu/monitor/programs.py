@@ -1,0 +1,339 @@
+"""Compiled-program registry: what did XLA actually build?
+
+PR 5 captured one number per compile (cost-analysis FLOPs into
+``jit.program.flops``). An operator of a live engine needs more than a
+total: *which* programs exist, what shapes they were specialized to,
+what they donate, how much HBM each one's temporaries claim, and which
+ones the cache actually serves. This module is that registry — a
+bounded process-global list of :class:`ProgramRecord`s fed from two
+seams:
+
+- ``jit/api.py``: every to_static program-cache miss calls
+  :func:`record_program` (and hits call :func:`note_hit`), so the
+  registry mirrors the reference's ``_ExecutorCache`` contents;
+- ``inference/engine.py``: the serving prefill/decode-chunk programs
+  register at first dispatch (monitor-gated, once per specialization).
+
+**Memory breakdown is lazy.** ``compiled.memory_analysis()`` needs a
+compiled executable, and re-compiling at the capture seam would double
+every compile's cost. Instead each record keeps a zero-cost *analyzer*
+closure over the jitted callable (weakly referenced — the registry
+must not pin dead programs) plus the call's ``ShapeDtypeStruct`` avals;
+:func:`analyze_pending` runs analyzers on demand — the ``/programs``
+and ``/metrics`` endpoints trigger it — paying one AOT lower+compile
+per program, once, only when an operator actually asks. Results cache
+on the record and feed the ``jit.program.last_*_bytes`` /
+``jit.program.temp_bytes.total`` gauges.
+
+Gating: callers gate on ``monitor.enabled()`` — with the flag off
+nothing records and the registry stays empty. ``monitor.reset()``
+clears it (generation-checked like the tensor gauges).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from typing import Callable, List, Optional
+
+__all__ = ["ProgramRecord", "record_program", "record_jit_call",
+           "note_hit", "has_record", "analyze_pending", "max_temp_bytes",
+           "programs_snapshot", "signature_of", "analyzer_for",
+           "next_uid", "reset"]
+
+# Bounded registry: a serving process cycling through prompt buckets
+# must not grow this without limit — oldest records evict FIFO.
+_MAX_RECORDS = 256
+
+_MU = threading.Lock()
+_RECORDS: List["ProgramRecord"] = []
+_BY_KEY: dict = {}
+_EVICTED = [0]
+# Serializes analyze_pending: concurrent scrapes must not duplicate
+# full AOT compiles of the same programs (see its docstring).
+_ANALYZE_MU = threading.Lock()
+
+# Process-unique monotonic ids for registry/provider keys. Owners
+# (StaticFunctions, engines, sentinel loops, watchdogs) key their
+# records by a uid instead of id(self): registry entries OUTLIVE their
+# owner, and CPython reuses addresses — a successor allocated at a
+# dead owner's address must never alias its stale records.
+# itertools.count.__next__ is C-implemented, so this is GIL-atomic
+# (two threads constructing owners concurrently cannot share a uid).
+_UID = itertools.count(1)
+
+
+def next_uid() -> int:
+    return next(_UID)
+
+
+class ProgramRecord:
+    """One compiled specialization. ``memory`` stays None until
+    :func:`analyze_pending` runs its analyzer (or the analyzer's
+    program died / failed to lower — then it stays None forever and
+    ``analyze_error`` says why)."""
+
+    __slots__ = ("key", "name", "source", "signature", "donated",
+                 "compile_ms", "flops", "hits", "created_unix",
+                 "memory", "analyze_error", "_analyzer")
+
+    def __init__(self, key, name: str, source: str, signature: str,
+                 donated=(), compile_ms: Optional[float] = None,
+                 flops: float = 0.0,
+                 analyzer: Optional[Callable[[], dict]] = None):
+        self.key = key
+        self.name = name
+        self.source = source
+        self.signature = signature
+        self.donated = tuple(donated)
+        self.compile_ms = compile_ms
+        self.flops = float(flops)
+        self.hits = 0
+        self.created_unix = round(time.time(), 3)
+        self.memory: Optional[dict] = None
+        self.analyze_error: Optional[str] = None
+        self._analyzer = analyzer
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "source": self.source,
+            "signature": self.signature,
+            "donated_args": list(self.donated),
+            "compile_ms": self.compile_ms,
+            "flops": self.flops,
+            "hits": self.hits,
+            "created_unix": self.created_unix,
+            "memory": self.memory,
+            **({"analyze_error": self.analyze_error}
+               if self.analyze_error else {}),
+        }
+
+
+def _sig_str(avals) -> str:
+    """Human-readable signature from a pytree of array-likes /
+    ShapeDtypeStructs: 'f32[4,128], i32[4]'."""
+    import jax
+    import jax.numpy as jnp
+
+    parts = []
+    for leaf in jax.tree_util.tree_leaves(avals):
+        try:
+            dt = jnp.result_type(leaf)
+            shape = ",".join(str(int(d)) for d in jnp.shape(leaf))
+            parts.append(f"{jnp.dtype(dt).name}[{shape}]")
+        except Exception:
+            parts.append(type(leaf).__name__)
+    return ", ".join(parts)
+
+
+def _avals_of(tree):
+    """ShapeDtypeStruct pytree mirroring ``tree`` — what the lazy
+    analyzer lowers with, so no concrete array is pinned alive."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(x):
+        return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+# memory_analysis() field -> short JSON key (the serialized HLO proto
+# and host-side fields are deliberately dropped: a scrape payload must
+# stay a few hundred bytes per program)
+_MEM_FIELDS = {
+    "temp_size_in_bytes": "temp_bytes",
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+}
+
+
+def _make_analyzer(jitted, avals_args: tuple, avals_kwargs: dict):
+    """Closure lowering+compiling ``jitted`` at ``avals`` to harvest
+    ``memory_analysis()``; holds the callable weakly where possible so
+    a dead StaticFunction's programs don't outlive it here."""
+    try:
+        ref = weakref.ref(jitted)
+        get = ref
+    except TypeError:
+        get = lambda: jitted  # noqa: E731  (C wrappers refuse weakrefs)
+
+    def analyze() -> dict:
+        fn = get()
+        if fn is None:
+            raise ReferenceError("program owner was garbage-collected")
+        ma = fn.lower(*avals_args, **avals_kwargs).compile() \
+               .memory_analysis()
+        out = {}
+        for attr, key in _MEM_FIELDS.items():
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[key] = int(v)
+        return out
+
+    return analyze
+
+
+def signature_of(tree) -> str:
+    """Public :func:`_sig_str`: dtype[shape] summary of a pytree."""
+    try:
+        return _sig_str(tree)
+    except Exception:
+        return ""
+
+
+def analyzer_for(jitted, args: tuple, kwargs: Optional[dict] = None):
+    """A lazy memory analyzer for ``jitted`` at the avals of these
+    concrete args, or None when avals can't be built."""
+    try:
+        return _make_analyzer(jitted, _avals_of(args),
+                              _avals_of(kwargs or {}))
+    except Exception:
+        return None
+
+
+def record_program(key, name: str, *, source: str, signature: str = "",
+                   donated=(), compile_ms: Optional[float] = None,
+                   flops: float = 0.0, analyzer=None) -> ProgramRecord:
+    """Register one freshly compiled program (callers gate on
+    ``monitor.enabled()``). Re-recording an existing key refreshes the
+    record in place (a StaticFunction re-tracing after enable_to_static
+    churn) rather than duplicating it."""
+    from . import set_gauge as _set_gauge
+
+    rec = ProgramRecord(key, name, source, signature, donated,
+                        compile_ms, flops, analyzer=analyzer)
+    with _MU:
+        old = _BY_KEY.pop(key, None)
+        if old is not None:
+            try:
+                _RECORDS.remove(old)
+            except ValueError:
+                pass
+        _RECORDS.append(rec)
+        _BY_KEY[key] = rec
+        while len(_RECORDS) > _MAX_RECORDS:
+            dead = _RECORDS.pop(0)
+            _BY_KEY.pop(dead.key, None)
+            _EVICTED[0] += 1
+        n = len(_RECORDS)
+    _set_gauge("jit.program.count",
+               n, doc="compiled programs in the introspection registry")
+    return rec
+
+
+def record_jit_call(key, name: str, jitted, args: tuple, *,
+                    kwargs: Optional[dict] = None, source: str = "jit",
+                    donated=(), compile_ms: Optional[float] = None
+                    ) -> ProgramRecord:
+    """Convenience for raw ``jax.jit`` call sites (the serving engine's
+    prefill/chunk programs): builds the signature + lazy analyzer from
+    the concrete call args, captures cost-analysis FLOPs (one re-trace,
+    no compile — feeds ``jit.program.flops`` so non-to_static programs
+    count too). Callers gate on ``monitor.enabled()``."""
+    from . import mfu as _mfu
+
+    kwargs = kwargs or {}
+    try:
+        avals_args = _avals_of(args)
+        avals_kwargs = _avals_of(kwargs)
+        analyzer = _make_analyzer(jitted, avals_args, avals_kwargs)
+        signature = _sig_str((args, kwargs))
+    except Exception:
+        analyzer, signature = None, ""
+    flops = _mfu.lowered_flops(jitted, *args, **kwargs)
+    if flops > 0:
+        _mfu.record_program_flops(flops, source=source)
+    return record_program(key, name, source=source, signature=signature,
+                          donated=donated, compile_ms=compile_ms,
+                          flops=flops, analyzer=analyzer)
+
+
+def note_hit(key):
+    """Count a program-cache hit against its record (no-op for keys
+    recorded before the registry existed / after eviction)."""
+    with _MU:
+        rec = _BY_KEY.get(key)
+        if rec is not None:
+            rec.hits += 1
+
+
+def has_record(key) -> bool:
+    with _MU:
+        return key in _BY_KEY
+
+
+def analyze_pending(max_n: int = 8) -> int:
+    """Run up to ``max_n`` pending memory analyzers (newest first — the
+    program an operator just compiled is the one they're asking about).
+    Each costs one AOT lower+compile; results cache on the record and
+    refresh the ``jit.program.*`` byte gauges. Returns how many ran.
+    Serialized under ``_ANALYZE_MU``: two concurrent scrapes must not
+    both compile the same programs (a duplicate analysis of a serving
+    program is seconds of wasted XLA work on TPU) — the second caller
+    blocks briefly and then sees the results already cached."""
+    from . import set_gauge as _set_gauge
+
+    with _ANALYZE_MU:
+        with _MU:
+            pending = [r for r in reversed(_RECORDS)
+                       if r.memory is None and r._analyzer is not None
+                       and r.analyze_error is None][:max_n]
+        ran = 0
+        for rec in pending:
+            try:
+                rec.memory = rec._analyzer()
+            except Exception as e:  # dead owner / unlowerable avals
+                rec.analyze_error = f"{type(e).__name__}: {e}"[:200]
+                continue
+            ran += 1
+            for key, gauge in (
+                    ("temp_bytes", "jit.program.last_temp_bytes"),
+                    ("argument_bytes",
+                     "jit.program.last_argument_bytes"),
+                    ("output_bytes", "jit.program.last_output_bytes")):
+                if key in rec.memory:
+                    _set_gauge(gauge, rec.memory[key],
+                               doc=f"XLA memory-analysis {key} of the "
+                                   "most recently analyzed program")
+        if ran:
+            with _MU:
+                total = sum(r.memory.get("temp_bytes", 0)
+                            for r in _RECORDS if r.memory)
+            _set_gauge("jit.program.temp_bytes.total", total,
+                       doc="summed XLA temp-buffer bytes across "
+                           "analyzed programs in the registry")
+        return ran
+
+
+def max_temp_bytes() -> int:
+    """Largest analyzed per-program temp footprint — the admission-
+    headroom input ``monitor/memory.py`` composes with the page pool."""
+    with _MU:
+        return max((r.memory.get("temp_bytes", 0) for r in _RECORDS
+                    if r.memory), default=0)
+
+
+def programs_snapshot(analyze: bool = False, max_analyze: int = 8
+                      ) -> List[dict]:
+    """JSON-safe record list, newest first (optionally running pending
+    analyzers first)."""
+    if analyze:
+        analyze_pending(max_analyze)
+    with _MU:
+        return [r.as_dict() for r in reversed(_RECORDS)]
+
+
+def evicted_count() -> int:
+    return _EVICTED[0]
+
+
+def reset():
+    with _MU:
+        _RECORDS.clear()
+        _BY_KEY.clear()
+        _EVICTED[0] = 0

@@ -1,0 +1,340 @@
+"""Operator-plane HTTP server: live telemetry endpoints.
+
+Everything the observability layer measures (PR 1 registry, PR 5
+traces/SLO histograms/MFU, PR 6 sentinel/watchdog) was in-process
+only — ``monitor.expose_text()`` existed and nothing served it. This
+module is the missing scrape target: a flag-gated stdlib
+``http.server`` daemon an operator's Prometheus / k8s probes hit:
+
+- ``GET /metrics`` — Prometheus text exposition 0.0.4 of the live
+  registry (refreshing the ``device.hbm.*`` gauges and running a
+  bounded batch of pending program memory analyses per scrape, so the
+  introspection gauges are fresh exactly when someone is looking).
+  ``?scope=fleet`` serves the cached cross-host aggregate
+  (``monitor/fleet.py``) with min/max/sum/per-host labeled series.
+- ``GET /healthz`` — JSON liveness: registered health providers
+  (hang-watchdog heartbeat age, sentinel ladder state, serving queue
+  depth). Any provider reporting ``ok: false`` — a blown watchdog
+  deadline — turns the response **503**, so a k8s-style liveness
+  probe restarts a wedged worker without custom glue.
+- ``GET /flight`` — the PR 5 flight record on demand (ring events +
+  full snapshot), without waiting for a crash.
+- ``GET /programs`` — the compiled-program registry
+  (``monitor/programs.py``): shapes, donation, compile ms, FLOPs,
+  hit counts, XLA memory breakdown (analyzed lazily, here).
+- ``GET /memory`` — per-device HBM stats + the serving headroom
+  estimate (``monitor/memory.py``).
+
+Gating & lifecycle: ``FLAGS_enable_monitor_server`` off (the default)
+means :func:`maybe_start` is ONE cached-flag branch — no thread, no
+socket. The entrypoints (ServingEngine, SentinelLoop, the hapi fit
+loop) call it; tests and bespoke loops call :func:`start_server`
+directly. Port 0 (the default) binds ephemeral with the bound port on
+``server.port``; the host is **127.0.0.1** unless
+``PADDLE_TPU_MONITOR_HOST`` overrides it — these endpoints expose
+operational detail and carry no auth, so exposing them beyond
+localhost is an explicit operator decision (front with a sidecar /
+network policy).
+
+Health providers: :func:`register_health_provider` maps a name to a
+zero-arg callable returning a dict (``ok`` defaults True). Owners that
+die (a test's engine) register through weakrefs and are pruned on
+read. A broken provider reports its error but does not fail liveness
+— a crashed *telemetry* hook must not get a healthy worker killed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..core import flags as _flags
+
+__all__ = ["start_server", "stop_server", "maybe_start", "get_server",
+           "bound_port", "plane_active", "register_health_provider",
+           "unregister_health_provider", "health", "MonitorServer"]
+
+_FLAG_SERVER = _flags.flag_info("enable_monitor_server")
+_PORT_FLAG = _flags.flag_info("monitor_server_port")
+
+_MU = threading.Lock()
+_SERVER: list = [None]
+
+_PROVIDERS_MU = threading.Lock()
+_HEALTH_PROVIDERS: Dict[str, Callable[[], Optional[dict]]] = {}
+
+# How many pending program memory-analyses one scrape may run (each is
+# an AOT lower+compile; bounding keeps scrape latency predictable — the
+# rest run on the next scrape).
+_ANALYZE_PER_SCRAPE = 8
+
+
+def plane_active() -> bool:
+    """True when the operator plane could serve a probe: the server
+    flag is set or a server is already running. Entrypoints whose
+    health providers are pruned only on reads (engine/sentinel
+    weakrefs) gate their registration on this OR on the monitor flag —
+    a fully-off process must register nothing, ever."""
+    return bool(_FLAG_SERVER.value) or _SERVER[0] is not None
+
+
+def _prune_dead_locked_snapshot():
+    """Snapshot the provider map, call each provider, and drop the
+    entries whose owner died (fn() -> None) — identity-checked, so a
+    provider RE-registered under the same name between the snapshot
+    and the pop is never deleted. Returns the live (name, fn, report)
+    triples plus the raising (name, error) pairs."""
+    with _PROVIDERS_MU:
+        items = list(_HEALTH_PROVIDERS.items())
+    live, errors, dead = [], [], []
+    for name, fn in items:
+        try:
+            rep = fn()
+        except Exception as e:
+            errors.append((name, f"{type(e).__name__}: {e}"[:200]))
+            continue
+        if rep is None:
+            dead.append((name, fn))
+            continue
+        live.append((name, fn, rep))
+    if dead:
+        with _PROVIDERS_MU:
+            for name, fn in dead:
+                if _HEALTH_PROVIDERS.get(name) is fn:
+                    _HEALTH_PROVIDERS.pop(name, None)
+    return live, errors
+
+
+def register_health_provider(name: str, fn: Callable[[], Optional[dict]]):
+    """Register/replace a ``/healthz`` contributor. ``fn()`` returns a
+    JSON-safe dict (key ``ok`` defaults True; False flips the endpoint
+    to 503) or None to self-prune (dead weakref owners). Each
+    registration also sweeps dead entries, so a loop creating engines
+    bounds the map by its LIVE owners even if no probe ever reads
+    it."""
+    with _PROVIDERS_MU:
+        _HEALTH_PROVIDERS[name] = fn
+    _prune_dead_locked_snapshot()
+
+
+def unregister_health_provider(name: str):
+    with _PROVIDERS_MU:
+        _HEALTH_PROVIDERS.pop(name, None)
+
+
+def health() -> tuple:
+    """``(all_ok, payload)`` across the registered providers. Providers
+    returning None are pruned (their owner died); providers raising are
+    reported but do NOT fail liveness."""
+    live, errors = _prune_dead_locked_snapshot()
+    providers = {}
+    ok = True
+    for name, _, rep in live:
+        providers[name] = rep
+        # falsy, not `is False`: a provider computing ok from a numpy
+        # bool (or 0) must still flip the probe
+        if not rep.get("ok", True):
+            ok = False
+    for name, err in errors:
+        providers[name] = {"error": err}
+    payload = {
+        "status": "ok" if ok else "unhealthy",
+        "pid": os.getpid(),
+        "unix_time": round(time.time(), 3),
+        "providers": providers,
+    }
+    return ok, payload
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the default handler logs every request to stderr — a scraper
+    # hitting /metrics every 15s must not spam a training log
+    def log_message(self, fmt, *args):
+        pass
+
+    server_version = "paddle-tpu-monitor"
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload):
+        self._send(code, json.dumps(payload, indent=1,
+                                    sort_keys=True).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802  (http.server API)
+        from . import inc as _inc
+        from . import observe as _observe
+
+        t0 = time.perf_counter()
+        url = urlparse(self.path)
+        route = url.path.rstrip("/") or "/"
+        try:
+            if route == "/metrics":
+                self._metrics(parse_qs(url.query))
+            elif route == "/healthz":
+                ok, payload = health()
+                self._send_json(200 if ok else 503, payload)
+            elif route == "/flight":
+                from . import trace as _trace
+                self._send_json(200, _trace.flight_payload(
+                    reason="operator_scrape"))
+            elif route == "/programs":
+                from . import programs as _programs
+                self._send_json(200, {
+                    "programs": _programs.programs_snapshot(
+                        analyze=True, max_analyze=_ANALYZE_PER_SCRAPE),
+                    "evicted": _programs.evicted_count(),
+                })
+            elif route == "/memory":
+                from . import memory as _memory
+                # one backend read: headroom() carries the hbm payload
+                # it already fetched, so the two blocks are consistent
+                hr = _memory.headroom()
+                self._send_json(200, {"hbm": hr.pop("hbm"),
+                                      "headroom": hr})
+            elif route == "/":
+                self._send_json(200, {
+                    "service": "paddle_tpu.monitor",
+                    "routes": ["/metrics", "/metrics?scope=fleet",
+                               "/healthz", "/flight", "/programs",
+                               "/memory"],
+                })
+            else:
+                self._send_json(404, {"error": f"no route {route!r}"})
+            _inc("monitor.server.requests",
+                 doc="operator-plane HTTP requests served")
+        except BrokenPipeError:
+            pass                     # scraper hung up mid-response
+        except Exception as e:
+            _inc("monitor.server.errors",
+                 doc="operator-plane requests that raised")
+            try:
+                self._send_json(500, {
+                    "error": f"{type(e).__name__}: {e}"[:400]})
+            except Exception:
+                pass
+        _observe("monitor.server.scrape_ms",
+                 (time.perf_counter() - t0) * 1e3,
+                 doc="wall time serving one operator-plane request")
+
+    def _metrics(self, query: dict):
+        from . import expose_text as _expose_text
+        from . import memory as _memory
+        from . import programs as _programs
+
+        scope = (query.get("scope") or ["process"])[0]
+        if scope == "fleet":
+            from . import fleet as _fleet
+            import jax
+
+            if jax.process_count() == 1:
+                # single host: the "gather" is local and cheap — compute
+                # fresh per scrape (a cached payload would freeze the
+                # fleet view at its first value)
+                payload = _fleet.aggregated_snapshot()
+            else:
+                payload = _fleet.last_aggregate()
+            if payload is None:
+                self._send_json(503, {
+                    "error": "no fleet aggregate published yet — "
+                             "aggregated_snapshot() is a collective the "
+                             "training/serving loop must call"})
+                return
+            body = _fleet.expose_fleet_text(payload)
+        else:
+            # scrape-time refresh: HBM gauges re-read the backend, and a
+            # bounded batch of pending program analyses runs so the
+            # jit.program.* byte gauges exist once someone is looking
+            _memory.update_hbm_gauges()
+            _programs.analyze_pending(_ANALYZE_PER_SCRAPE)
+            body = _expose_text()
+        self._send(200, body.encode(),
+                   "text/plain; version=0.0.4; charset=utf-8")
+
+
+class MonitorServer:
+    """One ``ThreadingHTTPServer`` + its serve thread (both daemonic:
+    an operator plane must never keep a finished job's process
+    alive)."""
+
+    def __init__(self, host: str, port: int):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        # 50ms shutdown poll (default 500ms): stop_server should not
+        # stall a test teardown or a SIGTERM drain half a second
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            daemon=True, name="paddle-tpu-monitor-server")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_server(port: Optional[int] = None,
+                 host: Optional[str] = None) -> MonitorServer:
+    """Start (or return the already-running) operator-plane server.
+    ``port`` defaults to ``FLAGS_monitor_server_port`` (0 =
+    ephemeral); ``host`` to ``PADDLE_TPU_MONITOR_HOST`` or
+    127.0.0.1."""
+    with _MU:
+        if _SERVER[0] is not None:
+            return _SERVER[0]
+        if host is None:
+            host = os.environ.get("PADDLE_TPU_MONITOR_HOST",
+                                  "127.0.0.1")
+        if port is None:
+            port = int(_PORT_FLAG.value)
+        srv = MonitorServer(host, port)
+        _SERVER[0] = srv
+        return srv
+
+
+def stop_server():
+    """Shut the server down and release the socket (idempotent)."""
+    with _MU:
+        srv = _SERVER[0]
+        _SERVER[0] = None
+    if srv is not None:
+        srv.close()
+
+
+def get_server() -> Optional[MonitorServer]:
+    return _SERVER[0]
+
+
+def bound_port() -> Optional[int]:
+    srv = _SERVER[0]
+    return srv.port if srv is not None else None
+
+
+def maybe_start() -> Optional[MonitorServer]:
+    """The entrypoint seam (ServingEngine / SentinelLoop / hapi fit):
+    starts the server iff ``FLAGS_enable_monitor_server`` is set. Off
+    path = this one cached-flag branch — no thread, no socket, no
+    registration."""
+    if not _FLAG_SERVER.value:
+        return None
+    try:
+        return start_server()
+    except OSError:
+        # a second process racing for a fixed port must not take down
+        # the training/serving loop it rides in
+        return None

@@ -47,7 +47,7 @@ from ..core import flags as _flags
 
 __all__ = [
     "span", "instant", "events", "clear", "capacity", "total_events",
-    "dump_flight_record", "export_chrome_trace",
+    "dump_flight_record", "flight_payload", "export_chrome_trace",
     "set_flight_record_path", "flight_record_path", "record_fault",
 ]
 
@@ -199,21 +199,14 @@ def flight_record_path() -> Optional[str]:
     return p or None
 
 
-def dump_flight_record(path: Optional[str] = None,
-                       reason: str = "manual") -> Optional[dict]:
-    """Write the black box: the ring's events plus a full
-    ``monitor.snapshot()``. ``path=None`` uses the armed destination
-    (no-op returning None when nothing is armed). The write is direct
-    (open/write/flush, no tmp+rename): this runs on crash paths where
-    a second syscall failing must not lose the payload, and a torn
-    file from a mid-write kill is still front-truncated-parseable by
-    forensic tooling — the alternative (rename) risks leaving NOTHING.
-    Returns the payload dict."""
-    path = path or flight_record_path()
-    if path is None:
-        return None
+def flight_payload(reason: str = "manual") -> dict:
+    """The flight-record payload WITHOUT writing it anywhere: the
+    ring's events plus a full ``monitor.snapshot()``. The on-demand
+    consumer is the operator-plane ``/flight`` endpoint (a live flight
+    record without waiting for a crash); ``dump_flight_record`` writes
+    the same shape on crash paths."""
     from . import snapshot as _snapshot
-    payload = {
+    return {
         "kind": "paddle_tpu.flight_record",
         "reason": reason,
         "pid": os.getpid(),
@@ -223,6 +216,21 @@ def dump_flight_record(path: Optional[str] = None,
         "events": events(),
         "metrics": _snapshot(),
     }
+
+
+def dump_flight_record(path: Optional[str] = None,
+                       reason: str = "manual") -> Optional[dict]:
+    """Write the black box (see :func:`flight_payload`). ``path=None``
+    uses the armed destination (no-op returning None when nothing is
+    armed). The write is direct (open/write/flush, no tmp+rename):
+    this runs on crash paths where a second syscall failing must not
+    lose the payload, and a torn file from a mid-write kill is still
+    front-truncated-parseable by forensic tooling — the alternative
+    (rename) risks leaving NOTHING. Returns the payload dict."""
+    path = path or flight_record_path()
+    if path is None:
+        return None
+    payload = flight_payload(reason)
     d = os.path.dirname(os.path.abspath(path))
     try:
         os.makedirs(d, exist_ok=True)

@@ -320,6 +320,31 @@ class AnomalySentinel:
         return anom, (max(norms) if norms else float("nan"))
 
 
+def _sentinel_health_provider(ref):
+    """``/healthz`` contributor over a weakly-held SentinelLoop: the
+    escalation-ladder state an operator reads before deciding whether a
+    fleet of skips is data rot or model divergence. A loop that burned
+    its rollback budget reports ``ok: false`` — it is alive but cannot
+    recover itself, exactly what a supervisor should replace."""
+    def provide():
+        loop = ref()
+        if loop is None:
+            return None
+        sent = loop.sentinel
+        return {
+            "ok": sent.rollbacks < sent.config.max_rollbacks,
+            "step": loop.step,
+            "applied": loop.applied,
+            "skipped": loop.skipped,
+            "consecutive_anomalies": sent.consecutive,
+            "anomalies": sent.anomalies,
+            "rollbacks": sent.rollbacks,
+            "max_rollbacks": sent.config.max_rollbacks,
+            "quarantined": len(sent.quarantine),
+        }
+    return provide
+
+
 class SentinelLoop:
     """Drive a GUARDED train step under an :class:`AnomalySentinel` —
     the functional-path loop the smoke/chaos harnesses and tests run.
@@ -350,6 +375,23 @@ class SentinelLoop:
         self.applied = 0
         self.skipped = 0
         self.last_loss: Optional[float] = None
+        # Operator plane: this is a long-running-loop entrypoint, so it
+        # starts the telemetry server when FLAGS_enable_monitor_server
+        # is set (one cached branch otherwise) and contributes the
+        # sentinel's ladder state to /healthz through a weakref (a
+        # finished loop prunes itself). Unique per-loop key — two
+        # loops must not evict each other's view — registered only
+        # while some plane could read it (a fully-off process must not
+        # grow the provider map).
+        from ..monitor import server as _mserver
+        import weakref
+        _mserver.maybe_start()
+        if _monitor.enabled() or _mserver.plane_active():
+            # process-unique uid (GIL-atomic, monitor/programs.py):
+            # two loops must not evict each other's /healthz view
+            _mserver.register_health_provider(
+                f"sentinel:{_monitor.programs.next_uid()}",
+                _sentinel_health_provider(weakref.ref(self)))
 
     def _state(self) -> Dict[str, Any]:
         return {"params": self.params, "opt": self.opt_state,
@@ -448,8 +490,10 @@ class HangWatchdog:
         self._fired = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._provider_key: Optional[str] = None
 
     def start(self) -> "HangWatchdog":
+        from ..monitor import server as _mserver
         from ..monitor import steptimer as _steptimer
         self._last = time.monotonic()
         _steptimer.add_step_listener(self.heartbeat)
@@ -457,7 +501,28 @@ class HangWatchdog:
             target=self._watch, daemon=True,
             name=f"sentinel-watchdog-{self.name}")
         self._thread.start()
+        # /healthz liveness: a blown heartbeat deadline flips the
+        # operator-plane endpoint to 503 (recomputed per probe, so a
+        # recovered loop reads healthy again without re-arming). The
+        # key carries a process-unique id (GIL-atomic counter): two
+        # watchdogs sharing a name (old loop draining while its
+        # replacement starts) must not have stop() unregister the
+        # SURVIVOR's provider. Bounded by live watchdogs — stop()
+        # removes exactly this instance's key.
+        self._provider_key = (f"watchdog:{self.name}:"
+                              f"{_monitor.programs.next_uid()}")
+        _mserver.register_health_provider(self._provider_key,
+                                          self._health)
         return self
+
+    def _health(self) -> dict:
+        age = time.monotonic() - self._last
+        return {
+            "ok": age <= self.deadline_s,
+            "last_heartbeat_age_s": round(age, 3),
+            "deadline_s": self.deadline_s,
+            "stalls": self.stalls,
+        }
 
     def heartbeat(self):
         """The step completed; push the deadline out. Re-arms after a
@@ -468,9 +533,13 @@ class HangWatchdog:
                      doc="step heartbeats fed to the hang watchdog")
 
     def stop(self):
+        from ..monitor import server as _mserver
         from ..monitor import steptimer as _steptimer
         self._stop.set()
         _steptimer.remove_step_listener(self.heartbeat)
+        if getattr(self, "_provider_key", None) is not None:
+            _mserver.unregister_health_provider(self._provider_key)
+            self._provider_key = None
         if self._thread is not None:
             self._thread.join(timeout=max(self.poll_s * 4, 1.0))
             self._thread = None

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression guard.
+
+The repo checks in one ``BENCH_r<NN>.json`` per round (the driver's
+end-of-round capture: a dict with the bench stdout in ``tail`` /
+``parsed``), but nothing ever READ the trajectory — a PR could halve
+decode throughput and tier-1 would stay green. This script is the
+guard:
+
+1. parse every ``BENCH_r*.json`` in round order, extracting the
+   allowlisted rungs (headline tokens/s plus the named sub-rungs the
+   bench embeds under ``extra`` — MoE, decode, serving, packing);
+   runs that failed (``value`` <= 0, an ``error`` field, or a dead
+   tunnel) are SKIPPED, not treated as zeros;
+2. the NEWEST successful run is the candidate; each rung's baseline is
+   the best of (a) every EARLIER successful run's value and (b) a
+   numeric entry in ``BASELINE.json``'s ``published`` map, when one
+   exists;
+3. fail (exit 1) when a candidate rung undercuts its baseline by more
+   than the noise tolerance (default 15% — container/bench spread is
+   ~10% per ROADMAP.md, and TPU-tunnel runs swing a few % more).
+
+All rungs are higher-is-better by construction of the allowlist; a
+rung missing from the newest run (bench evolved) is reported but not a
+failure, and with fewer than one successful prior run the guard
+passes trivially — it engages as the trajectory grows. Runs from
+tier-1 (tests/test_operator_plane.py) on the checked-in files and
+standalone::
+
+    python scripts/check_bench_regression.py [--tolerance 0.15] [-v]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# rung name -> dotted path into the parsed headline record. Only rungs
+# listed here are guarded (all higher-is-better); new bench rungs are
+# opted in deliberately, not guarded by accident.
+ALLOWLIST = {
+    "llama_train_tokens_per_sec_per_chip": "value",
+    "moe_train_tokens_per_sec": "extra.moe.tokens_per_sec",
+    "decode_tokens_per_sec": "extra.decode.decode_tokens_per_sec",
+    "int8_decode_tokens_per_sec": "extra.decode.int8_decode_tokens_per_sec",
+    "prefill_tokens_per_sec": "extra.decode.prefill_tokens_per_sec",
+    "serving_tokens_per_sec": "extra.serving_paged.serving_tokens_per_sec",
+    "packed_tokens_per_sec": "extra.training_packed.packed_tokens_per_sec",
+}
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _dig(record: dict, path: str):
+    cur = record
+    for seg in path.split("."):
+        if not isinstance(cur, dict) or seg not in cur:
+            return None
+        cur = cur[seg]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _headline_record(blob: dict):
+    """The headline bench JSON line of one BENCH_r file: ``parsed``
+    when the driver stored it, else the first parseable ``{"metric":
+    ...}`` line of ``tail``."""
+    parsed = blob.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    for line in (blob.get("tail") or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+    return None
+
+
+def extract_rungs(blob: dict, allowlist=None):
+    """{rung: value} for one BENCH_r blob, or None when the run failed
+    (no headline, an error field, or a non-positive headline value)."""
+    allowlist = allowlist if allowlist is not None else ALLOWLIST
+    rec = _headline_record(blob)
+    if rec is None or rec.get("error"):
+        return None
+    headline = rec.get("value")
+    if not isinstance(headline, (int, float)) or headline <= 0:
+        return None
+    out = {}
+    for rung, path in allowlist.items():
+        v = _dig(rec, path)
+        if v is not None and v > 0:
+            out[rung] = float(v)
+    return out or None
+
+
+def load_trajectory(root=REPO, allowlist=None):
+    """[(round_number, {rung: value})] for every successful checked-in
+    run, round-ascending. Self-measured / eager files are excluded by
+    the BENCH_r<NN>.json pattern."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rungs = extract_rungs(blob, allowlist)
+        if rungs:
+            out.append((int(m.group(1)), rungs))
+    out.sort()
+    return out
+
+
+def published_baselines(root=REPO, allowlist=None):
+    """Numeric entries of BASELINE.json's ``published`` map that name
+    an allowlisted rung (the map is empty today; the hook exists so a
+    hand-published number becomes part of the floor)."""
+    allowlist = allowlist if allowlist is not None else ALLOWLIST
+    try:
+        with open(os.path.join(root, "BASELINE.json"),
+                  encoding="utf-8") as f:
+            base = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    pub = base.get("published") or {}
+    return {k: float(v) for k, v in pub.items()
+            if k in allowlist and isinstance(v, (int, float)) and v > 0}
+
+
+def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
+    """Returns (ok, report_lines)."""
+    traj = load_trajectory(root, allowlist)
+    lines = []
+    if not traj:
+        lines.append("bench guard: no successful BENCH_r*.json run yet "
+                     "— nothing to guard (pass)")
+        return True, lines
+    newest_round, newest = traj[-1]
+    prior = traj[:-1]
+    floors: dict = dict(published_baselines(root, allowlist))
+    for _, rungs in prior:
+        for rung, v in rungs.items():
+            floors[rung] = max(floors.get(rung, 0.0), v)
+    if not floors:
+        lines.append(f"bench guard: r{newest_round:02d} is the first "
+                     "successful run — baseline established, nothing "
+                     "to compare (pass)")
+        return True, lines
+    ok = True
+    for rung, floor in sorted(floors.items()):
+        v = newest.get(rung)
+        if v is None:
+            lines.append(f"  ~ {rung}: absent from r{newest_round:02d} "
+                         f"(baseline {floor:.2f}) — not a failure")
+            continue
+        limit = floor * (1.0 - tolerance)
+        ratio = v / floor
+        if v < limit:
+            ok = False
+            lines.append(
+                f"  ✗ {rung}: {v:.2f} is {ratio:.3f}x of baseline "
+                f"{floor:.2f} — below the {1 - tolerance:.2f}x noise "
+                "floor: REGRESSION")
+        elif verbose:
+            lines.append(f"  ✓ {rung}: {v:.2f} vs baseline {floor:.2f} "
+                         f"({ratio:.3f}x)")
+    lines.insert(0, f"bench guard: r{newest_round:02d} vs "
+                    f"{len(prior)} prior run(s) + published floors, "
+                    f"tolerance {tolerance:.0%}: "
+                    f"{'ok' if ok else 'REGRESSION'}")
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO)
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="allowed fractional shortfall vs baseline "
+                         "(default 0.15)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    ok, lines = check(args.root, args.tolerance, verbose=args.verbose)
+    print("\n".join(lines))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -262,6 +262,62 @@ def main():
             f"leaked pages: {eng.cache.alloc.used_pages}"
         eng.cache.alloc.check_invariants()
 
+    @case("operator_scrape")
+    def _():
+        # the operator plane against the real chip: start the telemetry
+        # server, run a serving chunk, scrape /metrics + /healthz, and
+        # assert the text parses with the key gauges nonzero — the
+        # end-to-end proof an external Prometheus would see real numbers
+        import json as _json
+        import urllib.request
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import server as mon_server
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True})
+        try:
+            cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            eng = ServingEngine(L, params, cfg, num_slots=2, max_len=32,
+                                page_size=16, decode_chunk=2)
+            srv = mon_server.get_server()
+            assert srv is not None, "engine did not start the server"
+            outs = eng.run([Request(
+                rid=i, prompt=rng.integers(0, cfg.vocab_size, (6,))
+                .astype(np.int32), max_new_tokens=6) for i in range(3)])
+            assert len(outs) == 3
+            txt = urllib.request.urlopen(
+                f"{srv.url}/metrics", timeout=10).read().decode()
+            # parseable: every non-comment line is "name[{labels}] value"
+            samples = {}
+            for line in txt.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name, val = line.rsplit(" ", 1)
+                samples[name.split("{")[0]] = float(val)
+            for gauge in ("serving_tokens_generated",
+                          "serving_pages_total",
+                          "serving_latency_ttft_ms_count",
+                          "jit_program_flops"):
+                assert samples.get(gauge, 0) > 0, \
+                    f"{gauge} missing/zero in /metrics: " \
+                    f"{sorted(samples)[:40]}"
+            hz = urllib.request.urlopen(f"{srv.url}/healthz", timeout=10)
+            payload = _json.load(hz)
+            assert hz.status == 200 and payload["status"] == "ok"
+            assert any(k.startswith("serving:")
+                       for k in payload["providers"])
+            mem = _json.load(urllib.request.urlopen(
+                f"{srv.url}/memory", timeout=10))
+            if on_tpu:   # the TPU PJRT client reports memory_stats
+                assert mem["hbm"]["totals"].get("bytes_in_use", 0) > 0
+        finally:
+            mon_server.stop_server()
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp

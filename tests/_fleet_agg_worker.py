@@ -1,0 +1,80 @@
+"""Worker for the multi-host fleet-aggregation test (run via the
+launch CLI, not collected by pytest).
+
+Each rank records rank-distinct metrics, then both ranks call the
+COLLECTIVE ``monitor.fleet.aggregated_snapshot()`` at the same program
+point (the tagged KV gather — no compiled collectives, so it runs on
+the CPU backend where cross-process XLA collectives do not). The
+parent test asserts:
+
+- min/max/sum over the rank-distinct gauge are exact on BOTH ranks
+  (every rank returns the same aggregate);
+- the per-host view carries each rank's own value;
+- the divergence report surfaces the rank-skewed metric;
+- rank 0's operator-plane server serves the cached aggregate at
+  ``/metrics?scope=fleet`` without any peer participating in the
+  scrape.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import urllib.request  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import monitor  # noqa: E402
+from paddle_tpu.monitor import fleet, server  # noqa: E402
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    paddle.set_flags({"FLAGS_enable_monitor": True})
+
+    monitor.set_gauge("test.fleet.rank_gauge", 10.0 * (rank + 1),
+                      doc="rank-distinct gauge (divergence bait)")
+    monitor.inc("test.fleet.shared_counter", 7,
+                doc="identical on every rank")
+    monitor.observe("test.fleet.lat_ms", 5.0 + rank, doc="latency-ish")
+
+    agg = fleet.aggregated_snapshot(name="aggtest")
+    s = agg["aggregate"]["scalars"]["test.fleet.rank_gauge"]
+    print(f"AGG rank={rank} min={s['min']} max={s['max']} "
+          f"sum={s['sum']} hosts={s['hosts']}", flush=True)
+    sc = agg["aggregate"]["scalars"]["test.fleet.shared_counter"]
+    print(f"SHARED rank={rank} min={sc['min']} max={sc['max']} "
+          f"sum={sc['sum']}", flush=True)
+    hist = agg["aggregate"]["histograms"]["test.fleet.lat_ms"]
+    print(f"HIST rank={rank} count={hist['count']} sum={hist['sum']}",
+          flush=True)
+    div = [d["metric"] for d in agg["divergence"]]
+    print(f"DIVERGENT rank={rank} "
+          f"{'yes' if 'test.fleet.rank_gauge' in div else 'no'}",
+          flush=True)
+
+    if rank == 0:
+        srv = server.start_server(port=0)
+        txt = urllib.request.urlopen(
+            f"{srv.url}/metrics?scope=fleet", timeout=10).read().decode()
+        has_min = 'test_fleet_rank_gauge{agg="min"} 10' in txt
+        has_h1 = 'test_fleet_rank_gauge{host="1"} 20' in txt
+        print(f"FLEETSCRAPE rank=0 min={'ok' if has_min else 'MISSING'} "
+              f"host1={'ok' if has_h1 else 'MISSING'}", flush=True)
+        server.stop_server()
+    # both ranks must agree on the whole aggregate payload
+    import zlib
+    digest = zlib.crc32(json.dumps(agg["aggregate"],
+                                   sort_keys=True).encode())
+    print(f"DIGEST rank={rank} {digest:08x}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
