@@ -48,6 +48,7 @@ from .compat import (CountFilterEntry, DistAttr, DistModel,  # noqa
 
 from . import engine  # noqa: F401,E402
 from .engine import Engine, ParallelPlan, plan_parallel  # noqa: F401,E402
+from . import introspect  # noqa: F401,E402  (sharding-layout inspector)
 from . import sharding  # noqa: F401,E402
 from .sharding import (group_sharded_parallel,  # noqa: F401,E402
                        save_group_sharded_model)

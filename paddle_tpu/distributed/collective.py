@@ -44,6 +44,19 @@ def _note_eager(op: str, tensor=None):
             _monitor.inc(f"dist.eager.{op}.bytes", nbytes,
                          doc="eager host-collective operand bytes")
 
+
+def _lat(kind: str):
+    """Wall-time context for the host exchanges that genuinely block
+    (KV-store object gathers, barriers): observes
+    ``comm.latency.<kind>_ms`` on the shared SLO buckets. A rank whose
+    peers are slow shows up as a fat tail here — the fleet divergence
+    report (monitor/fleet.py) surfaces exactly that."""
+    from ..monitor.registry import LATENCY_BUCKETS_MS
+    return _monitor.timed(
+        f"comm.latency.{kind}_ms",
+        doc="wall time of one eager/host collective of this kind",
+        buckets=LATENCY_BUCKETS_MS)
+
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
     "get_backend", "is_available", "all_reduce", "all_gather",
@@ -233,22 +246,25 @@ def all_gather_object(object_list: List, obj, group=None, tag=None):
     that is identical across hosts and unique per exchange — tagged
     rounds use their own KV keys and cannot mis-pair with the counter."""
     _faults.hit("collective.gather")
+    _note_eager("all_gather_object")
     n = _group_size(group)
     client = _coord_client()
-    if client is not None and n > 1:
-        if tag is None:
-            tag = _AG_SEQ[0]
-            _AG_SEQ[0] += 1
-        me = env.get_rank()
-        blob = pickle.dumps(obj).hex()
-        client.key_value_set(f"ag_{tag}_{me}", blob)
-        object_list.clear()
-        for r in range(n):
-            data = client.blocking_key_value_get(f"ag_{tag}_{r}", 60_000)
-            object_list.append(pickle.loads(bytes.fromhex(data)))
-    else:
-        object_list.clear()
-        object_list.extend(obj for _ in range(n))
+    with _lat("all_gather_object"):
+        if client is not None and n > 1:
+            if tag is None:
+                tag = _AG_SEQ[0]
+                _AG_SEQ[0] += 1
+            me = env.get_rank()
+            blob = pickle.dumps(obj).hex()
+            client.key_value_set(f"ag_{tag}_{me}", blob)
+            object_list.clear()
+            for r in range(n):
+                data = client.blocking_key_value_get(f"ag_{tag}_{r}",
+                                                     60_000)
+                object_list.append(pickle.loads(bytes.fromhex(data)))
+        else:
+            object_list.clear()
+            object_list.extend(obj for _ in range(n))
 
 
 def _coord_client():
@@ -356,10 +372,11 @@ def barrier(group=None):
     barrier / ProcessGroup barrier)."""
     _note_eager("barrier")
     client = _coord_client()
-    if client is not None and env.get_world_size() > 1:
-        client.wait_at_barrier("pt_barrier", 60_000)
-    else:
-        (jnp.zeros(()) + 0).block_until_ready()
+    with _lat("barrier"):
+        if client is not None and env.get_world_size() > 1:
+            client.wait_at_barrier("pt_barrier", 60_000)
+        else:
+            (jnp.zeros(()) + 0).block_until_ready()
 
 
 

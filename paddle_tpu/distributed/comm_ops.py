@@ -51,8 +51,13 @@ def _note(op: str, raw):
     """Monitor-gated collective accounting. These wrappers run at TRACE
     time (inside jit/shard_map), so counts are per-compile, not
     per-execution — the honest observable without a host callback in
-    the compiled program. ``bytes`` is the per-device operand size."""
-    if not _monitor.enabled():
+    the compiled program. ``bytes`` is the per-device operand size.
+
+    Suppression: the observability layer's OWN re-traces (MFU capture,
+    lazy memory/comm analyzers — monitor.suppress_accounting) are
+    muted, so a program's collectives count exactly once per real
+    compile no matter how often a scrape re-lowers it."""
+    if not _monitor.enabled() or _monitor.suppressed():
         return
     _monitor.inc(f"dist.{op}.calls",
                  doc="traced compiled-collective call sites")
@@ -60,6 +65,16 @@ def _note(op: str, raw):
     if nbytes:
         _monitor.inc(f"dist.{op}.bytes", nbytes,
                      doc="per-device operand bytes at trace time")
+
+
+# These wrappers are deliberately NOT wall-timed: a named-axis
+# collective can only execute inside a trace (eager calls raise on the
+# unbound axis name), and a trace-time measurement would record
+# microseconds of tracing as "collective latency". Runtime
+# ``comm.latency.*`` histograms live at the host seam
+# (``distributed/collective.py``); the in-graph collectives are
+# accounted by ``_note`` and the compiled-HLO scan
+# (``monitor/comms.py``).
 
 
 def axis_index(axis: AxisName):

@@ -1,0 +1,264 @@
+"""Sharding-layout inspector: what does the mesh actually hold?
+
+GSPMD sharding (PAPERS.md) is declared leaf-by-leaf as PartitionSpecs
+and then *disappears* into the compiler — nothing at runtime says how
+a parameter tree is laid out, how many bytes each device carries, or
+whether one axis choice silently replicated a 2 GB embedding onto
+every chip. This module answers those questions for any pytree of
+(possibly sharded) arrays:
+
+- :func:`describe_leaf` — per-leaf PartitionSpec, mesh axes, global
+  vs per-device shard bytes, replication factor
+  (``devices x shard_elems / global_elems``; 1 = fully partitioned,
+  ``num_devices`` = fully replicated), and whether the leaf is fully
+  replicated.
+- :func:`describe_tree` — bounded per-leaf report plus totals and a
+  **cross-device imbalance summary**: per-device byte totals (summed
+  over the leaves' actual shards) with ``(max - min) / max`` — uneven
+  sharding of a 4D-parallel tree shows up as one number.
+- :func:`register_sharded_tree` / :func:`sharding_snapshot` — the
+  ``/sharding`` endpoint's feed: explicitly registered trees (the
+  serving engine registers its params; training loops can register
+  theirs) merged with the per-program argument-sharding summaries the
+  introspection registry captured at the ``jit/api.py`` cache-miss
+  seam and the engine's prefill/decode registrations — so a pure
+  serving run populates the view with no training loop in sight.
+
+Everything is read-only and backend-safe: a leaf without a
+``.sharding`` (numpy input, scalar) reports as unsharded, a dead/
+deleted array contributes nothing, and callers gate registration on
+``monitor.enabled()`` (the inspector itself registers nothing on the
+off path).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["describe_leaf", "describe_tree", "register_sharded_tree",
+           "ensure_sharded_tree", "unregister_sharded_tree",
+           "sharding_snapshot", "reset"]
+
+# Per-leaf reports are bounded: a 10k-leaf tree must not turn a scrape
+# payload into megabytes. Totals/imbalance still cover every leaf.
+_MAX_LEAVES = 256
+
+# Explicitly registered trees: name -> computed summary (bounded FIFO;
+# summaries are computed AT registration so the registry never pins
+# the arrays themselves).
+_MU = threading.Lock()
+_TREES: dict = {}
+_MAX_TREES = 32
+
+
+def _leaf_array(x):
+    """Unwrap Tensor facades; None for non-arrays."""
+    data = getattr(x, "_data", x)
+    if hasattr(data, "shape") and hasattr(data, "dtype"):
+        return data
+    return None
+
+
+def _path_str(path) -> str:
+    import jax
+    try:
+        return jax.tree_util.keystr(path)
+    except Exception:
+        return str(path)
+
+
+def describe_leaf(arr, path: str = "") -> Optional[dict]:
+    """Layout facts of one (possibly sharded) array, or None for
+    non-array leaves. Never raises — a deleted donated buffer reports
+    what it can."""
+    import numpy as np
+
+    data = _leaf_array(arr)
+    if data is None:
+        return None
+    try:
+        shape = tuple(int(d) for d in data.shape)
+        itemsize = np.dtype(data.dtype).itemsize
+    except Exception:
+        return None
+    global_elems = 1
+    for d in shape:
+        global_elems *= d
+    out = {
+        "path": path,
+        "shape": list(shape),
+        "dtype": str(np.dtype(data.dtype).name),
+        "global_bytes": global_elems * itemsize,
+        "spec": None,
+        "mesh_axes": None,
+        "num_devices": 1,
+        "shard_shape": list(shape),
+        "shard_bytes": global_elems * itemsize,
+        "replication_factor": 1.0,
+        "fully_replicated": True,
+    }
+    sh = getattr(data, "sharding", None)
+    if sh is None:
+        return out
+    try:
+        devs = getattr(sh, "device_set", None)
+        n_dev = len(devs) if devs else 1
+        out["num_devices"] = n_dev
+        spec = getattr(sh, "spec", None)
+        if spec is not None:
+            out["spec"] = str(spec)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None:
+            out["mesh_axes"] = {str(name): int(size) for name, size in
+                                zip(mesh.axis_names, mesh.devices.shape)}
+        shard_shape = tuple(int(d) for d in sh.shard_shape(shape))
+        shard_elems = 1
+        for d in shard_shape:
+            shard_elems *= d
+        out["shard_shape"] = list(shard_shape)
+        out["shard_bytes"] = shard_elems * itemsize
+        if global_elems > 0:
+            out["replication_factor"] = round(
+                n_dev * shard_elems / global_elems, 4)
+        out["fully_replicated"] = bool(
+            getattr(sh, "is_fully_replicated", shard_shape == shape))
+    except Exception:
+        # an exotic sharding (GSPMD opaque) keeps the global facts
+        out["spec"] = out["spec"] or str(sh)
+    return out
+
+
+def describe_tree(tree, max_leaves: int = _MAX_LEAVES) -> dict:
+    """Bounded per-leaf layout report + totals + cross-device
+    imbalance for a pytree of arrays (Tensor facades unwrapped)."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: _leaf_array(x) is not None)[0]
+    leaves = []
+    total_global = total_shard = 0
+    n_arrays = 0
+    per_device: dict = {}
+    replicated_bytes = 0
+    for path, x in flat:
+        d = describe_leaf(x, _path_str(path))
+        if d is None:
+            continue
+        n_arrays += 1
+        total_global += d["global_bytes"]
+        total_shard += d["shard_bytes"]
+        if d["fully_replicated"] and d["num_devices"] > 1:
+            replicated_bytes += d["global_bytes"]
+        data = _leaf_array(x)
+        try:
+            import numpy as np
+            itemsize = np.dtype(data.dtype).itemsize
+            for shard in getattr(data, "addressable_shards", []):
+                n = 1
+                for dim in shard.data.shape:
+                    n *= int(dim)
+                dev = str(shard.device)
+                per_device[dev] = per_device.get(dev, 0) + n * itemsize
+        except Exception:
+            pass
+        if len(leaves) < max_leaves:
+            leaves.append(d)
+    imbalance = None
+    if per_device:
+        vals = list(per_device.values())
+        mx, mn = max(vals), min(vals)
+        imbalance = {
+            "devices": len(per_device),
+            "max_device_bytes": mx,
+            "min_device_bytes": mn,
+            "mean_device_bytes": int(sum(vals) / len(vals)),
+            "relative_imbalance": round((mx - mn) / mx, 4)
+            if mx > 0 else 0.0,
+        }
+    return {
+        "leaves": leaves,
+        "num_arrays": n_arrays,
+        "truncated": n_arrays > len(leaves),
+        "total_global_bytes": total_global,
+        "total_shard_bytes_per_device": total_shard,
+        "replicated_bytes": replicated_bytes,
+        "imbalance": imbalance,
+    }
+
+
+def register_sharded_tree(name: str, tree) -> Optional[dict]:
+    """Compute + retain a named tree's layout summary for the
+    ``/sharding`` endpoint (the serving engine registers its params
+    here; training loops can register theirs). Self-gated on the
+    monitor flag — the off path computes and registers NOTHING.
+    Re-registering a name refreshes it; the map is FIFO-bounded."""
+    from .. import monitor as _monitor
+
+    if not _monitor.enabled():
+        return None
+    try:
+        summary = describe_tree(tree)
+    except Exception:
+        return None
+    with _MU:
+        _TREES.pop(name, None)
+        _TREES[name] = summary
+        while len(_TREES) > _MAX_TREES:
+            _TREES.pop(next(iter(_TREES)))
+    return summary
+
+
+def ensure_sharded_tree(name: str, tree_fn) -> bool:
+    """Register ``tree_fn()`` under ``name`` iff it is not already
+    registered — the per-dispatch reset-recovery seam (the serving
+    engine calls this from its program-registration path, so a
+    ``monitor.reset()`` mid-run repopulates ``/sharding`` on the next
+    dispatch instead of staying empty forever). Steady-state cost: one
+    locked dict lookup; the tree is only materialized (``tree_fn``
+    called) when absent. Monitor-gated like registration."""
+    from .. import monitor as _monitor
+
+    if not _monitor.enabled():
+        return False
+    with _MU:
+        if name in _TREES:
+            return False
+    return register_sharded_tree(name, tree_fn()) is not None
+
+
+def unregister_sharded_tree(name: str):
+    with _MU:
+        _TREES.pop(name, None)
+
+
+def sharding_snapshot() -> dict:
+    """The ``/sharding`` payload: world shape, explicitly registered
+    trees, and the per-program argument-sharding summaries the
+    introspection registry captured (serving prefill/decode programs
+    and to_static cache misses)."""
+    import jax
+
+    from ..monitor import programs as _programs
+
+    try:
+        world = {
+            "devices": jax.device_count(),
+            "local_devices": jax.local_device_count(),
+            "process_count": jax.process_count(),
+        }
+    except Exception:
+        world = {}
+    progs = []
+    for rec in _programs.programs_snapshot():
+        if rec.get("sharding") is not None:
+            progs.append({"name": rec["name"], "source": rec["source"],
+                          "signature": rec["signature"],
+                          "sharding": rec["sharding"]})
+    with _MU:
+        trees = dict(_TREES)
+    return {"world": world, "programs": progs, "trees": trees}
+
+
+def reset():
+    with _MU:
+        _TREES.clear()

@@ -313,6 +313,13 @@ class ServingEngine:
             _mserver.register_health_provider(
                 f"serving:{self._engine_uid}",
                 _engine_health_provider(weakref.ref(self)))
+        # Sharding inspector (distributed/introspect.py): the param
+        # tree's per-leaf layout for /sharding — pure serving runs
+        # populate the view with no training loop in sight. Self-gated
+        # on the monitor flag (off path computes + registers nothing).
+        from ..distributed import introspect as _introspect
+        _introspect.register_sharded_tree(
+            f"serving:{self._engine_uid}.params", self.params)
 
     def _record_serving_program(self, spec_key, name, jitted, args,
                                 kwargs, donated=()):
@@ -325,8 +332,14 @@ class ServingEngine:
         so the scrape endpoints and the headroom estimate's temp
         reservation recover instead of staying empty forever. The
         per-dispatch cost after the first is one locked dict lookup,
-        monitor-on only."""
+        monitor-on only. The params sharding tree rides the same
+        reset-recovery seam (ensure_sharded_tree): a mid-run
+        ``monitor.reset()`` repopulates ``/sharding`` on the next
+        dispatch, like the program registry itself."""
+        from ..distributed import introspect as _introspect
         from ..monitor import programs as _programs
+        _introspect.ensure_sharded_tree(
+            f"serving:{self._engine_uid}.params", lambda: self.params)
         key = ("engine", self._engine_uid) + spec_key
         if _programs.has_record(key):
             return

@@ -543,12 +543,13 @@ class StaticFunction:
             compile_ms = self._note_compile(t_compile)
             if t_compile is not None:
                 from ..monitor import mfu as _mfu
-                flops = _mfu.lowered_flops(
+                cost = _mfu.lowered_cost(
                     prog.jitted, param_arrays, buffer_arrays,
                     arg_arrays, kwarg_arrays)
-                _mfu.record_program_flops(flops, source="to_static")
+                _mfu.record_program_flops(cost["flops"],
+                                          source="to_static")
                 self._register_program(
-                    key, prog, compile_ms, flops, param_arrays,
+                    key, prog, compile_ms, cost, param_arrays,
                     buffer_arrays, arg_arrays, kwarg_arrays)
         else:
             train_names = [n for n, _ in trainable]
@@ -587,15 +588,16 @@ class StaticFunction:
                     # forward op the backward doesn't reuse)
                     return out, inner_vjp(cts)
 
-                flops = _mfu.lowered_flops(
+                cost = _mfu.lowered_cost(
                     jax.jit(_full_step), train_arrays, diff_arg_arrays)
-                if flops <= 0.0:
-                    flops = _mfu.lowered_flops(
+                if not cost["flops"]:
+                    cost = _mfu.lowered_cost(
                         prog.jitted, param_arrays, buffer_arrays,
                         arg_arrays, kwarg_arrays)
-                _mfu.record_program_flops(flops, source="to_static")
+                _mfu.record_program_flops(cost["flops"],
+                                          source="to_static")
                 self._register_program(
-                    key, prog, compile_ms, flops, param_arrays,
+                    key, prog, compile_ms, cost, param_arrays,
                     buffer_arrays, arg_arrays, kwarg_arrays)
 
             input_tensors = [p for _, p in trainable] + \
@@ -651,18 +653,28 @@ class StaticFunction:
             self._registry_uid = _programs.next_uid()
         return ("to_static", self._registry_uid, key)
 
-    def _register_program(self, key, prog, compile_ms, flops,
+    def _register_program(self, key, prog, compile_ms, cost,
                           param_arrays, buffer_arrays, arg_arrays,
                           kwarg_arrays):
         """Feed the compiled-program introspection registry
         (monitor/programs.py) at the cache-miss seam: name, input
-        signature, compile wall-ms, analyzed FLOPs, and a LAZY memory
-        analyzer over the forward program's avals (the ``/programs``
-        endpoint pays the one AOT compile, not this call). Grad-path
-        programs record the forward program's memory breakdown — the
-        executable this cache actually holds."""
+        signature, compile wall-ms, analyzed FLOPs + bytes-accessed
+        (``cost`` = monitor.mfu.lowered_cost result), the per-leaf
+        sharding summary of the concrete params/args (the ``/sharding``
+        endpoint's per-program feed), and a LAZY memory+collective
+        analyzer over the forward program's avals (the ``/programs`` /
+        ``/roofline`` endpoints pay the one AOT compile, not this
+        call). Grad-path programs record the forward program's memory
+        breakdown — the executable this cache actually holds."""
         from ..monitor import programs as _programs
         args = (param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
+        try:
+            from ..distributed import introspect as _introspect
+            sharding = _introspect.describe_tree(
+                {"params": param_arrays, "args": arg_arrays,
+                 "kwargs": kwarg_arrays})
+        except Exception:
+            sharding = None
         _programs.record_program(
             self._registry_key(key),
             getattr(self._fn, "__name__", "to_static"),
@@ -671,7 +683,9 @@ class StaticFunction:
             donated=(),
             compile_ms=round(compile_ms, 3)
             if compile_ms is not None else None,
-            flops=flops,
+            flops=cost["flops"],
+            bytes_accessed=cost["bytes_accessed"],
+            sharding=sharding,
             analyzer=_programs.analyzer_for(prog.jitted, args))
 
     @property

@@ -33,6 +33,7 @@ Instrumented seams (each self-documents its unit in the metric name):
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -48,7 +49,9 @@ __all__ = [
     "record_op", "tensor_bytes", "tensor_free",
     "trace", "mfu", "StepTimer", "ambient_phase",
     "server", "programs", "memory", "fleet",
+    "comms", "roofline",
     "start_server", "stop_server",
+    "suppressed", "suppress_accounting",
 ]
 
 # The one process-global registry (monitor.h StatRegistry::Instance()).
@@ -62,6 +65,36 @@ _FLAG = _flags.flag_info("enable_monitor")
 def enabled() -> bool:
     """True when FLAGS_enable_monitor is set (env or set_flags)."""
     return _FLAG.value
+
+
+# Trace-accounting suppression: the observability layer itself re-traces
+# user programs (mfu.lowered_cost per compile, the lazy memory/comm
+# analyzers per scrape). Instrumentation that fires at TRACE time — the
+# compiled-collective counters in distributed/comm_ops.py — would count
+# those internal re-traces as if the user compiled twice. Monitor-
+# internal lowering wraps itself in suppress_accounting(); trace-time
+# counters check suppressed() and stay silent, so "once per compile"
+# stays honest. Thread-local: a scrape thread's analyzer must not mute
+# the training thread's real compiles.
+_SUPPRESS = threading.local()
+
+
+def suppressed() -> bool:
+    """True while this thread is inside a monitor-internal re-trace."""
+    return getattr(_SUPPRESS, "depth", 0) > 0
+
+
+class suppress_accounting:
+    """Context manager muting trace-time accounting on this thread
+    (re-entrant)."""
+
+    def __enter__(self):
+        _SUPPRESS.depth = getattr(_SUPPRESS, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _SUPPRESS.depth -= 1
+        return False
 
 
 def registry() -> StatRegistry:
@@ -196,17 +229,27 @@ def reset():
     trace.clear()
     programs.reset()
     fleet.reset()
+    # the sharding inspector's registered trees empty with the rest
+    # (module-reference lookup: reset() must not be the thing that
+    # first imports the distributed package)
+    import sys as _sys
+    _introspect = _sys.modules.get("paddle_tpu.distributed.introspect")
+    if _introspect is not None:
+        _introspect.reset()
 
 
 class timed:
     """Context manager observing its wall time (ms) into a histogram
-    when the monitor is enabled — zero-cost pass-through otherwise."""
+    when the monitor is enabled — zero-cost pass-through otherwise.
+    ``buckets`` picks the histogram layout (e.g. the shared
+    ``registry.LATENCY_BUCKETS_MS`` for SLO-shaped latencies)."""
 
-    __slots__ = ("name", "doc", "_t0")
+    __slots__ = ("name", "doc", "buckets", "_t0")
 
-    def __init__(self, name: str, doc: str = ""):
+    def __init__(self, name: str, doc: str = "", buckets=None):
         self.name = name
         self.doc = doc
+        self.buckets = buckets
         self._t0 = None
 
     def __enter__(self):
@@ -218,7 +261,7 @@ class timed:
     def __exit__(self, *exc):
         if self._t0 is not None:
             observe(self.name, (time.perf_counter() - self._t0) * 1e3,
-                    self.doc)
+                    self.doc, buckets=self.buckets)
         return False
 
 
@@ -234,5 +277,9 @@ from .steptimer import StepTimer, ambient_phase  # noqa: E402
 from . import fleet  # noqa: E402
 from . import memory  # noqa: E402
 from . import programs  # noqa: E402
+# Communication + roofline observability (PR 8): HLO collective
+# accounting and compute/HBM/comm-bound attribution over the registry.
+from . import comms  # noqa: E402
+from . import roofline  # noqa: E402
 from . import server  # noqa: E402
 from .server import start_server, stop_server  # noqa: E402

@@ -1,0 +1,175 @@
+"""Communication accounting: HLO collective scan + runtime latency.
+
+GSPMD (PAPERS.md) turns sharding annotations into compiler-inserted
+collectives whose cost is invisible at the source level — the program
+the user wrote contains no ``lax.psum``, yet the compiled HLO is full
+of ``all-reduce``/``all-gather`` the partitioner synthesized. Before
+the pod-scale sharding refactor (ROADMAP item 1) can be *measured*
+rather than guessed, those ops must be countable. Two seams:
+
+- **Compiled-program scan** (:func:`scan_hlo_collectives`): walk the
+  post-optimization HLO text of a compiled executable — the one place
+  compiler-inserted collectives exist — and count defining collective
+  instructions by kind (all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all), with **estimated bytes** from each
+  instruction's result shape (per-device buffer bytes; async
+  ``-start`` tuples carry operand+result so they are halved, ``-done``
+  consumes the started op and is skipped). The scan rides the SAME
+  lazy AOT lower+compile the memory analyzer already pays
+  (``monitor/programs.py``) — one compile buys memory AND comm
+  introspection — and its results land as per-program ``collectives``
+  fields plus the ``comm.program.*`` gauges.
+- **Runtime latency** (:func:`observe_latency`): per-kind wall-time
+  histograms ``comm.latency.<kind>_ms`` on the shared
+  ``LATENCY_BUCKETS_MS``, fed by the host collective seam
+  (``distributed/collective.py``: object gathers, barriers — the
+  exchanges that genuinely block the host). The compiled collectives
+  (``distributed/comm_ops.py``) are deliberately not wall-timed: a
+  named-axis collective only executes inside a trace, so the only
+  measurable host time would be tracing itself — they are counted
+  per compile and HLO-scanned instead.
+
+Byte estimates are **per-device** and shape-derived: an all-reduce of
+``f32[2,8]`` counts 64 bytes regardless of the ring algorithm's actual
+wire traffic (2(n-1)/n ...), because the operand size is the number an
+operator can reason about and compare across programs. The roofline
+model (``monitor/roofline.py``) divides these bytes by interconnect
+bandwidth for its comm-bound verdicts.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+__all__ = ["COLLECTIVE_KINDS", "scan_hlo_collectives", "shape_bytes",
+           "total_counts", "observe_latency", "comm_summary"]
+
+# The five kinds the GSPMD partitioner emits (PAPERS.md: GSPMD §3).
+COLLECTIVE_KINDS = ("all_reduce", "all_gather", "reduce_scatter",
+                    "collective_permute", "all_to_all")
+
+# HLO opcode -> kind key. Async pairs: the ``-start`` op defines the
+# collective (its tuple shape holds operand+result buffers); the
+# matching ``-done`` only unpacks it and must not double-count.
+_KIND_OF = {
+    "all-reduce": "all_reduce",
+    "all-reduce-start": "all_reduce",
+    "all-gather": "all_gather",
+    "all-gather-start": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "collective-permute": "collective_permute",
+    "collective-permute-start": "collective_permute",
+    "all-to-all": "all_to_all",
+}
+
+# Element bytes by HLO dtype token (sub-byte s4/u4 round up to 1 —
+# an estimate must not claim fractional bytes it can't justify).
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# One defining HLO instruction: ``%name = SHAPE opcode(...`` where
+# SHAPE is an array shape (``f32[2,8]{1,0}``) or a tuple of them. The
+# shape is captured lazily up to `` opcode(`` rather than structurally:
+# TPU layouts embed parens inside the layout braces
+# (``bf16[1024]{0:T(1024)}``), so any "balanced-paren tuple" regex
+# truncates exactly on the async ``-start`` tuples the TPU backend
+# emits by default. Longest-match ordering in the opcode alternation
+# matters: ``all-reduce`` must not swallow ``all-reduce-start``'s
+# prefix (a ``-done`` never matches — its opcode is not followed by
+# ``(`` at the alternation's end).
+_OPS = sorted(_KIND_OF, key=len, reverse=True)
+_INSTR_RE = re.compile(
+    r"=\s*([^\n]*?)\s"
+    r"(" + "|".join(re.escape(op) for op in _OPS) + r")\(")
+
+_ATOM_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total bytes of every array atom in an HLO shape string —
+    ``f32[2,8]{1,0}`` -> 64, ``(f32[4], u32[2])`` -> 24. Unknown
+    dtypes count 0 (an estimate over-claiming is worse than one that
+    under-claims and says so)."""
+    total = 0
+    for dtype, dims in _ATOM_RE.findall(shape_str):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def scan_hlo_collectives(hlo_text: str) -> Dict[str, dict]:
+    """Count defining collective instructions in post-optimization HLO
+    text by kind. Returns ``{kind: {"count": n, "bytes": b}}`` with
+    only the kinds present (``{}`` = no collectives — a single-device
+    program). ``bytes`` is the summed per-device result-shape estimate
+    (async ``-start`` tuples halved: they carry operand AND result)."""
+    out: Dict[str, dict] = {}
+    for m in _INSTR_RE.finditer(hlo_text):
+        shape, op = m.group(1), m.group(2)
+        kind = _KIND_OF[op]
+        b = shape_bytes(shape)
+        if op.endswith("-start") and shape.startswith("("):
+            b //= 2
+        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += b
+    return out
+
+
+def total_counts(comms: Optional[dict]) -> tuple:
+    """``(total ops, total bytes)`` of a :func:`scan_hlo_collectives`
+    result (``(0, 0)`` for None/empty)."""
+    if not comms:
+        return 0, 0
+    return (sum(v.get("count", 0) for v in comms.values()),
+            sum(v.get("bytes", 0) for v in comms.values()))
+
+
+def observe_latency(kind: str, ms: float):
+    """Per-kind collective wall time into ``comm.latency.<kind>_ms``
+    on the shared SLO bucket layout. Self-gated (monitor flag)."""
+    from . import observe as _observe
+    from .registry import LATENCY_BUCKETS_MS
+    _observe(f"comm.latency.{kind}_ms", ms,
+             doc="wall time of one eager/host collective of this kind",
+             buckets=LATENCY_BUCKETS_MS)
+
+
+def comm_summary() -> dict:
+    """Cross-program aggregate of the scanned collectives in the
+    introspection registry: per-kind count/bytes plus how many
+    programs have been comm-analyzed at all — the ``/roofline``
+    payload's comm block and the bench ``extra.metrics.roofline``
+    input. Programs whose analyzer has not run (or failed) simply
+    do not contribute; absence is visible via ``programs_analyzed``."""
+    from . import programs as _programs
+
+    kinds: Dict[str, dict] = {}
+    analyzed = with_comms = 0
+    for rec in _programs.programs_snapshot():
+        comms = rec.get("collectives")
+        if comms is None:
+            continue
+        analyzed += 1
+        if comms:
+            with_comms += 1
+        for kind, v in comms.items():
+            agg = kinds.setdefault(kind, {"count": 0, "bytes": 0})
+            agg["count"] += v.get("count", 0)
+            agg["bytes"] += v.get("bytes", 0)
+    return {"kinds": kinds,
+            "programs_analyzed": analyzed,
+            "programs_with_collectives": with_comms}

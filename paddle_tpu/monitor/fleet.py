@@ -77,14 +77,20 @@ def aggregate_hosts(host_snaps: List[dict]) -> dict:
     scalars = {n: v for n, v in scalars.items() if v is not None}
 
     hists: dict = {}
-    for snap in host_snaps:
+    n_hosts = len(host_snaps)
+    for rank, snap in enumerate(host_snaps):
         for name, h in snap.get("histograms", {}).items():
             if not isinstance(h, dict) or not h.get("count"):
                 continue
             agg = hists.setdefault(name, {"count": 0, "sum": 0.0,
-                                          "min": None, "max": None})
+                                          "min": None, "max": None,
+                                          "host_means": [None] * n_hosts})
             agg["count"] += h["count"]
             agg["sum"] += h.get("sum", 0.0)
+            # per-host mean: the divergence report's histogram input —
+            # one rank's slow collectives (comm.latency.*) surface as a
+            # drifting mean even when counts match. Absent stays None.
+            agg["host_means"][rank] = h.get("sum", 0.0) / h["count"]
             for key, pick in (("min", min), ("max", max)):
                 v = h.get(key)
                 if v is not None:
@@ -111,6 +117,22 @@ def divergence(agg: dict, top_n: int = 20) -> List[dict]:
         if rel > _DIVERGENCE_FLOOR:
             out.append({"metric": name, "min": s["min"], "max": s["max"],
                         "mean": s["mean"],
+                        "relative_spread": round(rel, 6)})
+    # Histogram per-host means ride the same report as `<name>:mean`
+    # pseudo-metrics: a rank whose collective latency
+    # (comm.latency.<kind>_ms) drifts has identical counts but a fat
+    # mean — invisible to the scalar pass above. Hosts that never
+    # observed the histogram stay None and simply don't participate.
+    for name, h in agg.get("histograms", {}).items():
+        means = [m for m in h.get("host_means", []) if m is not None]
+        if len(means) < 2:
+            continue
+        mx, mn = max(means), min(means)
+        denom = max(abs(mx), abs(mn), _DIVERGENCE_FLOOR)
+        rel = (mx - mn) / denom
+        if rel > _DIVERGENCE_FLOOR:
+            out.append({"metric": f"{name}:mean", "min": mn, "max": mx,
+                        "mean": sum(means) / len(means),
                         "relative_spread": round(rel, 6)})
     out.sort(key=lambda d: -d["relative_spread"])
     return out[:top_n]
@@ -204,4 +226,10 @@ def expose_fleet_text(payload: dict) -> str:
         for key in ("count", "sum", "min", "max"):
             if h.get(key) is not None:
                 lines.append(render_sample(name, {"agg": key}, h[key]))
+        # per-host means as labeled samples: the scrape-side view of
+        # the divergence report's histogram input
+        for rank, v in enumerate(h.get("host_means", [])):
+            if v is not None:
+                lines.append(render_sample(name, {"host": str(rank),
+                                                  "agg": "mean"}, v))
     return "\n".join(lines) + "\n"

@@ -31,7 +31,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-__all__ = ["peak_flops", "lowered_flops", "cost_analysis_flops",
+__all__ = ["peak_flops", "resolve_peak", "lowered_flops",
+           "lowered_cost", "cost_analysis_flops", "cost_analysis_value",
            "record_program_flops", "mfu", "ones_cotangent"]
 
 # bf16 peak FLOP/s per chip by TPU generation (same table bench.py has
@@ -49,19 +50,25 @@ PEAK_FLOPS_TABLE = {
 _CPU_NOMINAL = 1e12
 
 
-def peak_flops(device=None) -> float:
-    """Peak FLOP/s for ``device`` (default: first jax device).
-    Resolution order: ``PADDLE_TPU_PEAK_FLOPS`` env override (any
-    float, the CPU-smoke escape hatch) -> TPU-generation table matched
-    against ``device_kind`` or the axon tunnel's
-    ``PALLAS_AXON_TPU_GEN`` -> v5p for unknown TPUs -> a 1e12 nominal
-    for CPU hosts."""
-    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+def resolve_peak(env_name: str, table: dict, nominal: float,
+                 device=None, scale: float = 1.0) -> dict:
+    """The one peak-denominator resolver shared by the FLOPs table
+    here and the bandwidth tables in ``monitor/roofline.py`` (two
+    copies of the generation-matching rules would let FLOP and
+    bandwidth denominators silently resolve to different generations
+    for the same device). Order: env override (``scale`` applied — the
+    CPU-smoke escape hatch) -> per-generation table matched against
+    ``device_kind`` or the axon tunnel's ``PALLAS_AXON_TPU_GEN`` ->
+    v5p for unknown TPUs -> ``nominal`` (already in absolute units).
+    Returns ``{"value", "source", "generation"}`` so consumers can
+    assert provenance (the smoke stage requires a real table hit)."""
+    env = os.environ.get(env_name)
     if env:
         try:
             v = float(env)
             if v > 0:
-                return v
+                return {"value": v * scale, "source": "env",
+                        "generation": None}
         except ValueError:
             pass
     if device is None:
@@ -69,48 +76,100 @@ def peak_flops(device=None) -> float:
             import jax
             device = jax.devices()[0]
         except Exception:
-            return _CPU_NOMINAL
+            return {"value": nominal, "source": "nominal",
+                    "generation": None}
     kind = (getattr(device, "device_kind", "") or "").lower()
     kind = kind.replace(" ", "")
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for k, v in PEAK_FLOPS_TABLE.items():
+    for k, v in table.items():
         if k in kind or k in gen:
-            return v
+            return {"value": v * scale, "source": "table",
+                    "generation": k}
     platform = getattr(device, "platform", "")
     if platform in ("tpu", "axon") or "tpu" in kind:
-        return PEAK_FLOPS_TABLE["v5p"]
-    return _CPU_NOMINAL
+        return {"value": table["v5p"] * scale,
+                "source": "default_tpu", "generation": "v5p"}
+    return {"value": nominal, "source": "nominal", "generation": None}
 
 
-def cost_analysis_flops(cost) -> float:
-    """Pull a flop count out of a jax cost-analysis result, which is a
-    dict on current jax and a list of per-computation dicts on some
-    versions. 0.0 when the analysis has no flops entry."""
+def peak_flops(device=None) -> float:
+    """Peak FLOP/s for ``device`` (default: first jax device) —
+    ``PADDLE_TPU_PEAK_FLOPS`` env override -> generation table ->
+    v5p for unknown TPUs -> a 1e12 nominal for CPU hosts (see
+    :func:`resolve_peak`)."""
+    return resolve_peak("PADDLE_TPU_PEAK_FLOPS", PEAK_FLOPS_TABLE,
+                        _CPU_NOMINAL, device)["value"]
+
+
+def cost_analysis_value(cost, key: str) -> Optional[float]:
+    """Pull a named property out of a jax cost-analysis result, which
+    is a dict on current jax and a list of per-computation dicts on
+    some versions. None when NO computation reports the key (a backend
+    that omits it, or XLA's -1 "unknown" sentinel) — callers must not
+    see a fabricated 0."""
     if cost is None:
-        return 0.0
+        return None
     if isinstance(cost, (list, tuple)):
-        return float(sum(cost_analysis_flops(c) for c in cost))
+        vals = [cost_analysis_value(c, key) for c in cost]
+        vals = [v for v in vals if v is not None]
+        return float(sum(vals)) if vals else None
     try:
-        v = cost.get("flops", 0.0)
+        v = cost.get(key)
     except AttributeError:
-        return 0.0
+        return None
     try:
         f = float(v)
     except (TypeError, ValueError):
-        return 0.0
-    # XLA reports -1 for "unknown" on some backends
-    return f if f > 0 else 0.0
+        return None
+    # XLA reports -1 for "unknown" on some backends; an answered 0
+    # (a pure data-movement program) passes through — only a missing/
+    # unknown read may look "unavailable"
+    return f if f >= 0 else None
 
 
-def lowered_flops(jitted_fn, *args, **kwargs) -> float:
-    """FLOPs of one invocation of ``jitted_fn(*args, **kwargs)`` per
-    XLA's HLO cost analysis. Re-traces and lowers (cheap) but does NOT
-    compile. 0.0 when the backend/analysis can't say."""
+def cost_analysis_flops(cost) -> float:
+    """0.0-defaulting flops read (legacy shape; ``lowered_cost`` is
+    the hardened Optional-returning capture seam)."""
+    return cost_analysis_value(cost, "flops") or 0.0
+
+
+def _note_unavailable():
+    from . import inc as _inc
+    _inc("monitor.cost_analysis.unavailable",
+         doc="cost_analysis() reads that raised or omitted the "
+             "requested key (flops / bytes accessed)")
+
+
+def lowered_cost(jitted_fn, *args, **kwargs) -> dict:
+    """``{"flops": Optional[float], "bytes_accessed": Optional[float]}``
+    of one invocation per XLA's HLO cost analysis. Re-traces and lowers
+    (cheap, wrapped in ``monitor.suppress_accounting`` so trace-time
+    counters don't see the internal re-trace) but does NOT compile.
+
+    Hardened for the jit cache-miss seam: a backend whose
+    ``cost_analysis()`` raises or omits keys yields ``None`` fields and
+    bumps ``monitor.cost_analysis.unavailable`` — a KeyError here must
+    never take down the compile it rides behind."""
+    from . import suppress_accounting as _suppress
     try:
-        lowered = jitted_fn.lower(*args, **kwargs)
-        return cost_analysis_flops(lowered.cost_analysis())
+        with _suppress():
+            lowered = jitted_fn.lower(*args, **kwargs)
+            cost = lowered.cost_analysis()
     except Exception:
-        return 0.0
+        _note_unavailable()
+        return {"flops": None, "bytes_accessed": None}
+    out = {"flops": cost_analysis_value(cost, "flops"),
+           "bytes_accessed": cost_analysis_value(cost, "bytes accessed")}
+    if out["flops"] is None or out["bytes_accessed"] is None:
+        _note_unavailable()
+    return out
+
+
+def lowered_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation of ``jitted_fn(*args, **kwargs)`` per
+    XLA's HLO cost analysis. None when the backend/analysis can't say
+    (counted under ``monitor.cost_analysis.unavailable``)."""
+    return lowered_cost(jitted_fn, *args, **kwargs)["flops"]
 
 
 def ones_cotangent(x):
@@ -127,11 +186,12 @@ def ones_cotangent(x):
     return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
 
 
-def record_program_flops(flops: float, source: str = "jit"):
+def record_program_flops(flops: Optional[float], source: str = "jit"):
     """Accumulate an analyzed program's FLOPs into the registry
     (``jit.program.flops`` counter + ``jit.program.last_flops`` gauge).
-    Callers gate on ``monitor.enabled()``."""
-    if flops <= 0:
+    Callers gate on ``monitor.enabled()``; None (analysis unavailable)
+    records nothing."""
+    if not flops or flops <= 0:
         return
     from . import inc as _inc
     from . import set_gauge as _set_gauge

@@ -69,18 +69,28 @@ def next_uid() -> int:
 
 
 class ProgramRecord:
-    """One compiled specialization. ``memory`` stays None until
-    :func:`analyze_pending` runs its analyzer (or the analyzer's
-    program died / failed to lower — then it stays None forever and
-    ``analyze_error`` says why)."""
+    """One compiled specialization. ``memory`` and ``comms`` stay None
+    until :func:`analyze_pending` runs the record's analyzer (or the
+    analyzer's program died / failed to lower — then they stay None
+    forever and ``analyze_error`` says why). ``bytes_accessed`` is the
+    HLO cost-analysis read captured with ``flops`` at record time
+    (None when the backend omits it — the roofline model treats that
+    as unclassifiable, never as zero traffic; ``flops`` keeps the same
+    discipline — an unavailable read stays None, a genuine zero-FLOP
+    data-movement program reports 0.0); ``sharding`` is the bounded
+    per-leaf layout summary of the call's concrete arguments
+    (``distributed/introspect.py``)."""
 
     __slots__ = ("key", "name", "source", "signature", "donated",
-                 "compile_ms", "flops", "hits", "created_unix",
-                 "memory", "analyze_error", "_analyzer")
+                 "compile_ms", "flops", "bytes_accessed", "hits",
+                 "created_unix", "memory", "comms", "sharding",
+                 "analyze_error", "_analyzer")
 
     def __init__(self, key, name: str, source: str, signature: str,
                  donated=(), compile_ms: Optional[float] = None,
                  flops: float = 0.0,
+                 bytes_accessed: Optional[float] = None,
+                 sharding: Optional[dict] = None,
                  analyzer: Optional[Callable[[], dict]] = None):
         self.key = key
         self.name = name
@@ -88,10 +98,13 @@ class ProgramRecord:
         self.signature = signature
         self.donated = tuple(donated)
         self.compile_ms = compile_ms
-        self.flops = float(flops)
+        self.flops = float(flops) if flops is not None else None
+        self.bytes_accessed = bytes_accessed
+        self.sharding = sharding
         self.hits = 0
         self.created_unix = round(time.time(), 3)
         self.memory: Optional[dict] = None
+        self.comms: Optional[dict] = None
         self.analyze_error: Optional[str] = None
         self._analyzer = analyzer
 
@@ -103,9 +116,12 @@ class ProgramRecord:
             "donated_args": list(self.donated),
             "compile_ms": self.compile_ms,
             "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
             "hits": self.hits,
             "created_unix": self.created_unix,
             "memory": self.memory,
+            "collectives": self.comms,
+            "sharding": self.sharding,
             **({"analyze_error": self.analyze_error}
                if self.analyze_error else {}),
         }
@@ -154,8 +170,12 @@ _MEM_FIELDS = {
 
 def _make_analyzer(jitted, avals_args: tuple, avals_kwargs: dict):
     """Closure lowering+compiling ``jitted`` at ``avals`` to harvest
-    ``memory_analysis()``; holds the callable weakly where possible so
-    a dead StaticFunction's programs don't outlive it here."""
+    ``memory_analysis()`` AND the post-optimization HLO collective
+    scan (``monitor/comms.py``) — one AOT compile buys both. Holds the
+    callable weakly where possible so a dead StaticFunction's programs
+    don't outlive it here. The re-trace runs under accounting
+    suppression: a scrape-triggered analysis must not re-fire the
+    trace-time collective counters the real compile already paid."""
     try:
         ref = weakref.ref(jitted)
         get = ref
@@ -163,17 +183,26 @@ def _make_analyzer(jitted, avals_args: tuple, avals_kwargs: dict):
         get = lambda: jitted  # noqa: E731  (C wrappers refuse weakrefs)
 
     def analyze() -> dict:
+        from . import suppress_accounting as _suppress
+        from . import comms as _comms
+
         fn = get()
         if fn is None:
             raise ReferenceError("program owner was garbage-collected")
-        ma = fn.lower(*avals_args, **avals_kwargs).compile() \
-               .memory_analysis()
-        out = {}
+        with _suppress():
+            compiled = fn.lower(*avals_args, **avals_kwargs).compile()
+        ma = compiled.memory_analysis()
+        mem = {}
         for attr, key in _MEM_FIELDS.items():
             v = getattr(ma, attr, None)
             if v is not None:
-                out[key] = int(v)
-        return out
+                mem[key] = int(v)
+        try:
+            comms = _comms.scan_hlo_collectives(compiled.as_text())
+        except Exception:
+            # a backend without HLO text rendering still gets memory
+            comms = None
+        return {"memory": mem, "collectives": comms}
 
     return analyze
 
@@ -198,7 +227,10 @@ def analyzer_for(jitted, args: tuple, kwargs: Optional[dict] = None):
 
 def record_program(key, name: str, *, source: str, signature: str = "",
                    donated=(), compile_ms: Optional[float] = None,
-                   flops: float = 0.0, analyzer=None) -> ProgramRecord:
+                   flops: float = 0.0,
+                   bytes_accessed: Optional[float] = None,
+                   sharding: Optional[dict] = None,
+                   analyzer=None) -> ProgramRecord:
     """Register one freshly compiled program (callers gate on
     ``monitor.enabled()``). Re-recording an existing key refreshes the
     record in place (a StaticFunction re-tracing after enable_to_static
@@ -206,7 +238,8 @@ def record_program(key, name: str, *, source: str, signature: str = "",
     from . import set_gauge as _set_gauge
 
     rec = ProgramRecord(key, name, source, signature, donated,
-                        compile_ms, flops, analyzer=analyzer)
+                        compile_ms, flops, bytes_accessed=bytes_accessed,
+                        sharding=sharding, analyzer=analyzer)
     with _MU:
         old = _BY_KEY.pop(key, None)
         if old is not None:
@@ -232,9 +265,11 @@ def record_jit_call(key, name: str, jitted, args: tuple, *,
                     ) -> ProgramRecord:
     """Convenience for raw ``jax.jit`` call sites (the serving engine's
     prefill/chunk programs): builds the signature + lazy analyzer from
-    the concrete call args, captures cost-analysis FLOPs (one re-trace,
-    no compile — feeds ``jit.program.flops`` so non-to_static programs
-    count too). Callers gate on ``monitor.enabled()``."""
+    the concrete call args, captures cost-analysis FLOPs and
+    bytes-accessed (one re-trace, no compile — feeds
+    ``jit.program.flops`` so non-to_static programs count too) and the
+    per-leaf sharding summary of the concrete arguments. Callers gate
+    on ``monitor.enabled()``."""
     from . import mfu as _mfu
 
     kwargs = kwargs or {}
@@ -245,12 +280,18 @@ def record_jit_call(key, name: str, jitted, args: tuple, *,
         signature = _sig_str((args, kwargs))
     except Exception:
         analyzer, signature = None, ""
-    flops = _mfu.lowered_flops(jitted, *args, **kwargs)
-    if flops > 0:
-        _mfu.record_program_flops(flops, source=source)
+    cost = _mfu.lowered_cost(jitted, *args, **kwargs)
+    _mfu.record_program_flops(cost["flops"], source=source)
+    try:
+        from ..distributed import introspect as _introspect
+        sharding = _introspect.describe_tree((args, kwargs))
+    except Exception:
+        sharding = None
     return record_program(key, name, source=source, signature=signature,
                           donated=donated, compile_ms=compile_ms,
-                          flops=flops, analyzer=analyzer)
+                          flops=cost["flops"],
+                          bytes_accessed=cost["bytes_accessed"],
+                          sharding=sharding, analyzer=analyzer)
 
 
 def note_hit(key):
@@ -286,10 +327,17 @@ def analyze_pending(max_n: int = 8) -> int:
         ran = 0
         for rec in pending:
             try:
-                rec.memory = rec._analyzer()
+                res = rec._analyzer()
             except Exception as e:  # dead owner / unlowerable avals
                 rec.analyze_error = f"{type(e).__name__}: {e}"[:200]
                 continue
+            # analyzers predating the comm scan (tests inject plain
+            # memory dicts) return the memory breakdown directly
+            if isinstance(res, dict) and "memory" in res:
+                rec.memory = res["memory"]
+                rec.comms = res.get("collectives")
+            else:
+                rec.memory = res
             ran += 1
             for key, gauge in (
                     ("temp_bytes", "jit.program.last_temp_bytes"),
@@ -300,13 +348,35 @@ def analyze_pending(max_n: int = 8) -> int:
                     _set_gauge(gauge, rec.memory[key],
                                doc=f"XLA memory-analysis {key} of the "
                                    "most recently analyzed program")
+            if rec.comms is not None:
+                from . import comms as _comms
+                n_ops, n_bytes = _comms.total_counts(rec.comms)
+                _set_gauge("comm.program.last_collectives", n_ops,
+                           doc="HLO collective instructions in the "
+                               "most recently analyzed program")
+                _set_gauge("comm.program.last_bytes", n_bytes,
+                           doc="estimated per-device collective bytes "
+                               "of the most recently analyzed program")
         if ran:
             with _MU:
                 total = sum(r.memory.get("temp_bytes", 0)
                             for r in _RECORDS if r.memory)
+                comm_ops = comm_bytes = 0
+                for r in _RECORDS:
+                    if r.comms is not None:
+                        from . import comms as _comms
+                        n_ops, n_bytes = _comms.total_counts(r.comms)
+                        comm_ops += n_ops
+                        comm_bytes += n_bytes
             _set_gauge("jit.program.temp_bytes.total", total,
                        doc="summed XLA temp-buffer bytes across "
                            "analyzed programs in the registry")
+            _set_gauge("comm.program.collectives.total", comm_ops,
+                       doc="summed HLO collective instructions across "
+                           "comm-analyzed programs in the registry")
+            _set_gauge("comm.program.bytes.total", comm_bytes,
+                       doc="summed estimated per-device collective "
+                           "bytes across comm-analyzed programs")
         return ran
 
 

@@ -24,6 +24,12 @@ module is the missing scrape target: a flag-gated stdlib
   hit counts, XLA memory breakdown (analyzed lazily, here).
 - ``GET /memory`` — per-device HBM stats + the serving headroom
   estimate (``monitor/memory.py``).
+- ``GET /roofline`` — per-program compute/HBM/comm-bound verdicts +
+  step-level attribution (``monitor/roofline.py``), resolving pending
+  analyses like ``/programs``.
+- ``GET /sharding`` — the sharding-layout inspector
+  (``distributed/introspect.py``): per-leaf PartitionSpecs, shard
+  bytes, replication, cross-device imbalance.
 
 Gating & lifecycle: ``FLAGS_enable_monitor_server`` off (the default)
 means :func:`maybe_start` is ONE cached-flag branch — no thread, no
@@ -201,12 +207,19 @@ class _Handler(BaseHTTPRequestHandler):
                 hr = _memory.headroom()
                 self._send_json(200, {"hbm": hr.pop("hbm"),
                                       "headroom": hr})
+            elif route == "/roofline":
+                from . import roofline as _roofline
+                self._send_json(200, _roofline.roofline_snapshot(
+                    analyze=True, max_analyze=_ANALYZE_PER_SCRAPE))
+            elif route == "/sharding":
+                from ..distributed import introspect as _introspect
+                self._send_json(200, _introspect.sharding_snapshot())
             elif route == "/":
                 self._send_json(200, {
                     "service": "paddle_tpu.monitor",
                     "routes": ["/metrics", "/metrics?scope=fleet",
                                "/healthz", "/flight", "/programs",
-                               "/memory"],
+                               "/memory", "/roofline", "/sharding"],
                 })
             else:
                 self._send_json(404, {"error": f"no route {route!r}"})

@@ -318,6 +318,83 @@ def main():
             from paddle_tpu import monitor as _mon
             _mon.reset()
 
+    @case("roofline_scrape")
+    def _():
+        # comm/roofline observability on the real chip: a guarded train
+        # step + an engine run populate the program registry, then
+        # /roofline must classify both (nonzero FLOPs + bytes-accessed,
+        # non-null boundedness verdict) and /sharding must report
+        # per-leaf layouts. On TPU the HBM-bandwidth denominator must
+        # come from the real generation table, not a fallback.
+        import json as _json
+        import urllib.request
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import server as mon_server
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True})
+        try:
+            cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            # guarded train step through the to_static-equivalent
+            # registration path: the registry must see a training
+            # program, not just serving
+            from paddle_tpu.monitor import programs as mon_programs
+            step = L.make_train_step(cfg, lr=1e-3, donate=False,
+                                     guard=False)
+            opt = L.adamw_init(params)
+            ids = jnp.asarray(rng.integers(
+                0, cfg.vocab_size, (2, 32)).astype(np.int32))
+            params, opt, _loss = step(params, opt, ids)
+            mon_programs.record_jit_call(
+                ("smoke.train_step",), "llama.train_step", step,
+                (params, opt, ids))
+            eng = ServingEngine(L, params, cfg, num_slots=2, max_len=32,
+                                page_size=16, decode_chunk=2)
+            eng.run([Request(
+                rid=i, prompt=rng.integers(0, cfg.vocab_size, (6,))
+                .astype(np.int32), max_new_tokens=4) for i in range(2)])
+            srv = mon_server.get_server()
+            assert srv is not None, "engine did not start the server"
+            rl = _json.load(urllib.request.urlopen(
+                f"{srv.url}/roofline", timeout=30))
+            progs = {p["name"]: p for p in rl["programs"]}
+            assert "llama.train_step" in progs, sorted(progs)
+            assert any(n.startswith("serving.decode_chunk")
+                       for n in progs), sorted(progs)
+            for name, p in progs.items():
+                if name == "llama.train_step" or \
+                        name.startswith("serving.decode_chunk"):
+                    assert p["flops"] and p["flops"] > 0, (name, p)
+                    assert p["bytes_accessed"] and \
+                        p["bytes_accessed"] > 0, (name, p)
+                    assert p["verdict"] in ("compute-bound",
+                                            "hbm-bound",
+                                            "comm-bound"), (name, p)
+                    # comm accounting ran (counts may be 0 on one chip,
+                    # but the scan itself must have happened)
+                    assert p["comms_analyzed"], (name, p)
+                    assert isinstance(p["collective_ops"], int)
+            if on_tpu:
+                assert rl["peaks"]["hbm_source"] == "table", rl["peaks"]
+            sh = _json.load(urllib.request.urlopen(
+                f"{srv.url}/sharding", timeout=10))
+            assert any(k.endswith(".params") for k in sh["trees"]), \
+                sorted(sh["trees"])
+            tree = next(v for k, v in sh["trees"].items()
+                        if k.endswith(".params"))
+            assert tree["num_arrays"] > 0 and tree["leaves"]
+            leaf = tree["leaves"][0]
+            assert leaf["shard_bytes"] > 0 and leaf["dtype"]
+            assert any(p["name"].startswith("serving.")
+                       for p in sh["programs"])
+        finally:
+            mon_server.stop_server()
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp
