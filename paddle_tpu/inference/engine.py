@@ -67,6 +67,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..core import enforce as E
+from ..monitor import profile_capture as _pcap
 from ..monitor import server as _mserver
 from ..monitor import trace as _trace
 from ..monitor.registry import LATENCY_BUCKETS_MS as _LATENCY_BUCKETS_MS
@@ -342,10 +343,12 @@ class ServingEngine:
             f"serving:{self._engine_uid}.params", lambda: self.params)
         key = ("engine", self._engine_uid) + spec_key
         if _programs.has_record(key):
-            return
+            _programs.note_hit(key)
+            return key
         _programs.record_jit_call(key, name, jitted, args,
                                   kwargs=kwargs, source="serving",
                                   donated=donated)
+        return key
 
     # -- submission ---------------------------------------------------------
 
@@ -639,21 +642,29 @@ class ServingEngine:
         pf_kwargs = dict(page_rows=jnp.asarray(rows),
                          slen=jnp.asarray(slen), temp=jnp.asarray(temps),
                          key=jnp.asarray(keys))
+        exec_rec = None
         if mon:
             # introspection-registry record, BEFORE the dispatch that
             # donates the pool buffers (once per specialization)
-            self._record_serving_program(
+            key = self._record_serving_program(
                 ("serving.prefill", g, s_pad, sampled),
                 f"serving.prefill[g{g},s{s_pad}]", pf, pf_args,
                 pf_kwargs, donated=(2, 3))
+            from ..monitor import exectime as _exectime
+            exec_rec = _exectime.maybe_sample(key, feed_last=False)
         with _trace.span("serving.prefill", group=len(group),
-                         s_pad=s_pad):
+                         s_pad=s_pad), \
+                _pcap.annotate("serving.prefill"):
             pk, pv, tok_a = pf(*pf_args, **pf_kwargs)
             self.cache.pool = {"k": pk, "v": pv}
             # the np.asarray download syncs the device — the span ends
             # (and TTFT is stamped) when the first token actually EXISTS
             # on the host, not when the dispatch returned
             toks = np.asarray(tok_a)
+        if exec_rec is not None:
+            # the download above already synchronized: rec(None) adds
+            # ZERO extra block_until_ready calls at this seam
+            exec_rec(None)
         t_first = None
         if mon:
             # TTFT is NOT observed here: a preemption would discard
@@ -802,14 +813,19 @@ class ServingEngine:
                    self.cache.pool["v"], d["bt"], d["tokens"],
                    d["kv_len"], d["done"], d["gen"], keys, d["temps"],
                    d["max_new"], d["eos"])
+        exec_rec = None
         if _monitor.enabled():
-            self._record_serving_program(
+            key = self._record_serving_program(
                 ("serving.decode_chunk", C, self._sampled),
                 f"serving.decode_chunk[c{C}"
                 f"{',sampled' if self._sampled else ''}]",
                 ck, ck_args, None, donated=(1, 2))
+            from ..monitor import exectime as _exectime
+            exec_rec = _exectime.maybe_sample(key, feed_last=False)
         with _trace.span("serving.decode_chunk", chunk=C,
-                         live=len(live_idx)):
+                         live=len(live_idx)), \
+                _pcap.annotate_step("serving.decode_chunk",
+                                    self.stats.decode_steps):
             pk, pv, tok, kvl, done_a, gen_a, emitted = ck(*ck_args)
             self.cache.pool = {"k": pk, "v": pv}
             self._dev.update(tokens=tok, kv_len=kvl, done=done_a,
@@ -820,6 +836,10 @@ class ServingEngine:
             # steps). The download syncs, so the span's end — and the
             # t_chunk stamp below — is when the tokens reached the host.
             emitted = np.asarray(emitted)                # [C, B]
+        if exec_rec is not None:
+            # the emitted-grid download already synchronized this
+            # chunk: rec(None) adds zero block_until_ready calls
+            exec_rec(None)
         t_chunk = time.perf_counter() if _monitor.enabled() else None
         new_tokens = 0
         for i in live_idx:

@@ -498,6 +498,7 @@ class StaticFunction:
     def __compiled_call(self, key, args, kwargs):
         prog = self._programs.get(key)
         t_compile = None
+        exec_rec = None
         if prog is None:
             if _monitor.enabled():
                 # program-cache miss == a fresh trace+compile; a miss on
@@ -516,8 +517,15 @@ class StaticFunction:
         elif _monitor.enabled():
             _monitor.inc("jit.cache.hit",
                          doc="to_static program-cache hits")
+            from ..monitor import exectime as _exectime
             from ..monitor import programs as _programs
             _programs.note_hit(self._registry_key(key))
+            # measured execution plane: 1-in-N sampled wall time of
+            # HIT dispatches only (a miss's wall time is compile —
+            # jit.compile_ms already owns it). The recorder blocks on
+            # the sampled call's outputs below; unsampled calls and
+            # the off path add zero synchronizations.
+            exec_rec = _exectime.maybe_sample(self._registry_key(key))
 
         named_params = self._named_params()
         named_buffers = self._named_buffers()
@@ -540,6 +548,8 @@ class StaticFunction:
         if not need_grad:
             flat_out, new_buffers = prog.jitted(
                 param_arrays, buffer_arrays, arg_arrays, kwarg_arrays)
+            if exec_rec is not None:
+                exec_rec((flat_out, new_buffers))
             compile_ms = self._note_compile(t_compile)
             if t_compile is not None:
                 from ..monitor import mfu as _mfu
@@ -568,6 +578,12 @@ class StaticFunction:
             diff_arg_arrays = tuple(a._data for _, a in diff_args)
             (flat_out, new_buffers), vjp_fn = jax.vjp(
                 closed, train_arrays, diff_arg_arrays)
+            if exec_rec is not None:
+                # the grad path re-traces the vjp composition per call,
+                # so a sample here measures the TRAINING dispatch's
+                # wall time (trace + forward execution) — the number a
+                # drift detector actually wants for this seam
+                exec_rec((flat_out, new_buffers))
             compile_ms = self._note_compile(t_compile)
             if t_compile is not None:
                 # MFU accounting must count what a TRAINING call

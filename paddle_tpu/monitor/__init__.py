@@ -50,6 +50,7 @@ __all__ = [
     "trace", "mfu", "StepTimer", "ambient_phase",
     "server", "programs", "memory", "fleet",
     "comms", "roofline",
+    "exectime", "profile_capture", "timeseries",
     "start_server", "stop_server",
     "suppressed", "suppress_accounting",
 ]
@@ -229,6 +230,8 @@ def reset():
     trace.clear()
     programs.reset()
     fleet.reset()
+    exectime.reset()
+    timeseries.reset()
     # the sharding inspector's registered trees empty with the rest
     # (module-reference lookup: reset() must not be the thing that
     # first imports the distributed package)
@@ -281,5 +284,10 @@ from . import programs  # noqa: E402
 # accounting and compute/HBM/comm-bound attribution over the registry.
 from . import comms  # noqa: E402
 from . import roofline  # noqa: E402
+# Measured performance plane (PR 9): sampled execution timing,
+# on-demand profiler capture, and the step timeseries + drift detector.
+from . import exectime  # noqa: E402
+from . import profile_capture  # noqa: E402
+from . import timeseries  # noqa: E402
 from . import server  # noqa: E402
 from .server import start_server, stop_server  # noqa: E402

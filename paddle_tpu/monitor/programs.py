@@ -38,9 +38,9 @@ import weakref
 from typing import Callable, List, Optional
 
 __all__ = ["ProgramRecord", "record_program", "record_jit_call",
-           "note_hit", "has_record", "analyze_pending", "max_temp_bytes",
-           "programs_snapshot", "signature_of", "analyzer_for",
-           "next_uid", "reset"]
+           "note_hit", "note_exec", "has_record", "analyze_pending",
+           "max_temp_bytes", "programs_snapshot", "signature_of",
+           "analyzer_for", "next_uid", "reset"]
 
 # Bounded registry: a serving process cycling through prompt buckets
 # must not grow this without limit — oldest records evict FIFO.
@@ -79,12 +79,23 @@ class ProgramRecord:
     discipline — an unavailable read stays None, a genuine zero-FLOP
     data-movement program reports 0.0); ``sharding`` is the bounded
     per-leaf layout summary of the call's concrete arguments
-    (``distributed/introspect.py``)."""
+    (``distributed/introspect.py``).
+
+    Measured-execution fields (``monitor/exectime.py`` sampler):
+    ``exec_samples`` / ``exec_total_ms`` / ``exec_max_ms`` accumulate
+    the 1-in-N sampled dispatch-to-outputs-ready wall times — the
+    measured numerator of the roofline ``model_error_ratio`` (None
+    when never sampled, never fabricated). ``last_hit_mono`` is the
+    monotonic stamp of the last cache hit, so ``/programs`` can show
+    staleness — a program that stopped being dispatched is otherwise
+    indistinguishable from a hot one."""
 
     __slots__ = ("key", "name", "source", "signature", "donated",
                  "compile_ms", "flops", "bytes_accessed", "hits",
                  "created_unix", "memory", "comms", "sharding",
-                 "analyze_error", "_analyzer")
+                 "analyze_error", "_analyzer",
+                 "exec_samples", "exec_total_ms", "exec_max_ms",
+                 "last_hit_mono")
 
     def __init__(self, key, name: str, source: str, signature: str,
                  donated=(), compile_ms: Optional[float] = None,
@@ -107,8 +118,20 @@ class ProgramRecord:
         self.comms: Optional[dict] = None
         self.analyze_error: Optional[str] = None
         self._analyzer = analyzer
+        self.exec_samples = 0
+        self.exec_total_ms = 0.0
+        self.exec_max_ms: Optional[float] = None
+        self.last_hit_mono: Optional[float] = None
+
+    def exec_mean_ms(self) -> Optional[float]:
+        """Mean sampled execution ms; None when never sampled — the
+        roofline calibration must not see a fabricated measurement."""
+        if not self.exec_samples:
+            return None
+        return self.exec_total_ms / self.exec_samples
 
     def as_dict(self) -> dict:
+        mean = self.exec_mean_ms()
         return {
             "name": self.name,
             "source": self.source,
@@ -119,6 +142,16 @@ class ProgramRecord:
             "bytes_accessed": self.bytes_accessed,
             "hits": self.hits,
             "created_unix": self.created_unix,
+            "exec_samples": self.exec_samples,
+            "exec_mean_ms": round(mean, 4) if mean is not None else None,
+            "exec_max_ms": round(self.exec_max_ms, 4)
+            if self.exec_max_ms is not None else None,
+            # staleness: seconds since the last cache hit (monotonic
+            # clock — wall-clock steps must not fake hot programs
+            # stale); None when the program was never hit after record
+            "last_hit_age_s": round(time.monotonic()
+                                    - self.last_hit_mono, 3)
+            if self.last_hit_mono is not None else None,
             "memory": self.memory,
             "collectives": self.comms,
             "sharding": self.sharding,
@@ -295,12 +328,26 @@ def record_jit_call(key, name: str, jitted, args: tuple, *,
 
 
 def note_hit(key):
-    """Count a program-cache hit against its record (no-op for keys
-    recorded before the registry existed / after eviction)."""
+    """Count a program-cache hit against its record and stamp its
+    staleness clock (no-op for keys recorded before the registry
+    existed / after eviction)."""
     with _MU:
         rec = _BY_KEY.get(key)
         if rec is not None:
             rec.hits += 1
+            rec.last_hit_mono = time.monotonic()
+
+
+def note_exec(key, ms: float):
+    """Fold one sampled execution time into the program's measured
+    stats (``monitor/exectime.py`` feed; no-op for unknown keys)."""
+    with _MU:
+        rec = _BY_KEY.get(key)
+        if rec is not None:
+            rec.exec_samples += 1
+            rec.exec_total_ms += float(ms)
+            if rec.exec_max_ms is None or ms > rec.exec_max_ms:
+                rec.exec_max_ms = float(ms)
 
 
 def has_record(key) -> bool:

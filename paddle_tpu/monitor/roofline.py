@@ -25,15 +25,28 @@ point is a consistent denominator, not a datasheet.
 All verdicts are honest about missing inputs: a program whose backend
 reported no FLOPs or bytes (``monitor.cost_analysis.unavailable``)
 classifies as ``None``, never as a fabricated bound.
+
+**Calibration** (the measured side, ``monitor/exectime.py``): every
+program carrying sampled execution times composes its measured mean
+wall time with its modeled time into ``model_error_ratio``
+(measured / modeled — ``None`` when unsampled, never fabricated).
+A ratio far from 1 means the analytical model is wrong for that
+program (overlap the roofline max() assumption missed, host overhead,
+a peak table that doesn't match the part); programs beyond
+``PADDLE_TPU_ROOFLINE_ERROR_MAX`` (default 4, either direction) are
+flagged ``model_divergent`` in the ``/roofline`` payload, and the
+worst ratio exports as ``roofline.model.max_error_ratio`` — the
+model-error signal every subsequent perf PR regresses against.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 __all__ = ["PEAK_HBM_GBS_TABLE", "PEAK_ICI_GBS_TABLE",
            "peak_hbm_bytes_per_sec", "peak_ici_bytes_per_sec",
            "ridge_point", "classify", "resolve_peaks",
-           "roofline_snapshot"]
+           "model_error_threshold", "roofline_snapshot"]
 
 # HBM bandwidth per chip by TPU generation (GB/s; public datasheet
 # figures — v5p is the BASELINE.json north-star part).
@@ -177,6 +190,18 @@ def classify(flops: Optional[float], bytes_accessed: Optional[float],
     return out
 
 
+def model_error_threshold() -> float:
+    """Divergence flag threshold for ``model_error_ratio``
+    (``PADDLE_TPU_ROOFLINE_ERROR_MAX``, default 4): a program whose
+    measured/modeled ratio exceeds it — or undercuts its reciprocal —
+    is flagged ``model_divergent``."""
+    try:
+        v = float(os.environ.get("PADDLE_TPU_ROOFLINE_ERROR_MAX", "4"))
+        return v if v > 1.0 else 4.0
+    except ValueError:
+        return 4.0
+
+
 def roofline_snapshot(analyze: bool = True, max_analyze: int = 8,
                       device=None) -> dict:
     """The ``/roofline`` payload + the bench ``extra.metrics.roofline``
@@ -199,9 +224,16 @@ def roofline_snapshot(analyze: bool = True, max_analyze: int = 8,
     if analyze:
         _programs.analyze_pending(max_analyze)
     peaks = resolve_peaks(device)
+    err_thr = model_error_threshold()
     progs = []
     total_t = total_comm_t = 0.0
-    classified = 0
+    classified = measured = 0
+    # worst ratio in EITHER direction: a 0.05x ratio (model 20x over-
+    # estimates) is a bigger model error than a 1.1x — rank by
+    # max(ratio, 1/ratio), report the actual ratio
+    max_error = None
+    max_error_dev = 0.0
+    divergent = []
     for rec in _programs.programs_snapshot():
         comm_ops, comm_bytes = _comms.total_counts(rec.get("collectives"))
         cls = classify(rec.get("flops"), rec.get("bytes_accessed"),
@@ -217,6 +249,30 @@ def roofline_snapshot(analyze: bool = True, max_analyze: int = 8,
             "comms_analyzed": rec.get("collectives") is not None,
             **cls,
         }
+        # calibration: measured (sampled) mean wall time vs the model.
+        # Both legs must exist — an unsampled or unclassified program
+        # keeps model_error_ratio None, never a fabricated number.
+        exec_mean_ms = rec.get("exec_mean_ms")
+        entry["exec_samples"] = rec.get("exec_samples", 0)
+        entry["exec_mean_ms"] = exec_mean_ms
+        entry["exec_max_ms"] = rec.get("exec_max_ms")
+        ratio = None
+        if exec_mean_ms is not None and cls["t_modeled_s"]:
+            ratio = (exec_mean_ms / 1e3) / cls["t_modeled_s"]
+            measured += 1
+            dev = max(ratio, 1.0 / ratio) if ratio > 0 else float("inf")
+            if max_error is None or dev > max_error_dev:
+                max_error, max_error_dev = ratio, dev
+        entry["model_error_ratio"] = round(ratio, 4) \
+            if ratio is not None else None
+        entry["model_divergent"] = bool(
+            ratio is not None
+            and (ratio > err_thr or ratio < 1.0 / err_thr))
+        if entry["model_divergent"]:
+            divergent.append({"name": entry["name"],
+                              "model_error_ratio":
+                                  entry["model_error_ratio"],
+                              "verdict": cls["verdict"]})
         if cls["t_modeled_s"] is not None:
             classified += 1
             entry["t_modeled_total_s"] = cls["t_modeled_s"] * invocations
@@ -237,6 +293,13 @@ def roofline_snapshot(analyze: bool = True, max_analyze: int = 8,
                    round(comm_fraction, 6),
                    doc="fraction of total modeled program time spent "
                        "in collectives (invocation-weighted)")
+    if max_error is not None:
+        _set_gauge("roofline.model.max_error_ratio",
+                   round(max_error, 4),
+                   doc="worst measured/modeled execution-time ratio "
+                       "across sampled registry programs (worst in "
+                       "EITHER direction, ranked by max(r, 1/r)) — "
+                       "the roofline model-error signal")
     verdicts = {}
     for p in progs:
         v = p["verdict"] or "unclassified"
@@ -245,6 +308,13 @@ def roofline_snapshot(analyze: bool = True, max_analyze: int = 8,
         "peaks": peaks,
         "programs": progs,
         "comm": _comms.comm_summary(),
+        "calibration": {
+            "measured_programs": measured,
+            "max_error_ratio": round(max_error, 4)
+            if max_error is not None else None,
+            "error_threshold": err_thr,
+            "divergent": divergent,
+        },
         "attribution": {
             "total_modeled_s": total_t,
             "comm_fraction": round(comm_fraction, 6)

@@ -30,6 +30,13 @@ module is the missing scrape target: a flag-gated stdlib
 - ``GET /sharding`` — the sharding-layout inspector
   (``distributed/introspect.py``): per-leaf PartitionSpecs, shard
   bytes, replication, cross-device imbalance.
+- ``GET /timeseries`` — the bounded step-indexed ring
+  (``monitor/timeseries.py``): per-step phase ms / loss / goodput /
+  sampled exec ms plus the step-time drift report.
+- ``GET /profile?seconds=N`` — on-demand device profiler capture
+  (``monitor/profile_capture.py``): one exclusive
+  ``jax.profiler`` window into a bounded capture directory; a second
+  concurrent request answers **409**.
 
 Gating & lifecycle: ``FLAGS_enable_monitor_server`` off (the default)
 means :func:`maybe_start` is ONE cached-flag branch — no thread, no
@@ -214,12 +221,18 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/sharding":
                 from ..distributed import introspect as _introspect
                 self._send_json(200, _introspect.sharding_snapshot())
+            elif route == "/timeseries":
+                from . import timeseries as _timeseries
+                self._send_json(200, _timeseries.timeseries_snapshot())
+            elif route == "/profile":
+                self._profile(parse_qs(url.query))
             elif route == "/":
                 self._send_json(200, {
                     "service": "paddle_tpu.monitor",
                     "routes": ["/metrics", "/metrics?scope=fleet",
                                "/healthz", "/flight", "/programs",
-                               "/memory", "/roofline", "/sharding"],
+                               "/memory", "/roofline", "/sharding",
+                               "/timeseries", "/profile?seconds=N"],
                 })
             else:
                 self._send_json(404, {"error": f"no route {route!r}"})
@@ -238,6 +251,36 @@ class _Handler(BaseHTTPRequestHandler):
         _observe("monitor.server.scrape_ms",
                  (time.perf_counter() - t0) * 1e3,
                  doc="wall time serving one operator-plane request")
+
+    def _profile(self, query: dict):
+        """On-demand profiler capture: blocks this handler thread for
+        the window (the server is threading — other routes keep
+        serving), 409 when a capture is already running, 400 on a bad
+        ``seconds``."""
+        from . import inc as _inc
+        from . import profile_capture as _pcap
+
+        raw = (query.get("seconds") or ["1"])[0]
+        try:
+            seconds = float(raw)
+        except ValueError:
+            self._send_json(400, {
+                "error": f"seconds={raw!r} is not a number"})
+            return
+        if not 0 < seconds <= _pcap.MAX_SECONDS:
+            self._send_json(400, {
+                "error": f"seconds must be in (0, {_pcap.MAX_SECONDS}]"
+                         f", got {seconds}"})
+            return
+        try:
+            info = _pcap.capture_sync(seconds)
+        except _pcap.CaptureBusy as e:
+            _inc("monitor.profile.busy_rejected",
+                 doc="/profile requests refused because a capture "
+                     "window was already open (HTTP 409)")
+            self._send_json(409, {"error": str(e)})
+            return
+        self._send_json(200, info)
 
     def _metrics(self, query: dict):
         from . import expose_text as _expose_text

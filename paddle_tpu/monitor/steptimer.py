@@ -132,6 +132,10 @@ class StepTimer:
         self._prev_active: list = []
         self._mu = threading.Lock()
         self._totals = {p: 0.0 for p in _PHASES}
+        # this step's phase seconds (reset at end_step): the per-step
+        # split the timeseries ring records alongside the cumulative
+        # histograms
+        self._step_phase = {p: 0.0 for p in _PHASES}
         self._steps = 0
         self._useful_tokens = 0
         self._t_first: Optional[float] = None
@@ -177,6 +181,7 @@ class StepTimer:
         from . import observe as _observe
         with self._mu:
             self._totals[phase] += seconds
+            self._step_phase[phase] += seconds
             now = time.perf_counter()
             if self._t_first is None:
                 self._t_first = now - seconds
@@ -190,10 +195,15 @@ class StepTimer:
                         time.perf_counter_ns() - int(seconds * 1e9),
                         int(seconds * 1e9), timer=self.name)
 
-    def end_step(self, useful_tokens: int = 0):
+    def end_step(self, useful_tokens: int = 0, loss=None):
         """Close one step: observes the step total, counts useful
-        tokens, refreshes the goodput gauges. Step listeners (the hang
-        watchdog's heartbeats) fire first, monitor on or off."""
+        tokens, refreshes the goodput gauges, and appends one row to
+        the step timeseries (``monitor/timeseries.py`` — phase split,
+        optional ``loss``, the step's sampled exec ms when one landed).
+        Step listeners (the hang watchdog's heartbeats) fire first,
+        monitor on or off. Pass ``loss`` only when it is already a
+        host value — coercing a device scalar here would add a sync
+        the loop didn't ask for."""
         for fn in tuple(_STEP_LISTENERS):
             try:
                 fn()
@@ -201,9 +211,11 @@ class StepTimer:
                 pass
         if not _FLAG.value:
             return
+        from . import exectime as _exectime
         from . import inc as _inc
         from . import observe as _observe
         from . import set_gauge as _set_gauge
+        from . import timeseries as _timeseries
         now = time.perf_counter()
         with self._mu:
             t_open = self._t_step_open if self._t_step_open is not None \
@@ -216,6 +228,19 @@ class StepTimer:
                 if self._t_first is not None else 0.0
             tokens = self._useful_tokens
             compute_s = self._totals["compute"]
+            step_phase = dict(self._step_phase)
+            for p in _PHASES:
+                self._step_phase[p] = 0.0
+        _timeseries.record_step(
+            step=self._steps,
+            total_ms=(now - t_open) * 1e3,
+            data_wait_ms=step_phase["data_wait"] * 1e3,
+            compute_ms=step_phase["compute"] * 1e3,
+            checkpoint_ms=step_phase["checkpoint"] * 1e3,
+            loss=loss,
+            goodput_tokens_per_sec=(tokens / wall)
+            if (wall > 0 and tokens) else None,
+            exec_ms=_exectime.take_last_sample_ms())
         _observe("train.step.total_ms", (now - t_open) * 1e3,
                  doc="wall time of one full train step (all phases + "
                      "untracked host time)", buckets=_PHASE_BUCKETS)

@@ -206,6 +206,15 @@ def flight_payload(reason: str = "manual") -> dict:
     record without waiting for a crash); ``dump_flight_record`` writes
     the same shape on crash paths."""
     from . import snapshot as _snapshot
+    try:
+        # the step-time trajectory (monitor/timeseries.py): a crash's
+        # black box should show whether steps were slowing down, not
+        # just the final distribution. Guarded — a flight dump on a
+        # crash path must never die on a telemetry extra.
+        from . import timeseries as _timeseries
+        ts = _timeseries.timeseries_snapshot()
+    except Exception:
+        ts = None
     return {
         "kind": "paddle_tpu.flight_record",
         "reason": reason,
@@ -215,6 +224,7 @@ def flight_payload(reason: str = "manual") -> dict:
         "trace_total_events": _RING.total,
         "events": events(),
         "metrics": _snapshot(),
+        "timeseries": ts,
     }
 
 

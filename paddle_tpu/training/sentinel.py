@@ -63,6 +63,8 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..core import flags as _flags
+from ..monitor import profile_capture as _pcap
+from ..monitor import timeseries as _timeseries
 from ..monitor import trace as _trace
 from ..testing import faults as _faults
 
@@ -189,6 +191,11 @@ class AnomalySentinel:
         self.anomalies = 0
         self.rollbacks = 0
         self.quarantine: set = set()
+        # step-time drift (monitor/timeseries.py), OBSERVE-ONLY: the
+        # ladder sees the signal (health provider, flight record) but
+        # a slow step never changes a verdict — slowness is a paging
+        # problem, not a data-corruption one.
+        self.step_time_drift: Optional[float] = None
 
     # -- device-gate feed ---------------------------------------------------
 
@@ -341,6 +348,10 @@ def _sentinel_health_provider(ref):
             "rollbacks": sent.rollbacks,
             "max_rollbacks": sent.config.max_rollbacks,
             "quarantined": len(sent.quarantine),
+            # observe-only drift visibility: the ladder never acts on
+            # it, but the operator reading /healthz sees slowness next
+            # to the anomaly state
+            "step_time_drift": sent.step_time_drift,
         }
     return provide
 
@@ -419,15 +430,35 @@ class SentinelLoop:
                 _trace.instant("anomaly.quarantine_skip", step=self.step)
                 continue
             cap = jnp.asarray(self.sentinel.gnorm_cap(), jnp.float32)
-            params, opt, loss, health = self.step_fn(
-                self.params, self.opt_state, batch, cap)
-            verdict = self.sentinel.observe(
-                finite=health["finite"], grad_norm=health["grad_norm"],
-                loss=loss, batch=batch)
+            t_step = time.perf_counter()
+            # StepTraceAnnotation only while an on-demand profiler
+            # capture window is open (null context otherwise), so
+            # device trace steps correlate with the host spans
+            with _pcap.annotate_step("train.step", self.step):
+                params, opt, loss, health = self.step_fn(
+                    self.params, self.opt_state, batch, cap)
+                verdict = self.sentinel.observe(
+                    finite=health["finite"],
+                    grad_norm=health["grad_norm"],
+                    loss=loss, batch=batch)
+            # observe() coerced the health scalars, so the step has
+            # synchronized: t_step -> now is a device-complete wall
+            # time — the timeseries row the drift detector consumes
+            step_ms = (time.perf_counter() - t_step) * 1e3
             self.params, self.opt_state = params, opt
             self.step += 1
             if self.watchdog is not None:
                 self.watchdog.heartbeat()
+            if _monitor.enabled():
+                from ..monitor import exectime as _exectime
+                _timeseries.record_step(
+                    step=self.step, total_ms=step_ms,
+                    loss=float(loss) if verdict == OK else None,
+                    grad_norm_ema=self.sentinel.stats.mean
+                    if self.sentinel.stats.n else None,
+                    exec_ms=_exectime.take_last_sample_ms())
+                self.sentinel.step_time_drift = \
+                    _timeseries.drift_status().get("ratio")
             if verdict == OK:
                 self.applied += 1
                 self.last_loss = float(loss)

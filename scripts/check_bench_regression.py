@@ -53,6 +53,17 @@ ALLOWLIST = {
     "packed_tokens_per_sec": "extra.training_packed.packed_tokens_per_sec",
 }
 
+# LOWER-is-better rungs (measured exec-ms distributions from the
+# performance plane's extra.metrics.exec block). Guarded separately:
+# the floor is the BEST (minimum) prior value and a candidate fails by
+# EXCEEDING it beyond tolerance. Absence on old BENCH_r*.json files
+# (the block predates them) simply contributes no floor — skipped,
+# never zero-floored.
+ALLOWLIST_LOWER = {
+    "headline_exec_ms_p50": "extra.metrics.exec.headline.p50_ms",
+    "decode_exec_ms_p50": "extra.metrics.exec.decode.p50_ms",
+}
+
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 
@@ -153,7 +164,23 @@ def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
     for _, rungs in prior:
         for rung, v in rungs.items():
             floors[rung] = max(floors.get(rung, 0.0), v)
-    if not floors:
+    # lower-is-better rungs (measured exec ms): best prior = MINIMUM.
+    # Runs predating the exec block contribute nothing here — their
+    # absence is a skip, never a 0 ceiling that every candidate would
+    # "exceed".
+    lower_allow = ALLOWLIST_LOWER if allowlist is None else {}
+    traj_lower = load_trajectory(root, lower_allow) if lower_allow \
+        else []
+    lower_by_round = dict(traj_lower)
+    newest_lower = lower_by_round.get(newest_round, {})
+    ceilings: dict = dict(published_baselines(root, lower_allow))
+    for rnd, rungs in traj_lower:
+        if rnd == newest_round:
+            continue
+        for rung, v in rungs.items():
+            prev = ceilings.get(rung)
+            ceilings[rung] = v if prev is None else min(prev, v)
+    if not floors and not ceilings:
         lines.append(f"bench guard: r{newest_round:02d} is the first "
                      "successful run — baseline established, nothing "
                      "to compare (pass)")
@@ -176,6 +203,24 @@ def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
         elif verbose:
             lines.append(f"  ✓ {rung}: {v:.2f} vs baseline {floor:.2f} "
                          f"({ratio:.3f}x)")
+    for rung, ceiling in sorted(ceilings.items()):
+        v = newest_lower.get(rung)
+        if v is None:
+            lines.append(f"  ~ {rung}: absent from r{newest_round:02d} "
+                         f"(baseline {ceiling:.2f} ms) — not a failure")
+            continue
+        limit = ceiling * (1.0 + tolerance)
+        ratio = v / ceiling
+        if v > limit:
+            ok = False
+            lines.append(
+                f"  ✗ {rung}: {v:.2f} ms is {ratio:.3f}x of baseline "
+                f"{ceiling:.2f} ms — above the {1 + tolerance:.2f}x "
+                "noise ceiling (lower is better): REGRESSION")
+        elif verbose:
+            lines.append(f"  ✓ {rung}: {v:.2f} ms vs baseline "
+                         f"{ceiling:.2f} ms ({ratio:.3f}x, lower is "
+                         "better)")
     lines.insert(0, f"bench guard: r{newest_round:02d} vs "
                     f"{len(prior)} prior run(s) + published floors, "
                     f"tolerance {tolerance:.0%}: "

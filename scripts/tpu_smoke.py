@@ -333,6 +333,8 @@ def main():
         from paddle_tpu.monitor import server as mon_server
         paddle.set_flags({"FLAGS_enable_monitor": True,
                           "FLAGS_enable_monitor_server": True})
+        from paddle_tpu.monitor import exectime as mon_exectime
+        mon_exectime.set_sample_rate(1)   # every dispatch measured
         try:
             cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
             params = L.init_params(cfg, jax.random.PRNGKey(0))
@@ -349,6 +351,10 @@ def main():
             mon_programs.record_jit_call(
                 ("smoke.train_step",), "llama.train_step", step,
                 (params, opt, ids))
+            # measured side: an explicitly timed execution so the
+            # train-step record carries exec stats for calibration
+            mon_exectime.time_call(("smoke.train_step",), step,
+                                   params, opt, ids)
             eng = ServingEngine(L, params, cfg, num_slots=2, max_len=32,
                                 page_size=16, decode_chunk=2)
             eng.run([Request(
@@ -377,6 +383,17 @@ def main():
                     assert isinstance(p["collective_ops"], int)
             if on_tpu:
                 assert rl["peaks"]["hbm_source"] == "table", rl["peaks"]
+            # roofline CALIBRATION: at least one registered program
+            # must report a measured/modeled error ratio (non-null,
+            # never fabricated) — the acceptance gate of the measured
+            # performance plane
+            measured = [p for p in rl["programs"]
+                        if p.get("model_error_ratio") is not None]
+            assert measured, \
+                "no program reported model_error_ratio at /roofline"
+            assert rl["calibration"]["measured_programs"] >= 1, \
+                rl["calibration"]
+            assert rl["calibration"]["max_error_ratio"] > 0
             sh = _json.load(urllib.request.urlopen(
                 f"{srv.url}/sharding", timeout=10))
             assert any(k.endswith(".params") for k in sh["trees"]), \
@@ -389,10 +406,115 @@ def main():
             assert any(p["name"].startswith("serving.")
                        for p in sh["programs"])
         finally:
+            mon_exectime.set_sample_rate(None)
             mon_server.stop_server()
             paddle.set_flags({"FLAGS_enable_monitor": False,
                               "FLAGS_enable_monitor_server": False})
             from paddle_tpu import monitor as _mon
+            _mon.reset()
+
+    @case("profile_capture")
+    def _():
+        # on-demand device profiler capture end to end: flags on, a
+        # short engine run DURING the /profile?seconds=1 window, then a
+        # parseable trace directory. TPU asserts device events landed
+        # in the xplane (CPU accepts host-only traces).
+        import json as _json
+        import tempfile
+        import urllib.request
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import profile_capture as pcap
+        from paddle_tpu.monitor import server as mon_server
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True})
+        prof_dir = tempfile.mkdtemp(prefix="smoke_prof_")
+        os.environ["PADDLE_TPU_PROFILE_DIR"] = prof_dir
+        try:
+            cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.bfloat16)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            eng = ServingEngine(L, params, cfg, num_slots=2, max_len=32,
+                                page_size=16, decode_chunk=2)
+            eng.run([Request(       # compile OUTSIDE the window
+                rid=0, prompt=rng.integers(0, cfg.vocab_size, (6,))
+                .astype(np.int32), max_new_tokens=4)])
+            srv = mon_server.get_server()
+            assert srv is not None
+
+            stop = threading.Event()
+
+            def churn():
+                # throttled: the point is device events DURING the
+                # window, not maximum op volume — an unthrottled tiny-
+                # model loop floods the host tracer and stop_trace
+                # then spends a minute serializing it on CPU
+                rid = 100
+                while not stop.is_set():
+                    eng.run([Request(
+                        rid=rid, prompt=rng.integers(
+                            0, cfg.vocab_size, (6,)).astype(np.int32),
+                        max_new_tokens=4)])
+                    rid += 1
+                    stop.wait(0.25)
+
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+            try:
+                # generous timeout: the window is 1s but stop_trace
+                # serialization scales with traced op volume
+                info = _json.load(urllib.request.urlopen(
+                    f"{srv.url}/profile?seconds=1", timeout=240))
+            finally:
+                stop.set()
+                t.join(timeout=60)
+            assert info["files"], f"empty capture: {info}"
+            xplanes = [f for f in info["files"]
+                       if f["path"].endswith(".xplane.pb")
+                       and (f["bytes"] or 0) > 0]
+            assert xplanes, f"no xplane in capture: {info['files']}"
+            assert os.path.isdir(info["dir"])
+            if on_tpu:
+                blob = b""
+                for f in xplanes:
+                    with open(os.path.join(info["dir"], f["path"]),
+                              "rb") as fh:
+                        blob += fh.read()
+                assert b"TPU" in blob, \
+                    "no device events in the TPU capture"
+        finally:
+            mon_server.stop_server()
+            os.environ.pop("PADDLE_TPU_PROFILE_DIR", None)
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+
+    @case("drift_detect")
+    def _():
+        # step-time drift detection end to end through the StepTimer
+        # seam: a synthetic slowdown (sleep-padded compute phases) must
+        # trip train.step.drift_ratio and the /timeseries drift report
+        from paddle_tpu import monitor as _mon
+        from paddle_tpu.monitor import timeseries as ts
+        paddle.set_flags({"FLAGS_enable_monitor": True})
+        try:
+            _mon.reset()
+            st = _mon.StepTimer("smoke.drift")
+            for i in range(16):          # baseline: fast steps
+                with st.compute():
+                    time.sleep(0.004)
+                st.end_step()
+            for i in range(8):           # recent: 4x slower
+                with st.compute():
+                    time.sleep(0.016)
+                st.end_step()
+            status = ts.drift_status()
+            assert status["ratio"] and status["ratio"] > 1.25, status
+            assert status["drifting"], status
+            g = _mon.snapshot()["gauges"].get("train.step.drift_ratio")
+            assert g and g > 1.25, f"drift gauge did not trip: {g}"
+        finally:
+            paddle.set_flags({"FLAGS_enable_monitor": False})
             _mon.reset()
 
     @case("ragged_paged_attention_kernel")
