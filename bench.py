@@ -290,6 +290,11 @@ def _metrics_summary():
                 "tokens_padding": c.get("packing.tokens.padding", 0),
                 "varlen_dispatch": _varlen_dispatch_counters(),
             },
+            # numerics plane (monitor/numerics.py): per-layer grad
+            # stats, worst-layer attribution, quantization SQNR audit,
+            # KV-page absmax — zeros/None when the run never enabled
+            # FLAGS_enable_numerics or sampled KV pages
+            "numerics": _numerics_block(),
             # operator plane (monitor/memory.py + monitor/programs.py):
             # HBM occupancy at end of run (empty on backends that
             # report nothing — never fabricated) and the compiled-
@@ -365,6 +370,31 @@ def _roofline_block():
             "comm_fraction": rs["attribution"]["comm_fraction"],
             "dominant": rs["attribution"]["dominant"],
             "comm": rs["comm"],
+        }
+    except Exception as e:                      # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _numerics_block():
+    """extra.metrics.numerics: the numerics plane condensed — step
+    coverage, worst layer, the quant audit's floor SQNR, KV-page
+    absmax distribution bounds. Full per-tensor detail stays on the
+    /numerics endpoint."""
+    try:
+        from paddle_tpu.monitor import numerics as _nm
+        snap = _nm.numerics_snapshot(n=0)
+        kv = snap["kv"]
+        quant = snap["quant"] or {}
+        return {
+            "steps": snap["total_steps"],
+            "tensors_tracked": len(snap["tensors"]),
+            "worst_layer": snap["worst_layer"],
+            "top_movers": snap["top_movers"][:3],
+            "quant_tensors": len(quant.get("tensors", {})),
+            "quant_min_sqnr_db": quant.get("min_sqnr_db"),
+            "kv_samples": kv["samples"],
+            "kv_pages": kv["pages"],
+            "kv_absmax_max": kv["max"],
         }
     except Exception as e:                      # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:200]}

@@ -99,6 +99,16 @@ define_flag("enable_sentinel", False,
             "losses (any model). Other families (dit, ocr) are not yet "
             "guarded. Off = one cached branch, zero extra device "
             "outputs.")
+define_flag("enable_numerics", False,
+            "Numerics plane: the GUARDED train steps (see "
+            "enable_sentinel) additionally compute per-layer tensor "
+            "statistics (absmax/rms/mean/zero fraction, overflow/"
+            "underflow fraction vs dtype range, per-layer grad-norm "
+            "breakdown) as fused on-device reductions, returned as a "
+            "'numerics' block in the health aux pytree and fed to "
+            "paddle_tpu.monitor.numerics. Only meaningful with the "
+            "sentinel guard on; off = the guarded step is byte-"
+            "identical to the pre-numerics program.")
 define_flag("enable_monitor_server", False,
             "Serve the operator plane (paddle_tpu.monitor.server): an "
             "HTTP daemon with /metrics (Prometheus text), /healthz "

@@ -284,6 +284,13 @@ class ServingEngine:
                             donate_argnums=(1, 2))
             for c in (self.decode_chunk, self.turbo_chunk)
             for s in (False, True)}
+        # KV-page absmax sampling (monitor/numerics.py): 1-in-N decode
+        # chunks dispatch a tiny per-layer per-page |K|/|V| max over
+        # the pool AFTER the chunk's emitted-grid download has already
+        # synchronized the device — zero added block_until_ready calls
+        # at any rate (PR 9's pattern, pinned by test)
+        self._kv_chunks = 0
+        self._kv_absmax_fn = None
         # device-side slot state, reused across chunks until a
         # join/retire/preempt (state) or page-table change (bt) dirties it
         self._dev: dict = {}
@@ -840,6 +847,8 @@ class ServingEngine:
             # the emitted-grid download already synchronized this
             # chunk: rec(None) adds zero block_until_ready calls
             exec_rec(None)
+        if _monitor.enabled():
+            self._maybe_sample_kv_absmax()
         t_chunk = time.perf_counter() if _monitor.enabled() else None
         new_tokens = 0
         for i in live_idx:
@@ -866,6 +875,40 @@ class ServingEngine:
                            doc="generated tokens / (decode steps x slots)")
         _monitor.inc("serving.tokens.generated", new_tokens)
         return True
+
+    def _maybe_sample_kv_absmax(self):
+        """KV-page absmax distribution feed (numerics plane): every
+        1-in-N chunks (``PADDLE_TPU_KV_SAMPLE``; 0 disables) compute
+        per-layer per-page max|K| / max|V| over the pool, keep only
+        the pages the allocator holds live (free pages are zeros that
+        would drown the distribution), and record them. Runs right
+        after the chunk's token download — the device is idle, so the
+        small [L, P] compute + transfer rides the existing seam with
+        zero extra synchronizations of in-flight work."""
+        from ..monitor import numerics as _numerics
+        rate = _numerics.kv_sample_rate()
+        if rate <= 0:
+            return
+        self._kv_chunks += 1
+        if self._kv_chunks < rate:
+            return
+        self._kv_chunks = 0
+        in_use = np.flatnonzero(self.cache.alloc._ref > 0)
+        if in_use.size == 0:
+            return
+        if self._kv_absmax_fn is None:
+            # pool layout [L, P, kv, page, hd] -> per-layer per-page
+            self._kv_absmax_fn = jax.jit(
+                lambda k, v: (
+                    jnp.max(jnp.abs(k), axis=(2, 3, 4)
+                            ).astype(jnp.float32),
+                    jnp.max(jnp.abs(v), axis=(2, 3, 4)
+                            ).astype(jnp.float32)))
+        km, vm = self._kv_absmax_fn(self.cache.pool["k"],
+                                    self.cache.pool["v"])
+        km = np.asarray(km)[:, in_use]
+        vm = np.asarray(vm)[:, in_use]
+        _numerics.record_kv_absmax(km, vm)
 
     def run(self, requests=None, max_steps: int = 1_000_000
             ) -> Dict[int, RequestOutput]:

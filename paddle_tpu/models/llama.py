@@ -41,7 +41,8 @@ from .. import nn
 from ..nn import functional as F
 from ..core import enforce as E
 from ..training.guards import (gated_update, grad_global_norm,
-                               resolve_guard, step_health)
+                               grad_numerics, resolve_guard,
+                               resolve_numerics, step_health)
 from ..nn.functional.attention import (gather_rope_rows as _gather_rope_rows,
                                        rope_raw, rope_tables as _rope_tables,
                                        sdpa_raw)
@@ -201,9 +202,17 @@ def _mm(x, w):
     weight-only-quantized {"q": int8 [in, out], "s": f32 [out]} dict
     (reference: nn/quant weight_only_linear). The dequant fuses into
     the dot under XLA, so HBM reads stay int8 — on the HBM-bound decode
-    path that halves the weight traffic."""
+    path that halves the weight traffic.
+
+    Dequant ordering matters for SQNR: the q*s multiply runs in f32
+    with ONE cast to the activation dtype. The old
+    ``q.astype(bf16) * s.astype(bf16)`` rounded the f32 scale AND the
+    product — double rounding that measurably degraded bf16 SQNR
+    (caught by the monitor/numerics.py quantization auditor, pinned
+    by tests/test_numerics.py)."""
     if isinstance(w, dict):
-        return x @ (w["q"].astype(x.dtype) * w["s"][None, :].astype(x.dtype))
+        return x @ (w["q"].astype(jnp.float32)
+                    * w["s"][None, :]).astype(x.dtype)
     return x @ w
 
 
@@ -211,7 +220,9 @@ def _head_logits(x2d, head):
     """lm-head logits [.., V] from hidden [.., D]; head is [V, D] (or
     its weight-only form {"q": int8 [V, D], "s": f32 [V]})."""
     if isinstance(head, dict):
-        w = head["q"].astype(x2d.dtype) * head["s"][:, None].astype(x2d.dtype)
+        # f32 multiply, one cast — the _mm dequant-ordering contract
+        w = (head["q"].astype(jnp.float32)
+             * head["s"][:, None]).astype(x2d.dtype)
     else:
         w = head
     return jnp.einsum("...d,vd->...v", x2d, w,
@@ -816,7 +827,8 @@ def make_forward(config: LlamaConfig, mesh: Optional[Mesh] = None):
 def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
                     lr: float = 3e-4, weight_decay: float = 0.1,
                     sp: bool = False, donate: bool = True,
-                    guard: Optional[bool] = None):
+                    guard: Optional[bool] = None,
+                    numerics: Optional[bool] = None):
     """Build `(params, opt_state, batch) -> (params, opt_state, loss)`.
 
     With a mesh (axes 'dp','fsdp','tp'): full GSPMD hybrid parallelism —
@@ -837,8 +849,18 @@ def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
     ``health`` = {"finite", "grad_norm"} feeds the host-side
     ``training.sentinel`` policy engine. Unguarded (the default with
     the flag off), the step is exactly the 3-in/3-out program above:
-    zero extra device outputs."""
+    zero extra device outputs.
+
+    ``numerics`` (default: ``FLAGS_enable_numerics``; guarded step
+    only) adds ``health["numerics"]`` — the in-graph per-layer tensor
+    statistics of the gradients (``training.guards.grad_numerics``:
+    absmax/rms/mean/zero fraction, overflow/underflow fraction vs
+    dtype range, and the per-layer grad-norm breakdown whose squared
+    entries sum to ``grad_norm``) as fused reductions in the SAME
+    compiled program. Off (the default) the guarded step is
+    byte-identical to the pre-numerics program."""
     guard = resolve_guard(guard)
+    numerics = guard and resolve_numerics(numerics)
 
     def grads_of(params, batch):
         return jax.value_and_grad(
@@ -856,6 +878,10 @@ def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
         loss, grads = grads_of(params, batch)
         ok, health = step_health(loss, grads, unpack_batch(batch)[0],
                                  config.vocab_size, gnorm_cap)
+        if numerics:
+            # fused per-layer reductions over the grads the step already
+            # holds — same program, small f32 aux outputs
+            health["numerics"] = grad_numerics(grads)
         params, opt_state = gated_update(ok, update, params, opt_state,
                                          grads)
         return params, opt_state, loss, health
@@ -871,11 +897,17 @@ def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None, *,
     dshard = NamedSharding(mesh, P(("dp", "fsdp"), None))
     scalar = NamedSharding(mesh, P())
     if guard:
+        # the health aux scalars replicate; with numerics on, `scalar`
+        # acts as a pytree PREFIX covering the whole stats subtree
+        # (every entry is a replicated scalar or [L] row). Without
+        # numerics the explicit dict keeps the program byte-identical
+        # to the pre-numerics one.
+        hshard = scalar if numerics else {"finite": scalar,
+                                          "grad_norm": scalar}
         return jax.jit(
             guarded_step,
             in_shardings=(pshard, oshard, dshard, scalar),
-            out_shardings=(pshard, oshard, scalar,
-                           {"finite": scalar, "grad_norm": scalar}),
+            out_shardings=(pshard, oshard, scalar, hshard),
             donate_argnums=dn)
     return jax.jit(step,
                    in_shardings=(pshard, oshard, dshard),

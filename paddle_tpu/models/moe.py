@@ -184,9 +184,12 @@ def _edeq(w, dtype):
     """Expert-grid weight for the batched einsums: plain array, or the
     weight-only form {"q": int8 [E, in, out], "s": f32 [E, out]}
     dequantized into the einsum (the convert fuses under XLA, so HBM
-    reads stay int8 — same seam as llama's _mm)."""
+    reads stay int8 — same seam as llama's _mm, including its dequant
+    ordering: f32 multiply, ONE cast, so the f32 scale is never
+    double-rounded through bf16)."""
     if isinstance(w, dict):
-        return w["q"].astype(dtype) * w["s"][:, None, :].astype(dtype)
+        return (w["q"].astype(jnp.float32)
+                * w["s"][:, None, :]).astype(dtype)
     return w
 
 
@@ -598,7 +601,8 @@ def adamw_init(params):
 
 def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
                     lr: float = 1e-4, donate: bool = True,
-                    guard: Optional[bool] = None):
+                    guard: Optional[bool] = None,
+                    numerics: Optional[bool] = None):
     """Jitted AdamW train step; with a mesh, params/opt-state placements
     come from param_specs and the batch shards over ('dp','fsdp').
     Buffer donation updates params/opt-state in place — without it the
@@ -610,11 +614,16 @@ def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
     ``llama.make_train_step``): the update gates on
     ``llama.step_health``'s ok flag behind a ``lax.cond``, anomalous
     steps leave params/opt-state byte-identical, and the health aux
-    scalars feed ``training.sentinel``."""
+    scalars feed ``training.sentinel``. ``numerics`` (default:
+    ``FLAGS_enable_numerics``; guarded step only) adds the in-graph
+    per-layer grad statistics block — same contract as the llama
+    family's."""
     from .llama import _adamw_update, unpack_batch
-    from ..training.guards import (gated_update, resolve_guard,
+    from ..training.guards import (gated_update, grad_numerics,
+                                   resolve_guard, resolve_numerics,
                                    step_health)
     guard = resolve_guard(guard)
+    numerics = guard and resolve_numerics(numerics)
 
     def grads_of(params, batch):
         return jax.value_and_grad(
@@ -632,6 +641,8 @@ def make_train_step(config: MoEConfig, mesh: Optional[Mesh] = None, *,
         loss, grads = grads_of(params, batch)
         ok, health = step_health(loss, grads, unpack_batch(batch)[0],
                                  config.vocab_size, gnorm_cap)
+        if numerics:
+            health["numerics"] = grad_numerics(grads)
         params, opt_state = gated_update(ok, update, params, opt_state,
                                          grads)
         return params, opt_state, loss, health

@@ -50,7 +50,7 @@ __all__ = [
     "trace", "mfu", "StepTimer", "ambient_phase",
     "server", "programs", "memory", "fleet",
     "comms", "roofline",
-    "exectime", "profile_capture", "timeseries",
+    "exectime", "profile_capture", "timeseries", "numerics",
     "start_server", "stop_server",
     "suppressed", "suppress_accounting",
 ]
@@ -232,6 +232,7 @@ def reset():
     fleet.reset()
     exectime.reset()
     timeseries.reset()
+    numerics.reset()
     # the sharding inspector's registered trees empty with the rest
     # (module-reference lookup: reset() must not be the thing that
     # first imports the distributed package)
@@ -289,5 +290,11 @@ from . import roofline  # noqa: E402
 from . import exectime  # noqa: E402
 from . import profile_capture  # noqa: E402
 from . import timeseries  # noqa: E402
+# Numerics plane (PR 11): per-layer grad statistics, quantization
+# SQNR audit, KV-page absmax distributions. Imported after the trace/
+# timeseries modules: its guards import pulls in training.sentinel,
+# which reads those submodules off this (partially initialized)
+# package.
+from . import numerics  # noqa: E402
 from . import server  # noqa: E402
 from .server import start_server, stop_server  # noqa: E402

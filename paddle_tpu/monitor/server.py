@@ -33,6 +33,10 @@ module is the missing scrape target: a flag-gated stdlib
 - ``GET /timeseries`` — the bounded step-indexed ring
   (``monitor/timeseries.py``): per-step phase ms / loss / goodput /
   sampled exec ms plus the step-time drift report.
+- ``GET /numerics`` — the numerics plane (``monitor/numerics.py``):
+  per-layer grad statistics + worst-layer attribution, the latest
+  weight-quantization SQNR audit, and the KV-page absmax
+  distribution.
 - ``GET /profile?seconds=N`` — on-demand device profiler capture
   (``monitor/profile_capture.py``): one exclusive
   ``jax.profiler`` window into a bounded capture directory; a second
@@ -224,6 +228,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/timeseries":
                 from . import timeseries as _timeseries
                 self._send_json(200, _timeseries.timeseries_snapshot())
+            elif route == "/numerics":
+                from . import numerics as _numerics
+                self._send_json(200, _numerics.numerics_snapshot())
             elif route == "/profile":
                 self._profile(parse_qs(url.query))
             elif route == "/":
@@ -232,7 +239,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "routes": ["/metrics", "/metrics?scope=fleet",
                                "/healthz", "/flight", "/programs",
                                "/memory", "/roofline", "/sharding",
-                               "/timeseries", "/profile?seconds=N"],
+                               "/timeseries", "/numerics",
+                               "/profile?seconds=N"],
                 })
             else:
                 self._send_json(404, {"error": f"no route {route!r}"})

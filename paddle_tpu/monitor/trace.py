@@ -215,6 +215,13 @@ def flight_payload(reason: str = "manual") -> dict:
         ts = _timeseries.timeseries_snapshot()
     except Exception:
         ts = None
+    try:
+        # the value trajectory (monitor/numerics.py): which layer's
+        # gradients were blowing up before the crash. Same guard.
+        from . import numerics as _numerics
+        nm = _numerics.numerics_snapshot(n=32)
+    except Exception:
+        nm = None
     return {
         "kind": "paddle_tpu.flight_record",
         "reason": reason,
@@ -225,6 +232,7 @@ def flight_payload(reason: str = "manual") -> dict:
         "events": events(),
         "metrics": _snapshot(),
         "timeseries": ts,
+        "numerics": nm,
     }
 
 

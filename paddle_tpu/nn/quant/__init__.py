@@ -80,13 +80,18 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
 
 @op_fn(name="weight_only_linear_op", nondiff_args=(1,))
 def _wol_op(x, qweight, scale, bias=None, *, algo, in_features):
+    # dequant in f32 with ONE cast to the activation dtype (the
+    # models/llama.py _mm ordering): casting the f32 scale to bf16
+    # before the multiply double-rounds and degrades SQNR
+    s32 = scale.astype(jnp.float32)
     if algo == "weight_only_int4":
         lo = (qweight << 4).astype(jnp.int8) >> 4
         hi = qweight >> 4
         full = jnp.stack([lo, hi], axis=1).reshape(-1, qweight.shape[1])
-        w = full[:in_features].astype(x.dtype) * scale[None, :].astype(x.dtype)
+        w = (full[:in_features].astype(jnp.float32)
+             * s32[None, :]).astype(x.dtype)
     else:
-        w = qweight.astype(x.dtype) * scale[None, :].astype(x.dtype)
+        w = (qweight.astype(jnp.float32) * s32[None, :]).astype(x.dtype)
     out = x @ w
     return out + bias if bias is not None else out
 

@@ -196,6 +196,17 @@ class AnomalySentinel:
         # a slow step never changes a verdict — slowness is a paging
         # problem, not a data-corruption one.
         self.step_time_drift: Optional[float] = None
+        # worst-layer attribution (monitor/numerics.py), OBSERVE-ONLY:
+        # {"name", "grad_norm", "finite"} of the latest numerics-
+        # enabled guarded step, set by the loop BEFORE observe() so a
+        # SKIP/ROLLBACK names a layer instead of a scalar — the
+        # verdict ladder itself never reads it. ``worst_layer_at_
+        # anomaly`` freezes the attribution of the most recent
+        # anomalous step: healthy steps after a skip keep refreshing
+        # ``worst_layer``, but the operator reading the health report
+        # still sees which layer blew up.
+        self.worst_layer: Optional[dict] = None
+        self.worst_layer_at_anomaly: Optional[dict] = None
 
     # -- device-gate feed ---------------------------------------------------
 
@@ -258,9 +269,16 @@ class AnomalySentinel:
             _monitor.set_gauge("train.anomaly.quarantined",
                                len(self.quarantine),
                                doc="batch hashes in the quarantine set")
+        wl = self.worst_layer
+        if wl is not None:
+            self.worst_layer_at_anomaly = wl
         _trace.instant("anomaly.skip", consecutive=self.consecutive,
                        nonfinite=nonfinite,
-                       grad_norm=g if math.isfinite(g) else None)
+                       grad_norm=g if math.isfinite(g) else None,
+                       worst_layer=wl["name"] if wl else None,
+                       worst_layer_grad_norm=(
+                           wl["grad_norm"] if wl and wl["finite"]
+                           else None))
         if self.manager is not None \
                 and self.consecutive >= c.max_consecutive:
             return ROLLBACK
@@ -298,8 +316,10 @@ class AnomalySentinel:
         _monitor.inc("train.anomaly.rollbacks",
                      doc="checkpoint restores triggered by consecutive "
                          "anomalies")
+        wl = self.worst_layer
         _trace.instant("anomaly.rollback", step=step,
-                       rollbacks=self.rollbacks)
+                       rollbacks=self.rollbacks,
+                       worst_layer=wl["name"] if wl else None)
         return step
 
     # -- multi-host agreement -----------------------------------------------
@@ -352,6 +372,23 @@ def _sentinel_health_provider(ref):
             # it, but the operator reading /healthz sees slowness next
             # to the anomaly state
             "step_time_drift": sent.step_time_drift,
+            # observe-only numerics attribution: which layer's grad
+            # norm dominated the latest numerics-enabled step (a
+            # fleet of skips names a layer, not a scalar)
+            "worst_layer": (sent.worst_layer or {}).get("name"),
+            # None when non-finite: NaN would make the JSON probe
+            # response unparseable for strict readers; "finite" below
+            # carries the distinction
+            "worst_layer_grad_norm":
+                (sent.worst_layer or {}).get("grad_norm")
+                if (sent.worst_layer or {}).get("finite") else None,
+            "worst_layer_finite":
+                (sent.worst_layer or {}).get("finite"),
+            # frozen at the most recent ANOMALY: the layer that blew
+            # up stays visible after healthy steps refresh the latest
+            # view above
+            "worst_layer_last_anomaly":
+                (sent.worst_layer_at_anomaly or {}).get("name"),
         }
     return provide
 
@@ -437,6 +474,17 @@ class SentinelLoop:
             with _pcap.annotate_step("train.step", self.step):
                 params, opt, loss, health = self.step_fn(
                     self.params, self.opt_state, batch, cap)
+                if "numerics" in health and _monitor.enabled():
+                    # numerics-enabled guarded step: feed the plane and
+                    # refresh the sentinel's worst-layer attribution
+                    # BEFORE observe(), so a SKIP/ROLLBACK instant
+                    # names THIS step's layer. The host coercion here
+                    # is the same sync observe() performs anyway.
+                    from ..monitor import numerics as _numerics
+                    wl = _numerics.record_step_stats(
+                        health["numerics"], step=self.step + 1)
+                    if wl is not None:
+                        self.sentinel.worst_layer = wl
                 verdict = self.sentinel.observe(
                     finite=health["finite"],
                     grad_norm=health["grad_norm"],

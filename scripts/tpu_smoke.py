@@ -517,6 +517,86 @@ def main():
             paddle.set_flags({"FLAGS_enable_monitor": False})
             _mon.reset()
 
+    @case("numerics_scrape")
+    def _():
+        # the numerics plane end to end: numerics-enabled guarded
+        # steps + engine churn with KV sampling, then /numerics must
+        # serve per-layer grad stats, a worst-layer attribution, a
+        # finite nonzero int8 SQNR audit, and KV-page absmax samples
+        import json as _json
+        import urllib.request
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import numerics as mon_numerics
+        from paddle_tpu.monitor import server as mon_server
+        from paddle_tpu.training.sentinel import (AnomalySentinel,
+                                                  SentinelConfig,
+                                                  SentinelLoop)
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True,
+                          "FLAGS_enable_numerics": True})
+        mon_numerics.set_kv_sample_rate(1)
+        try:
+            cfg = L.llama_tiny(num_hidden_layers=2, vocab_size=64)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            opt = L.adamw_init(params)
+            step = L.make_train_step(cfg, lr=1e-3, guard=True,
+                                     donate=False)
+
+            def batches():
+                for i in range(4):
+                    r = np.random.default_rng(2000 + i)
+                    ids = r.integers(0, 64, (2, 33)).astype(np.int32)
+                    yield ids[:, :-1], ids[:, 1:]
+
+            loop = SentinelLoop(step, params, opt, batches,
+                                sentinel=AnomalySentinel(
+                                    SentinelConfig(agree=False)))
+            out = loop.run(4)
+            assert out["applied"] == 4, out
+            # int8 audit through the shared seam contract
+            mon_numerics.audit_quantized_tree(
+                params, L.quantize_weights(params),
+                serving_dtype=jnp.bfloat16)
+            eng = ServingEngine(L, params, cfg, num_slots=2,
+                                max_len=32, page_size=16,
+                                decode_chunk=2)
+            eng.run([Request(
+                rid=i, prompt=rng.integers(0, 64, (6,))
+                .astype(np.int32), max_new_tokens=6)
+                for i in range(2)])
+            srv = mon_server.get_server()
+            assert srv is not None, "loop did not start the server"
+            p = _json.load(urllib.request.urlopen(
+                f"{srv.url}/numerics", timeout=10))
+            assert p["total_steps"] == 4, p["total_steps"]
+            assert any(k.startswith("layers.") for k in p["tensors"]), \
+                sorted(p["tensors"])[:10]
+            wq0 = p["tensors"]["layers.wq[0]"]
+            assert wq0["gnorm"] and wq0["gnorm"] > 0
+            assert wq0["absmax_ema"] and wq0["absmax_ema"] > 0
+            assert p["worst_layer"]["name"] and \
+                p["worst_layer"]["finite"]
+            for name, ent in p["quant"]["tensors"].items():
+                assert ent["sqnr_db"] and ent["sqnr_db"] > 0, \
+                    (name, ent)
+            assert p["quant"]["min_sqnr_db"] > 0
+            assert p["kv"]["samples"] > 0 and p["kv"]["max"] > 0
+            # sentinel health report names a layer
+            hz = _json.load(urllib.request.urlopen(
+                f"{srv.url}/healthz", timeout=10))
+            sent = next(v for k, v in hz["providers"].items()
+                        if k.startswith("sentinel:"))
+            assert sent["worst_layer"], sent
+        finally:
+            mon_numerics.set_kv_sample_rate(None)
+            mon_server.stop_server()
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False,
+                              "FLAGS_enable_numerics": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp

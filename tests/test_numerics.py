@@ -1,0 +1,810 @@
+"""Numerics plane (ISSUE 11): in-graph per-layer tensor statistics in
+the guarded train steps (``training/guards.py`` ``grad_numerics``),
+the host-side plane (``monitor/numerics.py``: timeseries, worst-layer
+attribution, quantization SQNR audit, KV-page absmax), the sentinel's
+observe-only worst-layer attribution, the engine's per-chunk KV
+sampling seam, the ``/numerics`` route + flight-record block, the
+off-flag byte-identical pins, and the int8 dequant cast-ordering
+bugfix."""
+import importlib
+import json
+import math
+import urllib.request
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.models import llama as L
+from paddle_tpu.models import moe as M
+from paddle_tpu.monitor import numerics as NM
+from paddle_tpu.testing import faults
+from paddle_tpu.training import guards as G
+from paddle_tpu.training import sentinel as S
+
+FA = importlib.import_module("paddle_tpu.kernels.flash_attention")
+
+B, T, V = 2, 16, 64
+INF_CAP = jnp.asarray(np.inf, jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.clear()
+    pt.set_flags({"FLAGS_enable_sentinel": False,
+                  "FLAGS_enable_numerics": False,
+                  "FLAGS_enable_monitor": False,
+                  "FLAGS_enable_monitor_server": False})
+    NM.set_kv_sample_rate(None)
+    from paddle_tpu.monitor import exectime
+    exectime.set_sample_rate(None)
+    from paddle_tpu.monitor import server as _srv
+    _srv.stop_server()
+    monitor.reset()
+
+
+def _batch(i, vocab=V):
+    r = np.random.RandomState(1000 + i)
+    ids = r.randint(0, vocab, size=(B, T + 1)).astype(np.int32)
+    return ids[:, :-1], ids[:, 1:]
+
+
+def _llama():
+    cfg = L.llama_tiny(vocab_size=V)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params, L.adamw_init(params)
+
+
+def _np_stats(g, reduce_axes):
+    """Pure-numpy reference of guards.tensor_stats."""
+    xf = np.asarray(g, np.float32)
+    fi = np.finfo(np.dtype(np.asarray(g).dtype)) \
+        if np.issubdtype(np.asarray(g).dtype, np.floating) else None
+    over_t = fi.max / 2.0 if fi is not None else np.inf
+    under_t = fi.tiny if fi is not None else 0.0
+    ax = reduce_axes
+    n = np.prod([xf.shape[a] for a in ax]) if ax else 1.0
+    if ax is None:
+        n, ax = xf.size, tuple(range(xf.ndim))
+    absx = np.abs(xf)
+    return {
+        "absmax": absx.max(axis=ax),
+        "rms": np.sqrt((xf * xf).sum(axis=ax) / n),
+        "mean": xf.sum(axis=ax) / n,
+        "zero_frac": (xf == 0).sum(axis=ax) / n,
+        "overflow_frac": (absx > over_t).sum(axis=ax) / n,
+        "underflow_frac": ((absx < under_t) & (xf != 0)).sum(axis=ax) / n,
+        "gnorm_sq": (xf * xf).sum(axis=ax),
+    }
+
+
+# ---------------------------------------------------------------------------
+# in-graph stats: parity, agreement, dtype boundaries
+# ---------------------------------------------------------------------------
+
+class TestInGraphStats:
+    def test_stats_parity_vs_numpy_reference(self):
+        """The guarded+numerics step's stats block equals a pure-numpy
+        recomputation from the same gradients."""
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+        batch = _batch(0)
+        _, _, _, h = step(params, opt, batch, INF_CAP)
+        _, grads = jax.value_and_grad(
+            lambda p: L.loss_fn(p, batch, cfg))(params)
+        nm = h["numerics"]
+        for name, g in grads["layers"].items():
+            want = _np_stats(np.asarray(g),
+                             tuple(range(1, np.asarray(g).ndim)))
+            for stat in G.NUMERIC_STATS:
+                np.testing.assert_allclose(
+                    np.asarray(nm["layers"][name][stat]), want[stat],
+                    rtol=2e-4, atol=1e-7, err_msg=f"{name}.{stat}")
+        for name in ("embed", "ln_f", "lm_head"):
+            want = _np_stats(np.asarray(grads[name]), None)
+            for stat in G.NUMERIC_STATS:
+                np.testing.assert_allclose(
+                    np.asarray(nm["tensors"][name][stat]), want[stat],
+                    rtol=2e-4, atol=1e-7, err_msg=f"{name}.{stat}")
+
+    def test_stats_parity_holds_on_both_attention_arms(self):
+        """Kernel-interpret and jnp-fallback attention produce the same
+        numerics block (within float tolerance) for the same packed
+        batch — the stats are attention-impl-independent."""
+        from paddle_tpu.io import packing as PK
+        from paddle_tpu.nn.functional import attention as att
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+        rng = np.random.default_rng(5)
+        docs = [rng.integers(0, V, (ln,)).astype(np.int32)
+                for ln in (40, 24)]
+        pb = PK.packed_train_batch(PK.pack_documents(docs, 64))
+        prev = att._SEGMENT_IMPL
+        blocks = []
+        try:
+            for impl in (None,                    # jnp fallback
+                         lambda *a, **kw: FA.flash_attention_segments(
+                             *a, **kw, interpret=True)):
+                att.register_segment_impl(impl)
+                _, _, _, h = step(params, opt, pb, INF_CAP)
+                blocks.append(jax.tree.map(np.asarray, h["numerics"]))
+        finally:
+            att.register_segment_impl(prev)
+        for a, b in zip(jax.tree.leaves(blocks[0]),
+                        jax.tree.leaves(blocks[1])):
+            np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-6)
+
+    def test_per_layer_sums_agree_with_global_norm(self):
+        """sqrt(sum of every gnorm_sq entry) == the guarded step's
+        grad_norm — the breakdown tiles the global norm exactly."""
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+        _, _, _, h = step(params, opt, _batch(1), INF_CAP)
+        nm = h["numerics"]
+        tot = sum(float(np.sum(np.asarray(s["gnorm_sq"])))
+                  for s in nm["layers"].values())
+        tot += sum(float(np.asarray(s["gnorm_sq"]))
+                   for s in nm["tensors"].values())
+        np.testing.assert_allclose(math.sqrt(tot),
+                                   float(h["grad_norm"]), rtol=1e-5)
+
+    def test_moe_family_same_contract(self):
+        cfg = M.moe_tiny(vocab_size=V)
+        params = M.init_params(cfg, jax.random.PRNGKey(0))
+        opt = M.adamw_init(params)
+        step = M.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+        _, _, _, h = step(params, opt, _batch(0), INF_CAP)
+        nm = h["numerics"]
+        assert "router" in nm["layers"] and "e_gate" in nm["layers"]
+        tot = sum(float(np.sum(np.asarray(s["gnorm_sq"])))
+                  for s in nm["layers"].values())
+        tot += sum(float(np.asarray(s["gnorm_sq"]))
+                   for s in nm["tensors"].values())
+        np.testing.assert_allclose(math.sqrt(tot),
+                                   float(h["grad_norm"]), rtol=1e-5)
+
+    def test_overflow_underflow_fraction_at_dtype_boundaries(self):
+        """Crafted fp16 values straddling the dtype range: 3/8 within
+        2x of finfo.max (overflow band: |x| > 32752), 1/8 nonzero
+        below finfo.tiny (underflow band: 0 < |x| < 6.1e-5), 2/8
+        exact zeros. fp16 keeps the bands far from f32's own
+        subnormal range, so the f32 accumulation of the stats sees
+        them exactly (bf16 subnormals can flush on XLA:CPU)."""
+        fi = jnp.finfo(jnp.float16)
+        arr = jnp.asarray(
+            [float(fi.max) * 0.9, 4e4, -5e4,       # over max/2
+             1.0, -0.5,                            # normal
+             1e-5,                                 # below tiny, nonzero
+             0.0, 0.0], jnp.float16)
+        st = jax.tree.map(float, G.tensor_stats(arr))
+        assert st["overflow_frac"] == pytest.approx(3 / 8)
+        assert st["underflow_frac"] == pytest.approx(1 / 8)
+        assert st["zero_frac"] == pytest.approx(2 / 8)
+        assert st["absmax"] == pytest.approx(float(
+            jnp.asarray(float(fi.max) * 0.9, jnp.float16)), rel=1e-6)
+
+    def test_exactly_at_thresholds_not_counted(self):
+        """The bands are strict: |x| == max/2 is not overflow, a
+        normal at exactly finfo.tiny is not underflow."""
+        fi = jnp.finfo(jnp.float32)
+        arr = jnp.asarray([float(fi.max) / 2.0, float(fi.tiny)],
+                          jnp.float32)
+        st = jax.tree.map(float, G.tensor_stats(arr))
+        assert st["overflow_frac"] == 0.0
+        assert st["underflow_frac"] == 0.0
+
+    def test_integer_tensor_has_no_float_range(self):
+        st = jax.tree.map(float, G.tensor_stats(
+            jnp.asarray([0, 5, -3], jnp.int32)))
+        assert st["overflow_frac"] == 0.0
+        assert st["underflow_frac"] == 0.0
+        assert st["zero_frac"] == pytest.approx(1 / 3)
+
+    def test_per_layer_rows_keep_axis_zero(self):
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        st = G.tensor_stats(x, reduce_axes=(1,))
+        assert np.asarray(st["absmax"]).shape == (3,)
+        np.testing.assert_allclose(np.asarray(st["mean"]),
+                                   np.arange(12).reshape(3, 4)
+                                   .mean(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# off-flag pins: byte-identical program, zero registrations
+# ---------------------------------------------------------------------------
+
+class TestOffFlagPins:
+    def test_numerics_off_guarded_health_is_two_keys(self):
+        """FLAGS_enable_numerics unset -> the guarded step is exactly
+        the pre-numerics 4-in/4-out program: health holds only
+        finite + grad_norm."""
+        cfg, params, opt = _llama()
+        pt.set_flags({"FLAGS_enable_sentinel": True})
+        step = L.make_train_step(cfg, donate=False)
+        out = step(params, opt, _batch(0), INF_CAP)
+        assert len(out) == 4 and sorted(out[3]) == ["finite",
+                                                    "grad_norm"]
+
+    def test_guard_off_stays_3_in_3_out_even_with_numerics_flag(self):
+        """Numerics is a guarded-step feature: with the sentinel off,
+        the numerics flag must not change the step's arity."""
+        cfg, params, opt = _llama()
+        pt.set_flags({"FLAGS_enable_numerics": True})
+        step = L.make_train_step(cfg, donate=False)
+        out = step(params, opt, _batch(0))
+        assert len(out) == 3
+        with pytest.raises(TypeError):
+            step(params, opt, _batch(0), INF_CAP)
+        # explicit numerics=True without guard: same pin
+        step2 = L.make_train_step(cfg, donate=False, guard=False,
+                                  numerics=True)
+        assert len(step2(params, opt, _batch(0))) == 3
+
+    def test_flag_resolves_numerics_on_guarded_step(self):
+        cfg, params, opt = _llama()
+        pt.set_flags({"FLAGS_enable_sentinel": True,
+                      "FLAGS_enable_numerics": True})
+        step = L.make_train_step(cfg, donate=False)
+        out = step(params, opt, _batch(0), INF_CAP)
+        assert "numerics" in out[3]
+
+    def test_zero_registrations_without_numerics(self):
+        """Monitor on, numerics flag off: a guarded step + an engine
+        run with KV sampling disabled register nothing under
+        numerics.*."""
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.set_kv_sample_rate(0)
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, donate=False)
+        step(params, opt, _batch(0), INF_CAP)
+        snap = monitor.snapshot()
+        names = (list(snap.get("counters", {}))
+                 + list(snap.get("gauges", {}))
+                 + list(snap.get("histograms", {})))
+        assert not [n for n in names if n.startswith("numerics.")]
+
+    def test_record_paths_noop_when_monitor_off(self):
+        assert not monitor.enabled()
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+        _, _, _, h = step(params, opt, _batch(0), INF_CAP)
+        assert NM.record_step_stats(h["numerics"]) is None
+        NM.record_kv_absmax(np.ones((2, 4), np.float32))
+        # the audit still RETURNS its report (explicit analysis), but
+        # persists nothing off-flag
+        rep = NM.audit_quantized_tree(params,
+                                      L.quantize_weights(params))
+        assert rep["tensors"] and NM.last_audit() is None
+        snap = NM.numerics_snapshot()
+        assert snap["total_steps"] == 0
+        assert snap["kv"]["samples"] == 0
+        assert snap["quant"] is None
+        assert monitor.snapshot() == {}
+
+    def test_guarded_update_math_unchanged_by_numerics(self):
+        """The numerics block is pure observation: params/opt/loss of
+        the numerics step equal the plain guarded step's exactly."""
+        cfg, params, opt = _llama()
+        a = L.make_train_step(cfg, guard=True, donate=False)
+        b = L.make_train_step(cfg, guard=True, numerics=True,
+                              donate=False)
+        pa, oa, la, _ = a(params, opt, _batch(0), INF_CAP)
+        pb, ob, lb, _ = b(params, opt, _batch(0), INF_CAP)
+        assert float(la) == float(lb)
+        for x, y in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(oa), jax.tree.leaves(ob)):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# GSPMD/donation invariance
+# ---------------------------------------------------------------------------
+
+class TestMeshInvariance:
+    def test_mesh_guarded_numerics_step_runs_with_donation(self):
+        """The numerics aux outputs are replicated scalars/[L] rows —
+        the sharding prefix must compose with the llama mesh path's
+        explicit out_shardings and donation."""
+        from jax.sharding import Mesh
+        cfg, params, opt = _llama()
+        devs = np.array(jax.devices()[:4]).reshape(1, 2, 2)
+        mesh = Mesh(devs, ("dp", "fsdp", "tp"))
+        step = L.make_train_step(cfg, mesh=mesh, guard=True,
+                                 numerics=True)
+        sharded = L.shard_params(params, cfg, mesh)
+        oshard = jax.tree.map(lambda p: p, L.adamw_init(sharded))
+        with mesh:
+            p2, o2, loss, h = step(sharded, oshard, _batch(0), INF_CAP)
+        assert np.isfinite(float(loss))
+        nm = h["numerics"]
+        assert np.asarray(nm["layers"]["wq"]["gnorm_sq"]).shape == \
+            (cfg.num_hidden_layers,)
+        tot = sum(float(np.sum(np.asarray(s["gnorm_sq"])))
+                  for s in nm["layers"].values())
+        tot += sum(float(np.asarray(s["gnorm_sq"]))
+                   for s in nm["tensors"].values())
+        np.testing.assert_allclose(math.sqrt(tot),
+                                   float(h["grad_norm"]), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# host plane: timeseries, movers, worst layer
+# ---------------------------------------------------------------------------
+
+def _fake_stats(layer_gnorms, leaf="wq"):
+    """Minimal stats tree: one stacked leaf with given per-layer
+    squared norms (other stats filled consistently)."""
+    g = np.asarray(layer_gnorms, np.float32)
+    z = np.zeros_like(g)
+    return {"layers": {leaf: {
+        "absmax": np.sqrt(g), "rms": np.sqrt(g), "mean": z,
+        "zero_frac": z, "overflow_frac": z, "underflow_frac": z,
+        "gnorm_sq": g}}, "tensors": {}}
+
+
+class TestNumericsPlane:
+    def setup_method(self, _):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+
+    def test_worst_layer_names_the_spiking_layer(self):
+        wl = NM.record_step_stats(_fake_stats([1.0, 100.0, 4.0]))
+        assert wl["name"] == "wq" or wl["name"] == "layers.wq[1]"
+        assert wl == NM.worst_layer()
+        assert wl["name"] == "layers.wq[1]"
+        assert wl["grad_norm"] == pytest.approx(10.0)
+        assert wl["finite"]
+
+    def test_nonfinite_layer_outranks_any_finite_norm(self):
+        wl = NM.record_step_stats(
+            _fake_stats([1e30, float("nan"), 2.0]))
+        assert wl["name"] == "layers.wq[1]"
+        assert not wl["finite"]
+        g = monitor.snapshot()["gauges"]
+        assert g["numerics.worst.gnorm"] == -1.0
+
+    def test_ring_is_bounded_with_lifetime_evidence(self):
+        cap = NM.numerics_snapshot()["capacity"]
+        for i in range(cap + 5):
+            NM.record_step_stats(_fake_stats([1.0, 2.0]), step=i)
+        snap = NM.numerics_snapshot()
+        assert len(snap["rows"]) == cap
+        assert snap["total_steps"] == cap + 5
+        # n selects the LAST n rows; n=0 means none (the bench
+        # condensation), not the whole ring
+        assert len(NM.numerics_snapshot(n=3)["rows"]) == 3
+        assert NM.numerics_snapshot(n=0)["rows"] == []
+
+    def test_top_movers_rank_by_either_direction(self):
+        """A 10x collapse must rank above a 2x growth (max(r, 1/r))."""
+        for _ in range(20):     # settle the EMAs
+            NM.record_step_stats(_fake_stats([4.0, 4.0]))
+        NM.record_step_stats(_fake_stats([4.0 * 0.01, 4.0 * 4.0]))
+        movers = NM.top_movers()
+        assert movers[0]["name"] == "layers.wq[0]"
+        assert movers[0]["ratio"] < 1.0
+
+    def test_gauges_and_counters_emitted(self):
+        NM.record_step_stats(_fake_stats([1.0, 9.0]))
+        snap = monitor.snapshot()
+        assert snap["counters"]["numerics.steps"] == 1
+        assert snap["gauges"]["numerics.tensors.tracked"] == 2
+        assert snap["gauges"]["numerics.worst.gnorm"] == \
+            pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# quantization audit: SQNR math + cast-ordering fix
+# ---------------------------------------------------------------------------
+
+class TestQuantAudit:
+    def test_sqnr_math_vs_numpy(self):
+        rng = np.random.default_rng(0)
+        ref = rng.normal(size=(64, 32)).astype(np.float32)
+        noisy = ref + rng.normal(size=ref.shape).astype(np.float32) * 1e-3
+        r64, n64 = ref.astype(np.float64), noisy.astype(np.float64)
+        want = 10 * np.log10((r64 ** 2).sum()
+                             / ((r64 - n64) ** 2).sum())
+        assert NM.sqnr_db(ref, noisy) == pytest.approx(float(want),
+                                                       rel=1e-9)
+        assert NM.sqnr_db(ref, ref) == float("inf")
+        assert NM.sqnr_db(np.zeros(4), np.ones(4)) == float("-inf")
+
+    def test_audit_int8_tree_finite_nonzero_sqnr(self):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        cfg, params, _ = _llama()
+        qp = L.quantize_weights(params)
+        report = NM.audit_quantized_tree(params, qp,
+                                         serving_dtype=jnp.bfloat16)
+        assert report["tensors"], "audit found no quantized leaves"
+        for path, ent in report["tensors"].items():
+            assert math.isfinite(ent["sqnr_db"]) and \
+                ent["sqnr_db"] > 20.0, (path, ent)
+            assert ent["max_abs_err"] > 0
+            assert math.isfinite(ent["sqnr_served_db"]), (path, ent)
+        assert report["min_sqnr_db"] is not None
+        assert math.isfinite(report["min_sqnr_db"])
+        assert NM.last_audit() is report
+
+    def test_audit_moe_tree_covers_expert_grids(self):
+        cfg = M.moe_tiny(vocab_size=V)
+        params = M.init_params(cfg, jax.random.PRNGKey(0))
+        qp = M.quantize_weights(params)
+        report = NM.audit_quantized_tree(params, qp)
+        assert "layers.e_gate" in report["tensors"]
+        assert report["tensors"]["layers.e_gate"]["sqnr_db"] > 20.0
+
+    def test_wrong_axis_scale_collapses_sqnr(self):
+        """The auditor is the wrong-axis tripwire: pairing a correctly
+        quantized int8 grid with a scale reduced over the WRONG axis
+        collapses SQNR from >30 dB to nonsense."""
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32)
+                        * 0.05)
+        good = L.quant_int8(w, in_axis=0)       # s [128], per out-chan
+        right = NM.sqnr_db(np.asarray(w),
+                           NM.dequant_ref(good["q"], good["s"]))
+        wrong_s = np.abs(np.asarray(w)).max(axis=1) / 127.0  # [256]
+        wrong = NM.sqnr_db(np.asarray(w),
+                           NM.dequant_ref(good["q"], wrong_s))
+        assert right > 30.0
+        assert wrong < right - 15.0     # >15 dB collapse trips review
+
+    def test_dequant_ref_rejects_unmatchable_scale(self):
+        with pytest.raises(ValueError):
+            NM.dequant_ref(np.zeros((4, 6), np.int8),
+                           np.zeros((5,), np.float32))
+
+    def test_mm_dequant_cast_ordering_fixed(self):
+        """The serving seams dequantize in f32 with ONE cast to the
+        activation dtype: the seam's bf16 output must bit-match the
+        f32-multiply reference, and its SQNR must be >= the old
+        double-rounded ordering's (the fixed regression)."""
+        rng = np.random.default_rng(2)
+        w = jnp.asarray(rng.normal(size=(64, 48)).astype(np.float32)
+                        * 0.1)
+        q = L.quant_int8(w, in_axis=0)
+        x = jnp.eye(64, dtype=jnp.bfloat16)   # identity: _mm == deq(w)
+        seam = np.asarray(L._mm(x, q), np.float32)
+        want = np.asarray(
+            (q["q"].astype(jnp.float32) * q["s"][None, :])
+            .astype(jnp.bfloat16) @ jnp.eye(48, dtype=jnp.bfloat16),
+            np.float32)
+        np.testing.assert_array_equal(seam, want)
+        old = np.asarray(
+            (q["q"].astype(jnp.bfloat16)
+             * q["s"][None, :].astype(jnp.bfloat16)), np.float32)
+        ref = np.asarray(w)
+        assert NM.sqnr_db(ref, seam) >= NM.sqnr_db(ref, old)
+
+    def test_weight_only_linear_bf16_cast_ordering_fixed(self):
+        """nn.quant.weight_only_linear shares the fixed ordering: with
+        bf16 activations the dequantized weight it matmuls against is
+        the f32 product cast ONCE (int8 and int4 paths)."""
+        from paddle_tpu.nn.quant import (weight_only_linear,
+                                         weight_quantize)
+        from paddle_tpu.core.tensor import to_tensor
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=(64, 48)).astype(np.float32) * 0.1
+        x16 = np.eye(64, dtype=np.float32)
+        for algo, dt in (("weight_only_int8", "int8"),
+                         ("weight_only_int4", "int4")):
+            q, s = weight_quantize(to_tensor(w), algo=algo)
+            out = weight_only_linear(
+                to_tensor(jnp.asarray(x16, jnp.bfloat16)), q,
+                weight_scale=s, weight_dtype=dt)
+            got = np.asarray(out.numpy(), np.float32)
+            # reference: unpack+dequant in f32, one cast to bf16
+            from paddle_tpu.nn.quant import weight_dequantize
+            wd = np.asarray(weight_dequantize(
+                q, s, algo=algo, out_dtype="float32").numpy())
+            want = np.asarray(
+                jnp.asarray(x16, jnp.bfloat16)
+                @ jnp.asarray(wd, jnp.float32).astype(jnp.bfloat16),
+                np.float32)
+            np.testing.assert_allclose(got, want, rtol=1e-2,
+                                       atol=1e-3, err_msg=algo)
+            # int8 ~43 dB, int4 ~19 dB on this matrix — both far from
+            # the wrong-axis collapse regime
+            assert NM.sqnr_db(w, np.asarray(
+                jnp.asarray(wd, jnp.bfloat16), np.float32)) > 15.0
+
+    def test_int8_decode_parity_bf16_quantized_tree(self):
+        """The fixed dequant ordering flows through generate: the int8
+        tree still decodes (finite logits, valid tokens) and the f32
+        tree's greedy tokens are unchanged by quantization-at-bf16
+        beyond the documented tolerance path (token validity only —
+        exact parity vs bf16 lives in test_paged.py's engine matrix)."""
+        cfg, params, _ = _llama()
+        qp = L.quantize_weights(params)
+        ids = jnp.asarray(_batch(0)[0][:, :8])
+        toks = np.asarray(L.generate(qp, ids, cfg, max_new_tokens=4))
+        assert toks.shape == (B, 4)
+        assert (toks >= 0).all() and (toks < V).all()
+
+
+# ---------------------------------------------------------------------------
+# KV-page absmax sampling (engine seam)
+# ---------------------------------------------------------------------------
+
+def _run_engine(n_requests=3, max_new=6):
+    from paddle_tpu.inference import Request, ServingEngine
+    rng = np.random.default_rng(0)
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(L, params, cfg, num_slots=2, max_len=32,
+                        page_size=8, decode_chunk=2)
+    outs = eng.run([Request(
+        rid=i, prompt=rng.integers(0, cfg.vocab_size, (6,))
+        .astype(np.int32), max_new_tokens=max_new)
+        for i in range(n_requests)])
+    assert len(outs) == n_requests
+    return eng
+
+
+class TestKVPageSampling:
+    def test_sampling_zero_extra_syncs(self, monkeypatch):
+        """KV sampling at rate 1 adds ZERO block_until_ready calls:
+        the per-chunk token download is the only synchronization (the
+        PR 9 pattern, pinned via the exectime indirection)."""
+        from paddle_tpu.monitor import exectime
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        exectime.set_sample_rate(0)     # isolate the KV seam
+        NM.set_kv_sample_rate(1)
+        calls = []
+        monkeypatch.setattr(exectime, "_block_until_ready",
+                            lambda out: calls.append(out))
+        eng = _run_engine()
+        snap = NM.kv_snapshot()
+        assert snap["samples"] > 0 and snap["pages"] > 0
+        assert calls == []
+
+    def test_rate_zero_disables_sampling(self):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.set_kv_sample_rate(0)
+        _run_engine()
+        assert NM.kv_snapshot()["samples"] == 0
+
+    def test_monitor_off_no_sampling_work(self):
+        NM.set_kv_sample_rate(1)
+        eng = _run_engine()
+        assert NM.kv_snapshot()["samples"] == 0
+        assert eng._kv_absmax_fn is None     # never even built
+
+    def test_free_pages_excluded_and_values_plausible(self):
+        """Sampled absmax values come from live pages only: all finite
+        and positive (free pages are zeros the filter drops)."""
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.set_kv_sample_rate(1)
+        _run_engine()
+        snap = NM.kv_snapshot()
+        assert snap["min"] is not None and snap["min"] > 0
+        assert snap["max"] >= snap["min"]
+        assert snap["recent"][0]["p50"] <= snap["recent"][0]["p95"]
+        g = monitor.snapshot()["gauges"]
+        assert g["numerics.kv.absmax.max"] == pytest.approx(
+            snap["max"], rel=1e-6)
+
+    def test_one_in_n_rate(self):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.set_kv_sample_rate(3)
+        eng = _run_engine(n_requests=4, max_new=12)
+        chunks = eng.stats.decode_steps // eng.decode_chunk
+        samples = NM.kv_snapshot()["samples"]
+        # every 3rd chunk (some chunks may be turbo-length; bound, not
+        # exact): at least one sample, never more than chunks/3 + 1
+        assert 1 <= samples <= chunks // 3 + 1
+
+
+# ---------------------------------------------------------------------------
+# sentinel attribution (observe-only)
+# ---------------------------------------------------------------------------
+
+class TestSentinelAttribution:
+    def test_corrupt_batch_names_worst_layer_in_health_report(self):
+        """The acceptance path: a spike injected via the corrupt fault
+        action surfaces the worst layer in the sentinel health report;
+        the verdict ladder is untouched (one SKIP, training
+        continues)."""
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+
+        def make_stream():
+            return (_batch(i) for i in range(8))
+
+        loop = S.SentinelLoop(step, params, opt, make_stream,
+                              sentinel=S.AnomalySentinel(
+                                  S.SentinelConfig(agree=False)))
+        faults.inject("train.batch", action="corrupt", nth=3)
+        out = loop.run(8)
+        assert out["skipped"] == 1 and out["applied"] == 7
+        # frozen at the anomaly: healthy steps after the skip refresh
+        # the latest view but not the last-anomaly attribution
+        wl = loop.sentinel.worst_layer_at_anomaly
+        assert wl is not None and not wl["finite"]
+        assert loop.sentinel.worst_layer["finite"]   # latest step OK
+        report = S._sentinel_health_provider(weakref.ref(loop))()
+        assert report["worst_layer_last_anomaly"] == wl["name"]
+        assert report["worst_layer"] == \
+            loop.sentinel.worst_layer["name"]
+        # the plane recorded every step; the skip instant names a layer
+        ev = [e for e in monitor.trace.events()
+              if e["name"] == "anomaly.skip"]
+        assert ev and ev[-1]["args"]["worst_layer"] == wl["name"]
+
+    def test_healthy_steps_keep_finite_attribution(self):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        cfg, params, opt = _llama()
+        step = L.make_train_step(cfg, guard=True, numerics=True,
+                                 donate=False)
+        loop = S.SentinelLoop(step, params, opt,
+                              lambda: (_batch(i) for i in range(3)),
+                              sentinel=S.AnomalySentinel(
+                                  S.SentinelConfig(agree=False)))
+        out = loop.run(3)
+        assert out["applied"] == 3
+        wl = loop.sentinel.worst_layer
+        assert wl is not None and wl["finite"]
+        report = S._sentinel_health_provider(weakref.ref(loop))()
+        assert report["worst_layer_grad_norm"] == pytest.approx(
+            wl["grad_norm"])
+        assert NM.numerics_snapshot()["total_steps"] == 3
+
+    def test_verdicts_identical_with_and_without_numerics(self):
+        """Observe-only: the same poisoned stream produces the same
+        skip/apply accounting whether or not numerics is on."""
+        cfg, params, opt = _llama()
+        outs = {}
+        for numerics in (False, True):
+            pt.set_flags({"FLAGS_enable_monitor": numerics})
+            monitor.reset()
+            step = L.make_train_step(cfg, guard=True, numerics=numerics,
+                                     donate=False)
+            loop = S.SentinelLoop(step, params, opt,
+                                  lambda: (_batch(i) for i in range(6)),
+                                  sentinel=S.AnomalySentinel(
+                                      S.SentinelConfig(agree=False)))
+            faults.inject("train.batch", action="corrupt", nth=2)
+            outs[numerics] = loop.run(6)
+            faults.clear()
+        assert outs[False]["skipped"] == outs[True]["skipped"] == 1
+        assert outs[False]["applied"] == outs[True]["applied"]
+
+
+# ---------------------------------------------------------------------------
+# /numerics route + flight record
+# ---------------------------------------------------------------------------
+
+class TestRouteAndFlight:
+    def test_numerics_route_serves_stats_and_audit(self):
+        from paddle_tpu.monitor import server as srv
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.record_step_stats(_fake_stats([1.0, 25.0]))
+        cfg, params, _ = _llama()
+        NM.audit_quantized_tree(params, L.quantize_weights(params),
+                                serving_dtype=jnp.bfloat16)
+        s = srv.start_server()
+        try:
+            p = json.load(urllib.request.urlopen(
+                f"{s.url}/numerics", timeout=10))
+        finally:
+            srv.stop_server()
+        assert p["worst_layer"]["name"] == "layers.wq[1]"
+        assert p["tensors"]["layers.wq[0]"]["gnorm"] == \
+            pytest.approx(1.0)
+        assert p["quant"]["min_sqnr_db"] > 0
+        assert "layers.wq" in p["quant"]["tensors"]
+        # strict JSON: the payload round-trips with no NaN tokens
+        assert json.loads(json.dumps(p, allow_nan=False)) == p
+
+    def test_route_listed_at_root(self):
+        from paddle_tpu.monitor import server as srv
+        s = srv.start_server()
+        try:
+            p = json.load(urllib.request.urlopen(f"{s.url}/",
+                                                 timeout=10))
+        finally:
+            srv.stop_server()
+        assert "/numerics" in p["routes"]
+
+    def test_flight_record_carries_numerics_block(self):
+        from paddle_tpu.monitor import trace as T
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.record_step_stats(_fake_stats([float("nan"), 2.0]))
+        fp = T.flight_payload()
+        assert fp["numerics"]["total_steps"] == 1
+        assert fp["numerics"]["worst_layer"]["name"] == "layers.wq[0]"
+        # non-finite floats serialize as null, never NaN tokens
+        json.dumps(fp["numerics"], allow_nan=False)
+
+    def test_snapshot_sanitizes_nonfinite(self):
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        monitor.reset()
+        NM.record_step_stats(_fake_stats([float("nan")]))
+        snap = NM.numerics_snapshot()
+        assert snap["worst_layer"]["grad_norm"] is None
+        assert snap["worst_layer"]["finite"] is False
+        assert snap["rows"][0]["gnorm"]["layers.wq[0]"] is None
+
+
+# ---------------------------------------------------------------------------
+# overhead measurement harness
+# ---------------------------------------------------------------------------
+
+def measure_numerics_overhead(iters=20, windows=6):
+    """Median per-window overhead of the in-graph numerics block:
+    interleaved ON/OFF windows of the same guarded PACKED train step
+    at the bench training_packed rung's CPU shape (llama_tiny, the
+    shared heavy-tailed trace) — the acceptance measurement. Returns
+    (median_pct, per-pair pcts). Measured on this container:
+    0.59% median across 9x30-step window pairs (CHANGES.md)."""
+    import time
+    from paddle_tpu.io import packing as PK
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    opt = L.adamw_init(params)
+    lens = PK.heavy_tailed_lengths(128, 24, seed=7)
+    rng = np.random.default_rng(7)
+    docs = [rng.integers(0, cfg.vocab_size, (ln,)).astype(np.int32)
+            for ln in lens]
+    packed = PK.pack_documents(docs, 128)
+    batch = tuple(jnp.asarray(a) for a in
+                  (packed["ids"], packed["labels"],
+                   packed["segment_ids"], packed["positions"]))
+    off = L.make_train_step(cfg, guard=True, numerics=False,
+                            donate=False)
+    on = L.make_train_step(cfg, guard=True, numerics=True,
+                           donate=False)
+
+    def window(step):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(params, opt, batch, INF_CAP)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    window(off), window(on)                      # compile + warm
+    pcts = []
+    for _ in range(windows):
+        t_off = window(off)
+        t_on = window(on)
+        pcts.append((t_on - t_off) / t_off * 100.0)
+    pcts.sort()
+    mid = len(pcts) // 2
+    med = pcts[mid] if len(pcts) % 2 else (pcts[mid - 1]
+                                           + pcts[mid]) / 2
+    return med, pcts
+
+
+@pytest.mark.slow
+def test_numerics_overhead_harness():
+    """The in-graph stats are fused reductions over grads the step
+    already holds: median overhead across interleaved ON/OFF windows
+    stays small. The tier-1 bound is loose (shared 2-core container
+    swings +/-10% window to window); the <2% acceptance number is the
+    9x30-window median recorded in CHANGES.md."""
+    med, pcts = measure_numerics_overhead()
+    assert med < 10.0, (med, pcts)
