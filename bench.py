@@ -295,6 +295,10 @@ def _metrics_summary():
             # KV-page absmax — zeros/None when the run never enabled
             # FLAGS_enable_numerics or sampled KV pages
             "numerics": _numerics_block(),
+            # SLO accounting plane (monitor/slo.py): p99 TTFT/TPOT the
+            # regression guard's lower-is-better rungs read, windowed
+            # compliance + burn rates, tenant count, autoscale signals
+            "slo": _slo_block(),
             # operator plane (monitor/memory.py + monitor/programs.py):
             # HBM occupancy at end of run (empty on backends that
             # report nothing — never fabricated) and the compiled-
@@ -395,6 +399,46 @@ def _numerics_block():
             "kv_samples": kv["samples"],
             "kv_pages": kv["pages"],
             "kv_absmax_max": kv["max"],
+        }
+    except Exception as e:                      # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _slo_block():
+    """extra.metrics.slo: the SLO accounting plane condensed. The
+    ``ttft_p99_ms``/``tpot_p99_ms`` rungs are the serving latency
+    histograms' interpolated p99s (post-warmup observations — the
+    serving rung resets them after compile warmup), the lower-is-
+    better floors ``scripts/check_bench_regression.py`` guards. Full
+    per-tenant detail stays on the ``/slo`` endpoint."""
+    try:
+        from paddle_tpu import monitor
+        from paddle_tpu.monitor import slo as _slo
+        reg = monitor.registry()
+
+        def _p99(name):
+            h = reg.get(f"serving.latency.{name}")
+            if h is None or not h.count:
+                return None
+            v = h.quantile(0.99)
+            return round(v, 3) if v is not None else None
+
+        rep = _slo.compliance_report()
+        tenants = _slo.tenants_snapshot()
+        return {
+            "ttft_p99_ms": _p99("ttft_ms"),
+            "tpot_p99_ms": _p99("tpot_ms"),
+            "e2e_p99_ms": _p99("e2e_ms"),
+            "objectives": {k: v["objective"]
+                           for k, v in rep["objectives"].items()},
+            "compliance": {k: v["compliance"]
+                           for k, v in rep["objectives"].items()},
+            "burn_slow": {k: v["burn_slow"]
+                          for k, v in rep["objectives"].items()},
+            "alerting": rep["alerting"],
+            "window_requests": rep["window"]["size"],
+            "tenants": len(tenants["tenants"]),
+            "autoscale": _slo.update_autoscale_gauges(),
         }
     except Exception as e:                      # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:200]}

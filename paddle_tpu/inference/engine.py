@@ -34,7 +34,9 @@ SLO latency (monitor-gated, one cached-flag branch when off): each
 request's lifecycle is stamped enqueue -> admit -> prefill -> first
 token -> retire, feeding the ``serving.latency.*`` histograms —
 ``queue_wait_ms`` (latest enqueue to admission; a preempted request
-re-queues and waits again), ``ttft_ms`` (ORIGINAL enqueue to the
+re-queues and waits again — each wait observed once, while the
+per-request cost record keeps the CUMULATIVE sum), ``ttft_ms``
+(ORIGINAL enqueue to the
 prefill-sampled first token of the run the client KEEPS — observed
 once per request at retirement, so a preempted run's discarded first
 token never biases the histogram),
@@ -50,6 +52,25 @@ first token + decode emissions — work done, including work later
 thrown away); ``serving.tokens.discarded`` counts tokens a preemption
 discarded for recompute. On a drained engine
 ``generated - discarded == sum(len(output.tokens))`` exactly.
+
+Cost attribution (monitor-gated, PR 12): requests carry a ``tenant``
+(default ``"default"``) and ``priority``, validated/coerced at submit
+with the rest of the isolation screening, and every request
+accumulates a :class:`RequestCost` record across its lifecycle —
+prefill/decode/discarded tokens, CUMULATIVE queue wait across
+preemption re-queues (the ``queue_wait_ms`` histogram still observes
+each individual wait once), page-seconds (pages held x wall,
+integrated at the chunk boundaries the emitted-grid download already
+synchronizes — the cost plane adds ZERO device synchronizations at
+any rate), slot steps + occupancy share, and modeled FLOPs (the
+chunk/prefill program's registered cost-analysis FLOPs from
+``monitor/programs.py``, split evenly across the live slots/group
+rows that shared the dispatch). The record rides out on
+``RequestOutput.cost`` and folds into ``monitor/slo.py``'s windowed
+SLO accounting + bounded per-tenant aggregates at retirement; each
+scheduler step also feeds the autoscale tick
+(``slo.note_sched_tick``). Monitor off: ``cost`` is None and none of
+this exists — byte-identical emitted tokens either way.
 """
 from __future__ import annotations
 
@@ -70,6 +91,7 @@ from ..core import enforce as E
 from ..monitor import profile_capture as _pcap
 from ..monitor import server as _mserver
 from ..monitor import trace as _trace
+from ..monitor import slo as _slo
 from ..monitor.registry import LATENCY_BUCKETS_MS as _LATENCY_BUCKETS_MS
 from .paged import PagedKVCache, paged_decode_step, paged_prefill
 
@@ -97,7 +119,8 @@ def _engine_health_provider(ref):
 def _observe_latency(name: str, ms: float, doc: str):
     _monitor.observe(name, ms, doc=doc, buckets=_LATENCY_BUCKETS_MS)
 
-__all__ = ["Request", "RequestOutput", "RequestRejected", "ServingEngine"]
+__all__ = ["Request", "RequestCost", "RequestOutput", "RequestRejected",
+           "ServingEngine"]
 
 
 class RequestRejected(E.InvalidArgumentError):
@@ -126,6 +149,40 @@ class Request:
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
     key: Optional[jax.Array] = None      # PRNG key when temperature > 0
+    tenant: str = "default"              # cost-attribution dimension
+    priority: int = 0                    # scheduling class (observe-only
+    #                                      today; item-5 scheduler feed)
+
+
+@dataclasses.dataclass
+class RequestCost:
+    """Per-request resource attribution, accumulated at the engine's
+    existing host-sync seams (monitor-gated; see the module
+    docstring). Cumulative across preemption re-queues — the record
+    follows the REQUEST, not one run of it."""
+
+    tenant: str = "default"
+    priority: int = 0
+    prefill_tokens: int = 0      # prompt tokens prefilled (re-prefills
+    #                              after preemption included)
+    decode_tokens: int = 0       # decode emissions (work done, incl.
+    #                              tokens a preemption later discarded)
+    discarded_tokens: int = 0    # thrown away by preemption recompute
+    queue_wait_ms: float = 0.0   # SUM of every enqueue->admission wait
+    page_seconds: float = 0.0    # KV pages held x wall (chunk edges)
+    slot_steps: int = 0          # decode-grid steps a slot was held
+    grid_steps: int = 0          # grid capacity (steps x slots) that
+    #                              elapsed during the residencies
+    slot_share: Optional[float] = None   # slot_steps / grid_steps
+    model_flops: float = 0.0     # registered program FLOPs, split
+    #                              across the dispatch's live slots
+    preemptions: int = 0
+    ttft_ms: Optional[float] = None
+    tpot_ms: Optional[float] = None
+    e2e_ms: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 @dataclasses.dataclass
@@ -134,11 +191,15 @@ class RequestOutput:
     tokens: np.ndarray                   # generated ids (<= max_new_tokens)
     prompt_len: int
     preemptions: int = 0                 # times this request was evicted
+    tenant: str = "default"
+    cost: Optional[RequestCost] = None   # monitor on: the attribution
+    #                                      record; monitor off: None
 
 
 class _Slot:
     __slots__ = ("req", "kv_len", "gen", "tokens", "pending", "done",
-                 "keys", "preemptions", "t_first", "t_last")
+                 "keys", "preemptions", "t_first", "t_last",
+                 "cost", "t_tick", "steps0")
 
     def __init__(self, req: Request, keys: np.ndarray):
         self.req = req
@@ -151,6 +212,9 @@ class _Slot:
         self.preemptions = 0
         self.t_first = None      # first-token wall stamp (monitor on)
         self.t_last = None       # latest-token wall stamp (monitor on)
+        self.cost = None         # the request's RequestCost (monitor on)
+        self.t_tick = None       # last page-seconds integration stamp
+        self.steps0 = 0          # engine decode_steps at admission
 
 
 class EngineStats:
@@ -291,6 +355,10 @@ class ServingEngine:
         # at any rate (PR 9's pattern, pinned by test)
         self._kv_chunks = 0
         self._kv_absmax_fn = None
+        # registered-program FLOPs, cached per registry key: the cost
+        # plane reads it once per chunk, not once per slot, and the
+        # cached value keeps the per-dispatch cost at one dict lookup
+        self._flops_by_key: dict = {}
         # device-side slot state, reused across chunks until a
         # join/retire/preempt (state) or page-table change (bt) dirties it
         self._dev: dict = {}
@@ -357,6 +425,19 @@ class ServingEngine:
                                   donated=donated)
         return key
 
+    def _program_flops(self, key):
+        """Cached ``monitor/programs.flops_of`` read (None when the
+        backend never reported a count). An unknown key is NOT cached
+        as None: a ``monitor.reset()`` mid-run re-registers on the
+        next dispatch and the lookup must recover with it."""
+        v = self._flops_by_key.get(key)
+        if v is None:
+            from ..monitor import programs as _programs
+            v = _programs.flops_of(key)
+            if v is not None:
+                self._flops_by_key[key] = v
+        return v
+
     # -- submission ---------------------------------------------------------
 
     def _reject_reason(self, req: Request):
@@ -396,7 +477,9 @@ class ServingEngine:
             if max_new != req.max_new_tokens:   # 2.9 must not pass as 2
                 return bad(f"max_new_tokens {req.max_new_tokens!r} is "
                            "not an integral count")
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
+            # OverflowError: int(float('inf')) — must reject typed,
+            # not crash the caller
             return bad(f"max_new_tokens {req.max_new_tokens!r} is not "
                        "an int")
         if max_new < 1:
@@ -410,7 +493,29 @@ class ServingEngine:
             return bad(f"temperature {req.temperature!r} is not a float")
         if not math.isfinite(temp) or temp < 0.0:
             return bad(f"temperature must be finite and >= 0, got {temp}")
-        return None, (prompt, max_new, temp)
+        tenant = req.tenant
+        if tenant is None:
+            tenant = "default"
+        else:
+            try:
+                tenant = str(tenant)
+            except Exception:
+                return bad("tenant is not string-coercible")
+            tenant = tenant or "default"
+            # content is NOT restricted — exposition escapes hostile
+            # bytes and the slo plane bounds cardinality — but a label
+            # value is not a document
+            if len(tenant) > 128:
+                return bad(f"tenant name of {len(tenant)} chars exceeds "
+                           "the 128-char limit")
+        try:
+            priority = int(req.priority)
+            if priority != req.priority:     # 1.5 must not pass as 1
+                return bad(f"priority {req.priority!r} is not an "
+                           "integral class")
+        except (TypeError, ValueError, OverflowError):
+            return bad(f"priority {req.priority!r} is not an int")
+        return None, (prompt, max_new, temp, tenant, priority)
 
     def submit(self, req: Request):
         """Queue a request, or raise :class:`RequestRejected` (typed,
@@ -422,11 +527,22 @@ class ServingEngine:
                          doc="malformed submissions refused at the "
                              "door (engine state untouched)")
             _trace.instant("serving.reject", rid=req.rid, reason=reason)
+            if _monitor.enabled():
+                # availability = non-rejected fraction: the refusal
+                # must enter the SLO window, attributed to whatever
+                # tenant the submission claimed (best-effort — the
+                # rejection may be ABOUT the tenant field)
+                try:
+                    tenant = str(req.tenant or "default")[:128]
+                except Exception:
+                    tenant = "default"
+                _slo.record_rejected(tenant or "default")
             raise RequestRejected(req.rid, reason)
         # the scheduler consumes the NORMALIZED values it was screened
         # on — the original coercible-but-wrong-typed fields must not
         # ride into the loop
-        req.prompt, req.max_new_tokens, req.temperature = norm
+        (req.prompt, req.max_new_tokens, req.temperature,
+         req.tenant, req.priority) = norm
         plen = int(req.prompt.shape[0])
         if _monitor.enabled():
             now = time.perf_counter()
@@ -434,8 +550,15 @@ class ServingEngine:
             # refreshed by preemption re-queues and anchors queue_wait
             req._t0 = getattr(req, "_t0", None) or now
             req._t_enqueue = now
+            # the cost record follows the REQUEST across preemption
+            # re-queues (they re-enter via appendleft, not submit —
+            # but a client resubmitting the same object keeps it too)
+            if getattr(req, "_cost", None) is None:
+                req._cost = RequestCost(tenant=req.tenant,
+                                        priority=req.priority)
             _trace.instant("serving.enqueue", rid=req.rid, prompt=plen,
-                           max_new=req.max_new_tokens)
+                           max_new=req.max_new_tokens,
+                           tenant=req.tenant)
         self.queue.append(req)
 
     # -- scheduling ---------------------------------------------------------
@@ -492,43 +615,75 @@ class ServingEngine:
         slot = self.slots[idx]
         self.slots[idx] = None
         self._state_dirty = self._bt_dirty = True
+        mon = _monitor.enabled()
+        cost = slot.cost if mon else None
+        if cost is not None and slot.t_tick is not None:
+            # final page-seconds tick: pages held from the last chunk
+            # edge until this retirement, read BEFORE the free below
+            now_t = time.perf_counter()
+            cost.page_seconds += (
+                self.cache.alloc.page_count(slot.req.rid)
+                * (now_t - slot.t_tick))
+            slot.t_tick = now_t
         self.cache.alloc.free(slot.req.rid)
         self.outputs[slot.req.rid] = RequestOutput(
             rid=slot.req.rid,
             tokens=np.asarray(slot.tokens, np.int32),
             prompt_len=int(np.asarray(slot.req.prompt).shape[0]),
-            preemptions=slot.preemptions)
+            preemptions=slot.preemptions,
+            tenant=getattr(slot.req, "tenant", "default"),
+            cost=cost)
         self.stats.completed += 1
         _monitor.inc("serving.requests.completed")
-        if _monitor.enabled():
+        if mon:
             now = time.perf_counter()
             t0 = getattr(slot.req, "_t0", None)
             if t0 is not None:
+                e2e = (now - t0) * 1e3
                 _observe_latency(
-                    "serving.latency.e2e_ms", (now - t0) * 1e3,
+                    "serving.latency.e2e_ms", e2e,
                     "request lifetime: original enqueue to retirement")
+                if cost is not None:
+                    cost.e2e_ms = e2e
                 if slot.t_first is not None:
                     # observed at retirement, not at prefill: a
                     # preempted request re-prefills, and only the
                     # surviving run's first token — the one the client
                     # keeps — counts. One sample per completed request.
+                    ttft = (slot.t_first - t0) * 1e3
                     _observe_latency(
-                        "serving.latency.ttft_ms",
-                        (slot.t_first - t0) * 1e3,
+                        "serving.latency.ttft_ms", ttft,
                         "original enqueue to the prefill-sampled "
                         "first token the client keeps")
+                    if cost is not None:
+                        cost.ttft_ms = ttft
             if slot.gen > 1 and slot.t_first is not None \
                     and slot.t_last is not None:
                 # mean inter-token time over the decode phase; t_last
                 # is the arrival of the final emitted token (chunk-edge
                 # resolution), t_first the prefill-sampled token
+                tpot = (slot.t_last - slot.t_first) / (slot.gen - 1) * 1e3
                 _observe_latency(
-                    "serving.latency.tpot_ms",
-                    (slot.t_last - slot.t_first) / (slot.gen - 1) * 1e3,
+                    "serving.latency.tpot_ms", tpot,
                     "mean time per output token after the first")
+                if cost is not None:
+                    cost.tpot_ms = tpot
+            if cost is not None:
+                cost.preemptions = slot.preemptions
+                # slot-occupancy share: fraction of the decode grid's
+                # capacity this request held over its residencies
+                # (cumulative across preemption re-runs; None when it
+                # retired without a decode chunk in between)
+                cost.grid_steps += (self.stats.decode_steps
+                                    - slot.steps0) * self.num_slots
+                cost.slot_share = round(
+                    cost.slot_steps / cost.grid_steps, 6) \
+                    if cost.grid_steps > 0 else None
+                _slo.record_request(cost.as_dict())
             _trace.instant("serving.retire", rid=slot.req.rid,
                            tokens=slot.gen,
-                           preemptions=slot.preemptions)
+                           preemptions=slot.preemptions,
+                           tenant=getattr(slot.req, "tenant", "default"))
 
     def _preempt_youngest(self) -> bool:
         """Evict the most recently admitted live request (recompute
@@ -539,6 +694,15 @@ class ServingEngine:
             if slot is not None and not slot.done:
                 self.slots[idx] = None
                 self._state_dirty = self._bt_dirty = True
+                now = time.perf_counter() if _monitor.enabled() else None
+                cost = slot.cost if now is not None else None
+                if cost is not None and slot.t_tick is not None:
+                    # final page-seconds tick for this run, read before
+                    # the free — an evicted request PAID for the pages
+                    # it held even though the work is recomputed
+                    cost.page_seconds += (
+                        self.cache.alloc.page_count(slot.req.rid)
+                        * (now - slot.t_tick))
                 self.cache.alloc.free(slot.req.rid)
                 slot.req._preempt_count = getattr(
                     slot.req, "_preempt_count", 0) + 1
@@ -552,8 +716,17 @@ class ServingEngine:
                 _monitor.inc("serving.tokens.discarded", slot.gen,
                              doc="sampled tokens thrown away by "
                                  "preemption recompute")
-                if _monitor.enabled():
-                    slot.req._t_enqueue = time.perf_counter()
+                if now is not None:
+                    # the re-queue refreshes t_enqueue: the NEXT wait
+                    # accumulates onto the record's cumulative
+                    # queue_wait_ms at re-admission (the histogram
+                    # observes each wait once, the record keeps the sum)
+                    slot.req._t_enqueue = now
+                    if cost is not None:
+                        cost.discarded_tokens += slot.gen
+                        cost.grid_steps += (self.stats.decode_steps
+                                            - slot.steps0) \
+                            * self.num_slots
                     _trace.instant("serving.preempt", rid=slot.req.rid,
                                    discarded=slot.gen)
                 return True
@@ -612,15 +785,23 @@ class ServingEngine:
         touch the pool."""
         need = s_pad // self.page_size
         mon = _monitor.enabled()
+        t_admit = None
         if mon:
             t_admit = time.perf_counter()
             for r in group:
                 t_enq = getattr(r, "_t_enqueue", None)
                 if t_enq is not None:
+                    wait_ms = (t_admit - t_enq) * 1e3
                     _observe_latency(
-                        "serving.latency.queue_wait_ms",
-                        (t_admit - t_enq) * 1e3,
+                        "serving.latency.queue_wait_ms", wait_ms,
                         "enqueue (or preemption re-queue) to admission")
+                    cost = getattr(r, "_cost", None)
+                    if cost is not None:
+                        # CUMULATIVE across preemption re-queues: the
+                        # histogram above observes each wait once; the
+                        # record answers "how long did this request
+                        # spend queued in total"
+                        cost.queue_wait_ms += wait_ms
                 _trace.instant("serving.admit", rid=r.rid)
         g = 1
         while g < len(group):
@@ -650,6 +831,7 @@ class ServingEngine:
                          slen=jnp.asarray(slen), temp=jnp.asarray(temps),
                          key=jnp.asarray(keys))
         exec_rec = None
+        pf_flops_share = None
         if mon:
             # introspection-registry record, BEFORE the dispatch that
             # donates the pool buffers (once per specialization)
@@ -659,6 +841,12 @@ class ServingEngine:
                 pf_kwargs, donated=(2, 3))
             from ..monitor import exectime as _exectime
             exec_rec = _exectime.maybe_sample(key, feed_last=False)
+            # modeled-FLOPs attribution: the registered program's
+            # cost-analysis count split across the real requests that
+            # shared this dispatch (dummy pad rows attribute nowhere)
+            pf_flops = self._program_flops(key)
+            if pf_flops:
+                pf_flops_share = pf_flops / len(group)
         with _trace.span("serving.prefill", group=len(group),
                          s_pad=s_pad), \
                 _pcap.annotate("serving.prefill"):
@@ -690,6 +878,16 @@ class ServingEngine:
             slot.pending = tok
             slot.gen = 1
             slot.t_first = slot.t_last = t_first
+            if mon:
+                slot.cost = getattr(r, "_cost", None)
+                # page-seconds integrate from admission (pages were
+                # allocated in _admit) at chunk-edge resolution
+                slot.t_tick = t_admit
+                slot.steps0 = self.stats.decode_steps
+                if slot.cost is not None:
+                    slot.cost.prefill_tokens += int(slen[j])
+                    if pf_flops_share:
+                        slot.cost.model_flops += pf_flops_share
             slot.done = (tok == r.eos_token_id
                          if r.eos_token_id is not None else False) \
                 or slot.gen >= r.max_new_tokens
@@ -763,6 +961,14 @@ class ServingEngine:
 
         live_idx = [i for i, s in enumerate(self.slots)
                     if s is not None and not s.done]
+        if _monitor.enabled():
+            # autoscale feed (monitor/slo.py): one host tick per
+            # scheduling step — queue depth, live slots, page slack.
+            # The gauges themselves are recomputed at scrape time.
+            _slo.note_sched_tick(
+                len(self.queue), len(live_idx), self.num_slots,
+                self.cache.alloc.free_pages / self.cache.num_pages
+                if self.cache.num_pages else 0.0)
         if not live_idx:
             return bool(self.queue) or any(
                 s is not None for s in self.slots)
@@ -821,6 +1027,7 @@ class ServingEngine:
                    d["kv_len"], d["done"], d["gen"], keys, d["temps"],
                    d["max_new"], d["eos"])
         exec_rec = None
+        ck_flops_share = None
         if _monitor.enabled():
             key = self._record_serving_program(
                 ("serving.decode_chunk", C, self._sampled),
@@ -829,6 +1036,15 @@ class ServingEngine:
                 ck, ck_args, None, donated=(1, 2))
             from ..monitor import exectime as _exectime
             exec_rec = _exectime.maybe_sample(key, feed_last=False)
+            # modeled-FLOPs attribution: the chunk program's registered
+            # cost-analysis count split across the live slots sharing
+            # this dispatch (done/empty slots ride along for free in
+            # the static grid; the work exists because of the live
+            # ones). None/0 when the backend never reported — skipped,
+            # not fabricated.
+            ck_flops = self._program_flops(key)
+            if ck_flops:
+                ck_flops_share = ck_flops / len(live_idx)
         with _trace.span("serving.decode_chunk", chunk=C,
                          live=len(live_idx)), \
                 _pcap.annotate_step("serving.decode_chunk",
@@ -863,6 +1079,20 @@ class ServingEngine:
                 s.gen += len(toks)
                 s.pending = toks[-1]
                 s.t_last = t_chunk if t_chunk is not None else s.t_last
+            if t_chunk is not None and s.cost is not None:
+                # cost attribution at the chunk edge the emitted-grid
+                # download above already synchronized: pure host reads
+                # (allocator page counts, the cached program FLOPs) —
+                # zero added device synchronizations at any rate
+                if s.t_tick is not None:
+                    s.cost.page_seconds += (
+                        self.cache.alloc.page_count(s.req.rid)
+                        * (t_chunk - s.t_tick))
+                s.t_tick = t_chunk
+                s.cost.slot_steps += C
+                s.cost.decode_tokens += len(toks)
+                if ck_flops_share:
+                    s.cost.model_flops += ck_flops_share
             s.done = s.gen >= s.req.max_new_tokens or (
                 s.req.eos_token_id is not None and bool(toks)
                 and toks[-1] == s.req.eos_token_id)

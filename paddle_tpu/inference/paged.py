@@ -91,6 +91,11 @@ class PageAllocator:
     def seq_pages(self, seq_id: int) -> List[int]:
         return list(self._seqs[seq_id]["pages"])
 
+    def page_count(self, seq_id: int) -> int:
+        """Pages currently held by this sequence (no list copy — the
+        engine's per-chunk cost attribution reads it per live slot)."""
+        return len(self._seqs[seq_id]["pages"])
+
     def block_row(self, seq_id: int, width: Optional[int] = None
                   ) -> np.ndarray:
         """This sequence's block-table row, padded with the ``num_pages``

@@ -50,7 +50,7 @@ __all__ = [
     "trace", "mfu", "StepTimer", "ambient_phase",
     "server", "programs", "memory", "fleet",
     "comms", "roofline",
-    "exectime", "profile_capture", "timeseries", "numerics",
+    "exectime", "profile_capture", "timeseries", "numerics", "slo",
     "start_server", "stop_server",
     "suppressed", "suppress_accounting",
 ]
@@ -206,8 +206,13 @@ def snapshot() -> dict:
 
 
 def expose_text() -> str:
-    """Prometheus text exposition of every registered metric."""
-    return _exposition.expose_text(_REGISTRY)
+    """Prometheus text exposition of every registered metric, plus the
+    SLO plane's per-tenant labeled series (``slo_tenant_*{tenant=...}``
+    — tenant names are client-supplied strings, so they ride label
+    escaping, not metric names; empty until a tenant records)."""
+    text = _exposition.expose_text(_REGISTRY)
+    tenant_text = slo.tenant_exposition_text()
+    return text + tenant_text if tenant_text else text
 
 
 def dump_json(run_id: Optional[str] = None,
@@ -233,6 +238,7 @@ def reset():
     exectime.reset()
     timeseries.reset()
     numerics.reset()
+    slo.reset()
     # the sharding inspector's registered trees empty with the rest
     # (module-reference lookup: reset() must not be the thing that
     # first imports the distributed package)
@@ -296,5 +302,8 @@ from . import timeseries  # noqa: E402
 # which reads those submodules off this (partially initialized)
 # package.
 from . import numerics  # noqa: E402
+# SLO accounting plane (PR 12): per-request/per-tenant cost records,
+# error-budget burn rates, observe-only autoscaling signals.
+from . import slo  # noqa: E402
 from . import server  # noqa: E402
 from .server import start_server, stop_server  # noqa: E402

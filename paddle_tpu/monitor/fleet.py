@@ -95,7 +95,26 @@ def aggregate_hosts(host_snaps: List[dict]) -> dict:
                 v = h.get(key)
                 if v is not None:
                     agg[key] = v if agg[key] is None else pick(agg[key], v)
-    return {"scalars": scalars, "histograms": hists}
+
+    out = {"scalars": scalars, "histograms": hists}
+    # SLO tenant aggregates (monitor/slo.py): per-host cost tables ride
+    # the gathered payload under "slo_tenants"; the fleet view is the
+    # field-wise SUM per tenant — summed serving cost per tenant across
+    # replicas is the number a billing/scheduling consumer wants. Each
+    # host's table is already cardinality-bounded, so the union is at
+    # most hosts x (max_tenants + 1) entries.
+    tenants: dict = {}
+    for snap in host_snaps:
+        for t, fields in (snap.get("slo_tenants") or {}).items():
+            if not isinstance(fields, dict):
+                continue
+            agg_t = tenants.setdefault(t, {})
+            for k, v in fields.items():
+                if isinstance(v, (int, float)):
+                    agg_t[k] = agg_t.get(k, 0) + v
+    if tenants:
+        out["slo_tenants"] = tenants
+    return out
 
 
 def divergence(agg: dict, top_n: int = 20) -> List[dict]:
@@ -148,8 +167,15 @@ def aggregated_snapshot(name: str = "monitor") -> dict:
 
     from . import snapshot as _snapshot
     from . import inc as _inc
+    from . import slo as _slo
 
     local = _snapshot()
+    tenants = _slo.tenants_for_fleet()
+    if tenants:
+        # per-tenant cost table rides the same gathered payload (extra
+        # key — the scalar/histogram reducers ignore it)
+        local = dict(local)
+        local["slo_tenants"] = tenants
     nproc = jax.process_count()
     if nproc > 1:
         from ..distributed import collective as _coll
@@ -232,4 +258,22 @@ def expose_fleet_text(payload: dict) -> str:
             if v is not None:
                 lines.append(render_sample(name, {"host": str(rank),
                                                   "agg": "mean"}, v))
+    # fleet-summed per-tenant SLO cost aggregates: one family per cost
+    # field, one {tenant="..."} sample per tenant (label escaping —
+    # tenant names are client-supplied)
+    tenants = agg.get("slo_tenants") or {}
+    fields: dict = {}
+    for t, tf in tenants.items():
+        for k, v in tf.items():
+            if isinstance(v, (int, float)):
+                fields.setdefault(k, []).append((t, v))
+    for field in sorted(fields):
+        name = f"slo.tenant.{field}"
+        pname = sanitize_name(name)
+        lines.append(f"# HELP {pname} "
+                     f"{escape_help('fleet-summed per-tenant ' + field)}")
+        lines.append(f"# TYPE {pname} gauge")
+        for t, v in sorted(fields[field]):
+            lines.append(render_sample(name, {"tenant": t,
+                                              "agg": "sum"}, v))
     return "\n".join(lines) + "\n"

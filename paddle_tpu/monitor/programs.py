@@ -38,7 +38,8 @@ import weakref
 from typing import Callable, List, Optional
 
 __all__ = ["ProgramRecord", "record_program", "record_jit_call",
-           "note_hit", "note_exec", "has_record", "analyze_pending",
+           "note_hit", "note_exec", "has_record", "flops_of",
+           "analyze_pending",
            "max_temp_bytes", "programs_snapshot", "signature_of",
            "analyzer_for", "next_uid", "reset"]
 
@@ -353,6 +354,16 @@ def note_exec(key, ms: float):
 def has_record(key) -> bool:
     with _MU:
         return key in _BY_KEY
+
+
+def flops_of(key) -> Optional[float]:
+    """Registered cost-analysis FLOPs of one program (the serving
+    engine's per-chunk cost-attribution numerator), or None when the
+    key is unknown or the backend never reported a count — the cost
+    plane skips the contribution, never fabricates one."""
+    with _MU:
+        rec = _BY_KEY.get(key)
+        return rec.flops if rec is not None else None
 
 
 def analyze_pending(max_n: int = 8) -> int:

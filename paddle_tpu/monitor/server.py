@@ -37,6 +37,10 @@ module is the missing scrape target: a flag-gated stdlib
   per-layer grad statistics + worst-layer attribution, the latest
   weight-quantization SQNR audit, and the KV-page absmax
   distribution.
+- ``GET /slo`` — the SLO accounting plane (``monitor/slo.py``):
+  objectives, windowed compliance ratios, fast/slow error-budget burn
+  rates and budget remaining, per-tenant cost aggregates (bounded
+  cardinality), and the observe-only autoscaling signals.
 - ``GET /profile?seconds=N`` — on-demand device profiler capture
   (``monitor/profile_capture.py``): one exclusive
   ``jax.profiler`` window into a bounded capture directory; a second
@@ -231,6 +235,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/numerics":
                 from . import numerics as _numerics
                 self._send_json(200, _numerics.numerics_snapshot())
+            elif route == "/slo":
+                from . import memory as _memory
+                from . import slo as _slo
+                # one backend read: the headroom payload rides into the
+                # autoscale block so the HBM leg of the demand estimate
+                # is fresh exactly when someone asks
+                self._send_json(200, _slo.slo_snapshot(
+                    headroom=_memory.headroom()))
             elif route == "/profile":
                 self._profile(parse_qs(url.query))
             elif route == "/":
@@ -239,7 +251,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "routes": ["/metrics", "/metrics?scope=fleet",
                                "/healthz", "/flight", "/programs",
                                "/memory", "/roofline", "/sharding",
-                               "/timeseries", "/numerics",
+                               "/timeseries", "/numerics", "/slo",
                                "/profile?seconds=N"],
                 })
             else:
@@ -315,11 +327,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = _fleet.expose_fleet_text(payload)
         else:
-            # scrape-time refresh: HBM gauges re-read the backend, and a
-            # bounded batch of pending program analyses runs so the
-            # jit.program.* byte gauges exist once someone is looking
-            _memory.update_hbm_gauges()
+            # scrape-time refresh: HBM gauges re-read the backend (the
+            # headroom composition reuses that one read), a bounded
+            # batch of pending program analyses runs so the
+            # jit.program.* byte gauges exist once someone is looking,
+            # and the serving.autoscale.* gauges recompute from the
+            # engine's latest scheduler tick
+            from . import slo as _slo
+            hr = _memory.headroom()
             _programs.analyze_pending(_ANALYZE_PER_SCRAPE)
+            _slo.update_autoscale_gauges(headroom=hr)
+            _slo.compliance_report()      # refreshes the slo.* gauges
             body = _expose_text()
         self._send(200, body.encode(),
                    "text/plain; version=0.0.4; charset=utf-8")

@@ -222,6 +222,14 @@ def flight_payload(reason: str = "manual") -> dict:
         nm = _numerics.numerics_snapshot(n=32)
     except Exception:
         nm = None
+    try:
+        # the serving story (monitor/slo.py): which tenants were in
+        # flight and whether an SLO was burning when it died. headroom
+        # stays None — a crash dump must not read the device backend.
+        from . import slo as _slo
+        sl = _slo.slo_snapshot()
+    except Exception:
+        sl = None
     return {
         "kind": "paddle_tpu.flight_record",
         "reason": reason,
@@ -233,6 +241,7 @@ def flight_payload(reason: str = "manual") -> dict:
         "metrics": _snapshot(),
         "timeseries": ts,
         "numerics": nm,
+        "slo": sl,
     }
 
 

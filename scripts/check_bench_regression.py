@@ -62,6 +62,11 @@ ALLOWLIST = {
 ALLOWLIST_LOWER = {
     "headline_exec_ms_p50": "extra.metrics.exec.headline.p50_ms",
     "decode_exec_ms_p50": "extra.metrics.exec.decode.p50_ms",
+    # serving SLO p99s (extra.metrics.slo, fed by the serving rung's
+    # post-warmup latency histograms): a PR that regresses tail
+    # latency without touching throughput now fails the guard
+    "serving_ttft_ms_p99": "extra.metrics.slo.ttft_p99_ms",
+    "serving_tpot_ms_p99": "extra.metrics.slo.tpot_p99_ms",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -597,6 +597,105 @@ def main():
             from paddle_tpu import monitor as _mon
             _mon.reset()
 
+    @case("slo_scrape")
+    def _():
+        # the SLO accounting plane end to end: a mixed-tenant engine
+        # run with one forced preemption (tiny page pool), scraped
+        # mid-run (autoscale demand nonzero) and after drain — /slo
+        # must serve finite burn rates + per-tenant cost aggregates,
+        # /metrics must carry the tenant-labeled series (hostile
+        # tenant names escaped, not corrupting), and a malformed
+        # submission must land in the availability window
+        import json as _json
+        import urllib.request
+        from paddle_tpu.inference import (Request, RequestRejected,
+                                          ServingEngine)
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import server as mon_server
+        from paddle_tpu.monitor import slo as mon_slo
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True})
+        try:
+            cfg = L.llama_tiny(num_hidden_layers=2)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            # 5-page pool, 2 slots: three 12-token sequences cannot
+            # coexist -> at least one recompute preemption (the
+            # test_trace token-invariant shape)
+            eng = ServingEngine(L, params, cfg, num_slots=2,
+                                max_len=16, page_size=4, num_pages=5,
+                                decode_chunk=2)
+            tenants = ["alpha", "beta", 'evil"\n\\tenant']
+            # 15 requests: 5 per tenant, clearing the per-tenant
+            # min-sample floor (5) so tenant_compliance can answer
+            reqs = [Request(rid=i,
+                            prompt=rng.integers(0, cfg.vocab_size, (4,))
+                            .astype(np.int32),
+                            max_new_tokens=8 if i < 3 else 3,
+                            tenant=tenants[i % 3], priority=i % 2)
+                    for i in range(15)]
+            for r in reqs:
+                eng.submit(r)
+            for _i in range(3):                # mid-run: backlog live
+                eng.step()
+            srv = mon_server.get_server()
+            assert srv is not None, "engine did not start the server"
+            mid = _json.load(urllib.request.urlopen(
+                f"{srv.url}/slo", timeout=30))
+            asc = mid["autoscale"]
+            assert asc["available"] and not asc["drain_safe"], asc
+            assert asc["demand_estimate"] > 0, asc
+            assert asc["desired_capacity_hint"] >= 1, asc
+            eng.run()                          # drain
+            assert eng.stats.preempted >= 1, eng.stats.as_dict()
+            try:
+                # malformed AFTER alpha earned its label slot: the
+                # rejection attributes to the claimed tenant and
+                # enters the availability window
+                eng.submit(Request(rid=99, prompt=reqs[0].prompt,
+                                   max_new_tokens=3, tenant="alpha",
+                                   priority=1.5))      # not integral
+                raise AssertionError("bad priority was not rejected")
+            except RequestRejected:
+                pass
+            pre = [o for o in eng.outputs.values()
+                   if o.cost and o.cost.preemptions >= 1]
+            assert pre, "no output carries a preempted cost record"
+            assert pre[0].cost.queue_wait_ms > 0
+            p = _json.load(urllib.request.urlopen(
+                f"{srv.url}/slo", timeout=30))
+            comp = p["compliance"]["objectives"]
+            for obj in ("availability", "ttft_p99_ms", "e2e_p99_ms"):
+                st = comp[obj]
+                assert st["compliance"] is not None, (obj, st)
+                for k in ("burn_fast", "burn_slow", "budget_remaining"):
+                    assert st[k] is not None and \
+                        np.isfinite(st[k]), (obj, k, st)
+            # the rejected submission entered the availability window
+            assert comp["availability"]["compliance"] < 1.0, comp
+            tl = p["tenants"]["tenants"]
+            for t in tenants:
+                assert t in tl, sorted(tl)
+                assert tl[t]["decode_tokens"] > 0, (t, tl[t])
+                assert tl[t]["page_seconds"] > 0, (t, tl[t])
+            tc = p["tenant_compliance"]
+            assert tc["alpha"]["availability"] is not None, tc
+            assert tl["alpha"]["rejected"] >= 1, tl["alpha"]
+            assert p["autoscale"]["drain_safe"], p["autoscale"]
+            text = urllib.request.urlopen(
+                f"{srv.url}/metrics", timeout=30).read().decode()
+            assert 'slo_tenant_requests{tenant="alpha"}' in text
+            # hostile tenant name rides label ESCAPING, never raw bytes
+            assert 'tenant="evil\\"\\n\\\\tenant"' in text, \
+                [ln for ln in text.splitlines() if "slo_tenant" in ln][:3]
+            assert "serving_autoscale_drain_safe 1" in text
+            assert "slo_window_requests" in text
+        finally:
+            mon_server.stop_server()
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp

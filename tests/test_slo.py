@@ -1,0 +1,748 @@
+"""SLO accounting plane (monitor/slo.py + engine cost attribution,
+/slo route, tenant exposition, autoscale signals, bench-guard rungs).
+
+The load-bearing contracts:
+
+- **Cost attribution**: every retired request carries a RequestCost
+  with tokens, CUMULATIVE queue wait across preemption re-queues (the
+  histogram still observes each wait once — pinned by
+  sum(record waits) == histogram sum AND histogram count ==
+  admissions), page-seconds, slot share, modeled FLOPs — with ZERO
+  added device synchronizations at any sample rate (pinned via the
+  exectime ``_block_until_ready`` indirection).
+- **Burn-rate math**: compliance / fast+slow burn / budget remaining
+  pinned against synthetic traces with known violation patterns;
+  insufficient data answers None, never fabricated; warn flips and
+  recovers; off-flag = zero registrations.
+- **Tenant cardinality + escaping**: hostile tenant names round-trip
+  through the exposition escaping; the cap collapses overflow into
+  ``_other`` and never grows the registry.
+- **Autoscale honesty**: no engine ticks -> no gauges; the demand
+  model components pin exactly; drain_safe flips on idle.
+"""
+import json
+import math
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.monitor import exectime
+from paddle_tpu.monitor import fleet
+from paddle_tpu.monitor import server
+from paddle_tpu.monitor import slo
+from paddle_tpu.monitor import trace
+
+
+@pytest.fixture
+def mon():
+    """Monitor on, clean state; everything torn down after."""
+    monitor.reset()
+    server.stop_server()
+    pt.set_flags({"FLAGS_enable_monitor": True})
+    yield monitor
+    server.stop_server()
+    server.unregister_health_provider("slo_burn")
+    slo._PROVIDER_REGISTERED[0] = False
+    slo.set_objectives(ttft_p99_ms=None, tpot_p99_ms=None,
+                       e2e_p99_ms=None, availability=None)
+    slo.set_max_tenants(None)
+    slo.set_window(None)
+    exectime.set_sample_rate(None)
+    pt.set_flags({"FLAGS_enable_monitor": False,
+                  "FLAGS_enable_monitor_server": False})
+    monitor.reset()
+
+
+def _engine(**kw):
+    import jax
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny()
+    params = L.init_params(cfg, jax.random.PRNGKey(3))
+    return ServingEngine(L, params, cfg, **kw), cfg
+
+
+def _reqs(cfg, lens, new, tenants=None, seed=0):
+    from paddle_tpu.inference import Request
+    rng = np.random.default_rng(seed)
+    tenants = tenants or ["default"] * len(lens)
+    return [Request(rid=i,
+                    prompt=rng.integers(0, cfg.vocab_size,
+                                        (n,)).astype(np.int32),
+                    max_new_tokens=m, tenant=t)
+            for i, (n, m, t) in enumerate(zip(lens, new, tenants))]
+
+
+def _completed(rec=None, tenant="default", **latencies):
+    """A synthetic completed-request record for the burn-math tests."""
+    out = {"tenant": tenant, "rejected": False, "prefill_tokens": 4,
+           "decode_tokens": 4, "queue_wait_ms": 1.0,
+           "page_seconds": 0.01, "slot_steps": 4, "model_flops": 100.0,
+           "ttft_ms": 10.0, "tpot_ms": 5.0, "e2e_ms": 50.0}
+    out.update(rec or {})
+    out.update(latencies)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine cost attribution
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+class TestCostAttribution:
+    def test_cost_record_populates(self, mon):
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        reqs = _reqs(cfg, lens=(5, 3, 6), new=(4, 4, 4),
+                     tenants=("alpha", "beta", "alpha"))
+        outs = eng.run(reqs)
+        for r in reqs:
+            o = outs[r.rid]
+            c = o.cost
+            assert c is not None and o.tenant == r.tenant
+            assert c.tenant == r.tenant
+            assert c.prefill_tokens == len(r.prompt)
+            assert c.decode_tokens == len(o.tokens) - 1  # first token
+            #                        is sampled by prefill, not decode
+            assert c.discarded_tokens == 0 and c.preemptions == 0
+            assert c.queue_wait_ms >= 0
+            assert c.page_seconds > 0
+            assert c.slot_steps > 0 and c.grid_steps >= c.slot_steps
+            assert c.slot_share is not None and 0 < c.slot_share <= 1
+            # CPU cost-analysis reports FLOPs, so attribution is live
+            assert c.model_flops > 0
+            assert c.ttft_ms is not None and c.e2e_ms is not None
+            assert c.e2e_ms >= c.ttft_ms
+        # per-tenant aggregates agree with the records exactly
+        tl = slo.tenants_snapshot()["tenants"]
+        assert set(tl) == {"alpha", "beta"}
+        assert tl["alpha"]["completed"] == 2
+        assert tl["alpha"]["prefill_tokens"] == 5 + 6
+        assert tl["beta"]["decode_tokens"] == \
+            outs[1].cost.decode_tokens
+        total_flops = sum(outs[r.rid].cost.model_flops for r in reqs)
+        agg_flops = sum(t["model_flops"] for t in tl.values())
+        assert agg_flops == pytest.approx(total_flops)
+
+    def test_queue_wait_cumulative_across_preemption(self, mon):
+        """The satellite pin: one preemption + re-admission -> the
+        record keeps the CUMULATIVE wait while the histogram observes
+        each individual wait once (count == admissions, and the
+        per-request sums partition the histogram's total)."""
+        eng, cfg = _engine(num_slots=2, max_len=16, page_size=4,
+                           num_pages=5, decode_chunk=2)
+        reqs = _reqs(cfg, lens=(4, 4, 4), new=(8, 8, 8))
+        outs = eng.run(reqs)
+        s = eng.stats
+        assert s.preempted >= 1                # tiny pool forces it
+        pre = [outs[r.rid] for r in reqs
+               if outs[r.rid].cost.preemptions >= 1]
+        assert pre, "no request was preempted"
+        assert pre[0].cost.discarded_tokens > 0
+        h = monitor.registry().get("serving.latency.queue_wait_ms")
+        # each ADMISSION (first or re-) observed exactly once
+        assert h.count == s.admitted > len(reqs)
+        # the cumulative per-request sums partition the histogram's
+        # total: a record missing its re-queue wait would break this
+        total = sum(outs[r.rid].cost.queue_wait_ms for r in reqs)
+        assert total == pytest.approx(h.sum, rel=1e-6)
+
+    def test_zero_added_syncs_at_any_rate(self, mon, monkeypatch):
+        """The acceptance pin: cost attribution rides the per-chunk
+        emitted-grid download — at exec sample rate 0 AND rate 1 the
+        engine adds zero ``block_until_ready`` synchronizations."""
+        calls = []
+        monkeypatch.setattr(
+            exectime, "_block_until_ready",
+            lambda outputs: calls.append(1))
+        for rate in (0, 1):
+            exectime.set_sample_rate(rate)
+            eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                               decode_chunk=2)
+            outs = eng.run(_reqs(cfg, lens=(4, 5), new=(4, 4)))
+            assert len(outs) == 2
+            assert outs[0].cost.page_seconds > 0   # plane was live
+            assert calls == [], f"rate {rate} added {len(calls)} syncs"
+
+    def test_off_path_no_cost_no_registrations(self):
+        monitor.reset()
+        assert not monitor.enabled()
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        outs = eng.run(_reqs(cfg, lens=(4,), new=(3,)))
+        assert outs[0].cost is None
+        assert outs[0].tenant == "default"
+        assert monitor.snapshot() == {}
+        assert slo.records() == []
+        assert slo.tenants_snapshot()["tenants"] == {}
+        assert slo.update_autoscale_gauges() == {"available": False}
+
+    def test_tenant_priority_validation(self, mon):
+        from paddle_tpu.inference import Request, RequestRejected
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        prompt = np.array([1, 2, 3], np.int32)
+        # coercible-but-wrong-typed fields are normalized onto the
+        # request (the PR 6 screening discipline)
+        r = Request(rid=0, prompt=prompt, max_new_tokens=2,
+                    tenant=7, priority=np.int64(2))
+        eng.submit(r)
+        assert r.tenant == "7" and r.priority == 2
+        # non-integral priority is refused before any engine state
+        with pytest.raises(RequestRejected, match="priority"):
+            eng.submit(Request(rid=1, prompt=prompt, max_new_tokens=2,
+                               priority=1.5))
+        # infinities reject TYPED, not as an escaping OverflowError
+        with pytest.raises(RequestRejected, match="priority"):
+            eng.submit(Request(rid=7, prompt=prompt, max_new_tokens=2,
+                               priority=float("inf")))
+        with pytest.raises(RequestRejected, match="max_new_tokens"):
+            eng.submit(Request(rid=8, prompt=prompt,
+                               max_new_tokens=float("inf")))
+        # oversized tenant label is refused (128-char limit)
+        with pytest.raises(RequestRejected, match="tenant"):
+            eng.submit(Request(rid=2, prompt=prompt, max_new_tokens=2,
+                               tenant="x" * 200))
+        # empty/None tenant coerces to "default"
+        r3 = Request(rid=3, prompt=prompt, max_new_tokens=2, tenant="")
+        eng.submit(r3)
+        assert r3.tenant == "default"
+        # rejections entered the availability window — but none of
+        # these tenants had completed a request yet, and a rejection
+        # cannot CLAIM a label slot (squatting defense), so they all
+        # collapse into _other
+        rej = [x for x in slo.records() if x["rejected"]]
+        assert len(rej) == 4
+        assert {x["tenant"] for x in rej} == {slo.OVERFLOW_TENANT}
+        eng.run()
+        tl = slo.tenants_snapshot()["tenants"]
+        assert tl[slo.OVERFLOW_TENANT]["rejected"] == 4
+        assert tl["default"]["completed"] == 1     # the ""->default
+        assert tl["7"]["completed"] == 1           # the coerced int
+        # engine kept serving after the poisoned submissions
+        assert len(eng.outputs) == 2
+        # a rejection claiming an ALREADY-tracked tenant attributes
+        with pytest.raises(RequestRejected):
+            eng.submit(Request(rid=9, prompt=prompt, max_new_tokens=2,
+                               tenant="7", priority=0.5))
+        assert slo.tenants_snapshot()["tenants"]["7"]["rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (synthetic traces)
+# ---------------------------------------------------------------------------
+
+class TestBurnRateMath:
+    def test_compliance_and_burn_pinned(self, mon):
+        slo.set_objectives(ttft_p99_ms=100.0, availability=0.9)
+        # 10 completed: 2 violate the 100ms TTFT objective
+        for i in range(10):
+            slo.record_request(_completed(
+                ttft_ms=200.0 if i < 2 else 50.0))
+        rep = slo.compliance_report()
+        t = rep["objectives"]["ttft_p99_ms"]
+        assert t["samples_slow"] == 10
+        assert t["compliance"] == pytest.approx(0.8)
+        # bad_frac 0.2 / budget 0.01 = 20x burn; budget overdrawn
+        assert t["burn_slow"] == pytest.approx(20.0)
+        assert t["burn_fast"] == pytest.approx(20.0)  # fast ⊇ all 10
+        assert t["budget_remaining"] == pytest.approx(-19.0)
+        # availability: one rejection among 11 -> bad_frac 1/11 over
+        # a 0.1 budget
+        slo.record_rejected("default")
+        a = slo.compliance_report()["objectives"]["availability"]
+        assert a["samples_slow"] == 11
+        assert a["compliance"] == pytest.approx(10 / 11)
+        assert a["burn_slow"] == pytest.approx((1 / 11) / 0.1)
+        # rejected records are NOT relevant to latency windows
+        t2 = slo.compliance_report()["objectives"]["ttft_p99_ms"]
+        assert t2["samples_slow"] == 10
+        # gauges mirror the report
+        g = monitor.snapshot()["gauges"]
+        assert g["slo.ttft_p99_ms.burn_slow"] == pytest.approx(20.0)
+        assert g["slo.window.requests"] == 11
+
+    def test_insufficient_data_answers_none(self, mon):
+        slo.set_objectives(ttft_p99_ms=100.0)
+        for _ in range(4):                   # below the 5-sample floor
+            slo.record_request(_completed(ttft_ms=500.0,
+                                          tpot_ms=None))
+        t = slo.compliance_report()["objectives"]["ttft_p99_ms"]
+        assert t["compliance"] is None
+        assert t["burn_fast"] is None and t["burn_slow"] is None
+        assert t["budget_remaining"] is None and not t["alerting"]
+        # a missing latency (1-token request has no TPOT) is simply
+        # not relevant — never counted as good OR bad
+        tp = slo.compliance_report()["objectives"]["tpot_p99_ms"]
+        assert tp["samples_slow"] == 0
+        slo.record_request(_completed(ttft_ms=500.0,    # 5th answers
+                                      tpot_ms=None))
+        t = slo.compliance_report()["objectives"]["ttft_p99_ms"]
+        assert t["compliance"] == 0.0
+        assert t["burn_slow"] == pytest.approx(100.0)
+
+    def test_warn_flips_and_recovers(self, mon):
+        slo.set_objectives(ttft_p99_ms=100.0)
+        for _ in range(8):                            # all violating
+            slo.record_request(_completed(ttft_ms=900.0))
+        rep = slo.compliance_report()
+        assert "ttft_p99_ms" in rep["alerting"]
+        assert rep["objectives"]["ttft_p99_ms"]["burn_fast"] \
+            == pytest.approx(100.0)
+        assert monitor.snapshot()["gauges"]["slo.alerting"] == 1
+        hz = slo._slo_provider()
+        assert hz["ok"] is True and hz["level"] == "warn"
+        assert "ttft_p99_ms" in hz["alerting"]
+        # recovery: enough good requests to flush the fast window
+        for _ in range(rep["fast_window"]):
+            slo.record_request(_completed(ttft_ms=10.0))
+        rep2 = slo.compliance_report()
+        assert "ttft_p99_ms" not in rep2["alerting"]
+        assert rep2["objectives"]["ttft_p99_ms"]["burn_fast"] \
+            == pytest.approx(0.0)
+        assert monitor.snapshot()["gauges"]["slo.alerting"] == 0
+
+    def test_tenant_compliance_windowed(self, mon):
+        slo.set_objectives(ttft_p99_ms=100.0)
+        for _ in range(6):
+            slo.record_request(_completed(tenant="good", ttft_ms=10.0))
+        for _ in range(6):
+            slo.record_request(_completed(tenant="bad", ttft_ms=500.0))
+        slo.record_request(_completed(tenant="thin"))
+        tc = slo.tenant_compliance()
+        assert tc["good"]["ttft_p99_ms"] == 1.0
+        assert tc["bad"]["ttft_p99_ms"] == 0.0
+        assert tc["bad"]["availability"] == 1.0     # not rejected
+        # below the min-sample floor: None, never fabricated
+        assert tc["thin"]["ttft_p99_ms"] is None
+        assert tc["thin"]["requests_in_window"] == 1
+
+    def test_off_flag_zero_registration(self):
+        monitor.reset()
+        assert not monitor.enabled()
+        slo.record_request(_completed())
+        slo.record_rejected("ghost")
+        slo.note_sched_tick(4, 2, 2, 0.5)
+        assert slo.records() == []
+        assert slo.tenants_snapshot()["tenants"] == {}
+        assert monitor.snapshot() == {}
+
+    def test_window_bounded(self, mon):
+        slo.set_window(16)
+        for i in range(50):
+            slo.record_request(_completed(tenant=f"t{i % 2}"))
+        assert slo.window_capacity() == 16
+        assert len(slo.records()) == 16
+        assert slo.total_records() == 50
+        # tenant aggregates keep the LIFETIME sums, not the window's
+        tl = slo.tenants_snapshot()["tenants"]
+        assert tl["t0"]["requests"] + tl["t1"]["requests"] == 50
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError, match="unknown"):
+            slo.set_objectives(nope=1.0)
+        with pytest.raises(ValueError, match="out of range"):
+            slo.set_objectives(availability=1.5)
+        with pytest.raises(ValueError, match="out of range"):
+            slo.set_objectives(ttft_p99_ms=0)
+
+    def test_env_objectives(self, mon, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SLO_TTFT_P99_MS", "42.5")
+        assert slo.objectives()["ttft_p99_ms"] == 42.5
+        slo.set_objectives(ttft_p99_ms=7.0)        # override wins
+        assert slo.objectives()["ttft_p99_ms"] == 7.0
+        # availability >= 1.0 from the env would zero the error budget
+        # and silently disable burn rates — falls back to the default
+        # (the same input set_objectives rejects loudly)
+        monkeypatch.setenv("PADDLE_TPU_SLO_AVAILABILITY", "1.0")
+        assert slo.objectives()["availability"] == 0.995
+
+
+# ---------------------------------------------------------------------------
+# tenant exposition + cardinality
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v):
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def _tenant_samples(text, family):
+    """{tenant: value} for one slo_tenant_* family, asserting the
+    TYPE line precedes its samples (the strict-format discipline)."""
+    out = {}
+    type_seen = False
+    for line in text.splitlines():
+        if line == f"# TYPE {family} counter":
+            type_seen = True
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m and m.group(1) == family:
+            assert type_seen, f"sample before TYPE for {family}"
+            labels = dict((k, _unescape(v)) for k, v in
+                          _LABEL_RE.findall(m.group(2) or ""))
+            out[labels["tenant"]] = float(m.group(3))
+    return out
+
+
+class TestTenantExposition:
+    def test_hostile_tenant_round_trips(self, mon):
+        nasty = 'evil"\n\\tenant'
+        slo.record_request(_completed(tenant=nasty))
+        slo.record_request(_completed(tenant="plain"))
+        text = monitor.expose_text()
+        samples = _tenant_samples(text, "slo_tenant_requests")
+        assert samples == {nasty: 1.0, "plain": 1.0}
+        # the raw bytes never appear unescaped: every line still
+        # parses as exactly one sample or comment
+        for line in text.splitlines():
+            assert line.startswith("#") or _SAMPLE_RE.match(line), \
+                repr(line)
+
+    def test_every_cost_family_exposed(self, mon):
+        slo.record_request(_completed(tenant="acme"))
+        text = monitor.expose_text()
+        for field in ("requests", "completed", "rejected",
+                      "prefill_tokens", "decode_tokens",
+                      "discarded_tokens", "queue_wait_ms",
+                      "page_seconds", "slot_steps", "model_flops",
+                      "preemptions"):
+            fam = f"slo_tenant_{field}"
+            assert f"# TYPE {fam} counter" in text, fam
+            assert _tenant_samples(text, fam), fam
+
+    def test_cardinality_cap_collapses_to_other(self, mon):
+        slo.set_max_tenants(3)
+        for i in range(10):
+            slo.record_request(_completed(tenant=f"tenant-{i}"))
+        snap = slo.tenants_snapshot()
+        tl = snap["tenants"]
+        real = [t for t in tl if t != slo.OVERFLOW_TENANT]
+        assert sorted(real) == ["tenant-0", "tenant-1", "tenant-2"]
+        assert tl[slo.OVERFLOW_TENANT]["requests"] == 7
+        assert snap["overflow_records"] == 7
+        # the ring records carry the COLLAPSED key too, so window
+        # views can never resurrect unbounded names
+        assert {r["tenant"] for r in slo.records()} == \
+            set(real) | {slo.OVERFLOW_TENANT}
+
+    def test_cap_never_grows_registry(self, mon):
+        slo.set_max_tenants(2)
+        # warm the window past min-samples and materialize every
+        # slo.* gauge the plane will ever register (gauges refresh
+        # pull-shaped inside compliance_report) BEFORE the churn
+        for _ in range(8):
+            slo.record_request(_completed(tenant="a"))
+        slo.compliance_report()
+        n_metrics = len(monitor.registry())
+        for i in range(20):
+            slo.record_request(_completed(tenant=f"hostile-{i}"))
+        slo.compliance_report()
+        # tenant churn grows NEITHER the registry nor the label space
+        assert len(monitor.registry()) == n_metrics
+        tl = slo.tenants_snapshot()["tenants"]
+        assert len(tl) <= 3                    # 2 real + _other
+
+    def test_rejection_cannot_claim_label_slot(self, mon):
+        # unauthenticated garbage with fresh tenant claims must not
+        # squat the bounded label space: rejections only attribute to
+        # tenants that EARNED a slot by completing a request
+        slo.record_rejected("squatter")
+        assert "squatter" not in slo.tenants_snapshot()["tenants"]
+        assert slo.tenants_snapshot()["tenants"][
+            slo.OVERFLOW_TENANT]["rejected"] == 1
+        slo.record_request(_completed(tenant="squatter"))
+        slo.record_rejected("squatter")        # now tracked: honored
+        assert slo.tenants_snapshot()["tenants"][
+            "squatter"]["rejected"] == 1
+
+    def test_empty_without_records(self, mon):
+        assert slo.tenant_exposition_text() == ""
+        assert "slo_tenant" not in monitor.expose_text()
+
+
+# ---------------------------------------------------------------------------
+# autoscale signals
+# ---------------------------------------------------------------------------
+
+class TestAutoscale:
+    def test_no_ticks_no_gauges(self, mon):
+        out = slo.update_autoscale_gauges()
+        assert out == {"available": False}
+        assert monitor.registry().get(
+            "serving.autoscale.demand_estimate") is None
+
+    def test_demand_model_pinned(self, mon):
+        # queue grows 0 -> 8; last tick: half the slots live, 3/4 of
+        # the page pool used, 8 queued on a 4-slot engine
+        for qd in (0, 2, 4, 8):
+            slo.note_sched_tick(qd, 2, 4, 0.25)
+        out = slo.update_autoscale_gauges()
+        assert out["available"] and not out["drain_safe"]
+        assert out["utilization"] == pytest.approx(0.75)  # page leg
+        assert out["backlog_slots"] == pytest.approx(2.0)
+        assert out["queue_depth_trend_per_s"] is not None
+        assert out["queue_depth_trend_per_s"] > 0
+        growth = out["queue_depth_trend_per_s"] * out["horizon_s"] / 4
+        assert out["demand_estimate"] == pytest.approx(
+            0.75 + 2.0 + growth, rel=1e-3)
+        assert out["desired_capacity_hint"] == \
+            math.ceil(out["demand_estimate"] - 1e-9)
+        g = monitor.snapshot()["gauges"]
+        assert g["serving.autoscale.demand_estimate"] > 0
+        assert g["serving.autoscale.drain_safe"] == 0
+
+    def test_drain_safe_on_idle(self, mon):
+        slo.note_sched_tick(4, 2, 2, 0.5)
+        slo.note_sched_tick(0, 0, 2, 1.0)
+        out = slo.update_autoscale_gauges()
+        assert out["drain_safe"] and out["utilization"] == 0.0
+        assert out["demand_estimate"] == 0.0    # negative trend clamped
+        assert out["desired_capacity_hint"] == 0
+        assert monitor.snapshot()["gauges"][
+            "serving.autoscale.drain_safe"] == 1
+
+    def test_headroom_leg_composes(self, mon):
+        slo.note_sched_tick(0, 1, 4, 1.0)
+        hr = {"est_admittable_bytes": 25,
+              "hbm": {"totals": {"bytes_limit": 100,
+                                 "bytes_in_use": 60}}}
+        out = slo.update_autoscale_gauges(headroom=hr)
+        assert out["memory_utilization"] == pytest.approx(0.75)
+        assert out["utilization"] == pytest.approx(0.75)  # beats 0.25
+        assert out["est_admittable_bytes"] == 25
+        # a silent backend contributes nothing — never fabricated
+        out2 = slo.update_autoscale_gauges(
+            headroom={"est_admittable_bytes": None,
+                      "hbm": {"totals": {}}})
+        assert out2["memory_utilization"] is None
+        assert out2["utilization"] == pytest.approx(0.25)  # slot leg
+
+    def test_engine_feeds_ticks(self, mon):
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        reqs = _reqs(cfg, lens=(4, 4, 4, 4), new=(6, 6, 6, 6))
+        for r in reqs:
+            eng.submit(r)
+        eng.step()                                # mid-run: backlog up
+        mid = slo.update_autoscale_gauges()
+        assert mid["available"] and not mid["drain_safe"]
+        assert mid["demand_estimate"] >= 1.0
+        eng.run()
+        end = slo.update_autoscale_gauges()
+        assert end["drain_safe"] and end["demand_estimate"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# routes, healthz, flight record, fleet
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.mark.serving
+class TestSurfaces:
+    def test_slo_route_end_to_end(self, mon):
+        srv = server.start_server(port=0)
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        eng.run(_reqs(cfg, lens=(4, 5, 3, 6, 4, 5), new=(3,) * 6,
+                      tenants=["a", "b"] * 3))
+        status, body = _get(f"{srv.url}/slo")
+        assert status == 200
+        p = json.loads(body)
+        assert p["kind"] == "paddle_tpu.slo"
+        av = p["compliance"]["objectives"]["availability"]
+        assert av["compliance"] == 1.0 and av["burn_slow"] == 0.0
+        assert set(p["tenants"]["tenants"]) == {"a", "b"}
+        assert p["autoscale"]["available"]
+        # the route is listed at the root index
+        _, idx = _get(f"{srv.url}/")
+        assert "/slo" in json.loads(idx)["routes"]
+        # /metrics carries the tenant series and autoscale gauges
+        _, mtext = _get(f"{srv.url}/metrics")
+        mtext = mtext.decode()
+        assert 'slo_tenant_requests{tenant="a"}' in mtext
+        assert "serving_autoscale_drain_safe" in mtext
+
+    def test_healthz_warn_provider(self, mon):
+        slo.set_objectives(ttft_p99_ms=1.0)     # everything violates
+        srv = server.start_server(port=0)
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        eng.run(_reqs(cfg, lens=(4,) * 6, new=(3,) * 6))
+        status, body = _get(f"{srv.url}/healthz")
+        hz = json.loads(body)
+        assert status == 200, hz             # warn level: never 503
+        rep = hz["providers"]["slo_burn"]
+        assert rep["level"] == "warn"
+        assert "ttft_p99_ms" in rep["alerting"]
+        assert rep["burn_fast"]["ttft_p99_ms"] > 14.4
+
+    def test_flight_record_carries_slo_block(self, mon):
+        slo.record_request(_completed(tenant="boxed"))
+        payload = trace.flight_payload(reason="test")
+        assert payload["slo"]["kind"] == "paddle_tpu.slo"
+        assert "boxed" in payload["slo"]["tenants"]["tenants"]
+        json.dumps(payload["slo"])           # strictly serializable
+
+    def test_fleet_aggregate_carries_tenants(self, mon):
+        slo.record_request(_completed(tenant="acme", model_flops=10.0))
+        slo.record_request(_completed(tenant="acme", model_flops=5.0))
+        agg = fleet.aggregated_snapshot(name="slo-test")
+        t = agg["aggregate"]["slo_tenants"]["acme"]
+        assert t["requests"] == 2
+        assert t["model_flops"] == pytest.approx(15.0)
+        text = fleet.expose_fleet_text(agg)
+        assert 'slo_tenant_requests{tenant="acme",agg="sum"} 2' in text
+
+    def test_monitor_reset_empties_plane(self, mon):
+        slo.record_request(_completed(tenant="gone"))
+        slo.note_sched_tick(1, 1, 2, 0.5)
+        monitor.reset()
+        assert slo.records() == []
+        assert slo.tenants_snapshot()["tenants"] == {}
+        assert slo.update_autoscale_gauges() == {"available": False}
+        assert slo.tenant_exposition_text() == ""
+
+
+# ---------------------------------------------------------------------------
+# bench-guard lower rungs
+# ---------------------------------------------------------------------------
+
+def _load_guard():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_regression",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "scripts", "check_bench_regression.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+class TestBenchGuardSloRungs:
+    def test_slo_rungs_in_lower_allowlist(self):
+        g = _load_guard()
+        assert g.ALLOWLIST_LOWER["serving_ttft_ms_p99"] == \
+            "extra.metrics.slo.ttft_p99_ms"
+        assert g.ALLOWLIST_LOWER["serving_tpot_ms_p99"] == \
+            "extra.metrics.slo.tpot_p99_ms"
+
+    def test_extraction_and_absence_skip(self, tmp_path):
+        g = _load_guard()
+        blob = {"parsed": {"metric": "x", "value": 100.0, "extra": {
+            "metrics": {"slo": {"ttft_p99_ms": 12.5,
+                                "tpot_p99_ms": 3.25}}}}}
+        rungs = g.extract_rungs(blob, g.ALLOWLIST_LOWER)
+        assert rungs["serving_ttft_ms_p99"] == 12.5
+        assert rungs["serving_tpot_ms_p99"] == 3.25
+        # absence on an old blob contributes nothing (skip, not zero)
+        old = {"parsed": {"metric": "x", "value": 100.0, "extra": {}}}
+        assert g.extract_rungs(old, g.ALLOWLIST_LOWER) is None
+        # trajectory: old round without the block + new round with it
+        # -> no ceiling yet, guard passes
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(blob))
+        ok, lines = g.check(str(tmp_path))
+        assert ok, lines
+        # a later round regressing TTFT beyond tolerance FAILS
+        worse = {"parsed": {"metric": "x", "value": 100.0, "extra": {
+            "metrics": {"slo": {"ttft_p99_ms": 20.0,
+                                "tpot_p99_ms": 3.30}}}}}
+        (tmp_path / "BENCH_r03.json").write_text(json.dumps(worse))
+        ok, lines = g.check(str(tmp_path))
+        assert not ok
+        assert any("serving_ttft_ms_p99" in ln and "REGRESSION" in ln
+                   for ln in lines)
+
+    def test_checked_in_trajectory_still_green(self):
+        g = _load_guard()
+        ok, lines = g.check()
+        assert ok, "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# overhead harness (slow lane — the acceptance measurement)
+# ---------------------------------------------------------------------------
+
+def measure_slo_overhead(windows=6):
+    """Median per-window engine overhead with the whole monitor plane
+    (incl. PR 12 cost attribution) ON vs OFF, interleaved windows of
+    the serving_paged CPU trace shape. Returns (median_pct, pcts).
+    Measured on this container: see CHANGES.md."""
+    import time as _time
+
+    import jax
+    from paddle_tpu.inference import Request, ServingEngine
+    from paddle_tpu.models import llama as L
+
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
+    jax.block_until_ready(params["embed"])
+    rng = np.random.default_rng(42)
+    trace_lens = [(int(rng.choice((4, 8, 16))),
+                   int(rng.choice((4, 8, 16)))) for _ in range(16)]
+    trace_lens.sort(key=lambda t: -t[1])
+    max_len = max(p for p, _ in trace_lens) + max(g for _, g in
+                                                  trace_lens)
+
+    def run_once(base):
+        eng = ServingEngine(L, params, cfg, num_slots=4,
+                            max_len=max_len, page_size=4,
+                            decode_chunk=8)
+        reqs = [Request(rid=base + i,
+                        prompt=rng.integers(0, cfg.vocab_size, (p,))
+                        .astype(np.int32), max_new_tokens=g,
+                        tenant=f"t{i % 4}")
+                for i, (p, g) in enumerate(trace_lens)]
+        t0 = _time.perf_counter()
+        eng.run(reqs)
+        return _time.perf_counter() - t0
+
+    def window(flag, base):
+        pt.set_flags({"FLAGS_enable_monitor": flag})
+        return run_once(base)
+
+    window(False, 0), window(True, 10_000)        # compile + warm
+    pcts = []
+    for w in range(windows):
+        t_off = window(False, 20_000 + w * 1000)
+        t_on = window(True, 50_000 + w * 1000)
+        pcts.append((t_on - t_off) / t_off * 100.0)
+    pt.set_flags({"FLAGS_enable_monitor": False})
+    monitor.reset()
+    pcts.sort()
+    mid = len(pcts) // 2
+    med = pcts[mid] if len(pcts) % 2 else (pcts[mid - 1]
+                                           + pcts[mid]) / 2
+    return med, pcts
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_slo_overhead_harness():
+    """Cost attribution is pure host arithmetic at seams that already
+    synchronized: the monitor-on engine (SLO plane included) stays
+    within noise of monitor-off. The tier-1 bound is loose (shared
+    2-core container swings ±10% window to window); the <1% acceptance
+    number is the interleaved-window median recorded in CHANGES.md."""
+    med, pcts = measure_slo_overhead()
+    assert med < 10.0, (med, pcts)
