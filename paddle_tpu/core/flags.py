@@ -119,6 +119,37 @@ define_flag("monitor_server_port", 0,
             "Port for the operator-plane HTTP server (binds 127.0.0.1; "
             "override host with PADDLE_TPU_MONITOR_HOST). 0 = an "
             "ephemeral port, exposed on the server object for tests.")
+define_flag("serving_priority_admission", False,
+            "Serving engine admission orders the queue by (priority "
+            "desc, arrival) instead of FIFO and honours "
+            "FLAGS_serving_tenant_inflight_cap. Off (the default) = "
+            "the original FIFO scan, byte-identical scheduling.")
+define_flag("serving_tenant_inflight_cap", 0,
+            "Max live decode slots one tenant may hold at once "
+            "(0 = uncapped). Works alone (admission stays strict FIFO "
+            "among cap-eligible requests) or with "
+            "FLAGS_serving_priority_admission (priority order among "
+            "cap-eligible).")
+define_flag("serving_max_queue", 0,
+            "Bounded serving queue: submissions beyond this depth are "
+            "shed with a typed EngineOverloaded carrying a "
+            "retry_after_s hint from the autoscale demand model "
+            "(higher-priority submissions displace the lowest-priority "
+            "queued request instead). 0 (the default) = unbounded, "
+            "today's behavior.")
+define_flag("serving_shed_on_burn", False,
+            "Shed priority<=0 submissions while a LATENCY SLO "
+            "objective's (TTFT/TPOT/e2e — availability excluded: "
+            "sheds are themselves availability-bad records and must "
+            "not re-arm their own trigger) fast-window burn rate is "
+            "at/over the warn threshold (monitor on only; the burn "
+            "check is cached ~0.5s). Off by default.")
+define_flag("serving_slo_preemption", False,
+            "Page-pressure preemption evicts the request with the "
+            "LOWEST eviction cost (priority, then prior preemptions, "
+            "then accumulated work from the per-request cost record) "
+            "instead of youngest-first. Off (the default) = "
+            "youngest-first, today's behavior.")
 define_flag("fault_injection", "",
             "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
             " armed at import by paddle_tpu.testing.faults (actions: "

@@ -259,6 +259,263 @@ class AdaptiveElasticManager(ElasticManager):
             shutil.rmtree(ctl, ignore_errors=True)
 
 
+    # -- serving-replica elasticity (ROADMAP item 5, acting half) ------------
+    #
+    # Training elasticity above re-forms a WORLD between launches; serving
+    # elasticity manages a fleet of independent engine REPLICAS against the
+    # autoscale demand signals the SLO plane computes
+    # (monitor/slo.demand_model — the same payload /slo serves as
+    # serving.autoscale.*). Transport-agnostic: the caller provides
+    # spawn/stop/signals callables (a k8s deployment, subprocesses, or
+    # in-process engines in tests); the controller owns the POLICY — scale
+    # toward the demand hint within [min, max], drain before stopping, and
+    # replace heartbeat-stale replicas.
+
+    def _drain_and_stop(self, name, handle, *, signals, drain, stop,
+                        drain_timeout: float, poll_interval: float,
+                        state_fn=None, ckpt_dir=None,
+                        checkpoint: bool = True,
+                        stop_event=None) -> bool:
+        """The scale-in path, in the order that keeps it crash-safe:
+        (1) checkpoint via the PR 2 CheckpointManager (atomic commit —
+        a kill -9 anywhere after this leaves only committed state;
+        ``checkpoint=False`` on a RETRY of the same victim, so a
+        repeatedly-timing-out drain does not re-save identical state
+        every tick), (2) tell the replica to stop admitting
+        (``drain``, default ``handle.begin_drain()``, idempotent: new
+        submissions shed with retry hints), (3) WAIT until its signals
+        report ``drain_safe`` (no queued, no resident requests — live
+        work finishes, never dropped), (4) stop it. Returns False on
+        drain timeout — or when ``stop_event`` fires, so a controller
+        shutdown never hangs behind a long decode — WITHOUT stopping:
+        a replica is stopped only when ``drain_safe``; the caller
+        retries on a later tick."""
+        import os
+
+        from ...testing import faults as _faults
+
+        root = ckpt_dir or os.environ.get("PADDLE_ELASTIC_CKPT_DIR")
+        if checkpoint and state_fn is not None and root:
+            _faults.hit("drain.checkpoint")
+            mgr = _manager_for(root)
+            step = (mgr.latest_step() or 0) + 1
+            mgr.save(step, dict(state_fn()), blocking=True)
+        drain(name, handle)
+        deadline = time.monotonic() + drain_timeout
+        while True:
+            try:
+                sig = signals(name, handle)
+            except Exception:
+                sig = None
+            if sig and sig.get("drain_safe"):
+                break
+            if time.monotonic() >= deadline:
+                return False
+            if stop_event is not None and stop_event.is_set():
+                return False
+            time.sleep(poll_interval)
+        _faults.hit("drain.stop")
+        stop(name, handle)
+        return True
+
+    def run_serving(self, spawn, stop, *, signals=None, drain=None,
+                    min_replicas: int = 1, max_replicas: int = 4,
+                    poll_interval: float = 0.05,
+                    drain_timeout: float = 60.0,
+                    heartbeat_dir: Optional[str] = None,
+                    heartbeat_timeout: float = 0.0,
+                    state_fn=None, ckpt_dir: Optional[str] = None,
+                    max_ticks: Optional[int] = None,
+                    stop_event=None) -> dict:
+        """Drive a serving-replica fleet against the autoscale signals.
+
+        ``spawn(name) -> handle`` creates a replica; ``stop(name,
+        handle)`` terminates one; ``signals(name, handle) -> dict``
+        returns its demand payload (default:
+        ``handle.autoscale_payload()`` — the engine's own
+        ``monitor/slo.demand_model`` view); ``drain(name, handle)``
+        begins its drain (default ``handle.begin_drain()``).
+
+        Each tick: (1) heartbeat-stale replicas (``heartbeat_dir`` +
+        ``heartbeat_timeout``, via ``heartbeat.stale_names``) are
+        force-stopped and replaced — a wedged replica cannot execute a
+        drain protocol, so it burns a unit of the restart budget
+        instead; (2) fleet demand = sum of per-replica
+        ``demand_estimate``, and the fleet scales toward
+        ``ceil(demand)`` clamped to [min_replicas, max_replicas] —
+        scale-out spawns immediately, scale-in retires the NEWEST
+        replica (oldest keep their warm compile caches) through
+        :meth:`_drain_and_stop`, at most one per tick, and ONLY once
+        its ``drain_safe`` signal holds. A drain is COMMITTED: once
+        ``begin_drain`` ran, the replica sheds all new work (the
+        engine has no un-drain), so it stops counting toward
+        effective capacity — a demand rise mid-drain spawns a
+        replacement instead of stranding a shedding replica in the
+        fleet — and the controller keeps retrying its drain (without
+        re-checkpointing) until it completes. Returns a summary once
+        ``max_ticks`` elapse or ``stop_event`` is set; the event log
+        rides ``self.events`` like the training paths."""
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"[{min_replicas}, {max_replicas}]")
+        from .. import heartbeat as _heartbeat
+
+        if signals is None:
+            def signals(name, h):
+                return h.autoscale_payload() \
+                    if hasattr(h, "autoscale_payload") else None
+        if drain is None:
+            def drain(name, h):
+                if hasattr(h, "begin_drain"):
+                    h.begin_drain()
+        self.restarts = 0
+        self.events = []
+        replicas: dict = {}
+        spawn_times: dict = {}
+        next_idx = [0]
+
+        def _spawn(reason):
+            name = f"replica{next_idx[0]}"
+            next_idx[0] += 1
+            replicas[name] = spawn(name)
+            spawn_times[name] = time.time()
+            self._record(ElasticStatus.RESTART,
+                         {"reason": reason, "replica": name,
+                          "replicas": len(replicas)})
+            return name
+
+        for _ in range(min_replicas):
+            _spawn("spawn")
+        ticks = 0
+        draining: set = set()    # committed drains: shedding, excluded
+        #                          from effective capacity, retried
+        ckpted: set = set()      # victims whose pre-drain checkpoint
+        #                          already committed (never re-saved)
+        drain_deadline: dict = {}   # name -> [cross-tick deadline,
+        #                             timeout-event-recorded flag]
+        while True:
+            if stop_event is not None and stop_event.is_set():
+                self._record(ElasticStatus.EXIT, {"reason": "stopped"})
+                break
+            if max_ticks is not None and ticks >= max_ticks:
+                self._record(ElasticStatus.EXIT,
+                             {"reason": "max_ticks", "ticks": ticks})
+                break
+            ticks += 1
+            if heartbeat_dir and heartbeat_timeout > 0:
+                stale = _heartbeat.stale_names(
+                    heartbeat_dir, list(replicas), heartbeat_timeout,
+                    started_at=spawn_times)
+                for name, why in stale.items():
+                    # a wedged replica cannot drain — force-stop and
+                    # replace, burning a unit of the restart budget
+                    handle = replicas.pop(name)
+                    spawn_times.pop(name, None)
+                    draining.discard(name)
+                    ckpted.discard(name)
+                    drain_deadline.pop(name, None)
+                    self._record(ElasticStatus.RESTART,
+                                 {"reason": "stale-replace",
+                                  "replica": name, "detail": why})
+                    try:
+                        stop(name, handle)
+                    except Exception as e:
+                        self._record(ElasticStatus.ERROR,
+                                     {"reason": "stale-stop-failed",
+                                      "replica": name,
+                                      "detail": repr(e)})
+                    self.restarts += 1
+                    # >= : same budget semantics as the training paths
+                    # (max_restarts replacements total, not N+1)
+                    if self.restarts >= self.max_restarts:
+                        self._record(
+                            ElasticStatus.ERROR,
+                            {"reason": "restart budget exhausted"})
+                        return {"replicas": list(replicas),
+                                "ticks": ticks, "events": self.events}
+            payloads = {}
+            for name, h in list(replicas.items()):
+                try:
+                    p = signals(name, h)
+                except Exception:
+                    p = None
+                if p:
+                    payloads[name] = p
+            if payloads:
+                import math as _math
+                demand = sum(p.get("demand_estimate", 0.0)
+                             for p in payloads.values())
+                desired = max(int(_math.ceil(demand - 1e-9)), 0)
+            else:
+                # no signals: hold effective capacity steady
+                desired = len(replicas) - len(draining)
+            desired = min(max(desired, min_replicas), max_replicas)
+            # effective capacity excludes committed drains: a replica
+            # that began draining sheds every submission, so demand
+            # growth mid-drain spawns a replacement instead of
+            # counting a shedding replica as capacity. The TOTAL fleet
+            # (draining included) still honors max_replicas — on infra
+            # provisioned for exactly that many, the replacement waits
+            # for the drain to land rather than oversubscribing.
+            while (len(replicas) - len(draining) < desired
+                   and len(replicas) < max_replicas):
+                _spawn("scale-out")
+            target = None
+            if draining:
+                # resume a committed drain first (no re-checkpoint)
+                target = next(n for n in replicas if n in draining)
+            elif len(replicas) - len(draining) > desired:
+                target = next(n for n in reversed(list(replicas))
+                              if n not in draining)   # newest first
+            if target is not None:
+                if target not in draining:
+                    draining.add(target)
+                    drain_deadline[target] = [
+                        time.monotonic() + drain_timeout, False]
+                # the in-tick wait is BOUNDED (~one poll interval):
+                # the drain itself persists across ticks via the sets
+                # above, so a slow drain never suspends heartbeat
+                # checks, demand gathering, or scale-out for the rest
+                # of the fleet; drain_timeout is accounted against the
+                # cross-tick deadline instead
+                ok = self._drain_and_stop(
+                    target, replicas[target], signals=signals,
+                    drain=drain, stop=stop,
+                    drain_timeout=poll_interval,
+                    poll_interval=poll_interval, state_fn=state_fn,
+                    ckpt_dir=ckpt_dir,
+                    checkpoint=target not in ckpted,
+                    stop_event=stop_event)
+                ckpted.add(target)
+                if ok:
+                    replicas.pop(target)
+                    spawn_times.pop(target, None)
+                    draining.discard(target)
+                    ckpted.discard(target)
+                    drain_deadline.pop(target, None)
+                    self._record(ElasticStatus.RESTART,
+                                 {"reason": "scale-in",
+                                  "replica": target,
+                                  "replicas": len(replicas)})
+                else:
+                    dl = drain_deadline.get(target)
+                    if dl and not dl[1] and time.monotonic() >= dl[0]:
+                        # cross-tick drain_timeout spent: record the
+                        # transition ONCE (informational — the drain
+                        # stays committed and keeps retrying)
+                        dl[1] = True
+                        self._record(ElasticStatus.RESTART,
+                                     {"reason": "drain-timeout",
+                                      "replica": target})
+            if stop_event is not None:
+                stop_event.wait(poll_interval)
+            else:
+                time.sleep(poll_interval)
+        return {"replicas": list(replicas), "ticks": ticks,
+                "events": self.events}
+
+
 # -- worker-side elastic state (resume across world re-forms) ----------------
 
 def elastic_run_index() -> int:

@@ -161,6 +161,72 @@ def check_stale(dir_path: str, ranks, auto_timeout: float,
     return stale
 
 
+# -- named beats (serving replicas & other non-rank participants) ------------
+#
+# The rank-keyed files above serve elastic TRAINING; the elastic SERVING
+# controller (fleet/elastic.py run_serving) watches arbitrarily-NAMED
+# participants — "replica3" is not a trainer rank. Same transport, same
+# staleness semantics, name-keyed files.
+
+def touch_named(dir_path: str, name: str, payload: Optional[dict] = None):
+    """One liveness beat for a named participant (``<name>.alive``)."""
+    os.makedirs(dir_path, exist_ok=True)
+    _touch(os.path.join(dir_path, f"{name}{_AUTO_SUFFIX}"),
+           payload or {"t": time.time()})
+
+
+def start_named(dir_path: str, name: str,
+                interval: float = 1.0) -> threading.Event:
+    """Auto-beat daemon for a named participant; returns the stop
+    event. The thread dies with the process — a kill -9'd replica goes
+    stale within ``interval`` + the watcher's timeout."""
+    os.makedirs(dir_path, exist_ok=True)
+    stop = threading.Event()
+    path = os.path.join(dir_path, f"{name}{_AUTO_SUFFIX}")
+
+    def loop():
+        while not stop.is_set():
+            try:
+                _touch(path)
+            except OSError:
+                pass
+            stop.wait(interval)
+
+    threading.Thread(target=loop, daemon=True).start()
+    return stop
+
+
+def stale_names(dir_path: str, names, timeout: float,
+                started_at=None) -> Dict[str, str]:
+    """{name: reason} for every stale named participant. Same contract
+    as :func:`check_stale`'s auto-beat leg: a participant that never
+    beat is stale only once its startup grace (one ``timeout`` from
+    ``started_at``) is spent. ``started_at`` may be a single float or
+    a {name: float} map (per-replica spawn times). A beat file OLDER
+    than ``started_at`` is a leftover from a previous incarnation of
+    the name (controllers reuse replica0, replica1, ...) and counts as
+    never-beat — a fresh healthy replica must get its startup grace,
+    not be declared stale off a predecessor's mtime."""
+    now = time.time()
+    stale: Dict[str, str] = {}
+    for name in names:
+        path = os.path.join(dir_path, f"{name}{_AUTO_SUFFIX}")
+        t0 = started_at.get(name) if isinstance(started_at, dict) \
+            else started_at
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            mtime = None
+        if mtime is not None and (t0 is None or mtime >= t0):
+            age = now - mtime
+            if timeout > 0 and age > timeout:
+                stale[name] = f"no liveness beat for {age:.1f}s"
+        elif timeout > 0 and t0 is not None and now - t0 > timeout:
+            stale[name] = ("never emitted a liveness beat "
+                           f"({now - t0:.1f}s since spawn)")
+    return stale
+
+
 # -- KV-store transport (multi-host, no shared filesystem) -------------------
 
 class KVHeartbeatWatcher:

@@ -23,10 +23,12 @@ from ..core import jax_compat as _jax_compat  # noqa: F401  (jax.export shim)
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PrecisionType", "PlaceType", "get_version",
+           "EngineOverloaded",
            "PageAllocator", "PagedKVCache", "Request", "RequestCost",
            "RequestOutput", "RequestRejected", "ServingEngine"]
 
 _SERVING = {"PageAllocator": "paged", "PagedKVCache": "paged",
+            "EngineOverloaded": "engine",
             "Request": "engine", "RequestCost": "engine",
             "RequestOutput": "engine", "RequestRejected": "engine",
             "ServingEngine": "engine"}

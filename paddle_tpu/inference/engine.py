@@ -71,6 +71,34 @@ SLO accounting + bounded per-tenant aggregates at retirement; each
 scheduler step also feeds the autoscale tick
 (``slo.note_sched_tick``). Monitor off: ``cost`` is None and none of
 this exists — byte-identical emitted tokens either way.
+
+Overload control (PR 13, the ACTING half of ROADMAP item 5 — all
+flag-gated, every flag default OFF, flags-off scheduling byte-identical
+to the accounting-only engine; see docs/overload.md):
+
+- **Priority admission** (``FLAGS_serving_priority_admission``): the
+  admission scan orders the queue by (priority desc, arrival) and
+  enforces ``FLAGS_serving_tenant_inflight_cap`` live slots per tenant.
+- **Bounded queue + shedding** (``FLAGS_serving_max_queue``,
+  ``FLAGS_serving_shed_on_burn``): a full queue — or an SLO
+  fast-burn, for priority<=0 work — sheds submissions with a typed
+  :class:`EngineOverloaded` carrying a ``retry_after_s`` hint from the
+  autoscale demand model; a higher-priority arrival displaces the
+  lowest-priority queued request instead.
+- **Deadlines** (per-request ``Request.deadline_s``, default off):
+  a spent TTL expires the request in queue or evicts it from the
+  running batch (partial tokens delivered, ``finish_reason="expired"``,
+  cost recorded).
+- **SLO-aware preemption** (``FLAGS_serving_slo_preemption``): page
+  pressure evicts the lowest-(priority, prior preemptions, accumulated
+  work) request instead of youngest-first.
+- **Drain lifecycle** (:meth:`ServingEngine.begin_drain`): stop
+  admitting, shed the queue with retry hints, finish live decodes;
+  ``drain_complete`` gates the elastic controller's scale-in
+  (``distributed/fleet/elastic.py``).
+
+Every submitted request ends in exactly one of completed / rejected /
+expired / shed, with a typed reason — nothing is dropped silently.
 """
 from __future__ import annotations
 
@@ -119,8 +147,8 @@ def _engine_health_provider(ref):
 def _observe_latency(name: str, ms: float, doc: str):
     _monitor.observe(name, ms, doc=doc, buckets=_LATENCY_BUCKETS_MS)
 
-__all__ = ["Request", "RequestCost", "RequestOutput", "RequestRejected",
-           "ServingEngine"]
+__all__ = ["EngineOverloaded", "Request", "RequestCost", "RequestOutput",
+           "RequestRejected", "ServingEngine"]
 
 
 class RequestRejected(E.InvalidArgumentError):
@@ -141,6 +169,21 @@ class RequestRejected(E.InvalidArgumentError):
         super().__init__(f"request {rid!r} rejected: {reason}")
 
 
+class EngineOverloaded(RequestRejected):
+    """Backpressure: a WELL-FORMED submission refused by overload
+    policy — bounded queue full (``FLAGS_serving_max_queue``), SLO
+    fast-burn shedding (``FLAGS_serving_shed_on_burn``), or a draining
+    replica. Unlike its malformed-submission parent this is not the
+    client's fault: ``retry_after_s`` carries a hint computed from the
+    autoscale demand model (``monitor/slo.retry_after_hint`` over this
+    engine's own state), so the caller can back off or retry on
+    another replica. Counted under ``serving.requests.shed``."""
+
+    def __init__(self, rid, reason: str, retry_after_s: float):
+        self.retry_after_s = retry_after_s
+        super().__init__(rid, reason)
+
+
 @dataclasses.dataclass
 class Request:
     rid: int
@@ -150,8 +193,15 @@ class Request:
     eos_token_id: Optional[int] = None
     key: Optional[jax.Array] = None      # PRNG key when temperature > 0
     tenant: str = "default"              # cost-attribution dimension
-    priority: int = 0                    # scheduling class (observe-only
-    #                                      today; item-5 scheduler feed)
+    priority: int = 0                    # scheduling class: HIGHER is
+    #                                      more important (admission
+    #                                      order, shed exemption,
+    #                                      preemption protection)
+    deadline_s: Optional[float] = None   # TTL from submit; the request
+    #                                      expires in queue or is
+    #                                      evicted from the running
+    #                                      batch once it is spent
+    #                                      (default off)
 
 
 @dataclasses.dataclass
@@ -194,6 +244,13 @@ class RequestOutput:
     tenant: str = "default"
     cost: Optional[RequestCost] = None   # monitor on: the attribution
     #                                      record; monitor off: None
+    finish_reason: str = "completed"     # completed | expired | shed —
+    #                                      every request that entered
+    #                                      the engine ends in exactly
+    #                                      one (rejected submissions
+    #                                      never enter)
+    retry_after_s: Optional[float] = None  # shed only: demand-model
+    #                                      backoff hint
 
 
 class _Slot:
@@ -222,6 +279,8 @@ class EngineStats:
         self.admitted = 0
         self.completed = 0
         self.preempted = 0
+        self.expired = 0         # retired by their submit-time deadline
+        self.shed = 0            # refused/ended by overload policy
         self.decode_steps = 0
         self.tokens_generated = 0    # incl. the token sampled at prefill
         self.tokens_decoded = 0      # emitted by decode steps only
@@ -241,6 +300,7 @@ class EngineStats:
     def as_dict(self) -> dict:
         return {"admitted": self.admitted, "completed": self.completed,
                 "preempted": self.preempted,
+                "expired": self.expired, "shed": self.shed,
                 "decode_steps": self.decode_steps,
                 "tokens_generated": self.tokens_generated,
                 "tokens_prefilled": self.tokens_prefilled,
@@ -303,7 +363,37 @@ class ServingEngine:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  decode_chunk: int = 4, watermark: float = 0.0,
-                 kv_dtype=None):
+                 kv_dtype=None,
+                 priority_admission: Optional[bool] = None,
+                 tenant_inflight_cap: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 shed_on_burn: Optional[bool] = None,
+                 slo_preemption: Optional[bool] = None):
+        # Overload policies (ROADMAP item 5, acting half). Each kwarg
+        # defaults to its FLAGS_serving_* flag (the make_train_step
+        # guard=None pattern); every flag defaults OFF, and with all of
+        # them off the scheduler is byte-identical to the pre-policy
+        # engine — the existing parity tests are the contract.
+        from ..core import flags as _eflags
+
+        def _opt(v, flag):
+            return _eflags.flag_value(flag) if v is None else v
+        self._priority_admission = bool(
+            _opt(priority_admission, "serving_priority_admission"))
+        # negatives clamp to 0 = uncapped/unbounded (the "-1 means
+        # unlimited" convention; a raw -1 cap would read `0 >= -1` for
+        # every tenant and block admission forever)
+        self._tenant_cap = max(0, int(
+            _opt(tenant_inflight_cap, "serving_tenant_inflight_cap")))
+        self._max_queue = max(0, int(
+            _opt(max_queue, "serving_max_queue")))
+        self._shed_on_burn = bool(
+            _opt(shed_on_burn, "serving_shed_on_burn"))
+        self._slo_preemption = bool(
+            _opt(slo_preemption, "serving_slo_preemption"))
+        self._draining = False
+        self._deadlines_seen = False   # sticky: first deadline request
+        #                                arms the per-step expiry scan
         self.family = family
         self.params = params
         self.config = config
@@ -515,12 +605,33 @@ class ServingEngine:
                            "integral class")
         except (TypeError, ValueError, OverflowError):
             return bad(f"priority {req.priority!r} is not an int")
-        return None, (prompt, max_new, temp, tenant, priority)
+        deadline = req.deadline_s
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError, OverflowError):
+                # OverflowError: float(10**400) — reject typed, don't
+                # crash the caller (the max_new_tokens precedent)
+                return bad(f"deadline_s {req.deadline_s!r} is not a "
+                           "float")
+            if not math.isfinite(deadline) or deadline <= 0.0:
+                return bad("deadline_s must be finite and > 0, got "
+                           f"{deadline}")
+        return None, (prompt, max_new, temp, tenant, priority, deadline)
 
     def submit(self, req: Request):
         """Queue a request, or raise :class:`RequestRejected` (typed,
         counted) when it is malformed — the engine and every in-flight
-        request are untouched either way until admission."""
+        request are untouched either way until admission. With the
+        overload policies on (all default-off), a well-formed
+        submission may instead be SHED with :class:`EngineOverloaded`
+        (typed, counted, ``retry_after_s`` hint): the queue is bounded
+        (``max_queue``), an SLO fast-burn sheds priority<=0 work
+        (``shed_on_burn``), and a draining replica refuses everything.
+        A higher-priority submission arriving at a full queue displaces
+        the lowest strictly-lower-priority queued request instead (the
+        displaced one ends in ``outputs`` with
+        ``finish_reason="shed"``)."""
         reason, norm = self._reject_reason(req)
         if reason is not None:
             _monitor.inc("serving.requests.rejected",
@@ -542,7 +653,37 @@ class ServingEngine:
         # on — the original coercible-but-wrong-typed fields must not
         # ride into the loop
         (req.prompt, req.max_new_tokens, req.temperature,
-         req.tenant, req.priority) = norm
+         req.tenant, req.priority, req.deadline_s) = norm
+        # overload gates, in severity order: a draining replica refuses
+        # everything; an SLO fast-burn sheds best-effort work; a full
+        # bounded queue sheds (or displaces for higher priority). All
+        # three raise BEFORE the request touches any engine state.
+        if self._draining:
+            self._shed_submit(req, "engine is draining")
+        if (self._shed_on_burn and req.priority <= 0
+                and _monitor.enabled()
+                and _slo.burn_alerting(load_only=True)):
+            # load_only: the trigger reads the LATENCY burn — the
+            # sheds this gate produces are availability-bad records,
+            # and feeding them back would lock best-effort traffic
+            # out long after the real overload cleared
+            self._shed_submit(req, "SLO fast-burn alerting; "
+                                   "priority<=0 work shed")
+        if self._max_queue and len(self.queue) >= self._max_queue:
+            victim = self._displaceable_pos(req.priority)
+            if victim is None:
+                self._shed_submit(
+                    req, f"queue full ({self._max_queue}) and no "
+                         f"lower-priority request to displace")
+            else:
+                shed = self.queue[victim]
+                del self.queue[victim]
+                self._finish_shed(
+                    shed, "displaced by higher-priority request "
+                          f"{req.rid!r}")
+        if req.deadline_s is not None:
+            req._t_deadline = time.perf_counter() + req.deadline_s
+            self._deadlines_seen = True
         plen = int(req.prompt.shape[0])
         if _monitor.enabled():
             now = time.perf_counter()
@@ -560,6 +701,223 @@ class ServingEngine:
                            max_new=req.max_new_tokens,
                            tenant=req.tenant)
         self.queue.append(req)
+
+    # -- overload policy: shedding, deadlines, drain ------------------------
+
+    def autoscale_payload(self) -> dict:
+        """The autoscale demand model (``monitor/slo.demand_model``)
+        over THIS engine's state — works with the monitor off (shedding
+        needs a ``retry_after_s`` hint regardless), and is the
+        per-replica signal the elastic serving controller consumes.
+        Slots count as live while RESIDENT (done-but-unretired
+        included): a finished request's output only materializes at
+        the next ``step``'s retire, so ``drain_safe`` here matches
+        :attr:`drain_complete` — a controller acting on it can never
+        stop a replica while an output is still trapped in a slot.
+        (The ``serving.autoscale.*`` gauges tick inside ``step`` after
+        retirement, where the two notions coincide.)"""
+        resident = sum(1 for s in self.slots if s is not None)
+        return _slo.demand_model(
+            len(self.queue), resident, self.num_slots,
+            self.cache.alloc.free_pages / self.cache.num_pages
+            if self.cache.num_pages else 0.0)
+
+    def _retry_after(self) -> float:
+        return _slo.retry_after_hint(self.autoscale_payload())
+
+    def _shed_submit(self, req: Request, why: str):
+        """Refuse a WELL-FORMED submission by overload policy: typed
+        :class:`EngineOverloaded` with the demand-model backoff hint,
+        before the request touches any engine state."""
+        hint = self._retry_after()
+        self.stats.shed += 1
+        _monitor.inc("serving.requests.shed",
+                     doc="admissible work refused by overload policy "
+                         "(bounded queue, SLO burn, displacement, "
+                         "drain) with a retry_after_s hint")
+        _trace.instant("serving.shed", rid=req.rid, reason=why,
+                       retry_after_s=hint)
+        if _monitor.enabled():
+            _slo.record_shed(getattr(req, "tenant", "default")
+                             or "default")
+        raise EngineOverloaded(req.rid, why, hint)
+
+    def _displaceable_pos(self, priority: int) -> Optional[int]:
+        """Queue position of the displacement victim for an arriving
+        ``priority`` request at a full queue: the LOWEST-priority
+        queued request, oldest first, and only when strictly below the
+        newcomer — equal-priority work is never displaced (FIFO
+        fairness within a class). Preemption re-queues are EXEMPT:
+        they are admitted work mid-recompute, and admitted work is
+        never dropped (the begin_drain contract) — a newcomer, however
+        important, outranks only work that has not been served yet."""
+        pos, lowest = None, None
+        for j, r in enumerate(self.queue):
+            if getattr(r, "_preempt_count", 0) > 0:
+                continue
+            p = getattr(r, "priority", 0)
+            if p < priority and (lowest is None or p < lowest):
+                pos, lowest = j, p
+        return pos
+
+    def _finish_shed(self, req: Request, why: str):
+        """End a QUEUED request as shed (displacement or drain): it
+        leaves through ``outputs`` with ``finish_reason="shed"`` and
+        the backoff hint — never silently dropped (its submitter
+        already returned from ``submit``)."""
+        hint = self._retry_after()
+        self.stats.shed += 1
+        _monitor.inc("serving.requests.shed")
+        mon = _monitor.enabled()
+        cost = getattr(req, "_cost", None) if mon else None
+        if cost is not None:
+            t_enq = getattr(req, "_t_enqueue", None)
+            if t_enq is not None:
+                cost.queue_wait_ms += (time.perf_counter() - t_enq) * 1e3
+        if mon:
+            if cost is not None:
+                # the shed rides availability like a rejection, but
+                # its consumption (prefill before a preemption,
+                # page-seconds, the queue wait above) folds into the
+                # tenant aggregates — the tenant PAID for it
+                _slo.record_request(dict(cost.as_dict(),
+                                         rejected=True, shed=True))
+            else:
+                _slo.record_shed(getattr(req, "tenant", "default")
+                                 or "default")
+        self.outputs[req.rid] = RequestOutput(
+            rid=req.rid, tokens=np.zeros(0, np.int32),
+            prompt_len=int(np.asarray(req.prompt).shape[0]),
+            preemptions=getattr(req, "_preempt_count", 0),
+            tenant=getattr(req, "tenant", "default"),
+            cost=cost, finish_reason="shed", retry_after_s=hint)
+        _trace.instant("serving.shed", rid=req.rid, reason=why,
+                       retry_after_s=hint)
+
+    def begin_drain(self, shed_queued: bool = True):
+        """Enter the drain lifecycle: stop admitting new work (submit
+        sheds with ``EngineOverloaded``), shed the not-yet-admitted
+        queue (``shed_queued=False`` lets it finish instead), and let
+        live decodes run to retirement — ``drain_complete`` flips once
+        nothing is queued or resident. A preemption during drain still
+        re-queues for recompute (finishing live work may require it);
+        only NEW submissions are refused. Idempotent."""
+        from ..testing import faults as _faults
+        _faults.hit("serving.drain")
+        already = self._draining
+        self._draining = True
+        _trace.instant("serving.drain.begin", queued=len(self.queue),
+                       again=already)
+        if shed_queued:
+            keep: deque = deque()
+            while self.queue:
+                r = self.queue.popleft()
+                if getattr(r, "_preempt_count", 0) > 0:
+                    # a preemption re-queue is ADMITTED live work
+                    # awaiting recompute — the drain contract finishes
+                    # it. This also makes repeat begin_drain calls
+                    # (the elastic controller retries every tick)
+                    # safe: after the first call, only preemption
+                    # re-queues can enter the queue.
+                    keep.append(r)
+                else:
+                    self._finish_shed(r, "engine is draining")
+            self.queue = keep
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drain_complete(self) -> bool:
+        """No queued and no resident requests (done-but-unretired slots
+        count as resident: their outputs only materialize at the next
+        ``step``)."""
+        return not self.queue and all(s is None for s in self.slots)
+
+    def _expire_due(self):
+        """Deadline/TTL enforcement: retire every request whose
+        submit-time deadline is spent — queued requests leave with no
+        tokens, running ones are evicted with the tokens they had
+        (pages freed, counted in the cost record). Runs once per
+        ``step`` and only after some request has carried a deadline
+        (``_deadlines_seen`` — deadline-free serving never pays the
+        scan). A DONE slot past its deadline retires normally: its
+        output is complete."""
+        now = time.perf_counter()
+        if self.queue and any(
+                getattr(r, "_t_deadline", None) is not None
+                and now >= r._t_deadline for r in self.queue):
+            keep = deque()
+            for r in self.queue:
+                t = getattr(r, "_t_deadline", None)
+                if t is not None and now >= t:
+                    self._finish_expired(r, slot_idx=None, now=now)
+                else:
+                    keep.append(r)
+            self.queue = keep
+        for idx in range(self.num_slots):
+            slot = self.slots[idx]
+            if slot is None or slot.done:
+                continue
+            t = getattr(slot.req, "_t_deadline", None)
+            if t is not None and now >= t:
+                self._finish_expired(slot.req, slot_idx=idx, now=now)
+
+    def _finish_expired(self, req: Request, slot_idx: Optional[int],
+                        now: float):
+        """End ``req`` as deadline-expired: from the queue (no tokens)
+        or evicted from a running slot (partial tokens delivered —
+        they were sampled and are the client's to keep, so the
+        generated-discarded==emitted token contract holds)."""
+        mon = _monitor.enabled()
+        cost = getattr(req, "_cost", None) if mon else None
+        tokens = np.zeros(0, np.int32)
+        preemptions = getattr(req, "_preempt_count", 0)
+        if slot_idx is not None:
+            slot = self.slots[slot_idx]
+            self.slots[slot_idx] = None
+            self._state_dirty = self._bt_dirty = True
+            if cost is not None and slot.t_tick is not None:
+                # final page-seconds tick, read before the free
+                cost.page_seconds += (
+                    self.cache.alloc.page_count(req.rid)
+                    * (now - slot.t_tick))
+            self.cache.alloc.free(req.rid)
+            tokens = np.asarray(slot.tokens, np.int32)
+            preemptions = slot.preemptions
+            if cost is not None:
+                cost.grid_steps += (self.stats.decode_steps
+                                    - slot.steps0) * self.num_slots
+        elif cost is not None:
+            t_enq = getattr(req, "_t_enqueue", None)
+            if t_enq is not None:
+                cost.queue_wait_ms += (now - t_enq) * 1e3
+        self.stats.expired += 1
+        _monitor.inc("serving.requests.expired",
+                     doc="requests retired by their submit-time "
+                         "deadline (expired in queue or evicted from "
+                         "the running batch)")
+        if cost is not None:
+            cost.preemptions = preemptions
+            t0 = getattr(req, "_t0", None)
+            if t0 is not None:
+                cost.e2e_ms = (now - t0) * 1e3
+            if cost.grid_steps > 0:
+                cost.slot_share = round(
+                    cost.slot_steps / cost.grid_steps, 6)
+            # the SLO window counts an expiry BAD for availability and
+            # excludes it from the latency objectives (monitor/slo.py)
+            _slo.record_request(dict(cost.as_dict(), expired=True))
+        self.outputs[req.rid] = RequestOutput(
+            rid=req.rid, tokens=tokens,
+            prompt_len=int(np.asarray(req.prompt).shape[0]),
+            preemptions=preemptions,
+            tenant=getattr(req, "tenant", "default"),
+            cost=cost, finish_reason="expired")
+        _trace.instant("serving.expire", rid=req.rid,
+                       tokens=int(tokens.shape[0]),
+                       in_slot=slot_idx is not None)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -685,54 +1043,94 @@ class ServingEngine:
                            preemptions=slot.preemptions,
                            tenant=getattr(slot.req, "tenant", "default"))
 
-    def _preempt_youngest(self) -> bool:
-        """Evict the most recently admitted live request (recompute
-        policy: pages freed, request requeued at the FRONT so it re-runs
-        before newcomers). False when nothing can be evicted."""
-        for idx in range(self.num_slots - 1, -1, -1):
+    def _preempt_victim_idx(self) -> Optional[int]:
+        """Pick the eviction victim. Default: the YOUNGEST live request
+        (highest slot index — the original recompute policy). With
+        ``slo_preemption`` on: the request with the LOWEST eviction
+        cost, ordered by (priority, prior preemptions, accumulated
+        work) — evict the least important class first; within a class
+        protect repeat victims (anti-starvation) and then evict the
+        request that is cheapest to recompute. Work comes from the
+        per-request cost record (prefill+decode tokens, cumulative
+        across re-runs) when the monitor keeps one, else the current
+        run's KV length — the monitor-off proxy of the same quantity."""
+        if not self._slo_preemption:
+            for idx in range(self.num_slots - 1, -1, -1):
+                slot = self.slots[idx]
+                if slot is not None and not slot.done:
+                    return idx
+            return None
+        best_idx, best_key = None, None
+        for idx in range(self.num_slots):
             slot = self.slots[idx]
-            if slot is not None and not slot.done:
-                self.slots[idx] = None
-                self._state_dirty = self._bt_dirty = True
-                now = time.perf_counter() if _monitor.enabled() else None
-                cost = slot.cost if now is not None else None
-                if cost is not None and slot.t_tick is not None:
-                    # final page-seconds tick for this run, read before
-                    # the free — an evicted request PAID for the pages
-                    # it held even though the work is recomputed
-                    cost.page_seconds += (
-                        self.cache.alloc.page_count(slot.req.rid)
-                        * (now - slot.t_tick))
-                self.cache.alloc.free(slot.req.rid)
-                slot.req._preempt_count = getattr(
-                    slot.req, "_preempt_count", 0) + 1
-                self.queue.appendleft(slot.req)
-                self.stats.preempted += 1
-                # the evicted request's sampled-but-unretired tokens are
-                # recomputed from scratch: move them to the discarded
-                # column so generated - discarded stays == emitted
-                self.stats.tokens_discarded += slot.gen
-                _monitor.inc("serving.requests.preempted")
-                _monitor.inc("serving.tokens.discarded", slot.gen,
-                             doc="sampled tokens thrown away by "
-                                 "preemption recompute")
-                if now is not None:
-                    # the re-queue refreshes t_enqueue: the NEXT wait
-                    # accumulates onto the record's cumulative
-                    # queue_wait_ms at re-admission (the histogram
-                    # observes each wait once, the record keeps the sum)
-                    slot.req._t_enqueue = now
-                    if cost is not None:
-                        cost.discarded_tokens += slot.gen
-                        cost.grid_steps += (self.stats.decode_steps
-                                            - slot.steps0) \
-                            * self.num_slots
-                    _trace.instant("serving.preempt", rid=slot.req.rid,
-                                   discarded=slot.gen)
-                return True
-        return False
+            if slot is None or slot.done:
+                continue
+            work = slot.kv_len
+            if slot.cost is not None:
+                work = slot.cost.prefill_tokens + slot.cost.decode_tokens
+            key = (getattr(slot.req, "priority", 0), slot.preemptions,
+                   work, -idx)       # final tie-break: youngest
+            if best_key is None or key < best_key:
+                best_idx, best_key = idx, key
+        return best_idx
+
+    def _preempt_one(self) -> bool:
+        """Evict one live request (recompute policy: pages freed,
+        request requeued at the FRONT so it re-runs before newcomers);
+        the victim is :meth:`_preempt_victim_idx`'s. False when
+        nothing can be evicted."""
+        idx = self._preempt_victim_idx()
+        if idx is None:
+            return False
+        slot = self.slots[idx]
+        self.slots[idx] = None
+        self._state_dirty = self._bt_dirty = True
+        now = time.perf_counter() if _monitor.enabled() else None
+        cost = slot.cost if now is not None else None
+        if cost is not None and slot.t_tick is not None:
+            # final page-seconds tick for this run, read before
+            # the free — an evicted request PAID for the pages
+            # it held even though the work is recomputed
+            cost.page_seconds += (
+                self.cache.alloc.page_count(slot.req.rid)
+                * (now - slot.t_tick))
+        self.cache.alloc.free(slot.req.rid)
+        slot.req._preempt_count = getattr(
+            slot.req, "_preempt_count", 0) + 1
+        self.queue.appendleft(slot.req)
+        self.stats.preempted += 1
+        # the evicted request's sampled-but-unretired tokens are
+        # recomputed from scratch: move them to the discarded
+        # column so generated - discarded stays == emitted
+        self.stats.tokens_discarded += slot.gen
+        _monitor.inc("serving.requests.preempted")
+        _monitor.inc("serving.tokens.discarded", slot.gen,
+                     doc="sampled tokens thrown away by "
+                         "preemption recompute")
+        if now is not None:
+            # the re-queue refreshes t_enqueue: the NEXT wait
+            # accumulates onto the record's cumulative
+            # queue_wait_ms at re-admission (the histogram
+            # observes each wait once, the record keeps the sum)
+            slot.req._t_enqueue = now
+            if cost is not None:
+                cost.discarded_tokens += slot.gen
+                cost.grid_steps += (self.stats.decode_steps
+                                    - slot.steps0) \
+                    * self.num_slots
+            _trace.instant("serving.preempt", rid=slot.req.rid,
+                           discarded=slot.gen)
+        return True
 
     def _admit(self):
+        # PAIRED SCANS: this FIFO body and _admit_policy below share
+        # the admission-control math (watermark, idle override,
+        # alloc-failure enforce, group fill) by deliberate copy — the
+        # flag-off path must stay byte-identical to the pre-policy
+        # engine, so it is never routed through policy code. A fix to
+        # the shared math MUST be applied to both.
+        if self._priority_admission or self._tenant_cap:
+            return self._admit_policy()
         while self.queue:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
@@ -775,6 +1173,104 @@ class ServingEngine:
                     break
                 del self.queue[scanned]
                 group.append(cand)
+            self._prefill_group(free, group, s_pad)
+
+    def _admit_policy(self):
+        """Priority-class admission (``priority_admission`` /
+        ``tenant_inflight_cap``): each pass admits the
+        highest-priority eligible request — ties broken by queue
+        position, i.e. arrival order, with preemption re-queues at the
+        front — instead of the FIFO head, and a tenant already holding
+        ``tenant_inflight_cap`` live slots is skipped (its requests
+        wait without blocking other tenants' head-of-line). The cap
+        WITHOUT priority admission keeps strict FIFO order among
+        eligible requests — the cap alone must not change scheduling
+        class semantics (the flag doc's contract). Same page
+        watermark, idle override, and same-bucket grouping as the FIFO
+        scan; grouping may co-admit lower-priority same-bucket waiters
+        into slots of the dispatch that would otherwise idle — a
+        bounded, one-dispatch-deep inversion traded for batched
+        prefill. PAIRED with _admit's FIFO body (see the comment
+        there): fixes to the shared admission-control math go in
+        both."""
+        cap = self._tenant_cap
+        inflight: Dict[str, int] = {}
+        if cap:
+            for s in self.slots:
+                if s is not None:
+                    t = getattr(s.req, "tenant", "default")
+                    inflight[t] = inflight.get(t, 0) + 1
+        while self.queue:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                break
+            pos = None
+            for j, r in enumerate(self.queue):
+                if cap and inflight.get(
+                        getattr(r, "tenant", "default"), 0) >= cap:
+                    continue
+                if not self._priority_admission:
+                    pos = j               # cap-only: first eligible (FIFO)
+                    break
+                if pos is None or getattr(r, "priority", 0) \
+                        > getattr(self.queue[pos], "priority", 0):
+                    pos = j
+            if pos is None:
+                break                     # every waiter's tenant is at cap
+            req = self.queue[pos]
+            plen = int(np.asarray(req.prompt).shape[0])
+            s_pad = max(self._bucket(plen), self.page_size)
+            need = s_pad // self.page_size
+            idle = not any(s is not None and not s.done
+                           for s in self.slots)
+            if (self.cache.alloc.free_pages - need < self.watermark_pages
+                    and not idle):
+                break
+            del self.queue[pos]
+            if self.cache.alloc.alloc(req.rid, s_pad) is None:
+                self.queue.insert(pos, req)
+                E.enforce(not idle,
+                          f"request {req.rid} needs {need} pages but only "
+                          f"{self.cache.alloc.free_pages} exist free on an "
+                          f"idle engine", error=E.ResourceExhaustedError)
+                break
+            group = [req]
+            if cap:
+                t = getattr(req, "tenant", "default")
+                inflight[t] = inflight.get(t, 0) + 1
+            # group fill in PRIORITY order (ties: queue position), not
+            # queue order — an equal-or-higher-priority same-bucket
+            # waiter must not lose its seat in the dispatch to an
+            # earlier-queued lower-priority one. Cap-only mode fills
+            # in queue order (FIFO semantics preserved).
+            if self._priority_admission:
+                order = sorted(
+                    range(len(self.queue)),
+                    key=lambda j: (
+                        -getattr(self.queue[j], "priority", 0), j))
+            else:
+                order = list(range(len(self.queue)))
+            picked: List[int] = []
+            for j in order:
+                if len(group) >= len(free):
+                    break
+                if (self.cache.alloc.free_pages - need
+                        < self.watermark_pages):
+                    break
+                cand = self.queue[j]
+                cp = int(np.asarray(cand.prompt).shape[0])
+                ct = getattr(cand, "tenant", "default")
+                if max(self._bucket(cp), self.page_size) != s_pad or (
+                        cap and inflight.get(ct, 0) >= cap):
+                    continue
+                if self.cache.alloc.alloc(cand.rid, s_pad) is None:
+                    break
+                picked.append(j)
+                group.append(cand)
+                if cap:
+                    inflight[ct] = inflight.get(ct, 0) + 1
+            for j in sorted(picked, reverse=True):
+                del self.queue[j]
             self._prefill_group(free, group, s_pad)
 
     def _prefill_group(self, free: List[int], group: List["Request"],
@@ -933,7 +1429,7 @@ class ServingEngine:
             got = self.cache.alloc.ensure(slot.req.rid,
                                           slot.kv_len + appends)
             if got is None:
-                E.enforce(self._preempt_youngest(),
+                E.enforce(self._preempt_one(),
                           "page pool exhausted with nothing left to "
                           "preempt", error=E.ResourceExhaustedError)
                 continue                  # retry this slot
@@ -944,8 +1440,11 @@ class ServingEngine:
         return [idx for idx in live_idx if self.slots[idx] is not None]
 
     def step(self) -> bool:
-        """One scheduling iteration: retire -> compact -> admit -> one
-        decode chunk. Returns False when the engine is fully idle."""
+        """One scheduling iteration: expire (when any request carries a
+        deadline) -> retire -> compact -> admit -> one decode chunk.
+        Returns False when the engine is fully idle."""
+        if self._deadlines_seen:
+            self._expire_due()
         for idx in range(self.num_slots):
             if self.slots[idx] is not None and self.slots[idx].done:
                 self._retire(idx)

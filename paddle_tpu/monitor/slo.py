@@ -69,9 +69,11 @@ from ..core import flags as _flags
 
 __all__ = [
     "objectives", "set_objectives", "record_request", "record_rejected",
-    "records", "compliance_report", "tenants_snapshot",
+    "record_shed",
+    "records", "compliance_report", "burn_alerting", "tenants_snapshot",
     "tenant_compliance",
     "tenants_for_fleet", "tenant_exposition_text", "note_sched_tick",
+    "demand_model", "retry_after_hint",
     "update_autoscale_gauges", "slo_snapshot", "window_capacity",
     "set_window", "max_tenants", "set_max_tenants", "total_records",
     "reset", "OVERFLOW_TENANT",
@@ -126,6 +128,11 @@ _TENANT_FIELDS = (
                       "(completed + rejected)"),
     ("completed", int, "requests retired with output for this tenant"),
     ("rejected", int, "submissions refused at the door for this tenant"),
+    ("shed", int, "admissible submissions refused by overload policy "
+                  "(bounded queue, SLO burn, displacement, drain) with "
+                  "a retry_after_s hint"),
+    ("expired", int, "requests retired by their submit-time deadline "
+                     "(in queue or evicted from the running batch)"),
     ("prefill_tokens", int, "prompt tokens prefilled (re-prefills after "
                             "preemption included)"),
     ("decode_tokens", int, "tokens emitted by decode chunks (work done, "
@@ -269,12 +276,30 @@ def _fold_tenant_locked(key: str, rec: dict):
                for f, kind, _ in _TENANT_FIELDS}
         _TENANTS[key] = agg
     agg["requests"] += 1
+    fold_costs = True
     if rec.get("rejected"):
         agg["rejected"] += 1
+        if rec.get("shed"):
+            # a shed is a POLICY refusal of admissible work (bounded
+            # queue / burn / drain), counted alongside the malformed
+            # rejections it rides availability with. A shed of
+            # admitted-then-displaced/drained work CARRIES a cost
+            # record (prefill, page-seconds, queue wait) — fold it;
+            # submit-time sheds carry no cost fields and fold nothing.
+            agg["shed"] += 1
+        else:
+            fold_costs = False   # malformed: touched no engine state
+    elif rec.get("expired"):
+        # deadline-expired: not completed, but it DID consume queue
+        # wait / pages / slot steps — fold the cost columns
+        agg["expired"] += 1
+    else:
+        agg["completed"] += 1
+    if not fold_costs:
         return
-    agg["completed"] += 1
     for field, kind, _ in _TENANT_FIELDS:
-        if field in ("requests", "completed", "rejected"):
+        if field in ("requests", "completed", "rejected", "shed",
+                     "expired"):
             continue
         v = rec.get(field)
         if v is None:
@@ -317,6 +342,16 @@ def record_rejected(tenant: str = "default"):
     record_request({"tenant": tenant, "rejected": True})
 
 
+def record_shed(tenant: str = "default"):
+    """Record an overload shed: a WELL-FORMED submission the engine
+    refused by policy (bounded queue, SLO burn, displacement, drain).
+    Rides the rejection path for availability — shed work was not
+    served — plus the ``shed`` tenant column; the same
+    cannot-claim-a-label-slot rule applies (shedding happens under
+    overload, where submissions are cheapest for an attacker)."""
+    record_request({"tenant": tenant, "rejected": True, "shed": True})
+
+
 def records(n: Optional[int] = None) -> List[dict]:
     """Buffered records, oldest first (last ``n`` when given)."""
     with _MU:
@@ -328,10 +363,13 @@ def records(n: Optional[int] = None) -> List[dict]:
 
 def _relevance(rec: dict, objective: str, value: float):
     """``None`` when the record does not participate in this
-    objective's window, else True (good) / False (violating)."""
+    objective's window, else True (good) / False (violating).
+    Deadline-expired requests count BAD for availability (the client
+    was not served) and are excluded from the latency windows — a
+    fast expiry must not score as a good e2e."""
     if objective == "availability":
-        return not rec.get("rejected")
-    if rec.get("rejected"):
+        return not (rec.get("rejected") or rec.get("expired"))
+    if rec.get("rejected") or rec.get("expired"):
         return None
     v = rec.get(_OBJECTIVE_FIELD[objective])
     if v is None:
@@ -412,6 +450,40 @@ def compliance_report() -> dict:
     }
     _refresh_slo_gauges(rep)
     return rep
+
+
+# burn_alerting cache: (monotonic stamp, full verdict, load-only
+# verdict). The engine's shed-on-burn policy asks on the SUBMIT path;
+# the window scan must not run per submission, so the verdicts are
+# cached for a short TTL.
+_ALERT_CACHE = [0.0, False, False]
+
+
+def burn_alerting(max_age_s: float = 0.5, load_only: bool = False
+                  ) -> bool:
+    """True while an objective's fast-window burn rate is at/over the
+    warn threshold — the :func:`compliance_report` ``alerting`` verdict
+    behind a ``max_age_s`` cache (pass 0 to force recomputation).
+
+    ``load_only=True`` answers from the LATENCY objectives only,
+    ignoring an availability-only burn. The engine's shed-on-burn
+    trigger uses this: every shed is itself recorded availability-bad,
+    so an availability-fed trigger would be a positive feedback loop —
+    retried best-effort traffic keeps the burn alight and stays locked
+    out long after the real overload (which shows up as TTFT/TPOT/e2e
+    burn) has cleared.
+
+    False with the monitor off: shedding on a signal nobody is
+    recording would be acting on fabricated data."""
+    if not _FLAG.value:
+        return False
+    now = time.monotonic()
+    if max_age_s <= 0 or now - _ALERT_CACHE[0] > max_age_s:
+        alerting = compliance_report()["alerting"]
+        _ALERT_CACHE[1] = bool(alerting)
+        _ALERT_CACHE[2] = any(n != "availability" for n in alerting)
+        _ALERT_CACHE[0] = now
+    return _ALERT_CACHE[2] if load_only else _ALERT_CACHE[1]
 
 
 def _refresh_slo_gauges(rep: dict):
@@ -556,23 +628,93 @@ def note_sched_tick(queue_depth: int, live_slots: int, num_slots: int,
         }
 
 
+def demand_model(queue_depth: int, live_slots: int, num_slots: int,
+                 pages_free_fraction: float, trend: Optional[float] = None,
+                 headroom: Optional[dict] = None) -> dict:
+    """The autoscale demand model as a PURE function of one replica's
+    scheduler state — shared verbatim by the observe-only
+    ``serving.autoscale.*`` gauges, the engine's
+    ``ServingEngine.autoscale_payload()`` (which works monitor-off:
+    shedding must be able to hint ``retry_after_s`` without the metrics
+    plane), and the elastic controller's scale decisions.
+
+    ``utilization`` = max(live-slot fraction, page-pool used fraction,
+    HBM-unadmittable fraction when a ``monitor/memory.headroom()``
+    payload is given — absent backends contribute nothing);
+    ``demand_estimate`` = utilization + queue_depth/num_slots +
+    max(queue trend, 0) x horizon / num_slots
+    (``PADDLE_TPU_AUTOSCALE_HORIZON_S``, default 30);
+    ``desired_capacity_hint`` is its ceiling. ``drain_safe`` = no
+    queued and no live requests."""
+    num_slots = max(int(num_slots), 1)
+    queue_depth = int(queue_depth)
+    live_slots = int(live_slots)
+    slot_util = live_slots / num_slots
+    page_util = max(1.0 - float(pages_free_fraction), 0.0)
+    mem_util = None
+    est_admittable = None
+    if headroom:
+        est_admittable = headroom.get("est_admittable_bytes")
+        limit = (headroom.get("hbm") or {}).get("totals", {}) \
+            .get("bytes_limit")
+        if est_admittable is not None and limit:
+            mem_util = min(max(1.0 - est_admittable / limit, 0.0), 1.0)
+    utilization = max(v for v in (slot_util, page_util, mem_util)
+                      if v is not None)
+    backlog = queue_depth / num_slots
+    horizon = _env_float("PADDLE_TPU_AUTOSCALE_HORIZON_S",
+                         _DEFAULT_HORIZON_S)
+    growth = max(trend or 0.0, 0.0) * horizon / num_slots
+    demand = utilization + backlog + growth
+    desired = max(int(math.ceil(demand - 1e-9)), 0)
+    return {
+        "queue_depth": queue_depth,
+        "live_slots": live_slots,
+        "num_slots": num_slots,
+        "pages_free_fraction": round(float(pages_free_fraction), 4),
+        "queue_depth_trend_per_s": round(trend, 4)
+        if trend is not None else None,
+        "utilization": round(utilization, 4),
+        "memory_utilization": round(mem_util, 4)
+        if mem_util is not None else None,
+        "est_admittable_bytes": est_admittable,
+        "backlog_slots": round(backlog, 4),
+        "horizon_s": horizon,
+        "demand_estimate": round(demand, 4),
+        "desired_capacity_hint": desired,
+        "drain_safe": queue_depth == 0 and live_slots == 0,
+    }
+
+
+def retry_after_hint(payload: Optional[dict] = None) -> float:
+    """Seconds a shed client should wait before retrying, from the
+    demand model: the demand in excess of this one replica, spread
+    over the autoscale horizon (an overloaded-by-2x replica hints one
+    full horizon), clamped to [1, 2 x horizon] so a deep backlog never
+    tells a client to go away for hours. ``payload`` is a
+    :func:`demand_model` dict (the engine passes its own); without one
+    the latest scheduler tick is used, or a flat 1.0 when no engine
+    has ticked."""
+    if payload is None:
+        with _MU:
+            last = _LAST_TICK[0]
+        if last is None:
+            return 1.0
+        payload = demand_model(
+            last["queue_depth"], last["live_slots"], last["num_slots"],
+            last["pages_free_fraction"])
+    horizon = payload.get("horizon_s") or _DEFAULT_HORIZON_S
+    excess = max(payload["demand_estimate"] - 1.0, 0.0)
+    return round(min(max(excess * horizon, 1.0), 2.0 * horizon), 3)
+
+
 def update_autoscale_gauges(headroom: Optional[dict] = None) -> dict:
     """Turn the tick state into the ``serving.autoscale.*`` gauges and
     return the payload (``/slo``'s ``autoscale`` block). Pull-shaped:
     the ``/metrics`` and ``/slo`` scrapes call it, so the gauges are
-    fresh at scrape time and cost nothing between scrapes.
-
-    ``headroom`` is an optional ``monitor/memory.headroom()`` payload:
-    when present AND the backend reports HBM, utilization gains a
-    memory leg (``1 - est_admittable_bytes / bytes_limit``). Absent
-    backends contribute nothing — never fabricated.
-
-    The demand model (documented, observe-only):
-    ``utilization`` = max(live-slot fraction, page-pool used fraction,
-    HBM-unadmittable fraction); ``demand_estimate`` = utilization +
-    queue_depth/num_slots + max(queue trend, 0) x horizon / num_slots
-    (``PADDLE_TPU_AUTOSCALE_HORIZON_S``, default 30); the hint is its
-    ceiling. ``drain_safe`` = no queued and no live requests."""
+    fresh at scrape time and cost nothing between scrapes. The math is
+    :func:`demand_model`; ``headroom`` is an optional
+    ``monitor/memory.headroom()`` payload feeding its HBM leg."""
     with _MU:
         last = _LAST_TICK[0]
         ticks = list(_TICKS)
@@ -587,61 +729,32 @@ def update_autoscale_gauges(headroom: Optional[dict] = None) -> dict:
         dt = ticks[-1][0] - ticks[0][0]
         if dt > 0:
             trend = (ticks[-1][1] - ticks[0][1]) / dt
-    slot_util = last["live_slots"] / last["num_slots"]
-    page_util = max(1.0 - last["pages_free_fraction"], 0.0)
-    mem_util = None
-    est_admittable = None
-    if headroom:
-        est_admittable = headroom.get("est_admittable_bytes")
-        limit = (headroom.get("hbm") or {}).get("totals", {}) \
-            .get("bytes_limit")
-        if est_admittable is not None and limit:
-            mem_util = min(max(1.0 - est_admittable / limit, 0.0), 1.0)
-    utilization = max(v for v in (slot_util, page_util, mem_util)
-                      if v is not None)
-    backlog = last["queue_depth"] / last["num_slots"]
-    horizon = _env_float("PADDLE_TPU_AUTOSCALE_HORIZON_S",
-                         _DEFAULT_HORIZON_S)
-    growth = max(trend or 0.0, 0.0) * horizon / last["num_slots"]
-    demand = utilization + backlog + growth
-    desired = max(int(math.ceil(demand - 1e-9)), 0)
-    drain_safe = last["queue_depth"] == 0 and last["live_slots"] == 0
+    payload = demand_model(last["queue_depth"], last["live_slots"],
+                           last["num_slots"],
+                           last["pages_free_fraction"], trend=trend,
+                           headroom=headroom)
     if trend is not None:
         _set_gauge("serving.autoscale.queue_depth_trend_per_s",
-                   round(trend, 4),
+                   payload["queue_depth_trend_per_s"],
                    doc="queue-depth slope over the recent scheduler "
                        "ticks (requests/second; >0 = demand growing)")
-    _set_gauge("serving.autoscale.utilization", round(utilization, 4),
+    _set_gauge("serving.autoscale.utilization", payload["utilization"],
                doc="max of live-slot, page-pool and HBM-unadmittable "
                    "pressure — the replica's load factor")
-    _set_gauge("serving.autoscale.demand_estimate", round(demand, 4),
+    _set_gauge("serving.autoscale.demand_estimate",
+               payload["demand_estimate"],
                doc="estimated demand in replicas of this engine's "
                    "size: utilization + queued backlog + queue trend "
                    "x horizon")
-    _set_gauge("serving.autoscale.desired_capacity_hint", desired,
-               doc="ceil(demand_estimate) — the observe-only replica "
-                   "hint a later elastic scaler consumes")
-    _set_gauge("serving.autoscale.drain_safe", 1 if drain_safe else 0,
+    _set_gauge("serving.autoscale.desired_capacity_hint",
+               payload["desired_capacity_hint"],
+               doc="ceil(demand_estimate) — the replica hint the "
+                   "elastic serving controller scales toward")
+    _set_gauge("serving.autoscale.drain_safe",
+               1 if payload["drain_safe"] else 0,
                doc="1 when no queued and no live requests: this "
                    "replica can drain without dropping work")
-    return {
-        "available": True,
-        "queue_depth": last["queue_depth"],
-        "live_slots": last["live_slots"],
-        "num_slots": last["num_slots"],
-        "pages_free_fraction": round(last["pages_free_fraction"], 4),
-        "queue_depth_trend_per_s": round(trend, 4)
-        if trend is not None else None,
-        "utilization": round(utilization, 4),
-        "memory_utilization": round(mem_util, 4)
-        if mem_util is not None else None,
-        "est_admittable_bytes": est_admittable,
-        "backlog_slots": round(backlog, 4),
-        "horizon_s": horizon,
-        "demand_estimate": round(demand, 4),
-        "desired_capacity_hint": desired,
-        "drain_safe": drain_safe,
-    }
+    return {"available": True, **payload}
 
 
 # -- snapshot ---------------------------------------------------------------
@@ -677,3 +790,6 @@ def reset():
         _OVERFLOW_RECORDS[0] = 0
         _TICKS.clear()
         _LAST_TICK[0] = None
+    _ALERT_CACHE[0] = 0.0
+    _ALERT_CACHE[1] = False
+    _ALERT_CACHE[2] = False

@@ -18,7 +18,10 @@ point:
 
 Arming is per-point with an ``nth`` trigger (fire on the Nth hit,
 1-based), so a test can let the first save succeed and murder the
-second. Disarmed, ``hit()`` is one list-indexing branch.
+second; ``every=True`` keeps firing on EVERY hit from the Nth onward
+(sustained chaos: delay every scheduler step, corrupt every batch —
+the overload-chaos suites' storm mode). Disarmed, ``hit()`` is one
+list-indexing branch.
 
 In-process use::
 
@@ -43,6 +46,13 @@ Known injection points (grep ``faults.hit`` for the live list):
 - ``train.batch``          value point: each batch entering a sentinel
   loop / hapi train step (``faults.corrupt`` — grep ``faults.corrupt``
   for the live list of value points)
+- ``serving.drain``        as the serving engine enters its drain
+  lifecycle (``ServingEngine.begin_drain``)
+- ``drain.checkpoint``     before the elastic scale-in path's
+  pre-drain checkpoint save (fleet/elastic.py ``_drain_and_stop``)
+- ``drain.stop``           after ``drain_safe`` held, before the
+  replica is stopped — a ``kill`` here proves the checkpoint
+  committed strictly before the replica died
 """
 from __future__ import annotations
 
@@ -63,9 +73,11 @@ class FaultInjected(RuntimeError):
 
 
 class _Injection:
-    __slots__ = ("point", "action", "nth", "delay_s", "hits", "fired")
+    __slots__ = ("point", "action", "nth", "delay_s", "hits", "fired",
+                 "every")
 
-    def __init__(self, point: str, action: str, nth: int, delay_s: float):
+    def __init__(self, point: str, action: str, nth: int, delay_s: float,
+                 every: bool = False):
         if action not in ("raise", "delay", "kill", "corrupt",
                           "corrupt_inf"):
             raise ValueError(f"unknown fault action {action!r} "
@@ -78,6 +90,7 @@ class _Injection:
         self.delay_s = delay_s
         self.hits = 0
         self.fired = False
+        self.every = bool(every)
 
 
 _MU = threading.Lock()
@@ -89,10 +102,13 @@ _ARMED = [False]
 
 
 def inject(point: str, action: str = "raise", nth: int = 1,
-           delay_s: float = 0.05):
-    """Arm ``point`` to fire ``action`` on its ``nth`` hit (counted from
-    now). Re-arming a point resets its hit count."""
-    inj = _Injection(point, action, nth, delay_s)
+           delay_s: float = 0.05, every: bool = False):
+    """Arm ``point`` to fire ``action`` on its ``nth`` hit (counted
+    from now); ``every=True`` keeps firing on every hit from the Nth
+    onward (sustained chaos — meaningful for ``delay``/``corrupt``
+    storms; ``raise``/``kill`` end the flow on the first firing
+    anyway). Re-arming a point resets its hit count."""
+    inj = _Injection(point, action, nth, delay_s, every)
     with _MU:
         _POINTS[point] = inj
         _ARMED[0] = True
@@ -113,8 +129,8 @@ class injected:
     """Context manager: arm on enter, disarm (that point) on exit."""
 
     def __init__(self, point: str, action: str = "raise", nth: int = 1,
-                 delay_s: float = 0.05):
-        self._args = (point, action, nth, delay_s)
+                 delay_s: float = 0.05, every: bool = False):
+        self._args = (point, action, nth, delay_s, every)
 
     def __enter__(self):
         return inject(*self._args)
@@ -139,7 +155,8 @@ def _fire(point: str, value_point: bool):
         inj.hits += 1
         if inj.hits < inj.nth:
             return None
-        inj.fired = True
+        if not inj.every:       # every=True re-fires on later hits
+            inj.fired = True
         return inj.action, inj.delay_s
 
 

@@ -696,6 +696,72 @@ def main():
             from paddle_tpu import monitor as _mon
             _mon.reset()
 
+    @case("overload_drain")
+    def _():
+        # the acting control plane end to end on the real backend:
+        # submit -> shed -> drain. A bounded-queue priority-admission
+        # engine under a burst must shed low-priority work with a
+        # typed EngineOverloaded + demand-model retry hint, displace
+        # for high priority, expire a deadline, finish everything
+        # admitted, then drain clean (drain_safe flips, queue shed
+        # with hints, live decodes retired) — every submit accounted
+        # in exactly one terminal state
+        from paddle_tpu.inference import (EngineOverloaded, Request,
+                                          ServingEngine)
+        from paddle_tpu.models import llama as L
+
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        eng = ServingEngine(L, params, cfg, num_slots=2, max_len=16,
+                            page_size=4, decode_chunk=2,
+                            priority_admission=True, max_queue=3,
+                            slo_preemption=True)
+
+        def mk(rid, **kw):
+            return Request(rid=rid,
+                           prompt=rng.integers(0, cfg.vocab_size, (5,))
+                           .astype(np.int32),
+                           max_new_tokens=6, **kw)
+        shed_rids, submitted = [], []
+        for i in range(8):                      # burst > slots + queue
+            try:
+                eng.submit(mk(i, priority=0))
+                submitted.append(i)
+            except EngineOverloaded as e:
+                assert e.retry_after_s >= 1.0, e.retry_after_s
+                shed_rids.append(i)
+        assert shed_rids, "burst did not shed over the bounded queue"
+        eng.submit(mk(100, priority=5))          # displaces a low
+        submitted.append(100)
+        displaced = [r for r, o in eng.outputs.items()
+                     if o.finish_reason == "shed"]
+        assert len(displaced) == 1, displaced
+        eng.submit(mk(101, priority=5, deadline_s=1e-4))
+        submitted.append(101)
+        time.sleep(0.01)                        # deadline burns out
+        for _ in range(3):
+            eng.step()
+        eng.begin_drain()                        # shed queue, finish live
+        try:
+            eng.submit(mk(200))
+            raise AssertionError("draining engine accepted a submit")
+        except EngineOverloaded:
+            shed_rids.append(200)                # drain refusal counts
+        eng.run()
+        assert eng.drain_complete
+        assert eng.autoscale_payload()["drain_safe"]
+        states = {r: o.finish_reason for r, o in eng.outputs.items()}
+        assert sorted(states) == sorted(submitted), (states, submitted)
+        assert states[100] == "completed", states
+        assert states[101] == "expired", states
+        assert eng.stats.completed + eng.stats.expired \
+            + eng.stats.shed == len(submitted) + len(shed_rids)
+        emitted = sum(len(o.tokens) for o in eng.outputs.values())
+        assert eng.stats.tokens_generated \
+            - eng.stats.tokens_discarded == emitted
+        eng.cache.alloc.check_invariants()
+        assert eng.cache.alloc.free_pages == eng.cache.num_pages
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp

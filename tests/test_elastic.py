@@ -143,3 +143,452 @@ class TestElasticScaleOut:
         # consumed: a second check does not double-credit
         m._down_times.append(_t.time())
         assert m._capacity(3, str(tmp_path)) == 2
+
+
+# ===========================================================================
+# Serving-replica elasticity (ISSUE 13): AdaptiveElasticManager.run_serving
+# acts on the autoscale demand signals — scale toward the hint within
+# bounds, drain (and only drain-safe replicas are ever stopped), replace
+# heartbeat-stale replicas, checkpoint before stopping.
+# ===========================================================================
+
+import json
+import subprocess
+import threading
+import time
+
+
+class _FakeReplica:
+    """Controllable demand source with the engine's signal surface."""
+
+    def __init__(self, demand=0.0, drain_safe=True):
+        self.demand = demand
+        self._drain_safe = drain_safe
+        self.draining = False
+
+    def autoscale_payload(self):
+        return {"demand_estimate": self.demand,
+                "desired_capacity_hint": int(np.ceil(self.demand)),
+                "drain_safe": self._drain_safe}
+
+    def begin_drain(self):
+        self.draining = True
+
+
+class TestServingElasticity:
+    def test_scales_toward_hint_within_bounds(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+
+        replicas = {}
+        stopped = []
+
+        def spawn(name):
+            # the first replica reports the fleet's demand; later ones
+            # idle (drain-safe) — the classic scale-out-then-settle
+            r = _FakeReplica(demand=2.6 if name == "replica0" else 0.0)
+            replicas[name] = r
+            return r
+
+        def stop(name, h):
+            stopped.append(name)
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        out = {}
+
+        def run():
+            out.update(mgr.run_serving(
+                spawn, stop, min_replicas=1, max_replicas=3,
+                poll_interval=0.01, drain_timeout=2.0, max_ticks=400,
+                stop_event=done))
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 5
+        while len(replicas) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sorted(replicas) == ["replica0", "replica1", "replica2"]
+        assert not stopped                      # no premature scale-in
+        replicas["replica0"].demand = 0.2       # load fell off
+        deadline = time.monotonic() + 5
+        while len(stopped) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done.set()
+        th.join(timeout=5)
+        # newest drained first, min bound respected
+        assert stopped == ["replica2", "replica1"]
+        assert out["replicas"] == ["replica0"]
+        reasons = [d.get("reason") for _, s, d in mgr.events]
+        assert reasons.count("scale-out") == 2
+        assert reasons.count("scale-in") == 2
+        # every drained replica was told to stop admitting first
+        assert replicas["replica1"].draining
+        assert replicas["replica2"].draining
+
+    def test_scale_down_waits_for_drain_safe_live_requests(self):
+        # acceptance: a replica with a LIVE request held open is never
+        # stopped — the controller waits on its drain_safe signal and
+        # stops it only after the live decode finishes
+        import jax
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+
+        cfg = L.llama_tiny()
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        fake = _FakeReplica(demand=1.6)      # forces scale-out to 2
+        engines = {}
+        stopped = []
+
+        def spawn(name):
+            if name == "replica0":
+                return fake
+            eng = ServingEngine(L, params, cfg, num_slots=2,
+                                max_len=32, page_size=4,
+                                decode_chunk=2)
+            rng = np.random.default_rng(0)
+            eng.submit(Request(
+                rid=1,
+                prompt=rng.integers(0, cfg.vocab_size, (5,))
+                .astype(np.int32),
+                max_new_tokens=8))
+            eng.step()                       # live decode held open
+            engines[name] = eng
+            return eng
+
+        def stop(name, h):
+            stopped.append(name)
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+
+        def run():
+            mgr.run_serving(spawn, stop, min_replicas=1,
+                            max_replicas=2, poll_interval=0.01,
+                            drain_timeout=30.0, max_ticks=100_000,
+                            stop_event=done)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 10
+        while "replica1" not in engines and time.monotonic() < deadline:
+            time.sleep(0.01)
+        eng = engines["replica1"]
+        fake.demand = 0.1                    # scale-in wanted now
+        deadline = time.monotonic() + 10
+        while not eng.draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.draining                  # drain began...
+        time.sleep(0.3)
+        assert stopped == []                 # ...but NOT stopped: the
+        #                                      live request is open
+        assert not eng.autoscale_payload()["drain_safe"]
+        eng.run()                            # finish the live decode
+        deadline = time.monotonic() + 10
+        while not stopped and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stopped == ["replica1"]       # stopped only drain-safe
+        assert eng.outputs[1].finish_reason == "completed"
+        done.set()
+        th.join(timeout=5)
+
+    def test_drain_timeout_never_stops(self):
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+
+        stuck = _FakeReplica(drain_safe=False)
+        stopped = []
+        mgr = AdaptiveElasticManager()
+        ok = mgr._drain_and_stop(
+            "r", stuck,
+            signals=lambda n, h: h.autoscale_payload(),
+            drain=lambda n, h: h.begin_drain(),
+            stop=lambda n, h: stopped.append(n),
+            drain_timeout=0.05, poll_interval=0.01)
+        assert ok is False and stopped == [] and stuck.draining
+
+    def test_stale_heartbeat_replaced(self, tmp_path):
+        from paddle_tpu.distributed import heartbeat
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager, ElasticStatus)
+
+        hb = str(tmp_path / "hb")
+        spawned = []
+        stopped = []
+
+        def spawn(name):
+            spawned.append(name)
+            return _FakeReplica()
+
+        def stop(name, h):
+            stopped.append(name)
+
+        # the test beats for every replica EXCEPT replica1 — the wedged
+        # one goes stale (never-beat grace = one timeout from spawn)
+        beat_stop = threading.Event()
+
+        def beater():
+            while not beat_stop.is_set():
+                for n in list(spawned):
+                    if n != "replica1":
+                        heartbeat.touch_named(hb, n)
+                time.sleep(0.03)
+
+        threading.Thread(target=beater, daemon=True).start()
+        mgr = AdaptiveElasticManager(max_restarts=5)
+        done = threading.Event()
+
+        def run():
+            mgr.run_serving(spawn, stop, min_replicas=2,
+                            max_replicas=3, poll_interval=0.02,
+                            heartbeat_dir=hb, heartbeat_timeout=0.25,
+                            max_ticks=100_000, stop_event=done)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 10
+        while "replica1" not in stopped and time.monotonic() < deadline:
+            time.sleep(0.02)
+        done.set()
+        beat_stop.set()
+        th.join(timeout=5)
+        assert "replica1" in stopped          # wedged replica removed
+        assert len(spawned) >= 3              # and replaced (min=2)
+        details = [d for _, s, d in mgr.events
+                   if d.get("reason") == "stale-replace"]
+        assert details and details[0]["replica"] == "replica1"
+        assert mgr.restarts >= 1              # burned restart budget
+
+    @pytest.mark.faults
+    @pytest.mark.chaos
+    def test_kill_mid_drain_leaves_committed_checkpoint(self, tmp_path):
+        # kill -9 between the drain checkpoint's atomic commit and the
+        # replica stop: the parent must find exactly the committed
+        # step, restorable — nothing torn, nothing uncommitted
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+        from paddle_tpu.testing import faults
+
+        root = str(tmp_path / "ckpt")
+        child = (
+            "import sys\n"
+            "import numpy as np\n"
+            "import paddle_tpu as pt\n"
+            "from paddle_tpu.distributed.fleet.elastic import (\n"
+            "    AdaptiveElasticManager)\n"
+            "class H:\n"
+            "    def autoscale_payload(self):\n"
+            "        return {'drain_safe': True, 'demand_estimate': 0.0}\n"
+            "    def begin_drain(self):\n"
+            "        pass\n"
+            "state = {'w': pt.to_tensor(np.arange(6, dtype='float32')),\n"
+            "         'step': 7}\n"
+            "mgr = AdaptiveElasticManager()\n"
+            "h = H()\n"
+            "mgr._drain_and_stop('replica0', h,\n"
+            "    signals=lambda n, x: x.autoscale_payload(),\n"
+            "    drain=lambda n, x: x.begin_drain(),\n"
+            "    stop=lambda n, x: None, drain_timeout=5,\n"
+            "    poll_interval=0.01, state_fn=lambda: state,\n"
+            "    ckpt_dir=sys.argv[1])\n"
+            "print('SURVIVED')\n")
+        r = subprocess.run(
+            [sys.executable, "-c", child, root],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                     FLAGS_fault_injection="drain.stop:kill:1"))
+        assert r.returncode == faults.KILL_EXIT_CODE, \
+            (r.returncode, r.stderr[-800:])
+        assert "SURVIVED" not in r.stdout
+        mgr = CheckpointManager(root)
+        assert mgr.latest_step() == 1          # committed before death
+        import paddle_tpu as pt
+        target = {"w": pt.to_tensor(np.zeros(6, "float32")), "step": 0}
+        assert mgr.restore_latest(target) == 1
+        np.testing.assert_array_equal(
+            np.asarray(target["w"].numpy()),
+            np.arange(6, dtype="float32"))
+        assert target["step"] == 7
+
+    def test_committed_drain_excluded_from_capacity(self):
+        # review fix: a drain that times out leaves the replica
+        # SHEDDING (no un-drain exists) — it must stop counting as
+        # capacity, so a demand rise mid-drain spawns a replacement,
+        # and the drain keeps retrying until it completes
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+
+        feeder = _FakeReplica(demand=1.6)        # scale-out to 2
+        stuck = []
+        spawned = []
+        stopped = []
+
+        def spawn(name):
+            spawned.append(name)
+            if name == "replica1":
+                r = _FakeReplica(demand=0.0, drain_safe=False)
+                stuck.append(r)                  # drain will hang
+                return r
+            return feeder if name == "replica0" else _FakeReplica()
+
+        def stop(name, h):
+            stopped.append(name)
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+
+        def run():
+            mgr.run_serving(spawn, stop, min_replicas=1,
+                            max_replicas=3, poll_interval=0.01,
+                            drain_timeout=0.05, max_ticks=100_000,
+                            stop_event=done)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 5
+        while "replica1" not in spawned and time.monotonic() < deadline:
+            time.sleep(0.01)
+        feeder.demand = 0.2                      # scale-in replica1...
+        deadline = time.monotonic() + 5
+        while not (stuck and stuck[0].draining) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stuck[0].draining and not stopped  # ...drain committed,
+        #                                           times out, no stop
+        # let the CROSS-TICK drain deadline pass: the timeout event
+        # must record exactly once while the drain keeps retrying
+        deadline = time.monotonic() + 5
+        while not any(d.get("reason") == "drain-timeout"
+                      for _, s, d in mgr.events) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not stopped
+        feeder.demand = 1.6                      # demand rises mid-drain
+        deadline = time.monotonic() + 5
+        while len(spawned) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # the shedding replica no longer counts: a REPLACEMENT spawned
+        assert len(spawned) == 3, spawned
+        assert not stopped                       # still never stopped
+        stuck[0]._drain_safe = True              # live work finished
+        deadline = time.monotonic() + 5
+        while "replica1" not in stopped and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stopped == ["replica1"]           # committed drain lands
+        done.set()
+        th.join(timeout=5)
+        reasons = [d.get("reason") for _, s, d in mgr.events]
+        assert reasons.count("drain-timeout") == 1   # transition, not
+        #                                              one per retry
+
+    def test_stop_event_interrupts_drain_wait(self):
+        # review fix: a controller shutdown must not hang behind a
+        # drain_timeout-long wait on an undrainable replica
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+
+        stuck = _FakeReplica(drain_safe=False)
+        stopped = []
+        mgr = AdaptiveElasticManager()
+        ev = threading.Event()
+        out = []
+
+        def run():
+            out.append(mgr._drain_and_stop(
+                "r", stuck,
+                signals=lambda n, h: h.autoscale_payload(),
+                drain=lambda n, h: h.begin_drain(),
+                stop=lambda n, h: stopped.append(n),
+                drain_timeout=60.0, poll_interval=0.01,
+                stop_event=ev))
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        assert th.is_alive()                     # waiting on drain_safe
+        ev.set()
+        th.join(timeout=2)
+        assert not th.is_alive() and out == [False] and stopped == []
+
+    def test_stale_replace_budget_matches_training_semantics(self,
+                                                             tmp_path):
+        # review fix: the serving stale-replace budget stops at
+        # max_restarts like the training paths, not N+1
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager, ElasticStatus)
+
+        hb = str(tmp_path / "hb")
+        os.makedirs(hb)
+        spawned = []
+
+        def spawn(name):
+            spawned.append(name)
+            return _FakeReplica()              # never beats
+
+        stopped = []
+        mgr = AdaptiveElasticManager(max_restarts=2)
+        out = mgr.run_serving(
+            spawn, lambda n, h: stopped.append(n), min_replicas=1,
+            max_replicas=2, poll_interval=0.01, heartbeat_dir=hb,
+            heartbeat_timeout=0.05, max_ticks=100_000)
+        reasons = [d.get("reason") for _, s, d in mgr.events]
+        assert reasons.count("stale-replace") == 2    # == budget, not 3
+        assert mgr.restarts == 2
+        assert any(s == ElasticStatus.ERROR
+                   and d.get("reason") == "restart budget exhausted"
+                   for _, s, d in mgr.events)
+        assert "replicas" in out                      # clean summary
+
+    def test_total_fleet_never_exceeds_max_replicas(self):
+        # review fix: a replacement for a committed-but-stuck drain
+        # waits for the drain to land rather than pushing the TOTAL
+        # fleet (draining included) past max_replicas
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+
+        feeder = _FakeReplica(demand=1.6)
+        stuck = []
+        spawned = []
+        stopped = []
+
+        def spawn(name):
+            spawned.append(name)
+            if name == "replica1":
+                r = _FakeReplica(demand=0.0, drain_safe=False)
+                stuck.append(r)
+                return r
+            return feeder if name == "replica0" else _FakeReplica()
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+
+        def run():
+            mgr.run_serving(spawn, lambda n, h: stopped.append(n),
+                            min_replicas=1, max_replicas=2,
+                            poll_interval=0.01, drain_timeout=0.05,
+                            max_ticks=100_000, stop_event=done)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 5
+        while len(spawned) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        feeder.demand = 0.2                  # trigger the scale-in
+        deadline = time.monotonic() + 5
+        while not (stuck and stuck[0].draining) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stuck and stuck[0].draining
+        feeder.demand = 1.6                  # demand high mid-drain
+        time.sleep(0.3)                      # would overshoot without
+        #                                      the hard bound
+        assert len(spawned) == 2, spawned    # fleet held at max (=2)
+        stuck[0]._drain_safe = True          # drain lands...
+        deadline = time.monotonic() + 5
+        while len(spawned) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(spawned) == 3             # ...THEN the replacement
+        assert stopped == ["replica1"]
+        done.set()
+        th.join(timeout=5)

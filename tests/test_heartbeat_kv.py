@@ -112,3 +112,62 @@ class TestKVRelay:
     def test_relay_without_client_returns_none(self, monkeypatch):
         monkeypatch.setattr(hb, "_kv_client", lambda: None)
         assert hb.start_kv_relay("/tmp/nope", [0]) is None
+
+
+class TestNamedBeats:
+    """Name-keyed beats for serving replicas (ISSUE 13): same files,
+    same staleness semantics, arbitrary participant names — the
+    transport fleet/elastic.py run_serving watches."""
+
+    def test_touch_and_stale(self, tmp_path):
+        d = str(tmp_path)
+        hb.touch_named(d, "replica0")
+        assert hb.stale_names(d, ["replica0"], timeout=5.0) == {}
+        time.sleep(0.06)
+        stale = hb.stale_names(d, ["replica0"], timeout=0.05)
+        assert "replica0" in stale
+        assert "no liveness beat" in stale["replica0"]
+
+    def test_never_beat_grace(self, tmp_path):
+        d = str(tmp_path)
+        t0 = time.time()
+        # inside the startup grace: not stale yet
+        assert hb.stale_names(d, ["replica1"], timeout=5.0,
+                              started_at={"replica1": t0}) == {}
+        stale = hb.stale_names(d, ["replica1"], timeout=0.01,
+                               started_at={"replica1": t0 - 1.0})
+        assert "never emitted" in stale["replica1"]
+        # no started_at: a never-beat name is never declared stale
+        assert hb.stale_names(d, ["replica1"], timeout=0.01) == {}
+
+    def test_start_named_daemon_beats(self, tmp_path):
+        d = str(tmp_path)
+        stop = hb.start_named(d, "replica2", interval=0.02)
+        try:
+            deadline = time.time() + 2
+            path = os.path.join(d, "replica2.alive")
+            while not os.path.exists(path) and time.time() < deadline:
+                time.sleep(0.01)
+            assert hb.stale_names(d, ["replica2"], timeout=1.0) == {}
+        finally:
+            stop.set()
+
+    def test_leftover_file_older_than_spawn_gets_grace(self, tmp_path):
+        # review fix: controllers reuse replica names across runs — a
+        # beat file left by a previous incarnation must not get a
+        # fresh healthy replica declared stale before its startup
+        # grace; an mtime older than started_at counts as never-beat
+        d = str(tmp_path)
+        hb.touch_named(d, "replica0")            # previous incarnation
+        time.sleep(0.06)
+        t_spawn = time.time()                    # fresh spawn NOW
+        stale = hb.stale_names(d, ["replica0"], timeout=0.05,
+                               started_at={"replica0": t_spawn})
+        assert stale == {}, stale                # grace, not stale
+        time.sleep(0.07)                         # grace spent, no beat
+        stale = hb.stale_names(d, ["replica0"], timeout=0.05,
+                               started_at={"replica0": t_spawn})
+        assert "never emitted" in stale["replica0"]
+        hb.touch_named(d, "replica0")            # THIS incarnation beats
+        assert hb.stale_names(d, ["replica0"], timeout=0.05,
+                              started_at={"replica0": t_spawn}) == {}

@@ -746,3 +746,99 @@ def test_slo_overhead_harness():
     number is the interleaved-window median recorded in CHANGES.md."""
     med, pcts = measure_slo_overhead()
     assert med < 10.0, (med, pcts)
+
+
+# ---------------------------------------------------------------------------
+# acting-half signals (ISSUE 13): pure demand model, retry hints, burn
+# cache, shed/expired accounting
+# ---------------------------------------------------------------------------
+
+class TestActingSignals:
+    def test_demand_model_matches_gauge_payload(self, mon):
+        # the pure function and the tick-driven gauge path are ONE
+        # model: identical fields for identical inputs
+        slo.note_sched_tick(3, 2, 4, 0.5)
+        via_gauges = slo.update_autoscale_gauges()
+        pure = slo.demand_model(3, 2, 4, 0.5)
+        for k, v in pure.items():
+            assert via_gauges[k] == v, (k, v, via_gauges[k])
+
+    def test_retry_after_hint_math(self, mon):
+        horizon = slo.demand_model(0, 0, 1, 1.0)["horizon_s"]
+        # idle: floor of 1s
+        assert slo.retry_after_hint(slo.demand_model(0, 0, 2, 1.0)) \
+            == 1.0
+        # demand 2.0 -> one replica's worth of excess -> one horizon
+        p = slo.demand_model(2, 2, 2, 0.0)   # util 1 + backlog 1
+        assert p["demand_estimate"] == 2.0
+        assert slo.retry_after_hint(p) == pytest.approx(horizon)
+        # deep backlog clamps at 2 x horizon
+        deep = slo.demand_model(100, 2, 2, 0.0)
+        assert slo.retry_after_hint(deep) == pytest.approx(2 * horizon)
+        # no ticks at all: flat 1.0, never an error
+        assert slo.retry_after_hint() == 1.0
+
+    def test_shed_counts_against_availability(self, mon):
+        for _ in range(6):
+            slo.record_request(_completed(tenant="t"))
+        for _ in range(2):
+            slo.record_shed("t")
+        rep = slo.compliance_report()
+        av = rep["objectives"]["availability"]
+        assert av["samples_slow"] == 8
+        assert av["compliance"] == pytest.approx(6 / 8)
+        agg = slo.tenants_snapshot()["tenants"]
+        # sheds ride the rejection column plus their own; the claimed
+        # tenant had earned its slot by completing
+        assert agg["t"]["shed"] == 2 and agg["t"]["rejected"] == 2
+
+    def test_expired_bad_for_availability_excluded_from_latency(
+            self, mon):
+        for _ in range(6):
+            slo.record_request(_completed(tenant="t"))
+        # an expired request with a tiny e2e must NOT score as a good
+        # e2e sample — excluded from latency windows, bad for
+        # availability
+        slo.record_request({"tenant": "t", "expired": True,
+                            "e2e_ms": 0.5, "queue_wait_ms": 3.0,
+                            "page_seconds": 0.01})
+        rep = slo.compliance_report()
+        assert rep["objectives"]["availability"]["samples_slow"] == 7
+        assert rep["objectives"]["availability"]["compliance"] \
+            == pytest.approx(6 / 7)
+        assert rep["objectives"]["e2e_p99_ms"]["samples_slow"] == 6
+        agg = slo.tenants_snapshot()["tenants"]["t"]
+        assert agg["expired"] == 1 and agg["completed"] == 6
+        # expired costs still fold (it consumed resources)
+        assert agg["queue_wait_ms"] == pytest.approx(6 * 1.0 + 3.0)
+
+    def test_burn_alerting_cached_and_monitor_gated(self, mon):
+        import paddle_tpu as pt
+        slo.set_objectives(e2e_p99_ms=1.0)
+        for _ in range(40):
+            slo.record_request(_completed(e2e_ms=100.0))
+        assert slo.burn_alerting(max_age_s=0) is True
+        # cached verdict survives a reset for the TTL...
+        monitor.reset()
+        assert slo.burn_alerting(max_age_s=3600) is False  # reset
+        #          cleared the cache stamp, so this recomputed: False
+        # ...and the monitor-off path never reads the window
+        pt.set_flags({"FLAGS_enable_monitor": False})
+        assert slo.burn_alerting(max_age_s=0) is False
+        pt.set_flags({"FLAGS_enable_monitor": True})
+
+    def test_cost_carrying_shed_folds_consumption(self, mon):
+        # review fix: a shed of work that already consumed resources
+        # (displaced/drained after queue wait) folds its cost columns
+        # into the tenant aggregates; a malformed rejection still
+        # folds nothing
+        slo.record_request(_completed(tenant="t"))      # earn the slot
+        slo.record_request({"tenant": "t", "rejected": True,
+                            "shed": True, "queue_wait_ms": 5.0,
+                            "prefill_tokens": 7})
+        slo.record_request({"tenant": "t", "rejected": True,
+                            "queue_wait_ms": 99.0})     # malformed
+        agg = slo.tenants_snapshot()["tenants"]["t"]
+        assert agg["shed"] == 1 and agg["rejected"] == 2
+        assert agg["queue_wait_ms"] == pytest.approx(1.0 + 5.0)
+        assert agg["prefill_tokens"] == 4 + 7
