@@ -26,7 +26,10 @@ from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
                          get_group, irecv, is_available, isend, new_group,
                          P2POp, batch_isend_irecv,
                          recv, reduce, reduce_scatter, scatter,
-                         scatter_object_list, send, wait)
+                         scatter_object_list, send, wait,
+                         CollectiveTimeout, PeerLostError,
+                         PEER_FAILURE_RC, COLLECTIVE_TIMEOUT_RC,
+                         abort_on_collective_fault, coordinated_abort)
 from .env import (ParallelEnv, get_rank, get_world_size,  # noqa
                   init_parallel_env, is_initialized)
 from .placement import Partial, Placement, ReduceType, Replicate, Shard  # noqa

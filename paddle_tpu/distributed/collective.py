@@ -17,8 +17,11 @@ reference's TCPStore-based bootstrap (phi/core/distributed/store/tcp_store.cc).
 """
 from __future__ import annotations
 
+import os
 import pickle
-from typing import List, Optional, Sequence
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +32,8 @@ from ..core.tensor import Tensor, _nbytes_of
 from ..testing import faults as _faults
 from . import env
 from ..core import enforce as E
+from .launch.main import (COLLECTIVE_TIMEOUT_RC,  # noqa: F401 (re-exported)
+                          PEER_FAILURE_RC)
 
 
 def _note_eager(op: str, tensor=None):
@@ -64,7 +69,333 @@ __all__ = [
     "scatter", "scatter_object_list", "gather", "alltoall",
     "alltoall_single", "reduce_scatter", "send", "recv", "isend", "irecv",
     "barrier", "wait",
+    "CollectiveTimeout", "PeerLostError", "COLLECTIVE_FAULTS",
+    "coordinated_abort", "abort_on_collective_fault", "coll_timeout_s",
+    "PEER_FAILURE_RC", "COLLECTIVE_TIMEOUT_RC",
 ]
+
+
+# -- typed collective fault layer --------------------------------------------
+#
+# Every multi-host object exchange below used to block inside a bare
+# ``blocking_key_value_get(key, 60_000)``: a dead peer meant every
+# survivor stalled the full minute and then crashed with a backend error
+# naming no rank, no op, no tag. The deadline loop here replaces that
+# with short polls under one env-configurable TOTAL budget
+# (``PADDLE_TPU_COLL_TIMEOUT_S``, default keeps the 60s), capped
+# exponential backoff between polls, and — each poll — a check of the
+# dead-peer tombstones and coordinated-abort markers the launcher /
+# heartbeat layer publishes (heartbeat.py), so a peer that is already
+# gone fails the survivors in ~one poll interval with a typed error
+# naming exactly who is missing. Single-process / client-less behavior
+# is byte-identical: the layer only changes what happens when a peer is
+# already gone or never shows up.
+
+DEFAULT_COLL_TIMEOUT_S = 60.0
+_BACKOFF_FLOOR_S = 0.002
+_BACKOFF_CAP_S = 0.1
+# how often the wait loop re-checks tombstone/abort markers: the fast
+# path only needs ~poll-interval granularity, and on jaxlib without a
+# non-blocking try_get each KV marker probe costs a blocking get —
+# checking every single poll would double the pass cost
+_MARKER_CHECK_INTERVAL_S = 0.2
+# blocking-get budgets for jaxlib without key_value_try_get. The HEAD
+# (lowest pending rank) gets an event-driven wait — a blocking get
+# returns the instant the key lands, so the common path stays
+# server-notified like the old one-key-at-a-time code. Every OTHER
+# pending key gets only a presence check (a present key returns
+# immediately regardless of budget; an absent one costs the budget), so
+# a pass over W pending peers is ~50ms + (W-1)*RTT-bounded-by-10ms, not
+# W*50ms — and every key eventually becomes the head as lower ranks
+# resolve.
+_HEAD_PROBE_MS = 50
+_SHORT_PROBE_MS = 10
+# sustained every-probe-transport-error window before the wait raises
+# UnavailableError (coordinator unreachable) instead of spending the
+# whole deadline and then mis-attributing live peers as missing
+_TRANSPORT_FAIL_S = 5.0
+
+
+def _looks_absent(e: BaseException) -> bool:
+    """True when a probe error means 'key not present yet' (the normal
+    blocked state) rather than a transport failure. jaxlib surfaces
+    absence as NOT_FOUND (try_get) or DEADLINE_EXCEEDED (short blocking
+    get); dict-backed fakes raise KeyError. Unknown shapes default to
+    transport ONLY after a sustained all-probes-failing window, so a
+    misclassification cannot fail a healthy wait."""
+    if isinstance(e, KeyError):
+        return True
+    s = str(e)
+    return "NOT_FOUND" in s or "DEADLINE_EXCEEDED" in s \
+        or "not found" in s.lower()
+
+
+def _kv_probe(client, key: str, probe_ms: int = _HEAD_PROBE_MS):
+    """One non-blocking-ish KV read (shared helper in heartbeat.py:
+    ``key_value_try_get`` when the client has it, else a blocking get
+    bounded by ``probe_ms``). Raises when the key is (still) absent."""
+    from . import heartbeat as _hb
+    return _hb._kv_try(client, key, probe_ms=probe_ms)
+
+
+def coll_timeout_s() -> float:
+    """The host-collective deadline budget: PADDLE_TPU_COLL_TIMEOUT_S
+    seconds (unset, unparseable, or non-positive values fall back to the
+    60s default the bare waits used — a misconfigured knob must degrade
+    to today's behavior, not hang forever or spin)."""
+    raw = os.environ.get("PADDLE_TPU_COLL_TIMEOUT_S", "")
+    if not raw:
+        return DEFAULT_COLL_TIMEOUT_S
+    try:
+        v = float(raw)
+    except ValueError:
+        return DEFAULT_COLL_TIMEOUT_S
+    return v if v > 0 else DEFAULT_COLL_TIMEOUT_S
+
+
+def _next_delay(delay: float) -> float:
+    """Capped exponential backoff schedule for the KV polls."""
+    return min(delay * 2.0, _BACKOFF_CAP_S)
+
+
+class CollectiveTimeout(E.ExecutionTimeoutError):
+    """A host collective expired its deadline with contributions still
+    missing. Names the op, tag, elapsed time, and the exact ranks whose
+    per-rank keys never resolved (derivable attribution: each rank
+    writes its own key)."""
+
+    def __init__(self, op: str, tag, elapsed_s: float, missing_ranks,
+                 world: int, timeout_s: float):
+        self.op = op
+        self.tag = tag
+        self.elapsed_s = float(elapsed_s)
+        self.missing_ranks = sorted(int(r) for r in missing_ranks)
+        self.world = int(world)
+        self.timeout_s = float(timeout_s)
+        super().__init__(
+            f"collective '{op}' (tag={tag}) timed out after "
+            f"{self.elapsed_s:.1f}s (budget {self.timeout_s:g}s): no "
+            f"contribution from rank(s) {self.missing_ranks} of world "
+            f"{self.world}",
+            hint="raise PADDLE_TPU_COLL_TIMEOUT_S if peers are merely "
+                 "slow; a rank that is gone should instead surface as "
+                 "PeerLostError via the launcher's death markers")
+
+
+class PeerLostError(E.UnavailableError):
+    """A peer rank is known-dead (launcher death marker / heartbeat
+    tombstone) or announced a coordinated abort while this rank was
+    blocked in a host collective — the fast path that spares survivors
+    the full deadline."""
+
+    def __init__(self, op: str, tag, lost: Dict[int, str],
+                 elapsed_s: float, world: int):
+        self.op = op
+        self.tag = tag
+        self.lost_ranks = sorted(int(r) for r in lost)
+        self.reasons = {int(r): str(why) for r, why in lost.items()}
+        self.elapsed_s = float(elapsed_s)
+        self.world = int(world)
+        detail = "; ".join(f"rank {r}: {self.reasons[r]}"
+                           for r in self.lost_ranks)
+        super().__init__(
+            f"collective '{op}' (tag={tag}) lost peer rank(s) "
+            f"{self.lost_ranks} of world {self.world} after "
+            f"{self.elapsed_s:.1f}s ({detail})",
+            hint="the elastic manager restarts the world on the "
+                 "coordinated-abort rc; see "
+                 "docs/fault_tolerance.md#surviving-rank-loss")
+
+
+COLLECTIVE_FAULTS = (CollectiveTimeout, PeerLostError)
+
+
+def _reject_multihost_subgroup(op: str, n: int, client):
+    """The object-exchange KV paths key by GLOBAL rank, so they serve
+    the whole-world group only. A multi-host SUBGROUP call must fail
+    TYPED — the old code hung on keys no member writes; silently
+    falling back to identity semantics would instead return wrong data
+    (each rank seeing only itself)."""
+    if client is not None and env.get_world_size() > 1 and 1 < n < \
+            env.get_world_size():
+        raise E.UnimplementedError(
+            f"{op} over a multi-host SUBGROUP ({n} of "
+            f"{env.get_world_size()} ranks) is not supported: the "
+            "KV exchange keys by global rank",
+            hint="use the default (whole-world) group, or exchange "
+                 "through tagged whole-world collectives and filter")
+
+
+def _lost_peers(pending_ranks, me: Optional[int], client) -> Dict[int, str]:
+    """{rank: reason} of peers this wait can no longer expect: pending
+    ranks with a death marker, plus any OTHER rank that published this
+    generation's coordinated-abort marker (its world is going down even
+    if it already contributed here)."""
+    from . import heartbeat as _hb
+    lost = dict(_hb.dead_ranks(sorted(pending_ranks), client=client))
+    marker = _hb.read_abort_marker(client=client)
+    if marker is not None:
+        r = int(marker.get("rank", -1))
+        if r >= 0 and r != me and r not in lost:
+            lost[r] = ("aborted its collective: "
+                       f"{marker.get('reason', 'coordinated abort')}")
+    return lost
+
+
+def _wait_for_keys(client, *, op: str, tag, want: Dict[int, str],
+                   world: int, me: Optional[int] = None,
+                   timeout_s: Optional[float] = None) -> Dict[int, str]:
+    """Deadline-looped multi-key KV wait with failed-rank attribution.
+    ``want`` maps the rank a key is ATTRIBUTED to -> the key; returns
+    {rank: value} once every key resolved. Raises PeerLostError (fast
+    path: tombstone/abort marker observed) or CollectiveTimeout (budget
+    spent; names exactly the unresolved ranks)."""
+    timeout_s = coll_timeout_s() if timeout_s is None else float(timeout_s)
+    t0 = time.monotonic()
+    delay = _BACKOFF_FLOOR_S
+    pending = dict(want)
+    out: Dict[int, str] = {}
+    mon = _monitor.enabled()
+    next_marker_check = 0.0   # first blocked pass checks immediately
+    transport_down_since = None   # first pass where EVERY probe failed
+    #                               with a non-absent (transport) error
+
+    def _observe_wait():
+        if mon:
+            _monitor.observe(
+                "dist.collective.wait_ms",
+                (time.monotonic() - t0) * 1e3,
+                doc="deadline-looped host-collective KV wait wall time "
+                    "(success and failure)")
+
+    while pending:
+        _faults.hit("collective.kv_get")
+        transport_errs = 0
+        probes = 0
+        for i, r in enumerate(sorted(pending)):
+            key = pending[r]
+            probes += 1
+            try:
+                val = _kv_probe(client, key,
+                                probe_ms=_HEAD_PROBE_MS if i == 0
+                                else _SHORT_PROBE_MS)
+            except Exception as e:
+                if not _looks_absent(e):
+                    transport_errs += 1
+                continue
+            out[r] = val
+            del pending[r]
+        if not pending:
+            break
+        elapsed = time.monotonic() - t0
+        # 'key not present yet' and 'coordination service unreachable'
+        # are different failures: a pass where EVERY probe died with a
+        # transport-shaped error starts (or continues) the outage
+        # clock, and a sustained outage raises typed instead of
+        # burning the whole deadline and then blaming live peers
+        if probes and transport_errs == probes:
+            if transport_down_since is None:
+                transport_down_since = elapsed
+            elif elapsed - transport_down_since >= _TRANSPORT_FAIL_S:
+                _observe_wait()
+                raise E.UnavailableError(
+                    f"coordination service unreachable for "
+                    f"{elapsed - transport_down_since:.1f}s while "
+                    f"'{op}' (tag={tag}) waited on rank(s) "
+                    f"{sorted(pending)} — keys may exist but cannot "
+                    "be read (coordinator died?)",
+                    hint="this is NOT peer attribution; the elastic "
+                         "manager should restart the world")
+        else:
+            transport_down_since = None
+        # tombstone/abort markers are rate-limited: the fast path needs
+        # ~poll-interval granularity, and each KV marker probe can cost
+        # a 50ms blocking get on jaxlib without a non-blocking read
+        lost = None
+        if elapsed >= next_marker_check:
+            next_marker_check = elapsed + _MARKER_CHECK_INTERVAL_S
+            lost = _lost_peers(pending, me, client)
+        if lost:
+            _observe_wait()
+            if mon:
+                _monitor.inc("dist.collective.peer_lost",
+                             doc="host collectives failed fast on a "
+                                 "dead-peer tombstone or abort marker")
+            raise PeerLostError(op, tag, lost, elapsed, world)
+        if elapsed >= timeout_s:
+            _observe_wait()
+            if mon:
+                _monitor.inc("dist.collective.timeouts",
+                             doc="host collectives that expired their "
+                                 "deadline with contributions missing")
+            raise CollectiveTimeout(op, tag, elapsed, set(pending),
+                                    world, timeout_s)
+        time.sleep(delay)
+        delay = _next_delay(delay)
+    _observe_wait()
+    return out
+
+
+def coordinated_abort(exc=None, *, reason: Optional[str] = None,
+                      exit_process: bool = True, rc: Optional[int] = None):
+    """The failing rank's half of the abort protocol: publish the
+    generation-keyed abort marker (peers blocked in ANY wait observe it
+    next poll and fail fast as PeerLostError), dump the flight record
+    (crash discipline — the black box survives the exit), and leave
+    with a typed rc: ``PEER_FAILURE_RC`` for a PeerLostError (peer
+    CONFIRMED dead — the elastic manager restarts without blaming this
+    rank or engaging scale-in) or ``COLLECTIVE_TIMEOUT_RC`` otherwise
+    (the peer may be wedged-but-alive, so the manager's ordinary
+    worker-failure heuristics stay engaged). ``exit_process=False``
+    publishes + dumps but returns (tests; bespoke supervisors that own
+    their exit)."""
+    me = env.get_rank()
+    why = reason or (f"{type(exc).__name__}: {exc}" if exc is not None
+                     else "coordinated abort")
+    payload = {"reason": why,
+               "op": getattr(exc, "op", None),
+               "tag": getattr(exc, "tag", None),
+               "lost_ranks": (getattr(exc, "lost_ranks", None)
+                              or getattr(exc, "missing_ranks", None))}
+    from . import heartbeat as _hb
+    _hb.write_abort_marker(me, payload)
+    try:
+        from ..monitor import trace as _trace
+        _trace.instant("collective.abort", rank=me, reason=why[:400])
+        _trace.dump_flight_record(reason=f"collective.abort:rank{me}")
+    except Exception:
+        pass
+    print(f"[collective] rank {me} aborting: {why}", file=sys.stderr)
+    if exit_process:
+        try:
+            sys.stderr.flush()
+            sys.stdout.flush()
+        except Exception:
+            pass
+        if rc is None:
+            rc = PEER_FAILURE_RC if isinstance(exc, PeerLostError) \
+                else COLLECTIVE_TIMEOUT_RC
+        # os._exit, not sys.exit: atexit could hang on a coordination
+        # service whose coordinator is the rank that just died
+        os._exit(rc)
+
+
+class abort_on_collective_fault:
+    """Context manager for worker train loops: a CollectiveTimeout /
+    PeerLostError escaping the block triggers :func:`coordinated_abort`
+    (marker + flight record + rc). With ``exit_process=False`` the
+    marker/record still land and the fault re-raises."""
+
+    def __init__(self, exit_process: bool = True):
+        self._exit = exit_process
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is not None and issubclass(et, COLLECTIVE_FAULTS):
+            coordinated_abort(ev, exit_process=self._exit)
+        return False
 
 
 class ReduceOp:
@@ -233,11 +564,38 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor, group=None,
 # discipline), so the counter value is identical across peers at each call —
 # unlike id(object_list), which is process-local.
 _AG_SEQ = [0]
+# Distance-2 key reclamation for the untagged SYMMETRIC exchanges
+# (all_gather_object, barrier): a rank entering untagged exchange N has
+# completed N-1, which required every peer's N-1 key — so every peer
+# finished N-2's reads (it had to, to write its N-1 key) and this
+# process's keys from exchanges <= N-2 are provably dead. Without this
+# a job that barriers every step grows the coordination-service KV
+# store unboundedly (same discipline as the checkpoint stream's
+# _begin_tagged_op_and_reclaim). The asymmetric broadcast/scatter
+# paths get NO reclamation: their src never blocks, so it has no
+# causal proof peers consumed older keys. Tagged calls are the
+# caller's to reclaim (the checkpoint layer already does).
+_AG_SPENT: list = []     # (seq, key this process wrote)
+_BAR_SPENT: list = []
 
 
-def all_gather_object(object_list: List, obj, group=None, tag=None):
+def _reclaim_untagged(client, spent: list, seq: int):
+    doomed = [k for s, k in spent if s <= seq - 2]
+    spent[:] = [e for e in spent if e[0] > seq - 2]
+    for k in doomed:
+        try:
+            client.key_value_delete(k)
+        except Exception:
+            pass
+
+
+def all_gather_object(object_list: List, obj, group=None, tag=None,
+                      timeout_s=None):
     """Host object exchange. Multi-host: via the coordination-service KV
-    store (jax.distributed client), mirroring TCPStore exchange.
+    store (jax.distributed client), mirroring TCPStore exchange, under
+    the typed fault layer: one TOTAL deadline across all peers (env
+    ``PADDLE_TPU_COLL_TIMEOUT_S``), tombstone/abort fast path, and
+    failed-rank attribution in the raised error.
 
     Untagged calls pair across hosts by a per-process sequence counter,
     which is only sound when every host issues its collectives in the
@@ -249,19 +607,24 @@ def all_gather_object(object_list: List, obj, group=None, tag=None):
     _note_eager("all_gather_object")
     n = _group_size(group)
     client = _coord_client()
+    _reject_multihost_subgroup("all_gather_object", n, client)
     with _lat("all_gather_object"):
-        if client is not None and n > 1:
+        if client is not None and n > 1 and n == env.get_world_size():
             if tag is None:
                 tag = _AG_SEQ[0]
                 _AG_SEQ[0] += 1
+                _reclaim_untagged(client, _AG_SPENT, tag)
+                _AG_SPENT.append((tag, f"ag_{tag}_{env.get_rank()}"))
             me = env.get_rank()
             blob = pickle.dumps(obj).hex()
             client.key_value_set(f"ag_{tag}_{me}", blob)
+            got = _wait_for_keys(
+                client, op="all_gather_object", tag=tag,
+                want={r: f"ag_{tag}_{r}" for r in range(n)},
+                world=n, me=me, timeout_s=timeout_s)
             object_list.clear()
-            for r in range(n):
-                data = client.blocking_key_value_get(f"ag_{tag}_{r}",
-                                                     60_000)
-                object_list.append(pickle.loads(bytes.fromhex(data)))
+            object_list.extend(pickle.loads(bytes.fromhex(got[r]))
+                               for r in range(n))
         else:
             object_list.clear()
             object_list.extend(obj for _ in range(n))
@@ -281,7 +644,40 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     return _Task(tensor) if not sync_op else tensor
 
 
-def broadcast_object_list(object_list: List, src: int = 0, group=None):
+# untagged broadcast/scatter object exchanges pair by their own
+# sequence counters (same single-thread program-order contract as
+# _AG_SEQ; distinct namespaces so the three families cannot mis-pair)
+_BC_SEQ = [0]
+_SC_SEQ = [0]
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None,
+                          tag=None, timeout_s=None):
+    """Reference: communication/broadcast.py broadcast_object_list.
+    Multi-host: ``src`` publishes the pickled list once; every other
+    rank waits under the typed fault layer (a missing contribution is
+    attributed to ``src``). Single-controller worlds keep the identity
+    semantics unchanged."""
+    _note_eager("broadcast_object_list")
+    n = _group_size(group)
+    client = _coord_client()
+    _reject_multihost_subgroup("broadcast_object_list", n, client)
+    with _lat("broadcast_object_list"):
+        if client is not None and n > 1 and n == env.get_world_size():
+            if tag is None:
+                tag = _BC_SEQ[0]
+                _BC_SEQ[0] += 1
+            me = env.get_rank()
+            if me == src:
+                client.key_value_set(
+                    f"bc_{tag}", pickle.dumps(list(object_list)).hex())
+            else:
+                got = _wait_for_keys(
+                    client, op="broadcast_object_list", tag=tag,
+                    want={src: f"bc_{tag}"}, world=n, me=me,
+                    timeout_s=timeout_s)
+                object_list[:] = pickle.loads(
+                    bytes.fromhex(got[src]))
     return object_list
 
 
@@ -300,12 +696,51 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None,
 
 
 def scatter_object_list(out_object_list: List, in_object_list=None,
-                        src: int = 0, group=None):
-    me = (group or _get_default_group()).rank
-    me = max(me, 0)
-    out_object_list.clear()
-    if in_object_list:
-        out_object_list.append(in_object_list[me])
+                        src: int = 0, group=None, tag=None,
+                        timeout_s=None):
+    """Reference: communication/scatter.py scatter_object_list.
+    Multi-host: ``src`` publishes one per-rank key; each rank waits only
+    for ITS key under the typed fault layer (a missing contribution is
+    attributed to ``src``). Single-controller worlds keep the identity
+    semantics unchanged."""
+    _note_eager("scatter_object_list")
+    client = _coord_client()
+    n = _group_size(group)
+    _reject_multihost_subgroup("scatter_object_list", n, client)
+    with _lat("scatter_object_list"):
+        if client is not None and n > 1 and n == env.get_world_size():
+            if tag is None:
+                tag = _SC_SEQ[0]
+                _SC_SEQ[0] += 1
+            me = env.get_rank()
+            if me == src:
+                E.enforce(in_object_list is not None
+                          and len(in_object_list) >= n,
+                          "scatter_object_list src needs one object per "
+                          f"rank (world {n})", E.InvalidArgumentError)
+                for r in range(n):
+                    if r == me:
+                        continue   # src takes its piece locally — an
+                        #            unread key would just leak
+                    client.key_value_set(
+                        f"sc_{tag}_{r}",
+                        pickle.dumps(in_object_list[r]).hex())
+                out_object_list.clear()
+                out_object_list.append(in_object_list[me])
+            else:
+                got = _wait_for_keys(
+                    client, op="scatter_object_list", tag=tag,
+                    want={src: f"sc_{tag}_{me}"}, world=n, me=me,
+                    timeout_s=timeout_s)
+                out_object_list.clear()
+                out_object_list.append(pickle.loads(
+                    bytes.fromhex(got[src])))
+            return
+        me = (group or _get_default_group()).rank
+        me = max(me, 0)
+        out_object_list.clear()
+        if in_object_list:
+            out_object_list.append(in_object_list[me])
 
 
 def gather(tensor: Tensor, gather_list=None, dst: int = 0, group=None,
@@ -367,14 +802,32 @@ def irecv(tensor: Tensor, src: int = 0, group=None):
     return recv(tensor, src, group, sync_op=False)
 
 
-def barrier(group=None):
+# barriers pair by program order like the other untagged exchanges
+_BAR_SEQ = [0]
+
+
+def barrier(group=None, tag=None, timeout_s=None):
     """Host barrier over the coordination service (reference: TCPStore
-    barrier / ProcessGroup barrier)."""
+    barrier / ProcessGroup barrier). Implemented as a per-rank key
+    exchange under the typed fault layer (instead of the opaque
+    ``wait_at_barrier(..., 60_000)``), so a barrier stranded by a dead
+    peer raises PeerLostError/CollectiveTimeout NAMING the absent
+    rank(s) — and honors the tombstone fast path."""
     _note_eager("barrier")
     client = _coord_client()
     with _lat("barrier"):
         if client is not None and env.get_world_size() > 1:
-            client.wait_at_barrier("pt_barrier", 60_000)
+            if tag is None:
+                tag = _BAR_SEQ[0]
+                _BAR_SEQ[0] += 1
+                _reclaim_untagged(client, _BAR_SPENT, tag)
+                _BAR_SPENT.append((tag, f"bar_{tag}_{env.get_rank()}"))
+            n = env.get_world_size()
+            me = env.get_rank()
+            client.key_value_set(f"bar_{tag}_{me}", "1")
+            _wait_for_keys(client, op="barrier", tag=tag,
+                           want={r: f"bar_{tag}_{r}" for r in range(n)},
+                           world=n, me=me, timeout_s=timeout_s)
         else:
             (jnp.zeros(()) + 0).block_until_ready()
 

@@ -53,9 +53,19 @@ class ElasticManager:
         self.restarts = 0
         self.events = []
         nproc = nproc_per_node
+        base_env = dict(launch_kwargs.pop("extra_env", None) or {})
+        run_idx = 0
         while True:
+            # export the world incarnation (like run_adaptive): the
+            # death/abort markers are generation-keyed, so each
+            # relaunch must advance the generation or a marker from the
+            # previous incarnation (same shared heartbeat dir) would
+            # instantly kill the new world
+            env = dict(base_env, PADDLE_ELASTIC_RUN=str(run_idx))
+            run_idx += 1
             rc = self._launch(script, script_args,
-                              nproc_per_node=nproc, **launch_kwargs)
+                              nproc_per_node=nproc, extra_env=env,
+                              **launch_kwargs)
             if rc == 0:
                 self._record(ElasticStatus.COMPLETED, {"nproc": nproc})
                 return 0
@@ -65,14 +75,24 @@ class ElasticManager:
                               "reason": "restart budget exhausted"})
                 return rc
             self.restarts += 1
+            # Typed coordinated abort (collective.coordinated_abort):
+            # PEER_FAILURE_RC means an INNOCENT rank exited on a typed
+            # CollectiveTimeout/PeerLostError because a PEER died —
+            # restart the world, but never feed the scale-in heuristic
+            # off the innocent rank's rc (the exiting worker is not the
+            # sick one).
+            peer_failure = rc == PEER_FAILURE_RC
             # scale-in after half the budget is burned (reference scale-in
             # when a peer stays unhealthy)
-            if (self.min_nproc is not None and nproc > self.min_nproc
+            if (not peer_failure and self.min_nproc is not None
+                    and nproc > self.min_nproc
                     and self.restarts > self.max_restarts // 2):
                 nproc = max(self.min_nproc, nproc - 1)
             self._record(ElasticStatus.RESTART,
                          {"nproc": nproc, "rc": rc,
-                          "attempt": self.restarts})
+                          "attempt": self.restarts,
+                          "reason": "peer-failure" if peer_failure
+                          else "worker-failure"})
             time.sleep(self.restart_delay)
 
 
@@ -90,7 +110,8 @@ def run_elastic(script: str, script_args: Sequence[str] = (),
 # -- membership, fleet/elastic/manager.py:124: the np=min:max band plus
 # -- _match()-triggered world rebuilds) --------------------------------------
 
-from ..launch.main import RESCALE_RC  # one protocol constant, one home
+from ..launch.main import PEER_FAILURE_RC, RESCALE_RC  # one home for the
+#                                                       # protocol rcs
 
 
 class AdaptiveElasticManager(ElasticManager):
@@ -248,10 +269,21 @@ class AdaptiveElasticManager(ElasticManager):
                                   "reason": "restart budget exhausted"})
                     return rc
                 self.restarts += 1
-                self._down_times.append(time.time())
+                if rc != PEER_FAILURE_RC:
+                    self._down_times.append(time.time())
+                else:
+                    # coordinated abort: the FIRST observed exit was an
+                    # INNOCENT rank's typed collective fault — marking
+                    # a slot down off its rc would permanently shrink
+                    # the next world (no up-file will ever re-admit a
+                    # worker that was never sick); restart full-size
+                    pass
                 self._record(ElasticStatus.RESTART,
                              {"nproc": np_now, "rc": rc,
-                              "attempt": self.restarts})
+                              "attempt": self.restarts,
+                              "reason": "peer-failure"
+                              if rc == PEER_FAILURE_RC
+                              else "worker-failure"})
                 time.sleep(self.restart_delay)
         finally:
             # the control tempdir (rescale flag) must not leak

@@ -36,7 +36,10 @@ from typing import Dict, Optional
 
 _AUTO_SUFFIX = ".alive"
 _PROGRESS_SUFFIX = ".progress"
+_DEAD_SUFFIX = ".dead"
 _KV_PREFIX = "paddle_hb"
+_DEAD_KV_PREFIX = "pt_dead"
+_ABORT_KV_PREFIX = "pt_abort"
 _state = {"thread": None, "stop": None, "dir": None, "rank": None,
           "seq": 0}
 
@@ -320,3 +323,235 @@ def start_kv_relay(dir_path: str, world_ranks, interval: float = 1.0,
 
     threading.Thread(target=loop, daemon=True).start()
     return stop
+
+
+# -- dead-peer tombstones + coordinated-abort markers ------------------------
+#
+# The fast path of the typed collective fault layer (collective.py): a
+# rank blocked in a KV wait polls these each backoff step, so a peer the
+# launcher already reaped — or one that aborted on its own typed fault —
+# fails the survivors in ~one poll interval instead of the full
+# PADDLE_TPU_COLL_TIMEOUT_S deadline. Two transports, same as the beats:
+# per-rank FILES in the heartbeat dir (written by the launch controller,
+# which has no coordination client) and KV keys (written by workers,
+# visible without a shared filesystem). Markers are GENERATION-keyed by
+# the elastic run index (PR 2's reclamation discipline): a marker from
+# world incarnation g-1 must never kill incarnation g after a restart,
+# and writers best-effort delete their stale-generation KV keys.
+
+def elastic_generation() -> int:
+    """The elastic world incarnation markers are keyed by (0 = first;
+    AdaptiveElasticManager exports PADDLE_ELASTIC_RUN per relaunch)."""
+    try:
+        return int(os.environ.get("PADDLE_ELASTIC_RUN", "0"))
+    except ValueError:
+        return 0
+
+
+def _marker_dir(dir_path: Optional[str]) -> Optional[str]:
+    return dir_path or os.environ.get("PADDLE_HEARTBEAT_DIR")
+
+
+def _kv_try(client, key: str, probe_ms: int = 50):
+    """Short KV probe (also collective.py's wait-loop poll):
+    ``key_value_try_get`` when the client has one (fakes, newer
+    jaxlib), else a ``probe_ms`` blocking get — jaxlib <= 0.4.x has no
+    non-blocking read. Raises when absent."""
+    try_get = getattr(client, "key_value_try_get", None)
+    if try_get is not None:
+        return try_get(key)
+    return client.blocking_key_value_get(key, probe_ms)
+
+
+def _job_identity(job: Optional[str]) -> Optional[str]:
+    """Markers are scoped to one JOB: its rendezvous address (every
+    launch picks a fresh free port by default, so two successive jobs
+    reusing a log_dir — same generation 0 — can never honor each
+    other's markers, while multi-node controllers of ONE job share the
+    master and therefore the markers)."""
+    return job or os.environ.get("PADDLE_MASTER")
+
+
+def _job_matches(payload: dict) -> bool:
+    """A marker counts only for the job that wrote it; markers or
+    readers without a job identity (direct API use, tests) match
+    everything."""
+    mine = os.environ.get("PADDLE_MASTER")
+    theirs = payload.get("job")
+    return theirs is None or mine is None or theirs == mine
+
+
+def mark_dead(rank: int, reason: str, *, dir_path: Optional[str] = None,
+              client=None, generation: Optional[int] = None,
+              job: Optional[str] = None):
+    """Write rank ``rank``'s death marker (file + KV, whichever
+    transports are reachable). Idempotent; never raises."""
+    gen = elastic_generation() if generation is None else int(generation)
+    payload = {"rank": int(rank), "reason": str(reason), "gen": gen,
+               "job": _job_identity(job), "t": time.time()}
+    d = _marker_dir(dir_path)
+    if d:
+        try:
+            os.makedirs(d, exist_ok=True)
+            _touch(os.path.join(d, f"rank{rank}.g{gen}{_DEAD_SUFFIX}"),
+                   payload)
+        except OSError:
+            pass
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        try:
+            client.key_value_set(
+                f"{_DEAD_KV_PREFIX}/g{gen}/rank{rank}",
+                json.dumps(payload), allow_overwrite=True)
+        except Exception:
+            pass
+
+
+def dead_ranks(ranks, *, dir_path: Optional[str] = None, client=None,
+               generation: Optional[int] = None) -> Dict[int, str]:
+    """{rank: reason} for every rank in ``ranks`` with a death marker of
+    THIS generation on either transport."""
+    gen = elastic_generation() if generation is None else int(generation)
+    d = _marker_dir(dir_path)
+    client = client if client is not None else _kv_client()
+    out: Dict[int, str] = {}
+    for rank in ranks:
+        payload = None
+        if d:
+            try:
+                with open(os.path.join(
+                        d, f"rank{rank}.g{gen}{_DEAD_SUFFIX}")) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                payload = None
+        if payload is None and client is not None:
+            try:
+                # presence check only — never WAIT for a marker to
+                # appear (the caller polls); 10ms bounds per-rank cost
+                # on clients whose only read is a blocking get
+                payload = json.loads(_kv_try(
+                    client, f"{_DEAD_KV_PREFIX}/g{gen}/rank{rank}",
+                    probe_ms=10))
+            except Exception:
+                payload = None
+        if payload is not None and _job_matches(payload):
+            out[int(rank)] = str(payload.get("reason", "dead"))
+    return out
+
+
+def write_abort_marker(rank: int, payload: dict, *,
+                       dir_path: Optional[str] = None, client=None,
+                       generation: Optional[int] = None,
+                       job: Optional[str] = None):
+    """Publish the coordinated-abort marker: the failing rank announces
+    its typed collective fault so every surviving peer's wait loop fails
+    fast instead of waiting out its own deadline. One marker per
+    generation (last writer wins — any marker means the world is going
+    down). Best-effort reclamation: the previous generation's KV marker
+    is deleted. Never raises."""
+    gen = elastic_generation() if generation is None else int(generation)
+    payload = dict(payload, rank=int(rank), gen=gen,
+                   job=_job_identity(job), t=time.time())
+    d = _marker_dir(dir_path)
+    if d:
+        try:
+            os.makedirs(d, exist_ok=True)
+            _touch(os.path.join(d, f"abort.g{gen}.json"), payload)
+        except OSError:
+            pass
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        try:
+            client.key_value_set(f"{_ABORT_KV_PREFIX}/g{gen}",
+                                 json.dumps(payload),
+                                 allow_overwrite=True)
+        except Exception:
+            pass
+        if gen > 0:
+            try:
+                client.key_value_delete(
+                    f"{_ABORT_KV_PREFIX}/g{gen - 1}")
+            except Exception:
+                pass
+
+
+def read_abort_marker(*, dir_path: Optional[str] = None, client=None,
+                      generation: Optional[int] = None) -> Optional[dict]:
+    """This generation's abort marker payload, or None."""
+    gen = elastic_generation() if generation is None else int(generation)
+    d = _marker_dir(dir_path)
+    if d:
+        try:
+            with open(os.path.join(d, f"abort.g{gen}.json")) as f:
+                payload = json.load(f)
+            if _job_matches(payload):
+                return payload
+        except (OSError, ValueError):
+            pass
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        try:
+            payload = json.loads(_kv_try(client,
+                                         f"{_ABORT_KV_PREFIX}/g{gen}",
+                                         probe_ms=10))
+            if _job_matches(payload):
+                return payload
+        except Exception:
+            pass
+    return None
+
+
+_MARKER_GEN_RE = None
+
+
+def clear_run_markers(dir_path: str, generation: Optional[int] = None,
+                      own_ranks=()):
+    """Launcher start-of-run hygiene over a shared heartbeat dir. Drops
+    marker FILES that are provably stale from THIS controller's view:
+
+    - every marker of a generation OLDER than ``generation`` (elastic
+      manager paths export a fresh PADDLE_ELASTIC_RUN per relaunch);
+    - current-generation markers for ``own_ranks`` — this node's
+      workers haven't spawned yet, so any marker for them predates
+      this job (a re-run with a pinned --master reusing a log_dir);
+    - current-generation ABORT markers — one present at launcher start
+      cannot have been written by this not-yet-started incarnation
+      (worst case it was a cross-node peer's live abort: the peer's
+      own controller still fails that job; only the fast path is lost).
+
+    Other nodes' current-generation rank tombstones are PRESERVED — a
+    later-starting controller of a multi-node job must not delete a
+    peer node's live markers. Residual limitation (documented in
+    docs/fault_tolerance.md): a multi-node run with a pinned master
+    reusing a log_dir should clean ``heartbeats/`` between jobs.
+    Markers with no parseable generation are legacy debris and are
+    dropped. KV markers need no sweep — every launch rendezvouses a
+    fresh coordination service."""
+    import re
+    global _MARKER_GEN_RE
+    if _MARKER_GEN_RE is None:
+        _MARKER_GEN_RE = re.compile(
+            r"(?:^abort\.g(\d+)\.json$|\.g(\d+)" +
+            re.escape(_DEAD_SUFFIX) + r"$)")
+    gen = elastic_generation() if generation is None else int(generation)
+    own = {int(r) for r in own_ranks}
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return
+    for name in names:
+        if not (name.endswith(_DEAD_SUFFIX) or name.startswith("abort.g")):
+            continue
+        m = _MARKER_GEN_RE.search(name)
+        marker_gen = int(m.group(1) or m.group(2)) if m else None
+        if marker_gen is not None and marker_gen >= gen:
+            if name.startswith("abort.g"):
+                pass                 # pre-start abort: provably stale
+            else:
+                rm = re.match(r"^rank(\d+)\.", name)
+                if rm is None or int(rm.group(1)) not in own:
+                    continue         # a peer node may own it — keep
+        try:
+            os.remove(os.path.join(dir_path, name))
+        except OSError:
+            pass

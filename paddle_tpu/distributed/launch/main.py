@@ -45,6 +45,10 @@ def _parse(argv):
     p.add_argument("--progress_timeout", type=float, default=0.0,
                    help="seconds without a training-progress beat before "
                         "an opted-in worker is declared wedged (0 = off)")
+    p.add_argument("--peer_grace", type=float, default=None,
+                   help="seconds survivors get to observe a dead peer's "
+                        "tombstone and abort typed before the SIGTERM "
+                        "sweep (default 4, env PADDLE_TPU_PEER_GRACE_S)")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
@@ -71,12 +75,25 @@ def _kill_all(procs, alive):
 
 
 RESCALE_RC = 125   # controlled stop for an elastic re-scale (not a failure)
+# Coordinated abort (collective.coordinated_abort): an INNOCENT rank
+# exiting on a typed PeerLostError — a peer is CONFIRMED dead (marker).
+# The elastic manager maps this to "peer failure — restart the world"
+# and never treats the exiting rank as the sick one (no scale-in off
+# its rc).
+PEER_FAILURE_RC = 123
+# Coordinated abort on a CollectiveTimeout: a contribution is MISSING
+# but nothing confirmed the peer dead — it may be wedged-but-alive (a
+# deterministic wedge would otherwise restart at full size forever),
+# so this rc deliberately engages the manager's ordinary
+# worker-failure path, scale-in heuristic included.
+COLLECTIVE_TIMEOUT_RC = 122
 
 
 def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
            master=None, log_dir=None, job_id="default",
            extra_env=None, heartbeat_timeout: float = 0.0,
-           progress_timeout: float = 0.0, control_dir=None) -> int:
+           progress_timeout: float = 0.0, control_dir=None,
+           peer_grace: float = None) -> int:
     """Spawn ``nproc_per_node`` worker processes with rendezvous env and
     watch them (reference: CollectiveController.run). Returns the exit
     code: 0 iff every worker exited 0; on any failure the remaining
@@ -88,18 +105,47 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
     training-progress beat goes stale after it opted in — is declared
     WEDGED and the job is killed (rc=124) so the elastic manager can
     restart it. This is the reference's etcd-heartbeat membership signal
-    (fleet/elastic/manager.py:124) over the launcher's filesystem."""
+    (fleet/elastic/manager.py:124) over the launcher's filesystem.
+
+    Dead-peer tombstones (typed collective fault layer): every worker
+    exit — crash or clean — writes a generation-keyed death marker into
+    the heartbeat dir, which survivors' KV wait loops poll; a rank
+    blocked in a collective on a dead peer raises ``PeerLostError``
+    naming it within ~one poll interval. On the first worker failure
+    the controller gives survivors a short ``peer_grace`` window
+    (default 4s; env ``PADDLE_TPU_PEER_GRACE_S``) to observe the marker
+    and exit with their typed error in their own logs before the
+    SIGTERM sweep."""
     if master is None:
         master = f"127.0.0.1:{_free_port()}"
     host, port = master.rsplit(":", 1)
     world = nnodes * nproc_per_node
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-    hb_dir = None
-    if heartbeat_timeout > 0 or progress_timeout > 0:
-        import tempfile
-        hb_dir = os.path.join(log_dir, "heartbeats") if log_dir             else tempfile.mkdtemp(prefix="paddle_hb_")
-        os.makedirs(hb_dir, exist_ok=True)
+    import tempfile
+    # the heartbeat dir now always exists: it also carries the death
+    # markers the typed collective fault layer polls (the staleness
+    # WATCHER below still only runs when a timeout is configured)
+    hb_tmp = None
+    if log_dir:
+        hb_dir = os.path.join(log_dir, "heartbeats")
+    else:
+        hb_dir = hb_tmp = tempfile.mkdtemp(prefix="paddle_hb_")
+    os.makedirs(hb_dir, exist_ok=True)
+    if peer_grace is None:
+        try:
+            peer_grace = float(
+                os.environ.get("PADDLE_TPU_PEER_GRACE_S", "") or 4.0)
+        except ValueError:
+            peer_grace = 4.0
+    # marker generation: elastic relaunches share a heartbeat dir, so
+    # markers are keyed by the run index the manager exports to workers
+    try:
+        death_gen = int((extra_env or {}).get(
+            "PADDLE_ELASTIC_RUN",
+            os.environ.get("PADDLE_ELASTIC_RUN", "0")) or 0)
+    except ValueError:
+        death_gen = 0
 
     procs = []
     logs = []
@@ -134,9 +180,25 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
     job_start = time.time()
     try:
         from .. import heartbeat as _hb
+        # a relaunch into the same log_dir must not inherit stale
+        # markers: older generations, this node's own ranks, and
+        # pre-start abort markers are swept; other nodes' live
+        # same-generation tombstones survive
+        _hb.clear_run_markers(
+            hb_dir, generation=death_gen,
+            own_ranks=[node_rank * nproc_per_node + l
+                       for l in range(nproc_per_node)])
         alive = set(range(len(procs)))
         rescale_flag = os.path.join(control_dir, "rescale") \
             if control_dir else None
+
+        def _tombstone(local, r):
+            # job-scoped (master addr): a later job reusing this
+            # log_dir at the same generation must never honor these
+            _hb.mark_dead(node_rank * nproc_per_node + local,
+                          f"worker exited rc={r}", dir_path=hb_dir,
+                          generation=death_gen, job=master)
+
         while alive:
             time.sleep(0.2)
             # poll exits BEFORE honoring a rescale flag: a world whose
@@ -147,13 +209,33 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                 if r is None:
                     continue
                 alive.discard(i)
+                # death marker on EVERY exit: a rank that left — even
+                # cleanly — can never contribute to a survivor's pending
+                # collective, so survivors should fail fast and typed
+                # instead of waiting out the deadline
+                _tombstone(i, r)
                 if r != 0:
                     # fail fast: one dead worker kills the job
-                    # (reference: watcher peer-failure propagation).
-                    # Break immediately: continuing over the pre-kill
-                    # snapshot would poll the peers _kill_all just
-                    # SIGTERMed and overwrite rc with their -15
+                    # (reference: watcher peer-failure propagation) —
+                    # but first give survivors a grace window to observe
+                    # the tombstone and exit with their typed
+                    # PeerLostError in their own logs. rc stays the
+                    # PRIMARY failure's; secondary exits during the
+                    # grace are reaped and tombstoned only.
                     rc = r
+                    print(f"[launch] rank "
+                          f"{node_rank * nproc_per_node + i} failed "
+                          f"(rc={r}); tombstoned, giving peers "
+                          f"{peer_grace:.1f}s to abort typed",
+                          file=sys.stderr)
+                    deadline = time.time() + max(peer_grace, 0.0)
+                    while alive and time.time() < deadline:
+                        for j in list(alive):
+                            rj = procs[j].poll()
+                            if rj is not None:
+                                alive.discard(j)
+                                _tombstone(j, rj)
+                        time.sleep(0.05)
                     _kill_all(procs, alive)
                     break
             if not alive:
@@ -167,7 +249,7 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                 rc = RESCALE_RC
                 _kill_all(procs, alive)
                 break
-            if hb_dir:
+            if hb_dir and (heartbeat_timeout > 0 or progress_timeout > 0):
                 my_ranks = [node_rank * nproc_per_node + l
                             for l in range(nproc_per_node)]
                 stale = _hb.check_stale(
@@ -183,6 +265,12 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
                         print(f"[launch] rank {r} wedged: {why}; "
                               "killing job for elastic restart",
                               file=sys.stderr)
+                        # tombstone the wedged rank too: peers of a
+                        # multi-NODE job (other controllers' workers)
+                        # see the marker through shared storage
+                        _hb.mark_dead(node_rank * nproc_per_node + r,
+                                      f"wedged: {why}", dir_path=hb_dir,
+                                      generation=death_gen, job=master)
                     rc = 124
                     _kill_all(procs, alive)
                     break
@@ -194,6 +282,13 @@ def launch(script, script_args=(), nproc_per_node=1, nnodes=1, node_rank=0,
         for log in logs:
             if log:
                 log.close()
+        if hb_tmp is not None:
+            # launcher-owned temp heartbeat dir: every worker is dead by
+            # now, so the beats/markers have no remaining reader — an
+            # elastic manager churning restarts must not leak one temp
+            # dir per attempt
+            import shutil
+            shutil.rmtree(hb_tmp, ignore_errors=True)
     return rc
 
 
@@ -205,7 +300,8 @@ def main(argv=None):
                 master=args.master, log_dir=args.log_dir,
                 job_id=args.job_id,
                 heartbeat_timeout=args.heartbeat_timeout,
-                progress_timeout=args.progress_timeout)
+                progress_timeout=args.progress_timeout,
+                peer_grace=args.peer_grace)
     sys.exit(rc)
 
 

@@ -170,12 +170,21 @@ class FaultTolerantCheckpoint(Callback):
     optimizer's accumulators are pre-created so a freshly-built
     optimizer can receive them. The epoch/step loop itself restarts at
     0; ``restored_step`` records what was loaded.
+
+    Exactly-once data resume: ``Model.fit`` registers its train
+    DataLoader here (``register_dataloader``); its
+    {seed, epoch, batch-cursor, collator-carry} state then rides every
+    checkpoint under ``data`` and is restored on resume, so the
+    restarted fit's first epoch continues the interrupted epoch from
+    the exact batch boundary — no sample replayed, none skipped
+    (``include_dataloader=False`` opts out).
     """
 
     def __init__(self, save_dir: str, keep_last_n: int = 3,
                  save_interval_steps: int = 100, async_save: bool = True,
                  resume: bool = True, preemption_hook: bool = True,
-                 include_optimizer: bool = True):
+                 include_optimizer: bool = True,
+                 include_dataloader: bool = True):
         super().__init__()
         self.save_dir = save_dir
         self.keep_last_n = keep_last_n
@@ -184,10 +193,19 @@ class FaultTolerantCheckpoint(Callback):
         self.resume = resume
         self.preemption_hook = preemption_hook
         self.include_optimizer = include_optimizer
+        self.include_dataloader = include_dataloader
         self.manager = None
         self.restored_step = None
         self._gstep = 0
         self._last_saved = 0
+        self._loader = None
+
+    def register_dataloader(self, loader):
+        """Called by ``Model.fit`` with the train loader; accepted only
+        when it carries the resume-state protocol."""
+        if self.include_dataloader and hasattr(loader, "state_dict") \
+                and hasattr(loader, "set_state_dict"):
+            self._loader = loader
 
     def _state(self):
         state = {"model": dict(self.model.network.state_dict())}
@@ -196,7 +214,37 @@ class FaultTolerantCheckpoint(Callback):
             opt_sd = getattr(opt, "state_dict", lambda: {})() if opt else {}
             if opt_sd:
                 state["opt"] = dict(opt_sd)
+        if self._loader is not None:
+            state["data"] = dict(self._loader.state_dict())
         return state
+
+    def _state_provider(self):
+        """Offer-time provider for the per-batch save: model/optimizer
+        stay LAZY (interval-skipped batches pay nothing) but the
+        loader cursor is snapshotted NOW — a SIGTERM emergency save
+        materializes the provider mid-NEXT-batch, when the live cursor
+        is one ahead of this batch's step; a deferred read would make
+        the resumed loader silently skip that batch."""
+        data_fn = None
+        if self._loader is not None:
+            if hasattr(self._loader, "state_provider"):
+                data_fn = self._loader.state_provider()      # O(1) pin
+            else:
+                snap = dict(self._loader.state_dict())
+                data_fn = lambda: snap                       # noqa: E731
+
+        def provide():
+            state = {"model": dict(self.model.network.state_dict())}
+            if self.include_optimizer:
+                opt = getattr(self.model, "_optimizer", None)
+                opt_sd = getattr(opt, "state_dict", lambda: {})() \
+                    if opt else {}
+                if opt_sd:
+                    state["opt"] = dict(opt_sd)
+            if data_fn is not None:
+                state["data"] = dict(data_fn())
+            return state
+        return provide
 
     def on_train_begin(self, logs=None):
         from ..distributed.checkpoint import CheckpointManager
@@ -240,17 +288,22 @@ class FaultTolerantCheckpoint(Callback):
                 if "opt" in state and opt is not None \
                         and hasattr(opt, "set_state_dict"):
                     opt.set_state_dict(state["opt"])
+                if "data" in state and self._loader is not None:
+                    # re-seat the train loader at the restored step's
+                    # batch boundary (exactly-once across the restart)
+                    self._loader.set_state_dict(state["data"])
         if self.preemption_hook:
             self.manager.install_preemption_hook()
 
     def on_train_batch_end(self, step, logs=None):
         self._gstep += 1
         if self.manager is not None:
-            # pass the provider, not the state: the manager materializes
+            # pass a provider, not the state: the manager materializes
             # it only when the interval policy actually saves (or in a
             # SIGTERM emergency save), so interval-skipped batches don't
-            # pay a full state-dict + optimizer traversal
-            if self.manager.save(self._gstep, self._state):
+            # pay a full state-dict + optimizer traversal — but the
+            # loader cursor inside it is pinned to THIS batch
+            if self.manager.save(self._gstep, self._state_provider()):
                 self._last_saved = self._gstep
 
     def on_train_end(self, logs=None):

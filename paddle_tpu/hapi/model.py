@@ -143,6 +143,13 @@ class Model:
                                 save_freq=save_freq, save_dir=save_dir,
                                 verbose=verbose, metrics=self._metric_names())
         self.stop_training = False
+        # hand the train loader to resume-aware callbacks BEFORE
+        # on_begin: FaultTolerantCheckpoint checkpoints its
+        # {epoch, cursor, collator} state and re-seats it on restore,
+        # making fit resume exactly-once at the batch level
+        for cb in cbks:
+            if hasattr(cb, "register_dataloader"):
+                cb.register_dataloader(loader)
         cbks.on_begin("train")
         it = 0
         # Step-timeline accounting (monitor/steptimer.py): data-wait vs

@@ -15,6 +15,19 @@ background and converts to device arrays at yield time. Two worker modes:
 
 num_workers=0 is fully synchronous (debug mode, like the reference's
 single-process mode).
+
+Determinism + exactly-once resume: the loader owns a seed root
+(``seed=`` at construction; when omitted, drawn ONCE from the framework
+generator so ``paddle.seed`` keeps controlling shuffle order — never
+re-drawn inside ``__iter__``), and every per-epoch stream — shuffle
+permutation, subprocess worker seeds, the native feeder — derives from
+``(seed, epoch)``. ``state_dict()/set_state_dict()`` capture/restore
+{seed, epoch, intra-epoch batch cursor, stateful-collator state}; a
+restored loader fast-forwards to the exact batch boundary WITHOUT
+touching the dataset (sampler indices are consumed, samples are not),
+so an elastic restart replays no sample and skips none. Each yielded
+batch passes the ``dataloader.batch`` fault value point
+(``testing/faults.py``) — chaos runs kill/poison the stream there.
 """
 from __future__ import annotations
 
@@ -31,6 +44,7 @@ import numpy as np
 from .. import monitor as _monitor
 from ..core import enforce as E
 from ..core.tensor import Tensor, to_tensor
+from ..testing import faults as _faults
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -205,7 +219,8 @@ class _ProcessPrefetcher:
     queue + reorder logic in dataloader_iter.py)."""
 
     def __init__(self, dataset, batches, num_workers, prefetch_factor,
-                 worker_init_fn, collate_fn=None, timeout=0):
+                 worker_init_fn, collate_fn=None, timeout=0,
+                 base_seed=0):
         self._dataset = dataset
         self._batches = batches
         self._n = num_workers
@@ -215,6 +230,11 @@ class _ProcessPrefetcher:
         # (a user fn may build Tensors — jax must stay out of the workers)
         self._collate = collate_fn
         self._timeout = timeout or None
+        # per-epoch worker base seed, derived by the DataLoader from its
+        # owned (seed, epoch) root — never from ambient np.random, so
+        # two identically-seeded loaders give identical worker seeds
+        # regardless of interleaved global-RNG use
+        self._base_seed = int(base_seed)
 
     def __iter__(self):
         ctx = mp.get_context(
@@ -222,11 +242,7 @@ class _ProcessPrefetcher:
         index_q = ctx.Queue()
         result_q = ctx.Queue()
         ship_raw = self._collate is not None
-        # Fresh base seed per epoch (each __iter__ call) so worker RNG
-        # streams differ across epochs — drawn from the parent's numpy
-        # stream so np.random.seed()/paddle.seed() keeps whole runs
-        # reproducible (os.urandom would not be).
-        base_seed = int(np.random.randint(0, 2**31 - 1))
+        base_seed = self._base_seed
         workers = [ctx.Process(
             target=_process_worker_loop,
             args=(self._dataset, index_q, result_q, self._init_fn, w,
@@ -303,7 +319,8 @@ class DataLoader:
                  num_workers: int = 0, use_buffer_reader: bool = True,
                  prefetch_factor: int = 2, use_shared_memory: bool = True,
                  timeout: int = 0, worker_init_fn=None,
-                 persistent_workers=False, worker_mode: str = "thread"):
+                 persistent_workers=False, worker_mode: str = "thread",
+                 seed: Optional[int] = None):
         E.enforce(worker_mode in ("thread", "process", "native"),
                   "worker_mode must be 'thread', 'process', or 'native'",
                   E.InvalidArgumentError)
@@ -318,6 +335,17 @@ class DataLoader:
         self._shuffle = bool(shuffle)
         self._drop_last = bool(drop_last)
         self._user_batch_sampler = batch_sampler is not None
+        # loader-owned seed root: every per-epoch stream (shuffle,
+        # worker seeds, native feeder) derives from (seed, epoch).
+        # None = drawn lazily ONCE from the framework generator (so
+        # paddle.seed before first use keeps whole runs reproducible,
+        # as RandomSampler always behaved) — never re-drawn inside
+        # __iter__.
+        self._seed = None if seed is None else int(seed) & 0xFFFFFFFF
+        self._epoch = -1          # epoch currently/last iterated
+        self._cursor = 0          # batches yielded this epoch
+        self._resume_epoch = None  # set_state_dict target epoch
+        self._resume_skip = 0      # batches to fast-forward past
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -336,11 +364,16 @@ class DataLoader:
                     drop_last=drop_last)
                 self.batch_size = batch_size
 
-    def _raw_iter(self):
+    def _raw_iter(self, skip: int = 0):
+        """The synchronous batch source. ``skip`` fast-forwards past the
+        first N batches WITHOUT building them: map-style skips consume
+        sampler indices only (no dataset access, no collate); iterable
+        datasets must draw the samples (the iterator owns the position)
+        but still skip the collate."""
         if self._iterable_mode:
             it = iter(self.dataset)
             if self.batch_size is None:
-                for sample in it:
+                for sample in itertools.islice(it, skip, None):
                     yield sample
                 return
             while True:
@@ -349,19 +382,158 @@ class DataLoader:
                     return
                 if len(batch) < self.batch_size and self.drop_last:
                     return
+                if skip > 0:
+                    skip -= 1
+                    continue
                 yield self.collate_fn(batch)
         elif self.batch_sampler is None:
-            for i in range(len(self.dataset)):
+            for i in range(skip, len(self.dataset)):
                 yield self.dataset[i]
         else:
             for batch_idx in self.batch_sampler:
+                if skip > 0:
+                    skip -= 1
+                    continue
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
+    # -- loader-owned determinism + resume state ----------------------------
+
+    def _root_seed(self) -> int:
+        if self._seed is None:
+            # seedless loaders draw their root ONCE from the framework
+            # generator (the RNG RandomSampler always used), so
+            # paddle.seed keeps controlling shuffle order exactly as
+            # before — np.random stays the fallback when the framework
+            # generator is unavailable
+            try:
+                from ..framework import random as frandom
+                gen = frandom.default_generator
+                self._seed = int(np.asarray(gen.next_key(),
+                                            dtype=np.uint32)[-1])
+            except Exception:
+                self._seed = int(np.random.randint(0, 2**31 - 1))
+        return self._seed
+
+    def _epoch_rng(self) -> np.random.Generator:
+        """Fresh Generator for THIS epoch, derived from (seed, epoch) —
+        replayable, so a restored loader reproduces the epoch's shuffle
+        and worker seeds bit-exactly."""
+        return np.random.default_rng(
+            np.random.SeedSequence([self._root_seed(),
+                                    max(self._epoch, 0)]))
+
+    def _epoch_base_seed(self) -> int:
+        return int(self._epoch_rng().integers(0, 2**31 - 1))
+
+    def state_dict(self) -> dict:
+        """Resume state: seed root, epoch index, intra-epoch batch
+        cursor, and a stateful collator's state (PackingCollator's
+        carry-over buffer). JSON-safe — registers directly into
+        CheckpointManager state. With prefetching workers
+        (num_workers>0) a stateful COLLATOR may have run ahead of the
+        consumed cursor; checkpoint stateful-collator loaders with
+        num_workers=0 for exact carry accounting."""
+        if self._resume_epoch is not None:
+            # a restore is pending but __iter__ hasn't run yet (e.g. a
+            # preemption save between resume and the first batch): the
+            # truthful position is the pending target, not the stale
+            # pre-restore counters
+            epoch, cursor = self._resume_epoch, self._resume_skip
+        else:
+            epoch, cursor = self._epoch, self._cursor
+        sd = {"seed": self._root_seed(), "epoch": int(epoch),
+              "cursor": int(cursor)}
+        if hasattr(self.collate_fn, "state_dict"):
+            sd["collate"] = self.collate_fn.state_dict()
+        return sd
+
+    def state_provider(self):
+        """Offer-time pin of the resume state at O(1) cost, for
+        per-batch save providers (SentinelLoop, FaultTolerantCheckpoint):
+        the scalar cursor state is captured NOW; a stateful collator
+        exposing ``state_snapshot``/``render_state`` (PackingCollator)
+        has its carry pinned by REFERENCE and rendered JSON-safe only
+        when the returned callable runs — an interval-skipped save pays
+        nothing. Collators with only ``state_dict`` are captured
+        eagerly (correct, possibly costlier)."""
+        if self._resume_epoch is not None:
+            epoch, cursor = self._resume_epoch, self._resume_skip
+        else:
+            epoch, cursor = self._epoch, self._cursor
+        seed = self._root_seed()
+        collate = self.collate_fn
+        pinned = rendered = None
+        if hasattr(collate, "state_snapshot") and \
+                hasattr(collate, "render_state"):
+            pinned = collate.state_snapshot()
+        elif hasattr(collate, "state_dict"):
+            rendered = collate.state_dict()
+
+        def provide() -> dict:
+            sd = {"seed": int(seed), "epoch": int(epoch),
+                  "cursor": int(cursor)}
+            if pinned is not None:
+                sd["collate"] = collate.render_state(pinned)
+            elif rendered is not None:
+                sd["collate"] = rendered
+            return sd
+        return provide
+
+    def set_state_dict(self, state: dict):
+        """Restore :meth:`state_dict`: the NEXT ``__iter__`` re-enters
+        the captured epoch and fast-forwards to its batch cursor, so
+        every sample index is consumed exactly once across the
+        kill/resume boundary (no replay, no skip)."""
+        self._seed = int(state["seed"]) & 0xFFFFFFFF
+        epoch = int(state.get("epoch", -1))
+        cursor = int(state.get("cursor", 0))
+        if epoch < 0:
+            self._resume_epoch = None
+            self._resume_skip = 0
+            self._epoch = -1
+            self._cursor = 0
+        else:
+            self._resume_epoch = epoch
+            self._resume_skip = cursor
+        if "collate" in state and hasattr(self.collate_fn,
+                                          "set_state_dict"):
+            self.collate_fn.set_state_dict(state["collate"])
+
     def __iter__(self):
-        it = self._make_iter()
+        if self._resume_epoch is not None:
+            self._epoch = self._resume_epoch
+            self._resume_epoch = None
+        else:
+            self._epoch += 1
+            self._resume_skip = 0
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._cursor = skip
+        if skip and _monitor.enabled():
+            _monitor.inc("data.resume.fast_forward_batches", skip,
+                         doc="batches fast-forwarded (indices consumed, "
+                             "samples untouched) by state_dict resume")
+        # re-derive the owned shuffle stream for this epoch (only when
+        # the loader built its own sampler — a user batch_sampler owns
+        # its order)
+        if (self.batch_sampler is not None and not self._user_batch_sampler
+                and self._shuffle
+                and hasattr(self.batch_sampler, "sampler")):
+            self.batch_sampler.sampler.generator = self._epoch_rng()
+        it = self._counted(self._make_iter(skip))
         if _monitor.enabled():
             return self._monitored(it)
         return it
+
+    def _counted(self, it):
+        """Innermost consumer-side wrapper: advances the intra-epoch
+        cursor per YIELDED batch (prefetchers may run ahead; the cursor
+        tracks what the training loop actually consumed) and exposes
+        the ``dataloader.batch`` fault value point."""
+        for batch in it:
+            batch = _faults.corrupt("dataloader.batch", batch)
+            self._cursor += 1
+            yield batch
 
     def _monitored(self, it):
         """Per-batch throughput instrumentation (entered only when the
@@ -398,7 +570,7 @@ class DataLoader:
                     round(n / elapsed, 3),
                     doc="throughput of the most recently finished epoch")
 
-    def _make_iter(self):
+    def _make_iter(self, skip: int = 0):
         if self.worker_mode == "native":
             if self._user_batch_sampler:
                 raise E.InvalidArgumentError(
@@ -406,7 +578,7 @@ class DataLoader:
                     "shuffle and cannot honor a custom batch_sampler",
                     hint="drop batch_sampler (use shuffle=/drop_last=) "
                          "or use worker_mode='thread'/'process'")
-            return self._native_iter()
+            return self._native_iter(skip)
         if self.num_workers > 0 and self.worker_mode == "process":
             if self._iterable_mode or self.batch_sampler is None:
                 raise E.InvalidArgumentError(
@@ -414,28 +586,29 @@ class DataLoader:
                     "with batching (IterableDataset / batch_size=None "
                     "cannot be index-partitioned across workers); use "
                     "worker_mode='thread'")
-            batches = [list(b) for b in self.batch_sampler]
+            batches = [list(b) for b in self.batch_sampler][skip:]
             user_collate = (self.collate_fn
                             if self.collate_fn is not default_collate_fn
                             else None)
-            return iter(_ProcessPrefetcher(self.dataset, batches,
-                                           self.num_workers,
-                                           self.prefetch_factor,
-                                           self.worker_init_fn,
-                                           collate_fn=user_collate,
-                                           timeout=self.timeout))
+            return iter(_ProcessPrefetcher(
+                self.dataset, batches, self.num_workers,
+                self.prefetch_factor, self.worker_init_fn,
+                collate_fn=user_collate, timeout=self.timeout,
+                base_seed=self._epoch_base_seed()))
         if self.num_workers > 0:
-            return iter(_ThreadedPrefetcher(self._raw_iter,
-                                            self.num_workers,
-                                            self.prefetch_factor))
-        return self._raw_iter()
+            return iter(_ThreadedPrefetcher(
+                lambda: self._raw_iter(skip), self.num_workers,
+                self.prefetch_factor))
+        return self._raw_iter(skip)
 
-    def _native_iter(self):
+    def _native_iter(self, skip: int = 0):
         """worker_mode='native': C++ batch assembly (csrc/datafeed.cc)
         for row-aligned array datasets — TensorDataset, or any dataset
         exposing ``numpy_arrays()`` -> tuple of [N, ...] numpy arrays.
         Shuffle/drop_last honored natively; yields Tensor tuples like
-        the default collate."""
+        the default collate. Resume fast-forward drains ``skip``
+        assembled batches (the feeder owns its position — the C++ path
+        cannot skip index-only)."""
         import numpy as np
 
         from .dataset import TensorDataset
@@ -460,17 +633,18 @@ class DataLoader:
                 "cannot run a custom collate_fn",
                 hint="drop collate_fn or use worker_mode="
                      "'thread'/'process'")
-        # fresh seed per epoch (drawn from the parent numpy stream so
-        # paddle.seed/np.random.seed keeps runs reproducible) — every
-        # __iter__ reshuffles like the thread/process paths
-        self._native_epoch = getattr(self, "_native_epoch", -1) + 1
-        seed = int(np.random.randint(0, 2**31 - 1)) + self._native_epoch
+        # per-epoch seed derived from the loader-owned (seed, epoch)
+        # root — every __iter__ reshuffles like the thread/process
+        # paths, and a restored loader replays the same order
         feeder = NativeArrayFeeder(
             arrays, self.batch_size, shuffle=self._shuffle,
-            drop_last=self._drop_last, seed=seed,
+            drop_last=self._drop_last, seed=self._epoch_base_seed(),
             num_threads=max(self.num_workers, 1), epochs=1)
         try:
             for batch in feeder:
+                if skip > 0:
+                    skip -= 1
+                    continue
                 yield tuple(to_tensor(b) for b in batch)
         finally:
             feeder.close()

@@ -53,13 +53,19 @@ def _as_1d_ids(doc) -> np.ndarray:
 
 def pack_documents(docs: Sequence, seq_len: int, *, pad_id: int = 0,
                    ignore_index: int = IGNORE_INDEX,
-                   max_rows: Optional[int] = None) -> dict:
+                   max_rows: Optional[int] = None,
+                   collect_overflow: bool = False):
     """Greedily first-fit ``docs`` (1-D token-id arrays) into packed
     [B, S] rows. Deterministic in arrival order. ``max_rows`` caps the
     batch: a document whose chunk fits no open row once the cap is
-    reached raises (callers size their traces to their row budget).
+    reached raises (callers size their traces to their row budget) —
+    unless ``collect_overflow``, in which case that chunk AND every
+    later one spill to an overflow list (arrival order preserved — a
+    later small chunk must not jump the queue, or sample order would
+    reshuffle across batches) and ``(packed, overflow)`` is returned.
 
-    Returns the dict described in the module docstring."""
+    Returns the dict described in the module docstring (plus the
+    overflow list when ``collect_overflow``)."""
     E.enforce(seq_len >= 2, f"seq_len must be >= 2, got {seq_len}",
               E.InvalidArgumentError)
     chunks = []
@@ -74,7 +80,8 @@ def pack_documents(docs: Sequence, seq_len: int, *, pad_id: int = 0,
 
     rows: list = []          # list of list-of-chunks
     space: list = []         # remaining capacity per row
-    for ch in chunks:
+    overflow: list = []
+    for ci, ch in enumerate(chunks):
         for r, free in enumerate(space):
             if free >= len(ch):
                 rows[r].append(ch)
@@ -82,6 +89,9 @@ def pack_documents(docs: Sequence, seq_len: int, *, pad_id: int = 0,
                 break
         else:
             if max_rows is not None and len(rows) >= max_rows:
+                if collect_overflow:
+                    overflow = chunks[ci:]
+                    break
                 raise E.ResourceExhaustedError(
                     f"pack_documents: a {len(ch)}-token chunk fits none "
                     f"of the {len(rows)} open rows and max_rows="
@@ -89,6 +99,8 @@ def pack_documents(docs: Sequence, seq_len: int, *, pad_id: int = 0,
                     "fewer documents per pack")
             rows.append([ch])
             space.append(seq_len - len(ch))
+    if overflow:
+        chunks = chunks[:len(chunks) - len(overflow)]
 
     b = max(len(rows), 1)
     ids = np.full((b, seq_len), pad_id, np.int32)
@@ -117,8 +129,11 @@ def pack_documents(docs: Sequence, seq_len: int, *, pad_id: int = 0,
         _monitor.inc("packing.rows", b)
         _monitor.inc("packing.tokens.real", real)
         _monitor.inc("packing.tokens.padding", slots - real)
-    return {"ids": ids, "segment_ids": seg, "positions": pos,
-            "labels": labels}
+    packed = {"ids": ids, "segment_ids": seg, "positions": pos,
+              "labels": labels}
+    if collect_overflow:
+        return packed, overflow
+    return packed
 
 
 def packing_efficiency(packed: dict) -> float:
@@ -141,20 +156,77 @@ class PackingCollator:
     samples (numpy arrays / lists / Tensors) packs into one dense
     [B, S] batch per the module contract. Deterministic — the same
     sample list always yields the same batch. Returns numpy arrays
-    (convert with ``packed_train_batch`` for the jitted train step)."""
+    (convert with ``packed_train_batch`` for the jitted train step).
+
+    ``carry_over=True`` (requires ``max_rows``) makes the collator
+    STATEFUL: chunks that don't fit the row budget buffer into a
+    carry-over and lead the NEXT call's pack instead of raising — no
+    token is ever dropped, batches keep a fixed row ceiling. The buffer
+    rides ``state_dict()/set_state_dict()`` (JSON-safe lists), so
+    DataLoader resume restores mid-epoch carry bit-exactly and every
+    token still trains exactly once across a kill/restart."""
 
     def __init__(self, seq_len: int, *, pad_id: int = 0,
                  ignore_index: int = IGNORE_INDEX,
-                 max_rows: Optional[int] = None):
+                 max_rows: Optional[int] = None,
+                 carry_over: bool = False):
+        E.enforce(not carry_over or max_rows,
+                  "PackingCollator carry_over requires max_rows (an "
+                  "unbounded pack never overflows)",
+                  E.InvalidArgumentError)
         self.seq_len = seq_len
         self.pad_id = pad_id
         self.ignore_index = ignore_index
         self.max_rows = max_rows
+        self.carry_over = bool(carry_over)
+        self._carry: list = []
 
     def __call__(self, batch) -> dict:
-        return pack_documents(batch, self.seq_len, pad_id=self.pad_id,
-                              ignore_index=self.ignore_index,
-                              max_rows=self.max_rows)
+        if not self.carry_over:
+            return pack_documents(batch, self.seq_len, pad_id=self.pad_id,
+                                  ignore_index=self.ignore_index,
+                                  max_rows=self.max_rows)
+        docs = list(self._carry) + list(batch)
+        packed, leftover = pack_documents(
+            docs, self.seq_len, pad_id=self.pad_id,
+            ignore_index=self.ignore_index, max_rows=self.max_rows,
+            collect_overflow=True)
+        self._carry = [np.asarray(ch, np.int32) for ch in leftover]
+        return packed
+
+    def flush(self) -> Optional[dict]:
+        """Pack one more batch from the carry-over (end of stream);
+        None once it is empty. A flush can itself overflow ``max_rows``
+        and re-fill the carry, so call REPEATEDLY until None — a single
+        call may leave chunks buffered::
+
+            while (tail := collator.flush()) is not None:
+                consume(tail)
+        """
+        if not self._carry:
+            return None
+        docs, self._carry = self._carry, []
+        return self(docs)
+
+    # JSON-safe (the checkpoint layer stores object leaves as JSON)
+    def state_dict(self) -> dict:
+        return self.render_state(self.state_snapshot())
+
+    def set_state_dict(self, state: dict):
+        self._carry = [np.asarray(c, np.int32).reshape(-1)
+                       for c in state.get("carry", [])]
+
+    # O(1) offer-time pin for per-batch save providers: the carry list
+    # is REBOUND (never mutated in place) by __call__/set_state_dict,
+    # so a shallow copy of the references freezes the state; the
+    # JSON-safe rendering is deferred to actual save time
+    def state_snapshot(self) -> list:
+        return list(self._carry)
+
+    @staticmethod
+    def render_state(snapshot: list) -> dict:
+        return {"carry": [np.asarray(c).ravel().astype(int).tolist()
+                          for c in snapshot]}
 
 
 def heavy_tailed_lengths(seq_len: int, n_docs: int, seed: int = 7):

@@ -506,12 +506,15 @@ def _generate_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
     E.enforce(M >= S + max_new_tokens,
               f"max_len {M} < prompt {S} + max_new_tokens "
               f"{max_new_tokens}")
+    if max_new_tokens == 0:
+        return jnp.zeros((B, 0), jnp.int32)
     cache = init_cache_fn(c, B, M)
     cache, logits = prefill_fn(params, ids, c, cache)
     sample = make_sampler(temperature, top_k=top_k, top_p=top_p)
 
-    def body(carry, k):
-        cache, logits, done = carry
+    def emit(logits, done, k):
+        """One sampling step's token + masked output (shared by the
+        scan body and the final carried-logits sample)."""
         tok = sample(logits, k)
         if eos_token_id is not None:
             out = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32),
@@ -519,12 +522,23 @@ def _generate_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
             done = done | (tok == eos_token_id)
         else:
             out = tok
+        return tok, out, done
+
+    def body(carry, k):
+        cache, logits, done = carry
+        tok, out, done = emit(logits, done, k)
         cache, logits = decode_fn(params, cache, tok, c)
         return (cache, logits, done), out
 
     keys = jax.random.split(
         key if key is not None else jax.random.PRNGKey(0), max_new_tokens)
-    _, toks = lax.scan(body, (cache, logits, jnp.zeros((B,), bool)), keys)
+    # scan only max_new_tokens-1 decode steps: the final token samples
+    # from the carried logits — the last decode's logits were computed
+    # and discarded before (one whole step of wasted decode per call)
+    (cache, logits, done), toks = lax.scan(
+        body, (cache, logits, jnp.zeros((B,), bool)), keys[:-1])
+    _, last, _ = emit(logits, done, keys[-1])
+    toks = jnp.concatenate([toks, last[None]], axis=0)
     return toks.T                                   # [B, max_new_tokens]
 
 
@@ -581,9 +595,14 @@ def _beam_search_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
     # tokens from the prompt distribution
     scores = jnp.tile(jnp.asarray([0.0] + [-jnp.inf] * (K - 1)), (B, 1))
     neg = jnp.asarray(-jnp.inf, jnp.float32)
+    if max_new_tokens == 0:
+        best0 = jnp.argmax(scores, axis=1)
+        return (jnp.zeros((B, 0), jnp.int32),
+                jnp.take_along_axis(scores, best0[:, None], axis=1)[:, 0])
 
-    def step(carry, _):
-        cache, logits, scores, done, lengths = carry
+    def select(logits, scores, done, lengths):
+        """One beam-selection step (pure math over the carried logits);
+        shared by the scan body and the final no-decode step."""
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         logp = logp.reshape(B, K, V)
         # frozen beams: only pad continues, at zero additional score
@@ -592,10 +611,6 @@ def _beam_search_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
         total = scores[:, :, None] + logp               # [B, K, V]
         top, flat = lax.top_k(total.reshape(B, K * V), K)
         beam_idx, tok = flat // V, (flat % V).astype(jnp.int32)  # [B, K]
-        gather_rows = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
-        cache = {"k": jnp.take(cache["k"], gather_rows, axis=1),
-                 "v": jnp.take(cache["v"], gather_rows, axis=1),
-                 "pos": cache["pos"]}
         done = jnp.take_along_axis(done, beam_idx, axis=1)
         lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
         lengths = lengths + (~done).astype(jnp.int32)
@@ -605,14 +620,31 @@ def _beam_search_over(init_cache_fn, prefill_fn, decode_fn, params, ids,
         tok = jnp.where(done, jnp.asarray(pad_token_id, jnp.int32), tok)
         if eos_token_id is not None:
             done = done | ((tok == eos_token_id) & ~done)
+        return top, tok, beam_idx, done, lengths
+
+    def step(carry, _):
+        cache, logits, scores, done, lengths = carry
+        scores, tok, beam_idx, done, lengths = select(
+            logits, scores, done, lengths)
+        gather_rows = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        cache = {"k": jnp.take(cache["k"], gather_rows, axis=1),
+                 "v": jnp.take(cache["v"], gather_rows, axis=1),
+                 "pos": cache["pos"]}
         cache, logits = decode_fn(params, cache, tok.reshape(-1), c)
-        return (cache, logits, top, done, lengths), (tok, beam_idx)
+        return (cache, logits, scores, done, lengths), (tok, beam_idx)
 
     done0 = jnp.zeros((B, K), bool)
     len0 = jnp.zeros((B, K), jnp.int32)
+    # scan only max_new_tokens-1 decode steps; the final selection runs
+    # on the carried logits with no trailing decode (whose logits were
+    # previously computed and thrown away) and no cache reorder
     (cache, logits, scores, done, lengths), (toks, bidx) = lax.scan(
         step, (cache, logits, scores, done0, len0), None,
-        length=max_new_tokens)
+        length=max_new_tokens - 1)
+    scores, tok_f, bidx_f, done, lengths = select(
+        logits, scores, done, lengths)
+    toks = jnp.concatenate([toks, tok_f[None]], axis=0)
+    bidx = jnp.concatenate([bidx, bidx_f[None]], axis=0)
 
     # Reconstruct each surviving beam's token path by walking the
     # recorded (token, parent-beam) choices backwards.

@@ -43,6 +43,13 @@ Known injection points (grep ``faults.hit`` for the live list):
 - ``checkpoint.rename``    before the tmp-dir -> final-dir rename
 - ``checkpoint.commit``    before the COMMIT marker lands
 - ``collective.gather``    inside ``all_gather_object``
+- ``collective.kv_get``    each poll of the typed collective fault
+  layer's deadline loop (``collective._wait_for_keys``) — a ``kill``
+  here murders a rank mid-gather; ``delay`` widens the wait window
+- ``dataloader.batch``     value point: each batch a DataLoader yields
+  to its consumer — ``kill`` at the Nth batch drives the exactly-once
+  resume chaos tests; ``corrupt`` poisons the input pipeline upstream
+  of the train loop
 - ``train.batch``          value point: each batch entering a sentinel
   loop / hapi train step (``faults.corrupt`` — grep ``faults.corrupt``
   for the live list of value points)

@@ -405,14 +405,32 @@ class SentinelLoop:
     (``testing/faults.py``), so chaos runs can poison the stream
     without touching the loop. With a ``manager``, applied steps are
     offered to ``manager.save`` (its interval policy decides), and the
-    ROLLBACK verdict restores + fast-forwards in place."""
+    ROLLBACK verdict restores + fast-forwards in place.
 
-    def __init__(self, step_fn, params, opt_state, make_stream, *,
+    ``dataloader=`` (an ``io.DataLoader`` with state_dict/
+    set_state_dict) upgrades data positioning to EXACTLY-ONCE: the
+    loader's own {epoch, cursor, RNG-seed, collator-carry} state rides
+    every checkpoint in ``_state()['data']``, rollback/restore re-seats
+    the loader at the exact batch boundary of the restored step (the
+    loader fast-forwards indices without touching samples), and
+    :meth:`restore_latest` gives a restarted worker a one-call
+    resume. When set, the loop streams from ``iter(dataloader)`` and
+    never applies the external step-count fast-forward (the loader owns
+    its position)."""
+
+    def __init__(self, step_fn, params, opt_state, make_stream=None, *,
                  sentinel: Optional[AnomalySentinel] = None,
-                 manager=None, watchdog: Optional["HangWatchdog"] = None):
+                 manager=None, watchdog: Optional["HangWatchdog"] = None,
+                 dataloader=None):
         self.step_fn = step_fn
         self.params = params
         self.opt_state = opt_state
+        self.dataloader = dataloader
+        if make_stream is None:
+            if dataloader is None:
+                raise ValueError(
+                    "SentinelLoop needs make_stream or dataloader")
+            make_stream = lambda: iter(dataloader)  # noqa: E731
         self.make_stream = make_stream
         self.manager = manager
         self.sentinel = sentinel or AnomalySentinel(manager=manager)
@@ -442,14 +460,73 @@ class SentinelLoop:
                 _sentinel_health_provider(weakref.ref(self)))
 
     def _state(self) -> Dict[str, Any]:
-        return {"params": self.params, "opt": self.opt_state,
-                "step": self.step}
+        state = {"params": self.params, "opt": self.opt_state,
+                 "step": self.step}
+        if self.dataloader is not None and \
+                hasattr(self.dataloader, "state_dict"):
+            state["data"] = dict(self.dataloader.state_dict())
+        return state
+
+    def _state_provider(self):
+        """Offer-time save provider: params/opt stay LAZY (an
+        interval-skipped save must not pay a traversal) but step and
+        the dataloader cursor are snapshotted NOW — the SIGTERM
+        emergency save materializes the provider mid-NEXT-batch, when
+        the live cursor has already advanced one past the offered
+        step; a deferred read would make the resumed loader skip that
+        batch (silent sample loss on exactly the preemption path)."""
+        step = self.step
+        data_fn = None
+        if self.dataloader is not None:
+            if hasattr(self.dataloader, "state_provider"):
+                data_fn = self.dataloader.state_provider()   # O(1) pin
+            elif hasattr(self.dataloader, "state_dict"):
+                snap = dict(self.dataloader.state_dict())
+                data_fn = lambda: snap                       # noqa: E731
+
+        def provide():
+            state = {"params": self.params, "opt": self.opt_state,
+                     "step": step}
+            if data_fn is not None:
+                state["data"] = dict(data_fn())
+            return state
+        return provide
+
+    def _new_stream(self):
+        """A stream positioned at ``self.step``: the dataloader owns its
+        own cursor (exactly-once, index-level skip); factory streams
+        fast-forward by step count (the PR 6 deterministic-replay
+        contract)."""
+        if self.dataloader is not None:
+            return iter(self.dataloader)
+        return fast_forward(self.make_stream(), self.step) \
+            if self.step else self.make_stream()
+
+    def _apply_restored(self, state) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt"]
+        self.step = int(state["step"])
+        if self.dataloader is not None and "data" in state \
+                and hasattr(self.dataloader, "set_state_dict"):
+            self.dataloader.set_state_dict(state["data"])
+
+    def restore_latest(self) -> Optional[int]:
+        """One-call elastic resume for a freshly-constructed loop:
+        restore the newest committed checkpoint into params/opt/step AND
+        the dataloader's batch boundary. Returns the restored step (None
+        = fresh start)."""
+        if self.manager is None:
+            return None
+        state = self._state()
+        step = self.manager.restore_latest(state)
+        if step is not None:
+            self._apply_restored(state)
+        return step
 
     def run(self, n_steps: int) -> Dict[str, Any]:
         import jax.numpy as jnp
 
-        stream = fast_forward(self.make_stream(), self.step) \
-            if self.step else self.make_stream()
+        stream = self._new_stream()
         while self.step < n_steps:
             try:
                 batch = next(stream)
@@ -511,18 +588,15 @@ class SentinelLoop:
                 self.applied += 1
                 self.last_loss = float(loss)
                 if self.manager is not None:
-                    self.manager.save(self.step, self._state)
+                    self.manager.save(self.step, self._state_provider())
             else:
                 self.skipped += 1
                 if verdict == ROLLBACK:
                     state = self._state()
                     restored = self.sentinel.rollback(state)
                     if restored is not None:
-                        self.params = state["params"]
-                        self.opt_state = state["opt"]
-                        self.step = int(state["step"])
-                        stream = fast_forward(self.make_stream(),
-                                              self.step)
+                        self._apply_restored(state)
+                        stream = self._new_stream()
         if self.manager is not None:
             self.manager.wait()
         return {"steps": self.step, "applied": self.applied,

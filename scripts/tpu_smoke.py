@@ -931,6 +931,106 @@ def main():
                 f"no convergence after skip: first {float(first_loss)} "
                 f"last {out['last_loss']}")
 
+    @case("rank_kill_resume")
+    def _():
+        # survivable multi-host training end to end (ISSUE 14): a
+        # 2-process world is launched through the elastic manager; on
+        # run 0 rank 1 kill -9s itself mid-gather; rank 0 must log a
+        # typed PeerLostError NAMING rank 1 (tombstone fast path) and
+        # exit through coordinated_abort; the elastic restart resumes
+        # the per-rank DataLoader from committed state and the stitched
+        # sample log shows every index consumed exactly once
+        import re
+        import tempfile
+
+        from paddle_tpu.distributed.fleet.elastic import \
+            AdaptiveElasticManager
+
+        work = tempfile.mkdtemp(prefix="smoke_rank_kill_")
+        worker = os.path.join(work, "worker.py")
+        with open(worker, "w") as f:
+            f.write(
+                "import os, sys, time\n"
+                "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+                "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+                "import numpy as np\n"
+                "import paddle_tpu.distributed as dist\n"
+                "from paddle_tpu.distributed import collective as coll\n"
+                "from paddle_tpu.distributed.fleet import elastic\n"
+                "from paddle_tpu.io import DataLoader\n"
+                "from paddle_tpu.io.dataset import Dataset\n"
+                "N, BS, TOTAL = 16, 2, 8\n"
+                "class DS(Dataset):\n"
+                "    def __len__(self): return N\n"
+                "    def __getitem__(self, i):\n"
+                "        return np.asarray([i], np.int64)\n"
+                "log_path = sys.argv[1]\n"
+                "dist.init_parallel_env()\n"
+                "rank, run = dist.get_rank(), elastic.elastic_run_index()\n"
+                "loader = DataLoader(DS(), batch_size=BS, shuffle=True,\n"
+                "                    seed=5)\n"
+                "start, state = elastic.load_state(\n"
+                "    {'data': loader.state_dict(), 'step': 0})\n"
+                "if start: loader.set_state_dict(state['data'])\n"
+                "step = int(start)\n"
+                "with coll.abort_on_collective_fault():\n"
+                "    log = open(f'{log_path}.rank{rank}', 'a')\n"
+                "    for batch in loader:\n"
+                "        if step >= TOTAL: break\n"
+                "        ids = ' '.join(str(int(x)) for x in\n"
+                "                       np.asarray(batch.numpy()).ravel())\n"
+                "        log.write(f'run={run} step={step} ids={ids}\\n')\n"
+                "        log.flush()\n"
+                "        step += 1\n"
+                "        # collective save: EVERY rank participates in\n"
+                "        # the commit-status gathers\n"
+                "        elastic.save_state(step,\n"
+                "            {'data': dict(loader.state_dict()),\n"
+                "             'step': step}, blocking=True)\n"
+                "        if run == 0 and rank == 1 and step == 3:\n"
+                "            os.kill(os.getpid(), 9)  # mid-gather kill\n"
+                "        dist.all_gather_object([], step,\n"
+                "                               tag=f'r{run}s{step}',\n"
+                "                               timeout_s=45)\n"
+                "print(f'SMOKE_DONE rank={rank} run={run}', flush=True)\n")
+        log = os.path.join(work, "samples")
+        # readmit_after=0: the killed slot re-admits immediately — the
+        # restart keeps the full world size
+        mgr = AdaptiveElasticManager(max_restarts=2, restart_delay=0.2,
+                                     readmit_after=0.0)
+        rc = mgr.run_adaptive(
+            worker, (log,), nproc_per_node=2,
+            ckpt_dir=os.path.join(work, "ckpt"),
+            log_dir=os.path.join(work, "logs"),
+            extra_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+        if rc != 0:
+            raise RuntimeError(f"elastic world never completed: rc={rc}")
+        wl = ""
+        for run_dir in sorted(os.listdir(os.path.join(work, "logs"))):
+            for fn in sorted(os.listdir(
+                    os.path.join(work, "logs", run_dir))):
+                if fn.startswith("workerlog"):
+                    wl += open(os.path.join(work, "logs", run_dir,
+                                            fn)).read()
+        if "PeerLostError" not in wl or "[1]" not in wl:
+            raise RuntimeError(
+                f"survivor did not raise a typed error naming rank 1:\n"
+                f"{wl[-2000:]}")
+        restarts = [d for _, s, d in mgr.events if s == "restart"]
+        if not restarts:
+            raise RuntimeError("elastic manager recorded no restart")
+        # rank 0's stitched sample log: every step exactly once
+        lines = [ln for ln in open(f"{log}.rank0").read().splitlines()
+                 if ln]
+        steps = [int(re.search(r"step=(\d+)", ln).group(1))
+                 for ln in lines]
+        if steps != list(range(8)):
+            raise RuntimeError(f"sample accounting broken: {steps}")
+        ids = [int(x) for ln in lines
+               for x in re.search(r"ids=(.*)$", ln).group(1).split()]
+        if sorted(ids) != list(range(16)):
+            raise RuntimeError(f"samples not exactly-once: {sorted(ids)}")
+
     @case("flash_block_autotune_bench_shape")
     def _():
         # pre-tune the bench shapes; winners land in the REPO cache that

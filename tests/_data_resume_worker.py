@@ -1,0 +1,69 @@
+"""Worker for the elastic exactly-once data-resume pin (run via
+AdaptiveElasticManager, NOT collected by pytest). Consumes a seeded
+shuffled DataLoader for a fixed number of batches across 2 epochs,
+logging every consumed sample index, checkpointing the loader's
+{seed, epoch, cursor} state after EVERY batch; on run 0 it kill -9s
+itself mid-epoch. The resumed run must consume exactly the unseen
+tail — the test stitches the logs and asserts every sample index
+trains exactly once per epoch (no replay, no skip)."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from paddle_tpu.distributed.fleet import elastic
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+
+N, BS, EPOCHS = 20, 2, 2
+TOTAL = (N // BS) * EPOCHS
+
+
+class IdentDS(Dataset):
+    def __len__(self):
+        return N
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.int64)
+
+
+def main():
+    log_path = sys.argv[1]
+    kill_at = int(os.environ.get("KILL_AT_BATCH", "-1"))
+    run = elastic.elastic_run_index()
+    loader = DataLoader(IdentDS(), batch_size=BS, shuffle=True, seed=13)
+    start, state = elastic.load_state(
+        {"data": loader.state_dict(), "step": 0})
+    if start:
+        loader.set_state_dict(state["data"])
+    step = int(start)
+    with open(log_path, "a") as log:
+        while step < TOTAL:
+            advanced = False
+            for batch in loader:
+                ids = " ".join(str(int(x)) for x in
+                               np.asarray(batch.numpy()).ravel())
+                log.write(f"run={run} step={step} ids={ids}\n")
+                log.flush()
+                step += 1
+                advanced = True
+                elastic.save_state(
+                    step, {"data": dict(loader.state_dict()),
+                           "step": step}, blocking=True)
+                if run == 0 and step == kill_at:
+                    os._exit(137)          # simulated node loss
+                if step >= TOTAL:
+                    break
+            if not advanced:
+                break                      # defensive: never spin
+    print(f"DATA_DONE run={run} steps={step}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Worker for the rank-loss chaos pin (run via the launch CLI, NOT
+collected by pytest). After a warm-up gather proves the world is live,
+rank 1 kill -9s itself while rank 0 enters the next gather; rank 0 must
+surface a typed PeerLostError NAMING rank 1 in wall time far under the
+collective deadline (tombstone fast path), then exit through
+coordinated_abort (PEER_FAILURE_RC) with the abort marker + flight
+record on disk."""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import collective as coll
+
+
+def main():
+    deadline_s = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    out = []
+    dist.all_gather_object(out, rank, tag="warm")
+    assert out == [0, 1], out
+    print(f"WARM_OK rank={rank}", flush=True)
+
+    if rank == 1:
+        os.kill(os.getpid(), 9)      # mid-job kill -9: no cleanup at all
+
+    t0 = time.monotonic()
+    try:
+        dist.all_gather_object([], {"rank": rank}, tag="doomed",
+                               timeout_s=deadline_s)
+    except coll.PeerLostError as e:
+        dt = time.monotonic() - t0
+        print(f"PEER_LOST rank={rank} lost={e.lost_ranks} "
+              f"dt={dt:.2f}s reasons={e.reasons}", flush=True)
+        assert e.lost_ranks == [1], e.lost_ranks
+        assert dt < deadline_s / 2, \
+            f"tombstone fast path missed: waited {dt:.1f}s"
+        coll.coordinated_abort(e)    # exits PEER_FAILURE_RC
+    print(f"UNEXPECTED_SURVIVAL rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,534 @@
+"""Typed collective fault layer (ISSUE 14): deadline loop, failed-rank
+attribution, tombstone/abort fast paths, env knobs, and the elastic
+manager's peer-failure rc mapping — all fast-lane (a dict-backed FakeKV
+stands in for the coordination service; no subprocesses). The real
+2-process kill -9 chaos pin lives in tests/test_rank_loss_chaos.py
+(slow lane) and scripts/tpu_smoke.py's ``rank_kill_resume`` stage.
+"""
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.distributed import collective as coll
+from paddle_tpu.distributed import heartbeat as hb
+from paddle_tpu.testing import faults
+
+
+class FakeKV:
+    """Dict-backed stand-in for the coordination-service client (same
+    contract as tests/test_heartbeat_kv.py)."""
+
+    def __init__(self):
+        self.d = {}
+
+    def key_value_set(self, k, v, allow_overwrite=False):
+        if not allow_overwrite and k in self.d:
+            raise RuntimeError(f"key exists: {k}")
+        self.d[k] = v
+
+    def key_value_try_get(self, k):
+        if k not in self.d:
+            raise KeyError(k)
+        return self.d[k]
+
+    def key_value_delete(self, k):
+        self.d.pop(k, None)
+
+
+@pytest.fixture
+def no_markers(monkeypatch, tmp_path):
+    """Isolate marker transports: a private heartbeat dir and gen 0."""
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path / "hb"))
+    monkeypatch.delenv("PADDLE_ELASTIC_RUN", raising=False)
+    return str(tmp_path / "hb")
+
+
+def _want(tag, world):
+    return {r: f"ag_{tag}_{r}" for r in range(world)}
+
+
+class TestWaitForKeys:
+    def test_all_resolved_returns_values(self, no_markers):
+        kv = FakeKV()
+        for r in range(3):
+            kv.key_value_set(f"ag_t_{r}", f"v{r}")
+        got = coll._wait_for_keys(kv, op="all_gather_object", tag="t",
+                                  want=_want("t", 3), world=3,
+                                  timeout_s=1.0)
+        assert got == {0: "v0", 1: "v1", 2: "v2"}
+
+    def test_late_key_resolves_within_deadline(self, no_markers):
+        kv = FakeKV()
+        kv.key_value_set("ag_t_0", "v0")
+        threading.Timer(
+            0.15, lambda: kv.key_value_set("ag_t_1", "v1")).start()
+        got = coll._wait_for_keys(kv, op="all_gather_object", tag="t",
+                                  want=_want("t", 2), world=2,
+                                  timeout_s=10.0)
+        assert got[1] == "v1"
+
+    def test_timeout_names_exactly_the_missing_ranks(self, no_markers):
+        kv = FakeKV()
+        kv.key_value_set("ag_t_0", "v0")
+        kv.key_value_set("ag_t_2", "v2")
+        t0 = time.monotonic()
+        with pytest.raises(coll.CollectiveTimeout) as ei:
+            coll._wait_for_keys(kv, op="all_gather_object", tag="t",
+                                want=_want("t", 4), world=4,
+                                timeout_s=0.2)
+        e = ei.value
+        assert e.missing_ranks == [1, 3]
+        assert e.op == "all_gather_object" and e.world == 4
+        assert e.elapsed_s >= 0.2 and time.monotonic() - t0 < 5
+        # the rendered message carries the attribution an operator greps
+        assert "rank(s) [1, 3]" in str(e) and "tag=t" in str(e)
+        # typed family: ExecutionTimeoutError -> TimeoutError builtin
+        assert isinstance(e, TimeoutError)
+
+    def test_tombstone_fast_path_beats_the_deadline(self, no_markers):
+        kv = FakeKV()
+        kv.key_value_set("ag_t_0", "v0")
+        hb.mark_dead(1, "worker exited rc=137", dir_path=no_markers,
+                     generation=0)
+        t0 = time.monotonic()
+        with pytest.raises(coll.PeerLostError) as ei:
+            coll._wait_for_keys(kv, op="all_gather_object", tag="t",
+                                want=_want("t", 2), world=2, me=0,
+                                timeout_s=30.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, "tombstone did not short-circuit the wait"
+        e = ei.value
+        assert e.lost_ranks == [1]
+        assert "rc=137" in e.reasons[1]
+        assert isinstance(e, RuntimeError)   # UnavailableError family
+
+    def test_tombstone_kv_transport(self, no_markers):
+        kv = FakeKV()
+        kv.key_value_set("ag_t_0", "v0")
+        hb.mark_dead(1, "kv-only death", dir_path=None, client=kv,
+                     generation=0)
+        # markers ride the SAME client the wait polls — no filesystem
+        with pytest.raises(coll.PeerLostError):
+            coll._wait_for_keys(kv, op="barrier", tag="t",
+                                want=_want("t", 2), world=2, me=0,
+                                timeout_s=30.0)
+
+    def test_stale_generation_tombstone_is_ignored(self, no_markers,
+                                                   monkeypatch):
+        kv = FakeKV()
+        hb.mark_dead(1, "previous world", dir_path=no_markers,
+                     generation=0)
+        monkeypatch.setenv("PADDLE_ELASTIC_RUN", "1")  # restarted world
+        with pytest.raises(coll.CollectiveTimeout):
+            coll._wait_for_keys(kv, op="barrier", tag="t",
+                                want=_want("t", 2), world=2, me=0,
+                                timeout_s=0.2)
+
+    def test_abort_marker_fails_peers_fast(self, no_markers):
+        kv = FakeKV()
+        kv.key_value_set("ag_t_0", "v0")
+        kv.key_value_set("ag_t_1", "v1")
+        # rank 2 aborted in a DIFFERENT exchange; this wait still has
+        # rank 2's key pending -> marker observed, typed, attributed
+        hb.write_abort_marker(2, {"reason": "CollectiveTimeout: ..."},
+                              dir_path=no_markers, generation=0)
+        t0 = time.monotonic()
+        with pytest.raises(coll.PeerLostError) as ei:
+            coll._wait_for_keys(kv, op="all_gather_object", tag="t",
+                                want=_want("t", 3), world=3, me=0,
+                                timeout_s=30.0)
+        assert time.monotonic() - t0 < 5.0
+        assert ei.value.lost_ranks == [2]
+        assert "abort" in ei.value.reasons[2]
+
+    def test_own_abort_marker_does_not_self_trigger(self, no_markers):
+        kv = FakeKV()
+        hb.write_abort_marker(0, {"reason": "me"}, dir_path=no_markers,
+                              generation=0)
+        with pytest.raises(coll.CollectiveTimeout):
+            coll._wait_for_keys(kv, op="barrier", tag="t",
+                                want=_want("t", 2), world=2, me=0,
+                                timeout_s=0.2)
+
+    def test_sustained_transport_outage_raises_unavailable(
+            self, no_markers, monkeypatch):
+        # 'key not present' and 'coordination service unreachable' are
+        # different failures: a dead coordinator must surface typed
+        # (and WITHOUT blaming live peers) instead of burning the
+        # whole deadline
+        from paddle_tpu.core import enforce as E
+
+        class DeadKV:
+            def key_value_try_get(self, k):
+                raise ConnectionError("coordinator unreachable")
+
+        monkeypatch.setattr(coll, "_TRANSPORT_FAIL_S", 0.3)
+        t0 = time.monotonic()
+        with pytest.raises(E.UnavailableError) as ei:
+            coll._wait_for_keys(DeadKV(), op="barrier", tag="t",
+                                want=_want("t", 2), world=2, me=0,
+                                timeout_s=60.0)
+        assert time.monotonic() - t0 < 10.0
+        assert "coordination service unreachable" in str(ei.value)
+        assert not isinstance(ei.value, coll.PeerLostError)
+
+    def test_absent_shaped_errors_do_not_trip_the_outage_clock(
+            self, no_markers, monkeypatch):
+        monkeypatch.setattr(coll, "_TRANSPORT_FAIL_S", 0.05)
+        kv = FakeKV()   # raises KeyError for absent keys: normal block
+        with pytest.raises(coll.CollectiveTimeout):
+            coll._wait_for_keys(kv, op="barrier", tag="t",
+                                want=_want("t", 2), world=2, me=0,
+                                timeout_s=0.3)
+
+    def test_kv_get_fault_point(self, no_markers):
+        kv = FakeKV()
+        kv.key_value_set("ag_t_0", "v0")
+        with faults.injected("collective.kv_get", action="raise"):
+            with pytest.raises(faults.FaultInjected):
+                coll._wait_for_keys(kv, op="barrier", tag="t",
+                                    want=_want("t", 1), world=1,
+                                    timeout_s=1.0)
+
+
+class TestKnobs:
+    def test_env_override_parses(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COLL_TIMEOUT_S", "5.5")
+        assert coll.coll_timeout_s() == 5.5
+
+    def test_default_and_bad_values_fall_back(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_COLL_TIMEOUT_S", raising=False)
+        assert coll.coll_timeout_s() == coll.DEFAULT_COLL_TIMEOUT_S == 60.0
+        monkeypatch.setenv("PADDLE_TPU_COLL_TIMEOUT_S", "garbage")
+        assert coll.coll_timeout_s() == 60.0
+        monkeypatch.setenv("PADDLE_TPU_COLL_TIMEOUT_S", "-3")
+        assert coll.coll_timeout_s() == 60.0
+        monkeypatch.setenv("PADDLE_TPU_COLL_TIMEOUT_S", "0")
+        assert coll.coll_timeout_s() == 60.0
+
+    def test_backoff_doubles_and_caps(self):
+        d = coll._BACKOFF_FLOOR_S
+        seen = []
+        for _ in range(12):
+            seen.append(d)
+            d = coll._next_delay(d)
+        assert seen[0] == pytest.approx(0.002)
+        assert seen[1] == pytest.approx(0.004)
+        assert max(seen) == pytest.approx(coll._BACKOFF_CAP_S)
+        assert seen[-1] == seen[-2] == pytest.approx(0.1)  # capped
+
+    def test_wait_uses_env_budget(self, monkeypatch, no_markers):
+        monkeypatch.setenv("PADDLE_TPU_COLL_TIMEOUT_S", "0.15")
+        kv = FakeKV()
+        t0 = time.monotonic()
+        with pytest.raises(coll.CollectiveTimeout) as ei:
+            coll._wait_for_keys(kv, op="barrier", tag="t",
+                                want=_want("t", 2), world=2)
+        assert 0.1 < time.monotonic() - t0 < 5.0
+        assert ei.value.timeout_s == pytest.approx(0.15)
+
+
+class TestMetrics:
+    def test_timeout_and_wait_ms_counted(self, no_markers):
+        monitor.reset()
+        pt.set_flags({"FLAGS_enable_monitor": True})
+        try:
+            kv = FakeKV()
+            kv.key_value_set("ag_t_0", "v0")
+            with pytest.raises(coll.CollectiveTimeout):
+                coll._wait_for_keys(kv, op="all_gather_object", tag="t",
+                                    want=_want("t", 2), world=2,
+                                    timeout_s=0.1)
+            hb.mark_dead(1, "dead", dir_path=None, client=kv,
+                         generation=0)
+            with pytest.raises(coll.PeerLostError):
+                coll._wait_for_keys(kv, op="all_gather_object", tag="t2",
+                                    want={1: "ag_t2_1"}, world=2, me=0,
+                                    timeout_s=5.0)
+            snap = monitor.snapshot()
+            assert snap["counters"]["dist.collective.timeouts"] == 1
+            assert snap["counters"]["dist.collective.peer_lost"] == 1
+            assert snap["histograms"]["dist.collective.wait_ms"][
+                "count"] == 2
+        finally:
+            pt.set_flags({"FLAGS_enable_monitor": False})
+            monitor.reset()
+
+
+class TestObjectCollectivePaths:
+    """The exchange surfaces route through the typed wait (FakeKV +
+    patched env; world-size-1 semantics byte-identical is covered by
+    the existing test_distributed/test_comms_roofline suites)."""
+
+    @pytest.fixture
+    def fake_world(self, monkeypatch, no_markers):
+        kv = FakeKV()
+        from paddle_tpu.distributed import env as denv
+        monkeypatch.setattr(coll, "_coord_client", lambda: kv)
+        monkeypatch.setattr(denv, "get_world_size", lambda: 2)
+        monkeypatch.setattr(denv, "get_rank", lambda: 0)
+        coll.destroy_process_group()   # drop the cached world-1 group
+        yield kv
+        coll.destroy_process_group()
+
+    def test_all_gather_object_attributes_missing_peer(self, fake_world):
+        kv = fake_world
+        with pytest.raises(coll.CollectiveTimeout) as ei:
+            coll.all_gather_object([], {"x": 1}, tag="T1",
+                                   timeout_s=0.15)
+        assert ei.value.missing_ranks == [1]
+        # our own contribution landed before the wait
+        assert "ag_T1_0" in kv.d
+
+    def test_all_gather_object_completes_when_peer_lands(self, fake_world):
+        kv = fake_world
+        import pickle
+        kv.key_value_set("ag_T2_1", pickle.dumps({"r": 1}).hex())
+        out = []
+        coll.all_gather_object(out, {"r": 0}, tag="T2", timeout_s=5.0)
+        assert out == [{"r": 0}, {"r": 1}]
+
+    def test_barrier_attributes_missing_peer(self, fake_world):
+        with pytest.raises(coll.CollectiveTimeout) as ei:
+            coll.barrier(tag="B1", timeout_s=0.15)
+        assert ei.value.missing_ranks == [1]
+        assert ei.value.op == "barrier"
+
+    def test_barrier_completes(self, fake_world):
+        fake_world.key_value_set("bar_B2_1", "1")
+        coll.barrier(tag="B2", timeout_s=5.0)
+
+    def test_broadcast_object_list_waits_on_src(self, fake_world):
+        kv = fake_world
+        import pickle
+        kv.key_value_set("bc_C1", pickle.dumps([7, 8]).hex())
+        # receiving rank (0) with src=1
+        got = coll.broadcast_object_list([0, 0], src=1, tag="C1",
+                                         timeout_s=5.0)
+        assert got == [7, 8]
+        with pytest.raises(coll.CollectiveTimeout) as ei:
+            coll.broadcast_object_list([0], src=1, tag="C2",
+                                       timeout_s=0.15)
+        assert ei.value.missing_ranks == [1]   # attributed to src
+
+    def test_scatter_object_list_waits_on_src(self, fake_world):
+        kv = fake_world
+        import pickle
+        kv.key_value_set("sc_S1_0", pickle.dumps("mine").hex())
+        out = []
+        coll.scatter_object_list(out, src=1, tag="S1", timeout_s=5.0)
+        assert out == ["mine"]
+        with pytest.raises(coll.CollectiveTimeout):
+            coll.scatter_object_list([], src=1, tag="S2",
+                                     timeout_s=0.15)
+
+    def test_src_side_scatter_publishes_per_rank_keys(self, fake_world):
+        kv = fake_world
+        out = []
+        coll.scatter_object_list(out, ["a", "b"], src=0, tag="S3",
+                                 timeout_s=5.0)
+        assert out == ["a"]
+        assert "sc_S3_1" in kv.d
+        assert "sc_S3_0" not in kv.d   # src takes its piece locally —
+        #                                an unread key would just leak
+
+
+class TestCoordinatedAbort:
+    def test_rc_distinguishes_confirmed_death_from_timeout(self,
+                                                           no_markers,
+                                                           monkeypatch):
+        # PeerLostError (confirmed dead) -> 123, CollectiveTimeout
+        # (possibly wedged-but-alive) -> 122, so the elastic scale-in
+        # heuristic stays engaged for deterministic wedges
+        exits = []
+        import os as _os
+        monkeypatch.setattr(_os, "_exit", lambda code: exits.append(code))
+        coll.coordinated_abort(
+            coll.PeerLostError("barrier", 1, {1: "dead"}, 0.1, 2))
+        coll.coordinated_abort(
+            coll.CollectiveTimeout("barrier", 2, 60.0, {1}, 2, 60.0))
+        assert exits == [coll.PEER_FAILURE_RC,
+                         coll.COLLECTIVE_TIMEOUT_RC] == [123, 122]
+
+    def test_abort_writes_marker_and_flight_record(self, no_markers,
+                                                   tmp_path, monkeypatch):
+        from paddle_tpu.monitor import trace as _trace
+        flight = tmp_path / "box.json"
+        _trace.set_flight_record_path(str(flight))
+        try:
+            exc = coll.PeerLostError("all_gather_object", "t",
+                                     {1: "exited rc=137"}, 0.4, 2)
+            coll.coordinated_abort(exc, exit_process=False)
+        finally:
+            _trace.set_flight_record_path(None)
+        marker = hb.read_abort_marker(dir_path=no_markers, generation=0)
+        assert marker is not None
+        assert marker["rank"] == 0 and marker["lost_ranks"] == [1]
+        assert "PeerLostError" in marker["reason"]
+        assert flight.exists()
+
+    def test_context_manager_marks_and_reraises(self, no_markers):
+        with pytest.raises(coll.CollectiveTimeout):
+            with coll.abort_on_collective_fault(exit_process=False):
+                raise coll.CollectiveTimeout("barrier", 3, 1.0, {1}, 2,
+                                             60.0)
+        marker = hb.read_abort_marker(dir_path=no_markers, generation=0)
+        assert marker is not None and marker["op"] == "barrier"
+
+    def test_non_collective_errors_pass_through_unmarked(self, no_markers):
+        with pytest.raises(ValueError):
+            with coll.abort_on_collective_fault(exit_process=False):
+                raise ValueError("unrelated")
+        assert hb.read_abort_marker(dir_path=no_markers,
+                                    generation=0) is None
+
+
+class TestLauncherMarkers:
+    def test_clear_run_markers_is_generation_scoped(self, tmp_path):
+        # the sweep drops only OLDER generations: in a multi-node job
+        # sharing a heartbeat dir, a later-starting controller must not
+        # delete a peer node's live (current-generation) tombstones
+        d = str(tmp_path)
+        hb.mark_dead(0, "old world", dir_path=d, generation=0)
+        hb.write_abort_marker(1, {"reason": "old"}, dir_path=d,
+                              generation=0)
+        hb.mark_dead(2, "peer node's live tombstone", dir_path=d,
+                     generation=1)
+        hb.mark_dead(5, "my own rank, stale by definition", dir_path=d,
+                     generation=1)
+        hb.write_abort_marker(3, {"reason": "pre-start abort"},
+                              dir_path=d, generation=1)
+        hb.touch_named(d, "replica0")   # unrelated files survive
+        hb.clear_run_markers(d, generation=1, own_ranks=[4, 5])
+        # older generation: swept entirely
+        assert hb.dead_ranks([0], dir_path=d, generation=0) == {}
+        assert hb.read_abort_marker(dir_path=d, generation=0) is None
+        # current generation: a PEER node's tombstone survives...
+        assert hb.dead_ranks([2], dir_path=d, generation=1) != {}
+        # ...but my own ranks' markers and any pre-start abort marker
+        # are provably stale and go
+        assert hb.dead_ranks([5], dir_path=d, generation=1) == {}
+        assert hb.read_abort_marker(dir_path=d, generation=1) is None
+        import os
+        assert "replica0.alive" in os.listdir(d)
+
+    def test_markers_are_job_scoped(self, tmp_path, monkeypatch):
+        # a later job reusing the same heartbeat dir at the same
+        # generation must not honor its predecessor's markers: markers
+        # carry the writing job's rendezvous address and readers match
+        # it against their own PADDLE_MASTER
+        d = str(tmp_path)
+        hb.mark_dead(1, "old job corpse", dir_path=d, generation=0,
+                     job="127.0.0.1:1111")
+        hb.write_abort_marker(2, {"reason": "old"}, dir_path=d,
+                              generation=0, job="127.0.0.1:1111")
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:2222")
+        assert hb.dead_ranks([1], dir_path=d, generation=0) == {}
+        assert hb.read_abort_marker(dir_path=d, generation=0) is None
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:1111")
+        assert 1 in hb.dead_ranks([1], dir_path=d, generation=0)
+        assert hb.read_abort_marker(dir_path=d,
+                                    generation=0) is not None
+        # markers without a job identity (direct API use) match anyone
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:9999")
+        monkeypatch.delenv("PADDLE_MASTER", raising=False)
+        hb.mark_dead(3, "unscoped", dir_path=d, generation=0)
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:9999")
+        assert 3 in hb.dead_ranks([3], dir_path=d, generation=0)
+
+    def test_untagged_reclamation_distance_two(self):
+        # symmetric-exchange KV keys are deleted once provably dead
+        # (<= seq-2), bounding coordination-service growth over a
+        # long run
+        kv = FakeKV()
+        spent = []
+        for seq in range(5):
+            kv.key_value_set(f"bar_{seq}_0", "1")
+            coll._reclaim_untagged(kv, spent, seq)
+            spent.append((seq, f"bar_{seq}_0"))
+        assert set(kv.d) == {"bar_3_0", "bar_4_0"}
+
+    def test_plain_elastic_run_advances_generation(self):
+        # plain ElasticManager.run must export PADDLE_ELASTIC_RUN per
+        # relaunch, or the generation-scoped marker sweep would
+        # preserve the previous incarnation's tombstones into the new
+        # world (same gen) and instantly kill it
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        gens = []
+
+        def launcher(script, args, nproc_per_node=1, extra_env=None,
+                     **kw):
+            gens.append((extra_env or {}).get("PADDLE_ELASTIC_RUN"))
+            return 1 if len(gens) < 3 else 0
+
+        m = ElasticManager(max_restarts=5, restart_delay=0.0,
+                           launcher=launcher)
+        assert m.run("job.py") == 0
+        assert gens == ["0", "1", "2"]
+
+
+class TestElasticPeerFailureMapping:
+    def test_peer_rc_restarts_without_scale_in(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        seen = []
+
+        def launcher(script, args, nproc_per_node=1, **kw):
+            seen.append(nproc_per_node)
+            return coll.PEER_FAILURE_RC if len(seen) < 4 else 0
+
+        m = ElasticManager(max_restarts=5, min_nproc=1,
+                           restart_delay=0.0, launcher=launcher)
+        assert m.run("job.py", nproc_per_node=3) == 0
+        # peer-failure rcs never feed the sick-worker scale-in heuristic
+        assert seen == [3, 3, 3, 3]
+        reasons = [d.get("reason") for _, s, d in m.events
+                   if s == "restart"]
+        assert reasons == ["peer-failure"] * 3
+
+    def test_ordinary_rc_still_scales_in(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        seen = []
+
+        def launcher(script, args, nproc_per_node=1, **kw):
+            seen.append(nproc_per_node)
+            return 1 if len(seen) < 5 else 0
+
+        m = ElasticManager(max_restarts=5, min_nproc=1,
+                           restart_delay=0.0, launcher=launcher)
+        assert m.run("job.py", nproc_per_node=3) == 0
+        assert seen[0] == 3 and seen[-1] < 3   # scale-in engaged
+        assert any(d.get("reason") == "worker-failure"
+                   for _, s, d in m.events if s == "restart")
+
+    def test_adaptive_peer_rc_keeps_world_size(self):
+        # review fix: a coordinated abort's rc must not mark a slot
+        # down in run_adaptive — with no membership dir or readmit
+        # backoff the slot would never re-admit and every later world
+        # would run permanently shrunk off an INNOCENT rank's exit
+        from paddle_tpu.distributed.fleet.elastic import \
+            AdaptiveElasticManager
+        seen = []
+
+        def launcher(script, args, nproc_per_node=1, **kw):
+            seen.append(nproc_per_node)
+            return coll.PEER_FAILURE_RC if len(seen) < 3 else 0
+
+        m = AdaptiveElasticManager(max_restarts=5, restart_delay=0.0,
+                                   launcher=launcher)
+        assert m.run_adaptive("job.py", nproc_per_node=3) == 0
+        assert seen == [3, 3, 3]
+        reasons = [d.get("reason") for _, s, d in m.events
+                   if s == "restart"]
+        assert reasons == ["peer-failure"] * 2
+
+    def test_budget_still_bounds_peer_failures(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        m = ElasticManager(max_restarts=2, restart_delay=0.0,
+                           launcher=lambda *a, **k:
+                           coll.PEER_FAILURE_RC)
+        assert m.run("job.py") == coll.PEER_FAILURE_RC
+        assert m.restarts == 2

@@ -94,9 +94,13 @@ class TestLaunchCLI:
             "time.sleep(60)\n")
         import time
         t0 = time.time()
+        # short peer_grace: this worker never touches collectives, so
+        # the survivors-abort-typed window is pure wait here (tier-1
+        # wall-time budget; the full-grace path is exercised by the
+        # slow-lane rank-loss chaos tests)
         r = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--nproc_per_node", "2", str(bad)],
+             "--nproc_per_node", "2", "--peer_grace", "0.3", str(bad)],
             capture_output=True, text=True, timeout=120,
             env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
         assert r.returncode != 0

@@ -190,6 +190,9 @@ class TestMoECapacityDispatch:
         np.testing.assert_array_equal(np.asarray(got),
                                       np.stack(want, axis=1))
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14 rebalance): MoE int8
+    # decode parity duplicates the llama-family weight-only pins
+    # (test_models TestWeightOnlyDecode) under the same contract
     def test_weight_only_int8_decode(self):
         # quantized tree == dequantized-fp tree through forward AND the
         # decode loop (same bit-exact contract as the llama family) —

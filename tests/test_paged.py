@@ -216,6 +216,9 @@ class TestPagedDecodeParity:
         self._run(L, cfg, params, (8,), 6, temperature=0.8,
                   key=jax.random.PRNGKey(42))
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 14 rebalance): int8 paged
+    # parity duplicates the bf16 paged pin above + the weight-only
+    # generate/beam pins in test_models (TestWeightOnlyDecode)
     def test_llama_int8(self):
         cfg = L.llama_tiny()
         qp = L.quantize_weights(L.init_params(cfg, jax.random.PRNGKey(2)))

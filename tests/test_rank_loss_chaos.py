@@ -1,0 +1,109 @@
+"""Rank-loss chaos pins (ISSUE 14, slow lane — subprocess-heavy; the
+fast-lane logic pins live in test_collective_faults.py and
+test_data_resume.py):
+
+1. kill -9 of one rank mid-``all_gather_object`` surfaces a typed
+   ``PeerLostError`` on the survivor that NAMES the dead rank, in wall
+   time far under ``PADDLE_TPU_COLL_TIMEOUT_S`` (tombstone fast path),
+   and the survivor exits through the coordinated-abort protocol.
+2. an elastic run over a crashing-then-clean worker restarts and
+   resumes the DataLoader from its committed state with every sample
+   index consumed exactly once (no replay, no skip).
+"""
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _read_worker_logs(log_dir, nprocs):
+    logs = ""
+    for rank in range(nprocs):
+        p = os.path.join(log_dir, f"workerlog.{rank}")
+        if os.path.exists(p):
+            logs += f"--- rank {rank} ---\n" + open(p).read()
+    return logs
+
+
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): 2-process launch + jax
+# imports; the attribution/tombstone LOGIC pins run fast-lane against a
+# FakeKV in test_collective_faults.py
+class TestKillMidGather:
+    def test_kill9_surfaces_typed_peer_lost_fast(self, tmp_path):
+        worker = os.path.join(HERE, "_gather_kill_worker.py")
+        log_dir = str(tmp_path / "logs")
+        deadline = "45"
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", log_dir,
+             worker, deadline],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                     PADDLE_TPU_COLL_TIMEOUT_S=deadline))
+        wall = time.time() - t0
+        logs = _read_worker_logs(log_dir, 2)
+        assert r.returncode != 0, logs[-6000:]
+        assert "WARM_OK rank=0" in logs and "WARM_OK rank=1" in logs, \
+            logs[-6000:]
+        # the survivor's typed error names the dead rank...
+        assert "PEER_LOST rank=0 lost=[1]" in logs, logs[-6000:]
+        # ...in wall time far under the deadline (the worker asserts
+        # dt < deadline/2 itself; parse and pin harder here)
+        m = re.search(r"PEER_LOST rank=0 .* dt=([0-9.]+)s", logs)
+        assert m and float(m.group(1)) < 20.0, logs[-6000:]
+        # coordinated abort: marker announced + typed abort line
+        assert "aborting: PeerLostError" in logs, logs[-6000:]
+        assert "UNEXPECTED_SURVIVAL" not in logs, logs[-6000:]
+        # nothing waited out the 45s budget end to end
+        assert wall < 300, wall
+
+
+@pytest.mark.slow  # tier-1 budget (ISSUE 14): elastic relaunch = 2 jax
+# interpreter spins; the loader-state resume LOGIC pins run fast-lane
+# in test_data_resume.py
+class TestElasticExactlyOnceResume:
+    def test_kill9_mid_epoch_resumes_exactly_once(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import \
+            AdaptiveElasticManager
+
+        worker = os.path.join(HERE, "_data_resume_worker.py")
+        log = str(tmp_path / "samples.log")
+        mgr = AdaptiveElasticManager(max_restarts=2, restart_delay=0.1)
+        rc = mgr.run_adaptive(
+            worker, (log,), nproc_per_node=1,
+            ckpt_dir=str(tmp_path / "ckpt"),
+            log_dir=str(tmp_path / "logs"),
+            extra_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                       "KILL_AT_BATCH": "7"})
+        assert rc == 0, open(log).read() if os.path.exists(log) else rc
+        # one restart, attributed as a worker failure (rc=137 crash)
+        restarts = [d for _, s, d in mgr.events if s == "restart"]
+        assert len(restarts) == 1 and restarts[0]["rc"] == 137
+
+        lines = [ln for ln in open(log).read().splitlines() if ln]
+        steps = [int(re.search(r"step=(\d+)", ln).group(1))
+                 for ln in lines]
+        # every batch step logged exactly once across both runs —
+        # no replay (save committed BEFORE the kill), no skip
+        assert steps == sorted(steps) == list(range(20)), steps
+        per_step = {}
+        for ln in lines:
+            s = int(re.search(r"step=(\d+)", ln).group(1))
+            ids = [int(x) for x in
+                   re.search(r"ids=(.*)$", ln).group(1).split()]
+            per_step[s] = ids
+        epoch0 = [i for s in range(10) for i in per_step[s]]
+        epoch1 = [i for s in range(10, 20) for i in per_step[s]]
+        assert sorted(epoch0) == list(range(20))
+        assert sorted(epoch1) == list(range(20))
+        assert epoch0 != epoch1          # epochs reshuffle
+        # the kill landed mid-epoch-0: both runs contributed to it
+        runs = {ln.split()[0] for ln in lines}
+        assert runs == {"run=0", "run=1"}, runs
