@@ -299,6 +299,9 @@ def _metrics_summary():
             # regression guard's lower-is-better rungs read, windowed
             # compliance + burn rates, tenant count, autoscale signals
             "slo": _slo_block(),
+            # fleet SLO federation (monitor/federation.py): frames the
+            # serving rung's replica published + the federated verdict
+            "federation": _federation_block(),
             # operator plane (monitor/memory.py + monitor/programs.py):
             # HBM occupancy at end of run (empty on backends that
             # report nothing — never fabricated) and the compiled-
@@ -440,6 +443,35 @@ def _slo_block():
             "tenants": len(tenants["tenants"]),
             "autoscale": _slo.update_autoscale_gauges(),
         }
+    except Exception as e:                      # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _federation_block():
+    """extra.metrics.federation: the fleet SLO federation condensed —
+    which replicas published frames this run and the last federated
+    verdict (alerting objectives, summed demand, worst burner). The
+    serving rung attaches a local-only publisher, so single-process
+    bench runs still exercise the frame path end to end."""
+    try:
+        from paddle_tpu.monitor import federation as _fed
+        snap = _fed.fleet_serving_snapshot()
+        rep = snap.get("report")
+        if not snap.get("frames"):
+            return {"available": False}
+        out = {
+            "available": True,
+            "replicas": sorted(snap["frames"]),
+            "frames_seq": {n: f.get("seq")
+                           for n, f in snap["frames"].items()},
+        }
+        if rep:
+            att = rep.get("attribution") or []
+            out["alerting"] = rep.get("alerting")
+            out["demand_estimate_sum"] = (rep.get("demand") or {}) \
+                .get("demand_estimate_sum")
+            out["worst_replica"] = att[0]["replica"] if att else None
+        return out
     except Exception as e:                      # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
@@ -1015,6 +1047,11 @@ def _serving_paged_rung(on_tpu):
     eng = ServingEngine(L, params, cfg, num_slots=slots,
                         max_len=max_len, page_size=page,
                         decode_chunk=chunk)
+    # local-only federation frames (explicit: never falls back to a
+    # configured PADDLE_HEARTBEAT_DIR or global KV client — a bench
+    # publisher must not litter a live heartbeat dir): the
+    # extra.metrics.federation block reports a real publisher's output
+    eng.publish_frames("bench-replica0", local_only=True)
     from paddle_tpu.inference.engine import EngineStats
     eng.run(reqs(0))            # warmup: compiles every prefill bucket
     # drop warmup observations: a TTFT that includes an XLA compile is

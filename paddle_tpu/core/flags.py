@@ -150,6 +150,15 @@ define_flag("serving_slo_preemption", False,
             "then accumulated work from the per-request cost record) "
             "instead of youngest-first. Off (the default) = "
             "youngest-first, today's behavior.")
+define_flag("serving_fleet_burn_scaling", False,
+            "Elastic serving controller (run_serving) federates "
+            "per-replica SLO telemetry frames (monitor/federation.py): "
+            "a fleet latency-objective fast-burn adds scale-out "
+            "pressure even at flat demand, and scale-in is refused "
+            "while the fleet burn alerts (latency objectives only — "
+            "availability-fed triggers self-lock). Off (the default) "
+            "= demand-only scaling, byte-identical controller "
+            "decisions.")
 define_flag("fault_injection", "",
             "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
             " armed at import by paddle_tpu.testing.faults (actions: "

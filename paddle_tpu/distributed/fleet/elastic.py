@@ -114,6 +114,92 @@ from ..launch.main import PEER_FAILURE_RC, RESCALE_RC  # one home for the
 #                                                       # protocol rcs
 
 
+class _BoundedSignals:
+    """Control-loop isolation for ``run_serving``'s legacy ``signals``
+    callable: each call runs on a daemon worker thread joined for at
+    most ``timeout`` seconds. A call that blows the bound returns None
+    (no payload — never fabricated) and marks the replica WEDGED:
+    while its call is still outstanding, later ticks skip it instantly
+    instead of stacking threads, so one frozen replica delays the
+    whole fleet's tick by at most one bound, once. A late result from
+    a recovered callable is kept and served on the next ask.
+    ``timeout`` None/<=0 = pass-through (the pre-federation blocking
+    semantics). Exceptions surface to the caller's existing
+    try/except as None results."""
+
+    def __init__(self, fn, timeout: Optional[float]):
+        self._fn = fn
+        self._timeout = timeout
+        self._pending: dict = {}     # name -> (result box, done event)
+        self._workers: dict = {}     # name -> (thread, request queue)
+
+    def __call__(self, name, handle):
+        if not self._timeout or self._timeout <= 0:
+            return self._fn(name, handle)
+        import queue as _queue
+        import threading
+
+        pend = self._pending.get(name)
+        if pend is not None:
+            box, done = pend
+            if not done.is_set():
+                return None          # still wedged: skip instantly
+            self._pending.pop(name, None)
+            return box.get("value")  # late result from a recovery
+        w = self._workers.get(name)
+        if w is None or not w[0].is_alive():
+            # ONE persistent worker per name, created lazily and fed
+            # through a queue — not a thread per call: the healthy
+            # common case (every replica, every 50ms tick) must not
+            # pay thread create/join churn to buy wedge protection
+            # for the rare frozen callable
+            req: _queue.Queue = _queue.Queue()
+
+            def loop():
+                while True:
+                    item = req.get()
+                    if item is None:
+                        return       # retired
+                    h, box_, done_ = item
+                    try:
+                        box_["value"] = self._fn(name, h)
+                    except Exception:
+                        box_["value"] = None
+                    done_.set()
+
+            th = threading.Thread(target=loop, daemon=True,
+                                  name=f"signals:{name}")
+            th.start()
+            w = (th, req)
+            self._workers[name] = w
+        box: dict = {}
+        done = threading.Event()
+        w[1].put((handle, box, done))
+        if done.wait(self._timeout):
+            return box.get("value")
+        self._pending[name] = (box, done)
+        return None
+
+    def discard_pending(self, name):
+        """Drop an outstanding call's future result (the drain
+        barrier: a payload captured before ``begin_drain`` must not
+        be served inside the drain wait). The worker keeps running —
+        a wedged call finishes into a box nobody reads."""
+        self._pending.pop(name, None)
+
+    def retire(self, name):
+        """The name will never be asked again (its replica stopped or
+        was replaced; numbering is monotonic): drop the pending box
+        (it would pin the stopped replica's handle) and shut the
+        worker down — the sentinel lets a wedged call finish into a
+        box nobody reads, then the thread exits instead of idling for
+        the rest of the run."""
+        self._pending.pop(name, None)
+        w = self._workers.pop(name, None)
+        if w is not None:
+            w[1].put(None)
+
+
 class AdaptiveElasticManager(ElasticManager):
     """Elastic training with scale-IN on failure and scale-OUT on worker
     re-admission, resuming each world from the latest checkpoint.
@@ -307,7 +393,8 @@ class AdaptiveElasticManager(ElasticManager):
                         drain_timeout: float, poll_interval: float,
                         state_fn=None, ckpt_dir=None,
                         checkpoint: bool = True,
-                        stop_event=None) -> bool:
+                        discard_stale_signals: bool = True,
+                        stop_event=None, view=None) -> bool:
         """The scale-in path, in the order that keeps it crash-safe:
         (1) checkpoint via the PR 2 CheckpointManager (atomic commit —
         a kill -9 anywhere after this leaves only committed state;
@@ -333,12 +420,42 @@ class AdaptiveElasticManager(ElasticManager):
             step = (mgr.latest_step() or 0) + 1
             mgr.save(step, dict(state_fn()), blocking=True)
         drain(name, handle)
+        if discard_stale_signals and hasattr(signals,
+                                             "discard_pending"):
+            # a signals() call that wedged BEFORE the drain could
+            # complete late with a pre-drain "idle" payload — its
+            # drain_safe must never authorize this stop. ONCE, when
+            # the drain first commits (the checkpoint=False retry
+            # discipline): re-discarding on every retry tick would
+            # re-spawn a bounded worker per tick for a wedged
+            # callable and re-block the loop by the full bound each
+            # time — the exact stall _BoundedSignals exists to
+            # prevent.
+            signals.discard_pending(name)
         deadline = time.monotonic() + drain_timeout
         while True:
-            try:
-                sig = signals(name, handle)
-            except Exception:
-                sig = None
+            sig = None
+            if view is not None:
+                # federation first: a fresh frame's autoscale payload
+                # answers drain_safe without touching the (possibly
+                # wedged, possibly remote) signals callable. Only a
+                # frame that already REFLECTS the drain counts — a
+                # pre-drain frame still inside the staleness window
+                # reports the idle state from before admission and
+                # must not authorize the stop (begin_drain
+                # force-publishes, so the draining frame arrives as
+                # fast as the transport can carry it).
+                view.poll([name])
+                frame = view.fresh_frames([name]).get(name)
+                if frame is not None and frame.get("draining"):
+                    sig = frame.get("autoscale")
+                    if not isinstance(sig, dict):
+                        sig = None   # remote input: fall through
+            if sig is None:
+                try:
+                    sig = signals(name, handle)
+                except Exception:
+                    sig = None
             if sig and sig.get("drain_safe"):
                 break
             if time.monotonic() >= deadline:
@@ -358,7 +475,9 @@ class AdaptiveElasticManager(ElasticManager):
                     heartbeat_timeout: float = 0.0,
                     state_fn=None, ckpt_dir: Optional[str] = None,
                     max_ticks: Optional[int] = None,
-                    stop_event=None) -> dict:
+                    stop_event=None, federation=None,
+                    fleet_burn_scaling: Optional[bool] = None,
+                    signal_timeout: Optional[float] = 5.0) -> dict:
         """Drive a serving-replica fleet against the autoscale signals.
 
         ``spawn(name) -> handle`` creates a replica; ``stop(name,
@@ -386,13 +505,43 @@ class AdaptiveElasticManager(ElasticManager):
         fleet — and the controller keeps retrying its drain (without
         re-checkpointing) until it completes. Returns a summary once
         ``max_ticks`` elapse or ``stop_event`` is set; the event log
-        rides ``self.events`` like the training paths."""
+        rides ``self.events`` like the training paths.
+
+        Fleet SLO federation (``monitor/federation.py``):
+        ``federation`` is a ``FleetSLOView`` over the replicas'
+        published telemetry frames — with one, each tick reads frames
+        NON-BLOCKING and the ``signals`` callable is only a fallback
+        for replicas with no fresh frame. ``fleet_burn_scaling``
+        (default ``FLAGS_serving_fleet_burn_scaling``, OFF — flags-off
+        decisions byte-identical) arms burn-aware actuation: a
+        federated latency-objective fast-burn adds one replica of
+        scale-out pressure even at flat demand, and scale-in is
+        REFUSED while the fleet burn alerts (latency objectives only —
+        the shed-on-burn ``load_only`` lesson: availability-fed
+        triggers self-lock; already-committed drains keep retrying).
+        With the flag on and no view passed, one is built over
+        ``heartbeat_dir``. ``signal_timeout`` bounds every USER-PASSED
+        ``signals`` call on a worker thread (None/<=0 = unbounded):
+        one frozen replica's callable delays a tick by at most the
+        bound ONCE — while its call is still outstanding the replica
+        is skipped (payload None), so heartbeat checks and scale-out
+        for the rest of the fleet keep running. The built-in default
+        (a direct in-process ``handle.autoscale_payload()`` read)
+        stays inline — it cannot wedge on a transport, and bounding
+        it would cost a thread per replica per tick. Beat hygiene:
+        stopping or replacing a replica sweeps its name-keyed beat
+        file and frame (``heartbeat.remove_named``), and spawning one
+        sweeps any leftover from a PRIOR controller incarnation (a
+        higher-seq dead frame would otherwise outrank the fresh
+        replica's), so a long-lived controller dir does not
+        accumulate dead replicas' files."""
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
                 f"[{min_replicas}, {max_replicas}]")
         from .. import heartbeat as _heartbeat
 
+        default_signals = signals is None
         if signals is None:
             def signals(name, h):
                 return h.autoscale_payload() \
@@ -401,8 +550,44 @@ class AdaptiveElasticManager(ElasticManager):
             def drain(name, h):
                 if hasattr(h, "begin_drain"):
                     h.begin_drain()
+        # the built-in default is a direct in-process attribute read —
+        # it cannot wedge on a remote transport, and bounding it would
+        # spawn a worker thread per replica per tick on the 50ms
+        # control loop for nothing; pass-through keeps the pre-bound
+        # inline semantics (discard_pending stays a no-op)
+        signals = _BoundedSignals(
+            signals, None if default_signals else signal_timeout)
+        from ...core import flags as _cflags
+        burn_scaling = bool(
+            _cflags.flag_value("serving_fleet_burn_scaling")
+            if fleet_burn_scaling is None else fleet_burn_scaling)
+        view = federation
+        if view is None and burn_scaling and heartbeat_dir:
+            from ...monitor import federation as _fed
+            view = _fed.FleetSLOView(heartbeat_dir)
+        if view is not None:
+            from ...monitor import federation as _fed
+            _fed.set_active_view(view)
+        # burn-actuation edge trackers (events record transitions, not
+        # every tick)
+        self._burn_pressure_on = False
+        self._burn_refused_on = False
         self.restarts = 0
         self.events = []
+        if burn_scaling and view is None:
+            # the flag promises burn-aware actuation, but with no
+            # federation view and no heartbeat_dir to build one over
+            # there is no telemetry to act on — burn_alert stays False
+            # forever and decisions degrade to demand-only scaling.
+            # Record the misconfiguration ONCE instead of silently
+            # behaving as if the flag were off.
+            self._record(ElasticStatus.RESTART,
+                         {"reason": "burn-scaling-no-telemetry",
+                          "detail": "FLAGS_serving_fleet_burn_scaling "
+                                    "is on but no federation view was "
+                                    "passed and no heartbeat_dir is "
+                                    "set — burn-aware scale-out/"
+                                    "scale-in refusal cannot engage"})
         replicas: dict = {}
         spawn_times: dict = {}
         next_idx = [0]
@@ -410,12 +595,45 @@ class AdaptiveElasticManager(ElasticManager):
         def _spawn(reason):
             name = f"replica{next_idx[0]}"
             next_idx[0] += 1
+            _sweep_name(name)
             replicas[name] = spawn(name)
             spawn_times[name] = time.time()
             self._record(ElasticStatus.RESTART,
                          {"reason": reason, "replica": name,
                           "replicas": len(replicas)})
             return name
+
+        def _sweep_name(name):
+            # transport-only name sweep: the global beat file + KV
+            # frame, and the view's OWN transport (a custom client /
+            # KV-only fleet the global-client remove_named cannot
+            # reach). At spawn time (numbering restarts at replica0
+            # every run) this clears a prior incarnation's leftover
+            # payload — its HIGHER seq would keep winning read_named's
+            # tiebreak, stamped fresh for one staleness window, then
+            # masking the live replica's frames until its seq caught
+            # up. In-memory view tracking is deliberately untouched
+            # here (in-process frame seeding for a name about to
+            # spawn is a supported pattern).
+            if heartbeat_dir:
+                _heartbeat.remove_named(heartbeat_dir, name)
+            if view is not None:
+                view.sweep(name)
+
+        def _gc_replica(name):
+            # beat-file + frame GC for a name that will NEVER be
+            # asked again (stopped or replaced; numbering is
+            # monotonic). One edit-wide contract for both retirement
+            # paths: the global transport, the view's OWN transport
+            # (custom client / KV-only fleets the global-client
+            # remove_named cannot reach) + its tracking, and the
+            # bounded-signals worker (a wedged call's pending box
+            # would pin the stopped replica's handle; its worker
+            # thread would idle for the rest of the run)
+            _sweep_name(name)
+            if view is not None:
+                view.forget(name)
+            signals.retire(name)
 
         for _ in range(min_replicas):
             _spawn("spawn")
@@ -457,6 +675,11 @@ class AdaptiveElasticManager(ElasticManager):
                                      {"reason": "stale-stop-failed",
                                       "replica": name,
                                       "detail": repr(e)})
+                    # GC AFTER the stop: a stale-but-recovering
+                    # replica could otherwise republish between
+                    # sweep and stop, resurrecting an orphan file
+                    # for a name no longer tracked
+                    _gc_replica(name)
                     self.restarts += 1
                     # >= : same budget semantics as the training paths
                     # (max_restarts replacements total, not N+1)
@@ -466,23 +689,75 @@ class AdaptiveElasticManager(ElasticManager):
                             {"reason": "restart budget exhausted"})
                         return {"replicas": list(replicas),
                                 "ticks": ticks, "events": self.events}
+            fed_fresh = {}
+            burn_alert = False
+            if view is not None:
+                # NON-BLOCKING telemetry: published frames answer for
+                # every replica with a fresh one; the signals callable
+                # is only the fallback below
+                try:
+                    view.poll(list(replicas))
+                    fed_fresh = view.fresh_frames(list(replicas))
+                    if burn_scaling:
+                        rep = view.fleet_report(list(replicas),
+                                                poll=False)
+                        burn_alert = bool(rep["alerting_load"])
+                except Exception:
+                    fed_fresh = {}
             payloads = {}
             for name, h in list(replicas.items()):
-                try:
-                    p = signals(name, h)
-                except Exception:
-                    p = None
+                frame = fed_fresh.get(name)
+                if frame is not None:
+                    # frame sub-blocks are remote input: a truthy
+                    # non-dict autoscale must contribute nothing, not
+                    # crash the tick
+                    p = frame.get("autoscale")
+                    if not isinstance(p, dict):
+                        p = None
+                else:
+                    try:
+                        p = signals(name, h)
+                    except Exception:
+                        p = None
                 if p:
                     payloads[name] = p
             if payloads:
                 import math as _math
-                demand = sum(p.get("demand_estimate", 0.0)
-                             for p in payloads.values())
+                # frame payloads are remote input: a malformed
+                # demand_estimate (a string, NaN) from one replica
+                # contributes nothing — it must not crash the fold or
+                # poison the fleet sum
+                demand = 0.0
+                for p in payloads.values():
+                    try:
+                        d = float(p.get("demand_estimate", 0.0))
+                    except (TypeError, ValueError):
+                        continue
+                    if _math.isfinite(d):
+                        demand += d
                 desired = max(int(_math.ceil(demand - 1e-9)), 0)
             else:
                 # no signals: hold effective capacity steady
                 desired = len(replicas) - len(draining)
             desired = min(max(desired, min_replicas), max_replicas)
+            if burn_scaling and burn_alert:
+                # fleet latency fast-burn = the current capacity is
+                # not meeting the SLO even when demand looks flat: one
+                # replica of scale-out pressure over the demand-based
+                # target (stable while the burn persists — pressure is
+                # +1 over demand, not +1 over capacity per tick, so it
+                # cannot escalate to max_replicas on its own)
+                desired = min(desired + 1, max_replicas)
+                if not self._burn_pressure_on:
+                    self._burn_pressure_on = True
+                    self._record(ElasticStatus.RESTART,
+                                 {"reason": "burn-pressure",
+                                  "desired": desired})
+            else:
+                # burn cleared (or scaling off): re-arm the
+                # once-per-episode transition events
+                self._burn_pressure_on = False
+                self._burn_refused_on = False
             # effective capacity excludes committed drains: a replica
             # that began draining sheds every submission, so demand
             # growth mid-drain spawns a replacement instead of
@@ -498,8 +773,22 @@ class AdaptiveElasticManager(ElasticManager):
                 # resume a committed drain first (no re-checkpoint)
                 target = next(n for n in replicas if n in draining)
             elif len(replicas) - len(draining) > desired:
-                target = next(n for n in reversed(list(replicas))
-                              if n not in draining)   # newest first
+                if burn_scaling and burn_alert:
+                    # scale-in REFUSED while the fleet burn alerts:
+                    # shrinking a fleet that is failing its latency
+                    # SLO digs the hole deeper. Latency objectives
+                    # only (load_only above) — the refusal itself can
+                    # never feed the trigger that caused it.
+                    if not self._burn_refused_on:
+                        self._burn_refused_on = True
+                        self._record(ElasticStatus.RESTART,
+                                     {"reason": "burn-scale-in-refused",
+                                      "desired": desired})
+                else:
+                    # (the burn-cleared else above already re-armed
+                    # the refused-episode tracker this tick)
+                    target = next(n for n in reversed(list(replicas))
+                                  if n not in draining)  # newest first
             if target is not None:
                 if target not in draining:
                     draining.add(target)
@@ -518,7 +807,8 @@ class AdaptiveElasticManager(ElasticManager):
                     poll_interval=poll_interval, state_fn=state_fn,
                     ckpt_dir=ckpt_dir,
                     checkpoint=target not in ckpted,
-                    stop_event=stop_event)
+                    discard_stale_signals=target not in ckpted,
+                    stop_event=stop_event, view=view)
                 ckpted.add(target)
                 if ok:
                     replicas.pop(target)
@@ -526,6 +816,7 @@ class AdaptiveElasticManager(ElasticManager):
                     draining.discard(target)
                     ckpted.discard(target)
                     drain_deadline.pop(target, None)
+                    _gc_replica(target)
                     self._record(ElasticStatus.RESTART,
                                  {"reason": "scale-in",
                                   "replica": target,

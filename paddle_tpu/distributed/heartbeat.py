@@ -199,6 +199,112 @@ def start_named(dir_path: str, name: str,
     return stop
 
 
+_NAMED_KV_PREFIX = "pt_named"
+
+
+def publish_named(name: str, payload: dict, *,
+                  dir_path: Optional[str] = None, client=None) -> bool:
+    """Publish a named participant's payload on BOTH transports: the
+    beat file (``touch_named`` — the payload IS the beat, so a replica
+    publishing telemetry frames needs no separate auto-beat daemon to
+    stay live under ``stale_names``) and the coordination-service KV
+    store (key ``pt_named/<name>``) for controllers with no shared
+    filesystem. Never raises; returns True when at least one transport
+    took the write."""
+    ok = False
+    d = _marker_dir(dir_path)
+    if d:
+        try:
+            touch_named(d, name, payload)
+            ok = True
+        except (OSError, TypeError, ValueError):
+            # TypeError/ValueError: a payload json.dumps can't take
+            # (e.g. numpy scalars from a user slo_fn) must report
+            # "transport took nothing", not crash the serving loop
+            # the docstring promises never to take down
+            pass
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        try:
+            client.key_value_set(f"{_NAMED_KV_PREFIX}/{name}",
+                                 json.dumps(payload),
+                                 allow_overwrite=True)
+            ok = True
+        except Exception:
+            pass
+    return ok
+
+
+def read_named(name: str, *, dir_path: Optional[str] = None,
+               client=None, env_fallback: bool = True) -> Optional[dict]:
+    """The freshest published payload for a named participant across
+    both transports (a ``seq`` field, when both carry one, breaks the
+    tie — the file and KV copies of one publisher never regress
+    against each other). None when neither transport has it.
+    ``env_fallback=False`` confines the file leg to the EXPLICIT
+    ``dir_path`` (skipped when None) instead of the
+    ``PADDLE_HEARTBEAT_DIR`` fallback — a KV-only reader must not
+    ingest an unrelated fleet's generic ``replicaN`` payloads off a
+    launcher-set env dir."""
+    best = None
+    d = _marker_dir(dir_path) if env_fallback else dir_path
+    if d:
+        try:
+            with open(os.path.join(d, f"{name}{_AUTO_SUFFIX}")) as f:
+                best = json.load(f)
+        except (OSError, ValueError):
+            best = None
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        try:
+            kv_payload = json.loads(_kv_try(
+                client, f"{_NAMED_KV_PREFIX}/{name}", probe_ms=10))
+        except Exception:
+            kv_payload = None
+        if isinstance(kv_payload, dict):
+            if not isinstance(best, dict) or \
+                    _seq_of(kv_payload) > _seq_of(best):
+                best = kv_payload
+    return best if isinstance(best, dict) else None
+
+
+def _seq_of(payload: dict) -> float:
+    """A payload's seq as a comparable number; -1 when missing or
+    malformed. Payloads are remote input — a corrupt KV copy carrying
+    ``"seq": "5"`` must lose the tiebreak, not raise a TypeError that
+    discards the valid file-transport copy too (and gets a healthy
+    frame-is-the-beat replica stale-killed)."""
+    s = payload.get("seq")
+    if isinstance(s, bool) or not isinstance(s, (int, float)) \
+            or s != s:
+        return -1
+    return s
+
+
+def remove_named(dir_path: Optional[str], name: str, *, client=None,
+                 env_fallback: bool = True):
+    """GC a stopped or replaced named participant: drop its beat file
+    and its KV payload key. Without this a long-lived controller dir
+    accumulates one ``<name>.alive`` per replica the fleet ever ran —
+    ``run_serving`` sweeps on every stop/replace. Idempotent, never
+    raises. ``env_fallback=False`` confines the file removal to the
+    EXPLICIT ``dir_path`` (skipped when None): a KV-only sweeper in a
+    process where the launcher exported ``PADDLE_HEARTBEAT_DIR`` must
+    not delete an unrelated fleet's beat files."""
+    d = _marker_dir(dir_path) if env_fallback else dir_path
+    if d:
+        try:
+            os.remove(os.path.join(d, f"{name}{_AUTO_SUFFIX}"))
+        except OSError:
+            pass
+    client = client if client is not None else _kv_client()
+    if client is not None:
+        try:
+            client.key_value_delete(f"{_NAMED_KV_PREFIX}/{name}")
+        except Exception:
+            pass
+
+
 def stale_names(dir_path: str, names, timeout: float,
                 started_at=None) -> Dict[str, str]:
     """{name: reason} for every stale named participant. Same contract

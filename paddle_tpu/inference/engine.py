@@ -445,6 +445,11 @@ class ServingEngine:
         # at any rate (PR 9's pattern, pinned by test)
         self._kv_chunks = 0
         self._kv_absmax_fn = None
+        # Fleet SLO federation (monitor/federation.py): an attached
+        # FramePublisher rides the per-scheduler-step host tick — one
+        # None check per step when unattached, pure host reads when
+        # attached (zero added device synchronizations at any rate)
+        self._frame_pub = None
         # registered-program FLOPs, cached per registry key: the cost
         # plane reads it once per chunk, not once per slot, and the
         # cached value keeps the per-dispatch cost at one dict lookup
@@ -725,6 +730,27 @@ class ServingEngine:
     def _retry_after(self) -> float:
         return _slo.retry_after_hint(self.autoscale_payload())
 
+    def publish_frames(self, name: str, dir_path: Optional[str] = None,
+                       *, min_interval_s: float = 0.25, client=None,
+                       local_only: bool = False, slo_fn=None):
+        """Opt this replica into fleet SLO federation
+        (``monitor/federation.py``): attach a frame publisher that
+        emits a compact versioned telemetry frame — autoscale payload,
+        per-objective burn/compliance, bounded tenant aggregates,
+        request terminal-state counters, drain state — on the existing
+        per-scheduler-step host tick, through the name-keyed heartbeat
+        transport (``dir_path`` file beats + coordination-service KV;
+        the frame IS the liveness beat). Pure host reads; zero added
+        device synchronizations at any publish rate. Returns the
+        publisher (one per engine; re-attaching replaces it)."""
+        from ..monitor import federation as _fed
+        self._frame_pub = _fed.FramePublisher(
+            name, dir_path=dir_path, client=client,
+            local_only=local_only,
+            min_interval_s=min_interval_s, slo_fn=slo_fn)
+        self._frame_pub.maybe_publish(self, force=True)
+        return self._frame_pub
+
     def _shed_submit(self, req: Request, why: str):
         """Refuse a WELL-FORMED submission by overload policy: typed
         :class:`EngineOverloaded` with the demand-model backoff hint,
@@ -823,6 +849,13 @@ class ServingEngine:
                 else:
                     self._finish_shed(r, "engine is draining")
             self.queue = keep
+        if self._frame_pub is not None:
+            # drain state must reach the federation controller now,
+            # not a rate-limit later — but only the TRANSITION forces:
+            # the controller re-invokes begin_drain every retry tick
+            # of a slow drain, and forcing each call would bypass the
+            # rate limit into per-tick transport I/O
+            self._frame_pub.maybe_publish(self, force=not already)
 
     @property
     def draining(self) -> bool:
@@ -1468,6 +1501,10 @@ class ServingEngine:
                 len(self.queue), len(live_idx), self.num_slots,
                 self.cache.alloc.free_pages / self.cache.num_pages
                 if self.cache.num_pages else 0.0)
+        if self._frame_pub is not None:
+            # federation frame on the same host tick (rate-limited
+            # inside; pure host state — zero device syncs)
+            self._frame_pub.maybe_publish(self)
         if not live_idx:
             return bool(self.queue) or any(
                 s is not None for s in self.slots)

@@ -51,6 +51,7 @@ __all__ = [
     "server", "programs", "memory", "fleet",
     "comms", "roofline",
     "exectime", "profile_capture", "timeseries", "numerics", "slo",
+    "federation",
     "start_server", "stop_server",
     "suppressed", "suppress_accounting",
 ]
@@ -212,7 +213,14 @@ def expose_text() -> str:
     escaping, not metric names; empty until a tenant records)."""
     text = _exposition.expose_text(_REGISTRY)
     tenant_text = slo.tenant_exposition_text()
-    return text + tenant_text if tenant_text else text
+    if tenant_text:
+        text += tenant_text
+    # federation per-replica attribution series (slo_fleet_replica_*
+    # {replica="..."}); empty until a federated report exists
+    fed_text = federation.exposition_text()
+    if fed_text:
+        text += fed_text
+    return text
 
 
 def dump_json(run_id: Optional[str] = None,
@@ -239,6 +247,7 @@ def reset():
     timeseries.reset()
     numerics.reset()
     slo.reset()
+    federation.reset()
     # the sharding inspector's registered trees empty with the rest
     # (module-reference lookup: reset() must not be the thing that
     # first imports the distributed package)
@@ -305,5 +314,8 @@ from . import numerics  # noqa: E402
 # SLO accounting plane (PR 12): per-request/per-tenant cost records,
 # error-budget burn rates, observe-only autoscaling signals.
 from . import slo  # noqa: E402
+# Fleet SLO federation (PR 15): per-replica telemetry frames + the
+# federated burn/compliance view the serving controller scales on.
+from . import federation  # noqa: E402
 from . import server  # noqa: E402
 from .server import start_server, stop_server  # noqa: E402

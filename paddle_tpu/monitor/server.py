@@ -41,6 +41,11 @@ module is the missing scrape target: a flag-gated stdlib
   objectives, windowed compliance ratios, fast/slow error-budget burn
   rates and budget remaining, per-tenant cost aggregates (bounded
   cardinality), and the observe-only autoscaling signals.
+- ``GET /fleet/serving`` — fleet SLO federation
+  (``monitor/federation.py``): per-replica telemetry frames, the
+  request-weighted federated burn/compliance verdict, and worst-first
+  per-replica attribution (on a controller: its view; on a replica:
+  the locally-published frames).
 - ``GET /profile?seconds=N`` — on-demand device profiler capture
   (``monitor/profile_capture.py``): one exclusive
   ``jax.profiler`` window into a bounded capture directory; a second
@@ -243,6 +248,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # is fresh exactly when someone asks
                 self._send_json(200, _slo.slo_snapshot(
                     headroom=_memory.headroom()))
+            elif route == "/fleet/serving":
+                from . import federation as _federation
+                self._send_json(
+                    200, _federation.fleet_serving_snapshot())
             elif route == "/profile":
                 self._profile(parse_qs(url.query))
             elif route == "/":
@@ -252,6 +261,7 @@ class _Handler(BaseHTTPRequestHandler):
                                "/healthz", "/flight", "/programs",
                                "/memory", "/roofline", "/sharding",
                                "/timeseries", "/numerics", "/slo",
+                               "/fleet/serving",
                                "/profile?seconds=N"],
                 })
             else:

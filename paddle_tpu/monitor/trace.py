@@ -230,6 +230,15 @@ def flight_payload(reason: str = "manual") -> dict:
         sl = _slo.slo_snapshot()
     except Exception:
         sl = None
+    try:
+        # the fleet story (monitor/federation.py): which replicas were
+        # publishing frames and what the last federated verdict said.
+        # Cached state only — no transport or backend reads on a crash
+        # path — and guarded like the other telemetry extras.
+        from . import federation as _federation
+        fd = _federation.flight_block()
+    except Exception:
+        fd = None
     return {
         "kind": "paddle_tpu.flight_record",
         "reason": reason,
@@ -242,6 +251,7 @@ def flight_payload(reason: str = "manual") -> dict:
         "timeseries": ts,
         "numerics": nm,
         "slo": sl,
+        "federation": fd,
     }
 
 

@@ -762,6 +762,168 @@ def main():
         eng.cache.alloc.check_invariants()
         assert eng.cache.alloc.free_pages == eng.cache.num_pages
 
+    @case("fleet_federation")
+    def _():
+        # fleet SLO federation end to end on the real backend: two
+        # in-process engines publish telemetry frames through the
+        # name-keyed heartbeat transport; the elastic controller
+        # (FLAGS_serving_fleet_burn_scaling on) scales OUT on an
+        # injected fast-burn at flat demand and refuses scale-in
+        # while it alerts; /fleet/serving names the burning replica
+        # on attribution line 1; beat files are swept on retirement
+        import json as _json
+        import tempfile
+        import threading
+        import urllib.request
+        from paddle_tpu.distributed import heartbeat as hb
+        from paddle_tpu.distributed.fleet.elastic import (
+            AdaptiveElasticManager)
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import federation as fed
+        from paddle_tpu.monitor import server as mon_server
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True})
+        fed.reset()
+        hb_dir = tempfile.mkdtemp(prefix="smoke_fed_")
+        cfg = L.llama_tiny(num_hidden_layers=1)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        burning = [True]
+
+        def burn_report():
+            # injected per-replica report: replica0 fast-burns while
+            # `burning` holds (the in-process engines share the global
+            # slo ring, so per-replica burns are injected here)
+            hot = burning[0]
+            return {"objectives": {"ttft_p99_ms": {
+                "compliance": 0.5 if hot else 1.0,
+                "burn_fast": 40.0 if hot else 0.0,
+                "burn_slow": 30.0 if hot else 0.0,
+                "samples_slow": 64, "samples_fast": 32,
+                "target_ratio": 0.99}},
+                "alerting": ["ttft_p99_ms"] if hot else []}
+
+        def healthy_report():
+            return {"objectives": {"ttft_p99_ms": {
+                "compliance": 1.0, "burn_fast": 0.0, "burn_slow": 0.0,
+                "samples_slow": 64, "samples_fast": 32,
+                "target_ratio": 0.99}}, "alerting": []}
+
+        engines = {}
+        stoppers = {}        # name -> (run_stop event, churn thread)
+        stopped = []
+
+        def spawn(name):
+            eng = ServingEngine(L, params, cfg, num_slots=2,
+                                max_len=16, page_size=4,
+                                decode_chunk=2)
+            eng.publish_frames(
+                name, hb_dir, min_interval_s=0.0,
+                slo_fn=burn_report if name == "replica0"
+                else healthy_report)
+            engines[name] = eng
+            run_stop = threading.Event()
+
+            def churn():
+                # a short real burst, then idle stepping: demand
+                # settles to ~0 (FLAT — the scale-out below must be
+                # attributable to the injected burn, not to load),
+                # while the per-step hook keeps publishing frames
+                for rid in range(3):
+                    try:
+                        eng.submit(Request(
+                            rid=rid,
+                            prompt=rng.integers(
+                                0, cfg.vocab_size, (3,))
+                            .astype(np.int32),
+                            max_new_tokens=2))
+                    except Exception:
+                        pass
+                while not run_stop.is_set():
+                    eng.step()
+                    time.sleep(0.002)
+
+            churn_th = threading.Thread(target=churn, daemon=True)
+            churn_th.start()
+            stoppers[name] = (run_stop, churn_th)
+            return eng
+
+        def stop(name, h):
+            # a real stop: halt the replica's loop BEFORE returning,
+            # so it cannot republish a frame after the controller's
+            # beat-file sweep
+            ev_th = stoppers.get(name)
+            if ev_th is not None:
+                ev_th[0].set()
+                ev_th[1].join(timeout=10)
+            stopped.append(name)
+
+        view = fed.FleetSLOView(hb_dir, staleness_s=10.0)
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+
+        def run_ctl():
+            mgr.run_serving(spawn, stop, min_replicas=1,
+                            max_replicas=2, poll_interval=0.02,
+                            heartbeat_dir=hb_dir, federation=view,
+                            fleet_burn_scaling=True,
+                            max_ticks=100_000, stop_event=done)
+
+        th = threading.Thread(target=run_ctl, daemon=True)
+        th.start()
+        try:
+            # injected fast-burn at flat demand -> scale-out to 2
+            deadline = time.monotonic() + 30
+            while len(engines) < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert len(engines) == 2, mgr.events
+            assert not stopped          # scale-in refused while hot
+            srv = mon_server.get_server()
+            assert srv is not None
+            deadline = time.monotonic() + 30
+            while True:
+                p = _json.load(urllib.request.urlopen(
+                    f"{srv.url}/fleet/serving", timeout=30))
+                if sorted(p["frames"]) == ["replica0", "replica1"] \
+                        or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.05)
+            assert p["source"] == "controller", p["source"]
+            assert sorted(p["frames"]) == ["replica0", "replica1"], \
+                sorted(p["frames"])
+            att = p["report"]["attribution"]
+            assert att[0]["replica"] == "replica0", att
+            assert att[0]["alerting"] is True, att
+            assert p["report"]["alerting"] == ["ttft_p99_ms"]
+            reasons = [d.get("reason") for _, _s, d in mgr.events]
+            assert "burn-pressure" in reasons, reasons
+            # burn clears -> demand (~0) wants 1 replica -> newest
+            # drained, stopped, beat file swept
+            burning[0] = False
+            deadline = time.monotonic() + 30
+            while "replica1" not in stopped \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert stopped == ["replica1"], (stopped, mgr.events)
+            deadline = time.monotonic() + 10
+            beat = os.path.join(hb_dir, "replica1.alive")
+            while os.path.exists(beat) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not os.path.exists(beat)
+        finally:
+            done.set()
+            th.join(timeout=10)
+            for ev, _th in stoppers.values():
+                ev.set()
+            mon_server.stop_server()
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+            import shutil
+            shutil.rmtree(hb_dir, ignore_errors=True)
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp

@@ -1,0 +1,126 @@
+"""Worker for the 2-process fleet-federation test (run via the launch
+CLI, not collected by pytest — the PR 7/8 ``_fleet_agg_worker``
+template).
+
+Each rank runs a tiny serving engine as one fleet replica and
+publishes telemetry frames over the coordination-service KV transport
+ONLY (``dir_path=None`` — no shared filesystem assumed). Rank 1
+injects a synthetic fast-burn into its frames; rank 0 builds a
+``FleetSLOView`` over the same KV store, federates both replicas, and
+serves ``/fleet/serving``. The parent test asserts:
+
+- both ranks published frames (seq advancing);
+- rank 0's federated report lists BOTH replicas;
+- attribution line 1 is the injected burner (replica1);
+- the rank-0 operator-plane scrape of ``/fleet/serving`` carries the
+  same verdict.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import urllib.request  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import heartbeat as hb  # noqa: E402
+from paddle_tpu.monitor import federation as fed  # noqa: E402
+from paddle_tpu.monitor import server  # noqa: E402
+
+
+def _burning_report():
+    """A synthetic fast-burn compliance report (the slo plane's shape)
+    rank 1 injects into its frames."""
+    return {
+        "objectives": {
+            "ttft_p99_ms": {"compliance": 0.5, "burn_fast": 40.0,
+                            "burn_slow": 30.0, "samples_slow": 64,
+                            "samples_fast": 32, "target_ratio": 0.99},
+        },
+        "alerting": ["ttft_p99_ms"],
+    }
+
+
+def _healthy_report():
+    return {
+        "objectives": {
+            "ttft_p99_ms": {"compliance": 1.0, "burn_fast": 0.0,
+                            "burn_slow": 0.0, "samples_slow": 64,
+                            "samples_fast": 32, "target_ratio": 0.99},
+        },
+        "alerting": [],
+    }
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    paddle.set_flags({"FLAGS_enable_monitor": True})
+
+    from paddle_tpu.inference import Request, ServingEngine
+    from paddle_tpu.models import llama as L
+
+    cfg = L.llama_tiny(num_hidden_layers=1)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(L, params, cfg, num_slots=2, max_len=16,
+                        page_size=4, decode_chunk=2)
+    name = f"replica{rank}"
+    slo_fn = _burning_report if rank == 1 else _healthy_report
+    pub = eng.publish_frames(name, None, min_interval_s=0.0,
+                             slo_fn=slo_fn)
+    rng = np.random.default_rng(rank)
+    eng.run([Request(rid=i,
+                     prompt=rng.integers(0, cfg.vocab_size, (4,))
+                     .astype(np.int32), max_new_tokens=3)
+             for i in range(3)])
+    print(f"PUBLISHED rank={rank} name={name} seq={pub.seq}",
+          flush=True)
+    assert pub.seq >= 2
+
+    # barrier-ish: both ranks must have published before rank 0 reads
+    from paddle_tpu.distributed import collective as coll
+    coll.barrier(tag="fedpub")
+
+    if rank == 0:
+        view = fed.FleetSLOView(None, staleness_s=60.0)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            view.poll(["replica0", "replica1"])
+            if len(view.fresh_frames()) == 2:
+                break
+            time.sleep(0.2)
+        rep = view.fleet_report(poll=False)
+        print(f"FEDERATED rank=0 "
+              f"replicas={','.join(rep['replicas'])}", flush=True)
+        att = rep["attribution"]
+        print(f"ATTRIBUTION rank=0 line1={att[0]['replica']}",
+              flush=True)
+        fed.set_active_view(view)
+        srv = server.start_server(port=0)
+        p = json.load(urllib.request.urlopen(
+            f"{srv.url}/fleet/serving", timeout=10))
+        ok = (p["source"] == "controller"
+              and sorted(p["frames"]) == ["replica0", "replica1"]
+              and p["report"]["alerting"] == ["ttft_p99_ms"])
+        burner = p["report"]["attribution"][0]["replica"]
+        print(f"SCRAPE rank=0 ok={1 if ok else 0} burner={burner}",
+              flush=True)
+        server.stop_server()
+    # keep rank 1 alive until rank 0 finished reading its KV frames
+    coll.barrier(tag="feddone")
+    # GC leaves the KV clean for whatever runs next in this store
+    hb.remove_named(None, name)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

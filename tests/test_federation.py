@@ -1,0 +1,1624 @@
+"""Fleet SLO federation (ISSUE 15): per-replica telemetry frames,
+federated burn-aware scaling, and the /fleet/serving surface.
+
+Fast-lane pins: frame schema/versioning, clock-skew-free staleness
+(stale/absent frames contribute NOTHING — never fabricated),
+request-weighted federation math against synthetic frames (exact
+ratios), flags-off byte-identical controller decisions on a recorded
+signal trace, fast-burn-at-flat-demand scale-out + alerting-burn
+scale-in refusal (both acceptance pins), the bounded legacy-signals
+fallback (one frozen replica delays a tick by at most its bound),
+heartbeat beat-file GC on stop/replace, the zero-device-sync pin via
+the exectime ``_block_until_ready`` indirection, and the
+/fleet/serving + exposition + flight surfaces. The 2-process
+launch-CLI case (frames over the KV transport, rank-0 scrape) is
+slow-marked.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.monitor import federation as fed
+from paddle_tpu.monitor import server
+from paddle_tpu.distributed import heartbeat as hb
+from paddle_tpu.distributed.fleet.elastic import (AdaptiveElasticManager,
+                                                  _BoundedSignals)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def mon():
+    monitor.reset()
+    server.stop_server()
+    pt.set_flags({"FLAGS_enable_monitor": True})
+    yield monitor
+    server.stop_server()
+    pt.set_flags({"FLAGS_enable_monitor": False,
+                  "FLAGS_enable_monitor_server": False})
+    monitor.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_federation():
+    fed.reset()
+    yield
+    fed.reset()
+
+
+class FakeKV:
+    def __init__(self):
+        self.d = {}
+
+    def key_value_set(self, k, v, allow_overwrite=False):
+        if not allow_overwrite and k in self.d:
+            raise RuntimeError(f"key exists: {k}")
+        self.d[k] = v
+
+    def key_value_try_get(self, k):
+        if k not in self.d:
+            raise KeyError(k)
+        return self.d[k]
+
+    def key_value_delete(self, k):
+        self.d.pop(k, None)
+
+
+def _tiny_engine(num_slots=2):
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny()
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return ServingEngine(L, params, cfg, num_slots=num_slots,
+                         max_len=32, page_size=4, decode_chunk=3), cfg
+
+
+def _requests(cfg, n, max_new=4, seed=0):
+    from paddle_tpu.inference import Request
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    prompt=rng.integers(0, cfg.vocab_size, (5,))
+                    .astype(np.int32),
+                    max_new_tokens=max_new) for i in range(n)]
+
+
+def _mk_frame(name, seq=1, *, demand=0.5, burn_fast=None,
+              compliance=None, samples=32, objective="ttft_p99_ms",
+              draining=False, drain_safe=True, tenants=None,
+              requests=None, version=fed.FRAME_VERSION):
+    """A synthetic frame with one objective's slo row."""
+    objectives = {}
+    if burn_fast is not None or compliance is not None:
+        objectives[objective] = {
+            "compliance": compliance,
+            "burn_fast": burn_fast,
+            "burn_slow": burn_fast,
+            "samples_slow": samples,
+            "samples_fast": samples,
+            "target_ratio": 0.99,
+        }
+    return {
+        "kind": fed.FRAME_KIND, "version": version, "name": name,
+        "seq": seq, "t": round(time.time(), 3),
+        "autoscale": {"demand_estimate": demand,
+                      "desired_capacity_hint": int(np.ceil(demand)),
+                      "queue_depth": 0, "live_slots": 0,
+                      "drain_safe": drain_safe},
+        "slo": {"objectives": objectives,
+                "alerting": [objective] if (burn_fast or 0) >= 14.4
+                else []},
+        "tenants": tenants or {},
+        "requests": requests or {"completed": samples},
+        "draining": draining, "drain_complete": drain_safe,
+    }
+
+
+class _StubEngine:
+    """Engine-shaped stand-in for publisher/surface tests that don't
+    need a real decode path (the real-engine pins — schema, the step
+    hook, zero-sync — keep a real ServingEngine; everything else
+    skips the compile cost)."""
+
+    def __init__(self):
+        from paddle_tpu.inference.engine import EngineStats
+        self.stats = EngineStats()
+        self.stats.admitted = self.stats.completed = 2
+        self.draining = False
+        self.drain_complete = True
+
+    def autoscale_payload(self):
+        return {"demand_estimate": 0.4, "desired_capacity_hint": 1,
+                "queue_depth": 0, "live_slots": 0, "drain_safe": True}
+
+
+class _FakeReplica:
+    def __init__(self, demand=0.0, drain_safe=True):
+        self.demand = demand
+        self._drain_safe = drain_safe
+        self.draining = False
+
+    def autoscale_payload(self):
+        return {"demand_estimate": self.demand,
+                "desired_capacity_hint": int(np.ceil(self.demand)),
+                "drain_safe": self._drain_safe}
+
+    def begin_drain(self):
+        self.draining = True
+
+
+# ---------------------------------------------------------------------------
+# frame schema + publisher
+# ---------------------------------------------------------------------------
+
+class TestFrameSchema:
+    def test_build_frame_fields_and_version(self, mon):
+        eng, cfg = _tiny_engine()
+        eng.run(_requests(cfg, 2))
+        frame = fed.build_frame(eng, name="r0", seq=3)
+        assert frame["kind"] == fed.FRAME_KIND
+        assert frame["version"] == fed.FRAME_VERSION == 1
+        assert frame["name"] == "r0" and frame["seq"] == 3
+        asc = frame["autoscale"]
+        assert asc["drain_safe"] is True        # drained engine
+        for obj in ("ttft_p99_ms", "availability"):
+            assert obj in frame["slo"]["objectives"]
+            row = frame["slo"]["objectives"][obj]
+            assert set(row) == {"compliance", "burn_fast", "burn_slow",
+                                "samples_slow", "samples_fast",
+                                "target_ratio"}
+        assert frame["requests"]["completed"] == 2
+        assert frame["requests"]["admitted"] == 2
+        assert frame["draining"] is False
+        assert frame["drain_complete"] is True
+        assert "default" in frame["tenants"]     # bounded table rides
+        json.dumps(frame)                        # JSON-serializable
+
+    def test_publisher_seq_rate_limit_and_force(self, mon):
+        eng, cfg = _tiny_engine()
+        clock = [0.0]
+        pub = fed.FramePublisher("r0", min_interval_s=1.0,
+                                 _time_fn=lambda: clock[0])
+        assert pub.maybe_publish(eng)["seq"] == 1
+        assert pub.maybe_publish(eng) is None          # rate-limited
+        assert pub.maybe_publish(eng, force=True)["seq"] == 2
+        clock[0] = 5.0
+        assert pub.maybe_publish(eng)["seq"] == 3
+        assert fed.local_frames()["r0"]["seq"] == 3
+
+    def test_publish_file_kv_roundtrip_prefers_higher_seq(self,
+                                                         tmp_path):
+        kv = FakeKV()
+        d = str(tmp_path)
+        hb.publish_named("r0", _mk_frame("r0", seq=1), dir_path=d,
+                         client=kv)
+        assert hb.read_named("r0", dir_path=d,
+                             client=kv)["seq"] == 1
+        # KV ahead of the file (a relay lag): reader takes the max seq
+        kv.key_value_set(f"{hb._NAMED_KV_PREFIX}/r0",
+                         json.dumps(_mk_frame("r0", seq=7)),
+                         allow_overwrite=True)
+        assert hb.read_named("r0", dir_path=d,
+                             client=kv)["seq"] == 7
+        # file ahead: file wins
+        hb.touch_named(d, "r0", _mk_frame("r0", seq=9))
+        assert hb.read_named("r0", dir_path=d,
+                             client=kv)["seq"] == 9
+
+    def test_engine_hook_publishes_and_frame_is_the_beat(self, mon,
+                                                         tmp_path):
+        d = str(tmp_path)
+        eng, cfg = _tiny_engine()
+        eng.publish_frames("replica0", d, min_interval_s=0.0)
+        eng.run(_requests(cfg, 2))
+        frame = hb.read_named("replica0", dir_path=d)
+        assert frame is not None and frame["seq"] >= 2
+        assert frame["requests"]["completed"] == 2
+        # the frame IS the liveness beat: stale_names sees it fresh
+        assert hb.stale_names(d, ["replica0"], timeout=30.0) == {}
+        # counted
+        snap = monitor.snapshot()
+        assert snap["counters"]["federation.frames.published"] >= 2
+
+    def test_begin_drain_force_publishes(self, mon, tmp_path):
+        d = str(tmp_path)
+        eng, cfg = _tiny_engine()
+        eng.publish_frames("replica0", d, min_interval_s=1e9)
+        eng.begin_drain()
+        frame = hb.read_named("replica0", dir_path=d)
+        assert frame["draining"] is True
+
+    def test_monitor_off_publishes_but_registers_nothing(self,
+                                                         tmp_path):
+        monitor.reset()
+        pt.set_flags({"FLAGS_enable_monitor": False})
+        d = str(tmp_path)
+        pub = fed.FramePublisher("replica0", d, min_interval_s=0.0)
+        assert pub.maybe_publish(_StubEngine()) is not None
+        # the explicit opt-in still publishes (a controller needs the
+        # demand signal regardless of the metrics plane)...
+        assert hb.read_named("replica0", dir_path=d) is not None
+        # ...and federating it writes no gauges either
+        view = fed.FleetSLOView(d, staleness_s=60.0)
+        view.fleet_report(["replica0"])
+        # the metrics registry stays empty
+        assert monitor.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# staleness (clock-skew-free) + version gating
+# ---------------------------------------------------------------------------
+
+class TestStaleness:
+    def test_fresh_then_stale_contributes_nothing(self):
+        clock = [0.0]
+        view = fed.FleetSLOView(staleness_s=5.0,
+                                _time_fn=lambda: clock[0])
+        view.ingest("a", _mk_frame("a", seq=1, burn_fast=20.0,
+                                   compliance=0.5, demand=0.8))
+        fresh, stale = view.frames()
+        assert "a" in fresh and not stale
+        rep = view.fleet_report(poll=False)
+        assert rep["objectives"]["ttft_p99_ms"]["burn_fast"] == 20.0
+        assert rep["demand"]["demand_estimate_sum"] == 0.8
+        clock[0] = 6.0                         # past the window
+        fresh, stale = view.frames()
+        assert not fresh and stale["a"] == 6.0
+        rep = view.fleet_report(poll=False)
+        # a stale frame contributes NOTHING — no objectives, no
+        # demand, no fabricated zeros
+        assert rep["objectives"] == {}
+        assert rep["demand"]["demand_estimate_sum"] is None
+        assert rep["demand"]["desired_capacity_hint"] is None
+        assert rep["attribution"] == []
+        assert rep["staleness"]["stale"] == {"a": 6.0}
+
+    def test_same_seq_does_not_reset_age_new_seq_does(self):
+        clock = [0.0]
+        view = fed.FleetSLOView(staleness_s=5.0,
+                                _time_fn=lambda: clock[0])
+        view.ingest("a", _mk_frame("a", seq=1))
+        clock[0] = 4.0
+        view.ingest("a", _mk_frame("a", seq=1))    # republish, no new
+        clock[0] = 6.0                             # 6s since seq change
+        assert view.frames()[0] == {}
+        view.ingest("a", _mk_frame("a", seq=2))    # a real new frame
+        assert "a" in view.frames()[0]
+
+    def test_absent_name_never_appears(self):
+        view = fed.FleetSLOView(staleness_s=5.0)
+        view.ingest("a", _mk_frame("a"))
+        fresh, stale = view.frames(names=["b"])
+        assert fresh == {} and stale == {}
+
+    def test_newer_version_dropped(self):
+        view = fed.FleetSLOView(staleness_s=5.0)
+        assert not view.ingest("a", _mk_frame(
+            "a", version=fed.FRAME_VERSION + 1))
+        assert not view.ingest("a", {"kind": "something-else"})
+        assert not view.ingest("a", _mk_frame("a", version="junk"))
+        assert view.frames()[0] == {}
+
+    def test_forget_drops_tracking(self):
+        view = fed.FleetSLOView(staleness_s=60.0)
+        view.ingest("a", _mk_frame("a"))
+        view.forget("a")
+        assert view.frames()[0] == {}
+
+    def test_poll_reads_transport(self, tmp_path):
+        d = str(tmp_path)
+        hb.publish_named("a", _mk_frame("a", seq=4), dir_path=d)
+        view = fed.FleetSLOView(d, staleness_s=60.0)
+        assert view.poll(["a", "missing"]) == 1
+        assert view.frames()[0]["a"]["seq"] == 4
+
+
+# ---------------------------------------------------------------------------
+# federation math (pure, exact)
+# ---------------------------------------------------------------------------
+
+class TestFederateMath:
+    def test_request_weighted_burn_and_compliance(self):
+        frames = {
+            "a": _mk_frame("a", compliance=0.9, burn_fast=10.0,
+                           samples=100),
+            "b": _mk_frame("b", compliance=0.99, burn_fast=1.0,
+                           samples=50),
+        }
+        rep = fed.federate(frames)
+        obj = rep["objectives"]["ttft_p99_ms"]
+        # (0.9*100 + 0.99*50) / 150
+        assert obj["compliance"] == pytest.approx(0.93)
+        # (10*100 + 1*50) / 150
+        assert obj["burn_slow"] == pytest.approx(7.0)
+        assert obj["burn_fast"] == pytest.approx(
+            (10.0 * 100 + 1.0 * 50) / 150)
+        assert obj["samples_slow"] == 150
+        assert obj["replicas_reporting"] == 2
+
+    def test_none_windows_never_fabricated(self):
+        frames = {"a": _mk_frame("a"),        # no slo rows at all
+                  "b": _mk_frame("b", compliance=None, burn_fast=None,
+                                 samples=0)}
+        rep = fed.federate(frames)
+        obj = rep["objectives"].get("ttft_p99_ms")
+        if obj is not None:
+            assert obj["compliance"] is None
+            assert obj["burn_fast"] is None
+            assert obj["burn_slow"] is None
+        assert rep["alerting"] == []
+
+    def test_alerting_threshold_and_load_view(self):
+        frames = {"a": _mk_frame("a", burn_fast=20.0, compliance=0.5,
+                                 samples=64)}
+        rep = fed.federate(frames)
+        assert rep["alerting"] == ["ttft_p99_ms"]
+        assert rep["alerting_load"] == ["ttft_p99_ms"]
+        # availability burn alone does NOT arm the load view
+        frames = {"a": _mk_frame("a", burn_fast=20.0, compliance=0.5,
+                                 samples=64, objective="availability")}
+        rep = fed.federate(frames)
+        assert rep["alerting"] == ["availability"]
+        assert rep["alerting_load"] == []
+
+    def test_attribution_burning_replica_is_line_one(self):
+        frames = {
+            "healthy": _mk_frame("healthy", compliance=1.0,
+                                 burn_fast=0.0, samples=64),
+            "burning": _mk_frame("burning", compliance=0.5,
+                                 burn_fast=50.0, samples=64),
+            "quiet": _mk_frame("quiet"),     # no slo data: last
+        }
+        att = fed.federate(frames)["attribution"]
+        assert [a["replica"] for a in att] == \
+            ["burning", "healthy", "quiet"]
+        assert att[0]["alerting"] is True
+        assert att[0]["objective"] == "ttft_p99_ms"
+        assert att[2]["burn_fast"] is None    # no data stays None
+
+    def test_tenant_and_request_sums_and_demand_ceiling(self):
+        frames = {
+            "a": _mk_frame("a", demand=0.6,
+                           tenants={"t1": {"requests": 3,
+                                           "decode_tokens": 10}},
+                           requests={"completed": 5, "shed": 1}),
+            "b": _mk_frame("b", demand=0.7,
+                           tenants={"t1": {"requests": 2},
+                                    "t2": {"requests": 9}},
+                           requests={"completed": 7, "expired": 2}),
+        }
+        rep = fed.federate(frames)
+        assert rep["tenants"]["t1"] == {"requests": 5,
+                                        "decode_tokens": 10}
+        assert rep["tenants"]["t2"] == {"requests": 9}
+        assert rep["requests"] == {"completed": 12, "shed": 1,
+                                   "expired": 2}
+        assert rep["demand"]["demand_estimate_sum"] == \
+            pytest.approx(1.3)
+        assert rep["demand"]["desired_capacity_hint"] == 2
+
+    def test_empty_fleet(self):
+        rep = fed.federate({})
+        assert rep["replicas"] == []
+        assert rep["objectives"] == {}
+        assert rep["demand"]["demand_estimate_sum"] is None
+
+
+# ---------------------------------------------------------------------------
+# controller actuation (acceptance pins)
+# ---------------------------------------------------------------------------
+
+def _run_controller(mgr, spawn, stop, done, out, **kw):
+    def run():
+        out.update(mgr.run_serving(spawn, stop, stop_event=done, **kw))
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th
+
+
+class TestControllerActuation:
+    def test_fast_burn_flat_demand_scales_out(self):
+        """Acceptance: a fleet latency fast-burn with FLAT demand
+        provably scales out — and the pressure is stable (+1 over the
+        demand target, not an escalation to max)."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+        view.ingest("replica0", _mk_frame(
+            "replica0", seq=1, demand=0.2, burn_fast=30.0,
+            compliance=0.5, samples=64))
+        replicas, stopped = {}, []
+
+        def spawn(name):
+            r = _FakeReplica(demand=0.0)
+            replicas[name] = r
+            return r
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        out = {}
+        th = _run_controller(
+            mgr, spawn, lambda n, h: stopped.append(n), done, out,
+            min_replicas=1, max_replicas=4, poll_interval=0.01,
+            federation=view, fleet_burn_scaling=True, max_ticks=2000)
+        deadline = time.monotonic() + 10
+        while len(replicas) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(replicas) == 2, "burn pressure did not scale out"
+        # pressure is stable: +1 over demand-desired (1) = 2, never 3
+        time.sleep(0.3)
+        assert len(replicas) == 2
+        assert not stopped        # and never scaled in while burning
+        done.set()
+        th.join(timeout=5)
+        reasons = [d.get("reason") for _, s, d in mgr.events]
+        assert "burn-pressure" in reasons
+
+    def test_alerting_burn_refuses_scale_in_until_clear(self):
+        """Acceptance: surplus capacity is NOT drained while the fleet
+        burn alerts; clearing the burn releases the scale-in."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+
+        def ingest_all(seq, burn, demand0):
+            for n in ("replica0", "replica1", "replica2"):
+                view.ingest(n, _mk_frame(
+                    n, seq=seq,
+                    demand=demand0 if n == "replica0" else 0.2,
+                    burn_fast=burn,
+                    compliance=0.5 if burn else 1.0, samples=64))
+
+        replicas, stopped = {}, []
+
+        def spawn(name):
+            r = _FakeReplica(demand=0.0)
+            replicas[name] = r
+            return r
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        out = {}
+        ingest_all(1, 0.0, demand0=2.5)         # healthy high demand
+        th = _run_controller(
+            mgr, spawn, lambda n, h: stopped.append(n), done, out,
+            min_replicas=1, max_replicas=4, poll_interval=0.01,
+            federation=view, fleet_burn_scaling=True, max_ticks=20000)
+        deadline = time.monotonic() + 5
+        while len(replicas) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(replicas) == 3               # demand scaled to 3
+        # demand collapses AND the fleet burns: desired = demand(1) +
+        # pressure(1) = 2 < live 3 — scale-in is wanted but refused
+        ingest_all(2, 30.0, demand0=0.2)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not any(
+                d.get("reason") == "burn-scale-in-refused"
+                for _, s, d in mgr.events):
+            time.sleep(0.01)
+        assert any(d.get("reason") == "burn-scale-in-refused"
+                   for _, s, d in mgr.events)
+        assert stopped == []                    # nothing drained
+        assert not any(r.draining for r in replicas.values())
+        ingest_all(3, 0.0, demand0=0.2)         # burn clears
+        deadline = time.monotonic() + 10
+        while not stopped and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done.set()
+        th.join(timeout=5)
+        assert stopped and stopped[0] == "replica2"  # newest drained
+
+    def test_flags_off_decisions_byte_identical_on_recorded_trace(self):
+        """Acceptance: with FLAGS_serving_fleet_burn_scaling off (the
+        default), the controller's decisions on a deterministic signal
+        trace are byte-identical to the pre-federation controller —
+        the exact event sequence of the demand-only policy."""
+        assert not pt.get_flags(
+            ["FLAGS_serving_fleet_burn_scaling"]
+        )["FLAGS_serving_fleet_burn_scaling"]
+        replicas, stopped = {}, []
+        tick = [0]
+        # recorded trace: 5 ticks at fleet demand 2.6 (scale 1->3),
+        # then flat 0.2 (scale 3->1, newest first, one per tick)
+        demand_by_tick = [2.6] * 5 + [0.2] * 200
+
+        def spawn(name):
+            r = _FakeReplica(demand=0.0, drain_safe=True)
+            replicas[name] = r
+            return r
+
+        def signals(name, h):
+            if name == "replica0":
+                # replica0 is polled first each gather: it carries the
+                # whole fleet's scripted demand and advances the tick
+                t = min(tick[0], len(demand_by_tick) - 1)
+                tick[0] += 1
+                return {"demand_estimate": demand_by_tick[t],
+                        "drain_safe": True}
+            return {"demand_estimate": 0.0,
+                    "drain_safe": True}
+
+        mgr = AdaptiveElasticManager()
+        out = mgr.run_serving(
+            spawn, lambda n, h: stopped.append(n), signals=signals,
+            min_replicas=1, max_replicas=4, poll_interval=0.001,
+            drain_timeout=5.0, max_ticks=40)
+        decisions = [(s, d.get("reason"), d.get("replica"))
+                     for _, s, d in mgr.events]
+        # the pre-PR controller's exact decision sequence, byte for
+        # byte: initial spawn, two scale-outs on the first 2.6 tick,
+        # then newest-first scale-ins once demand falls to 0.2
+        assert decisions == [
+            ("restart", "spawn", "replica0"),
+            ("restart", "scale-out", "replica1"),
+            ("restart", "scale-out", "replica2"),
+            ("restart", "scale-in", "replica2"),
+            ("restart", "scale-in", "replica1"),
+            ("exit", "max_ticks", None),
+        ], decisions
+        assert stopped == ["replica2", "replica1"]
+        assert out["replicas"] == ["replica0"]
+
+    def test_frames_replace_signals_calls(self):
+        """With a view holding fresh frames, the legacy callable is
+        never consulted for those replicas — the tick is frame-fed."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+        view.ingest("replica0", _mk_frame("replica0", seq=1,
+                                          demand=2.4))
+        calls = []
+
+        def signals(name, h):
+            calls.append(name)
+            return {"demand_estimate": 0.0, "drain_safe": True}
+
+        replicas = {}
+
+        def spawn(name):
+            r = _FakeReplica()
+            replicas[name] = r
+            return r
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        th = _run_controller(
+            mgr, spawn, lambda n, h: None, done, {},
+            signals=signals, min_replicas=1, max_replicas=3,
+            poll_interval=0.01, federation=view, max_ticks=2000)
+        deadline = time.monotonic() + 10
+        while (len(replicas) < 3 or "replica1" not in calls) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done.set()
+        th.join(timeout=5)
+        # frame demand 2.4 drove the scale-out to 3...
+        assert len(replicas) == 3
+        # ...and replica0 (fresh frame) was never signalled; the
+        # frame-less replicas used the fallback
+        assert "replica0" not in calls
+        assert "replica1" in calls
+
+
+# ---------------------------------------------------------------------------
+# control-loop isolation: bounded legacy callable
+# ---------------------------------------------------------------------------
+
+class TestControlLoopIsolation:
+    def test_bounded_signals_frozen_call_skipped_next_time(self):
+        frozen = threading.Event()
+        calls = []
+
+        def signals(name, h):
+            calls.append(name)
+            if name == "stuck":
+                frozen.wait()          # never set: wedged forever
+            return {"demand_estimate": 1.0}
+
+        b = _BoundedSignals(signals, timeout=0.2)
+        t0 = time.monotonic()
+        assert b("stuck", None) is None          # waited one bound
+        first = time.monotonic() - t0
+        assert 0.15 <= first < 2.0
+        t0 = time.monotonic()
+        assert b("stuck", None) is None          # skipped instantly
+        assert time.monotonic() - t0 < 0.05
+        assert b("ok", None) == {"demand_estimate": 1.0}
+        assert calls.count("stuck") == 1         # no thread stacking
+        frozen.set()
+
+    def test_bounded_signals_passthrough_and_recovery(self):
+        gate = threading.Event()
+
+        def signals(name, h):
+            gate.wait(0.4)
+            return {"demand_estimate": 2.0}
+
+        b = _BoundedSignals(signals, timeout=0.1)
+        assert b("r", None) is None              # blew the bound
+        gate.set()
+        time.sleep(0.5)                          # worker finished late
+        assert b("r", None) == {"demand_estimate": 2.0}  # late result
+        # unbounded passthrough
+        ub = _BoundedSignals(lambda n, h: {"x": 1}, timeout=None)
+        assert ub("r", None) == {"x": 1}
+
+    def test_bounded_signals_reuses_one_worker_and_retires(self):
+        """The healthy common case (every replica, every tick) rides
+        ONE persistent worker per name — no thread create/join per
+        call; retire() shuts the worker down so a stopped replica's
+        thread does not idle for the rest of the run."""
+        idents = []
+
+        def signals(name, h):
+            idents.append(threading.get_ident())
+            return {"demand_estimate": 1.0}
+
+        b = _BoundedSignals(signals, timeout=1.0)
+        for _ in range(5):
+            assert b("r", None) == {"demand_estimate": 1.0}
+        assert len(idents) == 5
+        assert len(set(idents)) == 1             # one worker, reused
+        assert idents[0] != threading.get_ident()
+        th = b._workers["r"][0]
+        b.retire("r")
+        th.join(timeout=2)
+        assert not th.is_alive()                 # worker shut down
+        assert "r" not in b._workers
+
+    def test_frozen_replica_does_not_stall_the_fleet(self):
+        """The isolation pin: one replica whose signals callable hangs
+        forever delays each tick by at most the bound — heartbeat
+        checks and scale-out for the rest of the fleet keep running."""
+        frozen = threading.Event()
+        demand0 = [1.2]
+
+        def signals(name, h):
+            if name == "replica1":
+                frozen.wait()                    # wedged forever
+                return None
+            return {"demand_estimate": demand0[0],
+                    "drain_safe": True}
+
+        replicas = {}
+
+        def spawn(name):
+            r = _FakeReplica()
+            replicas[name] = r
+            return r
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        th = _run_controller(
+            mgr, spawn, lambda n, h: None, done, {},
+            signals=signals, min_replicas=2, max_replicas=3,
+            poll_interval=0.01, signal_timeout=0.2, max_ticks=100000)
+        time.sleep(0.5)                          # past the first bound
+        demand0[0] = 2.5                         # demand rises
+        deadline = time.monotonic() + 5
+        while len(replicas) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done.set()
+        th.join(timeout=5)
+        frozen.set()
+        # the frozen replica1 did not stall the loop: the demand rise
+        # on replica0 still scaled the fleet out within the deadline
+        assert len(replicas) == 3, mgr.events
+
+    def test_default_inprocess_signals_not_thread_bounded(
+            self, monkeypatch):
+        """The built-in default signals (a direct in-process
+        ``autoscale_payload()`` read) is pass-through — it cannot
+        wedge on a transport, and bounding it would spawn a worker
+        thread per replica per tick on the control loop. A
+        user-passed callable keeps the bound."""
+        import paddle_tpu.distributed.fleet.elastic as el
+        timeouts = []
+        real = el._BoundedSignals
+
+        class Spy(real):
+            def __init__(self, fn, timeout):
+                timeouts.append(timeout)
+                super().__init__(fn, timeout)
+
+        monkeypatch.setattr(el, "_BoundedSignals", Spy)
+        mgr = AdaptiveElasticManager()
+        mgr.run_serving(lambda n: _FakeReplica(), lambda n, h: None,
+                        min_replicas=1, max_replicas=1,
+                        poll_interval=0.001, max_ticks=3)
+        assert timeouts == [None]            # default: inline
+        mgr2 = AdaptiveElasticManager()
+        mgr2.run_serving(lambda n: _FakeReplica(), lambda n, h: None,
+                         signals=lambda n, h: {"demand_estimate": 0.0,
+                                               "drain_safe": True},
+                         min_replicas=1, max_replicas=1,
+                         poll_interval=0.001, max_ticks=3)
+        assert timeouts == [None, 5.0]       # user callable: bounded
+
+
+# ---------------------------------------------------------------------------
+# heartbeat beat-file GC
+# ---------------------------------------------------------------------------
+
+class TestBeatFileGC:
+    def test_remove_named_file_and_kv(self, tmp_path):
+        d = str(tmp_path)
+        kv = FakeKV()
+        hb.publish_named("r0", _mk_frame("r0"), dir_path=d, client=kv)
+        assert os.path.exists(os.path.join(d, "r0.alive"))
+        hb.remove_named(d, "r0", client=kv)
+        assert not os.path.exists(os.path.join(d, "r0.alive"))
+        assert f"{hb._NAMED_KV_PREFIX}/r0" not in kv.d
+        hb.remove_named(d, "r0", client=kv)      # idempotent
+
+    def test_scale_in_sweeps_beat_file_no_stale_report(self, tmp_path):
+        """The satellite pin: stop -> sweep -> no stale report, no
+        accumulating beat files."""
+        d = str(tmp_path)
+        replicas, stopped = {}, []
+
+        def spawn(name):
+            r = _FakeReplica(demand=2.2 if name == "replica0" else 0.0)
+            replicas[name] = r
+            hb.touch_named(d, name)              # the replica beats
+            return r
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        th = _run_controller(
+            mgr, spawn, lambda n, h: stopped.append(n), done, {},
+            min_replicas=1, max_replicas=3, poll_interval=0.01,
+            heartbeat_dir=d, heartbeat_timeout=30.0, max_ticks=100000)
+        deadline = time.monotonic() + 5
+        while len(replicas) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        replicas["replica0"].demand = 0.2        # load falls off
+        deadline = time.monotonic() + 10
+        while len(stopped) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done.set()
+        th.join(timeout=5)
+        assert stopped == ["replica2", "replica1"]
+        # swept: the retired replicas' beat files are GONE...
+        for name in stopped:
+            assert not os.path.exists(os.path.join(d, f"{name}.alive"))
+            # ...and a later scan over the name reports nothing (no
+            # file, no started_at -> silent, not "stale forever")
+            assert hb.stale_names(d, [name], timeout=0.001) == {}
+        # the survivor's beat file remains
+        assert os.path.exists(os.path.join(d, "replica0.alive"))
+
+    def test_stale_replace_sweeps_beat_file(self, tmp_path):
+        d = str(tmp_path)
+        replicas, stopped, beat_stops = {}, [], []
+
+        def spawn(name):
+            r = _FakeReplica(demand=0.0)
+            replicas[name] = r
+            if name == "replica0":
+                hb.touch_named(d, name)          # beats once, then dies
+            else:
+                # the replacement keeps beating via its own thread
+                beat_stops.append(hb.start_named(d, name,
+                                                 interval=0.05))
+            return r
+
+        mgr = AdaptiveElasticManager(max_restarts=3)
+        done = threading.Event()
+        th = _run_controller(
+            mgr, spawn, lambda n, h: stopped.append(n), done, {},
+            min_replicas=1, max_replicas=2, poll_interval=0.05,
+            heartbeat_dir=d, heartbeat_timeout=0.3, max_ticks=100000)
+        deadline = time.monotonic() + 10
+        while "replica0" not in stopped and time.monotonic() < deadline:
+            time.sleep(0.02)
+        done.set()
+        th.join(timeout=5)
+        for ev in beat_stops:
+            ev.set()
+        assert "replica0" in stopped             # stale-replaced
+        assert "replica1" in replicas
+        assert not os.path.exists(os.path.join(d, "replica0.alive"))
+        reasons = [x[2].get("reason") for x in mgr.events]
+        assert "stale-replace" in reasons
+
+    def test_scale_in_sweeps_view_transport_kv_only(self):
+        """KV-only fleet (no shared filesystem, a view with its OWN
+        client — the deployment read_named's KV leg exists for):
+        scale-in retirement sweeps the retired name's pt_named key
+        through the VIEW's transport, not just the global client."""
+        kv = FakeKV()
+        view = fed.FleetSLOView(None, client=kv, staleness_s=0.01)
+        replicas, stopped = {}, []
+
+        def spawn(name):
+            r = _FakeReplica(demand=2.2 if name == "replica0" else 0.0)
+            replicas[name] = r
+            # the replica publishes one frame into the KV store; the
+            # tiny staleness window hands demand control back to the
+            # signals fallback right away
+            hb.publish_named(name, _mk_frame(name, seq=1), client=kv)
+            return r
+
+        mgr = AdaptiveElasticManager()
+        done = threading.Event()
+        th = _run_controller(
+            mgr, spawn, lambda n, h: stopped.append(n), done, {},
+            min_replicas=1, max_replicas=3, poll_interval=0.01,
+            federation=view, max_ticks=100000)
+        deadline = time.monotonic() + 5
+        while len(replicas) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(replicas) == 3
+        replicas["replica0"].demand = 0.2        # load falls off
+        deadline = time.monotonic() + 10
+        while len(stopped) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done.set()
+        th.join(timeout=5)
+        assert stopped == ["replica2", "replica1"]
+        for name in stopped:
+            assert f"{hb._NAMED_KV_PREFIX}/{name}" not in kv.d
+        # the survivor's frame is untouched
+        assert f"{hb._NAMED_KV_PREFIX}/replica0" in kv.d
+
+    def test_spawn_sweeps_prior_incarnation_frame(self, tmp_path):
+        """A prior controller incarnation that exited with replicas
+        live leaves a high-seq replica0 frame behind (file + KV); the
+        next incarnation's spawn sweeps the name, so the dead frame
+        is neither stamped fresh for a staleness window nor allowed
+        to outrank the fresh replica's restart-at-1 publisher in
+        ``read_named``'s seq tiebreak."""
+        d = str(tmp_path)
+        kv = FakeKV()
+        hb.publish_named("replica0",
+                         _mk_frame("replica0", seq=500, demand=3.9),
+                         dir_path=d, client=kv)
+        view = fed.FleetSLOView(d, client=kv, staleness_s=120.0)
+        replicas = {}
+
+        def spawn(name):
+            r = _FakeReplica(demand=0.0)
+            replicas[name] = r
+            return r
+
+        mgr = AdaptiveElasticManager()
+        out = mgr.run_serving(
+            spawn, lambda n, h: None, min_replicas=1, max_replicas=4,
+            poll_interval=0.001, heartbeat_dir=d, federation=view,
+            max_ticks=30)
+        # swept at spawn: file + KV gone before the first poll
+        assert not os.path.exists(os.path.join(d, "replica0.alive"))
+        assert f"{hb._NAMED_KV_PREFIX}/replica0" not in kv.d
+        # and the dead frame's demand (3.9 -> 4 replicas) never fed
+        # the controller: the live replica's 0.0 demand held the fleet
+        assert out["replicas"] == ["replica0"]
+        assert not any(x[2].get("reason") == "scale-out"
+                       for x in mgr.events)
+
+
+# ---------------------------------------------------------------------------
+# zero device synchronizations
+# ---------------------------------------------------------------------------
+
+class TestZeroSync:
+    def test_frame_publication_adds_zero_syncs_at_any_rate(
+            self, mon, tmp_path, monkeypatch):
+        """Acceptance: publishing every scheduler step adds ZERO
+        block_until_ready calls (the exectime indirection counts every
+        added synchronization; the engine's own paths add none at
+        sample rate 0)."""
+        from paddle_tpu.monitor import exectime
+        exectime.set_sample_rate(0)
+        calls = []
+        monkeypatch.setattr(exectime, "_block_until_ready",
+                            lambda outputs: calls.append(1))
+        try:
+            eng, cfg = _tiny_engine()
+            eng.publish_frames("r0", str(tmp_path), min_interval_s=0.0)
+            eng.run(_requests(cfg, 3))
+            assert eng.stats.completed == 3
+            assert fed.local_frames()["r0"]["seq"] >= 3
+            assert calls == []
+        finally:
+            exectime.set_sample_rate(None)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /fleet/serving, exposition, gauges, flight record
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestSurfaces:
+    def test_fleet_serving_route_local_mode(self, mon, tmp_path):
+        pub = fed.FramePublisher("replica0", str(tmp_path),
+                                 min_interval_s=0.0)
+        pub.maybe_publish(_StubEngine())
+        srv = server.start_server(port=0)
+        code, body = _get(f"{srv.url}/fleet/serving")
+        assert code == 200
+        p = json.loads(body)
+        assert p["kind"] == "paddle_tpu.fleet_serving"
+        assert p["source"] == "local"
+        assert "replica0" in p["frames"]
+        assert p["report"]["attribution"][0]["replica"] == "replica0"
+        # listed on the root index
+        code, body = _get(f"{srv.url}/")
+        assert "/fleet/serving" in json.loads(body)["routes"]
+
+    def test_fleet_serving_route_controller_mode_names_burner(
+            self, mon):
+        view = fed.FleetSLOView(staleness_s=120.0)
+        view.ingest("good", _mk_frame("good", burn_fast=0.5,
+                                      compliance=1.0, samples=64))
+        view.ingest("bad", _mk_frame("bad", burn_fast=40.0,
+                                     compliance=0.4, samples=64))
+        fed.set_active_view(view)
+        srv = server.start_server(port=0)
+        code, body = _get(f"{srv.url}/fleet/serving")
+        assert code == 200
+        p = json.loads(body)
+        assert p["source"] == "controller"
+        rep = p["report"]
+        assert rep["attribution"][0]["replica"] == "bad"
+        assert rep["attribution"][0]["alerting"] is True
+        assert rep["alerting"] == ["ttft_p99_ms"]
+        assert sorted(rep["staleness"]["fresh"]) == ["bad", "good"]
+
+    def test_gauges_and_labeled_exposition(self, mon):
+        view = fed.FleetSLOView(staleness_s=120.0)
+        hostile = 'evil"\n\\replica'
+        view.ingest(hostile, _mk_frame(hostile, burn_fast=20.5,
+                                       compliance=0.5, samples=64,
+                                       demand=0.7))
+        view.fleet_report(poll=False)
+        snap = monitor.snapshot()["gauges"]
+        assert snap["slo.fleet.replicas_fresh"] == 1
+        assert snap["slo.fleet.alerting"] == 1
+        assert snap["slo.fleet.demand_estimate"] == \
+            pytest.approx(0.7)
+        assert snap["slo.fleet.desired_capacity_hint"] == 1
+        assert snap["slo.fleet.ttft_p99_ms.burn_fast"] == \
+            pytest.approx(20.5)
+        text = monitor.expose_text()
+        # per-replica attribution series with the PR 7 label escaping:
+        # hostile replica names round-trip, never raw bytes
+        assert ('slo_fleet_replica_burn_fast{replica='
+                '"evil\\"\\n\\\\replica"} 20.5') in text, \
+            [ln for ln in text.splitlines()
+             if "slo_fleet_replica" in ln]
+        assert 'slo_fleet_replica_alerting{replica=' in text
+
+    def test_flight_record_federation_block(self, mon, tmp_path):
+        pub = fed.FramePublisher("replica0", str(tmp_path),
+                                 min_interval_s=0.0)
+        pub.maybe_publish(_StubEngine())
+        from paddle_tpu.monitor import trace
+        payload = trace.flight_payload(reason="test")
+        fd = payload["federation"]
+        assert fd is not None
+        assert "replica0" in fd["local_frames"]
+        assert fd["local_frames"]["replica0"]["seq"] >= 1
+        json.dumps(payload)            # crash-dump parseable
+
+    def test_no_frames_no_block_no_exposition(self, mon):
+        assert fed.flight_block() is None
+        assert fed.exposition_text() == ""
+        snap = fed.fleet_serving_snapshot()
+        assert snap["frames"] == {} and snap["report"] is None
+
+
+# ---------------------------------------------------------------------------
+# review hardening pins (code-review findings, all applied)
+# ---------------------------------------------------------------------------
+
+class TestReviewHardening:
+    def test_pre_drain_frame_never_authorizes_stop(self):
+        """A fresh frame captured BEFORE begin_drain (draining=False,
+        drain_safe=True — the replica was idle, then admitted work)
+        must not let _drain_and_stop stop the replica; a frame that
+        reflects the drain does."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+        view.ingest("r", _mk_frame("r", seq=1, draining=False,
+                                   drain_safe=True))
+        stopped = []
+        mgr = AdaptiveElasticManager()
+        ok = mgr._drain_and_stop(
+            "r", object(), signals=lambda n, h: None,
+            drain=lambda n, h: None,
+            stop=lambda n, h: stopped.append(n),
+            drain_timeout=0.3, poll_interval=0.02, view=view)
+        assert not ok and stopped == []       # pre-drain frame ignored
+        view.ingest("r", _mk_frame("r", seq=2, draining=True,
+                                   drain_safe=True))
+        ok = mgr._drain_and_stop(
+            "r", object(), signals=lambda n, h: None,
+            drain=lambda n, h: None,
+            stop=lambda n, h: stopped.append(n),
+            drain_timeout=2.0, poll_interval=0.02, view=view)
+        assert ok and stopped == ["r"]
+
+    def test_drain_barrier_discards_late_pre_drain_signal(self):
+        """A signals() call that wedged before the drain completing
+        late must not serve its pre-drain idle payload inside the
+        drain wait (the discard_pending barrier)."""
+        gate = threading.Event()
+
+        def signals(name, h):
+            gate.wait(0.3)
+            return {"demand_estimate": 0.0, "drain_safe": True}
+
+        b = _BoundedSignals(signals, timeout=0.05)
+        assert b("r", None) is None           # wedged pre-drain
+        gate.set()
+        time.sleep(0.4)                       # it finished late...
+        b.discard_pending("r")                # ...but the drain began
+        t0 = time.monotonic()
+        got = b("r", None)                    # fresh post-drain call
+        assert got == {"demand_estimate": 0.0, "drain_safe": True}
+        assert time.monotonic() - t0 < 0.2    # (fresh, not cached)
+
+    def test_publisher_retries_after_transport_failure(self, tmp_path):
+        """A configured-but-failing transport must not wait out a long
+        rate limit — but the retry sits behind a short backoff, never
+        per-step: a dead disk must not turn every scheduler tick into
+        transport I/O (the local registry keeps the frame either
+        way)."""
+        clock = [0.0]
+        bad = os.path.join(str(tmp_path), "missing", "x")
+        pub = fed.FramePublisher("r0", bad, min_interval_s=10.0,
+                                 _time_fn=lambda: clock[0])
+        eng = _StubEngine()
+        # publish_named makedirs the missing dir, so break it harder:
+        # a FILE where the dir should be
+        open(os.path.join(str(tmp_path), "missing"), "w").close()
+        assert pub.maybe_publish(eng) is not None
+        assert fed.local_frames()["r0"]["seq"] == 1
+        clock[0] = 0.1                  # inside the failure backoff
+        assert pub.maybe_publish(eng) is None       # NOT per-step
+        clock[0] = 0.3                  # backoff (0.25s) spent, far
+        #                                 inside the 10s rate limit
+        assert pub.maybe_publish(eng) is not None   # retried
+        # a WORKING local-only publisher (no transport configured)
+        # keeps its full rate limit
+        ok_pub = fed.FramePublisher("r1", None, min_interval_s=10.0,
+                                    _time_fn=lambda: clock[0])
+        assert ok_pub.maybe_publish(eng) is not None
+        clock[0] = 0.5
+        assert ok_pub.maybe_publish(eng) is None
+
+    def test_publisher_env_dir_fallback_failure_arms_retry(
+            self, tmp_path, monkeypatch):
+        """The fast-retry must key on the transport publish_named
+        ACTUALLY uses: a publisher relying on the PADDLE_HEARTBEAT_DIR
+        fallback (the launch-CLI worker pattern) whose env dir fails
+        deserves the same short backoff as an explicit dir_path — not
+        a full rate-limit window of frame gap."""
+        broken = os.path.join(str(tmp_path), "asfile")
+        open(broken, "w").close()       # a FILE where the dir should be
+        monkeypatch.setenv("PADDLE_HEARTBEAT_DIR",
+                           os.path.join(broken, "d"))
+        clock = [0.0]
+        pub = fed.FramePublisher("r0", None, min_interval_s=10.0,
+                                 _time_fn=lambda: clock[0])
+        eng = _StubEngine()
+        assert pub.maybe_publish(eng) is not None   # local frame kept
+        clock[0] = 0.1
+        assert pub.maybe_publish(eng) is None       # backoff holds
+        clock[0] = 0.3                  # backoff spent, far inside the
+        #                                 10s rate limit
+        assert pub.maybe_publish(eng) is not None   # retried
+        # with NO transport anywhere (env cleared), the full rate
+        # limit holds — no frame build every backoff for a publisher
+        # with nowhere to write
+        monkeypatch.delenv("PADDLE_HEARTBEAT_DIR")
+        pub2 = fed.FramePublisher("r1", None, min_interval_s=10.0,
+                                  _time_fn=lambda: clock[0])
+        assert pub2.maybe_publish(eng) is not None
+        clock[0] = 0.8
+        assert pub2.maybe_publish(eng) is None
+
+    def test_failing_frame_build_backs_off_and_counts(self, mon):
+        """A frame build that raises (a raising slo_fn, a malformed
+        report) gets the SAME short backoff as a failing transport —
+        not a retry on every scheduler step of the decode hot path —
+        and is counted, not silent (the frame is the liveness beat, so
+        a silently never-publishing replica gets stale-killed with no
+        diagnostic). seq is not burned on failed builds."""
+        clock = [0.0]
+        boom = [True]
+
+        def slo_fn():
+            if boom[0]:
+                raise RuntimeError("injected")
+            return {"objectives": {}, "alerting": []}
+
+        pub = fed.FramePublisher("r0", None, min_interval_s=10.0,
+                                 slo_fn=slo_fn,
+                                 _time_fn=lambda: clock[0])
+        eng = _StubEngine()
+        assert pub.maybe_publish(eng) is None
+        clock[0] = 0.1
+        assert pub.maybe_publish(eng) is None       # backoff holds...
+        clock[0] = 0.15
+        assert pub.maybe_publish(eng) is None       # ...not per-step
+        # exactly ONE build attempt was paid: the two held calls
+        # never reached build_frame (that is the backoff working)
+        counters = monitor.snapshot()["counters"]
+        assert counters["federation.frames.build_errors"] == 1
+        clock[0] = 0.3                              # backoff spent
+        boom[0] = False                             # build recovers
+        frame = pub.maybe_publish(eng)
+        assert frame is not None and frame["seq"] == 1  # seq unburned
+        assert "r0" in fed.local_frames()
+
+    def test_kv_only_view_never_touches_env_dir(self, tmp_path,
+                                                monkeypatch):
+        """A KV-only view's file leg must not resolve through the
+        PADDLE_HEARTBEAT_DIR fallback (the launcher exports it to
+        every worker): sweep must not delete, and poll must not
+        ingest, an unrelated fleet's generic replicaN files there."""
+        env_dir = str(tmp_path)
+        monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", env_dir)
+        # an unrelated fleet's beat file + frame in the env dir
+        other = _mk_frame("replica0", seq=99, demand=7.0,
+                          burn_fast=50.0, compliance=0.1, samples=64)
+        hb.touch_named(env_dir, "replica0", other)
+        kv = FakeKV()
+        view = fed.FleetSLOView(None, client=kv, staleness_s=60.0)
+        # poll: nothing on the view's own (KV) transport -> no ingest
+        # of the env dir's foreign frame
+        assert view.poll(["replica0"]) == 0
+        assert view.fresh_frames() == {}
+        # sweep: the foreign fleet's beat file survives
+        view.sweep("replica0")
+        assert os.path.exists(
+            os.path.join(env_dir, "replica0.alive"))
+        # the view's OWN transport still works both ways
+        mine = _mk_frame("replica0", seq=1, demand=0.5,
+                         burn_fast=0.0, compliance=1.0, samples=64)
+        kv.key_value_set("pt_named/replica0", json.dumps(mine),
+                         allow_overwrite=True)
+        view._next_read.clear()
+        assert view.poll(["replica0"]) == 1
+        view.sweep("replica0")
+        assert "pt_named/replica0" not in kv.d
+
+    def test_burn_scaling_without_telemetry_records_event(self):
+        """FLAGS_serving_fleet_burn_scaling on with NO federation view
+        and NO heartbeat_dir to build one over cannot engage — the
+        controller must record the misconfiguration once instead of
+        silently degrading to demand-only scaling."""
+        mgr = AdaptiveElasticManager()
+        mgr.run_serving(lambda n: _FakeReplica(demand=0.0),
+                        lambda n, h: None,
+                        min_replicas=1, max_replicas=2,
+                        poll_interval=0.01, fleet_burn_scaling=True,
+                        max_ticks=3)
+        reasons = [d.get("reason") for _, s, d in mgr.events]
+        assert reasons.count("burn-scaling-no-telemetry") == 1
+        # with a view passed, the event does not fire
+        mgr2 = AdaptiveElasticManager()
+        mgr2.run_serving(lambda n: _FakeReplica(demand=0.0),
+                         lambda n, h: None,
+                         min_replicas=1, max_replicas=2,
+                         poll_interval=0.01, fleet_burn_scaling=True,
+                         federation=fed.FleetSLOView(staleness_s=1.0),
+                         max_ticks=3)
+        assert not any(d.get("reason") == "burn-scaling-no-telemetry"
+                       for _, s, d in mgr2.events)
+
+    def test_fleet_gauge_names_bounded_to_known_objectives(self, mon):
+        """Gauge NAMES are process-global and permanent: objective
+        names inside frames are remote input, so slo.fleet.<obj>.*
+        gauges are minted only for the slo plane's closed objective
+        set — a buggy publisher varying objective names per publish
+        must not grow the registry without bound (the tenant-label
+        cardinality discipline, applied to metric names)."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+        for i in range(5):
+            view.ingest("r0", _mk_frame(
+                "r0", seq=i + 1, burn_fast=20.0, compliance=0.8,
+                samples=64, objective=f"req-{i}-ttft"))
+            view.fleet_report(["r0"], poll=False)
+        snap = monitor.snapshot()["gauges"]
+        assert not any("req-" in k for k in snap), sorted(snap)
+        # ...while the hostile objectives still ride the bounded
+        # report JSON, and canonical objectives still gauge
+        rep = fed.last_report()
+        assert "req-4-ttft" in rep["objectives"]
+        view.ingest("r0", _mk_frame("r0", seq=99, burn_fast=20.0,
+                                    compliance=0.8, samples=64))
+        view.fleet_report(["r0"], poll=False)
+        snap = monitor.snapshot()["gauges"]
+        assert "slo.fleet.ttft_p99_ms.burn_fast" in snap
+
+    def test_warn_threshold_shared_with_slo_plane(self, monkeypatch):
+        """One threshold governs both planes: federate() reads the
+        slo plane's env/default, so a custom PADDLE_TPU_SLO_BURN_WARN
+        moves the fleet verdict with the per-replica alerts."""
+        frames = {"a": _mk_frame("a", burn_fast=5.0, compliance=0.9,
+                                 samples=64)}
+        assert fed.federate(frames)["alerting"] == []     # 5 < 14.4
+        monkeypatch.setenv("PADDLE_TPU_SLO_BURN_WARN", "4.0")
+        assert fed.federate(frames)["alerting"] == ["ttft_p99_ms"]
+
+    def test_drain_retry_ticks_do_not_rearm_the_bound(self):
+        """The drain barrier discards ONCE, at commit: a committed
+        drain's per-tick retries must not re-spawn a bounded worker
+        for a wedged callable and re-block the loop by the full bound
+        every tick (the no-thread-stacking guarantee)."""
+        frozen = threading.Event()
+        calls = []
+
+        def signals(name, h):
+            calls.append(name)
+            frozen.wait()                     # wedged forever
+
+        b = _BoundedSignals(signals, timeout=0.2)
+        mgr = AdaptiveElasticManager()
+        kw = dict(signals=b, drain=lambda n, h: None,
+                  stop=lambda n, h: None, drain_timeout=0.05,
+                  poll_interval=0.01)
+        # commit tick: the barrier discards + one bounded call
+        assert not mgr._drain_and_stop("r", object(),
+                                       discard_stale_signals=True,
+                                       **kw)
+        n_commit = len(calls)
+        # retry ticks (the run_serving checkpoint=False discipline):
+        # the pending wedge is honored — skipped instantly, no new
+        # worker spawned, tick not re-blocked by the bound
+        for _ in range(3):
+            t0 = time.monotonic()
+            assert not mgr._drain_and_stop(
+                "r", object(), discard_stale_signals=False, **kw)
+            assert time.monotonic() - t0 < 0.15
+        assert len(calls) == n_commit         # no thread stacking
+        frozen.set()
+
+    def test_local_only_publisher_touches_no_transport(
+            self, tmp_path, monkeypatch):
+        """local_only frames must not fall back to a configured
+        PADDLE_HEARTBEAT_DIR (the bench publisher's contract: no beat
+        files nobody sweeps in a live heartbeat dir)."""
+        d = str(tmp_path)
+        monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", d)
+        pub = fed.FramePublisher("bench-r0", None, local_only=True,
+                                 min_interval_s=0.0)
+        assert pub.maybe_publish(_StubEngine()) is not None
+        assert fed.local_frames()["bench-r0"]["seq"] == 1
+        assert not os.path.exists(os.path.join(d, "bench-r0.alive"))
+        # without local_only, dir_path=None DOES fall back to the env
+        # dir — the heartbeat convention run_serving replicas rely on
+        pub2 = fed.FramePublisher("real-r0", None, min_interval_s=0.0)
+        assert pub2.maybe_publish(_StubEngine()) is not None
+        assert os.path.exists(os.path.join(d, "real-r0.alive"))
+
+    def test_concurrent_publish_serialized_monotonic_seq(
+            self, tmp_path, monkeypatch):
+        """The replica's step thread and the controller's begin_drain
+        force-publish race on one publisher: the publish lock
+        serializes whole frames in seq order — the transport never
+        sees an out-of-order publish (a lower-seq pre-drain frame
+        landing AFTER the forced draining frame would stall the
+        drain gate), and the local registry holds the highest seq."""
+        published = []
+
+        def slow_publish(name, payload, *, dir_path=None, client=None):
+            published.append(payload["seq"])
+            time.sleep(0.002)               # widen the race window
+            return True
+
+        monkeypatch.setattr(hb, "publish_named", slow_publish)
+        pub = fed.FramePublisher("r0", str(tmp_path),
+                                 min_interval_s=0.0)
+        eng = _StubEngine()
+
+        def burst():
+            for _ in range(5):
+                pub.maybe_publish(eng, force=True)
+
+        threads = [threading.Thread(target=burst) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(published) == 20
+        assert published == sorted(published)    # strictly in order
+        assert len(set(published)) == 20         # no duplicate seqs
+        assert fed.local_frames()["r0"]["seq"] == max(published)
+
+    def test_malformed_frame_degrades_never_crashes(self):
+        """Frame fields are remote input: one publisher emitting
+        non-numeric / NaN compliance, burn, samples, or demand must
+        contribute nothing (never fabricated) — not crash federation
+        (and 500 /fleet/serving) for the whole fleet."""
+        good = _mk_frame("good", burn_fast=20.0, compliance=0.8,
+                         samples=64, demand=1.5)
+        bad = _mk_frame("bad", burn_fast=1.0, compliance=0.9,
+                        samples=32, demand=2.0)
+        row = bad["slo"]["objectives"]["ttft_p99_ms"]
+        row["burn_fast"] = "n/a"
+        row["burn_slow"] = float("nan")
+        row["compliance"] = [0.9]
+        row["samples_slow"] = "many"
+        bad["autoscale"]["demand_estimate"] = float("nan")
+        bad["requests"]["completed"] = float("inf")
+        bad["tenants"] = {"t0": {"completed": "x"}}
+        rep = fed.federate({"good": good, "bad": bad})
+        obj = rep["objectives"]["ttft_p99_ms"]
+        # every fleet value == the good replica alone
+        assert obj["burn_fast"] == pytest.approx(20.0)
+        assert obj["compliance"] == pytest.approx(0.8)
+        assert obj["samples_slow"] == 64     # "many" dropped
+        assert obj["replicas_reporting"] == 1
+        assert rep["demand"]["demand_estimate_sum"] == \
+            pytest.approx(1.5)               # NaN dropped, not summed
+        assert rep["demand"]["desired_capacity_hint"] == 2
+        assert rep["requests"]["completed"] == 64   # inf dropped
+        assert rep["tenants"]["t0"] == {}    # non-numeric dropped
+        # attribution: the malformed replica ranks LAST with no data
+        assert [a["replica"] for a in rep["attribution"]] == \
+            ["good", "bad"]
+        assert rep["attribution"][1]["burn_fast"] is None
+        assert rep["attribution"][1]["alerting"] is False
+
+    def test_non_dict_sub_blocks_degrade_never_crash(self):
+        """The _num leaf discipline extends to SUB-BLOCKS: a truthy
+        non-dict slo/objectives/autoscale/requests/tenants block (or
+        a string objective row) bypasses the `or {}` guards and must
+        degrade like an absent block — never raise through the
+        fold."""
+        good = _mk_frame("good", burn_fast=20.0, compliance=0.8,
+                         samples=64, demand=1.5)
+        for block in ({"slo": "x"}, {"autoscale": "oops"},
+                      {"requests": "x"}, {"tenants": "x"},
+                      {"slo": {"objectives": "x", "alerting": []}},
+                      {"slo": {"objectives": {"ttft_p99_ms": "row"},
+                               "alerting": []}}):
+            bad = _mk_frame("bad", seq=2, demand=0.0)
+            bad.update(block)
+            rep = fed.federate({"good": good, "bad": bad})
+            obj = rep["objectives"]["ttft_p99_ms"]
+            assert obj["burn_fast"] == pytest.approx(20.0), block
+            assert rep["demand"]["desired_capacity_hint"] == 2, block
+
+    def test_corrupt_kv_seq_and_unprovable_seq(self, tmp_path):
+        """A corrupt KV copy carrying a non-numeric seq loses the
+        read_named tiebreak (the valid file copy is served — no
+        TypeError that would discard BOTH transports and get a
+        healthy frame-is-the-beat replica stale-killed), and a frame
+        whose seq cannot prove publication order is never ingested
+        (a NaN seq would re-stamp freshness every poll)."""
+        d = str(tmp_path)
+        kv = FakeKV()
+        hb.publish_named("r0", _mk_frame("r0", seq=3), dir_path=d)
+        kv.d[f"{hb._NAMED_KV_PREFIX}/r0"] = json.dumps(
+            {**_mk_frame("r0"), "seq": "5"})
+        got = hb.read_named("r0", dir_path=d, client=kv)
+        assert got["seq"] == 3               # file copy served
+        view = fed.FleetSLOView(staleness_s=120.0)
+        assert not view.ingest("r0", {**_mk_frame("r0"), "seq": "x"})
+        assert not view.ingest("r0", {**_mk_frame("r0"),
+                                      "seq": float("nan")})
+        assert view.fresh_frames() == {}
+
+    def test_non_dict_autoscale_in_controller_and_drain_gate(self):
+        """A fresh frame whose autoscale block is a truthy non-dict
+        contributes nothing to the controller tick (no crash), and a
+        draining frame with one falls through to the signals callable
+        at the drain gate instead of crashing run_serving."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+        f = _mk_frame("replica0", seq=1)
+        f["autoscale"] = "oops"
+        view.ingest("replica0", f)
+        mgr = AdaptiveElasticManager()
+        out = mgr.run_serving(
+            lambda n: _FakeReplica(), lambda n, h: None,
+            min_replicas=1, max_replicas=4, poll_interval=0.001,
+            federation=view, fleet_burn_scaling=True, max_ticks=20)
+        assert out["replicas"] == ["replica0"]   # held steady
+        view.ingest("r", _mk_frame("r", seq=1, draining=True))
+        view.fresh_frames(["r"])["r"]["autoscale"] = "oops"
+        stopped = []
+        mgr2 = AdaptiveElasticManager()
+        ok = mgr2._drain_and_stop(
+            "r", object(), signals=lambda n, h: {"drain_safe": True},
+            drain=lambda n, h: None,
+            stop=lambda n, h: stopped.append(n),
+            drain_timeout=2.0, poll_interval=0.02, view=view)
+        assert ok and stopped == ["r"]
+
+    def test_malformed_frame_demand_does_not_crash_controller(self):
+        """The controller's own demand fold sits outside the view's
+        try/except: a frame whose demand_estimate is a string (or
+        NaN — math.ceil(NaN) raises) must contribute nothing, not
+        crash run_serving."""
+        view = fed.FleetSLOView(staleness_s=120.0)
+        f = _mk_frame("replica0", seq=1, demand=1.0)
+        f["autoscale"]["demand_estimate"] = "lots"
+        view.ingest("replica0", f)
+        mgr = AdaptiveElasticManager()
+        out = mgr.run_serving(
+            lambda n: _FakeReplica(), lambda n, h: None,
+            min_replicas=1, max_replicas=4, poll_interval=0.001,
+            federation=view, max_ticks=20)
+        assert out["replicas"] == ["replica0"]   # held steady
+
+    def test_unconfigured_view_sweep_touches_no_env_transport(
+            self, tmp_path, monkeypatch):
+        """A transportless (in-process seeded) view's sweep must not
+        fall back to PADDLE_HEARTBEAT_DIR / the global KV client and
+        delete an unrelated live fleet's generic replicaN beat files
+        (the local_only publisher lesson, applied to the sweep)."""
+        d = str(tmp_path)
+        monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", d)
+        hb.touch_named(d, "replica0")        # an unrelated live fleet
+        view = fed.FleetSLOView(staleness_s=120.0)
+        view.sweep("replica0")
+        assert os.path.exists(os.path.join(d, "replica0.alive"))
+        # a view WITH its transport configured does sweep it
+        view2 = fed.FleetSLOView(d, staleness_s=120.0)
+        view2.sweep("replica0")
+        assert not os.path.exists(os.path.join(d, "replica0.alive"))
+
+    def test_poll_throttles_transport_reads(self, tmp_path,
+                                            monkeypatch):
+        """run_serving polls every tick (50ms), but on old jaxlib an
+        ABSENT pt_named key costs a blocking ~10ms KV probe per name:
+        per-name transport reads are capped at read_interval_s, and a
+        name found on neither transport backs off absent_backoff_s —
+        both far inside the staleness window, so freshness holds."""
+        d = str(tmp_path)
+        hb.publish_named("a", _mk_frame("a", seq=1), dir_path=d)
+        clock = [0.0]
+        reads = []
+        real = hb.read_named
+
+        def counting(name, **kw):
+            reads.append(name)
+            return real(name, **kw)
+
+        monkeypatch.setattr(hb, "read_named", counting)
+        view = fed.FleetSLOView(d, staleness_s=120.0,
+                                _time_fn=lambda: clock[0])
+        assert view.poll(["a", "b"]) == 1        # both read once
+        assert reads == ["a", "b"]
+        clock[0] = 0.1                           # inside both holds
+        view.poll(["a", "b"])
+        assert reads == ["a", "b"]               # no new reads
+        clock[0] = 0.3                           # past read_interval
+        view.poll(["a", "b"])
+        assert reads == ["a", "b", "a"]          # absent b held back
+        clock[0] = 1.4                           # past absent backoff
+        view.poll(["a", "b"])
+        assert reads.count("b") == 2
+        # forget clears the throttle: a respawned name reads NOW
+        view.forget("b")
+        view.poll(["b"])
+        assert reads.count("b") == 3
+
+    def test_stale_replace_prunes_pending_signals_entry(
+            self, tmp_path, monkeypatch):
+        """A wedged signals call's pending entry is dropped when its
+        replica is stale-replaced: the name is never asked again
+        (numbering is monotonic), and the entry would otherwise pin
+        the stopped replica's handle for the rest of the run."""
+        import paddle_tpu.distributed.fleet.elastic as el
+        instances = []
+        real = el._BoundedSignals
+
+        class Spy(real):
+            def __init__(self, fn, timeout):
+                instances.append(self)
+                super().__init__(fn, timeout)
+
+        monkeypatch.setattr(el, "_BoundedSignals", Spy)
+        d = str(tmp_path)
+        frozen = threading.Event()
+
+        def signals(name, h):
+            if name == "replica0":
+                frozen.wait()                    # wedged forever
+            return {"demand_estimate": 0.0, "drain_safe": True}
+
+        replicas, stopped, beat_stops = {}, [], []
+
+        def spawn(name):
+            r = _FakeReplica()
+            replicas[name] = r
+            if name == "replica0":
+                hb.touch_named(d, name)          # beats once, dies
+            else:
+                beat_stops.append(hb.start_named(d, name,
+                                                 interval=0.05))
+            return r
+
+        mgr = AdaptiveElasticManager(max_restarts=3)
+        done = threading.Event()
+        th = _run_controller(
+            mgr, spawn, lambda n, h: stopped.append(n), done, {},
+            signals=signals, signal_timeout=0.1, min_replicas=1,
+            max_replicas=2, poll_interval=0.05, heartbeat_dir=d,
+            heartbeat_timeout=0.3, max_ticks=100000)
+        deadline = time.monotonic() + 10
+        while "replica0" not in stopped and time.monotonic() < deadline:
+            time.sleep(0.02)
+        done.set()
+        th.join(timeout=5)
+        for ev in beat_stops:
+            ev.set()
+        assert "replica0" in stopped             # stale-replaced
+        assert instances and "replica0" not in instances[0]._pending
+        frozen.set()
+
+    def test_slo_report_ttl_cache_bounds_window_scans(self,
+                                                      monkeypatch):
+        """Frame publication must not push the slo window scan back
+        onto the scheduler step at the frame rate (the PR 12
+        pull-shaped hardening): the report is TTL-cached."""
+        from paddle_tpu.monitor import slo as mon_slo
+        calls = []
+        real = mon_slo.compliance_report
+
+        def counting():
+            calls.append(1)
+            return real()
+
+        monkeypatch.setattr(mon_slo, "compliance_report", counting)
+        clock = [0.0]
+        pub = fed.FramePublisher("r0", None, min_interval_s=0.0,
+                                 slo_cache_s=0.5,
+                                 _time_fn=lambda: clock[0])
+        eng = _StubEngine()
+        for i in range(10):                   # 10 publishes inside TTL
+            clock[0] = i * 0.01
+            pub.maybe_publish(eng, force=True)
+        assert len(calls) == 1                # one scan, not ten
+        clock[0] = 1.0                        # TTL expired
+        pub.maybe_publish(eng, force=True)
+        assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# 2-process launch-CLI federation (KV transport)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestTwoProcessFederation:
+    def test_frames_over_kv_rank0_scrape_names_both(self, tmp_path):
+        """The PR 7/8 template: two launch-CLI ranks each publish
+        frames over the coordination-service KV store; rank 0
+        federates them and serves /fleet/serving — both replicas
+        present, the injected burner is attribution line 1."""
+        worker = os.path.join(REPO, "tests", "_federation_worker.py")
+        log_dir = str(tmp_path / "logs")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", log_dir, worker],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+        logs = {}
+        for rank in range(2):
+            p = os.path.join(log_dir, f"workerlog.{rank}")
+            logs[rank] = open(p).read() if os.path.exists(p) else ""
+        blob = logs[0] + logs[1]
+        assert r.returncode == 0, blob[-4000:]
+        assert "PUBLISHED rank=0 name=replica0" in blob, blob[-4000:]
+        assert "PUBLISHED rank=1 name=replica1" in blob, blob[-4000:]
+        assert "FEDERATED rank=0 replicas=replica0,replica1" in blob, \
+            blob[-4000:]
+        assert "ATTRIBUTION rank=0 line1=replica1" in blob, blob[-4000:]
+        assert "SCRAPE rank=0 ok=1 burner=replica1" in blob, \
+            blob[-4000:]
