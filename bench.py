@@ -845,6 +845,25 @@ def _main():
     except Exception as e:                      # noqa: BLE001
         payload["extra"]["serving_paged"] = {
             "error": f"{type(e).__name__}: {e}"[:500]}
+    # Pin the guarded SLO block to the serving_paged rung's post-warmup
+    # observations NOW: the trace-replay rung below runs more requests
+    # through the same process-global latency histograms, and folding
+    # those into extra.metrics.slo would silently change what the
+    # lower-is-better ttft/tpot guard rungs measure between rounds.
+    _slo_snapshot = _slo_block()
+
+    # Trace-replay rung: the deterministic loadgen harness end to end —
+    # seeded multi-tenant arrival trace + scripted overload burst
+    # through the overload-policy engine, scored by the SLO scorecard
+    # (loadgen/scorecard.py). Optional like the rungs above.
+    try:
+        _stage("serving-trace-replay-rung", 240)
+        jax.clear_caches()
+        payload["extra"]["serving_trace_replay"] = \
+            _serving_trace_replay_rung(on_tpu)
+    except Exception as e:                      # noqa: BLE001
+        payload["extra"]["serving_trace_replay"] = {
+            "error": f"{type(e).__name__}: {e}"[:500]}
 
     # Packed-training rung: a heavy-tailed document-length trace trained
     # sequence-PACKED (segment-masked flash attention, io/packing.py)
@@ -865,6 +884,16 @@ def _main():
     # misses the MoE and decode stages' block/chunk decisions.
     payload["extra"]["autotune"] = _autotune_summary()
     payload["extra"]["metrics"] = _metrics_summary()
+    # the serving_paged-scoped snapshot captured before the trace
+    # replay ran (see the comment at the capture site)
+    payload["extra"]["metrics"]["slo"] = _slo_snapshot
+    # the full trace-replay scorecard (deterministic + timing planes)
+    try:
+        from paddle_tpu.loadgen import last_scorecard as _last_card
+        if _last_card() is not None:
+            payload["extra"]["metrics"]["scorecard"] = _last_card()
+    except Exception:                           # noqa: BLE001
+        pass
     payload["extra"]["metrics"]["mfu"] = mfu_block
     payload["extra"]["metrics"]["goodput"] = goodput_report
     # per-rung measured exec-ms p50/p99 (the headline/decode programs)
@@ -1028,11 +1057,12 @@ def _serving_paged_rung(on_tpu):
     params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
     jax.block_until_ready(params["embed"])
     rng = np.random.default_rng(42)
-    trace = [(int(rng.choice(plens)), int(rng.choice(glens)))
-             for _ in range(n_req)]
-    # longest-generation-first: the standard makespan heuristic — the
-    # drain tail is short requests, so slot occupancy stays high
-    trace.sort(key=lambda t: -t[1])
+    # the shared loadgen trace construction (longest-generation-first
+    # makespan ordering inside); passing the live rng preserves this
+    # rung's historical draw sequence exactly — prompt tokens below
+    # continue from where the trace draws left off
+    from paddle_tpu.loadgen.traces import mixed_length_trace
+    trace = mixed_length_trace(plens, glens, n_req, rng)
     max_p, max_g = max(p for p, _ in trace), max(g for _, g in trace)
     max_len = max_p + max_g
     useful = sum(g for _, g in trace)
@@ -1116,6 +1146,95 @@ def _serving_paged_rung(on_tpu):
         "page_pool_utilization": round(s.peak_pages_in_use / pool, 4),
         "preempted": s.preempted,
         "engine": s.as_dict(),
+    }
+
+
+def _serving_trace_replay_rung(on_tpu):
+    """Deterministic trace replay through the overload-policy engine:
+    a seeded multi-tenant arrival trace (loadgen/traces.py) with a
+    scripted mid-trace overload burst replays open-loop on the virtual
+    clock (loadgen/replay.py), and the SLO scorecard folds the typed
+    terminal states into the goodput / p99-TTFT numbers the regression
+    guard reads (``extra.serving_trace_replay.*``). The terminal-state
+    and token counts are a pure function of the trace seed + engine
+    flags — only the latency/wall numbers move between runs."""
+    import dataclasses as _dc
+    import time as _time
+
+    import jax
+
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.inference.engine import EngineStats
+    from paddle_tpu.loadgen import (Episode, TenantSpec, build_scorecard,
+                                    generate_trace, replay_trace)
+    from paddle_tpu.models import llama as L
+
+    if on_tpu:
+        cfg = L.llama_3_8b(num_hidden_layers=4, vocab_size=32000,
+                           remat=False)
+        slots, page, chunk = 8, 16, 4
+        rate = 40.0
+    else:
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        slots, page, chunk = 4, 4, 8
+        rate = 48.0
+
+    trace = generate_trace(
+        1616, duration_s=1.0, rate=rate,
+        tenants=[TenantSpec("interactive", share=1.0, priority=2),
+                 TenantSpec("batch", share=2.0, priority=0)],
+        prompt_len=(4, 16), max_new_tokens=(4, 24), alpha=1.3,
+        burst=(0.5, 0.2, 2.0))
+    episodes = [Episode("burst", at_s=0.55, n_requests=6 * slots)]
+
+    params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
+    jax.block_until_ready(params["embed"])
+    # headroom covers the burst injections (drawn from the same
+    # prompt/gen ranges the trace config echoes)
+    eng = ServingEngine(L, params, cfg, num_slots=slots,
+                        max_len=16 + 24, page_size=page,
+                        decode_chunk=chunk, priority_admission=True,
+                        max_queue=2 * slots)
+    eng.publish_frames("replay-replica0", local_only=True)
+
+    # warmup: the SAME arrival schedule under rid-shifted identities
+    # compiles every prefill bucket without colliding with the measured
+    # run's rids (the replay harvests only its own submissions, so the
+    # warmup outputs parked on the engine stay invisible). The global
+    # serving.latency histograms are NOT reset here — they belong to
+    # the serving_paged rung's guarded SLO block; this rung's p99s come
+    # from its own per-request cost samples via the scorecard.
+    warm = _dc.replace(trace, requests=[
+        _dc.replace(r, rid=r.rid + 500_000) for r in trace.requests])
+    replay_trace(eng, warm, dt_per_step=0.01)
+
+    eng.stats = EngineStats()
+    t0 = _time.perf_counter()
+    result = replay_trace(eng, trace, dt_per_step=0.01,
+                          episodes=episodes)
+    dt = _time.perf_counter() - t0
+    card = build_scorecard(result)
+
+    det = card["deterministic"]
+    lat = card["timing"]["latency_ms"]
+    return {
+        "config": f"llama_3_8b[{cfg.num_hidden_layers}L]" if on_tpu
+        else "llama_tiny[2L]",
+        "trace_sha256": det["trace"]["sha256"],
+        "trace_requests": det["trace"]["requests"],
+        "offered_requests": det["goodput"]["offered_requests"],
+        "terminal": det["terminal"],
+        "shed_by_reason": det["shed_by_reason"],
+        "request_goodput": det["goodput"]["request_goodput"],
+        "token_goodput": det["goodput"]["token_goodput"],
+        "useful_tokens": det["tokens"]["useful"],
+        # the two guarded rungs: useful decode tokens per wall second
+        # (higher is better) and completed-request p99 TTFT (lower)
+        "goodput_tokens_per_sec": round(det["tokens"]["useful"] / dt, 2),
+        "ttft_p99_ms": (lat.get("ttft_ms") or {}).get("p99"),
+        "latency_ms": lat,
+        "verdict": card["verdict"],
+        "wall_s": round(dt, 3),
     }
 
 

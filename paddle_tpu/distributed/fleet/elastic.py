@@ -477,7 +477,8 @@ class AdaptiveElasticManager(ElasticManager):
                     max_ticks: Optional[int] = None,
                     stop_event=None, federation=None,
                     fleet_burn_scaling: Optional[bool] = None,
-                    signal_timeout: Optional[float] = 5.0) -> dict:
+                    signal_timeout: Optional[float] = 5.0,
+                    on_tick=None) -> dict:
         """Drive a serving-replica fleet against the autoscale signals.
 
         ``spawn(name) -> handle`` creates a replica; ``stop(name,
@@ -534,7 +535,14 @@ class AdaptiveElasticManager(ElasticManager):
         sweeps any leftover from a PRIOR controller incarnation (a
         higher-seq dead frame would otherwise outrank the fresh
         replica's), so a long-lived controller dir does not
-        accumulate dead replicas' files."""
+        accumulate dead replicas' files.
+
+        ``on_tick(ticks, replicas)`` is an optional in-process hook
+        called at the top of every tick on the controller thread —
+        the loadgen trace-replay pump rides it to submit work and
+        step in-process engines in lockstep with the controller's
+        spawn/stop decisions. Exceptions are recorded as events,
+        never fatal."""
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
@@ -653,6 +661,19 @@ class AdaptiveElasticManager(ElasticManager):
                              {"reason": "max_ticks", "ticks": ticks})
                 break
             ticks += 1
+            if on_tick is not None:
+                # in-process pump hook (the loadgen replay driver):
+                # runs ON the controller thread, ordered with this
+                # tick's stale handling and scaling decisions — the
+                # caller submits work / steps in-process engines here
+                # without feeder-thread races. A raising hook is a
+                # caller bug: recorded, never fatal to the fleet.
+                try:
+                    on_tick(ticks, dict(replicas))
+                except Exception as e:
+                    self._record(ElasticStatus.ERROR,
+                                 {"reason": "on-tick-error",
+                                  "detail": repr(e)[:300]})
             if heartbeat_dir and heartbeat_timeout > 0:
                 stale = _heartbeat.stale_names(
                     heartbeat_dir, list(replicas), heartbeat_timeout,

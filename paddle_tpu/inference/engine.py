@@ -251,6 +251,11 @@ class RequestOutput:
     #                                      never enter)
     retry_after_s: Optional[float] = None  # shed only: demand-model
     #                                      backoff hint
+    shed_reason: Optional[str] = None    # shed only: the typed policy
+    #                                      reason (displacement /
+    #                                      drain) — what submit-time
+    #                                      sheds carry on the
+    #                                      EngineOverloaded they raise
 
 
 class _Slot:
@@ -816,7 +821,8 @@ class ServingEngine:
             prompt_len=int(np.asarray(req.prompt).shape[0]),
             preemptions=getattr(req, "_preempt_count", 0),
             tenant=getattr(req, "tenant", "default"),
-            cost=cost, finish_reason="shed", retry_after_s=hint)
+            cost=cost, finish_reason="shed", retry_after_s=hint,
+            shed_reason=why)
         _trace.instant("serving.shed", rid=req.rid, reason=why,
                        retry_after_s=hint)
 

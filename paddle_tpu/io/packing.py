@@ -233,9 +233,10 @@ def heavy_tailed_lengths(seq_len: int, n_docs: int, seed: int = 7):
     """Deterministic heavy-tailed document-length trace (most documents
     short, a few near ``seq_len``) — the distribution the packed
     training bench rung and the smoke pre-tuning share so both resolve
-    the same autotune shape key."""
-    rng = np.random.default_rng(seed)
-    buckets = np.array([seq_len // 16, seq_len // 8, seq_len // 4,
-                        seq_len // 2, seq_len])
-    probs = np.array([0.35, 0.25, 0.2, 0.15, 0.05])
-    return [int(x) for x in rng.choice(buckets, size=n_docs, p=probs)]
+    the same autotune shape key. The implementation lives in
+    ``loadgen/traces.py`` (the single source for every workload
+    trace); this re-export keeps the historical import path and the
+    byte-identical draw sequence the checked-in autotune cache keys
+    were swept under (pinned by tests/test_loadgen.py)."""
+    from ..loadgen.traces import heavy_tailed_lengths as _impl
+    return _impl(seq_len, n_docs, seed)

@@ -1,0 +1,27 @@
+"""Deterministic load generation + SLO grading for the serving stack.
+
+Three layers (see ``docs/load_testing.md``):
+
+- :mod:`.traces` — seeded, byte-identical-replayable arrival traces
+  (heavy-tailed lengths, Poisson/bursty multi-tenant arrivals,
+  canonical JSON serialization);
+- :mod:`.replay` — the open-loop virtual-clock replay driver feeding
+  one ``ServingEngine`` or an elastic fleet, with scripted
+  burst/drain/kill episodes;
+- :mod:`.scorecard` — the per-replay SLO verdict (terminal states,
+  goodput vs offered load, fairness, burn), deterministic content
+  quarantined from wall-clock timing, served at ``GET /scorecard``.
+"""
+from .traces import (ArrivalTrace, TenantSpec, TraceRequest,  # noqa: F401
+                     generate_trace, heavy_tailed_lengths,
+                     mixed_length_trace, prompt_tokens)
+from .replay import (Episode, ReplayResult, replay_fleet,  # noqa: F401
+                     replay_trace)
+from .scorecard import (build_scorecard, last_scorecard,  # noqa: F401
+                        set_last_scorecard)
+
+__all__ = ["ArrivalTrace", "TenantSpec", "TraceRequest", "Episode",
+           "ReplayResult", "generate_trace", "heavy_tailed_lengths",
+           "mixed_length_trace", "prompt_tokens", "replay_trace",
+           "replay_fleet", "build_scorecard", "last_scorecard",
+           "set_last_scorecard"]

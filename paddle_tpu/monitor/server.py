@@ -252,6 +252,20 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import federation as _federation
                 self._send_json(
                     200, _federation.fleet_serving_snapshot())
+            elif route == "/scorecard":
+                # the most recent trace-replay SLO scorecard
+                # (loadgen/scorecard.py). 404 until a replay graded —
+                # absence is honest, an empty card would read as a
+                # zero-traffic fleet that passed
+                from ..loadgen import scorecard as _scorecard
+                card = _scorecard.last_scorecard()
+                if card is None:
+                    self._send_json(404, {
+                        "available": False,
+                        "error": "no trace replay has been scored in "
+                                 "this process"})
+                else:
+                    self._send_json(200, card)
             elif route == "/profile":
                 self._profile(parse_qs(url.query))
             elif route == "/":
@@ -261,7 +275,7 @@ class _Handler(BaseHTTPRequestHandler):
                                "/healthz", "/flight", "/programs",
                                "/memory", "/roofline", "/sharding",
                                "/timeseries", "/numerics", "/slo",
-                               "/fleet/serving",
+                               "/fleet/serving", "/scorecard",
                                "/profile?seconds=N"],
                 })
             else:

@@ -60,6 +60,13 @@ Known injection points (grep ``faults.hit`` for the live list):
 - ``drain.stop``           after ``drain_safe`` held, before the
   replica is stopped — a ``kill`` here proves the checkpoint
   committed strictly before the replica died
+- ``loadgen.replay.step``  each engine step of a single-engine trace
+  replay (``loadgen/replay.py``) — ``delay`` widens the virtual-clock
+  windows for chaos runs
+- ``loadgen.replica.<name>.step``  each pump tick of fleet replica
+  ``<name>`` in a fleet trace replay — a ``raise`` here is the
+  scripted replica KILL: the pump stops stepping/publishing it, its
+  heartbeat goes stale, and the elastic controller replaces it
 """
 from __future__ import annotations
 

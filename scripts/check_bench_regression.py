@@ -9,7 +9,8 @@ guard:
 
 1. parse every ``BENCH_r*.json`` in round order, extracting the
    allowlisted rungs (headline tokens/s plus the named sub-rungs the
-   bench embeds under ``extra`` — MoE, decode, serving, packing);
+   bench embeds under ``extra`` — MoE, decode, serving, packing,
+   trace replay);
    runs that failed (``value`` <= 0, an ``error`` field, or a dead
    tunnel) are SKIPPED, not treated as zeros;
 2. the NEWEST successful run is the candidate; each rung's baseline is
@@ -51,6 +52,11 @@ ALLOWLIST = {
     "prefill_tokens_per_sec": "extra.decode.prefill_tokens_per_sec",
     "serving_tokens_per_sec": "extra.serving_paged.serving_tokens_per_sec",
     "packed_tokens_per_sec": "extra.training_packed.packed_tokens_per_sec",
+    # trace-replay goodput (loadgen harness): useful decode tokens per
+    # wall second across the seeded overload trace — a PR that sheds
+    # more work or slows the engine under burst load fails here
+    "serving_replay_goodput_tokens_per_sec":
+        "extra.serving_trace_replay.goodput_tokens_per_sec",
 }
 
 # LOWER-is-better rungs (measured exec-ms distributions from the
@@ -67,6 +73,10 @@ ALLOWLIST_LOWER = {
     # latency without touching throughput now fails the guard
     "serving_ttft_ms_p99": "extra.metrics.slo.ttft_p99_ms",
     "serving_tpot_ms_p99": "extra.metrics.slo.tpot_p99_ms",
+    # trace-replay p99 TTFT (per-request cost samples of the replay's
+    # completed requests, via the scorecard's timing plane)
+    "serving_replay_ttft_ms_p99":
+        "extra.serving_trace_replay.ttft_p99_ms",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
