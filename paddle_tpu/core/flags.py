@@ -159,6 +159,18 @@ define_flag("serving_fleet_burn_scaling", False,
             "availability-fed triggers self-lock). Off (the default) "
             "= demand-only scaling, byte-identical controller "
             "decisions.")
+define_flag("serving_failover", False,
+            "Exactly-once request failover (inference/failover.py): "
+            "engines journal every admitted request (idempotency key, "
+            "prompt spec, pinned PRNG key, attempt count) with "
+            "completion markers on the name-keyed heartbeat "
+            "transport; the elastic serving controller re-dispatches "
+            "work stranded on a replaced replica through normal "
+            "admission on survivors (bounded attempts, capped "
+            "retry_after_s backoff, poison-request quarantine, "
+            "per-replica circuit breakers). Off (the default) = no "
+            "journal, no coordinator, byte-identical scheduling and "
+            "tokens.")
 define_flag("fault_injection", "",
             "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
             " armed at import by paddle_tpu.testing.faults (actions: "

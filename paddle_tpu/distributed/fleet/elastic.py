@@ -477,6 +477,7 @@ class AdaptiveElasticManager(ElasticManager):
                     max_ticks: Optional[int] = None,
                     stop_event=None, federation=None,
                     fleet_burn_scaling: Optional[bool] = None,
+                    failover: Optional[bool] = None,
                     signal_timeout: Optional[float] = 5.0,
                     on_tick=None) -> dict:
         """Drive a serving-replica fleet against the autoscale signals.
@@ -537,6 +538,20 @@ class AdaptiveElasticManager(ElasticManager):
         replica's), so a long-lived controller dir does not
         accumulate dead replicas' files.
 
+        Exactly-once failover (``inference/failover.py``): with
+        ``failover`` on (default ``FLAGS_serving_failover``, OFF —
+        flags-off decisions byte-identical), the controller owns a
+        :class:`~paddle_tpu.inference.failover.FailoverCoordinator`
+        (exposed as ``self.failover_coordinator`` and registered for
+        the ``/fleet/serving`` failover block). When a stale replica
+        is force-replaced, the coordinator consumes its admission
+        journal — completion markers dedup work that finished just
+        before the crash — and queues the stranded remainder for
+        re-dispatch; the caller's pump (``on_tick``) drains
+        ``coordinator.due()`` through normal admission on survivors.
+        Spawning and retiring a replica sweeps its journal alongside
+        its beat/frame (same hygiene contract).
+
         ``on_tick(ticks, replicas)`` is an optional in-process hook
         called at the top of every tick on the controller thread —
         the loadgen trace-replay pump rides it to submit work and
@@ -569,6 +584,16 @@ class AdaptiveElasticManager(ElasticManager):
         burn_scaling = bool(
             _cflags.flag_value("serving_fleet_burn_scaling")
             if fleet_burn_scaling is None else fleet_burn_scaling)
+        failover_on = bool(
+            _cflags.flag_value("serving_failover")
+            if failover is None else failover)
+        coord = None
+        _fo = None
+        if failover_on:
+            from ...inference import failover as _fo
+            coord = _fo.FailoverCoordinator(heartbeat_dir=heartbeat_dir)
+            self.failover_coordinator = coord
+            _fo.set_active_coordinator(coord)
         view = federation
         if view is None and burn_scaling and heartbeat_dir:
             from ...monitor import federation as _fed
@@ -625,6 +650,12 @@ class AdaptiveElasticManager(ElasticManager):
             # spawn is a supported pattern).
             if heartbeat_dir:
                 _heartbeat.remove_named(heartbeat_dir, name)
+            if coord is not None:
+                # same leftover-payload hazard for the admission
+                # journal: a prior incarnation's higher-seq journal
+                # would win read_named's tiebreak and re-dispatch a
+                # dead fleet's requests into this one
+                _fo.sweep_journal(name, dir_path=heartbeat_dir)
             if view is not None:
                 view.sweep(name)
 
@@ -696,6 +727,18 @@ class AdaptiveElasticManager(ElasticManager):
                                      {"reason": "stale-stop-failed",
                                       "replica": name,
                                       "detail": repr(e)})
+                    if coord is not None:
+                        # consume the dead replica's admission journal
+                        # BEFORE the GC sweeps it: completion markers
+                        # dedup, poison requests quarantine, the rest
+                        # queue for re-dispatch on survivors
+                        stranded = coord.note_replaced(name)
+                        if stranded:
+                            self._record(
+                                ElasticStatus.RESTART,
+                                {"reason": "failover-strand",
+                                 "replica": name,
+                                 "stranded": stranded})
                     # GC AFTER the stop: a stale-but-recovering
                     # replica could otherwise republish between
                     # sweep and stop, resurrecting an orphan file
@@ -856,8 +899,11 @@ class AdaptiveElasticManager(ElasticManager):
                 stop_event.wait(poll_interval)
             else:
                 time.sleep(poll_interval)
-        return {"replicas": list(replicas), "ticks": ticks,
-                "events": self.events}
+        out = {"replicas": list(replicas), "ticks": ticks,
+               "events": self.events}
+        if coord is not None:
+            out["failover"] = coord.snapshot()
+        return out
 
 
 # -- worker-side elastic state (resume across world re-forms) ----------------

@@ -202,6 +202,14 @@ class Request:
     #                                      evicted from the running
     #                                      batch once it is spent
     #                                      (default off)
+    prompt_spec: Optional[dict] = None   # failover journal only: a
+    #                                      derivation spec (trace seed,
+    #                                      rid, lengths) the admission
+    #                                      journal records INSTEAD of
+    #                                      inline prompt tokens, so a
+    #                                      re-dispatch rebuilds the
+    #                                      exact prompt as a pure
+    #                                      function of the spec
 
 
 @dataclasses.dataclass
@@ -373,7 +381,8 @@ class ServingEngine:
                  tenant_inflight_cap: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  shed_on_burn: Optional[bool] = None,
-                 slo_preemption: Optional[bool] = None):
+                 slo_preemption: Optional[bool] = None,
+                 failover: Optional[bool] = None):
         # Overload policies (ROADMAP item 5, acting half). Each kwarg
         # defaults to its FLAGS_serving_* flag (the make_train_step
         # guard=None pattern); every flag defaults OFF, and with all of
@@ -396,6 +405,12 @@ class ServingEngine:
             _opt(shed_on_burn, "serving_shed_on_burn"))
         self._slo_preemption = bool(
             _opt(slo_preemption, "serving_slo_preemption"))
+        # Exactly-once failover (inference/failover.py): the flag only
+        # OFFERS durability — journaling starts when a controller (or
+        # test) calls attach_journal, the publish_frames opt-in shape.
+        # Flag off and unattached: one None check per terminal event.
+        self._failover = bool(_opt(failover, "serving_failover"))
+        self._journal = None
         self._draining = False
         self._deadlines_seen = False   # sticky: first deadline request
         #                                arms the per-step expiry scan
@@ -664,6 +679,22 @@ class ServingEngine:
         # ride into the loop
         (req.prompt, req.max_new_tokens, req.temperature,
          req.tenant, req.priority, req.deadline_s) = norm
+        if getattr(req, "_submitted", False):
+            # re-admission of a previously-submitted object (the client
+            # kept it): per-run mutable state must not carry over — the
+            # cost record restarts, TTFT/e2e re-anchor, a stale
+            # deadline anchor must not expire the new run, and the
+            # preemption count is the new run's. (Preemption re-queues
+            # re-enter via appendleft, not submit, and deliberately
+            # keep all of it — the record follows the request across
+            # ONE run.) The PRNG key is the exception: _keys_for pinned
+            # the first run's key onto req.key, so a resubmission
+            # replays byte-identical tokens.
+            req._t0 = None
+            req._t_enqueue = None
+            req._cost = None
+            req._t_deadline = None
+            req._preempt_count = 0
         # overload gates, in severity order: a draining replica refuses
         # everything; an SLO fast-burn sheds best-effort work; a full
         # bounded queue sheds (or displaces for higher priority). All
@@ -710,6 +741,17 @@ class ServingEngine:
             _trace.instant("serving.enqueue", rid=req.rid, prompt=plen,
                            max_new=req.max_new_tokens,
                            tenant=req.tenant)
+        req._submitted = True
+        if self._journal is not None:
+            # journal AFTER every gate that could still refuse the
+            # request (a shed/rejected submission never entered the
+            # engine and must not be re-dispatched), and pin the
+            # sampling key BEFORE the record is written so a
+            # re-dispatch replays byte-identical tokens
+            if req.temperature > 0.0 and req.key is None:
+                self._rng_fallback += 1
+                req.key = jax.random.PRNGKey(self._rng_fallback)
+            self._journal.admit(req)
         self.queue.append(req)
 
     # -- overload policy: shedding, deadlines, drain ------------------------
@@ -755,6 +797,24 @@ class ServingEngine:
             min_interval_s=min_interval_s, slo_fn=slo_fn)
         self._frame_pub.maybe_publish(self, force=True)
         return self._frame_pub
+
+    def attach_journal(self, name: str, dir_path: Optional[str] = None,
+                       *, client=None):
+        """Opt this replica into the exactly-once admission journal
+        (``inference/failover.py``; requires ``failover=True`` /
+        ``FLAGS_serving_failover`` — the flag gates the durability
+        layer, this call names the replica and the transport). Every
+        subsequent admission is journaled write-through and every
+        terminal event writes a completion marker, so the elastic
+        controller can re-dispatch work stranded by a crash without
+        ever double-serving a finished request. Returns the journal
+        (one per engine; re-attaching replaces it)."""
+        if not self._failover:
+            return None
+        from .failover import AdmissionJournal
+        self._journal = AdmissionJournal(name, dir_path=dir_path,
+                                         client=client)
+        return self._journal
 
     def _shed_submit(self, req: Request, why: str):
         """Refuse a WELL-FORMED submission by overload policy: typed
@@ -823,6 +883,8 @@ class ServingEngine:
             tenant=getattr(req, "tenant", "default"),
             cost=cost, finish_reason="shed", retry_after_s=hint,
             shed_reason=why)
+        if self._journal is not None:
+            self._journal.finish(req.rid, "shed")
         _trace.instant("serving.shed", rid=req.rid, reason=why,
                        retry_after_s=hint)
 
@@ -954,6 +1016,9 @@ class ServingEngine:
             preemptions=preemptions,
             tenant=getattr(req, "tenant", "default"),
             cost=cost, finish_reason="expired")
+        if self._journal is not None:
+            self._journal.finish(req.rid, "expired",
+                                 tokens=int(tokens.shape[0]))
         _trace.instant("serving.expire", rid=req.rid,
                        tokens=int(tokens.shape[0]),
                        in_slot=slot_idx is not None)
@@ -995,6 +1060,11 @@ class ServingEngine:
         if key is None:
             self._rng_fallback += 1
             key = jax.random.PRNGKey(self._rng_fallback)
+            # pin the fallback onto the request: a resubmission of the
+            # same object (and a failover re-dispatch reading it from
+            # the journal) replays byte-identical tokens instead of
+            # drawing a fresh counter key
+            req.key = key
         return np.asarray(jax.random.split(key, req.max_new_tokens),
                           np.uint32)
 
@@ -1030,6 +1100,12 @@ class ServingEngine:
             preemptions=slot.preemptions,
             tenant=getattr(slot.req, "tenant", "default"),
             cost=cost)
+        if self._journal is not None:
+            # the completion marker lands BEFORE the output can be
+            # harvested: a crash after this point re-dispatches
+            # nothing for this rid (exactly-once dedup)
+            self._journal.finish(slot.req.rid, "completed",
+                                 tokens=int(len(slot.tokens)))
         self.stats.completed += 1
         _monitor.inc("serving.requests.completed")
         if mon:

@@ -24,13 +24,21 @@ Scripted episodes (:class:`Episode`):
 - ``kill``   — fleet only: crash a replica via ``testing/faults.py``
   (the ``loadgen.replica.<name>.step`` injection point), leaving its
   in-flight requests to be reported ``lost`` and the elastic
-  controller to detect the stale heartbeat and replace it.
+  controller to detect the stale heartbeat and replace it. With
+  exactly-once failover on (``replay_fleet(failover=True)`` /
+  ``FLAGS_serving_failover``), the stranded requests are instead
+  re-dispatched from the victim's admission journal through normal
+  admission on survivors (``inference/failover.py``) and end
+  ``completed``/``expired``/``shed``/``quarantined`` with a
+  ``recovered_from`` lineage — ``lost`` then means the durability
+  layer itself failed, and the bench guard treats it as a bug.
 
 Every submitted request ends in exactly one typed terminal state:
-``completed | expired | shed | rejected | lost`` — ``shed`` carries
-the engine's typed reason and ``retry_after_s`` hint whether it was
-refused at submit (:class:`EngineOverloaded`) or displaced/drained out
-of the queue (``RequestOutput.finish_reason == "shed"``).
+``completed | expired | shed | rejected | lost`` (plus
+``quarantined`` under failover) — ``shed`` carries the engine's typed
+reason and ``retry_after_s`` hint whether it was refused at submit
+(:class:`EngineOverloaded`) or displaced/drained out of the queue
+(``RequestOutput.finish_reason == "shed"``).
 """
 from __future__ import annotations
 
@@ -90,6 +98,8 @@ class ReplayResult:
     offered: int = 0                    # trace + burst submissions
     offered_tokens: int = 0             # sum of their max_new_tokens
     fleet_events: Optional[list] = None
+    failover: Optional[dict] = None     # coordinator snapshot (fleet
+    #                                     replays with failover on)
     # wall-clock latency samples (ms) per request from the engine cost
     # records — timing-plane data the scorecard quarantines
     latency_samples: Dict[str, list] = dataclasses.field(
@@ -116,6 +126,7 @@ def _engine_flags(eng) -> dict:
         "tenant_inflight_cap": int(getattr(eng, "_tenant_cap", 0) or 0),
         "shed_on_burn": bool(getattr(eng, "_shed_on_burn", False)),
         "slo_preemption": bool(getattr(eng, "_slo_preemption", False)),
+        "failover": bool(getattr(eng, "_failover", False)),
         "num_slots": int(getattr(eng, "num_slots", 0)),
     }
 
@@ -128,14 +139,24 @@ def _mk_request(tr: TraceRequest, seed: int, vocab_size: int,
         prompt=prompt_tokens(seed, tr.rid, tr.prompt_len, vocab_size),
         max_new_tokens=tr.max_new_tokens, tenant=tr.tenant,
         priority=tr.priority,
-        deadline_s=tr.deadline_s if honor_deadlines else None)
+        deadline_s=tr.deadline_s if honor_deadlines else None,
+        # derivation spec for the admission journal: a failover
+        # re-dispatch rebuilds the exact prompt as a pure function
+        # instead of journaling inline tokens (inert without a journal)
+        prompt_spec={"seed": int(seed), "rid": int(tr.rid),
+                     "prompt_len": int(tr.prompt_len),
+                     "vocab": int(vocab_size)})
 
 
 def _submit(eng, req, terminal: Dict[int, dict], tenant: str,
-            episode: Optional[str] = None) -> bool:
+            episode: Optional[str] = None, coord=None,
+            replica: Optional[str] = None, now: float = 0.0) -> bool:
     """Submit one request, folding a typed refusal into the terminal
     map. Returns True when the request ENTERED the engine (its
-    terminal state will come from ``eng.outputs``)."""
+    terminal state will come from ``eng.outputs``). With a failover
+    coordinator, the outcome feeds ``replica``'s circuit breaker —
+    sheds only; a malformed-request rejection says nothing about the
+    replica's health."""
     from ..inference.engine import EngineOverloaded, RequestRejected
     rec = {"state": None, "tenant": tenant,
            "prompt_len": int(np.asarray(req.prompt).shape[0]),
@@ -148,12 +169,75 @@ def _submit(eng, req, terminal: Dict[int, dict], tenant: str,
         rec.update(state="shed", reason=e.reason,
                    retry_after_s=e.retry_after_s)
         terminal[req.rid] = rec
+        if coord is not None and replica is not None:
+            coord.admission_result(replica, False, now)
         return False
     except RequestRejected as e:
         rec.update(state="rejected", reason=e.reason)
         terminal[req.rid] = rec
         return False
+    if coord is not None and replica is not None:
+        coord.admission_result(replica, True, now)
     return True
+
+
+def _rebuild_request(rec: dict, vocab: int,
+                     deadline_s: Optional[float]):
+    """Reconstruct a journaled request for re-dispatch: the prompt
+    from its derivation spec (or inline tokens), the PINNED sampling
+    key (byte-identical tokens), the remaining deadline, and the
+    attempt/lineage bookkeeping the journal re-records on the
+    survivor. Returns None for a record too damaged to rebuild."""
+    from ..inference.engine import Request
+    spec = rec.get("prompt_spec")
+    try:
+        if spec:
+            prompt = prompt_tokens(int(spec["seed"]), int(spec["rid"]),
+                                   int(spec["prompt_len"]),
+                                   int(spec.get("vocab", vocab)))
+        elif rec.get("prompt") is not None:
+            prompt = np.asarray(rec["prompt"], np.int32)
+        else:
+            return None
+        key = None
+        if rec.get("key") is not None:
+            key = np.asarray(rec["key"], np.uint32)
+        req = Request(
+            rid=int(rec["rid"]), prompt=prompt,
+            max_new_tokens=int(rec["max_new_tokens"]),
+            temperature=float(rec.get("temperature", 0.0) or 0.0),
+            key=key, tenant=str(rec.get("tenant", "default")),
+            priority=int(rec.get("priority", 0) or 0),
+            deadline_s=deadline_s,
+            prompt_spec=dict(spec) if spec else None)
+    except (KeyError, TypeError, ValueError):
+        return None
+    req._failover_attempts = int(rec.get("attempts", 0))
+    req._recovered_from = list(rec.get("recovered_from") or [])
+    return req
+
+
+def _fold_failover_terminal(terminal: Dict[int, dict], rec: dict):
+    """Fold a coordinator terminal record (quarantined, expired while
+    stranded, attempts-exhausted shed) into the replay map — only over
+    a missing or still-open record; a harvested engine output always
+    wins."""
+    rid = int(rec["rid"])
+    t = terminal.get(rid)
+    if t is not None and t.get("state") is not None:
+        return
+    spec = rec.get("prompt_spec") or {}
+    plen = spec.get("prompt_len")
+    if plen is None:
+        plen = len(rec.get("prompt") or ())
+    t = t or {}
+    t.update(state=rec["state"],
+             tenant=rec.get("tenant", "unknown"),
+             prompt_len=int(plen or 0),
+             tokens=int(t.get("tokens", 0) or 0),
+             recovered_from=list(rec.get("recovered_from") or []),
+             failover_attempts=int(rec.get("attempts", 0)))
+    terminal[rid] = t
 
 
 def _burst_requests(trace: ArrivalTrace, ep: Episode, idx: int,
@@ -173,7 +257,10 @@ def _burst_requests(trace: ArrivalTrace, ep: Episode, idx: int,
         reqs.append(Request(
             rid=rid,
             prompt=prompt_tokens(trace.seed, rid, plen, vocab_size),
-            max_new_tokens=glen, tenant="burst", priority=0))
+            max_new_tokens=glen, tenant="burst", priority=0,
+            prompt_spec={"seed": int(trace.seed), "rid": int(rid),
+                         "prompt_len": int(plen),
+                         "vocab": int(vocab_size)}))
     return reqs
 
 
@@ -217,7 +304,8 @@ def _count_metrics(result: "ReplayResult"):
     counts = result.terminal_counts()
     _monitor.inc("loadgen.replay.offered", result.offered,
                  doc="requests a trace replay offered the engine/fleet")
-    for state in ("completed", "shed", "expired", "rejected", "lost"):
+    for state in ("completed", "shed", "expired", "rejected", "lost",
+                  "quarantined"):
         if counts.get(state):
             _monitor.inc(f"loadgen.replay.{state}", counts[state])
     _monitor.inc("loadgen.replay.tokens.useful",
@@ -338,6 +426,7 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
                  poll_interval: float = 0.005,
                  honor_deadlines: bool = False,
                  max_ticks: int = 50_000,
+                 failover: Optional[bool] = None,
                  manager=None) -> ReplayResult:
     """Replay ``trace`` through a multi-replica fleet driven by
     :meth:`AdaptiveElasticManager.run_serving`.
@@ -352,7 +441,18 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
     the victim, its heartbeat goes stale, the controller force-stops
     and replaces it, and its in-flight requests are reported with
     terminal state ``lost``. Requires ``heartbeat_dir`` +
-    ``heartbeat_timeout > 0`` for kill episodes to heal."""
+    ``heartbeat_timeout > 0`` for kill episodes to heal.
+
+    ``failover`` (default ``FLAGS_serving_failover``, off): each
+    spawned replica attaches an admission journal under its heartbeat
+    name, fresh submissions route through the controller coordinator's
+    circuit breakers and feed them their outcomes, and the pump drains
+    the coordinator's re-dispatch queue — work stranded by a kill is
+    resubmitted through normal admission on survivors (remaining
+    deadline carried when ``honor_deadlines``, bounded attempts,
+    capped ``retry_after_s`` backoff riding the VIRTUAL clock) and
+    ends in exactly one terminal state with a ``recovered_from``
+    lineage plus a timing-plane per-request ``recovery_s``."""
     import threading
 
     from ..distributed.fleet.elastic import AdaptiveElasticManager
@@ -365,6 +465,9 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
                              "can detect and replace the victim")
     vocab = None
     mgr = manager or AdaptiveElasticManager()
+    from ..core import flags as _cflags
+    failover_on = bool(_cflags.flag_value("serving_failover")
+                       if failover is None else failover)
     engines: Dict[str, object] = {}     # every engine ever spawned
     crashed: set = set()
     assigned: Dict[str, set] = {}       # replica -> rids submitted
@@ -375,6 +478,12 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
     state = {"vnow": 0.0, "offered": 0, "offered_tokens": 0,
              "steps": 0}
     armed_points: set = set()
+    # failover bookkeeping: rid -> (survivor name, journal record) for
+    # re-dispatched requests whose terminal output the pump polls (it
+    # stamps the timing-plane recovery_s and tells the coordinator)
+    redisp: Dict[int, tuple] = {}
+    arrival_by_rid = ({r.rid: r.arrival_s for r in trace.requests}
+                      if failover_on and honor_deadlines else {})
     done = threading.Event()
     t0 = time.perf_counter()
 
@@ -384,6 +493,11 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
             eng.publish_frames(name, heartbeat_dir, min_interval_s=0.0)
         else:
             eng.publish_frames(name, local_only=True)
+        if failover_on and hasattr(eng, "attach_journal"):
+            # durable admission journal under the replica's heartbeat
+            # name (requires the engine's own failover switch — an
+            # engine built flags-off declines and work stays `lost`)
+            eng.attach_journal(name, heartbeat_dir)
         engines[name] = eng
         assigned.setdefault(name, set())
         return eng
@@ -398,6 +512,13 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
         live = [n for n in sorted(live_replicas) if n not in crashed]
         if vocab is None and live:
             vocab = int(engines[live[0]].config.vocab_size)
+        coord = (getattr(mgr, "failover_coordinator", None)
+                 if failover_on else None)
+        if coord is not None and not state.get("clocked"):
+            # the coordinator's backoff/due stamps ride the replay's
+            # VIRTUAL clock: deterministic in virtual seconds
+            coord.clock = lambda: state["vnow"]
+            state["clocked"] = True
         if crashed and not state.get("recovered") and any(
                 n not in state.get("pre_kill", ()) for n in live):
             # first replacement spawned after a crash: the recovery
@@ -419,9 +540,13 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
                 state["offered_tokens"] += sum(r.max_new_tokens
                                                for r in reqs)
                 for i, r in enumerate(reqs):
-                    name = live[i % len(live)]
+                    if coord is not None:
+                        name = coord.pick_replica(live, i, now=vnow)
+                    else:
+                        name = live[i % len(live)]
                     if _submit(engines[name], r, terminal, "burst",
-                               episode="burst"):
+                               episode="burst", coord=coord,
+                               replica=name, now=vnow):
                         assigned[name].add(r.rid)
                 mark["n_requests"] = len(reqs)
             elif ep.kind == "drain" and live:
@@ -441,12 +566,73 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
             tr = pending.pop(0)
             state["offered"] += 1
             state["offered_tokens"] += tr.max_new_tokens
-            name = live[tr.rid % len(live)]
+            if coord is not None:
+                name = coord.pick_replica(live, tr.rid, now=vnow)
+            else:
+                name = live[tr.rid % len(live)]
             if _submit(engines[name],
                        _mk_request(tr, trace.seed, vocab,
                                    honor_deadlines),
-                       terminal, tr.tenant):
+                       terminal, tr.tenant, coord=coord,
+                       replica=name, now=vnow):
                 assigned[name].add(tr.rid)
+        if coord is not None and live:
+            # drain the re-dispatch queue: stranded journal records
+            # whose backoff elapsed re-enter NORMAL admission on a
+            # breaker-admissible survivor
+            for rec in coord.due(vnow):
+                rid = int(rec["rid"])
+                deadline = None
+                if honor_deadlines and rec.get("deadline_s") is not None:
+                    arr = arrival_by_rid.get(rid)
+                    spent = (vnow - arr) if arr is not None else 0.0
+                    deadline = float(rec["deadline_s"]) - spent
+                    if deadline <= 0.0:
+                        # the TTL was spent while stranded: typed
+                        # expired, never re-dispatched past its budget
+                        coord.resolve(rec, "expired")
+                        _fold_failover_terminal(terminal,
+                                                coord.terminal[rid])
+                        continue
+                req = _rebuild_request(rec, vocab, deadline)
+                if req is None:
+                    coord.resolve(rec, "shed")
+                    _fold_failover_terminal(terminal,
+                                            coord.terminal[rid])
+                    continue
+                name = coord.pick_replica(live, rid, now=vnow)
+                from ..inference.engine import (EngineOverloaded,
+                                                RequestRejected)
+                try:
+                    engines[name].submit(req)
+                except EngineOverloaded as e:
+                    coord.admission_result(name, False, vnow)
+                    coord.requeue(rec, vnow,
+                                  retry_after_s=e.retry_after_s)
+                    if rid in coord.terminal:
+                        _fold_failover_terminal(terminal,
+                                                coord.terminal[rid])
+                    continue
+                except RequestRejected:
+                    coord.resolve(rec, "shed")
+                    _fold_failover_terminal(terminal,
+                                            coord.terminal[rid])
+                    continue
+                coord.admission_result(name, True, vnow)
+                coord.redispatched(rec, name, vnow)
+                assigned[name].add(rid)
+                redisp[rid] = (name, rec)
+                # placeholder terminal record: _harvest folds the
+                # survivor's finish onto it, keeping the lineage
+                prev = terminal.get(rid) or {}
+                terminal[rid] = dict(
+                    prev, state=None,
+                    tenant=rec.get("tenant", "unknown"),
+                    prompt_len=int(np.asarray(req.prompt).shape[0]),
+                    tokens=0,
+                    recovered_from=list(rec.get("recovered_from")
+                                        or []),
+                    failover_attempts=int(rec.get("attempts", 0)))
         for name in live:
             eng = engines[name]
             try:
@@ -459,13 +645,52 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
                 # replaces it; its in-flight work is lost
                 crashed.add(name)
                 _faults.clear(f"loadgen.replica.{name}.step")
+                if coord is not None:
+                    # exactly-once accounting: tokens the victim had
+                    # generated for still-in-flight slots die with it
+                    # (the survivor regenerates from scratch), so they
+                    # are discarded — same contract as the preemption
+                    # recompute path — keeping token conservation
+                    # checkable even though nothing ends up `lost`
+                    for slot in eng.slots:
+                        if slot is not None:
+                            eng.stats.tokens_discarded += slot.gen
                 ep_log.append({"kind": "killed", "replica": name,
                                "tick": ticks,
                                "wall_s": round(
                                    time.perf_counter() - t0, 6)})
+        if coord is not None and redisp:
+            # poll re-dispatched rids for their survivor-side finish:
+            # stamps the timing-plane recovery_s (kill -> terminal,
+            # wall seconds) and settles the coordinator's bookkeeping
+            for rid in list(redisp):
+                name, rec = redisp[rid]
+                if name in crashed:
+                    # the survivor died too — note_replaced re-strands
+                    # this rid from ITS journal on the next strand
+                    del redisp[rid]
+                    continue
+                out = engines[name].outputs.get(rid)
+                if out is None:
+                    continue
+                coord.note_result(rid, out.finish_reason)
+                t = terminal.get(rid)
+                if t is not None and rec.get("_t_strand_wall"):
+                    t["recovery_s"] = round(
+                        time.perf_counter() - rec["_t_strand_wall"], 6)
+                del redisp[rid]
         state["steps"] += steps_per_tick
         state["vnow"] = vnow + dt_per_tick
-        if not pending and not eps:
+        # with failover on, a crashed replica the controller still
+        # tracks is stranded work the coordinator has not seen yet:
+        # keep the loop alive through staleness detection, the journal
+        # consume, and the re-dispatch drain — otherwise the replay
+        # exits the moment the SURVIVORS go idle and the durability
+        # layer never gets its tick
+        settling = coord is not None and (
+            any(n in live_replicas for n in crashed)
+            or coord.outstanding() or bool(redisp))
+        if not pending and not eps and not settling:
             idle = all(
                 not engines[n].queue and
                 all(s is None for s in engines[n].slots)
@@ -473,13 +698,14 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
             if idle and live:
                 done.set()
 
+    summary = None
     try:
-        mgr.run_serving(
+        summary = mgr.run_serving(
             spawn, stop, min_replicas=replicas,
             max_replicas=max_replicas or replicas + 1,
             poll_interval=poll_interval, heartbeat_dir=heartbeat_dir,
             heartbeat_timeout=heartbeat_timeout, max_ticks=max_ticks,
-            stop_event=done, on_tick=on_tick)
+            stop_event=done, failover=failover_on, on_tick=on_tick)
     finally:
         # a kill fault the victim never hit (it was replaced first)
         # must not stay armed past this replay
@@ -489,10 +715,21 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
     for name, eng in engines.items():
         _harvest(eng, terminal, assigned.get(name, ()), replica=name,
                  latency=lat)
+    coord = (getattr(mgr, "failover_coordinator", None)
+             if failover_on else None)
+    if coord is not None:
+        # coordinator-typed terminals (quarantined, expired while
+        # stranded, attempts-exhausted shed) land BEFORE the lost
+        # typing below — a stranded request the durability layer
+        # settled is never `lost`
+        for rec in coord.terminal.values():
+            _fold_failover_terminal(terminal, rec)
     # in-flight work that never retired — on a crashed replica OR one
     # the controller force-stopped/replaced mid-request — is typed
     # ``lost``: the crash-visibility state the kill episode exists to
-    # surface, never a silent accounting hole
+    # surface, never a silent accounting hole (with failover on it
+    # means the durability layer itself failed, e.g. an unjournaled
+    # engine or a journal the transport dropped)
     for name, rids in assigned.items():
         for rid in rids:
             rec = terminal.get(rid)
@@ -514,6 +751,10 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
         wall_s=round(time.perf_counter() - t0, 6),
         offered=state["offered"],
         offered_tokens=state["offered_tokens"],
-        fleet_events=list(mgr.events), latency_samples=lat)
+        fleet_events=list(mgr.events), latency_samples=lat,
+        failover=((summary or {}).get("failover")
+                  if summary and summary.get("failover") is not None
+                  else (coord.snapshot() if coord is not None
+                        else None)))
     _count_metrics(result)
     return result

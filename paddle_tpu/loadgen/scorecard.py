@@ -138,6 +138,9 @@ def _fleet_block() -> dict:
         if rep:
             out["alerting"] = rep.get("alerting")
             out["demand_estimate"] = rep.get("demand_estimate")
+        fo = snap.get("failover")
+        if fo is not None:
+            out["failover"] = fo
         return out
     except Exception:
         return {"available": False}
@@ -158,7 +161,7 @@ def build_scorecard(result: ReplayResult, *,
         t = per_tenant.setdefault(
             tenant, {"offered": 0, "completed": 0, "shed": 0,
                      "expired": 0, "rejected": 0, "lost": 0,
-                     "useful_tokens": 0})
+                     "quarantined": 0, "useful_tokens": 0})
         t["offered"] += 1
         state = rec["state"]
         t[state] = t.get(state, 0) + 1
@@ -233,6 +236,19 @@ def build_scorecard(result: ReplayResult, *,
         },
         "per_tenant": {k: dict(v) for k, v in
                        sorted(per_tenant.items())},
+        # exactly-once failover accounting: deterministic zeros with
+        # the flag off (no journal, no coordinator), so the flags-off
+        # determinism diff is unchanged by the block's presence
+        "failover": {
+            "recovered": sum(
+                1 for r in result.terminal.values()
+                if r.get("state") == "completed"
+                and r.get("recovered_from")),
+            "failover_attempts": sum(
+                int(r.get("failover_attempts", 0) or 0)
+                for r in result.terminal.values()),
+            "quarantined": counts.get("quarantined", 0),
+        },
         "fairness": {"jain_completion_index": fairness},
         "episodes": [
             {k: v for k, v in e.items()
@@ -266,6 +282,26 @@ def build_scorecard(result: ReplayResult, *,
                 round(recov["wall_s"] - kill["wall_s"], 6)
                 if recov is not None and kill.get("wall_s") is not None
                 else None)
+    # per-request failover recovery (strand -> survivor terminal, wall
+    # seconds) + the coordinator's own snapshot — timing plane: both
+    # depend on real heartbeat-staleness detection latency
+    recov_samples = sorted(
+        float(r["recovery_s"]) for r in result.terminal.values()
+        if r.get("recovery_s") is not None)
+    if recov_samples or result.failover is not None:
+        import numpy as _np
+        fo_t: dict = {}
+        if recov_samples:
+            a = _np.asarray(recov_samples, dtype=float)
+            fo_t["recovery_s"] = {
+                "count": int(a.size),
+                "p50": round(float(_np.percentile(a, 50)), 6),
+                "p99": round(float(_np.percentile(a, 99)), 6),
+                "max": round(float(a.max()), 6),
+            }
+        if result.failover is not None:
+            fo_t["coordinator"] = result.failover
+        timing["failover"] = fo_t
     if include_fleet:
         timing["fleet"] = _fleet_block()
     card = {

@@ -761,13 +761,24 @@ def fleet_serving_snapshot() -> dict:
             _LAST_REPORT[0] = report
             _update_fleet_gauges(report)
         source = "local"
-    return {
+    snap = {
         "kind": "paddle_tpu.fleet_serving",
         "source": source,
         "unix_time": round(time.time(), 3),
         "frames": fresh,
         "report": report,
     }
+    try:
+        from ..inference import failover as _fo
+        coord = _fo.active_coordinator()
+    except Exception:
+        coord = None
+    if coord is not None:
+        # the failover block rides only while a coordinator is live
+        # (FLAGS_serving_failover on, controller running) — absent
+        # otherwise, so flags-off payloads are byte-identical
+        snap["failover"] = coord.snapshot()
+    return snap
 
 
 def exposition_text() -> str:

@@ -77,6 +77,20 @@ ALLOWLIST_LOWER = {
     # completed requests, via the scorecard's timing plane)
     "serving_replay_ttft_ms_p99":
         "extra.serving_trace_replay.ttft_p99_ms",
+    # failover-on kill replay: p99 strand -> survivor-terminal wall
+    # seconds (the exactly-once layer's recovery tail)
+    "serving_failover_recovery_s_p99":
+        "extra.serving_failover_replay.recovery_s_p99",
+}
+
+# must-be-ZERO invariants, checked on the NEWEST successful run only
+# (there is no trajectory to compare — the value is a contract, not a
+# measurement). Absence is a skip (the rung didn't run); any positive
+# value is a regression. The failover replay's `lost` count is the
+# whole point of the durability layer: with FLAGS_serving_failover on,
+# a scripted kill must strand work into recovery, never into `lost`.
+ALLOWLIST_ZERO = {
+    "serving_failover_lost": "extra.serving_failover_replay.lost",
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -165,6 +179,39 @@ def published_baselines(root=REPO, allowlist=None):
             if k in allowlist and isinstance(v, (int, float)) and v > 0}
 
 
+def newest_zero_rungs(root=REPO):
+    """(round, {rung: value}) of the ALLOWLIST_ZERO paths on the
+    NEWEST successful run — zeros KEPT, unlike :func:`extract_rungs`
+    (this check exists precisely to tell 0 from >0)."""
+    best = None
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rec = _headline_record(blob)
+        if rec is None or rec.get("error"):
+            continue
+        v = rec.get("value")
+        if not isinstance(v, (int, float)) or v <= 0:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, rec)
+    if best is None:
+        return None, {}
+    out = {}
+    for rung, p in ALLOWLIST_ZERO.items():
+        v = _dig(best[1], p)
+        if v is not None:
+            out[rung] = float(v)
+    return best[0], out
+
+
 def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
     """Returns (ok, report_lines)."""
     traj = load_trajectory(root, allowlist)
@@ -195,11 +242,28 @@ def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
         for rung, v in rungs.items():
             prev = ceilings.get(rung)
             ceilings[rung] = v if prev is None else min(prev, v)
+    # must-be-zero invariants ride the NEWEST run alone — no baseline
+    # needed, so they apply even on the first successful run
+    zero_ok = True
+    zero_lines = []
+    if allowlist is None:
+        _, zvals = newest_zero_rungs(root)
+        for rung, v in sorted(zvals.items()):
+            if v > 0:
+                zero_ok = False
+                zero_lines.append(
+                    f"  ✗ {rung}: {v:g} — must-be-zero invariant "
+                    "violated: REGRESSION")
+            elif verbose:
+                zero_lines.append(
+                    f"  ✓ {rung}: 0 (invariant holds)")
     if not floors and not ceilings:
         lines.append(f"bench guard: r{newest_round:02d} is the first "
                      "successful run — baseline established, nothing "
-                     "to compare (pass)")
-        return True, lines
+                     "to compare"
+                     f"{' (pass)' if zero_ok else ''}")
+        lines.extend(zero_lines)
+        return zero_ok, lines
     ok = True
     for rung, floor in sorted(floors.items()):
         v = newest.get(rung)
@@ -236,6 +300,8 @@ def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
             lines.append(f"  ✓ {rung}: {v:.2f} ms vs baseline "
                          f"{ceiling:.2f} ms ({ratio:.3f}x, lower is "
                          "better)")
+    lines.extend(zero_lines)
+    ok = ok and zero_ok
     lines.insert(0, f"bench guard: r{newest_round:02d} vs "
                     f"{len(prior)} prior run(s) + published floors, "
                     f"tolerance {tolerance:.0%}: "

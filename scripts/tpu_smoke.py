@@ -973,6 +973,52 @@ def main():
         assert card["deterministic"]["shed_by_reason"], card
         assert card["deterministic"]["goodput"]["request_goodput"] < 1.0
 
+    @case("failover_replay")
+    def _():
+        # exactly-once failover on the real backend: a fleet replay
+        # with FLAGS_serving_failover on kills one replica mid-trace;
+        # the victim's journaled in-flight work must re-dispatch onto
+        # survivors and settle — zero ``lost``, lineage recorded,
+        # token conservation intact
+        import tempfile
+        from paddle_tpu.inference import ServingEngine
+        from paddle_tpu.loadgen import (Episode, TenantSpec,
+                                        build_scorecard, generate_trace)
+        from paddle_tpu.loadgen.replay import replay_fleet
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import federation as fed
+
+        cfg = L.llama_tiny(num_hidden_layers=1)
+        params = L.init_params(cfg, jax.random.PRNGKey(3))
+        fed.reset()
+        try:
+            trace = generate_trace(
+                41, duration_s=1.2, rate=24.0,
+                tenants=[TenantSpec("t0"), TenantSpec("t1")],
+                prompt_len=(3, 8), max_new_tokens=(4, 12))
+            with tempfile.TemporaryDirectory() as hb_dir:
+                res = replay_fleet(
+                    lambda name: ServingEngine(
+                        L, params, cfg, num_slots=2, max_len=24,
+                        page_size=4, decode_chunk=2, failover=True),
+                    trace, replicas=2,
+                    episodes=[Episode("kill", at_s=0.3,
+                                      replica="replica1")],
+                    dt_per_tick=0.02, steps_per_tick=1,
+                    heartbeat_dir=hb_dir, heartbeat_timeout=6.0,
+                    failover=True)
+            counts = res.terminal_counts()
+            assert counts.get("lost", 0) == 0, counts
+            assert len(res.terminal) == res.offered
+            assert res.failover["counters"]["stranded"] >= 1, \
+                res.failover
+            assert any(r.get("recovered_from")
+                       for r in res.terminal.values())
+            card = build_scorecard(res)
+            assert card["verdict"]["pass"], card["verdict"]
+        finally:
+            fed.reset()
+
     @case("ragged_paged_attention_kernel")
     def _():
         # the pallas kernel compiled NATIVELY (not interpret) vs the jnp

@@ -449,6 +449,85 @@ class TestFleetReplay:
         finally:
             fed.reset()
 
+    @pytest.mark.slow
+    def test_fleet_kill_failover_exactly_once(self, tmp_path):
+        # the same scripted kill with FLAGS_serving_failover on: the
+        # victim's journaled in-flight work is stranded, re-dispatched
+        # through normal admission on a survivor, and ends in exactly
+        # one terminal state with lineage — ZERO ``lost``, no token
+        # delivered twice (token conservation holds even though the
+        # victim's partial generation died with it)
+        from paddle_tpu.monitor import federation as fed
+        fed.reset()
+        try:
+            trace = generate_trace(
+                41, duration_s=1.2, rate=24.0,
+                tenants=[TenantSpec("t0"), TenantSpec("t1")],
+                prompt_len=(3, 8), max_new_tokens=(4, 12))
+            res = replay_fleet(
+                lambda name: _mk_engine(failover=True), trace,
+                replicas=2,
+                episodes=[Episode("kill", at_s=0.3,
+                                  replica="replica1")],
+                dt_per_tick=0.02, steps_per_tick=1,
+                heartbeat_dir=str(tmp_path), heartbeat_timeout=6.0,
+                failover=True)
+            kinds = [e["kind"] for e in res.episodes]
+            assert "killed" in kinds and "recovered" in kinds
+            counts = res.terminal_counts()
+            assert counts.get("lost", 0) == 0, counts
+            assert len(res.terminal) == res.offered
+            # the durability layer saw the strand and settled it
+            assert res.failover is not None
+            ctr = res.failover["counters"]
+            assert ctr["stranded"] >= 1
+            assert ctr["redispatched"] + ctr["quarantined"] \
+                + ctr["expired"] >= 1
+            recovered = [r for r in res.terminal.values()
+                         if r.get("recovered_from")]
+            assert recovered, res.failover
+            for rec in recovered:
+                assert rec["recovered_from"] == ["replica1"], rec
+                assert rec["state"] in ("completed", "expired",
+                                        "shed", "quarantined")
+                assert rec.get("failover_attempts", 0) >= 1
+            card = build_scorecard(res)
+            # token conservation inside the verdict pins "no token
+            # delivered twice": emitted == generated - discarded even
+            # with the re-dispatch regenerating from scratch
+            assert card["verdict"]["pass"], card["verdict"]
+            det_fo = card["deterministic"]["failover"]
+            assert det_fo["recovered"] == ctr["recovered"]
+            assert det_fo["failover_attempts"] >= 1
+            t_fo = card["timing"]["failover"]
+            assert t_fo["coordinator"]["counters"] == ctr
+            if ctr["recovered"]:
+                assert t_fo["recovery_s"]["count"] == len(
+                    [r for r in res.terminal.values()
+                     if r.get("recovery_s") is not None])
+                assert t_fo["recovery_s"]["p99"] > 0
+        finally:
+            fed.reset()
+
+    def test_fleet_flags_off_has_no_failover_surface(self):
+        # flag off: no journal, no coordinator, zeroed deterministic
+        # block — the flags-off determinism diff is unchanged
+        from paddle_tpu.monitor import federation as fed
+        fed.reset()
+        try:
+            res = replay_fleet(lambda name: _mk_engine(),
+                               _small_trace(31), replicas=2,
+                               dt_per_tick=0.05, steps_per_tick=2)
+            assert res.failover is None
+            assert res.engine_flags["failover"] is False
+            card = build_scorecard(res)
+            assert card["deterministic"]["failover"] == {
+                "recovered": 0, "failover_attempts": 0,
+                "quarantined": 0}
+            assert "failover" not in card["timing"]
+        finally:
+            fed.reset()
+
     def test_kill_without_heartbeat_rejected(self):
         with pytest.raises(ValueError, match="heartbeat"):
             replay_fleet(lambda name: _mk_engine(), _small_trace(),
@@ -480,6 +559,11 @@ def _bench_blob(value, extra=None):
 def _replay_extra(goodput, ttft_p99):
     return {"serving_trace_replay": {
         "goodput_tokens_per_sec": goodput, "ttft_p99_ms": ttft_p99}}
+
+
+def _failover_extra(lost, recovery_p99):
+    return {"serving_failover_replay": {
+        "lost": lost, "recovery_s_p99": recovery_p99}}
 
 
 class TestReplayBenchGuard:
@@ -545,6 +629,46 @@ class TestReplayBenchGuard:
         assert ok, "\n".join(lines)
         assert any("serving_replay_goodput" in l and "absent" in l
                    for l in lines)
+
+    def test_failover_rungs_in_allowlists(self):
+        guard = _load_guard()
+        assert guard.ALLOWLIST_LOWER["serving_failover_recovery_s_p99"] \
+            == "extra.serving_failover_replay.recovery_s_p99"
+        assert guard.ALLOWLIST_ZERO["serving_failover_lost"] \
+            == "extra.serving_failover_replay.lost"
+
+    def test_failover_lost_nonzero_fails_even_on_first_run(self,
+                                                           tmp_path):
+        # the invariant has no baseline: one run with a positive lost
+        # count is already a failure (and zero passes)
+        guard = _load_guard()
+        root = str(tmp_path)
+        self._write(root, 1, _bench_blob(1000.0,
+                                         _failover_extra(1, 0.5)))
+        ok, lines = guard.check(root)
+        assert not ok
+        assert any("serving_failover_lost" in l and "REGRESSION" in l
+                   for l in lines)
+        self._write(root, 1, _bench_blob(1000.0,
+                                         _failover_extra(0, 0.5)))
+        ok, lines = guard.check(root)
+        assert ok, "\n".join(lines)
+
+    def test_failover_recovery_p99_ceiling(self, tmp_path):
+        guard = _load_guard()
+        root = str(tmp_path)
+        self._write(root, 1, _bench_blob(1000.0,
+                                         _failover_extra(0, 1.0)))
+        self._write(root, 2, _bench_blob(1000.0,
+                                         _failover_extra(0, 2.0)))
+        ok, lines = guard.check(root)
+        assert not ok
+        assert any("serving_failover_recovery" in l
+                   and "REGRESSION" in l for l in lines)
+        self._write(root, 2, _bench_blob(1000.0,
+                                         _failover_extra(0, 1.05)))
+        ok, lines = guard.check(root)
+        assert ok, "\n".join(lines)
 
     def test_checked_in_trajectory_is_green(self):
         guard = _load_guard()
