@@ -171,6 +171,26 @@ define_flag("serving_failover", False,
             "per-replica circuit breakers). Off (the default) = no "
             "journal, no coordinator, byte-identical scheduling and "
             "tokens.")
+define_flag("serving_prefix_cache", False,
+            "Radix shared-prefix KV cache (inference/paged.py "
+            "PrefixCache): admission looks up the longest cached "
+            "page-aligned prompt prefix and forks those committed "
+            "pages with pure refcount bumps, prefilling only the "
+            "uncached tail; retirement inserts the request's "
+            "committed pages back into the radix. Cached pages are "
+            "pinned by a cache hold with LRU leaf eviction under "
+            "pool pressure. Off (the default) = no cache, "
+            "byte-identical scheduling and tokens.")
+define_flag("serving_spec_decode", False,
+            "N-gram self-drafting speculative decode on the greedy "
+            "turbo path: draft k tokens per sequence from a bigram "
+            "table over the request's own context, verify all k in "
+            "ONE jitted window program (k-fold fewer sequential "
+            "model passes), accept the longest matching run at the "
+            "chunk boundary. Greedy verify makes spec-on output "
+            "token-identical to spec-off by construction. Off (the "
+            "default) = sequential chunked decode, byte-identical "
+            "tokens.")
 define_flag("fault_injection", "",
             "Chaos-run fault spec: comma list of point:action[:nth[:delay_s]]"
             " armed at import by paddle_tpu.testing.faults (actions: "

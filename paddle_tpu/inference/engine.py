@@ -121,7 +121,9 @@ from ..monitor import server as _mserver
 from ..monitor import trace as _trace
 from ..monitor import slo as _slo
 from ..monitor.registry import LATENCY_BUCKETS_MS as _LATENCY_BUCKETS_MS
-from .paged import PagedKVCache, paged_decode_step, paged_prefill
+from .paged import (PagedKVCache, PrefixCache, paged_decode_step,
+                    paged_prefill, paged_prefill_shared,
+                    paged_verify_window)
 
 
 def _engine_health_provider(ref):
@@ -222,7 +224,15 @@ class RequestCost:
     tenant: str = "default"
     priority: int = 0
     prefill_tokens: int = 0      # prompt tokens prefilled (re-prefills
-    #                              after preemption included)
+    #                              after preemption included; tokens a
+    #                              cached prefix skipped are NOT here —
+    #                              they were not work done)
+    prefix_cached_tokens: int = 0    # prompt tokens served from the
+    #                              radix prefix cache instead of
+    #                              prefill (cumulative across re-runs)
+    prefill_flops_saved: float = 0.0  # modeled FLOPs the cached prefix
+    #                              skipped (tail program's registered
+    #                              per-padded-token rate x cached)
     decode_tokens: int = 0       # decode emissions (work done, incl.
     #                              tokens a preemption later discarded)
     discarded_tokens: int = 0    # thrown away by preemption recompute
@@ -269,7 +279,7 @@ class RequestOutput:
 class _Slot:
     __slots__ = ("req", "kv_len", "gen", "tokens", "pending", "done",
                  "keys", "preemptions", "t_first", "t_last",
-                 "cost", "t_tick", "steps0")
+                 "cost", "t_tick", "steps0", "ng", "ng_n")
 
     def __init__(self, req: Request, keys: np.ndarray):
         self.req = req
@@ -285,6 +295,9 @@ class _Slot:
         self.cost = None         # the request's RequestCost (monitor on)
         self.t_tick = None       # last page-seconds integration stamp
         self.steps0 = 0          # engine decode_steps at admission
+        self.ng = None           # spec decode: bigram draft table over
+        #                          this request's own context (lazy)
+        self.ng_n = 0            # context tokens folded into ng so far
 
 
 class EngineStats:
@@ -301,6 +314,15 @@ class EngineStats:
         self.tokens_discarded = 0    # thrown away by preemption recompute
         self.peak_pages_in_use = 0
         self._occ_steps = 0      # decode steps weighted by slot count
+        # shared-prefix radix cache (FLAGS_serving_prefix_cache)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0     # prompt tokens not re-prefilled
+        self.prefix_evictions = 0        # radix nodes dropped by pressure
+        # n-gram speculative decode (FLAGS_serving_spec_decode)
+        self.spec_rounds = 0     # per-slot verify windows dispatched
+        self.spec_drafted = 0    # draft tokens proposed (C-1 per round)
+        self.spec_accepted = 0   # drafts accepted by greedy verify
 
     def occupancy(self) -> float:
         """Useful-token fraction of the decode grid: decode-emitted
@@ -319,7 +341,14 @@ class EngineStats:
                 "tokens_prefilled": self.tokens_prefilled,
                 "tokens_discarded": self.tokens_discarded,
                 "peak_pages_in_use": self.peak_pages_in_use,
-                "batch_occupancy": round(self.occupancy(), 4)}
+                "batch_occupancy": round(self.occupancy(), 4),
+                "prefix_lookups": self.prefix_lookups,
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_saved": self.prefix_tokens_saved,
+                "prefix_evictions": self.prefix_evictions,
+                "spec_rounds": self.spec_rounds,
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted}
 
 
 def _sample_rows(logits, temps, keys, sampled=True):
@@ -382,7 +411,9 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  shed_on_burn: Optional[bool] = None,
                  slo_preemption: Optional[bool] = None,
-                 failover: Optional[bool] = None):
+                 failover: Optional[bool] = None,
+                 prefix_cache: Optional[bool] = None,
+                 spec_decode: Optional[bool] = None):
         # Overload policies (ROADMAP item 5, acting half). Each kwarg
         # defaults to its FLAGS_serving_* flag (the make_train_step
         # guard=None pattern); every flag defaults OFF, and with all of
@@ -410,6 +441,12 @@ class ServingEngine:
         # test) calls attach_journal, the publish_frames opt-in shape.
         # Flag off and unattached: one None check per terminal event.
         self._failover = bool(_opt(failover, "serving_failover"))
+        # Per-token-latency optimizations (ROADMAP item 2): both
+        # default off; flags-off scheduling and emitted tokens are
+        # byte-identical (the parity tests pin it). The PrefixCache
+        # itself is created after the page pool below.
+        self._prefix_on = bool(_opt(prefix_cache, "serving_prefix_cache"))
+        self._spec_decode = bool(_opt(spec_decode, "serving_spec_decode"))
         self._journal = None
         self._draining = False
         self._deadlines_seen = False   # sticky: first deadline request
@@ -440,6 +477,10 @@ class ServingEngine:
         self.watermark_pages = int(watermark * num_pages)
         self.cache = PagedKVCache(config, num_pages, self.page_size,
                                   self.max_pages_per_seq, kv_dtype)
+        # radix shared-prefix cache over the pool's committed pages;
+        # None (flag off) short-circuits every hook to the original code
+        self._prefix = PrefixCache(self.cache.alloc) if self._prefix_on \
+            else None
         self.queue: deque = deque()
         self.slots: List[Optional[_Slot]] = [None] * self.num_slots
         self.outputs: Dict[int, RequestOutput] = {}
@@ -447,6 +488,10 @@ class ServingEngine:
         self._rng_fallback = 0
 
         self._prefill_fns: dict = {}     # (S_pad, sampled) -> jitted
+        # shared-prefix tail prefills keyed by (g, S_tail, ctx_pages,
+        # sampled); spec verify windows keyed by chunk length
+        self._prefill_shared_fns: dict = {}
+        self._spec_fns: dict = {}
         # chunk programs keyed by (length, sampled): greedy-only skips
         # per-token RNG; the 4x "turbo" length engages when every live
         # slot is guaranteed to run it end-to-end (no retire/join could
@@ -1053,6 +1098,117 @@ class ServingEngine:
             self._prefill_fns[(g, s_pad, sampled)] = fn
         return fn
 
+    def _prefill_shared_fn(self, g: int, s_eff: int, ncp: int,
+                           sampled: bool):
+        """Tail-only prefill over ``ncp`` cached prefix pages: same
+        sample-inside-the-program contract as ``_prefill_fn``, one
+        compile per (group, tail, ctx-pages, sampled) specialization
+        (ctx length is page-bucketed like the tail, so the key space
+        stays log-bounded)."""
+        fn = self._prefill_shared_fns.get((g, s_eff, ncp, sampled))
+        if fn is None:
+            family, config = self.family, self.config
+
+            def _pf(params, ids, pool_k, pool_v, page_rows, slen, temp,
+                    key, ctx_rows):
+                pk, pv, logits = paged_prefill_shared(
+                    family, params, ids, config, pool_k, pool_v,
+                    page_rows, slen, ctx_rows)
+                tok = _sample_rows(logits, temp, key, sampled)
+                return pk, pv, tok
+
+            fn = jax.jit(_pf, donate_argnums=(2, 3))
+            self._prefill_shared_fns[(g, s_eff, ncp, sampled)] = fn
+        return fn
+
+    def _spec_fn(self, C: int):
+        """Greedy verify window for speculative decode: one program
+        per chunk length, argmax inside (the host only ever needs the
+        predicted ids)."""
+        fn = self._spec_fns.get(C)
+        if fn is None:
+            family, config = self.family, self.config
+
+            def _vf(params, pool_k, pool_v, bt, drafts, kv_len, live):
+                pk, pv, logits = paged_verify_window(
+                    family, params, drafts, config, pool_k, pool_v,
+                    bt, kv_len, live)
+                return pk, pv, jnp.argmax(
+                    logits, axis=-1).astype(jnp.int32)
+
+            fn = jax.jit(_vf, donate_argnums=(1, 2))
+            self._spec_fns[C] = fn
+        return fn
+
+    def _free_slack(self) -> int:
+        """Free pages the admission watermark may count: the free list
+        plus prefix-cache pages reclaimable on demand (one
+        ``_evict_pages`` away from free) — cold cache entries must
+        never jam admission. Flag off: exactly ``free_pages``."""
+        free = self.cache.alloc.free_pages
+        if self._prefix is not None:
+            free += self._prefix.reclaimable()
+        return free
+
+    def _evict_pages(self, n: int) -> int:
+        """LRU-evict prefix-cache entries until ``n`` pages hit the
+        free list (or nothing evictable remains); returns pages freed.
+        Flag off: a no-op 0."""
+        if self._prefix is None:
+            return 0
+        before = self._prefix.evicted_nodes
+        freed = self._prefix.evict(n)
+        dropped = self._prefix.evicted_nodes - before
+        if dropped:
+            self.stats.prefix_evictions += dropped
+            _monitor.inc("serving.prefix_cache.evictions", dropped,
+                         doc="radix nodes dropped under pool pressure")
+        return freed
+
+    def _match_len(self, req: Request) -> int:
+        """Cached page-aligned prefix length for a prompt (group-fill
+        compatibility probe; refreshes matched nodes' LRU stamps)."""
+        return self._prefix.match(np.asarray(req.prompt))[0]
+
+    def _alloc_for(self, req: Request, s_pad: int):
+        """Admission allocation through the radix prefix cache: fork
+        the longest cached page-aligned prefix by refcount and take
+        only the tail fresh, evicting LRU cache leaves under pool
+        pressure. The match is re-run after every eviction round —
+        eviction may drop the very nodes just matched, and a stale
+        pages list must never be forked. Stamps ``req._pfx_cached``
+        with the shared token count on success. Flag off: the original
+        ``alloc`` call, byte-identical."""
+        alloc = self.cache.alloc
+        if self._prefix is None:
+            return alloc.alloc(req.rid, s_pad)
+        self.stats.prefix_lookups += 1
+        _monitor.inc("serving.prefix_cache.lookups",
+                     doc="admission prompt-prefix radix probes")
+        need = alloc.pages_for(s_pad)
+        while True:
+            cached, pages = self._prefix.match(np.asarray(req.prompt))
+            missing = (need - len(pages)) - alloc.free_pages
+            if missing > 0:
+                if self._evict_pages(missing) == 0:
+                    return None
+                continue
+            got = alloc.alloc_prefix(req.rid, pages, s_pad) if cached \
+                else alloc.alloc(req.rid, s_pad)
+            if got is None:
+                return None
+            req._pfx_cached = cached
+            if cached:
+                self.stats.prefix_hits += 1
+                self.stats.prefix_tokens_saved += cached
+                _monitor.inc("serving.prefix_cache.hits",
+                             doc="admissions that forked cached "
+                                 "prefix pages")
+                _monitor.inc("serving.prefix_cache.tokens_saved", cached,
+                             doc="prompt tokens served from cached KV "
+                                 "instead of prefill")
+            return got
+
     def _keys_for(self, req: Request) -> np.ndarray:
         if req.temperature <= 0.0:
             return np.zeros((req.max_new_tokens, 2), np.uint32)
@@ -1092,6 +1248,21 @@ class ServingEngine:
                 self.cache.alloc.page_count(slot.req.rid)
                 * (now_t - slot.t_tick))
             slot.t_tick = now_t
+        if self._prefix is not None and slot.kv_len >= self.page_size:
+            # retirement insertion: only COMMITTED positions enter the
+            # radix — the prompt plus the generated tokens whose KV is
+            # already written (kv_len worth; the final pending token's
+            # KV never was). insert() takes a cache hold on each newly
+            # shared page BEFORE the free below, so the pages survive
+            # the sequence's release with ref >= 1.
+            prompt = np.asarray(slot.req.prompt, np.int32)
+            plen = int(prompt.shape[0])
+            gen_committed = slot.kv_len - plen
+            stream = prompt if gen_committed <= 0 else np.concatenate(
+                [prompt, np.asarray(slot.tokens[:gen_committed],
+                                    np.int32)])
+            self._prefix.insert(stream,
+                                self.cache.alloc.seq_pages(slot.req.rid))
         self.cache.alloc.free(slot.req.rid)
         self.outputs[slot.req.rid] = RequestOutput(
             rid=slot.req.rid,
@@ -1256,11 +1427,11 @@ class ServingEngine:
             need = s_pad // self.page_size
             idle = not any(s is not None and not s.done
                            for s in self.slots)
-            if (self.cache.alloc.free_pages - need < self.watermark_pages
+            if (self._free_slack() - need < self.watermark_pages
                     and not idle):        # head-of-line admission control
                 break
             self.queue.popleft()
-            if self.cache.alloc.alloc(req.rid, s_pad) is None:
+            if self._alloc_for(req, s_pad) is None:
                 self.queue.appendleft(req)
                 # an idle engine that cannot place its head request will
                 # never make progress — that is a sizing error, not a
@@ -1272,20 +1443,32 @@ class ServingEngine:
                 break
             # group same-bucket waiters into this prefill dispatch (a
             # bounded look-through keeps overall FIFO fairness while
-            # letting one program admit several requests)
+            # letting one program admit several requests). With the
+            # prefix cache on, co-grouped requests must also match the
+            # head's cached prefix length — the tail program's context
+            # page count is a static compile-time constant per group.
+            head_cached = getattr(req, "_pfx_cached", 0)
             group = [req]
             scanned = 0
             while (len(group) < len(free)
                    and scanned < len(self.queue)
-                   and self.cache.alloc.free_pages - need
+                   and self._free_slack() - need
                    >= self.watermark_pages):
                 cand = self.queue[scanned]
                 cp = int(np.asarray(cand.prompt).shape[0])
-                if max(self._bucket(cp), self.page_size) != s_pad:
+                if max(self._bucket(cp), self.page_size) != s_pad or (
+                        self._prefix is not None
+                        and self._match_len(cand) != head_cached):
                     scanned += 1
                     continue
-                if self.cache.alloc.alloc(cand.rid, s_pad) is None:
+                if self._alloc_for(cand, s_pad) is None:
                     break
+                if getattr(cand, "_pfx_cached", 0) != head_cached:
+                    # an eviction inside _alloc_for shifted the match;
+                    # not groupable this pass — leave it queued
+                    self.cache.alloc.free(cand.rid)
+                    scanned += 1
+                    continue
                 del self.queue[scanned]
                 group.append(cand)
             self._prefill_group(free, group, s_pad)
@@ -1338,17 +1521,18 @@ class ServingEngine:
             need = s_pad // self.page_size
             idle = not any(s is not None and not s.done
                            for s in self.slots)
-            if (self.cache.alloc.free_pages - need < self.watermark_pages
+            if (self._free_slack() - need < self.watermark_pages
                     and not idle):
                 break
             del self.queue[pos]
-            if self.cache.alloc.alloc(req.rid, s_pad) is None:
+            if self._alloc_for(req, s_pad) is None:
                 self.queue.insert(pos, req)
                 E.enforce(not idle,
                           f"request {req.rid} needs {need} pages but only "
                           f"{self.cache.alloc.free_pages} exist free on an "
                           f"idle engine", error=E.ResourceExhaustedError)
                 break
+            head_cached = getattr(req, "_pfx_cached", 0)
             group = [req]
             if cap:
                 t = getattr(req, "tenant", "default")
@@ -1369,17 +1553,24 @@ class ServingEngine:
             for j in order:
                 if len(group) >= len(free):
                     break
-                if (self.cache.alloc.free_pages - need
+                if (self._free_slack() - need
                         < self.watermark_pages):
                     break
                 cand = self.queue[j]
                 cp = int(np.asarray(cand.prompt).shape[0])
                 ct = getattr(cand, "tenant", "default")
                 if max(self._bucket(cp), self.page_size) != s_pad or (
-                        cap and inflight.get(ct, 0) >= cap):
+                        cap and inflight.get(ct, 0) >= cap) or (
+                        self._prefix is not None
+                        and self._match_len(cand) != head_cached):
                     continue
-                if self.cache.alloc.alloc(cand.rid, s_pad) is None:
+                if self._alloc_for(cand, s_pad) is None:
                     break
+                if getattr(cand, "_pfx_cached", 0) != head_cached:
+                    # eviction inside _alloc_for shifted the match;
+                    # not groupable this pass — leave it queued
+                    self.cache.alloc.free(cand.rid)
+                    continue
                 picked.append(j)
                 group.append(cand)
                 if cap:
@@ -1417,17 +1608,30 @@ class ServingEngine:
         g = 1
         while g < len(group):
             g *= 2
-        ids = np.zeros((g, s_pad), np.int32)
-        rows = np.full((g, need), self.cache.num_pages, np.int32)
+        # with the prefix cache on, every member of this group shares
+        # the same cached page-aligned prefix length (admission grouped
+        # by it): the program prefills only the uncached tail, reading
+        # the shared context pages without ever writing them
+        cached = int(getattr(group[0], "_pfx_cached", 0)) \
+            if self._prefix is not None else 0
+        ncp = cached // self.page_size
+        s_eff = s_pad - cached
+        need_eff = need - ncp
+        ids = np.zeros((g, s_eff), np.int32)
+        rows = np.full((g, need_eff), self.cache.num_pages, np.int32)
+        ctx_rows = np.full((g, ncp), self.cache.num_pages, np.int32)
         slen = np.ones(g, np.int32)
         temps = np.zeros(g, np.float32)
         keys = np.zeros((g, 2), np.uint32)
         slots = []
         for j, r in enumerate(group):
             plen = int(np.asarray(r.prompt).shape[0])
-            ids[j, :plen] = np.asarray(r.prompt, np.int32)
-            rows[j] = self.cache.alloc.block_row(r.rid, need)
-            slen[j] = plen
+            ids[j, :plen - cached] = np.asarray(r.prompt,
+                                                np.int32)[cached:]
+            brow = self.cache.alloc.block_row(r.rid, need)
+            ctx_rows[j] = brow[:ncp]
+            rows[j] = brow[ncp:]
+            slen[j] = plen - cached
             temps[j] = r.temperature
             slot = _Slot(r, self._keys_for(r))
             slot.kv_len = plen
@@ -1435,21 +1639,26 @@ class ServingEngine:
             keys[j] = slot.keys[0]
             slots.append(slot)
         sampled = any(r.temperature > 0 for r in group)
-        pf = self._prefill_fn(g, s_pad, sampled)
+        pf = self._prefill_shared_fn(g, s_eff, ncp, sampled) if cached \
+            else self._prefill_fn(g, s_pad, sampled)
         pf_args = (self.params, jnp.asarray(ids), self.cache.pool["k"],
                    self.cache.pool["v"])
         pf_kwargs = dict(page_rows=jnp.asarray(rows),
                          slen=jnp.asarray(slen), temp=jnp.asarray(temps),
                          key=jnp.asarray(keys))
+        if cached:
+            pf_kwargs["ctx_rows"] = jnp.asarray(ctx_rows)
         exec_rec = None
         pf_flops_share = None
         if mon:
             # introspection-registry record, BEFORE the dispatch that
             # donates the pool buffers (once per specialization)
             key = self._record_serving_program(
-                ("serving.prefill", g, s_pad, sampled),
-                f"serving.prefill[g{g},s{s_pad}]", pf, pf_args,
-                pf_kwargs, donated=(2, 3))
+                ("serving.prefill_shared", g, s_eff, ncp, sampled)
+                if cached else ("serving.prefill", g, s_pad, sampled),
+                f"serving.prefill_shared[g{g},s{s_eff},ctx{ncp}]"
+                if cached else f"serving.prefill[g{g},s{s_pad}]",
+                pf, pf_args, pf_kwargs, donated=(2, 3))
             from ..monitor import exectime as _exectime
             exec_rec = _exectime.maybe_sample(key, feed_last=False)
             # modeled-FLOPs attribution: the registered program's
@@ -1483,7 +1692,7 @@ class ServingEngine:
             for r in group:
                 _trace.instant("serving.first_token", rid=r.rid)
         for j, (r, slot) in enumerate(zip(group, slots)):
-            self.cache.alloc.advance(r.rid, int(slen[j]))
+            self.cache.alloc.advance(r.rid, int(slen[j]) + cached)
             tok = int(toks[j])
             slot.tokens.append(tok)
             slot.pending = tok
@@ -1497,6 +1706,15 @@ class ServingEngine:
                 slot.steps0 = self.stats.decode_steps
                 if slot.cost is not None:
                     slot.cost.prefill_tokens += int(slen[j])
+                    if cached:
+                        slot.cost.prefix_cached_tokens += cached
+                        if pf_flops_share:
+                            # modeled: the tail program's per-padded-
+                            # token cost scaled by the tokens the cache
+                            # served — what a full prefill would have
+                            # added, to first order
+                            slot.cost.prefill_flops_saved += (
+                                pf_flops_share / s_eff * cached)
                     if pf_flops_share:
                         slot.cost.model_flops += pf_flops_share
             slot.done = (tok == r.eos_token_id
@@ -1544,9 +1762,12 @@ class ServingEngine:
             got = self.cache.alloc.ensure(slot.req.rid,
                                           slot.kv_len + appends)
             if got is None:
-                E.enforce(self._preempt_one(),
-                          "page pool exhausted with nothing left to "
-                          "preempt", error=E.ResourceExhaustedError)
+                # reclaim cold prefix-cache pages before sacrificing a
+                # live request (flag off: a no-op 0, byte-identical)
+                if self._evict_pages(1) == 0:
+                    E.enforce(self._preempt_one(),
+                              "page pool exhausted with nothing left to "
+                              "preempt", error=E.ResourceExhaustedError)
                 continue                  # retry this slot
             if got[0] or got[1]:
                 self._bt_dirty = True
@@ -1594,6 +1815,15 @@ class ServingEngine:
         live_idx = self._ensure_chunk_capacity(live_idx, C)
         if not live_idx:
             return True
+        if (self._spec_decode and C == self.turbo_chunk
+                and not any(self.slots[i].req.temperature > 0
+                            for i in live_idx)):
+            # greedy turbo chunk: verify a self-drafted window in ONE
+            # model pass instead of C sequential decode steps. The
+            # turbo preconditions (full grid, no EOS, remaining run
+            # covers the chunk) already hold, so accept/reject lands at
+            # the same chunk boundary the sequential path downloads at.
+            return self._spec_step(live_idx, C)
 
         B = self.num_slots
         if self._state_dirty:
@@ -1722,6 +1952,147 @@ class ServingEngine:
         _monitor.set_gauge("serving.batch.occupancy", round(occ, 4),
                            doc="generated tokens / (decode steps x slots)")
         _monitor.inc("serving.tokens.generated", new_tokens)
+        return True
+
+    def _draft_for(self, s: "_Slot", C: int) -> np.ndarray:
+        """Draft a C-token verify window for one sequence: position 0
+        is the real pending token (its KV is the one unwritten commit),
+        positions 1..C-1 come from a bigram table folded incrementally
+        over the request's own context (prompt + emitted tokens), with
+        repeat-last as the cold-miss fallback. Pure host work — the
+        table is a dict on the slot, extended only over tokens appended
+        since the last draft."""
+        if s.ng is None:
+            s.ng = {}
+        prompt = np.asarray(s.req.prompt)
+        plen = int(prompt.shape[0])
+        total = plen + len(s.tokens)
+
+        def at(p):
+            return int(prompt[p]) if p < plen else int(s.tokens[p - plen])
+
+        for p in range(max(s.ng_n, 2), total):
+            s.ng[(at(p - 2), at(p - 1))] = at(p)
+        s.ng_n = total
+        out = np.empty(C, np.int32)
+        out[0] = s.pending
+        p2, p1 = at(total - 2), at(total - 1)
+        for t in range(1, C):
+            nxt = s.ng.get((p2, p1), p1)
+            out[t] = nxt
+            p2, p1 = p1, nxt
+        return out
+
+    def _spec_step(self, live_idx: List[int], C: int) -> bool:
+        """One speculative verify round over the greedy turbo chunk:
+        write all C drafted positions' KV, run ONE attention pass over
+        the window, and accept the longest run where the model's greedy
+        prediction confirms the next draft. Token-identity with the
+        sequential path is by construction: draft position 0 is the
+        real pending token, so prediction 0 is exactly the sequential
+        path's next token; each further draft is only kept when it
+        EQUALS the greedy prediction before it, and the first emitted
+        token after any rejection is again the model's own prediction.
+        (Identity is at the math level: the verify window is a
+        differently-shaped program than the turbo chunk, so in reduced
+        precision an argmax near-tie can flip — exact in f32.)
+        Rejected positions' KV stays in the pool as garbage masked out
+        by sequence length and overwritten by later commits."""
+        B = self.num_slots
+        if self._bt_dirty:
+            seq_ids = [self.slots[i].req.rid
+                       if i in set(live_idx) else None for i in range(B)]
+            self._dev["bt"] = jnp.asarray(self.cache.block_tables(seq_ids))
+            self._bt_dirty = False
+        drafts = np.zeros((B, C), np.int32)
+        kv_len = np.zeros(B, np.int32)
+        live_m = np.zeros(B, bool)
+        for i in live_idx:
+            s = self.slots[i]
+            drafts[i] = self._draft_for(s, C)
+            kv_len[i] = s.kv_len
+            live_m[i] = True
+        vf = self._spec_fn(C)
+        vf_args = (self.params, self.cache.pool["k"],
+                   self.cache.pool["v"], self._dev["bt"],
+                   jnp.asarray(drafts), jnp.asarray(kv_len),
+                   jnp.asarray(live_m))
+        exec_rec = None
+        vf_flops_share = None
+        if _monitor.enabled():
+            key = self._record_serving_program(
+                ("serving.spec_chunk", C),
+                f"serving.spec_chunk[c{C}]", vf, vf_args, None,
+                donated=(1, 2))
+            from ..monitor import exectime as _exectime
+            exec_rec = _exectime.maybe_sample(key, feed_last=False)
+            vf_flops = self._program_flops(key)
+            if vf_flops:
+                vf_flops_share = vf_flops / len(live_idx)
+        with _trace.span("serving.spec_chunk", chunk=C,
+                         live=len(live_idx)), \
+                _pcap.annotate_step("serving.spec_chunk",
+                                    self.stats.decode_steps):
+            pk, pv, preds_a = vf(*vf_args)
+            self.cache.pool = {"k": pk, "v": pv}
+            preds = np.asarray(preds_a)                  # [B, C]
+        if exec_rec is not None:
+            exec_rec(None)
+        if _monitor.enabled():
+            self._maybe_sample_kv_absmax()
+        t_chunk = time.perf_counter() if _monitor.enabled() else None
+        new_tokens = 0
+        accepted_total = 0
+        for i in live_idx:
+            s = self.slots[i]
+            dr = drafts[i]
+            col = preds[i]
+            a = 0
+            while a < C - 1 and dr[a + 1] == col[a]:
+                a += 1
+            emitted = [int(t) for t in col[:a + 1]]
+            s.tokens.extend(emitted)
+            new_tokens += len(emitted)
+            accepted_total += a
+            self.cache.alloc.advance(s.req.rid, len(emitted))
+            s.kv_len += len(emitted)
+            s.gen += len(emitted)
+            s.pending = emitted[-1]
+            s.t_last = t_chunk if t_chunk is not None else s.t_last
+            if t_chunk is not None and s.cost is not None:
+                if s.t_tick is not None:
+                    s.cost.page_seconds += (
+                        self.cache.alloc.page_count(s.req.rid)
+                        * (t_chunk - s.t_tick))
+                s.t_tick = t_chunk
+                s.cost.slot_steps += C
+                s.cost.decode_tokens += len(emitted)
+                if vf_flops_share:
+                    s.cost.model_flops += vf_flops_share
+            # turbo preconditions rule out EOS; only the length bound
+            # can finish a sequence here
+            s.done = s.gen >= s.req.max_new_tokens
+        self.stats.decode_steps += C
+        self.stats.tokens_generated += new_tokens
+        self.stats.tokens_decoded += new_tokens
+        self.stats._occ_steps += C * self.num_slots
+        self.stats.spec_rounds += len(live_idx)
+        self.stats.spec_drafted += (C - 1) * len(live_idx)
+        self.stats.spec_accepted += accepted_total
+        occ = self.stats.occupancy()
+        _monitor.set_gauge("serving.batch.occupancy", round(occ, 4),
+                           doc="generated tokens / (decode steps x slots)")
+        _monitor.inc("serving.tokens.generated", new_tokens)
+        _monitor.inc("serving.spec.rounds", len(live_idx),
+                     doc="per-sequence speculative verify rounds")
+        _monitor.inc("serving.spec.drafted", (C - 1) * len(live_idx),
+                     doc="n-gram draft tokens proposed for verification")
+        _monitor.inc("serving.spec.accepted", accepted_total,
+                     doc="draft tokens confirmed by the greedy verify")
+        # the device-side sequential slot state is stale after a spec
+        # round (tokens/kv_len/gen advanced on the host): rebuild it
+        # before the next sequential chunk
+        self._state_dirty = True
         return True
 
     def _maybe_sample_kv_absmax(self):

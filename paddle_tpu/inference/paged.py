@@ -45,8 +45,9 @@ from ..core import enforce as E
 from ..models.llama import _head_logits, _mm, _qkv_proj, _rms
 from ..nn.functional.attention import rope_raw, rope_tables
 
-__all__ = ["PageAllocator", "PagedKVCache", "init_pool",
-           "paged_prefill", "paged_decode_step"]
+__all__ = ["PageAllocator", "PagedKVCache", "PrefixCache", "init_pool",
+           "paged_prefill", "paged_prefill_shared", "paged_decode_step",
+           "paged_verify_window"]
 
 
 # ---------------------------------------------------------------------------
@@ -69,6 +70,10 @@ class PageAllocator:
         self.max_pages_per_seq = int(max_pages_per_seq)
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         self._ref = np.zeros(num_pages, np.int32)
+        # prefix-cache pins: each held page carries exactly one extra
+        # ref owned by the radix cache (0/1 per page), so
+        # seq-held-counts + cache-holds == _ref stays auditable
+        self._cache_hold = np.zeros(num_pages, np.int32)
         # seq_id -> {"pages": [page ids], "len": tokens written}
         self._seqs: Dict[int, dict] = {}
 
@@ -108,16 +113,24 @@ class PageAllocator:
 
     def check_invariants(self):
         """Refcount bookkeeping audit (tests): every page is either free
-        (ref 0) or referenced exactly as many times as sequences hold
-        it, and the free list is duplicate-free."""
+        (ref 0) or referenced exactly as many times as sequences AND the
+        prefix cache hold it, and the free list is duplicate-free. The
+        cache-hold half is what proves prefix-cache eviction can never
+        free a page a live sequence holds: ``cache_release`` only
+        returns a page to the free list when dropping the cache's own
+        ref leaves zero — a live holder keeps it referenced."""
         counts = np.zeros(self.num_pages, np.int32)
         for s in self._seqs.values():
             for p in s["pages"]:
                 counts[p] += 1
-        if not np.array_equal(counts, self._ref):
+        if not np.array_equal(counts + self._cache_hold, self._ref):
             raise AssertionError(
                 f"refcount drift: held={counts.tolist()} "
+                f"cached={self._cache_hold.tolist()} "
                 f"ref={self._ref.tolist()}")
+        if np.any(self._cache_hold < 0) or np.any(self._cache_hold > 1):
+            raise AssertionError(
+                f"cache-hold out of range: {self._cache_hold.tolist()}")
         free = set(self._free)
         if len(free) != len(self._free):
             raise AssertionError("duplicate pages on the free list")
@@ -150,6 +163,61 @@ class PageAllocator:
             return None
         self._seqs[seq_id] = {"pages": pages, "len": 0}
         return pages
+
+    def alloc_prefix(self, seq_id: int, shared_pages: List[int],
+                     n_tokens: int) -> Optional[List[int]]:
+        """Create a sequence whose leading pages are SHARED (pure
+        refcount bumps — the ``fork`` seam at admission granularity):
+        ``shared_pages`` hold the committed KV of a cached prompt
+        prefix; the remainder up to ``n_tokens`` capacity is taken
+        fresh. The shared region is strictly shorter than the prompt
+        (the cache caps matches below the last prompt token), so the
+        holder's writes start at/after ``len(shared_pages)`` pages and
+        a shared page is never written — CoW via ``ensure`` still
+        covers any later aliasing. None = OOM, state unchanged."""
+        E.enforce(seq_id not in self._seqs,
+                  f"sequence {seq_id} already allocated")
+        need = self.pages_for(n_tokens)
+        E.enforce(need <= self.max_pages_per_seq,
+                  f"{n_tokens} tokens need {need} pages > "
+                  f"max_pages_per_seq {self.max_pages_per_seq}")
+        E.enforce(len(shared_pages) < need,
+                  f"shared prefix ({len(shared_pages)} pages) must "
+                  f"leave a fresh tail page (need {need})")
+        E.enforce(all(self._ref[p] > 0 for p in shared_pages),
+                  "shared prefix references an unreferenced page")
+        fresh = self._take(need - len(shared_pages))
+        if fresh is None:
+            return None
+        for p in shared_pages:
+            self._ref[p] += 1
+        pages = list(shared_pages) + fresh
+        self._seqs[seq_id] = {"pages": pages, "len": 0}
+        return pages
+
+    def cache_hold(self, page: int):
+        """Pin ``page`` with the prefix cache's own ref. Only committed
+        (currently referenced) pages may be cached — insertion runs at
+        retirement BEFORE the sequence's ``free``."""
+        E.enforce(self._ref[page] > 0,
+                  f"cache_hold on unreferenced page {page}")
+        E.enforce(self._cache_hold[page] == 0,
+                  f"page {page} already cache-held")
+        self._ref[page] += 1
+        self._cache_hold[page] = 1
+
+    def cache_release(self, page: int) -> int:
+        """Drop the cache's pin on ``page``. Returns 1 if the page hit
+        the free list (no live sequence held it), else 0 — eviction by
+        construction never frees a live sequence's page."""
+        E.enforce(self._cache_hold[page] == 1,
+                  f"cache_release on unheld page {page}")
+        self._cache_hold[page] = 0
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+            return 1
+        return 0
 
     def ensure(self, seq_id: int, total_tokens: int
                ) -> Optional[Tuple[List[int], List[Tuple[int, int]]]]:
@@ -209,6 +277,142 @@ class PageAllocator:
             E.enforce(self._ref[p] >= 0, f"double free of page {p}")
             if self._ref[p] == 0:
                 self._free.append(p)
+
+
+class _RadixNode:
+    """One page of cached prefix: ``key`` is the page's token tuple,
+    path-from-root is the page-aligned prefix it completes."""
+    __slots__ = ("key", "page", "children", "parent", "stamp")
+
+    def __init__(self, key, page, parent, stamp):
+        self.key = key
+        self.page = page
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.parent = parent
+        self.stamp = stamp
+
+
+class PrefixCache:
+    """Radix tree over committed, page-aligned KV prefixes (vLLM
+    automatic-prefix-caching / SGLang RadixAttention shape, at page
+    granularity: one node per page, edge key = that page's token ids).
+
+    Lifecycle contract with :class:`PageAllocator`:
+
+    - ``insert`` runs at request retirement, BEFORE the sequence's
+      ``free`` — only fully committed pages enter, each pinned with
+      ``cache_hold`` (one extra ref owned by the cache).
+    - ``match`` returns the longest cached prefix STRICTLY shorter than
+      the prompt, page-aligned — admission always prefills >= 1 tail
+      token because the first sampled token needs last-position logits.
+      Matched nodes' LRU stamps refresh.
+    - ``evict`` drops LRU leaves whose page no live sequence holds
+      (``_ref == cache_hold``); releasing a live-held page would free
+      nothing, so pinned leaves are skipped — the allocator audit
+      (``check_invariants``) proves no shared-page free either way.
+
+    Two sequences producing the same token path produce the same KV
+    content (position-dependent rope included: same tokens at the same
+    positions), so descending an existing node on insert keeps the
+    cached copy — the same cross-shape determinism the ring/paged
+    parity tests already pin.
+    """
+
+    def __init__(self, alloc: PageAllocator):
+        self.alloc = alloc
+        self.page_size = alloc.page_size
+        self.root = _RadixNode(None, None, None, 0)
+        self._clock = 0
+        self._nodes = 0
+        self.evicted_nodes = 0
+
+    @property
+    def nodes(self) -> int:
+        return self._nodes
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _key(self, tokens, i: int) -> tuple:
+        ps = self.page_size
+        return tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+
+    def match(self, tokens) -> Tuple[int, List[int]]:
+        """Longest cached page-aligned prefix of ``tokens`` capped at
+        ``len(tokens) - 1``: returns (n_cached_tokens, pages). Touches
+        every matched node's LRU stamp."""
+        limit = (len(tokens) - 1) // self.page_size
+        node, pages = self.root, []
+        stamp = self._tick()
+        i = 0
+        while i < limit:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            child.stamp = stamp
+            pages.append(child.page)
+            node = child
+            i += 1
+        return i * self.page_size, pages
+
+    def insert(self, tokens, pages: List[int]) -> int:
+        """Insert the committed page-aligned prefix of ``tokens`` (KV
+        in ``pages``, the retiring sequence's block row). New nodes
+        take a cache hold on their page; existing nodes keep the cached
+        copy. Returns nodes added."""
+        n_full = min(len(tokens) // self.page_size, len(pages))
+        node, added = self.root, 0
+        stamp = self._tick()
+        for i in range(n_full):
+            key = self._key(tokens, i)
+            child = node.children.get(key)
+            if child is None:
+                self.alloc.cache_hold(pages[i])
+                child = _RadixNode(key, pages[i], node, stamp)
+                node.children[key] = child
+                self._nodes += 1
+                added += 1
+            else:
+                child.stamp = stamp
+            node = child
+        return added
+
+    def reclaimable(self) -> int:
+        """Pages eviction could return to the free list right now:
+        cache-held pages whose ONLY refs are the cache's. Admission
+        counts these as headroom — they are one ``evict`` away from
+        free, so the watermark must not let them jam the pool."""
+        a = self.alloc
+        return int(np.sum((a._cache_hold > 0)
+                          & (a._ref == a._cache_hold)))
+
+    def evict(self, n_pages: int) -> int:
+        """LRU leaf eviction until ``n_pages`` landed on the free list
+        or nothing evictable remains. Only leaves whose page would
+        actually free are dropped (interior nodes become leaves as
+        their subtrees drain, so deep reclaimable pages cascade out).
+        Returns pages freed."""
+        a = self.alloc
+        freed = 0
+        while freed < n_pages:
+            best = None
+            stack = [self.root]
+            while stack:
+                nd = stack.pop()
+                for ch in nd.children.values():
+                    if ch.children:
+                        stack.append(ch)
+                    elif a._ref[ch.page] == a._cache_hold[ch.page] \
+                            and (best is None or ch.stamp < best.stamp):
+                        best = ch
+            if best is None:
+                break
+            del best.parent.children[best.key]
+            self._nodes -= 1
+            self.evicted_nodes += 1
+            freed += a.cache_release(best.page)
+        return freed
 
 
 # ---------------------------------------------------------------------------
@@ -361,4 +565,130 @@ def paged_decode_step(family, params, pool_k, pool_v, block_tables,
     x, (kc, vc) = lax.scan(step, x, (params["layers"], pool_k, pool_v))
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
     logits = _head_logits(x[:, 0, :], family._head(params, c))
+    return kc, vc, logits
+
+
+def paged_prefill_shared(family, params, ids, config, pool_k, pool_v,
+                         page_rows, slen, ctx_rows):
+    """Tail-only prefill over a SHARED cached prefix: every row owns
+    ``ctx_rows`` [G, ncp] pages of committed prefix KV (the radix
+    cache's, forked by refcount — all rows share the same static
+    cached length ncp*ps) and prefills only its uncached tail ``ids``
+    [G, S_tail] into ``page_rows`` (sentinel drops, as in
+    ``paged_prefill``). Tail queries attend the gathered prefix pages
+    plus causally within the tail, with rope at the true absolute
+    positions, so logits at ``slen``-1 (tail-local) are identical to a
+    full prefill at position ncp*ps+slen-1. Returns (pool_k', pool_v',
+    logits [G, V])."""
+    c = config
+    G, S = ids.shape
+    L, P, kv, ps, hd = pool_k.shape
+    ncp = ctx_rows.shape[1]
+    E.enforce(S % ps == 0, f"padded tail {S} not a multiple of "
+              f"page_size {ps}")
+    E.enforce(ncp >= 1, "shared prefill needs a cached prefix")
+    ctx = ncp * ps
+    x = jnp.take(params["embed"], ids, axis=0)
+    cos, sin = rope_tables(ctx + S, c.head_dim, theta=c.rope_theta)
+    cos, sin = cos[ctx:], sin[ctx:]
+    # key t (prefix ++ tail token-major) visible to tail query i iff
+    # t <= ctx + i: the whole prefix, causal within the tail
+    mask = (jnp.arange(ctx + S)[None, :]
+            <= (jnp.arange(S)[:, None] + ctx))[None, None]
+
+    from ..nn.functional.attention import sdpa_raw
+
+    def step(carry, xs):
+        x = carry
+        lp, kpl, vpl = xs
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = rope_raw(q, cos, sin)
+        k = rope_raw(k, cos, sin)
+        # cached prefix pages, token-major: [G, ncp, kv, ps, hd] ->
+        # [G, ctx, kv, hd] (rope already applied when they were written)
+        ck = jnp.swapaxes(kpl[ctx_rows], 2, 3).reshape(G, ctx, kv, hd)
+        cv = jnp.swapaxes(vpl[ctx_rows], 2, 3).reshape(G, ctx, kv, hd)
+        ka = jnp.concatenate([ck.astype(k.dtype), k], axis=1)
+        va = jnp.concatenate([cv.astype(v.dtype), v], axis=1)
+        a = sdpa_raw(q, ka, va, attn_mask=mask).reshape(G, S, -1)
+        x = x + _mm(a.astype(x.dtype), lp["wo"])
+        return family.decode_mlp(x, lp, c), (k, v)
+
+    x, (ks, vs) = lax.scan(step, x, (params["layers"], pool_k, pool_v))
+    npad = S // ps
+    ks = jnp.moveaxis(ks.reshape(L, G, npad, ps, kv, hd), 4, 3)
+    vs = jnp.moveaxis(vs.reshape(L, G, npad, ps, kv, hd), 4, 3)
+    pool_k = pool_k.at[:, page_rows].set(ks.astype(pool_k.dtype),
+                                         mode="drop")
+    pool_v = pool_v.at[:, page_rows].set(vs.astype(pool_v.dtype),
+                                         mode="drop")
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(slen - 1, 0)[:, None, None], axis=1)[:, 0]
+    logits = _head_logits(last, family._head(params, c))
+    return pool_k, pool_v, logits
+
+
+def paged_verify_window(family, params, tokens, config, pool_k, pool_v,
+                        block_tables, kv_len, live):
+    """Speculative-decode verify: process a drafted window ``tokens``
+    [B, C] sitting at positions ``kv_len``..``kv_len``+C-1 of each
+    sequence in ONE forward pass — the window's KV is written into the
+    block-table pages first (dropped where ``live`` is False), then
+    every window query attends the sequence's full paged context plus
+    causally within the window. C-fold fewer sequential model passes
+    than C ``paged_decode_step`` calls; identical math per position, so
+    greedy argmax over the returned logits [B, C, V] reproduces the
+    sequential chunk token-for-token. The host accepts the longest
+    draft-matching run and simply does not ``advance`` past it —
+    rejected positions' KV is masked garbage until overwritten."""
+    c = config
+    B, C = tokens.shape
+    L, P, kv, ps, hd = pool_k.shape
+    maxp = block_tables.shape[1]
+    pos = kv_len[:, None] + jnp.arange(C)[None, :]          # [B, C]
+    x = jnp.take(params["embed"], tokens, axis=0)
+    inv = 1.0 / (c.rope_theta ** (
+        jnp.arange(0, c.head_dim, 2, jnp.float32) / c.head_dim))
+    freqs = pos.astype(jnp.float32)[:, :, None] * inv[None, None, :]
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+
+    page_idx = pos // ps
+    off = pos % ps
+    rows = jnp.take_along_axis(block_tables, page_idx, axis=1)
+    rows = jnp.where(live[:, None], rows, P)                # dead: drop
+    kvi = jnp.arange(kv)
+    # pool slot t (token-major over this row's block table) visible to
+    # window query i iff t <= kv_len + i; slots past the allocated
+    # pages gather clamped garbage and sit beyond every query's limit
+    mask = jnp.arange(maxp * ps)[None, None, :] <= pos[:, :, None]
+
+    from ..nn.functional.attention import sdpa_raw
+
+    def step(carry, xs):
+        x = carry
+        lp, kpl, vpl = xs
+        h = _rms(x, lp["ln1"], c.rms_norm_eps)
+        q, k, v = _qkv_proj(h, lp, c)
+        q = rope_raw(q, cos, sin)
+        k = rope_raw(k, cos, sin)
+        kpl = kpl.at[rows[:, :, None], kvi[None, None, :],
+                     off[:, :, None]].set(
+            k.astype(kpl.dtype), mode="drop", unique_indices=True)
+        vpl = vpl.at[rows[:, :, None], kvi[None, None, :],
+                     off[:, :, None]].set(
+            v.astype(vpl.dtype), mode="drop", unique_indices=True)
+        ck = jnp.swapaxes(kpl[block_tables], 2, 3).reshape(
+            B, maxp * ps, kv, hd)
+        cv = jnp.swapaxes(vpl[block_tables], 2, 3).reshape(
+            B, maxp * ps, kv, hd)
+        a = sdpa_raw(q, ck.astype(q.dtype), cv.astype(q.dtype),
+                     attn_mask=mask[:, None]).reshape(B, C, -1)
+        x = x + _mm(a.astype(x.dtype), lp["wo"])
+        return family.decode_mlp(x, lp, c), (kpl, vpl)
+
+    x, (kc, vc) = lax.scan(step, x, (params["layers"], pool_k, pool_v))
+    x = _rms(x, params["ln_f"], c.rms_norm_eps)
+    logits = _head_logits(x, family._head(params, c))
     return kc, vc, logits

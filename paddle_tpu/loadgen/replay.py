@@ -50,7 +50,8 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..testing import faults as _faults
-from .traces import ArrivalTrace, TraceRequest, prompt_tokens
+from .traces import (ArrivalTrace, TraceRequest, prompt_tokens,
+                     tenant_prefix_tokens)
 
 __all__ = ["Episode", "ReplayResult", "replay_trace", "replay_fleet",
            "BURST_RID_BASE"]
@@ -127,16 +128,34 @@ def _engine_flags(eng) -> dict:
         "shed_on_burn": bool(getattr(eng, "_shed_on_burn", False)),
         "slo_preemption": bool(getattr(eng, "_slo_preemption", False)),
         "failover": bool(getattr(eng, "_failover", False)),
+        "prefix_cache": bool(getattr(eng, "_prefix", None) is not None),
+        "spec_decode": bool(getattr(eng, "_spec_decode", False)),
         "num_slots": int(getattr(eng, "num_slots", 0)),
     }
+
+
+def _trace_prompt(seed: int, rid: int, prompt_len: int, vocab: int,
+                  tenant: str, prefix_len: int) -> np.ndarray:
+    """Materialize one trace prompt: the tenant's shared system prefix
+    (a pure function of (seed, tenant)) followed by per-request tokens
+    (a pure function of (seed, rid)). prefix_len=0 reproduces the v1
+    prompt bytes exactly."""
+    pfx = int(prefix_len or 0)
+    tail = prompt_tokens(seed, rid, int(prompt_len) - pfx, vocab)
+    if pfx <= 0:
+        return tail
+    return np.concatenate(
+        [tenant_prefix_tokens(seed, tenant, pfx, vocab), tail])
 
 
 def _mk_request(tr: TraceRequest, seed: int, vocab_size: int,
                 honor_deadlines: bool):
     from ..inference.engine import Request
+    pfx = int(getattr(tr, "prefix_len", 0) or 0)
     return Request(
         rid=tr.rid,
-        prompt=prompt_tokens(seed, tr.rid, tr.prompt_len, vocab_size),
+        prompt=_trace_prompt(seed, tr.rid, tr.prompt_len, vocab_size,
+                             tr.tenant, pfx),
         max_new_tokens=tr.max_new_tokens, tenant=tr.tenant,
         priority=tr.priority,
         deadline_s=tr.deadline_s if honor_deadlines else None,
@@ -145,7 +164,9 @@ def _mk_request(tr: TraceRequest, seed: int, vocab_size: int,
         # instead of journaling inline tokens (inert without a journal)
         prompt_spec={"seed": int(seed), "rid": int(tr.rid),
                      "prompt_len": int(tr.prompt_len),
-                     "vocab": int(vocab_size)})
+                     "vocab": int(vocab_size),
+                     "tenant": str(tr.tenant),
+                     "prefix_len": pfx})
 
 
 def _submit(eng, req, terminal: Dict[int, dict], tenant: str,
@@ -192,9 +213,11 @@ def _rebuild_request(rec: dict, vocab: int,
     spec = rec.get("prompt_spec")
     try:
         if spec:
-            prompt = prompt_tokens(int(spec["seed"]), int(spec["rid"]),
-                                   int(spec["prompt_len"]),
-                                   int(spec.get("vocab", vocab)))
+            prompt = _trace_prompt(
+                int(spec["seed"]), int(spec["rid"]),
+                int(spec["prompt_len"]), int(spec.get("vocab", vocab)),
+                str(spec.get("tenant", rec.get("tenant", "default"))),
+                int(spec.get("prefix_len", 0) or 0))
         elif rec.get("prompt") is not None:
             prompt = np.asarray(rec["prompt"], np.int32)
         else:

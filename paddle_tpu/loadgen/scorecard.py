@@ -146,6 +146,46 @@ def _fleet_block() -> dict:
         return {"available": False}
 
 
+def _sum_engine_stat(result: "ReplayResult", key: str) -> int:
+    return sum(int(s.get(key, 0) or 0)
+               for s in result.engine_stats.values())
+
+
+def _prefix_cache_block(result: "ReplayResult") -> dict:
+    """Radix shared-prefix cache accounting from the engines'
+    deterministic counters: hit rate over admission lookups, prompt
+    tokens served from cached KV instead of prefill, and LRU nodes
+    evicted under pool pressure. All-zero with the flag off."""
+    lookups = _sum_engine_stat(result, "prefix_lookups")
+    hits = _sum_engine_stat(result, "prefix_hits")
+    return {
+        "lookups": lookups,
+        "hits": hits,
+        "hit_rate": round(hits / lookups, 6) if lookups else None,
+        "prefill_tokens_saved": _sum_engine_stat(
+            result, "prefix_tokens_saved"),
+        "evictions": _sum_engine_stat(result, "prefix_evictions"),
+    }
+
+
+def _spec_decode_block(result: "ReplayResult") -> dict:
+    """Speculative-decode accounting: drafts proposed vs accepted by
+    the greedy verify, per-sequence verify rounds, and the mean
+    accepted run length. All-zero with the flag off."""
+    rounds = _sum_engine_stat(result, "spec_rounds")
+    drafted = _sum_engine_stat(result, "spec_drafted")
+    accepted = _sum_engine_stat(result, "spec_accepted")
+    return {
+        "rounds": rounds,
+        "drafted": drafted,
+        "accepted": accepted,
+        "acceptance_rate": round(accepted / drafted, 6)
+        if drafted else None,
+        "mean_accepted_run": round(accepted / rounds, 6)
+        if rounds else None,
+    }
+
+
 def build_scorecard(result: ReplayResult, *,
                     include_fleet: bool = True) -> dict:
     """Fold one :class:`ReplayResult` into the scorecard document and
@@ -249,6 +289,12 @@ def build_scorecard(result: ReplayResult, *,
                 for r in result.terminal.values()),
             "quarantined": counts.get("quarantined", 0),
         },
+        # prefix-cache / spec-decode accounting: summed from the
+        # deterministic engine counters, so flags off ⇒ all-zero blocks
+        # (presence never perturbs the flags-off determinism diff) and
+        # flags on ⇒ seed-reproducible hit/acceptance numbers
+        "prefix_cache": _prefix_cache_block(result),
+        "spec_decode": _spec_decode_block(result),
         "fairness": {"jain_completion_index": fairness},
         "episodes": [
             {k: v for k, v in e.items()

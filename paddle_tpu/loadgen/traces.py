@@ -35,9 +35,13 @@ import numpy as np
 
 __all__ = ["ArrivalTrace", "TenantSpec", "TraceRequest",
            "generate_trace", "heavy_tailed_lengths",
-           "mixed_length_trace", "prompt_tokens"]
+           "mixed_length_trace", "prompt_tokens",
+           "tenant_prefix_tokens"]
 
-TRACE_VERSION = 1
+# v2: per-tenant shared system prefixes (TenantSpec.prefix_len,
+# TraceRequest.prefix_len, tenant_prefix_tokens). v1 traces load
+# unchanged — the new fields default to 0 / absent.
+TRACE_VERSION = 2
 
 
 def heavy_tailed_lengths(seq_len: int, n_docs: int, seed: int = 7):
@@ -84,6 +88,23 @@ def prompt_tokens(seed: int, rid: int, prompt_len: int,
         np.int32)
 
 
+def tenant_prefix_tokens(seed: int, tenant: str, prefix_len: int,
+                         vocab_size: int) -> np.ndarray:
+    """Deterministic shared system-prefix ids for one tenant: a pure
+    function of (trace seed, tenant name), mirroring how
+    :func:`prompt_tokens` is a pure function of (seed, rid). The
+    three-entry seed sequence (vs prompt_tokens' two) keeps the stream
+    family disjoint from every per-request stream; the tenant name is
+    hashed (sha256, stable across processes) so renames — not dict
+    order — decide the stream."""
+    tid = int.from_bytes(
+        hashlib.sha256(str(tenant).encode()).digest()[:4], "big")
+    rng = np.random.default_rng(
+        [int(seed) & 0x7FFFFFFF, 0x70F1, tid])
+    return rng.integers(0, vocab_size, (int(prefix_len),)).astype(
+        np.int32)
+
+
 @dataclasses.dataclass
 class TenantSpec:
     """One tenant in the arrival mix: ``share`` weights how often the
@@ -94,6 +115,10 @@ class TenantSpec:
     share: float = 1.0
     priority: int = 0
     deadline_s: Optional[float] = None
+    # shared system-prefix length (tokens) every request of this tenant
+    # starts with — ids derived by :func:`tenant_prefix_tokens`. 0 = no
+    # shared prefix (the v1 behavior).
+    prefix_len: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -112,6 +137,9 @@ class TraceRequest:
     tenant: str = "default"
     priority: int = 0
     deadline_s: Optional[float] = None
+    # leading prefix_len of the prompt_len TOTAL tokens come from the
+    # tenant's shared prefix stream; the rest from the per-rid stream
+    prefix_len: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -124,7 +152,8 @@ class TraceRequest:
                    tenant=str(d.get("tenant", "default")),
                    priority=int(d.get("priority", 0)),
                    deadline_s=(None if d.get("deadline_s") is None
-                               else float(d["deadline_s"])))
+                               else float(d["deadline_s"])),
+                   prefix_len=int(d.get("prefix_len", 0)))
 
 
 @dataclasses.dataclass
@@ -230,11 +259,16 @@ def generate_trace(seed: int, *, duration_s: float = 1.0,
     reqs = []
     for i in range(n):
         spec = specs[int(tenant_idx[i])]
+        # the shared prefix is DERIVED (no extra rng draw — the v1 draw
+        # sequence is a pinned contract) and clamped so at least one
+        # prompt token stays per-request: prompt_len is the TOTAL
+        pfx = min(max(int(getattr(spec, "prefix_len", 0)), 0),
+                  int(plens[i]) - 1)
         reqs.append(TraceRequest(
             rid=i, arrival_s=round(arrivals[i], 9),
             prompt_len=int(plens[i]), max_new_tokens=int(glens[i]),
             tenant=spec.name, priority=spec.priority,
-            deadline_s=spec.deadline_s))
+            deadline_s=spec.deadline_s, prefix_len=max(pfx, 0)))
     config = {
         "rate": rate, "alpha": alpha,
         "prompt_len": list(prompt_len),

@@ -81,6 +81,15 @@ ALLOWLIST_LOWER = {
     # seconds (the exactly-once layer's recovery tail)
     "serving_failover_recovery_s_p99":
         "extra.serving_failover_replay.recovery_s_p99",
+    # shared-prefix replay (radix KV cache on, pinned prefix trace):
+    # completed-request p50 TTFT and the deterministic prefill-FLOPs-
+    # per-request proxy (2·N_params·tokens_prefilled/completed) — a PR
+    # that erodes the prefix cache's prefill skipping fails here even
+    # if throughput elsewhere holds
+    "serving_prefix_ttft_ms_p50":
+        "extra.serving_prefix_replay.ttft_p50_ms",
+    "serving_prefix_prefill_flops_per_request":
+        "extra.serving_prefix_replay.prefill_flops_per_request",
 }
 
 # must-be-ZERO invariants, checked on the NEWEST successful run only

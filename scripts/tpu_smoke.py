@@ -762,6 +762,68 @@ def main():
         eng.cache.alloc.check_invariants()
         assert eng.cache.alloc.free_pages == eng.cache.num_pages
 
+    @case("prefix_cache")
+    def _():
+        # radix shared-prefix KV cache on the real backend: two
+        # requests opening with the same 16-token system prefix run
+        # serially (the first's retirement seeds the radix), the
+        # second must fork cached pages — its prefill token count
+        # shrinks by the page-aligned prefix — and every emitted token
+        # must match the cache-off run byte for byte. A spec-decode
+        # engine then replays one request and must also match.
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+
+        # f32: the parity asserts compare tokens across differently
+        # shaped programs (full vs shared prefill, turbo chunk vs
+        # verify window) — identical math, but this random model's
+        # logit gaps sit inside bf16 cross-program rounding noise, so
+        # bf16 argmax ties could flip on the real chip
+        cfg = L.llama_tiny(num_hidden_layers=2, dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+        prompts = [np.concatenate([prefix, rng.integers(
+            0, cfg.vocab_size, (n,)).astype(np.int32)]) for n in (5, 3)]
+
+        def serve(**kw):
+            eng = ServingEngine(L, params, cfg, num_slots=2, max_len=48,
+                                page_size=4, decode_chunk=2, **kw)
+            outs = {}
+            for i, p in enumerate(prompts):     # serial: retire seeds
+                outs.update(eng.run([Request(
+                    rid=i, prompt=p, max_new_tokens=5)]))
+            eng.cache.alloc.check_invariants()
+            return eng, outs
+
+        eng_off, outs_off = serve()
+        eng_on, outs_on = serve(prefix_cache=True)
+        for i in range(len(prompts)):
+            np.testing.assert_array_equal(outs_on[i].tokens,
+                                          outs_off[i].tokens)
+        assert eng_on.stats.prefix_hits >= 1, eng_on.stats.as_dict()
+        saved = eng_on.stats.prefix_tokens_saved
+        assert saved >= 16, saved               # the full aligned prefix
+        assert eng_on.stats.tokens_prefilled \
+            == eng_off.stats.tokens_prefilled - saved
+        # cache holds outlive retirement: the radix pins pages the
+        # free-pool no longer counts (the off engine drained to empty)
+        assert eng_off.cache.alloc.free_pages == eng_off.cache.num_pages
+        assert eng_on.cache.alloc.free_pages < eng_on.cache.num_pages
+        # spec decode: greedy token identity through the verify window
+        eng_sp = ServingEngine(L, params, cfg, num_slots=1, max_len=64,
+                               page_size=4, decode_chunk=2,
+                               spec_decode=True)
+        outs_sp = eng_sp.run([Request(rid=0, prompt=prompts[0],
+                                      max_new_tokens=16)])
+        eng_ref = ServingEngine(L, params, cfg, num_slots=1, max_len=64,
+                                page_size=4, decode_chunk=2)
+        outs_ref = eng_ref.run([Request(rid=0, prompt=prompts[0],
+                                        max_new_tokens=16)])
+        np.testing.assert_array_equal(outs_sp[0].tokens,
+                                      outs_ref[0].tokens)
+        assert eng_sp.stats.spec_rounds > 0, eng_sp.stats.as_dict()
+        eng_sp.cache.alloc.check_invariants()
+
     @case("fleet_federation")
     def _():
         # fleet SLO federation end to end on the real backend: two
