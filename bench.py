@@ -1060,6 +1060,20 @@ def _decode_rung(on_tpu):
         out["int8_ms_per_token"] = round(qdt / new * 1000, 3)
     except Exception as e:                        # noqa: BLE001
         out["int8_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # Packed int4 weight-only variant: halves the weight bytes again
+    # over int8 (two nibbles per byte, unpacked in-register at the
+    # matmul). Same optional discipline as the int8 arm.
+    try:
+        qp4 = jax.jit(lambda p: L.quantize_weights(
+            p, weight_dtype="int4"))(params)
+        jax.block_until_ready(qp4["layers"]["wq"]["q4"])
+        q4tps, q4dt, _, _ = _decode_one_batch(L, cfg, qp4, batch,
+                                              prompt, new)
+        out["int4_decode_tokens_per_sec"] = round(q4tps, 2)
+        out["int4_ms_per_token"] = round(q4dt / new * 1000, 3)
+    except Exception as e:                        # noqa: BLE001
+        out["int4_error"] = f"{type(e).__name__}: {e}"[:300]
     return out
 
 
@@ -1166,7 +1180,7 @@ def _serving_paged_rung(on_tpu):
                 **{k: round(v, 3) for k, v in
                    _m.quantiles((0.5, 0.95, 0.99)).items()},
             }
-    return {
+    out = {
         "config": f"llama_3_8b[{cfg.num_hidden_layers}L]" if on_tpu
         else "llama_tiny[2L]",
         "latency_ms": latency,
@@ -1183,6 +1197,44 @@ def _serving_paged_rung(on_tpu):
         "preempted": s.preempted,
         "engine": s.as_dict(),
     }
+
+    # Quantized-memory-plane arm (FLAGS_serving_kv_quant): the same
+    # trace on int8 page pools. Throughput rides the regular guard;
+    # ``servable_concurrency_at_fixed_pool_bytes`` is the tentpole's
+    # capacity claim — per-KV-token pool bytes full-precision vs
+    # quantized (codes + scale planes), i.e. how many more concurrent
+    # sequences the same HBM pool budget holds (guarded as a static
+    # >= 1.8x floor in scripts/check_bench_regression.py). Optional —
+    # failure records an error note, never kills the rung.
+    try:
+        # int8 pages tile at 32 sublanes: round the page up on TPU so
+        # the quantized arm measures the kernel, not the jnp fallback
+        qpage = -(-eng.page_size // 32) * 32 if on_tpu else eng.page_size
+        qeng = ServingEngine(L, params, cfg, num_slots=slots,
+                             max_len=max_len, page_size=qpage,
+                             decode_chunk=chunk, kv_quant=True)
+        qeng.run(reqs(10_000))          # warmup: compiles every bucket
+        qdt = float("inf")
+        for w in range(1, 4):
+            qeng.stats = EngineStats()
+            t0 = _time.perf_counter()
+            qeng.run(reqs(10_000 + n_req * w))
+            qdt = min(qdt, _time.perf_counter() - t0)
+        fp_per_tok = (sum(a.nbytes for a in jax.tree.leaves(eng.cache.pool))
+                      / (eng.cache.num_pages * eng.page_size))
+        q_per_tok = (sum(a.nbytes for a in jax.tree.leaves(qeng.cache.pool))
+                     / (qeng.cache.num_pages * qeng.page_size))
+        out["kv_quant"] = {
+            "page_size": qeng.page_size,
+            "tokens_per_sec": round(useful / qdt, 2),
+            "pool_bytes_per_kv_token": round(q_per_tok, 2),
+            "full_precision_bytes_per_kv_token": round(fp_per_tok, 2),
+            "servable_concurrency_at_fixed_pool_bytes":
+                round(fp_per_tok / q_per_tok, 3),
+        }
+    except Exception as e:                        # noqa: BLE001
+        out["kv_quant_error"] = f"{type(e).__name__}: {e}"[:300]
+    return out
 
 
 def _serving_trace_replay_rung(on_tpu):

@@ -181,6 +181,19 @@ define_flag("serving_prefix_cache", False,
             "pinned by a cache hold with LRU leaf eviction under "
             "pool pressure. Off (the default) = no cache, "
             "byte-identical scheduling and tokens.")
+define_flag("serving_kv_quant", False,
+            "Quantized KV-cache memory plane (inference/paged.py): "
+            "page pools store int8 codes with per-page per-kv-head "
+            "f32 scale planes (absmax chosen at write time; the "
+            "scatter-with-drop write discipline quantizes "
+            "in-program), and the paged-attention kernel + jnp "
+            "fallback dequantize inline so HBM page reads stay int8 "
+            "— half (bf16) to a quarter (f32) the page-pool bytes at "
+            "fixed concurrency. Fork/CoW/free mirror scale rows with "
+            "their pages, so the allocator audit and the radix "
+            "prefix-cache holds balance unchanged. Off (the default) "
+            "= full-precision pools, byte-identical pool contents, "
+            "tokens and scheduling.")
 define_flag("serving_spec_decode", False,
             "N-gram self-drafting speculative decode on the greedy "
             "turbo path: draft k tokens per sequence from a bigram "

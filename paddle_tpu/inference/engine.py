@@ -405,7 +405,7 @@ class ServingEngine:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  decode_chunk: int = 4, watermark: float = 0.0,
-                 kv_dtype=None,
+                 kv_dtype=None, kv_quant: Optional[bool] = None,
                  priority_admission: Optional[bool] = None,
                  tenant_inflight_cap: Optional[int] = None,
                  max_queue: Optional[int] = None,
@@ -447,6 +447,10 @@ class ServingEngine:
         # itself is created after the page pool below.
         self._prefix_on = bool(_opt(prefix_cache, "serving_prefix_cache"))
         self._spec_decode = bool(_opt(spec_decode, "serving_spec_decode"))
+        # Quantized memory plane (ROADMAP perf item): int8 page pools
+        # with per-page per-kv-head scale planes. Off = full-precision
+        # pools, byte-identical contents and tokens.
+        self._kv_quant = bool(_opt(kv_quant, "serving_kv_quant"))
         self._journal = None
         self._draining = False
         self._deadlines_seen = False   # sticky: first deadline request
@@ -465,7 +469,8 @@ class ServingEngine:
             page_size = _at.paged_page_size(
                 num_slots, config.num_attention_heads,
                 config.num_key_value_heads, config.head_dim,
-                -(-max_len // 16) * 16, kv_dtype)
+                -(-max_len // 16) * 16, kv_dtype,
+                kv_quant=self._kv_quant)
         self.page_size = int(page_size)
         self.max_len = -(-max_len // self.page_size) * self.page_size
         self.max_pages_per_seq = self.max_len // self.page_size
@@ -476,7 +481,8 @@ class ServingEngine:
                   f"max-length sequence ({self.max_pages_per_seq} pages)")
         self.watermark_pages = int(watermark * num_pages)
         self.cache = PagedKVCache(config, num_pages, self.page_size,
-                                  self.max_pages_per_seq, kv_dtype)
+                                  self.max_pages_per_seq, kv_dtype,
+                                  kv_quant=self._kv_quant)
         # radix shared-prefix cache over the pool's committed pages;
         # None (flag off) short-circuits every hook to the original code
         self._prefix = PrefixCache(self.cache.alloc) if self._prefix_on \
@@ -2116,18 +2122,43 @@ class ServingEngine:
         if in_use.size == 0:
             return
         if self._kv_absmax_fn is None:
-            # pool layout [L, P, kv, page, hd] -> per-layer per-page
-            self._kv_absmax_fn = jax.jit(
-                lambda k, v: (
-                    jnp.max(jnp.abs(k), axis=(2, 3, 4)
-                            ).astype(jnp.float32),
-                    jnp.max(jnp.abs(v), axis=(2, 3, 4)
-                            ).astype(jnp.float32)))
-        km, vm = self._kv_absmax_fn(self.cache.pool["k"],
-                                    self.cache.pool["v"])
-        km = np.asarray(km)[:, in_use]
-        vm = np.asarray(vm)[:, in_use]
+            if self._kv_quant:
+                # quantized pool: codes [L, P, kv, page, hd] + scales
+                # [L, P, kv]. absmax = max|code|·scale; also surface the
+                # quantizer's own health — the scale magnitudes and the
+                # fraction of codes pinned at the clip rail (±127)
+                def _q_absmax(k, v):
+                    def one(leaf):
+                        am = jnp.max(jnp.abs(leaf["q"]), axis=(3, 4))
+                        return jnp.max(am.astype(jnp.float32)
+                                       * leaf["s"], axis=2)
+                    clip = (
+                        jnp.mean((jnp.abs(k["q"]) == 127),
+                                 axis=(0, 2, 3, 4)).astype(jnp.float32)
+                        + jnp.mean((jnp.abs(v["q"]) == 127),
+                                   axis=(0, 2, 3, 4)).astype(jnp.float32)
+                    ) * 0.5                               # [P]
+                    scales = jnp.maximum(jnp.max(k["s"], axis=2),
+                                         jnp.max(v["s"], axis=2))
+                    return one(k), one(v), scales, clip
+                self._kv_absmax_fn = jax.jit(_q_absmax)
+            else:
+                # pool layout [L, P, kv, page, hd] -> per-layer per-page
+                self._kv_absmax_fn = jax.jit(
+                    lambda k, v: (
+                        jnp.max(jnp.abs(k), axis=(2, 3, 4)
+                                ).astype(jnp.float32),
+                        jnp.max(jnp.abs(v), axis=(2, 3, 4)
+                                ).astype(jnp.float32)))
+        out = self._kv_absmax_fn(self.cache.pool["k"],
+                                 self.cache.pool["v"])
+        km = np.asarray(out[0])[:, in_use]
+        vm = np.asarray(out[1])[:, in_use]
         _numerics.record_kv_absmax(km, vm)
+        if self._kv_quant:
+            scales = np.asarray(out[2])[:, in_use]
+            clip = float(np.mean(np.asarray(out[3])[in_use]))
+            _numerics.record_kv_quant(scales, clip)
 
     def run(self, requests=None, max_steps: int = 1_000_000
             ) -> Dict[int, RequestOutput]:

@@ -419,13 +419,24 @@ class PrefixCache:
 # pool tensors
 # ---------------------------------------------------------------------------
 
-def init_pool(config, num_pages: int, page_size: int, dtype=None) -> dict:
+def init_pool(config, num_pages: int, page_size: int, dtype=None,
+              kv_quant: bool = False) -> dict:
     """Fresh page pools, one [P, kv, ps, hd] grid per layer (stacked on
     a leading layer axis to ride the decode lax.scan, like the ring
-    cache)."""
+    cache). With ``kv_quant`` (FLAGS_serving_kv_quant) each pool leaf
+    is the quantized pair {"q": int8 codes, "s": f32 [L, P, kv] scale
+    plane} — per-page per-kv-head write-time absmax scales ride the
+    SAME page axis as their codes, so every page-granular operation
+    (CoW copy, fork refcount, scatter-with-drop) moves code and scale
+    rows together. Zero scale = untouched page, dequantizing to 0."""
     dt = dtype if dtype is not None else config.dtype
     shape = (config.num_hidden_layers, num_pages,
              config.num_key_value_heads, page_size, config.head_dim)
+    if kv_quant:
+        def leaf():
+            return {"q": jnp.zeros(shape, jnp.int8),
+                    "s": jnp.zeros(shape[:3], jnp.float32)}
+        return {"k": leaf(), "v": leaf()}
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
@@ -435,18 +446,24 @@ class PagedKVCache:
     the jitted prefill/decode calls); control state in ``.alloc``."""
 
     def __init__(self, config, num_pages: int, page_size: int,
-                 max_pages_per_seq: int, dtype=None):
+                 max_pages_per_seq: int, dtype=None,
+                 kv_quant: bool = False):
         self.config = config
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         self.max_pages_per_seq = int(max_pages_per_seq)
-        self.pool = init_pool(config, num_pages, page_size, dtype)
+        self.kv_quant = bool(kv_quant)
+        self.pool = init_pool(config, num_pages, page_size, dtype,
+                              kv_quant=self.kv_quant)
         self.alloc = PageAllocator(num_pages, page_size, max_pages_per_seq)
+        # page-row copy over EVERY pool leaf: the quantized pool's
+        # scale planes share the page axis (axis 1) with their codes,
+        # so one tree_map mirrors CoW onto codes and scales exactly —
+        # the invariant the fork/CoW scale tests pin
         self._copy1 = jax.jit(
-            lambda pool, src, dst: {
-                "k": pool["k"].at[:, dst].set(pool["k"][:, src]),
-                "v": pool["v"].at[:, dst].set(pool["v"][:, src]),
-            }, donate_argnums=(0,))
+            lambda pool, src, dst: jax.tree.map(
+                lambda a: a.at[:, dst].set(a[:, src]), pool),
+            donate_argnums=(0,))
 
     def apply_cow(self, pairs):
         """Mirror allocator copy-on-write decisions onto the device pool."""
@@ -470,6 +487,79 @@ class PagedKVCache:
 # data plane (pure jax; family/config static under jit)
 # ---------------------------------------------------------------------------
 
+# int8 KV code range (FLAGS_serving_kv_quant). Scales are per-page
+# per-kv-head write-time absmax/127 — symmetric, round-to-nearest, the
+# same shape of contract as the weight-only scheme (llama.quant_int8)
+# but chosen dynamically at every page write.
+_KV_QMAX = 127.0
+
+
+def _kv_quantize(xf, s):
+    """int8 codes of f32 values under broadcastable scales ``s``."""
+    return jnp.clip(jnp.round(xf / jnp.maximum(s, 1e-10)),
+                    -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+
+
+def _kv_pool_write(pool, pages, page_rows):
+    """Scatter freshly computed whole-page grids ``pages``
+    [L, ..., kv, ps, hd] into a pool leaf at ``page_rows`` with the
+    drop discipline — quantizing in-program when the pool is the
+    {"q", "s"} pair: scales are the written pages' own absmax (over
+    the ps/hd axes, per kv head), and code + scale rows land under the
+    SAME drop mask, so a sentinel row drops both."""
+    if isinstance(pool, dict):
+        xf = pages.astype(jnp.float32)
+        s = jnp.max(jnp.abs(xf), axis=(-2, -1)) / _KV_QMAX
+        q = _kv_quantize(xf, s[..., None, None])
+        return {"q": pool["q"].at[:, page_rows].set(q, mode="drop"),
+                "s": pool["s"].at[:, page_rows].set(s, mode="drop")}
+    return pool.at[:, page_rows].set(pages.astype(pool.dtype),
+                                     mode="drop")
+
+
+def _kv_pool_gather(pool, rows, dtype):
+    """Gather page rows from a pool leaf as [*rows.shape, kv, ps, hd]
+    in ``dtype`` — dequantized (f32 multiply, ONE cast: the _mm seam
+    ordering) when the pool is quantized."""
+    if isinstance(pool, dict):
+        deq = (pool["q"][rows].astype(jnp.float32)
+               * pool["s"][rows][..., None, None])
+        return deq.astype(dtype)
+    return pool[rows].astype(dtype)
+
+
+def _kv_page_append(leaf, rows, off, val, P):
+    """Append one token's [B, kv, hd] values at slot ``off`` of pages
+    ``rows`` (sentinel ``P`` drops) — the decode-step write. Quantized
+    pools rescale the whole touched page: gather, dequantize, zero the
+    not-yet-written tail slots (a reused page's stale codes must not
+    inflate the scale), insert the token, requantize under the page's
+    fresh absmax, and scatter codes + scale row under one drop mask.
+    Committed slots re-round at most once per scale change — bounded
+    by page_size writes, inside the decode-parity SQNR budget."""
+    B, kv = val.shape[0], val.shape[1]
+    kvi = jnp.arange(kv)
+    if isinstance(leaf, dict):
+        ps = leaf["q"].shape[2]
+        rc = jnp.clip(rows, 0, P - 1)
+        page = (leaf["q"][rc].astype(jnp.float32)
+                * leaf["s"][rc][..., None, None])      # [B, kv, ps, hd]
+        keep = jnp.arange(ps)[None, None, :, None] \
+            <= off[:, None, None, None]
+        page = jnp.where(keep, page, 0.0)
+        page = page.at[jnp.arange(B)[:, None], kvi[None, :],
+                       off[:, None]].set(val.astype(jnp.float32),
+                                         unique_indices=True)
+        s = jnp.max(jnp.abs(page), axis=(-2, -1)) / _KV_QMAX
+        q = _kv_quantize(page, s[..., None, None])
+        return {"q": leaf["q"].at[rows[:, None], kvi[None, :]].set(
+                    q, mode="drop", unique_indices=True),
+                "s": leaf["s"].at[rows[:, None], kvi[None, :]].set(
+                    s, mode="drop", unique_indices=True)}
+    return leaf.at[rows[:, None], kvi[None, :], off[:, None]].set(
+        val.astype(leaf.dtype), mode="drop", unique_indices=True)
+
+
 def paged_prefill(family, params, ids, config, pool_k, pool_v, page_rows,
                   slen):
     """Consume a batch of padded prompts [G, S_pad] (S_pad a page
@@ -482,7 +572,8 @@ def paged_prefill(family, params, ids, config, pool_k, pool_v, page_rows,
     token-for-token."""
     c = config
     G, S = ids.shape
-    L, P, kv, ps, hd = pool_k.shape
+    quant = isinstance(pool_k, dict)
+    L, P, kv, ps, hd = (pool_k["q"] if quant else pool_k).shape
     E.enforce(S % ps == 0, f"padded prompt {S} not a multiple of "
               f"page_size {ps}")
     x = jnp.take(params["embed"], ids, axis=0)
@@ -505,10 +596,8 @@ def paged_prefill(family, params, ids, config, pool_k, pool_v, page_rows,
     # [L, G, S, kv, hd] -> [L, G, npad, kv, ps, hd] page grids
     ks = jnp.moveaxis(ks.reshape(L, G, npad, ps, kv, hd), 4, 3)
     vs = jnp.moveaxis(vs.reshape(L, G, npad, ps, kv, hd), 4, 3)
-    pool_k = pool_k.at[:, page_rows].set(ks.astype(pool_k.dtype),
-                                         mode="drop")
-    pool_v = pool_v.at[:, page_rows].set(vs.astype(pool_v.dtype),
-                                         mode="drop")
+    pool_k = _kv_pool_write(pool_k, ks, page_rows)
+    pool_v = _kv_pool_write(pool_v, vs, page_rows)
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
     last = jnp.take_along_axis(
         x, jnp.maximum(slen - 1, 0)[:, None, None], axis=1)[:, 0]
@@ -525,7 +614,8 @@ def paged_decode_step(family, params, pool_k, pool_v, block_tables,
     masks). Returns (pool_k', pool_v', logits [B, V])."""
     c = config
     B = tokens.shape[0]
-    L, P, kv, ps, hd = pool_k.shape
+    quant = isinstance(pool_k, dict)
+    L, P, kv, ps, hd = (pool_k["q"] if quant else pool_k).shape
     maxp = block_tables.shape[1]
     n = lengths
     posw = jnp.maximum(n - 1, 0)                       # [B] write position
@@ -554,11 +644,15 @@ def paged_decode_step(family, params, pool_k, pool_v, block_tables,
         q, k, v = _qkv_proj(h, lp, c)
         q = rope_raw(q, cos, sin)
         k = rope_raw(k, cos, sin)
-        kpl = kpl.at[rows[:, None], kvi[None, :], off[:, None]].set(
-            k[:, 0].astype(kpl.dtype), mode="drop", unique_indices=True)
-        vpl = vpl.at[rows[:, None], kvi[None, :], off[:, None]].set(
-            v[:, 0].astype(vpl.dtype), mode="drop", unique_indices=True)
-        a = dispatched_paged_attention(q[:, 0], kpl, vpl, block_tables, n)
+        kpl = _kv_page_append(kpl, rows, off, k[:, 0], P)
+        vpl = _kv_page_append(vpl, rows, off, v[:, 0], P)
+        if quant:
+            a = dispatched_paged_attention(
+                q[:, 0], kpl["q"], vpl["q"], block_tables, n,
+                k_scales=kpl["s"], v_scales=vpl["s"])
+        else:
+            a = dispatched_paged_attention(q[:, 0], kpl, vpl,
+                                           block_tables, n)
         x = x + _mm(a.reshape(B, 1, -1).astype(x.dtype), lp["wo"])
         return family.decode_mlp(x, lp, c), (kpl, vpl)
 
@@ -582,7 +676,8 @@ def paged_prefill_shared(family, params, ids, config, pool_k, pool_v,
     logits [G, V])."""
     c = config
     G, S = ids.shape
-    L, P, kv, ps, hd = pool_k.shape
+    quant = isinstance(pool_k, dict)
+    L, P, kv, ps, hd = (pool_k["q"] if quant else pool_k).shape
     ncp = ctx_rows.shape[1]
     E.enforce(S % ps == 0, f"padded tail {S} not a multiple of "
               f"page_size {ps}")
@@ -606,11 +701,14 @@ def paged_prefill_shared(family, params, ids, config, pool_k, pool_v,
         q = rope_raw(q, cos, sin)
         k = rope_raw(k, cos, sin)
         # cached prefix pages, token-major: [G, ncp, kv, ps, hd] ->
-        # [G, ctx, kv, hd] (rope already applied when they were written)
-        ck = jnp.swapaxes(kpl[ctx_rows], 2, 3).reshape(G, ctx, kv, hd)
-        cv = jnp.swapaxes(vpl[ctx_rows], 2, 3).reshape(G, ctx, kv, hd)
-        ka = jnp.concatenate([ck.astype(k.dtype), k], axis=1)
-        va = jnp.concatenate([cv.astype(v.dtype), v], axis=1)
+        # [G, ctx, kv, hd] (rope already applied when they were
+        # written; quantized pools dequantize in the gather)
+        ck = jnp.swapaxes(_kv_pool_gather(kpl, ctx_rows, k.dtype),
+                          2, 3).reshape(G, ctx, kv, hd)
+        cv = jnp.swapaxes(_kv_pool_gather(vpl, ctx_rows, v.dtype),
+                          2, 3).reshape(G, ctx, kv, hd)
+        ka = jnp.concatenate([ck, k], axis=1)
+        va = jnp.concatenate([cv, v], axis=1)
         a = sdpa_raw(q, ka, va, attn_mask=mask).reshape(G, S, -1)
         x = x + _mm(a.astype(x.dtype), lp["wo"])
         return family.decode_mlp(x, lp, c), (k, v)
@@ -619,10 +717,8 @@ def paged_prefill_shared(family, params, ids, config, pool_k, pool_v,
     npad = S // ps
     ks = jnp.moveaxis(ks.reshape(L, G, npad, ps, kv, hd), 4, 3)
     vs = jnp.moveaxis(vs.reshape(L, G, npad, ps, kv, hd), 4, 3)
-    pool_k = pool_k.at[:, page_rows].set(ks.astype(pool_k.dtype),
-                                         mode="drop")
-    pool_v = pool_v.at[:, page_rows].set(vs.astype(pool_v.dtype),
-                                         mode="drop")
+    pool_k = _kv_pool_write(pool_k, ks, page_rows)
+    pool_v = _kv_pool_write(pool_v, vs, page_rows)
     x = _rms(x, params["ln_f"], c.rms_norm_eps)
     last = jnp.take_along_axis(
         x, jnp.maximum(slen - 1, 0)[:, None, None], axis=1)[:, 0]
@@ -645,7 +741,8 @@ def paged_verify_window(family, params, tokens, config, pool_k, pool_v,
     rejected positions' KV is masked garbage until overwritten."""
     c = config
     B, C = tokens.shape
-    L, P, kv, ps, hd = pool_k.shape
+    quant = isinstance(pool_k, dict)
+    L, P, kv, ps, hd = (pool_k["q"] if quant else pool_k).shape
     maxp = block_tables.shape[1]
     pos = kv_len[:, None] + jnp.arange(C)[None, :]          # [B, C]
     x = jnp.take(params["embed"], tokens, axis=0)
@@ -664,6 +761,43 @@ def paged_verify_window(family, params, tokens, config, pool_k, pool_v,
     # pages gather clamped garbage and sit beyond every query's limit
     mask = jnp.arange(maxp * ps)[None, None, :] <= pos[:, :, None]
 
+    # quantized pools rewrite the window's touched pages wholesale:
+    # the window spans at most nwp consecutive pages per sequence
+    # (worst case: first token at the last slot of its page)
+    nwp = (C + ps - 2) // ps + 1
+    wstart = kv_len // ps                                   # [B]
+    wi = wstart[:, None] + jnp.arange(nwp)[None, :]         # [B, nwp]
+    wrows = jnp.take_along_axis(block_tables,
+                                jnp.clip(wi, 0, maxp - 1), axis=1)
+    # past-the-table or dead rows: sentinel, scatter drops the page
+    wrows = jnp.where((wi < maxp) & live[:, None], wrows, P)
+    lpi = page_idx - wstart[:, None]                        # [B, C] local
+    bi = jnp.arange(B)[:, None]
+
+    def _window_rewrite(leaf, val):
+        """Gather the window's nwp pages, dequantize, zero the
+        not-yet-written tail (stale codes must not inflate the
+        scale), insert the window tokens, requantize each page under
+        its fresh absmax, scatter codes + scale rows back under one
+        drop mask."""
+        rc = jnp.clip(wrows, 0, P - 1)
+        page = (leaf["q"][rc].astype(jnp.float32)
+                * leaf["s"][rc][..., None, None])  # [B, nwp, kv, ps, hd]
+        gpos = wi[:, :, None] * ps + jnp.arange(ps)[None, None, :]
+        keep = gpos <= (kv_len + C - 1)[:, None, None]      # [B, nwp, ps]
+        page = jnp.where(keep[:, :, None, :, None], page, 0.0)
+        page = page.at[bi[:, :, None], lpi[:, :, None],
+                       kvi[None, None, :], off[:, :, None]].set(
+            val.astype(jnp.float32), unique_indices=True)
+        s = jnp.max(jnp.abs(page), axis=(-2, -1)) / _KV_QMAX
+        q = _kv_quantize(page, s[..., None, None])
+        return {"q": leaf["q"].at[wrows[:, :, None],
+                                  kvi[None, None, :]].set(
+                    q, mode="drop", unique_indices=True),
+                "s": leaf["s"].at[wrows[:, :, None],
+                                  kvi[None, None, :]].set(
+                    s, mode="drop", unique_indices=True)}
+
     from ..nn.functional.attention import sdpa_raw
 
     def step(carry, xs):
@@ -673,17 +807,21 @@ def paged_verify_window(family, params, tokens, config, pool_k, pool_v,
         q, k, v = _qkv_proj(h, lp, c)
         q = rope_raw(q, cos, sin)
         k = rope_raw(k, cos, sin)
-        kpl = kpl.at[rows[:, :, None], kvi[None, None, :],
-                     off[:, :, None]].set(
-            k.astype(kpl.dtype), mode="drop", unique_indices=True)
-        vpl = vpl.at[rows[:, :, None], kvi[None, None, :],
-                     off[:, :, None]].set(
-            v.astype(vpl.dtype), mode="drop", unique_indices=True)
-        ck = jnp.swapaxes(kpl[block_tables], 2, 3).reshape(
-            B, maxp * ps, kv, hd)
-        cv = jnp.swapaxes(vpl[block_tables], 2, 3).reshape(
-            B, maxp * ps, kv, hd)
-        a = sdpa_raw(q, ck.astype(q.dtype), cv.astype(q.dtype),
+        if quant:
+            kpl = _window_rewrite(kpl, k)
+            vpl = _window_rewrite(vpl, v)
+        else:
+            kpl = kpl.at[rows[:, :, None], kvi[None, None, :],
+                         off[:, :, None]].set(
+                k.astype(kpl.dtype), mode="drop", unique_indices=True)
+            vpl = vpl.at[rows[:, :, None], kvi[None, None, :],
+                         off[:, :, None]].set(
+                v.astype(vpl.dtype), mode="drop", unique_indices=True)
+        ck = jnp.swapaxes(_kv_pool_gather(kpl, block_tables, q.dtype),
+                          2, 3).reshape(B, maxp * ps, kv, hd)
+        cv = jnp.swapaxes(_kv_pool_gather(vpl, block_tables, q.dtype),
+                          2, 3).reshape(B, maxp * ps, kv, hd)
+        a = sdpa_raw(q, ck, cv,
                      attn_mask=mask[:, None]).reshape(B, C, -1)
         x = x + _mm(a.astype(x.dtype), lp["wo"])
         return family.decode_mlp(x, lp, c), (kpl, vpl)

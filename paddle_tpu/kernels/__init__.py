@@ -51,6 +51,7 @@ _DISPATCH_STATS = {"flash": 0, "flash_fallback": 0,
                    "rms": 0, "rms_fallback": 0,
                    "fused_ce": 0, "fused_ce_fallback": 0,
                    "paged": 0, "paged_fallback": 0,
+                   "paged_quant": 0, "paged_quant_fallback": 0,
                    "varlen": 0, "varlen_fallback": 0}
 
 
@@ -163,20 +164,32 @@ def dispatched_segment_attention(q, k, v, seg_q, seg_k, pos_q, pos_k, *,
 
 
 def dispatched_paged_attention(q, k_pages, v_pages, block_tables, lengths,
-                               *, scale=None):
+                               *, scale=None, k_scales=None,
+                               v_scales=None):
     """Ragged paged decode attention with the same counter discipline as
     flash/rms: the pallas kernel on TPU when the shapes are supported,
     the pure-jnp gather reference elsewhere (tier-1's CPU path). Both
     share one masking/softmax definition — the serving engine's
-    paged-vs-ring parity holds on either path."""
-    if _on_tpu() and _pa.supported(q, k_pages, block_tables):
-        _DISPATCH_STATS["paged"] += 1
+    paged-vs-ring parity holds on either path.
+
+    The kv-dtype arm (FLAGS_serving_kv_quant): int8 page pools arrive
+    with per-page per-kv-head f32 ``k_scales``/``v_scales`` [P, kv]
+    planes; both the kernel and the reference dequantize inline (page
+    DMA stays int8, the scale folds into the attention dot), counted
+    separately (``paged_quant[_fallback]``) so benchmarks can assert
+    which arm a quantized shape actually traced."""
+    quant = k_scales is not None
+    arm = "paged_quant" if quant else "paged"
+    if _on_tpu() and _pa.supported(q, k_pages, block_tables,
+                                   quant=quant):
+        _DISPATCH_STATS[arm] += 1
         return _pa.ragged_paged_attention(
             q, k_pages, v_pages, block_tables, lengths, scale=scale,
-            interpret=False)
-    _DISPATCH_STATS["paged_fallback"] += 1
+            k_scales=k_scales, v_scales=v_scales, interpret=False)
+    _DISPATCH_STATS[arm + "_fallback"] += 1
     return _pa.paged_attention_ref(
-        q, k_pages, v_pages, block_tables, lengths, scale=scale)
+        q, k_pages, v_pages, block_tables, lengths, scale=scale,
+        k_scales=k_scales, v_scales=v_scales)
 
 
 def register(flash: bool = True, rms: bool = True, tpu_only: bool = False):

@@ -26,7 +26,9 @@ TPU-native design:
 from __future__ import annotations
 
 import json
+import math
 import os
+import re
 import time
 from typing import Callable, Optional
 
@@ -109,6 +111,35 @@ class AutotuneCache:
     def get(self, key: str):
         self._load()
         return self._mem.get(key)
+
+    def get_nearest(self, key: str):
+        """Warm-start lookup for a cold shape key: the closest tuned
+        entry whose key shares this key's non-numeric skeleton (same
+        knob family, backend, dtype — digit runs wildcarded), by
+        log-space distance over the numeric fields. A serving shape
+        that was never swept (new batch size, new max_len) then seeds
+        from its nearest tuned neighbor instead of the hardcoded
+        default. Returns ``(neighbor_key, value)`` or ``None``; error
+        entries never warm-start."""
+        self._load()
+        skel = re.sub(r"\d+", "#", key)
+        nums = [int(x) for x in re.findall(r"\d+", key)]
+        best = None
+        best_d = None
+        for k in sorted(self._mem):       # deterministic tie-break
+            v = self._mem[k]
+            if k == key or not isinstance(v, dict) or v.get("error"):
+                continue
+            if re.sub(r"\d+", "#", k) != skel:
+                continue
+            kn = [int(x) for x in re.findall(r"\d+", k)]
+            if len(kn) != len(nums):
+                continue
+            d = sum(abs(math.log(a + 1) - math.log(b + 1))
+                    for a, b in zip(nums, kn))
+            if best_d is None or d < best_d:
+                best_d, best = d, (k, v)
+        return best
 
     def put(self, key: str, value: dict):
         self._load()
@@ -416,10 +447,13 @@ PAGED_DEFAULT_PAGE = 16
 PAGED_CANDIDATES = (8, 16, 32, 64)
 
 
-def paged_candidates(dtype, max_len: int):
+def paged_candidates(dtype, max_len: int, kv_quant: bool = False):
     """Legal page-size candidates for a pool dtype, default first; the
-    packed-dtype sublane tile (16) floors bf16 pages."""
-    sub = 16 if jnp.dtype(dtype).itemsize == 2 else 8
+    packed-dtype sublane tile (16) floors bf16 pages. A quantized pool
+    stores int8 codes whose sublane tile is 32 rows — smaller pages
+    would force the kernel arm to fall back, so they are not offered."""
+    sub = 32 if kv_quant else (16 if jnp.dtype(dtype).itemsize == 2
+                               else 8)
     out = []
     for ps in (PAGED_DEFAULT_PAGE,) + PAGED_CANDIDATES:
         if ps < sub or ps > max(max_len, sub):
@@ -429,10 +463,12 @@ def paged_candidates(dtype, max_len: int):
     return out or [max(sub, PAGED_DEFAULT_PAGE)]
 
 
-def _paged_measurer(batch, nh, kvh, d, max_len, dtype):
+def _paged_measurer(batch, nh, kvh, d, max_len, dtype, kv_quant=False):
     """Per-sweep closure: one random KV working set, re-paged per
     candidate (pool bytes are identical across candidates; ``max_len``
-    rounds up to the largest candidate so every page size divides it)."""
+    rounds up to the largest candidate so every page size divides it).
+    ``kv_quant`` measures the int8-page arm: codes + per-page scales,
+    quantized from the same working set."""
     from .paged_attention import ragged_paged_attention
 
     cap = max(PAGED_CANDIDATES)
@@ -444,14 +480,29 @@ def _paged_measurer(batch, nh, kvh, d, max_len, dtype):
     lengths = jnp.asarray(
         rng.integers(max_len // 4, max_len + 1, (batch,)), jnp.int32)
 
+    def _quantize(pages_arr):
+        s = jnp.max(jnp.abs(pages_arr.astype(jnp.float32)),
+                    axis=(2, 3)) / 127.0
+        codes = jnp.round(
+            pages_arr.astype(jnp.float32)
+            / jnp.maximum(s, 1e-10)[:, :, None, None]).astype(jnp.int8)
+        return codes, s
+
     def measure(ps):
         maxp = max_len // ps
         pages = batch * maxp
         kp = jnp.moveaxis(flat_k.reshape(pages, ps, kvh, d), 2, 1)
         vp = jnp.moveaxis(flat_v.reshape(pages, ps, kvh, d), 2, 1)
         bt = jnp.asarray(np.arange(pages).reshape(batch, maxp), jnp.int32)
-        f = jax.jit(lambda q_, k_, v_: ragged_paged_attention(
-            q_, k_, v_, bt, lengths, interpret=False))
+        if kv_quant:
+            kp, ks = _quantize(kp)
+            vp, vs = _quantize(vp)
+            f = jax.jit(lambda q_, k_, v_: ragged_paged_attention(
+                q_, k_, v_, bt, lengths, k_scales=ks, v_scales=vs,
+                interpret=False))
+        else:
+            f = jax.jit(lambda q_, k_, v_: ragged_paged_attention(
+                q_, k_, v_, bt, lengths, interpret=False))
         out = f(q, kp, vp)              # compile + warmup
         jax.block_until_ready(out)
         best = float("inf")
@@ -468,22 +519,41 @@ def _paged_measurer(batch, nh, kvh, d, max_len, dtype):
 def paged_page_size(batch, num_heads, kv_heads, head_dim, max_len, dtype,
                     default: int = PAGED_DEFAULT_PAGE,
                     measure: Optional[Callable] = None,
-                    cache: Optional[AutotuneCache] = None) -> int:
+                    cache: Optional[AutotuneCache] = None,
+                    kv_quant: bool = False) -> int:
     """Tuned KV page size for a paged serving shape; measures the decode
     kernel once per shape key and caches (memory + disk), same policy
     gates as flash_blocks/ce_chunk. Used by the serving engine when
-    constructed with ``page_size=None``."""
-    cands = paged_candidates(dtype, max_len)
+    constructed with ``page_size=None``.
+
+    ``kv_quant`` selects the int8-page arm: its own ``:kvq`` key suffix
+    (the trade-off differs — int8 pages carry a 32-row sublane tile and
+    a scale-plane SMEM fetch — so quantized and full-precision tunings
+    never collide) and quantized measurement operands. Cold shapes that
+    cannot measure (off-TPU, cached-only mode, under a trace) warm-start
+    from the nearest tuned neighbor in the same key family instead of
+    the hardcoded default."""
+    cands = paged_candidates(dtype, max_len, kv_quant=kv_quant)
     default = default if default in cands else cands[0]
     key = (f"paged:{jax.default_backend()}:{jnp.dtype(dtype).name}:"
-           f"b{batch}h{num_heads}kv{kv_heads}d{head_dim}:m{max_len}")
+           f"b{batch}h{num_heads}kv{kv_heads}d{head_dim}:m{max_len}"
+           + (":kvq" if kv_quant else ""))
     mode = _mode()
+
+    def _warm_start(tag):
+        nb = (cache or _CACHE).get_nearest(key)
+        if nb and int(nb[1].get("page_size", -1)) in cands:
+            _USED[key] = {"page_size": int(nb[1]["page_size"]),
+                          "source": f"warm-start:{nb[0]}"}
+            return int(nb[1]["page_size"])
+        _USED[key] = {"page_size": default, "source": tag}
+        return default
+
     if not _flags.flag_value("use_autotune") or mode == "0":
         _USED[key] = {"page_size": default, "source": "off"}
         return default
     if measure is None and mode != "cached" and not _tuning_backend():
-        _USED[key] = {"page_size": default, "source": "default-not-tpu"}
-        return default
+        return _warm_start("default-not-tpu")
     cache = cache or _CACHE
     hit = cache.get(key)
     _monitor.inc("autotune.cache.hit" if hit and not hit.get("error")
@@ -496,17 +566,16 @@ def paged_page_size(batch, num_heads, kv_heads, head_dim, max_len, dtype,
         _USED[key] = {"page_size": default, "source": "default"}
         return default
     if mode == "cached":
-        _USED[key] = {"page_size": default, "source": "default"}
-        return default
+        return _warm_start("default")
     if measure is None and _in_trace():
-        _USED[key] = {"page_size": default, "source": "default-in-trace"}
-        return default
+        return _warm_start("default-in-trace")
     if len(cands) == 1:
         cache.put(key, {"page_size": cands[0], "us": None, "candidates": 1})
         _USED[key] = {"page_size": cands[0], "source": "measured"}
         return cands[0]
     measure = measure or _paged_measurer(batch, num_heads, kv_heads,
-                                         head_dim, max_len, dtype)
+                                         head_dim, max_len, dtype,
+                                         kv_quant=kv_quant)
     _monitor.inc("autotune.sweeps", doc="candidate measurement sweeps run")
     timings = {}
     last_err = None

@@ -57,8 +57,15 @@ def _sublane(dtype) -> int:
 # kernel
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc, m_s, l_s, *, scale, page_size, max_pages):
+def _decode_kernel(bt_ref, len_ref, *refs, scale, page_size, max_pages,
+                   quant):
+    if quant:
+        # int8 pages ride with per-(page, kv-head) scale scalars (SMEM,
+        # same block-table index map): dequant is a scalar multiply
+        # FOLDED into the dots — the page DMA itself stays int8
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, acc, m_s, l_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s = refs
     b = pl.program_id(0)
     pi = pl.program_id(2)
     length = len_ref[b]
@@ -76,9 +83,18 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     def _body():
         q = q_ref[0, 0]                                  # [gp, hd]
         k = k_ref[0, 0]                                  # [ps, hd]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [gp, ps]
+        if quant:
+            # every code in this (page, head) block shares ONE scale,
+            # so dot(q, codes) * (ks*scale) == dot(q, deq(codes)) * scale
+            s = jax.lax.dot_general(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) \
+                * (ks_ref[0, 0] * scale)                 # [gp, ps]
+        else:
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
         pos = pi * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(pos < length, s, _NEG_INF)         # partial last page
@@ -91,9 +107,16 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)
         l_s[:] = jnp.broadcast_to(
             l_prev * alpha + jnp.sum(p, axis=1, keepdims=True), l_s.shape)
-        acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if quant:
+            acc[:] = acc[:] * alpha + jax.lax.dot_general(
+                p, v_ref[0, 0].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * vs_ref[0, 0]
+        else:
+            acc[:] = acc[:] * alpha + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0, 0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
 
     @pl.when(pi == max_pages - 1)
@@ -104,13 +127,21 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
-                           scale=None, interpret=None):
+                           scale=None, k_scales=None, v_scales=None,
+                           interpret=None):
     """Paged decode attention. q: [B, num_heads, head_dim]; k_pages /
     v_pages: [num_pages, kv_heads, page_size, head_dim]; block_tables:
     [B, max_pages] page ids (entries past a sequence's pages may hold
     any value — they are clamped and masked); lengths: [B] valid KV
     positions per sequence (0 = empty slot -> zero output row).
+
+    With ``k_scales``/``v_scales`` ([num_pages, kv_heads] f32, both or
+    neither) the pages are int8 codes (FLAGS_serving_kv_quant): each
+    (page, kv-head) scale rides the SAME block-table index map as its
+    page, lands in SMEM as a (1, 1) scalar block, and dequantization
+    folds into the two dots — HBM page traffic stays int8.
     Returns [B, num_heads, head_dim]."""
+    quant = k_scales is not None
     B, nh, hd = q.shape
     P, kv, ps, _ = k_pages.shape
     maxp = block_tables.shape[1]
@@ -129,19 +160,31 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     # the BlockSpec DMA; their contribution is masked by ``lengths``
     bt = jnp.clip(block_tables, 0, P - 1).reshape(-1).astype(jnp.int32)
 
+    def _page_map(b, h, p, bt_, ln_, mp=maxp):
+        return (bt_[b * mp + p], h, 0, 0)
+
+    def _scale_map(b, h, p, bt_, ln_, mp=maxp):
+        return (bt_[b * mp + p], h)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, gp, hd),
+                     lambda b, h, p, bt_, ln_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, ps, hd), _page_map),
+        pl.BlockSpec((1, 1, ps, hd), _page_map),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1), _scale_map, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), _scale_map, memory_space=pltpu.SMEM),
+        ]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, kv, maxp),
-        in_specs=[
-            pl.BlockSpec((1, 1, gp, hd),
-                         lambda b, h, p, bt_, ln_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, hd),
-                         lambda b, h, p, bt_, ln_, mp=maxp:
-                         (bt_[b * mp + p], h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, hd),
-                         lambda b, h, p, bt_, ln_, mp=maxp:
-                         (bt_[b * mp + p], h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, gp, hd),
                                lambda b, h, p, bt_, ln_: (b, h, 0, 0)),
         scratch_shapes=[
@@ -152,11 +195,11 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, page_size=ps,
-                          max_pages=maxp),
+                          max_pages=maxp, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, kv, gp, hd), q.dtype),
         interpret=interpret,
-    )(bt, lengths.astype(jnp.int32), qg, k_pages, v_pages)
+    )(bt, lengths.astype(jnp.int32), *operands)
     return out[:, :, :g, :].reshape(B, nh, hd)
 
 
@@ -165,10 +208,12 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
 # ---------------------------------------------------------------------------
 
 def paged_attention_ref(q, k_pages, v_pages, block_tables, lengths, *,
-                        scale=None):
+                        scale=None, k_scales=None, v_scales=None):
     """Gather-based reference: same contract and masking semantics as the
     kernel (safe softmax — an empty sequence yields a zero row, never
-    NaN). This is the path tier-1 runs on CPU."""
+    NaN). This is the path tier-1 runs on CPU. ``k_scales``/``v_scales``
+    ([num_pages, kv_heads] f32) mark int8 pages: the gathered codes are
+    dequantized in f32 before the same einsum math."""
     B, nh, hd = q.shape
     P, kv, ps, _ = k_pages.shape
     maxp = block_tables.shape[1]
@@ -183,9 +228,17 @@ def paged_attention_ref(q, k_pages, v_pages, block_tables, lengths, *,
         mode="promise_in_bounds").reshape(B, maxp, kv, ps, hd)
     v = v_pages.at[bt].get(
         mode="promise_in_bounds").reshape(B, maxp, kv, ps, hd)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if k_scales is not None:
+        sk = k_scales.at[bt].get(
+            mode="promise_in_bounds").reshape(B, maxp, kv)
+        sv = v_scales.at[bt].get(
+            mode="promise_in_bounds").reshape(B, maxp, kv)
+        kf = kf * sk.astype(jnp.float32)[..., None, None]
+        vf = vf * sv.astype(jnp.float32)[..., None, None]
     qf = q.astype(jnp.float32).reshape(B, kv, g, hd)
-    s = jnp.einsum("bkgd,bmkpd->bkgmp", qf,
-                   k.astype(jnp.float32)) * scale
+    s = jnp.einsum("bkgd,bmkpd->bkgmp", qf, kf) * scale
     pos = jnp.arange(maxp)[:, None] * ps + jnp.arange(ps)[None, :]
     mask = pos[None] < lengths[:, None, None]          # [B, maxp, ps]
     s = jnp.where(mask[:, None, None], s, _NEG_INF)
@@ -193,14 +246,14 @@ def paged_attention_ref(q, k_pages, v_pages, block_tables, lengths, *,
     e = jnp.where(mask[:, None, None], jnp.exp(s - m), 0.0)
     l = jnp.sum(e, axis=(-2, -1), keepdims=True)
     l = jnp.where(l == 0.0, 1.0, l)
-    out = jnp.einsum("bkgmp,bmkpd->bkgd", e / l,
-                     v.astype(jnp.float32))
+    out = jnp.einsum("bkgmp,bmkpd->bkgd", e / l, vf)
     return out.reshape(B, nh, hd).astype(q.dtype)
 
 
-def supported(q, k_pages, block_tables) -> bool:
+def supported(q, k_pages, block_tables, quant=False) -> bool:
     """Whether the pallas kernel handles these shapes (else the
-    dispatcher uses paged_attention_ref)."""
+    dispatcher uses paged_attention_ref). ``quant`` marks the int8-page
+    arm (scale planes present)."""
     if q.ndim != 3 or k_pages.ndim != 4 or block_tables.ndim != 2:
         return False
     B, nh, hd = q.shape
@@ -210,6 +263,13 @@ def supported(q, k_pages, block_tables) -> bool:
     if jnp.dtype(q.dtype) not in (jnp.dtype(jnp.float32),
                                   jnp.dtype(jnp.bfloat16)):
         return False
+    if quant:
+        # int8 pages: the K/V block's sublane tile is 32 rows (1-byte
+        # dtype), and only int8 codes are a valid quantized pool
+        if jnp.dtype(k_pages.dtype) != jnp.dtype(jnp.int8) or ps % 32:
+            return False
+    elif jnp.dtype(k_pages.dtype) == jnp.dtype(jnp.int8):
+        return False     # int8 pool without scales is a contract breach
     # page rows must cover the dtype's sublane tile (16 for bf16) and
     # the lane dim should fill VREGs; anything smaller falls back
     return hd % 8 == 0 and ps % _sublane(q.dtype) == 0 and P >= 1

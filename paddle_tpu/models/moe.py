@@ -182,27 +182,28 @@ def init_params(config: MoEConfig, key) -> Dict[str, Any]:
 
 def _edeq(w, dtype):
     """Expert-grid weight for the batched einsums: plain array, or the
-    weight-only form {"q": int8 [E, in, out], "s": f32 [E, out]}
+    weight-only form {"q": int8 [E, in, out], "s": f32 [E, out]} (or
+    its packed-int4 sibling {"q4": int8 [E, in/2, out], "s"})
     dequantized into the einsum (the convert fuses under XLA, so HBM
-    reads stay int8 — same seam as llama's _mm, including its dequant
-    ordering: f32 multiply, ONE cast, so the f32 scale is never
-    double-rounded through bf16)."""
+    reads stay int8/int4 — same seam as llama's _mm, including its
+    dequant ordering: f32 multiply, ONE cast, so the f32 scale is
+    never double-rounded through bf16)."""
     if isinstance(w, dict):
-        return (w["q"].astype(jnp.float32)
+        from .llama import unpack_int4
+        q = unpack_int4(w["q4"], -2) if "q4" in w else w["q"]
+        return (q.astype(jnp.float32)
                 * w["s"][:, None, :]).astype(dtype)
     return w
 
 
 def quantize_weights(params, weight_dtype: str = "int8"):
-    """Weight-only int8 quantization of a MoE params pytree for serving
-    (see llama.quantize_weights). Attention, shared-expert, per-expert
-    grids, and the lm head quantize per out-channel; the router stays
-    float32 (routing logits are precision-sensitive) and the embedding
-    stays full precision (gathered, not matmul'd)."""
-    E.enforce_eq(weight_dtype, "int8",
-                 "only weight-only int8 is supported for the functional "
-                 "decode path", error=E.UnimplementedError)
-    from .llama import quant_int8   # the one scheme definition
+    """Weight-only quantization (int8 or packed int4) of a MoE params
+    pytree for serving (see llama.quantize_weights). Attention,
+    shared-expert, per-expert grids, and the lm head quantize per
+    out-channel; the router stays float32 (routing logits are
+    precision-sensitive) and the embedding stays full precision
+    (gathered, not matmul'd)."""
+    from .llama import quant_packed   # the one scheme definition
 
     out = {"embed": params["embed"], "ln_f": params["ln_f"],
            "layers": {}}
@@ -210,10 +211,13 @@ def quantize_weights(params, weight_dtype: str = "int8"):
         if name.startswith("ln") or name == "router":
             out["layers"][name] = w
         elif name.startswith("e_"):            # [L, E, in, out]
-            out["layers"][name] = quant_int8(w, in_axis=2)
+            out["layers"][name] = quant_packed(
+                w, in_axis=2, weight_dtype=weight_dtype)
         else:                                  # [L, in, out]
-            out["layers"][name] = quant_int8(w, in_axis=1)
-    out["lm_head"] = quant_int8(params["lm_head"], in_axis=1)
+            out["layers"][name] = quant_packed(
+                w, in_axis=1, weight_dtype=weight_dtype)
+    out["lm_head"] = quant_packed(params["lm_head"], in_axis=1,
+                                  weight_dtype=weight_dtype)
     return out
 
 

@@ -62,6 +62,7 @@ __all__ = [
     "record_step_stats", "worst_layer", "top_movers", "latest",
     "sqnr_db", "dequant_ref", "audit_quantized_tree", "last_audit",
     "kv_sample_rate", "set_kv_sample_rate", "record_kv_absmax",
+    "record_kv_quant", "kv_quant_snapshot",
     "kv_snapshot", "numerics_snapshot", "reset", "NUMERIC_STATS",
 ]
 
@@ -88,6 +89,9 @@ _KV_RATE: list = [None]          # None = re-read env on next use
 _KV_MU = threading.Lock()
 _KV = {"samples": 0, "pages": 0, "min": None, "max": None,
        "sum": 0.0, "recent": deque(maxlen=64)}
+# KV-quant write-time health (engine-fed when FLAGS_serving_kv_quant):
+# latest sampled scale-plane p99 + saturated-code fraction
+_KVQ = {"samples": 0, "scale_p99": None, "clip_fraction": None}
 
 
 def _capacity_from_env() -> int:
@@ -281,16 +285,34 @@ def _scheme_in_axis(qa: np.ndarray) -> int:
     return qa.ndim - 1 if qa.ndim == 2 else qa.ndim - 2
 
 
-def dequant_ref(q, s, in_axis: Optional[int] = None) -> np.ndarray:
+def _unpack_int4_np(qa: np.ndarray, axis: int) -> np.ndarray:
+    """Host-side inverse of llama.quant_packed's int4 nibble pack:
+    sign-extend both nibbles of each byte and re-interleave along
+    ``axis`` (even code -> low nibble, odd -> high), doubling it."""
+    lo = (qa & 0x0F).astype(np.int16)
+    lo = np.where(lo >= 8, lo - 16, lo)
+    hi = ((qa.astype(np.int16) >> 4) & 0x0F)
+    hi = np.where(hi >= 8, hi - 16, hi)
+    shape = list(qa.shape)
+    shape[axis] *= 2
+    return np.stack([lo, hi], axis=axis + 1).reshape(shape) \
+        .astype(np.int8)
+
+
+def dequant_ref(q, s, in_axis: Optional[int] = None, *,
+                int4_packed: bool = False) -> np.ndarray:
     """f32 reconstruction of a weight-only {"q": int8, "s": f32} leaf
-    under the one scheme definition (llama.quant_int8): the scale's
-    reduced axis is re-inserted and the multiply runs in f32 — the
-    reference the serving-dtype seams are audited against.
+    under the one scheme definition (llama.quant_int8) — or, with
+    ``int4_packed``, of a {"q4": packed int4, "s"} leaf
+    (llama.quant_packed): the packed axis unpacks to int8 codes first.
+    The scale's reduced axis is re-inserted and the multiply runs in
+    f32 — the reference the serving-dtype seams are audited against.
 
     ``in_axis`` pins the reduced axis; by default it is inferred from
     the shapes, falling back to the scheme convention
     (:func:`_scheme_in_axis`) when a square tensor makes the shapes
-    ambiguous."""
+    ambiguous. The scale drops the reduced axis entirely, so the
+    inference works identically on a packed (halved) axis."""
     qa = np.asarray(q)
     sa = np.asarray(s, np.float32)
     axes = _scale_axes(qa, sa)
@@ -309,12 +331,16 @@ def dequant_ref(q, s, in_axis: Optional[int] = None) -> np.ndarray:
     else:
         scheme = _scheme_in_axis(qa)
         axis = scheme if scheme in axes else axes[0]
+    if int4_packed:
+        qa = _unpack_int4_np(qa, axis)
     return qa.astype(np.float32) * np.expand_dims(sa, axis)
 
 
 def _walk_pair(ref, q, prefix=""):
-    """Yield (path, ref_leaf, quant_dict) for every weight-only leaf."""
-    if isinstance(q, dict) and set(q) == {"q", "s"}:
+    """Yield (path, ref_leaf, quant_dict) for every weight-only leaf —
+    int8 ({"q", "s"}) and packed-int4 ({"q4", "s"}) forms both."""
+    if isinstance(q, dict) and (set(q) == {"q", "s"}
+                                or set(q) == {"q4", "s"}):
         yield prefix, ref, q
         return
     if isinstance(q, dict):
@@ -336,13 +362,17 @@ def audit_quantized_tree(ref_params, q_params, serving_dtype=None
     onto the ``numerics.quant.*`` gauges; returns it."""
     tensors = {}
     min_sqnr = None
+    int4_min_sqnr = None
     for path, ref_leaf, q_leaf in _walk_pair(ref_params, q_params):
         ref = np.asarray(ref_leaf, np.float32)
-        deq = dequant_ref(q_leaf["q"], q_leaf["s"])
+        int4 = "q4" in q_leaf
+        deq = dequant_ref(q_leaf["q4"] if int4 else q_leaf["q"],
+                          q_leaf["s"], int4_packed=int4)
         entry = {
             "sqnr_db": round(sqnr_db(ref, deq), 3),
             "max_abs_err": round(float(np.max(np.abs(ref - deq))), 9),
             "absmax": round(float(np.max(np.abs(ref))), 9),
+            "bits": 4 if int4 else 8,
         }
         if serving_dtype is not None:
             served = deq.astype(serving_dtype).astype(np.float32)
@@ -351,10 +381,14 @@ def audit_quantized_tree(ref_params, q_params, serving_dtype=None
         s = entry.get("sqnr_served_db", entry["sqnr_db"])
         if math.isfinite(s) and (min_sqnr is None or s < min_sqnr):
             min_sqnr = s
+        if int4 and math.isfinite(s) and (int4_min_sqnr is None
+                                          or s < int4_min_sqnr):
+            int4_min_sqnr = s
     report = {
         "unix_time": round(time.time(), 3),
         "tensors": tensors,
         "min_sqnr_db": min_sqnr,
+        "int4_min_sqnr_db": int4_min_sqnr,
         "serving_dtype": str(np.dtype(serving_dtype))
         if serving_dtype is not None else None,
     }
@@ -372,6 +406,12 @@ def audit_quantized_tree(ref_params, q_params, serving_dtype=None
             _set_gauge("numerics.quant.min_sqnr_db",
                        round(min_sqnr, 3),
                        doc="worst per-tensor SQNR (dB) of the latest "
+                           "weight-only quantization audit")
+        if int4_min_sqnr is not None:
+            _set_gauge("numerics.quant.int4_min_sqnr_db",
+                       round(int4_min_sqnr, 3),
+                       doc="worst per-tensor SQNR (dB) among the "
+                           "packed-int4 leaves of the latest "
                            "weight-only quantization audit")
     return report
 
@@ -447,6 +487,43 @@ def record_kv_absmax(absmax_k, absmax_v=None):
                    "KV-quantization scale ceiling")
 
 
+def record_kv_quant(scales, clip_fraction: float):
+    """Digest one sampled chunk's KV-quant write-time health
+    (FLAGS_serving_kv_quant engines, same 1-in-N seam as
+    :func:`record_kv_absmax`): the referenced pages' scale-plane
+    values and the fraction of int8 codes sitting at the +-127 clamp
+    — saturation means a page's write-time scale went stale against
+    later appends. Monitor-gated."""
+    if not _FLAG.value:
+        return
+    from . import set_gauge as _set_gauge
+
+    vals = np.asarray(scales, np.float32).ravel()
+    vals = vals[np.isfinite(vals) & (vals > 0)]
+    clip = float(clip_fraction)
+    with _KV_MU:
+        _KVQ["samples"] += 1
+        if vals.size:
+            _KVQ["scale_p99"] = round(
+                float(np.percentile(vals, 99)), 9)
+        _KVQ["clip_fraction"] = round(clip, 9)
+        p99 = _KVQ["scale_p99"]
+    if p99 is not None:
+        _set_gauge("numerics.kv_quant.scale_p99", p99,
+                   doc="p99 of the referenced KV pages' write-time "
+                       "quantization scales (per-page per-kv-head "
+                       "absmax/127) at the latest sample")
+    _set_gauge("numerics.kv_quant.clip_fraction", round(clip, 9),
+               doc="fraction of referenced int8 KV codes at the "
+                   "+-127 clamp at the latest sample — saturation "
+                   "from scales gone stale against later appends")
+
+
+def kv_quant_snapshot() -> dict:
+    with _KV_MU:
+        return dict(_KVQ)
+
+
 def kv_snapshot() -> dict:
     with _KV_MU:
         return {
@@ -496,6 +573,7 @@ def numerics_snapshot(n: Optional[int] = None) -> dict:
         "rows": rows,
         "quant": _AUDIT[0],
         "kv": kv_snapshot(),
+        "kv_quant": kv_quant_snapshot(),
     })
 
 
@@ -511,3 +589,4 @@ def reset():
     with _KV_MU:
         _KV.update(samples=0, pages=0, sum=0.0, min=None, max=None)
         _KV["recent"].clear()
+        _KVQ.update(samples=0, scale_p99=None, clip_fraction=None)

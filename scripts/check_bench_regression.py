@@ -50,7 +50,12 @@ ALLOWLIST = {
     "decode_tokens_per_sec": "extra.decode.decode_tokens_per_sec",
     "int8_decode_tokens_per_sec": "extra.decode.int8_decode_tokens_per_sec",
     "prefill_tokens_per_sec": "extra.decode.prefill_tokens_per_sec",
+    "int4_decode_tokens_per_sec": "extra.decode.int4_decode_tokens_per_sec",
     "serving_tokens_per_sec": "extra.serving_paged.serving_tokens_per_sec",
+    # quantized memory plane (FLAGS_serving_kv_quant): the serving rung's
+    # int8-pool arm must keep pace with its own trajectory
+    "serving_kv_quant_tokens_per_sec":
+        "extra.serving_paged.kv_quant.tokens_per_sec",
     "packed_tokens_per_sec": "extra.training_packed.packed_tokens_per_sec",
     # trace-replay goodput (loadgen harness): useful decode tokens per
     # wall second across the seeded overload trace — a PR that sheds
@@ -100,6 +105,19 @@ ALLOWLIST_LOWER = {
 # a scripted kill must strand work into recovery, never into `lost`.
 ALLOWLIST_ZERO = {
     "serving_failover_lost": "extra.serving_failover_replay.lost",
+}
+
+# static MINIMUM floors, checked on the NEWEST successful run only —
+# like ALLOWLIST_ZERO these are contracts, not trajectories: the value
+# must meet the named floor outright (no tolerance — the floor already
+# leaves headroom below the theoretical value). Absence is a skip.
+# The kv-quant concurrency ratio is pure pool arithmetic (f32 pools are
+# ~4x int8+scales, bf16 ~2x), so 1.8x holds on every backend the bench
+# runs on.
+ALLOWLIST_MIN = {
+    "serving_kv_quant_concurrency_at_fixed_pool_bytes": (
+        "extra.serving_paged.kv_quant"
+        ".servable_concurrency_at_fixed_pool_bytes", 1.8),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -188,10 +206,9 @@ def published_baselines(root=REPO, allowlist=None):
             if k in allowlist and isinstance(v, (int, float)) and v > 0}
 
 
-def newest_zero_rungs(root=REPO):
-    """(round, {rung: value}) of the ALLOWLIST_ZERO paths on the
-    NEWEST successful run — zeros KEPT, unlike :func:`extract_rungs`
-    (this check exists precisely to tell 0 from >0)."""
+def _newest_record(root=REPO):
+    """(round, headline_record) of the NEWEST successful run, or
+    (None, None)."""
     best = None
     for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
         m = _ROUND_RE.search(os.path.basename(path))
@@ -211,14 +228,36 @@ def newest_zero_rungs(root=REPO):
         rnd = int(m.group(1))
         if best is None or rnd > best[0]:
             best = (rnd, rec)
-    if best is None:
+    return best if best is not None else (None, None)
+
+
+def newest_zero_rungs(root=REPO):
+    """(round, {rung: value}) of the ALLOWLIST_ZERO paths on the
+    NEWEST successful run — zeros KEPT, unlike :func:`extract_rungs`
+    (this check exists precisely to tell 0 from >0)."""
+    rnd, rec = _newest_record(root)
+    if rec is None:
         return None, {}
     out = {}
     for rung, p in ALLOWLIST_ZERO.items():
-        v = _dig(best[1], p)
+        v = _dig(rec, p)
         if v is not None:
             out[rung] = float(v)
-    return best[0], out
+    return rnd, out
+
+
+def newest_min_rungs(root=REPO):
+    """(round, {rung: (value, floor)}) of the ALLOWLIST_MIN paths on
+    the NEWEST successful run."""
+    rnd, rec = _newest_record(root)
+    if rec is None:
+        return None, {}
+    out = {}
+    for rung, (p, floor) in ALLOWLIST_MIN.items():
+        v = _dig(rec, p)
+        if v is not None:
+            out[rung] = (float(v), float(floor))
+    return rnd, out
 
 
 def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
@@ -266,6 +305,17 @@ def check(root=REPO, tolerance=0.15, allowlist=None, verbose=False):
             elif verbose:
                 zero_lines.append(
                     f"  ✓ {rung}: 0 (invariant holds)")
+        # static minimum floors: same newest-run-only discipline
+        _, mvals = newest_min_rungs(root)
+        for rung, (v, floor) in sorted(mvals.items()):
+            if v < floor:
+                zero_ok = False
+                zero_lines.append(
+                    f"  ✗ {rung}: {v:g} undercuts the static floor "
+                    f"{floor:g}: REGRESSION")
+            elif verbose:
+                zero_lines.append(
+                    f"  ✓ {rung}: {v:g} >= static floor {floor:g}")
     if not floors and not ceilings:
         lines.append(f"bench guard: r{newest_round:02d} is the first "
                      "successful run — baseline established, nothing "

@@ -262,6 +262,59 @@ def main():
             f"leaked pages: {eng.cache.alloc.used_pages}"
         eng.cache.alloc.check_invariants()
 
+    @case("kv_quant_decode")
+    def _():
+        # quantized memory plane (FLAGS_serving_kv_quant) on the real
+        # chip: the same trace served from int8 page pools must emit
+        # the full-precision pools' greedy tokens and drain the pool.
+        # page_size 32 = the int8 sublane tile, so on-chip this drives
+        # the quantized pallas kernel arm (not the jnp fallback)
+        from paddle_tpu.inference import Request, ServingEngine
+        from paddle_tpu.models import llama as L
+        # f32 like the prefix_cache stage: a random tiny model's logit
+        # gaps sit inside bf16 cross-program rounding noise. int8 KV
+        # quantization is additionally LOSSY, so even in f32 a greedy
+        # argmax whose top-2 gap is inside the quantization noise can
+        # legitimately flip — the assert below tolerates exactly that
+        # (runner-up token at a tiny fp gap) and nothing else.
+        cfg = L.llama_tiny(num_hidden_layers=2)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 9)]
+
+        def serve(kv_quant):
+            eng = ServingEngine(L, params, cfg, num_slots=2, max_len=64,
+                                page_size=32, decode_chunk=2,
+                                kv_quant=kv_quant)
+            outs = eng.run([Request(rid=i, prompt=p, max_new_tokens=6)
+                            for i, p in enumerate(prompts)])
+            assert eng.cache.alloc.used_pages == 0, \
+                f"leaked pages: {eng.cache.alloc.used_pages}"
+            eng.cache.alloc.check_invariants()
+            return {i: np.asarray(o.tokens) for i, o in outs.items()}, eng
+
+        want, _ = serve(kv_quant=False)
+        got, qeng = serve(kv_quant=True)
+        assert isinstance(qeng.cache.pool["k"], dict), "pool not quantized"
+        for i in want:
+            eq = want[i] == got[i]
+            if eq.all():
+                continue
+            # benign near-tie flip: the quant run may take the greedy
+            # runner-up when the fp top-2 gap is inside the int8 noise
+            # floor; anything else (wrong rank, fat gap) is a real bug
+            k = int(np.argmin(eq))
+            ctx = np.concatenate([prompts[i], want[i][:k]])
+            lg = np.asarray(
+                L.forward(params, jnp.asarray(ctx)[None, :], cfg)[0, -1],
+                np.float64)
+            order = np.argsort(lg)[::-1]
+            gap = float(lg[order[0]] - lg[order[1]])
+            assert int(order[1]) == int(got[i][k]) and gap < 1e-2, (
+                f"rid {i} diverged at token {k}: fp={want[i][k]} "
+                f"quant={got[i][k]}, fp top-2 gap {gap:.3e} — not a "
+                f"near-tie flip")
+
     @case("operator_scrape")
     def _():
         # the operator plane against the real chip: start the telemetry

@@ -101,6 +101,8 @@ class TinyLlamaBlock(nn.Layer):
 
 
 class TestLlamaBlockTraining:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): convergence run; block_jit_step_matches_eager
+    # keeps the block train-step seam fast
     def test_block_memorizes_sequence(self):
         paddle.seed(1)
         vocab = 97

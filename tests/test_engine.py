@@ -295,6 +295,8 @@ class TestPriorityAdmission:
         assert len(outs) == 4
         assert all(o.finish_reason == "completed" for o in outs.values())
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): token identity under policies duplicated
+    # by flags_off_stays_fifo + preemption_tokens_identical pins
     def test_admitted_tokens_byte_identical_under_policies(self):
         # acceptance: with policies ON and the engine overloaded,
         # every ADMITTED request still emits byte-identical tokens to
@@ -469,6 +471,8 @@ class TestDeadlines:
         assert outs[0].finish_reason == "completed"
         assert outs[0].tokens.size == 2
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): chaos storm; the per-seam deadline tests
+    # above pin expiry/retire/eviction behavior fast
     def test_expired_deadline_storm_chaos(self, mon):
         # chaos: a storm of near-instant deadlines mixed with viable
         # work — every request ends in exactly one typed state, the

@@ -158,6 +158,8 @@ class TestKVCacheDecode:
         np.testing.assert_array_equal(np.asarray(toks), greedy)
         assert np.isfinite(np.asarray(scores)).all()
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): beam-vs-naive sweep; greedy cache parity +
+    # the beam invariant units keep the seam fast
     def test_beam_search_matches_naive_reference(self):
         """Differential test: the jitted static beam search must agree
         with a naive python beam search that re-runs the full forward

@@ -76,6 +76,8 @@ class TestOnnxExport:
             size=(1, 2, 8, 8)).astype("float32")
         _check(net, [x], rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): big structural export; conv_net/pooling
+    # cover the same op set at unit scale
     def test_resnet18_exports_structurally(self):
         # full vision flagship: conv/bn-eval/relu/maxpool/residuals/
         # adaptive-avgpool/fc all convert (numeric check skipped: the
@@ -163,6 +165,8 @@ class TestOnnxExport:
         np.testing.assert_allclose(got[1], np.asarray(want[1]),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): forced-loop arm; scan_beyond_unroll_cap
+    # already pins loop lowering, scan_unroll pins llama numerics
     def test_llama_loop_path_numerics(self, monkeypatch):
         # force the flagship scan-over-layers decoder down the Loop path
         # (cap 0) and check parity vs eager — proves real models convert

@@ -184,6 +184,8 @@ class TestServerLifecycle:
 
 
 class TestConcurrentScrapes:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): concurrency stress; ephemeral_bind_scrape +
+    # engine_entrypoint keep the scrape seam fast
     def test_scrapes_during_live_engine_run(self, mon):
         """The acceptance scenario: while the engine decodes, /metrics
         returns conformant text carrying the serving SLO histograms and
@@ -713,6 +715,8 @@ class TestFleetAggregation:
         assert samples[("x_y", (("host", "1"),))] == 3
 
     @pytest.mark.slow
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): subprocess launch; single-process aggregate +
+    # synthetic-aggregate parse pin the math fast
     def test_two_process_launch_agreement(self, tmp_path):
         """Cross-host gather via the launch CLI (KV-store transport —
         no compiled collectives, so it runs on the jax-0.4.37 CPU

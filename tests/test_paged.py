@@ -320,6 +320,8 @@ class TestEngineScheduling:
         assert s.tokens_generated == sum(r.max_new_tokens for r in reqs)
         assert 0.0 < s.occupancy() <= 1.0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): preemption duplicated by the randomized
+    # arrival trace above + test_engine's eviction-policy suite
     def test_preemption_under_tiny_pool(self):
         cfg = L.llama_tiny()
         params = L.init_params(cfg, jax.random.PRNGKey(9))

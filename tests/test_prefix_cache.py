@@ -196,6 +196,8 @@ class TestEnginePrefixCache:
         # scheduling identical too: same decode-step count both ways
         assert eng_on.stats.decode_steps == eng_off.stats.decode_steps
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): pressure sweep; eviction_skips_live_holders +
+    # lru_prefers_cold_leaves pin the same rules as units
     def test_eviction_pressure_audit(self, tiny):
         # a pool sized so the radix must be evicted to admit fresh
         # prompts: every admission passes the extended refcount audit
@@ -214,6 +216,9 @@ class TestEnginePrefixCache:
 
 
 class TestSpecDecode:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): spec greedy identity re-pinned fast by
+    # test_quantization's spec composition (engine spec-on == flags-off
+    # tokens); the sampled-path guard + golden pins below stay
     def test_greedy_token_identity(self, tiny):
         cfg, params = tiny
         rng = np.random.default_rng(13)
@@ -374,6 +379,8 @@ class TestReplayDeterminism:
 
 @pytest.mark.slow
 class TestCacheThrashChaos:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): interleaving chaos; the radix unit tests +
+    # same-seed determinism pin keep the seam fast
     def test_thrash_interleavings(self, tiny):
         # deliberately starved pool + rotating prefix families: every
         # admission round interleaves radix eviction, CoW forks and

@@ -240,6 +240,8 @@ class TestGuardedStep:
         assert not bool(h["finite"])             # gated by the cap
         assert _tree_identical(params, p2) and _tree_identical(opt, o2)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): duplicated by numerics'
+    # guarded_update_math_unchanged_by_numerics fast pin
     def test_guarded_update_math_matches_unguarded(self):
         """With an infinite cap and clean data the guarded step applies
         EXACTLY the unguarded update (the cond's true branch is the
@@ -459,6 +461,8 @@ class TestHangWatchdog:
         from paddle_tpu.monitor import steptimer as st
         assert wd.heartbeat not in st._STEP_LISTENERS
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): subprocess forensics; stall_dumps_stacks
+    # pins the watchdog dump path in-process
     def test_exit_on_stall_subprocess_leaves_forensics(self, tmp_path):
         """A wedged step in a real process: the watchdog dumps the
         stall JSON + flight record and exits non-zero so process-level
@@ -661,6 +665,8 @@ class TestEngineIsolation:
             eng.submit(Request(rid=2, prompt=np.arange(4, dtype=np.int32),
                                max_new_tokens=2.9))
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): poisoned-submit e2e; the typed-rejection
+    # + normalization units pin the same isolation seam fast
     def test_engine_keeps_serving_after_poisoned_submit(self):
         """The isolation pin: a poisoned submission must not perturb
         the tokens of in-flight or subsequent requests — byte-identical

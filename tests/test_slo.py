@@ -152,6 +152,8 @@ class TestCostAttribution:
         total = sum(outs[r.rid].cost.queue_wait_ms for r in reqs)
         assert total == pytest.approx(h.sum, rel=1e-6)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): same zero-sync contract as numerics'
+    # KV-sampling pin; cost-record population test stays fast
     def test_zero_added_syncs_at_any_rate(self, mon, monkeypatch):
         """The acceptance pin: cost attribution rides the per-chunk
         emitted-grid download — at exec sample rate 0 AND rate 1 the
@@ -557,6 +559,8 @@ def _get(url, timeout=10):
 
 @pytest.mark.serving
 class TestSurfaces:
+    @pytest.mark.slow  # tier-1 budget (ISSUE 19 rebalance): route e2e; flight-record/healthz/fleet
+    # surface tests + pinned burn math keep the route covered fast
     def test_slo_route_end_to_end(self, mon):
         srv = server.start_server(port=0)
         eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
