@@ -325,6 +325,10 @@ def _metrics_summary():
             # fleet SLO federation (monitor/federation.py): frames the
             # serving rung's replica published + the federated verdict
             "federation": _federation_block(),
+            # request forensics plane (monitor/forensics.py): timeline
+            # store occupancy, scheduler decision counts, and the
+            # violation-cause attribution over the run's requests
+            "forensics": _forensics_block(),
             # operator plane (monitor/memory.py + monitor/programs.py):
             # HBM occupancy at end of run (empty on backends that
             # report nothing — never fabricated) and the compiled-
@@ -465,6 +469,26 @@ def _slo_block():
             "window_requests": rep["window"]["size"],
             "tenants": len(tenants["tenants"]),
             "autoscale": _slo.update_autoscale_gauges(),
+        }
+    except Exception as e:                      # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _forensics_block():
+    """extra.metrics.forensics: the request forensics plane condensed —
+    timeline-store occupancy, per-kind scheduler decision counts, and
+    the SLO violation-cause attribution table. Full timelines stay on
+    the ``/forensics`` and ``/requests/<rid>`` endpoints."""
+    try:
+        from paddle_tpu.monitor import forensics as _forensics
+        p = _forensics.forensics_payload(slowest_n=4)
+        return {
+            "tracked": p["tracked"],
+            "evicted": p["evicted"],
+            "terminal_by_state": p["terminal_by_state"],
+            "decisions_by_kind": p["decisions"]["by_kind"],
+            "attribution": p["attribution"],
+            "slowest": p["slowest"],
         }
     except Exception as e:                      # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:200]}

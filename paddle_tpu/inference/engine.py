@@ -120,6 +120,7 @@ from ..monitor import profile_capture as _pcap
 from ..monitor import server as _mserver
 from ..monitor import trace as _trace
 from ..monitor import slo as _slo
+from ..monitor import forensics as _forensics
 from ..monitor.registry import LATENCY_BUCKETS_MS as _LATENCY_BUCKETS_MS
 from .paged import (PagedKVCache, PrefixCache, paged_decode_step,
                     paged_prefill, paged_prefill_shared,
@@ -724,6 +725,9 @@ class ServingEngine:
                 except Exception:
                     tenant = "default"
                 _slo.record_rejected(tenant or "default")
+                _forensics.note_terminal(req.rid, "rejected",
+                                         reason=reason,
+                                         tenant=tenant or "default")
             raise RequestRejected(req.rid, reason)
         # the scheduler consumes the NORMALIZED values it was screened
         # on — the original coercible-but-wrong-typed fields must not
@@ -770,6 +774,12 @@ class ServingEngine:
             else:
                 shed = self.queue[victim]
                 del self.queue[victim]
+                _forensics.decision(
+                    "displace", rid=shed.rid, reason="queue_full",
+                    queue_depth=len(self.queue) + 1,
+                    max_queue=self._max_queue, by_rid=req.rid,
+                    by_priority=req.priority,
+                    victim_priority=getattr(shed, "priority", 0))
                 self._finish_shed(
                     shed, "displaced by higher-priority request "
                           f"{req.rid!r}")
@@ -792,6 +802,9 @@ class ServingEngine:
             _trace.instant("serving.enqueue", rid=req.rid, prompt=plen,
                            max_new=req.max_new_tokens,
                            tenant=req.tenant)
+            _forensics.note(req.rid, "enqueue", t=now,
+                            tenant=req.tenant, priority=req.priority,
+                            prompt=plen, max_new=req.max_new_tokens)
         req._submitted = True
         if self._journal is not None:
             # journal AFTER every gate that could still refuse the
@@ -877,11 +890,18 @@ class ServingEngine:
                      doc="admissible work refused by overload policy "
                          "(bounded queue, SLO burn, displacement, "
                          "drain) with a retry_after_s hint")
+        tenant = getattr(req, "tenant", "default") or "default"
         _trace.instant("serving.shed", rid=req.rid, reason=why,
-                       retry_after_s=hint)
+                       retry_after_s=hint, tenant=tenant)
         if _monitor.enabled():
-            _slo.record_shed(getattr(req, "tenant", "default")
-                             or "default")
+            _slo.record_shed(tenant)
+            _forensics.decision("shed", rid=req.rid, reason=why,
+                                queue_depth=len(self.queue),
+                                priority=getattr(req, "priority", 0),
+                                draining=self._draining)
+            _forensics.note_terminal(req.rid, "shed", reason=why,
+                                     tenant=tenant,
+                                     retry_after_s=round(hint, 3))
         raise EngineOverloaded(req.rid, why, hint)
 
     def _displaceable_pos(self, priority: int) -> Optional[int]:
@@ -936,8 +956,17 @@ class ServingEngine:
             shed_reason=why)
         if self._journal is not None:
             self._journal.finish(req.rid, "shed")
+        tenant = getattr(req, "tenant", "default") or "default"
         _trace.instant("serving.shed", rid=req.rid, reason=why,
-                       retry_after_s=hint)
+                       retry_after_s=hint, tenant=tenant)
+        if mon:
+            _forensics.decision("shed", rid=req.rid, reason=why,
+                                queued=True,
+                                priority=getattr(req, "priority", 0),
+                                draining=self._draining)
+            _forensics.note_terminal(req.rid, "shed", reason=why,
+                                     tenant=tenant,
+                                     retry_after_s=round(hint, 3))
 
     def begin_drain(self, shed_queued: bool = True):
         """Enter the drain lifecycle: stop admitting new work (submit
@@ -1070,9 +1099,21 @@ class ServingEngine:
         if self._journal is not None:
             self._journal.finish(req.rid, "expired",
                                  tokens=int(tokens.shape[0]))
+        tenant = getattr(req, "tenant", "default") or "default"
         _trace.instant("serving.expire", rid=req.rid,
                        tokens=int(tokens.shape[0]),
-                       in_slot=slot_idx is not None)
+                       in_slot=slot_idx is not None, tenant=tenant)
+        if mon:
+            if slot_idx is not None:
+                _forensics.decision("evict", rid=req.rid,
+                                    reason="deadline", slot=slot_idx,
+                                    tokens=int(tokens.shape[0]))
+            _forensics.note_terminal(
+                req.rid, "expired", t=now,
+                e2e_ms=(cost.e2e_ms if cost is not None
+                        and cost.e2e_ms else None),
+                tenant=tenant, tokens=int(tokens.shape[0]),
+                in_slot=slot_idx is not None)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -1334,6 +1375,14 @@ class ServingEngine:
                            tokens=slot.gen,
                            preemptions=slot.preemptions,
                            tenant=getattr(slot.req, "tenant", "default"))
+            _forensics.note_terminal(
+                slot.req.rid, "completed", t=now,
+                e2e_ms=(cost.e2e_ms if cost is not None
+                        and cost.e2e_ms else None),
+                ttft_ms=(cost.ttft_ms if cost is not None
+                         and cost.ttft_ms else None),
+                tenant=getattr(slot.req, "tenant", "default"),
+                tokens=slot.gen, preemptions=slot.preemptions)
 
     def _preempt_victim_idx(self) -> Optional[int]:
         """Pick the eviction victim. Default: the YOUNGEST live request
@@ -1410,9 +1459,37 @@ class ServingEngine:
                 cost.grid_steps += (self.stats.decode_steps
                                     - slot.steps0) \
                     * self.num_slots
+            tenant = getattr(slot.req, "tenant", "default") \
+                or "default"
             _trace.instant("serving.preempt", rid=slot.req.rid,
-                           discarded=slot.gen)
+                           discarded=slot.gen, tenant=tenant)
+            # the victim-selection inputs that chose this slot — the
+            # _preempt_victim_idx key, recorded so the eviction is
+            # auditable (forensics decision ring + the victim's own
+            # timeline)
+            work = slot.kv_len
+            if cost is not None:
+                work = (cost.prefill_tokens + cost.decode_tokens)
+            policy = "slo" if self._slo_preemption else "youngest"
+            victim = dict(policy=policy, slot=idx,
+                          priority=getattr(slot.req, "priority", 0),
+                          prior_preemptions=slot.preemptions,
+                          work=int(work))
+            _forensics.decision("preempt", rid=slot.req.rid,
+                                discarded=slot.gen, **victim)
+            _forensics.note(slot.req.rid, "preempt", t=now,
+                            tenant=tenant, discarded=slot.gen,
+                            **victim)
         return True
+
+    def _defer(self, req: "Request", reason: str, **inputs):
+        """Record one admission-scan deferral (forensics timeline +
+        decision ring, both self-gated and coalescing — a head request
+        blocked on the same reason for many steps is ONE record with a
+        count, not a flood)."""
+        _forensics.note_defer(req.rid, reason, **inputs)
+        _forensics.decision("defer", rid=req.rid, reason=reason,
+                            **inputs)
 
     def _admit(self):
         # PAIRED SCANS: this FIFO body and _admit_policy below share
@@ -1426,6 +1503,8 @@ class ServingEngine:
         while self.queue:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
+                self._defer(self.queue[0], "no_free_slot",
+                            queue_depth=len(self.queue))
                 break
             req = self.queue[0]
             plen = int(np.asarray(req.prompt).shape[0])
@@ -1435,10 +1514,17 @@ class ServingEngine:
                            for s in self.slots)
             if (self._free_slack() - need < self.watermark_pages
                     and not idle):        # head-of-line admission control
+                self._defer(req, "watermark",
+                            free_slack=self._free_slack(), need=need,
+                            watermark_pages=self.watermark_pages,
+                            queue_depth=len(self.queue))
                 break
             self.queue.popleft()
             if self._alloc_for(req, s_pad) is None:
                 self.queue.appendleft(req)
+                self._defer(req, "alloc_failed", need=need,
+                            free_pages=self.cache.alloc.free_pages,
+                            queue_depth=len(self.queue))
                 # an idle engine that cannot place its head request will
                 # never make progress — that is a sizing error, not a
                 # transient
@@ -1507,6 +1593,8 @@ class ServingEngine:
         while self.queue:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
+                self._defer(self.queue[0], "no_free_slot",
+                            queue_depth=len(self.queue))
                 break
             pos = None
             for j, r in enumerate(self.queue):
@@ -1520,7 +1608,10 @@ class ServingEngine:
                         > getattr(self.queue[pos], "priority", 0):
                     pos = j
             if pos is None:
-                break                     # every waiter's tenant is at cap
+                # every waiter's tenant is at cap
+                self._defer(self.queue[0], "tenant_cap", cap=cap,
+                            queue_depth=len(self.queue))
+                break
             req = self.queue[pos]
             plen = int(np.asarray(req.prompt).shape[0])
             s_pad = max(self._bucket(plen), self.page_size)
@@ -1529,10 +1620,17 @@ class ServingEngine:
                            for s in self.slots)
             if (self._free_slack() - need < self.watermark_pages
                     and not idle):
+                self._defer(req, "watermark",
+                            free_slack=self._free_slack(), need=need,
+                            watermark_pages=self.watermark_pages,
+                            queue_depth=len(self.queue))
                 break
             del self.queue[pos]
             if self._alloc_for(req, s_pad) is None:
                 self.queue.insert(pos, req)
+                self._defer(req, "alloc_failed", need=need,
+                            free_pages=self.cache.alloc.free_pages,
+                            queue_depth=len(self.queue))
                 E.enforce(not idle,
                           f"request {req.rid} needs {need} pages but only "
                           f"{self.cache.alloc.free_pages} exist free on an "
@@ -1596,7 +1694,13 @@ class ServingEngine:
         t_admit = None
         if mon:
             t_admit = time.perf_counter()
+            _forensics.decision(
+                "admit", rid=group[0].rid, group=len(group),
+                bucket=s_pad, free_slots=len(free),
+                queue_depth=len(self.queue),
+                pfx_cached=int(getattr(group[0], "_pfx_cached", 0)))
             for r in group:
+                wait_ms = None
                 t_enq = getattr(r, "_t_enqueue", None)
                 if t_enq is not None:
                     wait_ms = (t_admit - t_enq) * 1e3
@@ -1611,6 +1715,14 @@ class ServingEngine:
                         # spend queued in total"
                         cost.queue_wait_ms += wait_ms
                 _trace.instant("serving.admit", rid=r.rid)
+                # the admit event carries the prefix-cache match result
+                # (cached prefix length this group was grouped on)
+                _forensics.note(
+                    r.rid, "admit", t=t_admit, bucket=s_pad,
+                    group=len(group),
+                    wait_ms=round(wait_ms, 3)
+                    if wait_ms is not None else None,
+                    pfx_cached=int(getattr(r, "_pfx_cached", 0)))
         g = 1
         while g < len(group):
             g *= 2
@@ -1697,6 +1809,9 @@ class ServingEngine:
             t_first = time.perf_counter()
             for r in group:
                 _trace.instant("serving.first_token", rid=r.rid)
+                # pure host bookkeeping AFTER the np.asarray download
+                # above already synchronized: zero added device syncs
+                _forensics.note(r.rid, "first_token", t=t_first)
         for j, (r, slot) in enumerate(zip(group, slots)):
             self.cache.alloc.advance(r.rid, int(slen[j]) + cached)
             tok = int(toks[j])
@@ -2075,6 +2190,10 @@ class ServingEngine:
                 s.cost.decode_tokens += len(emitted)
                 if vf_flops_share:
                     s.cost.model_flops += vf_flops_share
+            if t_chunk is not None:
+                # aggregate fold, no event append: spec rounds are
+                # per-chunk-rate and would flood the bounded timeline
+                _forensics.note_spec(s.req.rid, C - 1, a)
             # turbo preconditions rule out EOS; only the length bound
             # can finish a sequence here
             s.done = s.gen >= s.req.max_new_tokens

@@ -55,6 +55,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..monitor import trace as _trace
+from ..monitor import forensics as _forensics
 
 JOURNAL_KIND = "paddle_tpu.admission_journal"
 JOURNAL_VERSION = 1
@@ -358,6 +359,14 @@ class FailoverCoordinator:
             self.counters.get(state, 0) + 1
         _trace.instant("serving.failover.terminal", rid=rid, state=state,
                        attempts=rec.get("attempts", 0))
+        if state in ("quarantined", "expired", "shed"):
+            # coordinator-terminated strands never reach an engine
+            # terminal — this is their one terminal timeline event
+            # (engine-terminated states already recorded theirs)
+            _forensics.note_terminal(
+                rid, state, attempts=rec.get("attempts", 0),
+                tenant=rec.get("tenant"),
+                recovered_from=list(rec.get("recovered_from") or []))
 
     def note_replaced(self, victim: str,
                       now: Optional[float] = None) -> int:
@@ -426,6 +435,12 @@ class FailoverCoordinator:
                 self.pending.append(rec)
             _trace.instant("serving.failover.strand", rid=rid,
                            replica=victim, attempts=attempts)
+            # the strand hop rides the journal record's lineage, so a
+            # recovered request's timeline spans replicas
+            _forensics.note(rid, "strand",
+                            t=rec["_t_strand_wall"], replica=victim,
+                            attempts=attempts,
+                            recovered_from=list(rec["recovered_from"]))
         _monitor.set_gauge("serving.failover.pending",
                            len(self.pending),
                            doc="stranded requests awaiting re-dispatch")
@@ -454,6 +469,8 @@ class FailoverCoordinator:
                          "admission on a surviving replica")
         _trace.instant("serving.failover.redispatch", rid=rid,
                        replica=replica, attempts=rec.get("attempts", 0))
+        _forensics.note(rid, "redispatch", replica=replica,
+                        attempts=rec.get("attempts", 0))
 
     def requeue(self, rec: dict, now: float,
                 retry_after_s: Optional[float] = None) -> None:
@@ -548,6 +565,8 @@ class FailoverCoordinator:
                                  "closed the breaker")
             _trace.instant("serving.failover.breaker", replica=replica,
                            state=b.state)
+            _forensics.decision("breaker", replica=replica,
+                                state=b.state, failures=b.failures)
         _monitor.set_gauge(
             "serving.failover.breaker.open",
             sum(1 for x in self.breakers.values()

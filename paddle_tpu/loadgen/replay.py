@@ -49,6 +49,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor import forensics as _forensics
 from ..testing import faults as _faults
 from .traces import (ArrivalTrace, TraceRequest, prompt_tokens,
                      tenant_prefix_tokens)
@@ -413,6 +414,8 @@ def replay_trace(eng, trace: ArrivalTrace, *,
                                         "prompt_len": 0}
             rec.update(state="lost", tokens=rec.get("tokens", 0))
             terminal[rid] = rec
+            _forensics.note_terminal(rid, "lost",
+                                     tenant=rec.get("tenant"))
     result = ReplayResult(
         trace=trace, terminal=terminal, episodes=ep_log,
         engine_stats={"engine0": eng.stats.as_dict()},
@@ -761,6 +764,9 @@ def replay_fleet(make_engine, trace: ArrivalTrace, *,
                 rec.update(state="lost", tokens=rec.get("tokens", 0),
                            replica=name)
                 terminal[rid] = rec
+                _forensics.note_terminal(rid, "lost",
+                                         tenant=rec.get("tenant"),
+                                         replica=name)
     for rid, rec in terminal.items():
         if rec["state"] is None:
             rec["state"] = "lost"

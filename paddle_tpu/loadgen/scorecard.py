@@ -125,6 +125,17 @@ def _slo_block() -> dict:
         return {}
 
 
+def _forensics_attribution_block() -> dict:
+    """The forensics plane's wall-clock violation-cause table (timing
+    plane: phase durations are real time). Empty with the monitor
+    off — presence never perturbs the deterministic half."""
+    try:
+        from ..monitor import forensics as _forensics
+        return _forensics.attribution_table()
+    except Exception:
+        return {}
+
+
 def _fleet_block() -> dict:
     try:
         from ..monitor import federation as _fed
@@ -295,6 +306,26 @@ def build_scorecard(result: ReplayResult, *,
         # flags on ⇒ seed-reproducible hit/acceptance numbers
         "prefix_cache": _prefix_cache_block(result),
         "spec_decode": _spec_decode_block(result),
+        # request-disruption attribution, counted purely from terminal
+        # records (virtual-time replay ⇒ byte-identical across
+        # same-seed runs; the timing-plane half below holds the
+        # wall-clock violation-cause table)
+        "attribution": {
+            "requests_preempted": sum(
+                1 for r in result.terminal.values()
+                if int(r.get("preemptions", 0) or 0) > 0),
+            "preemptions": sum(
+                int(r.get("preemptions", 0) or 0)
+                for r in result.terminal.values()),
+            "displaced": by_reason.get("displaced", 0),
+            "expired": counts.get("expired", 0),
+            "recovered": sum(
+                1 for r in result.terminal.values()
+                if r.get("state") == "completed"
+                and r.get("recovered_from")),
+            "quarantined": counts.get("quarantined", 0),
+            "lost": counts.get("lost", 0),
+        },
         "fairness": {"jain_completion_index": fairness},
         "episodes": [
             {k: v for k, v in e.items()
@@ -306,6 +337,7 @@ def build_scorecard(result: ReplayResult, *,
         "steps": result.steps,
         "latency_ms": _latency_block(result.latency_samples),
         "slo": _slo_block(),
+        "attribution": _forensics_attribution_block(),
         "episodes": [
             {"kind": e.get("kind"), "index": e.get("index"),
              "slo": e.get("slo"), "wall_s": e.get("wall_s")}

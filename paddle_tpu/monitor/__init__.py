@@ -51,7 +51,7 @@ __all__ = [
     "server", "programs", "memory", "fleet",
     "comms", "roofline",
     "exectime", "profile_capture", "timeseries", "numerics", "slo",
-    "federation",
+    "federation", "forensics",
     "start_server", "stop_server",
     "suppressed", "suppress_accounting",
 ]
@@ -248,6 +248,7 @@ def reset():
     numerics.reset()
     slo.reset()
     federation.reset()
+    forensics.reset()
     # the sharding inspector's registered trees empty with the rest
     # (module-reference lookup: reset() must not be the thing that
     # first imports the distributed package)
@@ -317,5 +318,8 @@ from . import slo  # noqa: E402
 # Fleet SLO federation (PR 15): per-replica telemetry frames + the
 # federated burn/compliance view the serving controller scales on.
 from . import federation  # noqa: E402
+# Request forensics plane (PR 20): per-request causal timelines,
+# scheduler decision audit ring, tail-latency cause attribution.
+from . import forensics  # noqa: E402
 from . import server  # noqa: E402
 from .server import start_server, stop_server  # noqa: E402

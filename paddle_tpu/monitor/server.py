@@ -266,6 +266,22 @@ class _Handler(BaseHTTPRequestHandler):
                                  "this process"})
                 else:
                     self._send_json(200, card)
+            elif route == "/forensics":
+                from . import forensics as _forensics
+                self._send_json(200, _forensics.forensics_payload())
+            elif route.startswith("/requests/"):
+                # per-request timeline: /requests/<rid> (the only
+                # prefix-matched route — the rid is the path tail)
+                from . import forensics as _forensics
+                rid = route[len("/requests/"):]
+                payload = _forensics.request_payload(rid)
+                if payload is None:
+                    self._send_json(404, {
+                        "error": f"no timeline for rid {rid!r} "
+                                 "(unknown, evicted, or the forensics "
+                                 "plane is off)"})
+                else:
+                    self._send_json(200, payload)
             elif route == "/profile":
                 self._profile(parse_qs(url.query))
             elif route == "/":
@@ -276,6 +292,7 @@ class _Handler(BaseHTTPRequestHandler):
                                "/memory", "/roofline", "/sharding",
                                "/timeseries", "/numerics", "/slo",
                                "/fleet/serving", "/scorecard",
+                               "/forensics", "/requests/<rid>",
                                "/profile?seconds=N"],
                 })
             else:

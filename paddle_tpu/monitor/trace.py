@@ -239,6 +239,14 @@ def flight_payload(reason: str = "manual") -> dict:
         fd = _federation.flight_block()
     except Exception:
         fd = None
+    try:
+        # the request story (monitor/forensics.py): the slowest-N full
+        # timelines, the scheduler decision tail, and the violation
+        # attribution the engine had folded when it died. Same guard.
+        from . import forensics as _forensics
+        fo = _forensics.flight_block()
+    except Exception:
+        fo = None
     return {
         "kind": "paddle_tpu.flight_record",
         "reason": reason,
@@ -252,6 +260,7 @@ def flight_payload(reason: str = "manual") -> dict:
         "numerics": nm,
         "slo": sl,
         "federation": fd,
+        "forensics": fo,
     }
 
 
@@ -324,6 +333,13 @@ def export_chrome_trace(path: str, include_profiler: bool = True) -> str:
     if prof_events:
         trace.append({"name": "process_name", "ph": "M", "pid": 1,
                       "args": {"name": "paddle_tpu.profiler.host"}})
+    try:
+        # serving lifecycle events link to their request's forensics
+        # timeline (guarded: an export must not die on a telemetry
+        # extra)
+        from . import forensics as _forensics
+    except Exception:
+        _forensics = None
     for n, ph, t, d, tid, a in own:
         ev = {"name": n, "ph": ph, "pid": 0, "tid": tid,
               "ts": (t - t0) / 1000.0}
@@ -333,6 +349,9 @@ def export_chrome_trace(path: str, include_profiler: bool = True) -> str:
             ev["s"] = "t"            # thread-scoped instant
         if a:
             ev["args"] = dict(a)
+            if (_forensics is not None and n.startswith("serving.")
+                    and "rid" in a and _forensics.has(a["rid"])):
+                ev["args"]["forensics"] = f"/requests/{a['rid']}"
         trace.append(ev)
     for e in prof_events:
         trace.append({"name": e["name"], "ph": "X", "pid": 1,

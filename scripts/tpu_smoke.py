@@ -815,6 +815,86 @@ def main():
         eng.cache.alloc.check_invariants()
         assert eng.cache.alloc.free_pages == eng.cache.num_pages
 
+    @case("request_forensics")
+    def _():
+        # the forensics plane end to end on the real backend: a
+        # mixed-priority overload run with forced preemption (tiny
+        # page pool), then scrape /forensics and /requests/<rid> —
+        # the preempted request's timeline must show the preemption
+        # with its victim-selection inputs, every terminal request
+        # exactly one terminal event, and phases summing to e2e
+        import json as _json
+        import urllib.request
+        from paddle_tpu.inference import (EngineOverloaded, Request,
+                                          ServingEngine)
+        from paddle_tpu.models import llama as L
+        from paddle_tpu.monitor import forensics as mon_forensics
+        from paddle_tpu.monitor import server as mon_server
+        paddle.set_flags({"FLAGS_enable_monitor": True,
+                          "FLAGS_enable_monitor_server": True})
+        try:
+            cfg = L.llama_tiny(num_hidden_layers=2)
+            params = L.init_params(cfg, jax.random.PRNGKey(0))
+            # 5-page pool, 2 slots: three 12-token sequences cannot
+            # coexist -> at least one recompute preemption
+            eng = ServingEngine(L, params, cfg, num_slots=2,
+                                max_len=16, page_size=4, num_pages=5,
+                                decode_chunk=2, max_queue=3)
+
+            def mk(rid, **kw):
+                return Request(rid=rid, prompt=rng.integers(
+                    0, cfg.vocab_size, (4,)).astype(np.int32),
+                    max_new_tokens=8, **kw)
+            shed = []
+            for i in range(6):                  # burst > slots + queue
+                try:
+                    eng.submit(mk(i, priority=i % 2,
+                                  tenant=f"t{i % 2}"))
+                except EngineOverloaded:
+                    shed.append(i)
+            assert shed, "burst did not shed over the bounded queue"
+            eng.run()
+            assert eng.stats.preempted >= 1, eng.stats.as_dict()
+            srv = mon_server.get_server()
+            assert srv is not None, "engine did not start the server"
+            p = _json.load(urllib.request.urlopen(
+                f"{srv.url}/forensics", timeout=30))
+            assert p["kind"] == "paddle_tpu.forensics"
+            by_state = p["terminal_by_state"]
+            assert by_state.get("completed") and by_state.get("shed")
+            assert p["decisions"]["by_kind"].get("preempt"), \
+                p["decisions"]["by_kind"]
+            term = set(mon_forensics._TERMINAL_KIND.values())
+            preempted = None
+            for rid_s in p["requests"]:
+                tl = _json.load(urllib.request.urlopen(
+                    f"{srv.url}/requests/{rid_s}", timeout=30))
+                assert tl["state"] is not None, tl
+                kinds = [e["kind"] for e in tl["events"]]
+                assert sum(k in term for k in kinds) == 1, tl
+                if tl["e2e_ms"] is not None:
+                    assert abs(tl["phase_sum_ms"] - tl["e2e_ms"]) \
+                        <= 1.0, tl
+                if "preempt" in kinds:
+                    preempted = tl
+            assert preempted is not None, "no timeline saw preemption"
+            ev = next(e for e in preempted["events"]
+                      if e["kind"] == "preempt")
+            for k in ("policy", "slot", "prior_preemptions", "work",
+                      "discarded"):
+                assert k in ev, (k, ev)
+            assert preempted["phases"]["preempted_out"] > 0, preempted
+            # a shed rid answers on /requests/<rid> too (terminal-only)
+            tl = _json.load(urllib.request.urlopen(
+                f"{srv.url}/requests/{shed[0]}", timeout=30))
+            assert tl["state"] == "shed", tl
+        finally:
+            mon_server.stop_server()
+            paddle.set_flags({"FLAGS_enable_monitor": False,
+                              "FLAGS_enable_monitor_server": False})
+            from paddle_tpu import monitor as _mon
+            _mon.reset()
+
     @case("prefix_cache")
     def _():
         # radix shared-prefix KV cache on the real backend: two

@@ -1,0 +1,610 @@
+"""Request forensics plane (monitor/forensics.py + the engine /
+failover / replay hooks, /forensics + /requests/<rid> routes,
+scorecard attribution, chrome-trace links).
+
+The load-bearing contracts:
+
+- **Phase decomposition**: the incremental phase machine folds
+  event-to-event time into named phases that sum to the timeline's
+  e2e BY CONSTRUCTION — exact even when the bounded event list
+  truncates, and matching the engine cost record's e2e at retirement
+  (same clock, same stamp).
+- **Terminal uniqueness**: every terminal request (completed /
+  rejected / expired / shed / quarantined / lost) carries exactly one
+  terminal timeline event — pinned under an overload + preemption +
+  deadline chaos run and under the failover coordinator's
+  strand/quarantine paths.
+- **Cause attribution**: forced queue-wait violations name
+  ``queue_wait`` as the top cause, forced preemption violations name
+  ``preempted_out`` (the acceptance construction).
+- **Off path**: flag off = zero registrations, zero timelines; flag
+  on = zero ADDED device synchronizations at any exectime sample rate
+  (the PR 12 ``_block_until_ready`` indirection pin, slow-marked).
+- **Tenant-attributed lifecycle instants** (the satellite fix):
+  ``serving.shed`` / ``serving.expire`` / ``serving.preempt`` trace
+  instants carry ``tenant``.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import monitor
+from paddle_tpu.monitor import exectime
+from paddle_tpu.monitor import forensics
+from paddle_tpu.monitor import server
+from paddle_tpu.monitor import trace
+
+
+@pytest.fixture
+def mon():
+    """Monitor on, clean state; everything torn down after."""
+    monitor.reset()
+    server.stop_server()
+    pt.set_flags({"FLAGS_enable_monitor": True})
+    yield monitor
+    server.stop_server()
+    exectime.set_sample_rate(None)
+    pt.set_flags({"FLAGS_enable_monitor": False,
+                  "FLAGS_enable_monitor_server": False})
+    monitor.reset()
+
+
+def _engine(**kw):
+    import jax
+    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.models import llama as L
+    cfg = L.llama_tiny()
+    params = L.init_params(cfg, jax.random.PRNGKey(3))
+    return ServingEngine(L, params, cfg, **kw), cfg
+
+
+def _reqs(cfg, lens, new, tenants=None, seed=0, **kw):
+    from paddle_tpu.inference import Request
+    rng = np.random.default_rng(seed)
+    tenants = tenants or ["default"] * len(lens)
+    return [Request(rid=i,
+                    prompt=rng.integers(0, cfg.vocab_size,
+                                        (n,)).astype(np.int32),
+                    max_new_tokens=m, tenant=t, **kw)
+            for i, (n, m, t) in enumerate(zip(lens, new, tenants))]
+
+
+_TERMINAL_KINDS = set(forensics._TERMINAL_KIND.values())
+
+
+def _terminal_events(tl: dict):
+    return [e for e in tl["events"] if e["kind"] in _TERMINAL_KINDS]
+
+
+# ---------------------------------------------------------------------------
+# constructed timelines: phase machine, bounds, terminal uniqueness
+# ---------------------------------------------------------------------------
+
+class TestTimeline:
+    def test_phase_decomposition_sums_exactly(self, mon):
+        f = forensics
+        f.note(1, "enqueue", t=0.0, tenant="a", priority=2)
+        f.note(1, "admit", t=0.5)
+        f.note(1, "first_token", t=0.7)
+        f.note(1, "preempt", t=1.0, policy="youngest")
+        f.note(1, "admit", t=1.4)
+        f.note(1, "first_token", t=1.5)
+        f.note_terminal(1, "completed", t=2.0)
+        tl = f.request_payload(1)
+        assert tl["state"] == "completed"
+        assert tl["tenant"] == "a" and tl["priority"] == 2
+        assert tl["phases"] == {
+            "queue_wait": 500.0,          # 0.0 -> 0.5
+            "prefill": pytest.approx(200.0 + 100.0),  # both runs
+            "decode": pytest.approx(300.0 + 500.0),
+            "preempted_out": pytest.approx(400.0),    # 1.0 -> 1.4
+        }
+        assert tl["phase_sum_ms"] == pytest.approx(tl["e2e_ms"])
+        assert tl["e2e_ms"] == pytest.approx(2000.0)
+        # timeline TTFT falls back to the LAST first_token (the run
+        # the client keeps)
+        assert tl["ttft_ms"] == pytest.approx(1500.0)
+        assert len(_terminal_events(tl)) == 1
+
+    def test_defer_coalesces_and_truncation_keeps_sums(self, mon,
+                                                       monkeypatch):
+        monkeypatch.setattr(forensics, "_MAX_EVENTS", 6)
+        f = forensics
+        f.note(3, "enqueue", t=0.0)
+        # same-reason defers coalesce into ONE event with a count
+        for _ in range(50):
+            f.note_defer(3, "no_free_slot", queue_depth=4)
+        tl = f.request_payload(3)
+        defers = [e for e in tl["events"] if e["kind"] == "defer"]
+        assert len(defers) == 1 and defers[0]["count"] == 50
+        # alternating reasons can't coalesce -> the event bound bites,
+        # the first event (causal anchor) survives, phases stay exact
+        for i in range(20):
+            f.note_defer(3, f"r{i % 3}", queue_depth=4)
+        f.note(3, "admit", t=4.0)
+        f.note_terminal(3, "completed", t=5.0)
+        tl = f.request_payload(3)
+        assert tl["truncated_events"] > 0
+        assert len(tl["events"]) <= 6 + 1      # bound + terminal
+        assert tl["events"][0]["kind"] == "enqueue"
+        assert tl["phases"]["queue_wait"] == pytest.approx(4000.0)
+        assert tl["phase_sum_ms"] == pytest.approx(tl["e2e_ms"])
+
+    def test_terminal_unique_and_resubmission_restarts(self, mon):
+        f = forensics
+        f.note(9, "enqueue", t=0.0)
+        f.note_terminal(9, "expired", t=1.0)
+        f.note_terminal(9, "completed", t=2.0)    # ignored: one terminal
+        tl = f.request_payload(9)
+        assert tl["state"] == "expired"
+        assert len(_terminal_events(tl)) == 1
+        # a NEW submission of a finished rid restarts the timeline
+        # (the engine restarts the run's mutable state with it)
+        f.note(9, "enqueue", t=3.0)
+        tl = f.request_payload(9)
+        assert tl["state"] is None
+        assert [e["kind"] for e in tl["events"]] == ["enqueue"]
+
+    def test_store_evicts_terminal_first(self, mon, monkeypatch):
+        monkeypatch.setattr(forensics, "_MAX_REQUESTS", 4)
+        f = forensics
+        f.note(100, "enqueue", t=0.0)              # stays OPEN
+        for rid in (101, 102, 103):
+            f.note(rid, "enqueue", t=0.0)
+            f.note_terminal(rid, "completed", t=1.0)
+        f.note(104, "enqueue", t=0.0)              # 5th: evicts 101
+        assert f.tracked() == 4
+        assert f.has(100) and not f.has(101) and f.has(104)
+        assert monitor.snapshot()["counters"][
+            "serving.forensics.requests.evicted"] == 1
+
+    def test_strand_recovery_phase_and_lineage(self, mon):
+        f = forensics
+        f.note(5, "enqueue", t=0.0, tenant="a")
+        f.note(5, "admit", t=0.1)
+        f.note(5, "strand", t=0.5, replica="r0",
+               recovered_from=["r0"])
+        f.note(5, "redispatch", t=1.0, replica="r1")
+        f.note(5, "enqueue", t=1.1)    # survivor re-admission: the
+        #                                strand phase keeps running
+        f.note(5, "admit", t=2.5)
+        f.note(5, "first_token", t=2.6)
+        f.note_terminal(5, "completed", t=3.0)
+        tl = f.request_payload(5)
+        assert tl["recovered_from"] == ["r0"]
+        assert tl["phases"]["stranded_recovery"] == \
+            pytest.approx(2000.0)                  # 0.5 -> 2.5
+        assert tl["phase_sum_ms"] == pytest.approx(tl["e2e_ms"])
+
+
+# ---------------------------------------------------------------------------
+# attribution: forced dominant causes (the acceptance construction)
+# ---------------------------------------------------------------------------
+
+class TestAttribution:
+    def test_queue_wait_vs_preemption_dominant_cause(self, mon):
+        f = forensics
+        # forced queue-wait TTFT violations (objective default 1000ms)
+        for rid in (1, 2):
+            f.note(rid, "enqueue", t=0.0)
+            f.note(rid, "admit", t=2.0)
+            f.note(rid, "first_token", t=2.1)
+            f.note_terminal(rid, "completed", t=2.2)
+        a = f.attribution_table()["ttft_p99_ms"]
+        assert a["violations"] == 2
+        assert a["top_cause"] == "queue_wait"
+        assert a["by_cause_pct"]["queue_wait"] == 100.0
+        monitor.reset()
+        # forced preemption violations: preempted-out dominates TTFT
+        for rid in (1, 2, 3):
+            f.note(rid, "enqueue", t=0.0)
+            f.note(rid, "admit", t=0.1)
+            f.note(rid, "preempt", t=0.2)
+            f.note(rid, "admit", t=2.3)
+            f.note(rid, "first_token", t=2.4)
+            f.note_terminal(rid, "completed", t=2.5)
+        a = f.attribution_table()["ttft_p99_ms"]
+        assert a["violations"] == 3
+        assert a["top_cause"] == "preempted_out"
+        # decode never attributes a TTFT violation, only e2e
+        e = f.attribution_table()["e2e_p99_ms"]
+        assert e["completed"] == 3 and e["violations"] == 0
+
+    def test_decision_ring_coalesces_and_counts(self, mon):
+        f = forensics
+        for _ in range(30):
+            f.decision("defer", rid=1, reason="watermark", need=2)
+        f.decision("admit", rid=1, group=1)
+        p = f.forensics_payload()
+        assert p["decisions"]["total"] == 31
+        assert p["decisions"]["by_kind"] == {"admit": 1, "defer": 30}
+        ring = p["decisions"]["ring"]
+        assert len(ring) == 2 and ring[0]["count"] == 30
+        # the metric counts DISTINCT records (post-coalescing)
+        assert monitor.snapshot()["counters"][
+            "serving.forensics.decisions"] == 2
+
+
+# ---------------------------------------------------------------------------
+# engine chaos: overload + preemption + deadline, one run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+class TestEngineForensics:
+    def test_chaos_run_terminal_and_phase_contracts(self, mon):
+        """One overloaded run producing every engine-side terminal
+        state: displaced shed, queue-full shed, deadline expiry,
+        malformed reject, preempted-then-completed — each with exactly
+        one terminal event and phases summing to its e2e."""
+        from paddle_tpu.inference import (EngineOverloaded, Request,
+                                          RequestRejected)
+        eng, cfg = _engine(num_slots=2, max_len=16, page_size=4,
+                           num_pages=5, decode_chunk=2, max_queue=3)
+        r = _reqs(cfg, lens=(4, 4, 4), new=(8, 8, 8),
+                  tenants=["a", "b", "a"])
+        r[2].deadline_s = 0.004          # spent long before admission
+        for x in r:
+            eng.submit(x)                # queue now full (max_queue=3)
+        with pytest.raises(EngineOverloaded):
+            eng.submit(Request(rid=3, prompt=r[0].prompt,
+                               max_new_tokens=4, tenant="c"))
+        # priority 1 displaces the oldest priority-0 request (rid 0)
+        eng.submit(Request(rid=4, prompt=np.array(r[0].prompt),
+                           max_new_tokens=8, tenant="b", priority=1))
+        with pytest.raises(RequestRejected):
+            eng.submit(Request(rid=5, prompt=r[0].prompt,
+                               max_new_tokens=float("inf")))
+        eng.run()
+        assert eng.stats.preempted >= 1  # the tiny pool forces it
+        want = {0: "shed", 1: "completed", 2: "expired", 3: "shed",
+                4: "completed", 5: "rejected"}
+        for rid, state in want.items():
+            tl = forensics.request_payload(rid)
+            assert tl is not None and tl["state"] == state, (rid, tl)
+            assert len(_terminal_events(tl)) == 1, tl
+            # phases sum to the timeline's e2e (cost-record e2e when
+            # the engine stamped one — same clock, same stamp)
+            if tl["e2e_ms"] is not None:
+                assert tl["phase_sum_ms"] == pytest.approx(
+                    tl["e2e_ms"], abs=1.0), (rid, tl)
+        # submit-time refusals never entered the engine: terminal-only
+        assert forensics.request_payload(3)["e2e_ms"] == 0.0
+        assert forensics.request_payload(5)["phases"] == {}
+        # a preemption event carries the victim-selection inputs
+        pre = [e for rid in (1, 4)
+               for e in forensics.request_payload(rid)["events"]
+               if e["kind"] == "preempt"]
+        assert pre, "no preempt event on any completed timeline"
+        for e in pre:
+            # victim priority/tenant fold into the timeline header;
+            # the event keeps the remaining selection inputs
+            assert {"policy", "slot", "prior_preemptions",
+                    "work", "discarded"} <= set(e)
+            assert e["policy"] in ("slo", "youngest")
+        # and the preempted timeline accumulated preempted_out time
+        owner = next(rid for rid in (1, 4)
+                     if any(e["kind"] == "preempt" for e in
+                            forensics.request_payload(rid)["events"]))
+        assert forensics.request_payload(owner)["phases"][
+            "preempted_out"] > 0
+        # decision audit ring saw the policy actions
+        kinds = set(forensics.forensics_payload()
+                    ["decisions"]["by_kind"])
+        assert {"shed", "displace", "admit", "preempt"} <= kinds
+        # satellite pin: shed/expire/preempt lifecycle instants are
+        # tenant-attributed
+        evs = trace.events()
+        for name, tenant in (("serving.shed", {"a", "c"}),
+                             ("serving.expire", {"a"}),
+                             ("serving.preempt", {"a", "b"})):
+            hits = [e for e in evs if e["name"] == name]
+            assert hits, name
+            for e in hits:
+                assert e["args"].get("tenant") in tenant, (name, e)
+
+    def test_defer_reasons_recorded(self, mon):
+        """A blocked queue records typed admission deferrals
+        (coalesced — bounded events however long the wait)."""
+        eng, cfg = _engine(num_slots=1, max_len=16, page_size=4,
+                           num_pages=4, decode_chunk=2)
+        eng.run(_reqs(cfg, lens=(4, 4), new=(8, 4)))
+        tl = forensics.request_payload(1)
+        defers = [e for e in tl["events"] if e["kind"] == "defer"]
+        assert defers, tl
+        assert all(e["reason"] in ("no_free_slot", "watermark",
+                                   "alloc_failed", "tenant_cap")
+                   for e in defers)
+
+    def test_off_path_zero_registrations(self):
+        monitor.reset()
+        assert not monitor.enabled()
+        eng, cfg = _engine(num_slots=2, max_len=32, page_size=4,
+                           decode_chunk=2)
+        eng.run(_reqs(cfg, lens=(4,), new=(3,)))
+        assert forensics.tracked() == 0
+        assert forensics.decisions() == []
+        assert forensics.attribution_table() == {}
+        assert forensics.flight_block() is None
+        assert monitor.snapshot() == {}
+
+    @pytest.mark.slow  # tier-1 budget: same zero-sync contract pinned
+    # fast by the SLO plane's cost-record test; forensics rides the
+    # identical seams
+    def test_zero_added_syncs_at_any_rate(self, mon, monkeypatch):
+        """Forensics is pure host bookkeeping at seams the engine
+        already synchronized: at exec sample rate 0 AND 1, with a
+        preemption-forcing pool, zero added block_until_ready."""
+        calls = []
+        monkeypatch.setattr(
+            exectime, "_block_until_ready",
+            lambda outputs: calls.append(1))
+        for rate in (0, 1):
+            exectime.set_sample_rate(rate)
+            eng, cfg = _engine(num_slots=2, max_len=16, page_size=4,
+                               num_pages=5, decode_chunk=2)
+            eng.run(_reqs(cfg, lens=(4, 4, 4), new=(8, 8, 8)))
+            assert eng.stats.preempted >= 1
+            assert forensics.tracked() == 3     # plane was live
+            assert calls == [], f"rate {rate} added {len(calls)} syncs"
+            monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# failover coordinator: strand lineage + coordinator terminals
+# ---------------------------------------------------------------------------
+
+class _Req:
+    """Duck-typed request: exactly the attributes the journal reads."""
+
+    def __init__(self, rid, prompt=(1, 2, 3), max_new_tokens=4,
+                 temperature=0.0, tenant="t0", priority=0,
+                 deadline_s=None, prompt_spec=None, key=None):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.tenant = tenant
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.prompt_spec = prompt_spec
+        self.key = key
+
+
+class TestFailoverForensics:
+    def test_strand_redispatch_lineage_and_quarantine(self, mon,
+                                                      tmp_path):
+        from paddle_tpu.inference import failover as fo
+        j = fo.AdmissionJournal("r0", dir_path=str(tmp_path))
+        j.admit(_Req(7))
+        j.admit(_Req(8))
+        c = fo.FailoverCoordinator(heartbeat_dir=str(tmp_path),
+                                   quarantine_attempts=2)
+        assert c.note_replaced("r0", now=10.0) == 2
+        tl = forensics.request_payload(7)
+        (ev,) = [e for e in tl["events"] if e["kind"] == "strand"]
+        assert ev["replica"] == "r0" and ev["attempts"] == 1
+        assert tl["recovered_from"] == ["r0"]
+        # re-dispatch hop lands on the timeline
+        for rec in c.due(11.0):
+            c.redispatched(rec, "r1", now=11.0)
+        tl = forensics.request_payload(7)
+        assert [e["kind"] for e in tl["events"]].count("redispatch") \
+            == 1
+        # the survivor dies too: second strand quarantines (attempts
+        # bound) with ONE coordinator terminal event
+        j1 = fo.AdmissionJournal("r1", dir_path=str(tmp_path))
+        for rid in (7, 8):
+            q = _Req(rid)
+            q._failover_attempts = 1          # lineage rides the req
+            q._recovered_from = ["r0"]
+            j1.admit(q)
+        assert c.note_replaced("r1", now=20.0) == 2
+        for rid in (7, 8):
+            tl = forensics.request_payload(rid)
+            assert tl["state"] == "quarantined", tl
+            assert len(_terminal_events(tl)) == 1
+        # breaker transitions land in the decision ring
+        for _ in range(3):
+            c.admission_result("r2", ok=False, now=30.0)
+        kinds = forensics.forensics_payload()["decisions"]["by_kind"]
+        assert kinds.get("breaker") == 1
+
+
+# ---------------------------------------------------------------------------
+# surfaces: routes, flight record, chrome trace, scorecard
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestSurfaces:
+    def _seed_plane(self):
+        f = forensics
+        f.note(42, "enqueue", t=0.0, tenant="a")
+        f.note(42, "admit", t=1.5)
+        f.note(42, "first_token", t=1.6)
+        f.note_terminal(42, "completed", t=2.0)
+        f.decision("admit", rid=42, group=1)
+
+    def test_routes_end_to_end(self, mon):
+        self._seed_plane()
+        srv = server.start_server(port=0)
+        status, body = _get(f"{srv.url}/forensics")
+        assert status == 200
+        p = json.loads(body)
+        assert p["kind"] == "paddle_tpu.forensics"
+        assert p["requests"]["42"]["state"] == "completed"
+        assert p["attribution"]["ttft_p99_ms"]["top_cause"] \
+            == "queue_wait"
+        assert p["slowest"][0]["rid"] == 42
+        status, body = _get(f"{srv.url}/requests/42")
+        assert status == 200
+        tl = json.loads(body)
+        assert tl["rid"] == 42 and tl["phase_sum_ms"] == \
+            pytest.approx(tl["e2e_ms"])
+        status, body = _get(f"{srv.url}/requests/999")
+        assert status == 404 and b"no timeline" in body
+        _, idx = _get(f"{srv.url}/")
+        routes = json.loads(idx)["routes"]
+        assert "/forensics" in routes and "/requests/<rid>" in routes
+        # the tracked gauge registered at payload build
+        assert monitor.snapshot()["gauges"][
+            "serving.forensics.requests.tracked"] == 1
+
+    def test_flight_record_carries_forensics_block(self, mon):
+        self._seed_plane()
+        p = trace.flight_payload(reason="test")
+        assert p["forensics"]["tracked"] == 1
+        assert p["forensics"]["slowest"][0]["rid"] == 42
+        assert p["forensics"]["attribution"]["ttft_p99_ms"][
+            "violations"] == 1
+        # guarded: a broken forensics payload never kills the dump
+        import paddle_tpu.monitor.forensics as f
+
+        def boom(*a, **k):
+            raise RuntimeError("boom")
+        orig = f.flight_block
+        f.flight_block = boom
+        try:
+            assert trace.flight_payload()["forensics"] is None
+        finally:
+            f.flight_block = orig
+
+    def test_chrome_trace_links_serving_events(self, mon, tmp_path):
+        self._seed_plane()
+        trace.instant("serving.retire", rid=42, tokens=3)
+        trace.instant("serving.retire", rid=7777)      # no timeline
+        out = tmp_path / "trace.json"
+        trace.export_chrome_trace(str(out))
+        evs = json.loads(out.read_text())["traceEvents"]
+        linked = [e for e in evs
+                  if e.get("args", {}).get("rid") == 42]
+        assert linked
+        assert all(e["args"]["forensics"] == "/requests/42"
+                   for e in linked)
+        bare = [e for e in evs
+                if e.get("args", {}).get("rid") == 7777]
+        assert bare and all("forensics" not in e["args"]
+                            for e in bare)
+
+    def test_scorecard_attribution_blocks(self, mon):
+        from paddle_tpu.loadgen.replay import ReplayResult
+        from paddle_tpu.loadgen.scorecard import build_scorecard
+        from paddle_tpu.loadgen.traces import generate_trace
+        self._seed_plane()
+        tr = generate_trace(1, duration_s=0.1, rate=30.0)
+        terminal = {
+            0: {"state": "completed", "tenant": "a", "tokens": 4,
+                "prompt_len": 4, "preemptions": 2},
+            1: {"state": "completed", "tenant": "a", "tokens": 4,
+                "prompt_len": 4, "preemptions": 0,
+                "recovered_from": ["r0"]},
+            2: {"state": "shed", "tenant": "b", "tokens": 0,
+                "prompt_len": 4, "reason": "displaced by rid 9",
+                "retry_after_s": 0.5},
+            3: {"state": "expired", "tenant": "b", "tokens": 0,
+                "prompt_len": 4},
+        }
+        res = ReplayResult(
+            trace=tr, terminal=terminal, episodes=[],
+            engine_stats={"engine0": {"generated": 8, "discarded": 0}},
+            engine_flags={}, steps=10, dt_per_step=0.01, wall_s=1.0,
+            offered=4, offered_tokens=16)
+        card = build_scorecard(res)
+        det = card["deterministic"]["attribution"]
+        assert det == {"requests_preempted": 1, "preemptions": 2,
+                       "displaced": 1, "expired": 1, "recovered": 1,
+                       "quarantined": 0, "lost": 0}
+        # the timing half is the forensics violation-cause table
+        tim = card["timing"]["attribution"]
+        assert tim["ttft_p99_ms"]["top_cause"] == "queue_wait"
+
+
+# ---------------------------------------------------------------------------
+# marginal overhead (the acceptance number, PR 12 interleaved harness)
+# ---------------------------------------------------------------------------
+
+def measure_forensics_overhead(windows=6):
+    """Median per-window MARGINAL engine overhead of the forensics
+    plane: both arms run monitor-ON (the plane the acceptance gate
+    compares against), the baseline arm with every forensics entry
+    point no-oped. Interleaved windows of the serving_paged CPU trace
+    shape, PR 12 pattern. Returns (median_pct, pcts). Measured on
+    this container: see CHANGES.md."""
+    import time as _time
+
+    import jax
+    from paddle_tpu.inference import Request, ServingEngine
+    from paddle_tpu.models import llama as L
+
+    cfg = L.llama_tiny(num_hidden_layers=2)
+    params = jax.jit(lambda: L.init_params(cfg, jax.random.PRNGKey(0)))()
+    jax.block_until_ready(params["embed"])
+    rng = np.random.default_rng(42)
+    trace_lens = [(int(rng.choice((4, 8, 16))),
+                   int(rng.choice((4, 8, 16)))) for _ in range(16)]
+    trace_lens.sort(key=lambda t: -t[1])
+    max_len = max(p for p, _ in trace_lens) + max(g for _, g in
+                                                  trace_lens)
+    pt.set_flags({"FLAGS_enable_monitor": True})
+    hooks = ("note", "note_defer", "note_spec", "note_terminal",
+             "decision")
+    saved = {h: getattr(forensics, h) for h in hooks}
+
+    def run_once(base, live):
+        for h in hooks:
+            setattr(forensics, h,
+                    saved[h] if live else (lambda *a, **k: None))
+        eng = ServingEngine(L, params, cfg, num_slots=4,
+                            max_len=max_len, page_size=4,
+                            decode_chunk=8)
+        reqs = [Request(rid=base + i,
+                        prompt=rng.integers(0, cfg.vocab_size, (p,))
+                        .astype(np.int32), max_new_tokens=g,
+                        tenant=f"t{i % 4}")
+                for i, (p, g) in enumerate(trace_lens)]
+        t0 = _time.perf_counter()
+        eng.run(reqs)
+        return _time.perf_counter() - t0
+
+    try:
+        run_once(0, False), run_once(10_000, True)    # compile + warm
+        pcts = []
+        for w in range(windows):
+            t_off = run_once(20_000 + w * 1000, False)
+            t_on = run_once(50_000 + w * 1000, True)
+            pcts.append((t_on - t_off) / t_off * 100.0)
+    finally:
+        for h in hooks:
+            setattr(forensics, h, saved[h])
+        pt.set_flags({"FLAGS_enable_monitor": False})
+        monitor.reset()
+    pcts.sort()
+    mid = len(pcts) // 2
+    med = pcts[mid] if len(pcts) % 2 else (pcts[mid - 1]
+                                           + pcts[mid]) / 2
+    return med, pcts
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_forensics_overhead_harness():
+    """Timelines + decision ring are bounded host-side appends at
+    seams that already synchronized: the forensics-live engine stays
+    within noise of forensics-stubbed, monitor ON in both arms. The
+    tier-1 bound is loose (shared container swings ±10% window to
+    window); the <1% acceptance number is the interleaved-window
+    median recorded in CHANGES.md and docs/observability.md."""
+    med, pcts = measure_forensics_overhead()
+    print(f"\nforensics marginal overhead: median {med:+.2f}% "
+          f"windows {[f'{p:+.1f}' for p in pcts]}")
+    assert med < 10.0, (med, pcts)

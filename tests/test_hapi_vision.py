@@ -151,6 +151,8 @@ class TestVision:
         x = paddle.to_tensor(RNG.normal(size=inshape).astype("float32"))
         assert net(x).shape == [inshape[0], classes]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): convergence run; fit_evaluate_predict
+    # + model_forward_shapes keep the hapi fit seam fast
     def test_lenet_trains_on_fakedata(self):
         paddle.seed(0)
         net = models.LeNet()

@@ -453,6 +453,8 @@ class TestEagerLlama:
                               max_new_tokens=3, num_beams=2)
         np.testing.assert_array_equal(bt.numpy(), np.asarray(bw))
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): convergence run;
+    # eager_matches_functional_forward keeps the Layer-vs-functional seam fast
     def test_eager_training_memorizes(self):
         cfg = tiny(num_hidden_layers=1)
         m = L.LlamaForCausalLM(cfg)

@@ -658,6 +658,8 @@ class TestSentinelAttribution:
               if e["name"] == "anomaly.skip"]
         assert ev and ev[-1]["args"]["worst_layer"] == wl["name"]
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): healthy-path arm;
+    # corrupt_batch_names_worst_layer_in_health_report keeps attribution fast
     def test_healthy_steps_keep_finite_attribution(self):
         pt.set_flags({"FLAGS_enable_monitor": True})
         monitor.reset()

@@ -319,6 +319,8 @@ class TestKVQuantDecodeParity:
             np.testing.assert_array_equal(got[i], want[i])
         assert isinstance(eng.cache.pool["k"], dict)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): kv-quant family re-run; llama_greedy_fallback
+    # keeps the dequant-parity seam fast
     def test_moe_greedy_fallback(self):
         cfg = M.moe_tiny()
         params = M.init_params(cfg, jax.random.PRNGKey(3))
@@ -327,6 +329,8 @@ class TestKVQuantDecodeParity:
         for i in want:
             np.testing.assert_array_equal(got[i], want[i])
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): interpret-kernel arm; llama_greedy_fallback +
+    # the TestKVQuantKernel parity units keep the seam fast
     def test_llama_greedy_interpret_kernel(self):
         """The quant KERNEL (interpret) slotted into the decode seam
         produces the fallback's tokens — both decode arms agree."""
@@ -377,6 +381,8 @@ class TestKVQuantDecodeParity:
         # the radix cache held pages across requests (prefill skipped)
         assert eng.stats.prefix_tokens_saved > 0
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): composition sweep; prefix_cache_composition +
+    # test_prefix_cache's spec greedy-identity pins keep the seam fast
     def test_spec_decode_composition(self):
         """Speculative verify windows rewrite quantized pages in place
         (paged_verify_window's gather/requant path): tokens match the

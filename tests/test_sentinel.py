@@ -174,6 +174,8 @@ class TestGuardedStep:
         assert bool(h3["finite"]) and np.isfinite(float(loss3))
         assert not _tree_identical(p2, p3)
 
+    @pytest.mark.slow  # tier-1 budget (ISSUE 20 rebalance): guard family re-run;
+    # llama_nan_batch_params_byte_identical_then_continues keeps the seam fast
     def test_moe_nan_batch_params_byte_identical(self):
         cfg = M.moe_tiny(vocab_size=V)
         params = M.init_params(cfg, jax.random.PRNGKey(0))
